@@ -1,0 +1,38 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU mesh (the JAX analogue of the reference's 2-process
+gloo pool, tests/unittests/conftest.py:26-60) — distributed behaviour is tested with
+shard_map over these devices, no real cluster needed.
+
+Must run before jax initialises its backends, hence env vars at import time.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_DEVICES = 8
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:NUM_DEVICES])
+    return Mesh(devices, ("batch",))
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)

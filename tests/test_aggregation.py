@@ -1,0 +1,86 @@
+"""Aggregation metric tests (mirrors reference tests/unittests/bases/test_aggregation.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "np_fn"),
+    [(SumMetric, np.sum), (MaxMetric, np.max), (MinMetric, np.min)],
+)
+def test_simple_aggregators(metric_cls, np_fn):
+    vals = np.random.randn(4, 10).astype(np.float32)
+    m = metric_cls()
+    for row in vals:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), np_fn(vals), rtol=1e-5)
+
+
+def test_cat_metric():
+    vals = np.random.randn(4, 10).astype(np.float32)
+    m = CatMetric()
+    for row in vals:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), vals.reshape(-1), rtol=1e-6)
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    m.update(jnp.asarray(5.0), weight=2.0)
+    assert abs(float(m.compute()) - 3.2) < 1e-6
+
+
+@pytest.mark.parametrize("nan_strategy", ["error", "warn", "ignore", 0.0])
+def test_nan_strategies(nan_strategy):
+    m = SumMetric(nan_strategy=nan_strategy)
+    x = jnp.asarray([1.0, float("nan"), 2.0])
+    if nan_strategy == "error":
+        with pytest.raises(RuntimeError):
+            m.update(x)
+    elif nan_strategy == "warn":
+        with pytest.warns(UserWarning):
+            m.update(x)
+        assert float(m.compute()) == 3.0
+    else:
+        m.update(x)
+        assert float(m.compute()) == 3.0
+
+
+def test_bad_nan_strategy_raises():
+    with pytest.raises(ValueError):
+        SumMetric(nan_strategy="bogus")
+
+
+def test_running_mean():
+    m = RunningMean(window=3)
+    for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        m.update(v)
+    # last 3: 3,4,5
+    assert abs(float(m.compute()) - 4.0) < 1e-6
+
+
+def test_running_sum():
+    m = RunningSum(window=2)
+    for v in [1.0, 2.0, 3.0]:
+        m.update(v)
+    assert abs(float(m.compute()) - 5.0) < 1e-6
+
+
+def test_mean_metric_ddp_semantics(mesh):
+    """MeanMetric synced over the mesh equals the global weighted mean."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    m = MeanMetric()
+
+    def step(x):
+        st = m.functional_update(m.init_state(), x)
+        st = m.functional_sync(st, "batch")
+        return m.functional_compute(st)
+
+    data = jnp.arange(24.0).reshape(8, 3)
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    assert abs(float(out) - float(data.mean())) < 1e-6

@@ -1,0 +1,279 @@
+"""Base Metric API lifecycle tests (mirrors reference tests/unittests/bases/test_metric.py)."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CompositionalMetric, Metric, MeanMetric, SumMetric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+class DummyMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.x
+
+
+class DummyListMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.x.append(jnp.asarray(x))
+
+    def compute(self):
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(self.x) if self.x else jnp.asarray([])
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError):
+        m.add_state("bad", default=[1, 2], dist_reduce_fx="sum")
+    with pytest.raises(ValueError):
+        m.add_state("bad", default=jnp.asarray(0.0), dist_reduce_fx="unknown")
+
+
+def test_update_and_compute():
+    m = DummyMetric()
+    assert not m.update_called
+    m.update(1.0)
+    m.update(2.0)
+    assert m.update_called
+    assert m.update_count == 2
+    assert float(m.compute()) == 3.0
+
+
+def test_reset():
+    m = DummyMetric()
+    m.update(5.0)
+    m.reset()
+    assert m.update_count == 0
+    assert float(m.compute()) == 0.0
+
+    lm = DummyListMetric()
+    lm.update(jnp.asarray([1.0]))
+    lm.reset()
+    assert lm.x == []
+
+
+def test_compute_cache_invalidation():
+    m = DummyMetric()
+    m.update(1.0)
+    assert float(m.compute()) == 1.0
+    m.update(1.0)
+    assert float(m.compute()) == 2.0
+
+
+def test_forward_dual_path():
+    m = DummyMetric()
+    batch_val = m(2.0)
+    assert float(batch_val) == 2.0
+    batch_val = m(3.0)
+    assert float(batch_val) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_forward_full_state_update_path():
+    class FullState(DummyMetric):
+        full_state_update = True
+
+    m = FullState()
+    assert float(m(2.0)) == 2.0
+    assert float(m(3.0)) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_frozen_metadata():
+    m = DummyMetric()
+    for attr in ("is_differentiable", "higher_is_better", "full_state_update"):
+        with pytest.raises(RuntimeError):
+            setattr(m, attr, True)
+
+
+def test_pickle_roundtrip():
+    m = DummyMetric()
+    m.update(4.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 4.0
+    m2.update(1.0)
+    assert float(m2.compute()) == 5.0
+    assert float(m.compute()) == 4.0
+
+
+def test_clone_independence():
+    m = DummyMetric()
+    m.update(1.0)
+    c = m.clone()
+    c.update(10.0)
+    assert float(m.compute()) == 1.0
+    assert float(c.compute()) == 11.0
+
+
+def test_state_dict_persistence():
+    m = DummyMetric()
+    m.update(3.0)
+    assert m.state_dict() == {}
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "x" in sd and float(sd["x"]) == 3.0
+    m2 = DummyMetric()
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 3.0
+
+
+def test_metric_state_property():
+    m = DummyMetric()
+    m.update(2.0)
+    assert set(m.metric_state) == {"x"}
+    assert float(m.metric_state["x"]) == 2.0
+
+
+def test_hash_changes_with_state():
+    m = DummyMetric()
+    h0 = hash(m)
+    m.update(1.0)
+    assert hash(m) != h0
+
+
+def test_double_sync_raises():
+    m = DummyMetric(sync_on_compute=False)
+    m.update(1.0)
+    m._is_synced = True
+    with pytest.raises(TorchMetricsUserError):
+        m.sync()
+    m._is_synced = False
+    with pytest.raises(TorchMetricsUserError):
+        m.unsync()
+        m.unsync()
+
+
+def test_functional_api_pure():
+    m = DummyMetric()
+    st = m.init_state()
+    st2 = m.functional_update(st, 5.0)
+    assert float(st["x"]) == 0.0  # input untouched
+    assert float(st2["x"]) == 5.0
+    assert float(m.functional_compute(st2)) == 5.0
+    assert float(m.compute()) == 0.0  # live state untouched
+
+    merged = m.merge_states(st2, st2)
+    assert float(merged["x"]) == 10.0
+
+    st3, bv = m.functional_forward(st2, 2.0)
+    assert float(bv) == 2.0
+    assert float(st3["x"]) == 7.0
+
+
+def test_functional_update_under_jit():
+    m = DummyMetric()
+    up = jax.jit(m.functional_update)
+    st = m.init_state()
+    for i in range(3):
+        st = up(st, float(i))
+    assert float(m.functional_compute(st)) == 3.0
+
+
+def test_filter_kwargs():
+    m = DummyMetric()
+    assert m._filter_kwargs(x=1, bogus=2) == {"x": 1}
+
+
+def test_to_device():
+    m = DummyMetric()
+    m.update(1.0)
+    m.to(jax.devices()[0])
+    assert float(m.compute()) == 1.0
+
+
+def test_set_dtype():
+    m = DummyMetric()
+    m.update(1.0)
+    m.set_dtype(jnp.bfloat16)
+    assert m._state["x"].dtype == jnp.bfloat16
+    m.float()
+    assert m._state["x"].dtype == jnp.float32
+
+
+class TestComposition:
+    def test_metric_plus_scalar(self):
+        m = DummyMetric()
+        c = m + 1.0
+        assert isinstance(c, CompositionalMetric)
+        m.update(2.0)
+        assert float(c.compute()) == 3.0
+
+    def test_metric_plus_metric(self):
+        a, b = DummyMetric(), DummyMetric()
+        c = a + b
+        c.update(2.0)  # fans out to both
+        assert float(c.compute()) == 4.0
+
+    def test_many_ops(self):
+        m = DummyMetric()
+        m.update(4.0)
+        assert float((m * 2).compute()) == 8.0
+        assert float((m - 1).compute()) == 3.0
+        assert float((m / 2).compute()) == 2.0
+        assert float((m**2).compute()) == 16.0
+        assert float((m % 3).compute()) == 1.0
+        assert float(abs(-1 * m).compute()) == 4.0
+        assert bool((m > 3).compute())
+        assert not bool((m < 3).compute())
+
+    def test_forward_composition(self):
+        m = DummyMetric()
+        c = m + 1.0
+        out = c(2.0)
+        assert float(out) == 3.0
+
+    def test_reset_propagates(self):
+        m = DummyMetric()
+        c = m + 1.0
+        m.update(5.0)
+        c.reset()
+        assert float(m.compute()) == 0.0
+
+
+def test_sync_shard_map(mesh):
+    """In-trace psum sync: per-device partial sums reduce to the global sum."""
+    from jax.sharding import PartitionSpec as P
+
+    m = DummyMetric()
+
+    def step(x):
+        st = m.functional_update(m.init_state(), x)
+        st = m.functional_sync(st, "batch")
+        return m.functional_compute(st)
+
+    data = jnp.arange(8.0).reshape(8, 1)
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    assert float(out) == float(data.sum())
+
+
+def test_oo_sync_inside_trace(mesh):
+    """The OO shell's compute() traces its collective when called under shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    def step(x):
+        m = DummyMetric()
+        m.update(x)
+        return m.compute()
+
+    data = jnp.arange(8.0).reshape(8, 1)
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    assert float(out) == float(data.sum())

@@ -1,0 +1,19 @@
+"""torchmetrics_tpu — TPU-native metrics framework on JAX/XLA.
+
+A from-scratch re-design of the TorchMetrics capability surface
+(reference: randombenj/torchmetrics) for TPU: state-as-pytree pure core,
+lax collectives over device meshes for distributed sync, jit-traceable
+update/compute, dual functional/modular API.
+"""
+__version__ = "0.1.0"
+
+from torchmetrics_tpu.aggregation import (  # noqa: F401
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from torchmetrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401

@@ -1,0 +1,248 @@
+"""Aggregation metrics (reference aggregation.py, 727 LoC).
+
+``BaseAggregator`` with nan_strategy in {"error","warn","ignore", float-replacement,
+"disable"}; concrete MaxMetric/MinMetric/SumMetric/CatMetric/MeanMetric and the
+Running* variants (built on the Running wrapper, see wrappers/running.py).
+
+TPU note: nan handling is expressed with ``jnp.where`` masks (trace-safe); the
+"error"/"warn" strategies need concrete values and therefore only act eagerly —
+under jit they degrade to "ignore"-style masking, matching XLA semantics.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _is_concrete(x: Any) -> bool:
+    import jax.core
+
+    return not isinstance(x, jax.core.Tracer)
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (reference aggregation.py:30).
+
+    Args:
+        fn: reduction applied on update ("sum", "max", "min", or callable)
+        default_value: default state value
+        nan_strategy: how to handle NaNs: "error", "warn", "ignore", "disable",
+            or a float replacement value.
+        state_name: name of the single state variable.
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.state_name = state_name
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None):
+        """Cast input to float array and handle NaNs per strategy (aggregation.py:75)."""
+        x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, jnp.ndarray) else x.astype(jnp.float32)
+        if weight is not None:
+            weight = (
+                jnp.asarray(weight, dtype=jnp.float32) if not isinstance(weight, jnp.ndarray) else weight.astype(jnp.float32)
+            )
+            weight = jnp.broadcast_to(weight, x.shape)
+        if self.nan_strategy == "disable":
+            return x, weight
+        nans = jnp.isnan(x)
+        nans_w = jnp.logical_or(nans, jnp.isnan(weight)) if weight is not None else nans
+        if self.nan_strategy in ("error", "warn") and _is_concrete(x):
+            anynan = bool(np.any(np.asarray(nans_w)))
+            if anynan:
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+        if self.nan_strategy in ("error", "warn", "ignore"):
+            # mask out nan entries (trace-safe, no boolean indexing)
+            if weight is not None:
+                weight = jnp.where(nans_w, 0.0, weight)
+            x = jnp.where(nans_w, self._nan_neutral(), x)
+        else:  # float replacement
+            x = jnp.where(nans_w, float(self.nan_strategy), x)
+        if weight is None:
+            weight = jnp.ones_like(x)
+        return x, weight
+
+    def _nan_neutral(self) -> float:
+        """Value that is a no-op for this aggregator's reduction."""
+        return 0.0
+
+    def update(self, value: Union[float, Array]) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._state[self.state_name]
+
+
+class MaxMetric(BaseAggregator):
+    """Running max aggregation (reference aggregation.py:114)."""
+
+    full_state_update = True
+    higher_is_better = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, dtype=jnp.float32), nan_strategy, state_name="max_value", **kwargs)
+
+    def _nan_neutral(self) -> float:
+        return -float("inf")
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.max_value = jnp.maximum(self.max_value, value.max() if value.size else jnp.asarray(-jnp.inf))
+
+
+class MinMetric(BaseAggregator):
+    """Running min aggregation (reference aggregation.py:219)."""
+
+    full_state_update = True
+    higher_is_better = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, dtype=jnp.float32), nan_strategy, state_name="min_value", **kwargs)
+
+    def _nan_neutral(self) -> float:
+        return float("inf")
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.min_value = jnp.minimum(self.min_value, value.min() if value.size else jnp.asarray(jnp.inf))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum aggregation (reference aggregation.py:324)."""
+
+    full_state_update = False
+    higher_is_better = None
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.sum_value = self.sum_value + value.sum()
+
+
+class CatMetric(BaseAggregator):
+    """Concatenation aggregation (reference aggregation.py:429)."""
+
+    full_state_update = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        return dim_zero_cat(self.value) if self.value else jnp.asarray([])
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference aggregation.py:493): states mean_value+weight."""
+
+    full_state_update = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        self.mean_value = self.mean_value + (value * weight).sum()
+        self.weight = self.weight + weight.sum()
+
+    def compute(self) -> Array:
+        from torchmetrics_tpu.utils.compute import _safe_divide
+
+        return _safe_divide(self.mean_value, self.weight)
+
+
+def _running_factory():
+    from torchmetrics_tpu.wrappers.running import Running
+
+    return Running
+
+
+class RunningMean(Metric):
+    """Mean over the last ``window`` updates (reference aggregation.py:616).
+
+    Implemented directly (rather than through the Running wrapper) as a
+    fixed-capacity ring buffer — static shapes, jit-native.
+    """
+
+    full_state_update = False
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.window = int(window)
+        self.nan_strategy = nan_strategy
+        self.add_state("values", default=jnp.zeros(self.window, dtype=jnp.float32), dist_reduce_fx=None)
+        self.add_state("mask", default=jnp.zeros(self.window, dtype=jnp.bool_), dist_reduce_fx=None)
+        self.add_state("cursor", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx=None)
+
+    def _nan_filter(self, value) -> Array:
+        value = jnp.asarray(value, dtype=jnp.float32)
+        if self.nan_strategy in ("error", "warn", "ignore"):
+            if _is_concrete(value) and bool(np.any(np.isnan(np.asarray(value)))):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+            value = jnp.where(jnp.isnan(value), 0.0, value)
+        elif isinstance(self.nan_strategy, float):
+            value = jnp.where(jnp.isnan(value), float(self.nan_strategy), value)
+        return value
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._nan_filter(value).mean()
+        idx = self.cursor % self.window
+        self.values = self.values.at[idx].set(value)
+        self.mask = self.mask.at[idx].set(True)
+        self.cursor = self.cursor + 1
+
+    def compute(self) -> Array:
+        from torchmetrics_tpu.utils.compute import _safe_divide
+
+        return _safe_divide((self.values * self.mask).sum(), self.mask.sum())
+
+
+class RunningSum(RunningMean):
+    """Sum over the last ``window`` updates (reference aggregation.py:673)."""
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._nan_filter(value).sum()
+        idx = self.cursor % self.window
+        self.values = self.values.at[idx].set(value)
+        self.mask = self.mask.at[idx].set(True)
+        self.cursor = self.cursor + 1
+
+    def compute(self) -> Array:
+        return (self.values * self.mask).sum()

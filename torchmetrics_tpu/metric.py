@@ -1,0 +1,884 @@
+"""Stateful ``Metric`` core — TPU-native redesign of reference metric.py (1,232 LoC).
+
+Architecture (SURVEY.md §7): JAX demands pure functions under jit, so the true core
+is *state-as-pytree*:
+
+    state = metric._defaults-derived dict of jnp arrays (or lists for growing states)
+    metric.functional_update(state, *batch) -> state'          # pure, jit/shard_map-safe
+    metric.functional_compute(state)        -> value           # pure
+    metric.merge_states(a, b)               -> state           # per-field declared reduction
+    sync_states(state, reductions, axis)    -> state           # lax.psum/all_gather
+
+The familiar stateful object (``m.update(...)``, ``m.compute()``, ``m(...)``,
+operator algebra, ``reset/clone/state_dict``) is a thin host-side shell over that
+pure core: attributes named in ``add_state`` are routed into the live state dict,
+so subclasses read and assign ``self.tp += tp`` exactly like the reference
+(metric.py:465-487) while the same ``update`` body traces cleanly when called
+through the functional API inside a jitted train step.
+
+Distributed sync: each state's ``dist_reduce_fx`` declaration drives
+- local merging (``forward``'s reduce-state path, reference metric.py:399-431),
+- in-trace collectives (``lax.psum/pmean/pmax/pmin/all_gather`` over a named mesh
+  axis — reference metric.py:433-463 + utilities/distributed.py rebuilt as
+  parallel/sync.py), and
+- host-side multi-host sync (DCN process_allgather).
+"""
+from __future__ import annotations
+
+import copy
+import functools
+import inspect
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.parallel.sync import (
+    Reduction,
+    host_sync_value,
+    in_named_axis_context,
+    sync_states,
+    sync_value,
+)
+from torchmetrics_tpu.utils.data import (
+    _flatten,
+    _squeeze_if_scalar,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def jit_distributed_available() -> bool:
+    """Default world check (reference metric.py:45-47): multi-process JAX runtime."""
+    return jax.process_count() > 1
+
+
+class Metric:
+    """Base class for all metrics.
+
+    Subclasses declare states in ``__init__`` via :meth:`add_state`, implement
+    ``update(self, ...)`` mutating those states, and ``compute(self)`` returning the
+    metric value. See reference metric.py:50 for the API this mirrors.
+
+    Args:
+        kwargs: common keyword arguments processed here (reference metric.py:113-148):
+
+            - ``compute_on_cpu``: move list states to host after update.
+            - ``dist_sync_on_step``: sync state when computing the batch value in
+              ``forward``.
+            - ``sync_axis``: the named mesh axis (or axes) collectives run over when
+              syncing inside a traced context. Defaults to ``"batch"``.
+            - ``dist_sync_fn``: override the per-state sync function
+              ``(value, reduction, axis_name) -> value``.
+            - ``distributed_available_fn``: override the world check.
+            - ``sync_on_compute``: sync state automatically in ``compute`` (default True).
+            - ``compute_with_cache``: cache the result of ``compute`` (default True).
+    """
+
+    __jit_unused_properties__: List[str] = ["is_differentiable"]
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = None
+
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        # internal bookkeeping set up *before* anything routes through __setattr__
+        object.__setattr__(self, "_state", {})
+        self._defaults: Dict[str, Any] = {}
+        self._reductions: Dict[str, Reduction] = {}
+        self._persistent: Dict[str, bool] = {}
+
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        if not isinstance(self.dist_sync_on_step, bool):
+            raise ValueError(f"Expected keyword argument `dist_sync_on_step` to be an `bool` but got {self.dist_sync_on_step}")
+        self.sync_axis = kwargs.pop("sync_axis", "batch")
+        self.process_group = kwargs.pop("process_group", None)
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
+            raise ValueError(f"Expected keyword argument `dist_sync_fn` to be an callable function but got {self.dist_sync_fn}")
+        self.distributed_available_fn = kwargs.pop("distributed_available_fn", None) or jit_distributed_available
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        if not isinstance(self.sync_on_compute, bool):
+            raise ValueError(f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}")
+        self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        if not isinstance(self.compute_with_cache, bool):
+            raise ValueError(f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}")
+        if kwargs:
+            kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
+            raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
+
+        self._update_signature = inspect.signature(self.update)
+        self._update_fn: Callable = self.update  # raw bound method (pre-wrap)
+        self._compute_fn: Callable = self.compute
+        self.update: Callable = self._wrap_update(self.update)
+        self.compute: Callable = self._wrap_compute(self.compute)
+        self._computed: Any = None
+        self._update_count: int = 0
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        self._enable_grad = False
+        self._dtype_convert = False
+
+        self._cache: Optional[Dict[str, Any]] = None
+        self._is_synced = False
+
+    # ------------------------------------------------------------------ states
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, List],
+        dist_reduce_fx: Reduction = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a metric state (reference metric.py:195-278).
+
+        ``default`` is either a jnp array (fixed-shape accumulator) or an empty
+        list (growing accumulator). ``dist_reduce_fx`` in
+        {"sum","mean","max","min","cat", None, callable} declares how the state
+        merges across batches (forward), devices (mesh collectives) and hosts.
+        """
+        if not isinstance(default, (list, int, float, np.ndarray, jnp.ndarray)) and not hasattr(default, "shape"):
+            raise ValueError("state variable must be a jax array or an empty list")
+        if isinstance(default, list) and default:
+            raise ValueError("state variable must be a jax array or an *empty* list (any data must be appended via update)")
+        if dist_reduce_fx not in ("sum", "mean", "cat", "min", "max", None) and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if isinstance(default, (int, float)):
+            default = jnp.asarray(default)
+        if not isinstance(default, list):
+            default = jnp.asarray(default)
+        self._defaults[name] = copy.deepcopy(default)
+        self._reductions[name] = dist_reduce_fx
+        self._persistent[name] = persistent
+        self._state[name] = copy.deepcopy(default)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            return state[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in ("higher_is_better", "is_differentiable", "full_state_update", "plot_lower_bound", "plot_upper_bound", "plot_legend_name"):
+            raise RuntimeError(f"Can't change const `{name}`.")
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            state[name] = value
+            return
+        object.__setattr__(self, name, value)
+
+    @property
+    def metric_state(self) -> Dict[str, Any]:
+        """Current (live) state values (reference metric.py:190-193)."""
+        return {attr: self._state[attr] for attr in self._defaults}
+
+    @property
+    def update_called(self) -> bool:
+        return self._update_count > 0
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    @property
+    def device(self):
+        """Device of the first array state (reference tracks _device via probe)."""
+        for v in self._state.values():
+            if isinstance(v, jnp.ndarray):
+                return list(v.devices())[0]
+            if isinstance(v, list) and v:
+                return list(v[0].devices())[0]
+        return jax.devices()[0]
+
+    @property
+    def dtype(self):
+        for v in self._state.values():
+            if isinstance(v, jnp.ndarray) and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.dtype
+        return jnp.float32
+
+    # ------------------------------------------------------------- update path
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            try:
+                update(*args, **kwargs)
+            except TypeError as err:
+                if "got an unexpected keyword argument" in str(err) or "positional argument" in str(err):
+                    raise TypeError(
+                        f"Encountered an error while calling `update` of {type(self).__name__}: {err}"
+                    ) from err
+                raise
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
+
+        return wrapped_func
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Move list states to host memory (reference metric.py:489-494)."""
+        cpu = jax.devices("cpu")[0] if any(d.platform == "cpu" for d in jax.devices()) else None
+        for key, value in self._state.items():
+            if isinstance(value, list) and cpu is not None:
+                self._state[key] = [jax.device_put(v, cpu) for v in value]
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self._update_count == 0:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {type(self).__name__}"
+                    " was called before the ``update`` method which may lead to errors,"
+                    " as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                value = _squeeze_if_scalar(compute(*args, **kwargs))
+            if self.compute_with_cache:
+                self._computed = value
+            return value
+
+        return wrapped_func
+
+    def update(self, *_: Any, **__: Any) -> None:  # overridden by subclass; rebound in __init__
+        raise NotImplementedError
+
+    def compute(self) -> Any:  # overridden by subclass; rebound in __init__
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- forward paths
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate into global state AND return the batch value (metric.py:281-312)."""
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """2× update strategy (reference metric.py:314-357)."""
+        self.update(*args, **kwargs)
+        _update_count = self._update_count
+        self._to_sync = self.dist_sync_on_step
+        cache = self._copy_state_dict()
+        self._computed = None
+        self._enable_grad = True
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+        # restore context
+        self._update_count = _update_count
+        self._state = cache
+        self._computed = None
+        self._enable_grad = False
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """1× update + state-merge strategy (reference metric.py:359-397)."""
+        global_state = self._copy_state_dict()
+        _update_count = self._update_count
+        self.reset()
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        self._enable_grad = True
+
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._update_count = _update_count + 1
+        self._reduce_states(global_state)
+        self._computed = None
+        self._enable_grad = False
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        return batch_val
+
+    def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
+        """Merge incoming (global) state into current (batch) state (metric.py:399-431)."""
+        for attr in self._defaults:
+            local_state = self._state[attr]
+            global_state = incoming_state[attr]
+            reduce_fn = self._reductions[attr]
+            if reduce_fn == "sum":
+                reduced = global_state + local_state
+            elif reduce_fn == "mean":
+                reduced = ((self._update_count - 1) * global_state + local_state) / self._update_count
+            elif reduce_fn == "max":
+                reduced = jnp.maximum(global_state, local_state)
+            elif reduce_fn == "min":
+                reduced = jnp.minimum(global_state, local_state)
+            elif reduce_fn == "cat":
+                if isinstance(global_state, list) or isinstance(local_state, list):
+                    reduced = list(global_state) + list(local_state)
+                else:
+                    reduced = jnp.concatenate([jnp.atleast_1d(global_state), jnp.atleast_1d(local_state)])
+            elif reduce_fn is None and isinstance(global_state, jnp.ndarray):
+                reduced = jnp.stack([global_state, local_state])
+            elif reduce_fn is None and isinstance(global_state, list):
+                reduced = _flatten([global_state, local_state])
+            elif callable(reduce_fn):
+                reduced = reduce_fn(jnp.stack([jnp.asarray(global_state), jnp.asarray(local_state)]))
+            else:
+                reduced = global_state
+            self._state[attr] = reduced
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    # ------------------------------------------------------------------- sync
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+        axis_name: Optional[Union[str, Sequence[str]]] = None,
+    ) -> None:
+        """All-reduce states across devices/hosts per declared reductions.
+
+        Reference metric.py:496-538, rebuilt for the mesh: inside a traced context
+        that binds ``axis_name`` (pmap/shard_map), each state syncs with a single
+        lax collective. On a multi-process (multi-host) runtime outside jit, a DCN
+        process_allgather + local reduce runs instead. Single-process outside a
+        trace, sync is a no-op (states are already global).
+        """
+        if self._is_synced and should_sync:
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        axis_name = axis_name if axis_name is not None else self.sync_axis
+        in_trace = isinstance(axis_name, str) and in_named_axis_context(axis_name)
+        distributed_available = distributed_available or self.distributed_available_fn
+        if not should_sync or (not in_trace and not distributed_available()):
+            return
+        # cache prior to syncing (restored by unsync)
+        self._cache = self._copy_state_dict()
+
+        dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+        if dist_sync_fn is not None:
+            self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
+        elif in_trace:
+            self._state = sync_states(self._state, self._reductions, axis_name)
+        else:  # multi-host, outside jit
+            self._state = {k: host_sync_value(v, self._reductions.get(k)) for k, v in self._state.items()}
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore pre-sync local state (reference metric.py:540-560)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise TorchMetricsUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise TorchMetricsUserError("The internal cache should exist to unsync the Metric.")
+        self._state = self._cache
+        self._cache = None
+        self._is_synced = False
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+        axis_name: Optional[Union[str, Sequence[str]]] = None,
+    ) -> Generator[None, None, None]:
+        """Sync on entry, restore on exit (reference metric.py:562-597)."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+            axis_name=axis_name,
+        )
+        yield
+        self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    # ------------------------------------------------------- pure / functional
+    def _copy_state_dict(self) -> Dict[str, Any]:
+        """Shallow-copy live state; jnp arrays are immutable so no deepcopy needed."""
+        out: Dict[str, Any] = {}
+        for k, v in self._state.items():
+            out[k] = list(v) if isinstance(v, list) else v
+        return out
+
+    def state(self) -> Dict[str, Any]:
+        """The live state as a pytree (entry point of the pure API)."""
+        return self._copy_state_dict()
+
+    def init_state(self) -> Dict[str, Any]:
+        """A fresh default state pytree (the pure analogue of ``reset``)."""
+        return {k: (list(v) if isinstance(v, list) else jnp.asarray(v)) for k, v in copy.deepcopy(self._defaults).items()}
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure update: ``(state, batch) -> state'``. jit/vmap/shard_map-safe.
+
+        Swaps the given state in, runs the (unwrapped) ``update`` body, captures
+        the result and restores the live state — so the same subclass code serves
+        both the eager OO shell and fully traced training steps.
+        """
+        saved = self._state
+        try:
+            object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
+            self._update_fn(*args, **kwargs)
+            return self._copy_state_dict()
+        finally:
+            object.__setattr__(self, "_state", saved)
+
+    def functional_compute(self, state: Dict[str, Any]) -> Any:
+        """Pure compute: ``state -> value``. jit-safe."""
+        saved = self._state
+        try:
+            object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
+            return _squeeze_if_scalar(self._compute_fn())
+        finally:
+            object.__setattr__(self, "_state", saved)
+
+    def functional_forward(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> tuple:
+        """Pure forward: ``(state, batch) -> (state', batch_value)``."""
+        batch_state = self.functional_update(self.init_state(), *args, **kwargs)
+        batch_value = self.functional_compute(batch_state)
+        return self.merge_states(state, batch_state), batch_value
+
+    def functional_sync(self, state: Dict[str, Any], axis_name: Optional[Union[str, Sequence[str]]] = None) -> Dict[str, Any]:
+        """Pure in-trace sync: apply the declared collectives over ``axis_name``."""
+        return sync_states(state, self._reductions, axis_name or self.sync_axis)
+
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        """Merge two state pytrees per declared reductions (generalised Chan merge).
+
+        Count-weighted "mean" is impossible without counts, so subclasses holding
+        mean states carry explicit weight states (as the reference's MeanMetric
+        does); plain "mean" merges as the unweighted average.
+        """
+        out: Dict[str, Any] = {}
+        for attr in self._defaults:
+            fx = self._reductions[attr]
+            va, vb = a[attr], b[attr]
+            if fx == "sum":
+                out[attr] = va + vb
+            elif fx == "mean":
+                out[attr] = (va + vb) / 2
+            elif fx == "max":
+                out[attr] = jnp.maximum(va, vb)
+            elif fx == "min":
+                out[attr] = jnp.minimum(va, vb)
+            elif fx == "cat":
+                if isinstance(va, list) or isinstance(vb, list):
+                    out[attr] = list(va) + list(vb)
+                else:
+                    out[attr] = jnp.concatenate([jnp.atleast_1d(va), jnp.atleast_1d(vb)])
+            elif fx is None and isinstance(va, list):
+                out[attr] = list(va) + list(vb)
+            elif callable(fx):
+                out[attr] = fx(jnp.stack([jnp.asarray(va), jnp.asarray(vb)]))
+            else:
+                out[attr] = jnp.stack([jnp.atleast_1d(va), jnp.atleast_1d(vb)])
+        return out
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        """Install a state pytree as the live state (inverse of :meth:`state`)."""
+        for k in self._defaults:
+            if k not in state:
+                raise KeyError(f"state missing field {k!r}")
+            v = state[k]
+            self._state[k] = list(v) if isinstance(v, (list, tuple)) else v
+        self._computed = None
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        """Restore default states (reference metric.py:679-694)."""
+        self._update_count = 0
+        self._computed = None
+        for attr, default in self._defaults.items():
+            if isinstance(default, list):
+                self._state[attr] = []
+            else:
+                self._state[attr] = jnp.asarray(default)
+        self._cache = None
+        self._is_synced = False
+
+    def clone(self) -> "Metric":
+        """Deep copy of the metric (reference metric.py:696-698)."""
+        return copy.deepcopy(self)
+
+    def persistent(self, mode: bool = False) -> None:
+        """Toggle persistence of all states (reference metric.py:840-843)."""
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[Dict[str, Any]] = None, prefix: str = "") -> Dict[str, Any]:
+        """Serialize persistent states (reference metric.py:845-877)."""
+        destination = destination if destination is not None else {}
+        for key in self._defaults:
+            if not self._persistent[key]:
+                continue
+            current_val = self._state[key]
+            if isinstance(current_val, list):
+                destination[prefix + key] = [np.asarray(v) for v in current_val]
+            else:
+                destination[prefix + key] = np.asarray(current_val)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        """Restore states from :meth:`state_dict` output (reference metric.py:894-911)."""
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                value = state_dict[name]
+                if isinstance(value, list):
+                    self._state[key] = [jnp.asarray(v) for v in value]
+                else:
+                    self._state[key] = jnp.asarray(value)
+            elif strict and self._persistent[key]:
+                raise KeyError(f"Missing key {name!r} in state_dict")
+        self._computed = None
+
+    def to(self, device) -> "Metric":
+        """Move states to a device (the ``nn.Module.to`` analogue, metric.py:744+)."""
+        for k, v in self._state.items():
+            if isinstance(v, list):
+                self._state[k] = [jax.device_put(el, device) for el in v]
+            else:
+                self._state[k] = jax.device_put(v, device)
+        self._defaults = {
+            k: ([jax.device_put(el, device) for el in v] if isinstance(v, list) else jax.device_put(v, device))
+            for k, v in self._defaults.items()
+        }
+        return self
+
+    def set_dtype(self, dst_type) -> "Metric":
+        """Explicitly cast float states to ``dst_type`` (reference metric.py:767-782)."""
+        self._dtype_convert = True
+
+        def _cast(v):
+            return v.astype(dst_type) if isinstance(v, jnp.ndarray) and jnp.issubdtype(v.dtype, jnp.floating) else v
+
+        for k, v in self._state.items():
+            self._state[k] = [_cast(el) for el in v] if isinstance(v, list) else _cast(v)
+        self._defaults = {
+            k: ([_cast(el) for el in v] if isinstance(v, list) else _cast(v)) for k, v in self._defaults.items()
+        }
+        self._dtype_convert = False
+        return self
+
+    # -------------------------------------------------------------- utilities
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Filter kwargs to those accepted by this metric's update (metric.py:913-932)."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        exists_var_keyword = any(v.kind == inspect.Parameter.VAR_KEYWORD for v in _sign_params.values())
+        if exists_var_keyword:
+            filtered_kwargs = kwargs
+        return filtered_kwargs
+
+    def __hash__(self) -> int:
+        hash_vals = [type(self).__name__]
+        for key in self._defaults:
+            val = self._state[key]
+            if isinstance(val, list):
+                hash_vals.extend([np.asarray(v).tobytes() for v in val])
+            else:
+                hash_vals.append(np.asarray(val).tobytes())
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def type(self, dst_type) -> "Metric":
+        return self.set_dtype(dst_type)
+
+    def float(self) -> "Metric":
+        return self.set_dtype(jnp.float32)
+
+    def double(self) -> "Metric":
+        return self.set_dtype(jnp.float64)
+
+    def half(self) -> "Metric":
+        return self.set_dtype(jnp.bfloat16)
+
+    # ----------------------------------------------------------- pickling
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        # drop the wrapped bound methods; re-created in __setstate__ (metric.py:700-719)
+        state.pop("update", None)
+        state.pop("compute", None)
+        state.pop("_update_fn", None)
+        state.pop("_compute_fn", None)
+        state.pop("_update_signature", None)
+        # jnp arrays pickle fine via numpy
+        state["_state"] = {
+            k: ([np.asarray(el) for el in v] if isinstance(v, list) else np.asarray(v)) for k, v in state["_state"].items()
+        }
+        state["_defaults"] = {
+            k: ([np.asarray(el) for el in v] if isinstance(v, list) else np.asarray(v))
+            for k, v in state["_defaults"].items()
+        }
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._state = {
+            k: ([jnp.asarray(el) for el in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
+        }
+        self._defaults = {
+            k: ([jnp.asarray(el) for el in v] if isinstance(v, list) else jnp.asarray(v))
+            for k, v in self._defaults.items()
+        }
+        cls_update = type(self).update
+        cls_compute = type(self).compute
+        self._update_signature = inspect.signature(cls_update.__get__(self))
+        self._update_fn = cls_update.__get__(self)
+        self._compute_fn = cls_compute.__get__(self)
+        object.__setattr__(self, "update", self._wrap_update(self._update_fn))
+        object.__setattr__(self, "compute", self._wrap_compute(self._compute_fn))
+
+    def __deepcopy__(self, memo: Optional[dict] = None) -> "Metric":
+        cls = self.__class__
+        new_obj = cls.__new__(cls)
+        if memo is not None:
+            memo[id(self)] = new_obj
+        state = self.__getstate__()
+        new_obj.__setstate__(copy.deepcopy(state, memo))
+        return new_obj
+
+    # --------------------------------------------------------------- plotting
+    def plot(self, *args: Any, **kwargs: Any):
+        """Default plot implementation (single/multi value) — see utils/plot.py."""
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = args[0] if args else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=kwargs.get("ax"),
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=type(self).__name__,
+        )
+
+    def _plot(self, val=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            name=type(self).__name__,
+        )
+
+    # --------------------------------------------------- composition algebra
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+    def __getnewargs__(self) -> tuple:
+        return tuple()
+
+    def __iter__(self):
+        raise NotImplementedError("Metrics does not support iteration.")
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Composition of two metrics (or metric and scalar) via an elementwise op.
+
+    Reference metric.py:1109-1231: fans update/forward/reset/persistent out to
+    child metrics and applies ``op`` to their compute results; its own sync is a
+    no-op (children sync themselves).
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Array, None],
+        metric_b: Union[Metric, float, int, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (int, float, np.ndarray)) and not isinstance(metric_a, bool) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (int, float, np.ndarray)) and not isinstance(metric_b, bool) else metric_b
+
+    def _sync_dist(self, *args: Any, **kwargs: Any) -> None:
+        pass  # children sync themselves
+
+    def sync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def unsync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            return None
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                return None
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics
+
+    def __hash__(self) -> int:
+        return object.__hash__(self)

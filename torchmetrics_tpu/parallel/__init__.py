@@ -1,0 +1,10 @@
+from torchmetrics_tpu.parallel.sync import (  # noqa: F401
+    Reduction,
+    class_reduce,
+    gather_all_tensors,
+    host_sync_value,
+    in_named_axis_context,
+    reduce,
+    sync_states,
+    sync_value,
+)

@@ -1,0 +1,13 @@
+from torchmetrics_tpu.utils import checks, compute, data, enums, exceptions, prints  # noqa: F401
+from torchmetrics_tpu.utils.checks import _check_same_shape  # noqa: F401
+from torchmetrics_tpu.utils.compute import _safe_divide, auc, interp  # noqa: F401
+from torchmetrics_tpu.utils.data import (  # noqa: F401
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    to_categorical,
+    to_onehot,
+)
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401

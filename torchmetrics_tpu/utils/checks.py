@@ -1,0 +1,85 @@
+"""Input-validation helpers (L0).
+
+Capability parity with the parts of reference utilities/checks.py used across
+metrics (_check_same_shape, basic classification input validation). Validation is
+host-side (concrete values) and always toggleable via each metric's
+``validate_args`` flag — under jit the validation stage is simply skipped, exactly
+like the reference's fast path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utils.enums import DataType
+
+
+def _is_concrete(x) -> bool:
+    """True if ``x`` holds real values (not a tracer) so host checks can read it."""
+    import jax.core
+
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if tuple(preds.shape) != tuple(target.shape):
+        raise ValueError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {tuple(preds.shape)} and {tuple(target.shape)}."
+        )
+
+
+def _basic_input_validation(
+    preds: Array, target: Array, threshold: float, multiclass: Optional[bool], ignore_index: Optional[int]
+) -> None:
+    """Common sanity checks on classification inputs (reference checks.py:47)."""
+    if not _is_concrete(target):
+        return
+    target = np.asarray(target)
+    if np.issubdtype(target.dtype, np.floating):
+        raise ValueError("The `target` has to be an integer tensor.")
+    min_target = target.min() if target.size else 0
+    if min_target < 0 and (ignore_index is None or ignore_index >= 0):
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    preds_float = np.issubdtype(np.asarray(preds).dtype, np.floating)
+    if not preds_float and np.asarray(preds).size and np.asarray(preds).min() < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if not preds.shape[0] == target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if multiclass is False and target.size and target.max() > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and np.asarray(preds).size and np.asarray(preds).max() > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _check_data_type(preds: Array, target: Array) -> DataType:
+    """Infer the classification data type of an input pair (subset of checks.py:207)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    preds_float = np.issubdtype(preds.dtype, np.floating)
+    if preds.ndim == target.ndim:
+        if preds_float and preds.size and preds.max() <= 1 and preds.min() >= 0 and not np.array_equal(preds, preds.round()):
+            return DataType.MULTILABEL
+        return DataType.MULTICLASS if (target.size and target.max() > 1) else DataType.BINARY
+    if preds.ndim == target.ndim + 1:
+        return DataType.MULTICLASS
+    raise ValueError("Could not infer the data type from `preds` and `target` shapes.")
+
+
+def _check_retrieval_inputs(
+    indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False, ignore: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Validate and flatten retrieval inputs (reference checks.py retrieval section)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(jnp.asarray(indexes).dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+    t = np.asarray(target)
+    if not allow_non_binary_target and _is_concrete(target) and t.size and (t.max() > 1 or t.min() < 0):
+        raise ValueError("`target` must contain `binary` values")
+    return jnp.asarray(indexes).ravel(), jnp.asarray(preds).ravel(), jnp.asarray(target).ravel()

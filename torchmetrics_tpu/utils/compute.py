@@ -1,0 +1,97 @@
+"""Safe-math and curve helpers (L0).
+
+Capability parity with reference utilities/compute.py (_safe_divide, _safe_xlogy,
+_safe_matmul, _auc_compute, interp) — re-expressed as pure jnp ops that trace
+cleanly under jit (no data-dependent Python branching).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Elementwise num/denom returning ``zero_division`` where denom == 0.
+
+    Uses the double-where trick so the division never produces nan/inf inside
+    a traced graph (important for grad correctness under XLA).
+    """
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    if not jnp.issubdtype(jnp.result_type(num, denom), jnp.floating):
+        num = num.astype(jnp.float32)
+        denom = denom.astype(jnp.float32)
+    zero = denom == 0
+    safe_denom = jnp.where(zero, jnp.ones_like(denom), denom)
+    return jnp.where(zero, jnp.asarray(zero_division, dtype=jnp.result_type(num, denom)), num / safe_denom)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], is_multilabel: bool, tp: Array, fp: Array, fn: Array, top_k: int = 1
+) -> Array:
+    """Macro/weighted averaging of per-class scores, ignoring absent classes.
+
+    Mirrors reference utilities/compute.py:58-69.
+    """
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+    else:  # macro
+        weights = jnp.ones_like(score, dtype=jnp.float32)
+        if not is_multilabel and top_k == 1:
+            weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+    return _safe_divide(weights * score, weights.sum(-1, keepdims=True)).sum(-1)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y) with the convention 0*log(0) = 0, nan-free under trace."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    zero = x == 0
+    safe_y = jnp.where(zero, jnp.ones_like(y), y)
+    return jnp.where(zero, jnp.zeros_like(x * safe_y), x * jnp.log(safe_y))
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul; on TPU we compute in fp32 accumulation regardless of input dtype."""
+    return jnp.matmul(x, y, precision="highest")
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y) assuming x already sorted in ``direction``."""
+    dx = jnp.diff(x, axis=axis)
+    avg_y = (y[..., :-1] + y[..., 1:]) / 2.0 if axis == -1 else (jnp.take(y, jnp.arange(y.shape[axis] - 1), axis))
+    return (dx * avg_y).sum(axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal AUC; optionally sorts by x first. Direction inferred from dx sign.
+
+    Note: under jit the monotonicity *check* of the reference (utilities/compute.py:88-115)
+    cannot raise; we instead infer direction from the first/last element which matches
+    the reference for monotone inputs.
+    """
+    if reorder:
+        order = jnp.argsort(x)
+        x = x[order]
+        y = y[order]
+    direction = jnp.where(x[-1] >= x[0], 1.0, -1.0)
+    dx = jnp.diff(x)
+    avg_y = (y[:-1] + y[1:]) / 2.0
+    return (dx * avg_y).sum() * direction
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Public AUC entrypoint (reference utilities/compute.py:118)."""
+    return _auc_compute(jnp.asarray(x), jnp.asarray(y), reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation, same semantics as reference utilities/compute.py:134.
+
+    ``jnp.interp`` is XLA-native and matches numpy semantics (clamping at the ends).
+    """
+    return jnp.interp(jnp.asarray(x), jnp.asarray(xp), jnp.asarray(fp))

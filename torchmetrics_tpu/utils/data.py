@@ -1,0 +1,137 @@
+"""Data-manipulation utilities (L0).
+
+Capability parity with reference utilities/data.py (dim_zero_* reducers, to_onehot,
+select_topk, to_categorical, _bincount, _cumsum, _flexible_bincount), designed
+TPU-first: ``_bincount`` uses jnp.bincount with a *static* ``length`` (legal under
+jit) which XLA lowers to a deterministic scatter-add — the reference's
+"XLA fallback" (one-hot + sum, utilities/data.py:203-205) is what XLA does natively.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0."""
+    if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(jnp.asarray(x), axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(jnp.asarray(x), axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(jnp.asarray(x), axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(jnp.asarray(x), axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: dict) -> tuple:
+    """Flatten one level of nested dicts; returns (new_dict, duplicates_found)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert (N, ...) integer labels to (N, C, ...) one-hot.
+
+    Reference utilities/data.py:80. One-hot via broadcast-compare is an MXU/VPU
+    friendly pattern on TPU.
+    """
+    label_tensor = jnp.asarray(label_tensor)
+    oh = jnp.asarray(label_tensor[:, None, ...] == jnp.arange(num_classes).reshape(
+        (1, num_classes) + (1,) * (label_tensor.ndim - 1)
+    ))
+    return oh.astype(jnp.int32)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """0/1 mask of the top-k entries along ``dim`` (reference utilities/data.py:125)."""
+    prob_tensor = jnp.asarray(prob_tensor)
+    if topk == 1:  # fast path: argmax one-hot
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits to integer labels via argmax (reference utilities/data.py:152)."""
+    return jnp.argmax(jnp.asarray(x), axis=argmax_dim)
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.squeeze() if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data):
+    import jax
+
+    return jax.tree_util.tree_map(_squeeze_scalar_element_tensor, data)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Deterministic bincount with a static length (jit-legal).
+
+    The reference needs an explicit XLA/deterministic fallback
+    (utilities/data.py:179-207); on TPU ``jnp.bincount(x, length=L)`` is already a
+    deterministic scatter-add with static output shape. ``minlength`` must be a
+    Python int (static) under jit.
+    """
+    return jnp.bincount(jnp.asarray(x).ravel().astype(jnp.int32), length=int(minlength))
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Cumulative sum; XLA's associative-scan lowering is deterministic on TPU."""
+    return jnp.cumsum(jnp.asarray(x), axis=axis)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount over values of ``x`` after densification.
+
+    Host-side helper (not jit-able: output shape depends on data), mirroring
+    reference utilities/data.py:222-238: subtract min, then count up to max+1.
+    """
+    x = jnp.asarray(x)
+    x = x - x.min()
+    unique_ids = int(x.max()) + 1
+    return _bincount(x, minlength=unique_ids)
+
+
+def allclose(tensor1: Array, tensor2: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    return bool(jnp.allclose(jnp.asarray(tensor1), jnp.asarray(tensor2, dtype=jnp.asarray(tensor1).dtype), rtol=rtol, atol=atol))

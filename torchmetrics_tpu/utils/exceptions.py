@@ -1,0 +1,12 @@
+"""Framework exceptions.
+
+Mirrors the reference's exception surface (torchmetrics/utilities/exceptions.py).
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised on wrong usage of the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on questionable usage of the metric API."""

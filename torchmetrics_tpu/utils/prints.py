@@ -1,0 +1,37 @@
+"""Process-zero-only printing helpers.
+
+Capability parity with reference utilities/prints.py (rank_zero_warn/info/debug),
+re-expressed for JAX's single-controller multi-process model: rank == jax.process_index().
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _process_zero_only(fn: Callable) -> Callable:
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        import jax
+
+        try:
+            if jax.process_index() != 0:
+                return None
+        except Exception:  # backend not initialised yet — treat as rank 0
+            pass
+        return fn(*args, **kwargs)
+
+    return wrapped_fn
+
+
+@_process_zero_only
+def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 3, **kwargs: Any) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
+
+
+rank_zero_info = _process_zero_only(partial(log.info))
+rank_zero_debug = _process_zero_only(partial(log.debug))
