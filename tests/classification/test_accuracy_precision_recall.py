@@ -1,0 +1,310 @@
+"""Classification parity tests vs sklearn (mirrors reference tests/unittests/classification)."""
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score as sk_accuracy,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1,
+    fbeta_score as sk_fbeta,
+    hamming_loss as sk_hamming,
+    jaccard_score as sk_jaccard,
+    precision_score as sk_precision,
+    recall_score as sk_recall,
+)
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+    StatScores,
+)
+
+import sys
+sys.path.insert(0, "/root/repo/tests")
+from helpers.testers import MetricTester  # noqa: E402
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 32, 5, 4
+
+rng = np.random.RandomState(7)
+BIN_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_LOGITS = rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_TARGET = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+ML_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+
+def _sk_binary(fn):
+    def wrapped(preds, target, **kw):
+        preds = (preds > 0.5).astype(int) if preds.dtype.kind == "f" else preds
+        return fn(target.reshape(-1), preds.reshape(-1), **kw)
+
+    return wrapped
+
+
+def _sk_multiclass(fn, **fn_kw):
+    def wrapped(preds, target):
+        if preds.ndim == target.ndim + 1:
+            preds = preds.argmax(1)
+        return fn(target.reshape(-1), preds.reshape(-1), **fn_kw)
+
+    return wrapped
+
+
+class TestBinaryAccuracy(MetricTester):
+    def test_functional(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_accuracy, _sk_binary(sk_accuracy))
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinaryAccuracy, _sk_binary(sk_accuracy), ddp=ddp)
+
+    def test_jit(self):
+        self.run_jit_test(BIN_PROBS, BIN_TARGET, BinaryAccuracy)
+
+
+class TestBinaryPrecisionRecallF1(MetricTester):
+    def test_precision(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_precision, _sk_binary(sk_precision))
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinaryPrecision, _sk_binary(sk_precision), ddp=True)
+
+    def test_recall(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_recall, _sk_binary(sk_recall))
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinaryRecall, _sk_binary(sk_recall), ddp=False)
+
+    def test_f1(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_f1_score, _sk_binary(sk_f1))
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinaryF1Score, _sk_binary(sk_f1), ddp=True)
+
+    def test_fbeta(self):
+        self.run_functional_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            functools.partial(F.binary_fbeta_score, beta=2.0),
+            _sk_binary(functools.partial(sk_fbeta, beta=2.0)),
+        )
+
+    def test_specificity(self):
+        def sk_specificity(target, preds):
+            tn = ((preds == 0) & (target == 0)).sum()
+            fp = ((preds == 1) & (target == 0)).sum()
+            return tn / (tn + fp)
+
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_specificity, _sk_binary(sk_specificity))
+
+    def test_hamming(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_hamming_distance, _sk_binary(sk_hamming))
+
+    def test_jaccard(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_jaccard_index, _sk_binary(sk_jaccard))
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+class TestMulticlassMetrics(MetricTester):
+    def test_accuracy(self, average):
+        if average == "micro":
+            sk_fn = _sk_multiclass(sk_accuracy)
+        else:
+            sk_avg = None if average is None else average
+            sk_fn = _sk_multiclass(
+                lambda t, p: sk_recall(t, p, average=sk_avg, labels=list(range(NUM_CLASSES)), zero_division=0)
+            )
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(F.multiclass_accuracy, num_classes=NUM_CLASSES, average=average), sk_fn
+        )
+        self.run_class_metric_test(
+            MC_LOGITS,
+            MC_TARGET,
+            functools.partial(MulticlassAccuracy, num_classes=NUM_CLASSES, average=average),
+            sk_fn,
+            ddp=True,
+        )
+
+    def test_precision(self, average):
+        sk_avg = None if average is None else average
+        sk_fn = _sk_multiclass(
+            lambda t, p: sk_precision(t, p, average=sk_avg, labels=list(range(NUM_CLASSES)), zero_division=0)
+        )
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(F.multiclass_precision, num_classes=NUM_CLASSES, average=average), sk_fn
+        )
+        self.run_class_metric_test(
+            MC_LOGITS,
+            MC_TARGET,
+            functools.partial(MulticlassPrecision, num_classes=NUM_CLASSES, average=average),
+            sk_fn,
+            ddp=True,
+        )
+
+    def test_recall(self, average):
+        sk_avg = None if average is None else average
+        sk_fn = _sk_multiclass(
+            lambda t, p: sk_recall(t, p, average=sk_avg, labels=list(range(NUM_CLASSES)), zero_division=0)
+        )
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(F.multiclass_recall, num_classes=NUM_CLASSES, average=average), sk_fn
+        )
+
+    def test_f1(self, average):
+        sk_avg = None if average is None else average
+        sk_fn = _sk_multiclass(lambda t, p: sk_f1(t, p, average=sk_avg, labels=list(range(NUM_CLASSES)), zero_division=0))
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(F.multiclass_f1_score, num_classes=NUM_CLASSES, average=average), sk_fn
+        )
+        self.run_class_metric_test(
+            MC_LOGITS,
+            MC_TARGET,
+            functools.partial(MulticlassF1Score, num_classes=NUM_CLASSES, average=average),
+            sk_fn,
+            ddp=True,
+        )
+
+    def test_jaccard(self, average):
+        sk_avg = None if average is None else average
+        sk_fn = _sk_multiclass(
+            lambda t, p: sk_jaccard(t, p, average=sk_avg, labels=list(range(NUM_CLASSES)), zero_division=0)
+        )
+        self.run_functional_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(F.multiclass_jaccard_index, num_classes=NUM_CLASSES, average=average), sk_fn
+        )
+
+
+class TestTopK(MetricTester):
+    def test_top2_accuracy(self):
+        def sk_top2(preds, target):
+            top2 = np.argsort(-preds, axis=1)[:, :2]
+            hit = np.array([t in tk for t, tk in zip(target, top2)]).astype(float)
+            return hit.mean()
+
+        self.run_functional_metric_test(
+            MC_LOGITS,
+            MC_TARGET,
+            functools.partial(F.multiclass_accuracy, num_classes=NUM_CLASSES, average="micro", top_k=2),
+            sk_top2,
+        )
+
+
+class TestMultilabel(MetricTester):
+    def test_accuracy_macro(self):
+        def sk_ml_acc(preds, target):
+            preds = (preds > 0.5).astype(int)
+            scores = [(preds[:, i] == target[:, i]).mean() for i in range(NUM_LABELS)]
+            return np.mean(scores)
+
+        self.run_functional_metric_test(
+            ML_PROBS, ML_TARGET, functools.partial(F.multilabel_accuracy, num_labels=NUM_LABELS, average="macro"), sk_ml_acc
+        )
+        self.run_class_metric_test(
+            ML_PROBS,
+            ML_TARGET,
+            functools.partial(MultilabelAccuracy, num_labels=NUM_LABELS, average="macro"),
+            sk_ml_acc,
+            ddp=True,
+        )
+
+    def test_f1_micro(self):
+        def sk_ml_f1(preds, target):
+            preds = (preds > 0.5).astype(int)
+            return sk_f1(target.reshape(-1), preds.reshape(-1))
+
+        self.run_functional_metric_test(
+            ML_PROBS, ML_TARGET, functools.partial(F.multilabel_f1_score, num_labels=NUM_LABELS, average="micro"), sk_ml_f1
+        )
+
+    def test_exact_match(self):
+        def sk_em(preds, target):
+            preds = (preds > 0.5).astype(int)
+            return (preds == target).all(axis=1).mean()
+
+        self.run_functional_metric_test(
+            ML_PROBS, ML_TARGET, functools.partial(F.multilabel_exact_match, num_labels=NUM_LABELS), sk_em
+        )
+
+
+class TestConfusionMatrix(MetricTester):
+    def test_binary(self):
+        def sk_cm(preds, target):
+            preds = (preds > 0.5).astype(int)
+            return sk_confusion_matrix(target.reshape(-1), preds.reshape(-1), labels=[0, 1])
+
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_confusion_matrix, sk_cm)
+
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_multiclass(self, normalize):
+        def sk_cm(preds, target):
+            if preds.ndim == target.ndim + 1:
+                preds = preds.argmax(1)
+            cm = sk_confusion_matrix(
+                target.reshape(-1), preds.reshape(-1), labels=list(range(NUM_CLASSES)), normalize=normalize
+            )
+            return np.nan_to_num(cm)
+
+        self.run_functional_metric_test(
+            MC_LOGITS,
+            MC_TARGET,
+            functools.partial(F.multiclass_confusion_matrix, num_classes=NUM_CLASSES, normalize=normalize),
+            sk_cm,
+        )
+
+    def test_class_interface(self):
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        def sk_cm(preds, target):
+            if preds.ndim == target.ndim + 1:
+                preds = preds.argmax(1)
+            return sk_confusion_matrix(target.reshape(-1), preds.reshape(-1), labels=list(range(NUM_CLASSES)))
+
+        self.run_class_metric_test(
+            MC_LOGITS, MC_TARGET, functools.partial(MulticlassConfusionMatrix, num_classes=NUM_CLASSES), sk_cm, ddp=True
+        )
+
+
+class TestStatScores(MetricTester):
+    def test_binary(self):
+        def sk_stat(preds, target):
+            preds = (preds > 0.5).astype(int)
+            t, p = target.reshape(-1), preds.reshape(-1)
+            tp = ((p == 1) & (t == 1)).sum()
+            fp = ((p == 1) & (t == 0)).sum()
+            tn = ((p == 0) & (t == 0)).sum()
+            fn = ((p == 0) & (t == 1)).sum()
+            return np.array([tp, fp, tn, fn, tp + fn])
+
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_stat_scores, sk_stat)
+
+    def test_task_dispatch(self):
+        m = StatScores(task="binary")
+        from torchmetrics_tpu.classification import BinaryStatScores
+
+        assert isinstance(m, BinaryStatScores)
+
+
+def test_ignore_index():
+    target = np.array([0, 1, 2, 1, -1, -1])
+    preds = np.array([0, 1, 1, 1, 0, 2])
+    res = F.multiclass_accuracy(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=3, average="micro", ignore_index=-1
+    )
+    assert abs(float(res) - 3 / 4) < 1e-6
+
+
+def test_samplewise_multidim():
+    rng2 = np.random.RandomState(3)
+    preds = rng2.randint(0, NUM_CLASSES, (8, 16))
+    target = rng2.randint(0, NUM_CLASSES, (8, 16))
+    res = F.multiclass_accuracy(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average="micro", multidim_average="samplewise"
+    )
+    expected = (preds == target).mean(axis=1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)

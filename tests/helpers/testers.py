@@ -192,7 +192,7 @@ class MetricTester:
             jax.shard_map(
                 sync_and_compute,
                 mesh=mesh,
-                in_specs={k: P("batch") for k in stacked},
+                in_specs=P("batch"),
                 out_specs=P(),
             )
         )(stacked)

@@ -1,0 +1,17 @@
+"""Abstract base for task-dispatching classification wrappers.
+
+Reference classification/base.py:19-30.
+"""
+from typing import Any
+
+from torchmetrics_tpu.metric import Metric
+
+
+class _ClassificationTaskWrapper(Metric):
+    """Raises on direct instantiation-time update/compute; ``__new__`` dispatches."""
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise NotImplementedError(f"{self.__class__.__name__} metric does not have an `update` method.")
+
+    def compute(self) -> None:
+        raise NotImplementedError(f"{self.__class__.__name__} metric does not have a `compute` method.")

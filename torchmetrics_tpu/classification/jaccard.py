@@ -1,0 +1,126 @@
+"""Modular Jaccard index (reference classification/jaccard.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.functional.classification.jaccard import _jaccard_index_reduce
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryJaccardIndex(BinaryStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, multidim_average="global", ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _jaccard_index_reduce(tp, fp, tn, fn, average="binary")
+
+
+class MulticlassJaccardIndex(MulticlassStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(f"Expected argument `average` to be one of ['micro','macro','weighted','none',None] but got {average}")
+        # always keep per-class states so ignore_index/micro masking happens at compute
+        super().__init__(
+            num_classes=num_classes,
+            top_k=1,
+            average="none",
+            multidim_average="global",
+            ignore_index=ignore_index,
+            validate_args=validate_args,
+            **kwargs,
+        )
+        self.average_jaccard = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _jaccard_index_reduce(tp, fp, tn, fn, average=self.average_jaccard, ignore_index=self.ignore_index)
+
+
+class MultilabelJaccardIndex(MultilabelStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels,
+            threshold=threshold,
+            average="none" if average in (None, "none", "macro", "weighted") else average,
+            multidim_average="global",
+            ignore_index=ignore_index,
+            validate_args=validate_args,
+            **kwargs,
+        )
+        self.average_jaccard = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _jaccard_index_reduce(tp, fp, tn, fn, average=self.average_jaccard)
+
+
+class JaccardIndex(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryJaccardIndex(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassJaccardIndex(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,109 @@
+"""Exact match (reference functional/classification/exact_match.py, 258 LoC).
+
+Multiclass (multidim): a sample counts only if every element matches;
+multilabel: a sample counts only if every label matches.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoBinary
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array, target: Array, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """preds/target shaped (N, ...) label tensors."""
+    if ignore_index is not None:
+        match_or_ignored = (preds == target) | (target == ignore_index)
+    else:
+        match_or_ignored = preds == target
+    correct = match_or_ignored.reshape(match_or_ignored.shape[0], -1).all(axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return correct.sum(), jnp.asarray(correct.shape[0], dtype=jnp.int32)
+    return correct, jnp.ones_like(correct)
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, 1)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    if multidim_average == "global":
+        return _exact_match_reduce(correct, total)
+    return correct.astype(jnp.float32)
+
+
+def _multilabel_exact_match_update(
+    preds: Array, target: Array, valid: Array, num_labels: int, multidim_average: str = "global"
+) -> Tuple[Array, Array]:
+    """preds/target shaped (N, L, ...) thresholded tensors."""
+    match_or_ignored = (preds == target) | ~valid
+    correct = match_or_ignored.reshape(match_or_ignored.shape[0], num_labels, -1).all(axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return correct.sum(), jnp.asarray(correct.size, dtype=jnp.int32)
+    return correct.sum(-1), jnp.asarray(correct.shape[1], dtype=jnp.int32) * jnp.ones(correct.shape[0], dtype=jnp.int32)
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, valid, num_labels, multidim_average)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

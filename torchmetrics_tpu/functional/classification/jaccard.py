@@ -1,0 +1,89 @@
+"""Jaccard index / IoU (reference functional/classification/jaccard.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification._stats_helper import (
+    _binary_stats,
+    _multiclass_stats,
+    _multilabel_stats,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _jaccard_index_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Jaccard = tp / (tp + fp + fn), class-averaged per ``average``.
+
+    For "macro", classes absent from both preds and target (union == 0) are
+    excluded from the mean; an in-range ``ignore_index`` class is excluded from
+    every average (reference jaccard.py:69-91 subtracts its denominator).
+    """
+    if average == "binary":
+        return _safe_divide(tp, tp + fp + fn)
+    keep = jnp.ones_like(tp, dtype=bool)
+    if ignore_index is not None and tp.ndim >= 1 and 0 <= ignore_index < tp.shape[-1]:
+        keep = jnp.arange(tp.shape[-1]) != ignore_index
+    if average == "micro":
+        tp_s = (tp * keep).sum()
+        union = ((tp + fp + fn) * keep).sum()
+        return _safe_divide(tp_s, union)
+    scores = _safe_divide(tp, tp + fp + fn)
+    if average in ("macro", None, "none"):
+        if average in (None, "none"):
+            return scores
+        present = ((tp + fp + fn) > 0) & keep
+        return _safe_divide((scores * present).sum(-1), present.sum(-1))
+    # weighted
+    weights = (tp + fn).astype(jnp.float32) * keep
+    return _safe_divide((scores * weights).sum(-1), weights.sum(-1))
+
+
+def binary_jaccard_index(preds, target, threshold=0.5, ignore_index=None, validate_args=True):
+    tp, fp, tn, fn = _binary_stats(preds, target, threshold, "global", ignore_index, validate_args)
+    return _jaccard_index_reduce(tp, fp, tn, fn, average="binary")
+
+
+def multiclass_jaccard_index(preds, target, num_classes, average="macro", ignore_index=None, validate_args=True):
+    tp, fp, tn, fn = _multiclass_stats(preds, target, num_classes, average, 1, "global", ignore_index, validate_args)
+    return _jaccard_index_reduce(tp, fp, tn, fn, average=average, ignore_index=ignore_index)
+
+
+def multilabel_jaccard_index(preds, target, num_labels, threshold=0.5, average="macro", ignore_index=None, validate_args=True):
+    tp, fp, tn, fn = _multilabel_stats(preds, target, num_labels, threshold, average, "global", ignore_index, validate_args)
+    return _jaccard_index_reduce(tp, fp, tn, fn, average=average)
+
+
+def jaccard_index(
+    preds,
+    target,
+    task,
+    threshold=0.5,
+    num_classes=None,
+    num_labels=None,
+    average="macro",
+    ignore_index=None,
+    validate_args=True,
+):
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_jaccard_index(preds, target, num_labels, threshold, average, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

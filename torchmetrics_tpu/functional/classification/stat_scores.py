@@ -1,0 +1,505 @@
+"""Stat-scores (tp/fp/tn/fn) machinery — the canonical per-metric decomposition.
+
+Capability parity with reference functional/classification/stat_scores.py:
+``_arg_validation`` → ``_tensor_validation`` → ``_format`` → ``_update`` → ``_compute``
+for each of binary / multiclass / multilabel, plus the task-dispatching public
+``stat_scores``. TPU-first re-design decisions:
+
+- No data-dependent Python branching: "sigmoid if logits" becomes a traced
+  ``jnp.where(any_outside_unit_interval, sigmoid(x), x)`` select; validation stages
+  read concrete values and are skipped automatically under jit.
+- ``ignore_index`` masking is weight-based (weighted bincount / masked sums) rather
+  than boolean gather — shapes stay static.
+- Multiclass counts use the flattened confusion-matrix bincount trick
+  (reference stat_scores.py:217-555): ``bincount(C*target + preds, length=C*C)``,
+  which XLA lowers to a deterministic scatter-add. ``top_k > 1`` uses the one-hot
+  top-k mask path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.data import _bincount, select_topk
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _sigmoid_if_logits(preds: Array) -> Array:
+    """Apply sigmoid iff any value lies outside [0, 1] (trace-safe select)."""
+    needs = jnp.any((preds < 0) | (preds > 1))
+    return jnp.where(needs, jax.nn.sigmoid(preds), preds)
+
+
+def _softmax_if_logits(preds: Array, axis: int = 1) -> Array:
+    needs = jnp.any((preds < 0) | (preds > 1))
+    return jnp.where(needs, jax.nn.softmax(preds, axis=axis), preds)
+
+
+# --------------------------------------------------------------------- binary
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array, target: Array, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not _is_concrete(target):
+        return
+    t = np.asarray(target)
+    unique_values = np.unique(t)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not set(unique_values.tolist()).issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique_values} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    p = np.asarray(preds)
+    if not np.issubdtype(p.dtype, np.floating):
+        unique_p = set(np.unique(p).tolist())
+        if not unique_p.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(unique_p)} but expected only"
+                " the following values [0,1] since preds is a label tensor."
+            )
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_format(
+    preds: Array, target: Array, threshold: float = 0.5, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Returns (preds01, target01, valid_mask), each flattened to (N, ...)-preserving shape."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _sigmoid_if_logits(preds)
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    if ignore_index is not None:
+        valid = (target != ignore_index)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _binary_stat_scores_update(
+    preds: Array, target: Array, valid: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    if multidim_average == "global":
+        preds, target, valid = preds.reshape(-1), target.reshape(-1), valid.reshape(-1)
+        axis = 0
+    else:
+        preds = preds.reshape(preds.shape[0], -1)
+        target = target.reshape(target.shape[0], -1)
+        valid = valid.reshape(valid.shape[0], -1)
+        axis = 1
+    v = valid.astype(jnp.int32)
+    tp = ((target == preds) & (target == 1) & valid).astype(jnp.int32).sum(axis)
+    fn = ((target != preds) & (target == 1) & valid).astype(jnp.int32).sum(axis)
+    fp = ((target != preds) & (target == 0) & valid).astype(jnp.int32).sum(axis)
+    tn = ((target == preds) & (target == 0) & valid).astype(jnp.int32).sum(axis)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    stacked = jnp.stack([tp, fp, tn, fn, tp + fn], axis=0 if tp.ndim == 0 or multidim_average == "global" else 1)
+    return stacked.squeeze() if multidim_average == "global" else stacked
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for binary tasks (reference stat_scores.py:141-214)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# ----------------------------------------------------------------- multiclass
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not isinstance(top_k, int) and top_k < 1:
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        if multidim_average != "global" and preds.ndim < 3:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should "
+                             " be at least 3D when multidim_average is set to `samplewise`")
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape.")
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError("When `preds` and `target` have the same shape, the shape should be at least 2D when"
+                             " multidim_average is set to `samplewise`")
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be"
+                         " (N, ...) and `preds` should be (N, C, ...).")
+    if not _is_concrete(target):
+        return
+    t = np.asarray(target)
+    num_unique = np.unique(t)
+    check_value = num_classes if ignore_index is None else num_classes + 1
+    if len(num_unique) > check_value or (t.size and (t.max() >= num_classes and (ignore_index is None or t.max() != ignore_index))):
+        raise RuntimeError(f"Detected more unique values in `target` than expected. Expected only {check_value} but found"
+                           f" {len(num_unique)} in `target`.")
+    p = np.asarray(preds)
+    if not np.issubdtype(p.dtype, np.floating) and p.size and p.max() >= num_classes:
+        raise RuntimeError(f"Detected more unique values in `preds` than expected. Expected only {num_classes} but found"
+                           f" more in `preds`.")
+
+
+def _multiclass_stat_scores_format(
+    preds: Array, target: Array, top_k: int = 1
+) -> Tuple[Array, Array]:
+    """Convert probability/logit preds to labels (top_k==1) and flatten extra dims."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1 and top_k == 1:
+        preds = preds.argmax(axis=1)
+    if top_k == 1:
+        preds = preds.reshape(preds.shape[0], -1) if preds.ndim > 1 else preds.reshape(preds.shape[0])
+    target = target.reshape(target.shape[0], -1) if target.ndim > 1 else target.reshape(target.shape[0])
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn per class.
+
+    top_k == 1: flattened confusion-matrix bincount (weights mask ignore_index).
+    top_k > 1: one-hot top-k mask path.
+    """
+    if top_k > 1:
+        # preds (N, C, ...) scores; build top-k mask
+        preds_mask = select_topk(preds, topk=top_k, dim=1)  # (N, C, ...)
+        target_oh = jax.nn.one_hot(target, num_classes, axis=1, dtype=jnp.int32)  # (N, C, ...)
+        if ignore_index is not None:
+            valid = (target != ignore_index)[:, None, ...]
+        else:
+            valid = jnp.ones_like(target, dtype=bool)[:, None, ...]
+        # ignored positions contribute to NO bucket — multiply every product by
+        # valid (reference stat_scores.py:374-386 excludes them via -1 rows)
+        sum_axes = (0,) + tuple(range(2, preds_mask.ndim)) if multidim_average == "global" else tuple(range(2, preds_mask.ndim))
+        tp = (preds_mask * target_oh * valid).sum(sum_axes)
+        fp = (preds_mask * (1 - target_oh) * valid).sum(sum_axes)
+        fn = ((1 - preds_mask) * target_oh * valid).sum(sum_axes)
+        tn = ((1 - preds_mask) * (1 - target_oh) * valid).sum(sum_axes)
+        return tp, fp, tn, fn
+
+    # label path: confusion-matrix bincount
+    if multidim_average == "global":
+        p = preds.reshape(-1)
+        t = target.reshape(-1)
+        if ignore_index is not None:
+            w = (t != ignore_index).astype(jnp.float32)
+            t = jnp.where(t == ignore_index, 0, t)
+        else:
+            w = jnp.ones_like(t, dtype=jnp.float32)
+        p = jnp.clip(p, 0, num_classes - 1)
+        idx = (num_classes * t + p).astype(jnp.int32)
+        confmat = jnp.zeros(num_classes * num_classes, dtype=jnp.float32).at[idx].add(w).reshape(num_classes, num_classes)
+        tp = jnp.diagonal(confmat)
+        fp = confmat.sum(0) - tp
+        fn = confmat.sum(1) - tp
+        tn = confmat.sum() - tp - fp - fn
+        return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+    # samplewise
+    n = preds.shape[0]
+    p = preds.reshape(n, -1)
+    t = target.reshape(n, -1)
+    if ignore_index is not None:
+        w = (t != ignore_index).astype(jnp.float32)
+        t = jnp.where(t == ignore_index, 0, t)
+    else:
+        w = jnp.ones_like(t, dtype=jnp.float32)
+    p = jnp.clip(p, 0, num_classes - 1)
+    sample_idx = jnp.arange(n)[:, None]
+    idx = (sample_idx * num_classes * num_classes + num_classes * t + p).astype(jnp.int32)
+    confmat = (
+        jnp.zeros(n * num_classes * num_classes, dtype=jnp.float32)
+        .at[idx.reshape(-1)]
+        .add(w.reshape(-1))
+        .reshape(n, num_classes, num_classes)
+    )
+    tp = jnp.diagonal(confmat, axis1=1, axis2=2)
+    fp = confmat.sum(1) - tp
+    fn = confmat.sum(2) - tp
+    tn = confmat.sum((1, 2))[:, None] - tp - fp - fn
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        # scalar states are already class-aggregated (reference stat_scores.py:430)
+        return res.sum(sum_axis) if res.ndim > 1 else res
+    if average in ("macro", "weighted"):
+        res = res.astype(jnp.float32)
+        weights = (tp + fn).astype(jnp.float32) if average == "weighted" else jnp.ones_like(tp, dtype=jnp.float32)
+        w = _safe_divide(weights, weights.sum(-1, keepdims=True) if weights.ndim else weights.sum())
+        return (res * (w[..., None] if res.ndim > w.ndim else w)).sum(sum_axis)
+    return res
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multiclass tasks (reference stat_scores.py:217-555)."""
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    if top_k == 1:
+        preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ----------------------------------------------------------------- multilabel
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array, target: Array, num_labels: int, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            f"Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+    if not _is_concrete(target):
+        return
+    t = np.asarray(target)
+    unique_values = set(np.unique(t).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique_values.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    p = np.asarray(preds)
+    if not np.issubdtype(p.dtype, np.floating):
+        unique_p = set(np.unique(p).tolist())
+        if not unique_p.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(unique_p)} but expected only 0s and 1s since preds"
+                " is a label tensor."
+            )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+
+
+def _multilabel_stat_scores_format(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _sigmoid_if_logits(preds)
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    preds = preds.reshape(*preds.shape[:2], -1)
+    target = target.reshape(*target.shape[:2], -1)
+    if ignore_index is not None:
+        valid = (target != ignore_index)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _multilabel_stat_scores_update(
+    preds: Array, target: Array, valid: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    sum_axes = (0, -1) if multidim_average == "global" else (-1,)
+    tp = ((target == preds) & (target == 1) & valid).astype(jnp.int32).sum(sum_axes)
+    fn = ((target != preds) & (target == 1) & valid).astype(jnp.int32).sum(sum_axes)
+    fp = ((target != preds) & (target == 0) & valid).astype(jnp.int32).sum(sum_axes)
+    tn = ((target == preds) & (target == 0) & valid).astype(jnp.int32).sum(sum_axes)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(sum_axis)
+    if average in ("macro", "weighted"):
+        res = res.astype(jnp.float32)
+        weights = (tp + fn).astype(jnp.float32) if average == "weighted" else jnp.ones_like(tp, dtype=jnp.float32)
+        w = _safe_divide(weights, weights.sum(-1, keepdims=True) if weights.ndim else weights.sum())
+        return (res * (w[..., None] if res.ndim > w.ndim else w)).sum(sum_axis)
+    return res
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multilabel tasks (reference stat_scores.py:557-810)."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ------------------------------------------------------------------- dispatch
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: Optional[str] = "global",
+    top_k: Optional[int] = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching stat scores (reference stat_scores.py public entry)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        if not isinstance(top_k, int):
+            raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

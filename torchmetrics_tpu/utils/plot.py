@@ -1,0 +1,189 @@
+"""Plotting helpers (reference utilities/plot.py, 330 LoC).
+
+matplotlib-gated: importing this module is cheap; calling any plot function
+without matplotlib installed raises a helpful error. Every metric's ``.plot()``
+routes here (plot_single_or_multi_val, plot_confusion_matrix, plot_curve).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    _MATPLOTLIB_AVAILABLE = True
+except Exception:  # pragma: no cover
+    _MATPLOTLIB_AVAILABLE = False
+    plt = None
+
+_PLOT_OUT_TYPE = Tuple[Any, Any]
+
+
+def _error_on_missing_matplotlib() -> None:
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(
+            "Plot function expects `matplotlib` to be installed. Install with `pip install matplotlib`"
+        )
+
+
+def _get_col_row_split(n: int) -> Tuple[int, int]:
+    """Split ``n`` plots into a near-square (rows, cols) grid."""
+    nsq = int(np.sqrt(n))
+    if nsq * nsq == n:
+        return nsq, nsq
+    if n <= nsq * (nsq + 1):
+        return nsq, nsq + 1
+    return nsq + 1, nsq + 1
+
+
+def trim_axs(axs: Any, nb: int) -> Any:
+    """Hide the extra axes of a grid beyond ``nb``."""
+    if hasattr(axs, "flat"):
+        axs = axs.flat
+        for ax in axs[nb:]:
+            ax.remove()
+        return axs[:nb]
+    return axs
+
+
+def plot_single_or_multi_val(
+    val: Union[Any, Sequence[Any], Dict[str, Any]],
+    ax: Optional[Any] = None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> _PLOT_OUT_TYPE:
+    """Plot a single metric value, a sequence of values, or a dict of values.
+
+    Reference utilities/plot.py:62 behavior: scalar → point plot; vector →
+    per-class points; list of results → line over steps; bounds drawn as dashed
+    lines with the optimal direction marked.
+    """
+    _error_on_missing_matplotlib()
+    fig, ax = (plt.subplots() if ax is None else (ax.get_figure(), ax))
+
+    def _asnp(v):
+        return np.asarray(v)
+
+    if isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            v = _asnp(v)
+            if v.ndim == 0:
+                ax.plot([i], [float(v)], "o", label=k)
+            else:
+                ax.plot(v.ravel(), label=k)
+        ax.legend()
+    elif isinstance(val, (list, tuple)) and not hasattr(val, "shape"):
+        series = np.stack([_asnp(v) for v in val])
+        if series.ndim == 1:
+            ax.plot(np.arange(len(series)), series, "-o")
+        else:
+            for c in range(series.shape[1]):
+                ax.plot(np.arange(series.shape[0]), series[:, c], "-o", label=f"{legend_name or 'Class'} {c}")
+            ax.legend()
+        ax.set_xlabel("Step")
+    else:
+        v = _asnp(val)
+        if v.ndim == 0:
+            ax.plot([0], [float(v)], "o")
+        else:
+            x = np.arange(v.size)
+            ax.plot(x, v.ravel(), "o")
+            if legend_name:
+                ax.set_xticks(x)
+                ax.set_xticklabels([f"{legend_name} {i}" for i in x], rotation=45)
+    if lower_bound is not None:
+        ax.axhline(lower_bound, color="k", linestyle="--", alpha=0.4)
+    if upper_bound is not None:
+        ax.axhline(upper_bound, color="k", linestyle="--", alpha=0.4)
+    if name is not None:
+        ax.set_title(name)
+    ax.grid(True, alpha=0.3)
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat: Any,
+    ax: Optional[Any] = None,
+    add_text: bool = True,
+    labels: Optional[List[Union[int, str]]] = None,
+    cmap: Optional[str] = None,
+) -> _PLOT_OUT_TYPE:
+    """Heatmap of a (C, C) or (N, C, C) confusion matrix (reference plot.py:199)."""
+    _error_on_missing_matplotlib()
+    confmat = np.asarray(confmat)
+    if confmat.ndim == 3:  # multilabel (N, 2, 2)
+        nb, n_classes = confmat.shape[0], confmat.shape[1]
+        rows, cols = _get_col_row_split(nb)
+        fig, axs = plt.subplots(nrows=rows, ncols=cols)
+        axs = np.asarray(axs).ravel()
+        for i in range(nb):
+            _plot_single_confmat(confmat[i], axs[i], add_text, labels, cmap, title=f"Label {i}")
+        for j in range(nb, rows * cols):
+            axs[j].remove()
+        return fig, axs
+    fig, ax = (plt.subplots() if ax is None else (ax.get_figure(), ax))
+    _plot_single_confmat(confmat, ax, add_text, labels, cmap)
+    return fig, ax
+
+
+def _plot_single_confmat(confmat, ax, add_text, labels, cmap, title=None) -> None:
+    n_classes = confmat.shape[0]
+    im = ax.imshow(confmat, cmap=cmap or "Blues")
+    if add_text:
+        for i in range(n_classes):
+            for j in range(n_classes):
+                v = confmat[i, j]
+                txt = f"{v:.2f}" if np.issubdtype(confmat.dtype, np.floating) else str(int(v))
+                ax.text(j, i, txt, ha="center", va="center")
+    labels = labels if labels is not None else list(range(n_classes))
+    ax.set_xticks(range(n_classes))
+    ax.set_yticks(range(n_classes))
+    ax.set_xticklabels(labels)
+    ax.set_yticklabels(labels)
+    ax.set_xlabel("Predicted class")
+    ax.set_ylabel("True class")
+    if title:
+        ax.set_title(title)
+
+
+def plot_curve(
+    curve: Tuple[Any, Any, Any],
+    score: Optional[Any] = None,
+    ax: Optional[Any] = None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> _PLOT_OUT_TYPE:
+    """Plot an (x, y, thresholds) curve like ROC / PR (reference plot.py:270)."""
+    _error_on_missing_matplotlib()
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    fig, ax = (plt.subplots() if ax is None else (ax.get_figure(), ax))
+    if y.ndim > 1:
+        for c in range(y.shape[0]):
+            lbl = f"{legend_name or 'Class'} {c}"
+            if score is not None and np.asarray(score).ndim:
+                lbl += f" (score={float(np.asarray(score)[c]):.3f})"
+            ax.plot(x[c] if x.ndim > 1 else x, y[c], label=lbl)
+        ax.legend()
+    else:
+        lbl = None
+        if score is not None:
+            lbl = f"score={float(np.asarray(score)):.3f}"
+        ax.plot(x, y, label=lbl)
+        if lbl:
+            ax.legend()
+    if label_names:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name:
+        ax.set_title(name)
+    ax.grid(True, alpha=0.3)
+    return fig, ax
