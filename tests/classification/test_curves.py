@@ -1,0 +1,158 @@
+"""PR-curve / ROC / AUROC / AP parity tests vs sklearn."""
+import functools
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score as sk_ap,
+    precision_recall_curve as sk_pr_curve,
+    roc_auc_score as sk_auroc,
+    roc_curve as sk_roc_curve,
+)
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.testers import MetricTester  # noqa: E402
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES = 4, 32, 5
+rng = np.random.RandomState(13)
+BIN_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_PROBS = MC_PROBS / MC_PROBS.sum(-1, keepdims=True)
+MC_TARGET = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+
+class TestBinaryCurves(MetricTester):
+    def test_pr_curve_exact(self):
+        def ours(preds, target):
+            return F.binary_precision_recall_curve(preds, target, thresholds=None)
+
+        for i in range(NUM_BATCHES):
+            p, r, t = ours(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+            sp, sr, st = sk_pr_curve(BIN_TARGET[i], BIN_PROBS[i])
+            np.testing.assert_allclose(np.asarray(p), sp, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(r), sr, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(t), st, atol=1e-5)
+
+    def test_roc_exact(self):
+        for i in range(NUM_BATCHES):
+            fpr, tpr, _ = F.binary_roc(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]), thresholds=None)
+            sfpr, stpr, _ = sk_roc_curve(BIN_TARGET[i], BIN_PROBS[i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fpr), sfpr, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(tpr), stpr, atol=1e-5)
+
+    def test_auroc_exact(self):
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, functools.partial(F.binary_auroc, thresholds=None), lambda p, t: sk_auroc(t, p)
+        )
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, BinaryAUROC, lambda p, t: sk_auroc(t.reshape(-1), p.reshape(-1)), ddp=False
+        )
+
+    def test_auroc_binned_close(self):
+        # binned mode approximates the exact value on a dense grid
+        for i in range(NUM_BATCHES):
+            exact = float(sk_auroc(BIN_TARGET[i], BIN_PROBS[i]))
+            binned = float(F.binary_auroc(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]), thresholds=200))
+            assert abs(exact - binned) < 0.02
+
+    def test_auroc_binned_exact_on_grid(self):
+        # preds drawn from the threshold grid → binned == exact
+        grid = np.linspace(0, 1, 5)
+        preds = rng.choice(grid, size=200).astype(np.float32)
+        target = rng.randint(0, 2, 200)
+        exact = float(F.binary_auroc(jnp.asarray(preds), jnp.asarray(target), thresholds=None))
+        binned = float(F.binary_auroc(jnp.asarray(preds), jnp.asarray(target), thresholds=jnp.asarray(grid)))
+        assert abs(exact - binned) < 1e-6
+
+    def test_ap_exact(self):
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, functools.partial(F.binary_average_precision, thresholds=None),
+            lambda p, t: sk_ap(t, p),
+        )
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, BinaryAveragePrecision, lambda p, t: sk_ap(t.reshape(-1), p.reshape(-1)), ddp=False
+        )
+
+    def test_binned_class_ddp(self):
+        # binned confmat state syncs with psum across the mesh
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            functools.partial(BinaryAUROC, thresholds=200),
+            lambda p, t: sk_auroc(t.reshape(-1), p.reshape(-1)),
+            ddp=True,
+            check_batch=False,
+            atol=2e-2,
+        )
+
+
+class TestMulticlassCurves(MetricTester):
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_auroc(self, average):
+        def sk_fn(preds, target):
+            return sk_auroc(target, preds, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+
+        self.run_functional_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            functools.partial(F.multiclass_auroc, num_classes=NUM_CLASSES, average=average, thresholds=None),
+            sk_fn,
+        )
+        self.run_class_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            functools.partial(MulticlassAUROC, num_classes=NUM_CLASSES, average=average),
+            lambda p, t: sk_fn(p.reshape(-1, NUM_CLASSES), t.reshape(-1)),
+            ddp=False,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", None])
+    def test_average_precision(self, average):
+        def sk_fn(preds, target):
+            target_oh = np.eye(NUM_CLASSES)[target]
+            res = [sk_ap(target_oh[:, c], preds[:, c]) for c in range(NUM_CLASSES)]
+            return np.mean(res) if average == "macro" else np.array(res)
+
+        self.run_functional_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            functools.partial(F.multiclass_average_precision, num_classes=NUM_CLASSES, average=average, thresholds=None),
+            sk_fn,
+        )
+
+    def test_pr_curve_class_binned_jit(self):
+        import jax
+
+        m = BinaryPrecisionRecallCurve(thresholds=50)
+        st = m.init_state()
+        upd = jax.jit(m.functional_update)
+        for i in range(NUM_BATCHES):
+            st = upd(st, jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+            m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+        p1, r1, _ = m.functional_compute(st)
+        p2, r2, _ = m.compute()
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-6)
+
+
+def test_roc_class_interface():
+    m = BinaryROC(thresholds=None)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+    fpr, tpr, t = m.compute()
+    sfpr, stpr, _ = sk_roc_curve(BIN_TARGET.reshape(-1), BIN_PROBS.reshape(-1), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sfpr, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tpr), stpr, atol=1e-5)

@@ -1,0 +1,176 @@
+"""Parity tests for kappa / MCC / calibration / hinge / ranking vs sklearn."""
+import functools
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    cohen_kappa_score as sk_kappa,
+    coverage_error as sk_coverage,
+    hinge_loss as sk_hinge,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_lrl,
+    matthews_corrcoef as sk_mcc,
+)
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryMatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassMatthewsCorrCoef,
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.testers import MetricTester  # noqa: E402
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 32, 5, 4
+rng = np.random.RandomState(21)
+BIN_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PREDS = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+MC_TARGET = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+ML_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+
+class TestCohenKappa(MetricTester):
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_binary(self, weights):
+        def sk_fn(preds, target):
+            preds = (preds > 0.5).astype(int)
+            return sk_kappa(target.reshape(-1), preds.reshape(-1), weights=weights)
+
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, functools.partial(F.binary_cohen_kappa, weights=weights), sk_fn
+        )
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, functools.partial(BinaryCohenKappa, weights=weights), sk_fn, ddp=True
+        )
+
+    def test_multiclass(self):
+        def sk_fn(preds, target):
+            return sk_kappa(target.reshape(-1), preds.reshape(-1))
+
+        self.run_functional_metric_test(
+            MC_PREDS, MC_TARGET, functools.partial(F.multiclass_cohen_kappa, num_classes=NUM_CLASSES), sk_fn
+        )
+        self.run_class_metric_test(
+            MC_PREDS, MC_TARGET, functools.partial(MulticlassCohenKappa, num_classes=NUM_CLASSES), sk_fn, ddp=True
+        )
+
+
+class TestMCC(MetricTester):
+    def test_binary(self):
+        def sk_fn(preds, target):
+            preds = (preds > 0.5).astype(int)
+            return sk_mcc(target.reshape(-1), preds.reshape(-1))
+
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, F.binary_matthews_corrcoef, sk_fn)
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinaryMatthewsCorrCoef, sk_fn, ddp=True)
+
+    def test_multiclass(self):
+        def sk_fn(preds, target):
+            return sk_mcc(target.reshape(-1), preds.reshape(-1))
+
+        self.run_functional_metric_test(
+            MC_PREDS, MC_TARGET, functools.partial(F.multiclass_matthews_corrcoef, num_classes=NUM_CLASSES), sk_fn
+        )
+        self.run_class_metric_test(
+            MC_PREDS, MC_TARGET, functools.partial(MulticlassMatthewsCorrCoef, num_classes=NUM_CLASSES), sk_fn, ddp=False
+        )
+
+
+class TestCalibration(MetricTester):
+    @pytest.mark.parametrize("norm", ["l1", "max"])
+    def test_binary_ece(self, norm):
+        def ref_ce(preds, target):
+            n_bins = 15
+            bins = np.clip((preds * n_bins).astype(int), 0, n_bins - 1)
+            conf = np.where(preds > 0.5, preds, 1 - preds)
+            acc = np.where(preds > 0.5, target == 1, target == 0)
+            bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
+            ce = []
+            props = []
+            for b in range(n_bins):
+                m = bins == b
+                if m.sum() == 0:
+                    continue
+                ce.append(abs(acc[m].mean() - conf[m].mean()))
+                props.append(m.mean())
+            ce, props = np.array(ce), np.array(props)
+            return (ce * props).sum() if norm == "l1" else ce.max()
+
+        for i in range(NUM_BATCHES):
+            ours = float(F.binary_calibration_error(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]), norm=norm))
+            ref = float(ref_ce(BIN_PROBS[i], BIN_TARGET[i]))
+            assert abs(ours - ref) < 1e-5
+
+
+class TestHinge(MetricTester):
+    def test_binary_probs(self):
+        # probability inputs pass through unsquashed → same math as sklearn
+        def sk_fn(preds, target):
+            return sk_hinge(target.reshape(-1), preds.reshape(-1), labels=[0, 1])
+
+        for i in range(NUM_BATCHES):
+            ours = float(F.binary_hinge_loss(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i])))
+            ref = float(sk_fn(BIN_PROBS[i], BIN_TARGET[i]))
+            assert abs(ours - ref) < 1e-5
+
+    def test_binary_logits_sigmoided(self):
+        # logits are auto-sigmoided before the margin (reference hinge.py:86-88)
+        logits = np.array([-3.0, 5.0], dtype=np.float32)
+        target = np.array([0, 1])
+        sig = 1 / (1 + np.exp(-logits))
+        expect = (max(0, 1 + sig[0]) + max(0, 1 - sig[1])) / 2
+        ours = float(F.binary_hinge_loss(jnp.asarray(logits), jnp.asarray(target)))
+        assert abs(ours - expect) < 1e-5
+
+    def test_multiclass_crammer_singer(self):
+        logits = rng.randn(BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        target = rng.randint(0, NUM_CLASSES, BATCH_SIZE)
+        ours = float(F.multiclass_hinge_loss(jnp.asarray(probs), jnp.asarray(target), num_classes=NUM_CLASSES))
+        ref = float(sk_hinge(target, probs, labels=list(range(NUM_CLASSES))))
+        assert abs(ours - ref) < 1e-5
+
+
+class TestRanking(MetricTester):
+    def test_coverage(self):
+        for i in range(NUM_BATCHES):
+            ours = float(F.multilabel_coverage_error(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]), num_labels=NUM_LABELS))
+            ref = float(sk_coverage(ML_TARGET[i], ML_PROBS[i]))
+            assert abs(ours - ref) < 1e-4
+
+    def test_lrap(self):
+        for i in range(NUM_BATCHES):
+            ours = float(
+                F.multilabel_ranking_average_precision(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]), num_labels=NUM_LABELS)
+            )
+            ref = float(sk_lrap(ML_TARGET[i], ML_PROBS[i]))
+            assert abs(ours - ref) < 1e-4
+
+    def test_ranking_loss(self):
+        for i in range(NUM_BATCHES):
+            ours = float(F.multilabel_ranking_loss(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]), num_labels=NUM_LABELS))
+            ref = float(sk_lrl(ML_TARGET[i], ML_PROBS[i]))
+            assert abs(ours - ref) < 1e-4
+
+    def test_class_interfaces(self):
+        m1 = MultilabelCoverageError(num_labels=NUM_LABELS)
+        m2 = MultilabelRankingAveragePrecision(num_labels=NUM_LABELS)
+        m3 = MultilabelRankingLoss(num_labels=NUM_LABELS)
+        for i in range(NUM_BATCHES):
+            m1.update(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]))
+            m2.update(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]))
+            m3.update(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]))
+        flat_t = ML_TARGET.reshape(-1, NUM_LABELS)
+        flat_p = ML_PROBS.reshape(-1, NUM_LABELS)
+        assert abs(float(m1.compute()) - sk_coverage(flat_t, flat_p)) < 1e-4
+        assert abs(float(m2.compute()) - sk_lrap(flat_t, flat_p)) < 1e-4
+        assert abs(float(m3.compute()) - sk_lrl(flat_t, flat_p)) < 1e-4

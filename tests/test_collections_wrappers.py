@@ -1,0 +1,190 @@
+"""MetricCollection (compute groups) and wrapper tests.
+
+Mirrors reference tests/unittests/bases/test_collections.py and wrappers tests.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy, f1_score as sk_f1, recall_score as sk_recall
+
+from torchmetrics_tpu import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanMetric,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+    SumMetric,
+)
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+
+NUM_CLASSES = 5
+rng = np.random.RandomState(31)
+PREDS = rng.randint(0, NUM_CLASSES, (4, 32))
+TARGET = rng.randint(0, NUM_CLASSES, (4, 32))
+
+
+class TestMetricCollection:
+    def _make(self, **kwargs):
+        return MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            ],
+            **kwargs,
+        )
+
+    def test_compute_values(self):
+        mc = self._make()
+        for i in range(4):
+            mc.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        res = mc.compute()
+        flat_p, flat_t = PREDS.reshape(-1), TARGET.reshape(-1)
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(flat_t, flat_p)) < 1e-6
+        assert (
+            abs(float(res["MulticlassRecall"]) - sk_recall(flat_t, flat_p, average="macro", zero_division=0)) < 1e-6
+        )
+
+    def test_compute_groups_detected(self):
+        mc = self._make()
+        mc.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        # precision/recall share per-class stat states → same group; accuracy micro has scalar states
+        groups = mc.compute_groups
+        sizes = sorted(len(v) for v in groups.values())
+        assert sizes == [1, 2]
+
+    def test_compute_groups_match_disabled(self):
+        mc_on = self._make(compute_groups=True)
+        mc_off = self._make(compute_groups=False)
+        for i in range(4):
+            mc_on.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+            mc_off.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        res_on, res_off = mc_on.compute(), mc_off.compute()
+        for k in res_on:
+            np.testing.assert_allclose(np.asarray(res_on[k]), np.asarray(res_off[k]), atol=1e-6)
+
+    def test_prefix_postfix(self):
+        mc = self._make(prefix="train_", postfix="_epoch")
+        mc.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = mc.compute()
+        assert all(k.startswith("train_") and k.endswith("_epoch") for k in res)
+
+    def test_dict_input(self):
+        mc = MetricCollection({
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES),
+        })
+        mc.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = mc.compute()
+        assert set(res) == {"acc", "f1"}
+
+    def test_forward_returns_dict(self):
+        mc = self._make()
+        out = mc(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        assert set(out) == {"MulticlassAccuracy", "MulticlassPrecision", "MulticlassRecall"}
+
+    def test_reset_and_clone(self):
+        mc = self._make()
+        mc.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        clone = mc.clone(prefix="val_")
+        mc.reset()
+        res = clone.compute()
+        assert any(k.startswith("val_") for k in res)
+
+    def test_user_compute_groups(self):
+        mc = self._make(compute_groups=[["MulticlassPrecision", "MulticlassRecall"], ["MulticlassAccuracy"]])
+        for i in range(2):
+            mc.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        res = mc.compute()
+        flat_p, flat_t = PREDS[:2].reshape(-1), TARGET[:2].reshape(-1)
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(flat_t, flat_p)) < 1e-6
+
+
+class TestWrappers:
+    def test_bootstrapper(self):
+        bs = BootStrapper(MeanMetric(), num_bootstraps=20, seed=0)
+        data = jnp.asarray(rng.rand(256).astype(np.float32))
+        bs.update(data)
+        res = bs.compute()
+        assert abs(float(res["mean"]) - float(data.mean())) < 0.05
+        assert float(res["std"]) > 0
+
+    def test_classwise(self):
+        cw = ClasswiseWrapper(MulticlassAccuracy(num_classes=NUM_CLASSES, average="none"), labels=["a", "b", "c", "d", "e"])
+        cw.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = cw.compute()
+        assert set(res) == {f"multiclassaccuracy_{x}" for x in "abcde"}
+
+    def test_minmax(self):
+        mm = MinMaxMetric(MeanMetric())
+        mm.update(jnp.asarray([1.0]))
+        r1 = mm.compute()
+        mm.update(jnp.asarray([9.0]))
+        r2 = mm.compute()
+        assert float(r2["max"]) >= float(r1["raw"])
+        assert float(r2["min"]) <= float(r2["raw"])
+
+    def test_multioutput(self):
+        mo = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        x = jnp.asarray([[1.0, 10.0], [3.0, 30.0]])
+        mo.update(x)
+        res = mo.compute()
+        np.testing.assert_allclose(np.asarray(res), [2.0, 20.0], atol=1e-6)
+
+    def test_multitask(self):
+        mt = MultitaskWrapper({"t1": BinaryAccuracy(), "t2": MeanMetric()})
+        mt.update(
+            {"t1": jnp.asarray([1, 0, 1]), "t2": jnp.asarray([1.0, 2.0])},
+            {"t1": jnp.asarray([1, 0, 0]), "t2": jnp.asarray([0.0, 0.0])},
+        )
+        res = mt.compute()
+        assert abs(float(res["t1"]) - 2 / 3) < 1e-6
+
+    def test_multitask_key_mismatch(self):
+        mt = MultitaskWrapper({"t1": BinaryAccuracy()})
+        with pytest.raises(ValueError):
+            mt.update({"bad": jnp.asarray([1])}, {"t1": jnp.asarray([1])})
+
+    def test_running(self):
+        r = Running(SumMetric(), window=3)
+        for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+            r.update(jnp.asarray(v))
+        assert float(r.compute()) == 12.0  # 3+4+5
+
+    def test_running_mean_forward(self):
+        r = Running(MeanMetric(), window=2)
+        vals = [2.0, 4.0, 6.0]
+        for v in vals:
+            bv = r(jnp.asarray(v))
+            assert abs(float(bv) - v) < 1e-6
+        assert abs(float(r.compute()) - 5.0) < 1e-6  # mean of 4, 6
+
+    def test_tracker(self):
+        tr = MetricTracker(MeanMetric(), maximize=True)
+        for epoch_vals in ([1.0, 1.0], [3.0, 3.0], [2.0, 2.0]):
+            tr.increment()
+            for v in epoch_vals:
+                tr.update(jnp.asarray(v))
+        all_vals = tr.compute_all()
+        np.testing.assert_allclose(np.asarray(all_vals), [1.0, 3.0, 2.0], atol=1e-6)
+        best, step = tr.best_metric(return_step=True)
+        assert best == 3.0 and step == 1
+
+    def test_tracker_collection(self):
+        tr = MetricTracker(
+            MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")]), maximize=[True]
+        )
+        tr.increment()
+        tr.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = tr.best_metric()
+        assert "MulticlassAccuracy" in res

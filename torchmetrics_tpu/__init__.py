@@ -16,4 +16,36 @@ from torchmetrics_tpu.aggregation import (  # noqa: F401
     RunningSum,
     SumMetric,
 )
+from torchmetrics_tpu.collections import MetricCollection  # noqa: F401
 from torchmetrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401
+from torchmetrics_tpu import classification, functional, wrappers  # noqa: F401
+from torchmetrics_tpu.classification import (  # noqa: F401
+    AUROC,
+    ROC,
+    Accuracy,
+    AveragePrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    ExactMatch,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    Specificity,
+    StatScores,
+)
+from torchmetrics_tpu.wrappers import (  # noqa: F401
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)

@@ -1,0 +1,161 @@
+"""Modular AUROC (reference classification/auroc.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.auroc import (
+    _binary_auroc_compute,
+    _reduce_auroc,
+)
+from torchmetrics_tpu.functional.classification.roc import _multiclass_roc_compute, _multilabel_roc_compute
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryAUROC(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        max_fpr: Optional[float] = None,
+        thresholds=None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        if validate_args and max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.max_fpr = max_fpr
+
+    def compute(self) -> Array:
+        return _binary_auroc_compute(self._curve_state(), self.thresholds, self.max_fpr)
+
+
+class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds=None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+        if validate_args and average not in ("macro", "weighted", "none", None):
+            raise ValueError(f"Expected argument `average` to be one of ('macro','weighted','none',None) but got {average}")
+        self.average = average
+
+    def compute(self) -> Array:
+        state = self._curve_state()
+        fpr, tpr, _ = _multiclass_roc_compute(state, self.num_classes, self.thresholds)
+        if self.average == "weighted":
+            if self.thresholds is None:
+                target = state[1]
+                weights = jnp.stack([(target == c).sum() for c in range(self.num_classes)]).astype(jnp.float32)
+            else:
+                weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
+        else:
+            weights = None
+        return _reduce_auroc(fpr, tpr, self.average, weights)
+
+
+class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds=None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(
+                f"Expected argument `average` to be one of ('micro','macro','weighted','none',None) but got {average}"
+            )
+        self.average = average
+
+    def compute(self) -> Array:
+        import numpy as np
+
+        if self.average == "micro":
+            # flatten all labels into one binary problem (reference auroc.py micro)
+            if self.thresholds is None:
+                preds, target = self._curve_state()
+                valid = self._valid_state()
+                keep = np.asarray(valid).ravel()
+                state = (
+                    jnp.asarray(np.asarray(preds).ravel()[keep]),
+                    jnp.asarray(np.asarray(target).ravel()[keep]),
+                )
+                return _binary_auroc_compute(state, None)
+            return _binary_auroc_compute(self.confmat.sum(1), self.thresholds)
+        if self.thresholds is None:
+            preds, target = self._curve_state()
+            valid = self._valid_state()
+            fpr, tpr, _ = _multilabel_roc_compute((preds, target), self.num_labels, None, valid)
+            weights = (target * valid).sum(0).astype(jnp.float32)
+        else:
+            fpr, tpr, _ = _multilabel_roc_compute(self.confmat, self.num_labels, self.thresholds)
+            weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
+        return _reduce_auroc(fpr, tpr, self.average, weights)
+
+
+class AUROC(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAUROC(max_fpr, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAUROC(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAUROC(num_labels, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

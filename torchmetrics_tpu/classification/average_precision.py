@@ -1,0 +1,152 @@
+"""Modular AveragePrecision (reference classification/average_precision.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.average_precision import (
+    _binary_average_precision_compute,
+    _reduce_average_precision,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        return _binary_average_precision_compute(self._curve_state(), self.thresholds)
+
+
+class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds=None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+        if validate_args and average not in ("macro", "weighted", "none", None):
+            raise ValueError(f"Expected argument `average` to be one of ('macro','weighted','none',None) but got {average}")
+        self.average = average
+
+    def compute(self) -> Array:
+        state = self._curve_state()
+        precision, recall, _ = _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds)
+        if self.average == "weighted":
+            if self.thresholds is None:
+                target = state[1]
+                weights = jnp.stack([(target == c).sum() for c in range(self.num_classes)]).astype(jnp.float32)
+            else:
+                weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
+        else:
+            weights = None
+        return _reduce_average_precision(precision, recall, self.average, weights)
+
+
+class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds=None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(
+                f"Expected argument `average` to be one of ('micro','macro','weighted','none',None) but got {average}"
+            )
+        self.average = average
+
+    def compute(self) -> Array:
+        import numpy as np
+
+        if self.average == "micro":
+            if self.thresholds is None:
+                preds, target = self._curve_state()
+                valid = self._valid_state()
+                keep = np.asarray(valid).ravel()
+                state = (
+                    jnp.asarray(np.asarray(preds).ravel()[keep]),
+                    jnp.asarray(np.asarray(target).ravel()[keep]),
+                )
+                return _binary_average_precision_compute(state, None)
+            return _binary_average_precision_compute(self.confmat.sum(1), self.thresholds)
+        if self.thresholds is None:
+            preds, target = self._curve_state()
+            valid = self._valid_state()
+            precision, recall, _ = _multilabel_precision_recall_curve_compute(
+                (preds, target), self.num_labels, None, self.ignore_index, valid
+            )
+            weights = (target * valid).sum(0).astype(jnp.float32)
+        else:
+            precision, recall, _ = _multilabel_precision_recall_curve_compute(
+                self.confmat, self.num_labels, self.thresholds
+            )
+            weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
+        return _reduce_average_precision(precision, recall, self.average, weights)
+
+
+class AveragePrecision(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAveragePrecision(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAveragePrecision(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAveragePrecision(num_labels, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

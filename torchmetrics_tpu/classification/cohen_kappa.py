@@ -1,0 +1,82 @@
+"""Modular CohenKappa (reference classification/cohen_kappa.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(threshold, ignore_index, normalize=None, validate_args=validate_args, **kwargs)
+        if validate_args and weights not in (None, "linear", "quadratic"):
+            raise ValueError(f"Expected argument `weights` to be one of None, 'linear', 'quadratic' but got {weights}")
+        self.weights = weights
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, ignore_index, normalize=None, validate_args=validate_args, **kwargs)
+        if validate_args and weights not in (None, "linear", "quadratic"):
+            raise ValueError(f"Expected argument `weights` to be one of None, 'linear', 'quadratic' but got {weights}")
+        self.weights = weights
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        weights: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCohenKappa(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,136 @@
+"""Modular HingeLoss (reference classification/hinge.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.hinge import (
+    _binary_hinge_loss_arg_validation,
+    _binary_hinge_loss_update,
+    _hinge_loss_compute,
+    _multiclass_hinge_loss_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class BinaryHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        squared: bool = False,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_hinge_loss_arg_validation(squared, ignore_index)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        import numpy as np
+
+        from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits
+
+        preds = _sigmoid_if_logits(jnp.asarray(preds).reshape(-1).astype(jnp.float32))
+        target = jnp.asarray(target).reshape(-1)
+        if self.ignore_index is not None:
+            keep = np.asarray(target != self.ignore_index)
+            preds = jnp.asarray(np.asarray(preds)[keep])
+            target = jnp.asarray(np.asarray(target)[keep])
+        measures, total = _binary_hinge_loss_update(preds, target, self.squared)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class MulticlassHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_hinge_loss_arg_validation(squared, ignore_index)
+            if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+                raise ValueError(
+                    f"Expected argument `multiclass_mode` to be one of 'crammer-singer', 'one-vs-all' but got {multiclass_mode}"
+                )
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state(
+            "measures",
+            jnp.asarray(0.0) if multiclass_mode == "crammer-singer" else jnp.zeros(num_classes),
+            dist_reduce_fx="sum",
+        )
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        import numpy as np
+
+        from torchmetrics_tpu.functional.classification.stat_scores import _softmax_if_logits
+
+        preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, self.num_classes).astype(jnp.float32)
+        preds = _softmax_if_logits(preds, axis=-1)
+        target = jnp.asarray(target).reshape(-1)
+        if self.ignore_index is not None:
+            keep = np.asarray(target != self.ignore_index)
+            preds = jnp.asarray(np.asarray(preds)[keep])
+            target = jnp.asarray(np.asarray(target)[keep])
+        measures, total = _multiclass_hinge_loss_update(
+            preds, target, self.num_classes, self.squared, self.multiclass_mode
+        )
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class HingeLoss(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        num_classes: Optional[int] = None,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryHingeLoss(squared, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassHingeLoss(num_classes, squared, multiclass_mode, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,244 @@
+"""Modular PR-curve family base classes (reference classification/precision_recall_curve.py).
+
+State layout per mode:
+- thresholds=None → list states ``preds``/``target`` (dist_reduce_fx="cat")
+- binned → single ``confmat`` tensor state (T, [C,] 2, 2) with "sum" — jit-native.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            self.add_state(
+                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        preds, target, valid, _ = _binary_precision_recall_curve_format(
+            preds, target, None if self.thresholds is None else self.thresholds, self.ignore_index
+        )
+        if self.thresholds is None:
+            keep = np.asarray(valid)
+            self.preds.append(jnp.asarray(np.asarray(preds)[keep]))
+            self.target.append(jnp.asarray(np.asarray(target)[keep]))
+        else:
+            self.confmat = self.confmat + _binary_precision_recall_curve_update(preds, target, valid, self.thresholds)
+
+    def _curve_state(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.thresholds is None:
+            return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return self.confmat
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        return _binary_precision_recall_curve_compute(self._curve_state(), self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=type(self).__name__,
+        )
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            self.add_state(
+                "confmat",
+                default=jnp.zeros((len(thresholds), num_classes, 2, 2), dtype=jnp.int32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target, valid, _ = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, None if self.thresholds is None else self.thresholds, self.ignore_index
+        )
+        if self.thresholds is None:
+            keep = np.asarray(valid)
+            self.preds.append(jnp.asarray(np.asarray(preds)[keep]))
+            self.target.append(jnp.asarray(np.asarray(target)[keep]))
+        else:
+            self.confmat = self.confmat + _multiclass_precision_recall_curve_update(
+                preds, target, valid, self.num_classes, self.thresholds
+            )
+
+    def _curve_state(self):
+        if self.thresholds is None:
+            return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return self.confmat
+
+    def compute(self):
+        return _multiclass_precision_recall_curve_compute(self._curve_state(), self.num_classes, self.thresholds)
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self.add_state("valid", default=[], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            self.add_state(
+                "confmat",
+                default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, None if self.thresholds is None else self.thresholds, self.ignore_index
+        )
+        if self.thresholds is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            self.valid.append(valid)
+        else:
+            self.confmat = self.confmat + _multilabel_precision_recall_curve_update(
+                preds, target, valid, self.num_labels, self.thresholds
+            )
+
+    def _curve_state(self):
+        if self.thresholds is None:
+            return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return self.confmat
+
+    def _valid_state(self):
+        return dim_zero_cat(self.valid) if self.thresholds is None else None
+
+    def compute(self):
+        if self.thresholds is None:
+            return _multilabel_precision_recall_curve_compute(
+                self._curve_state(), self.num_labels, None, self.ignore_index, self._valid_state()
+            )
+        return _multilabel_precision_recall_curve_compute(self._curve_state(), self.num_labels, self.thresholds)
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,71 @@
+"""Modular multilabel ranking metrics (reference classification/ranking.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.ranking import (
+    _coverage_error_update,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_update,
+    _multilabel_ranking_format,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class _MultilabelRankingBase(Metric):
+    is_differentiable = False
+    full_state_update: bool = False
+
+    _update_fn_ranking = None
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args and (not isinstance(num_labels, int) or num_labels < 2):
+            raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _ranking_update(self, preds: Array, target: Array):
+        raise NotImplementedError
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _multilabel_ranking_format(preds, target, self.num_labels, self.ignore_index)
+        measure, total = self._ranking_update(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.measure / self.total
+
+
+class MultilabelCoverageError(_MultilabelRankingBase):
+    higher_is_better = False
+
+    def _ranking_update(self, preds: Array, target: Array):
+        return _coverage_error_update(preds, target)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingBase):
+    higher_is_better = True
+
+    def _ranking_update(self, preds: Array, target: Array):
+        return _label_ranking_average_precision_update(preds, target)
+
+
+class MultilabelRankingLoss(_MultilabelRankingBase):
+    higher_is_better = False
+
+    def _ranking_update(self, preds: Array, target: Array):
+        return _label_ranking_loss_update(preds, target)

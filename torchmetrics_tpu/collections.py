@@ -1,0 +1,355 @@
+"""MetricCollection with compute groups (reference collections.py, 664 LoC).
+
+Accepts a list/dict/kwargs of metrics, renames outputs with prefix/postfix, and
+filters kwargs per metric. **Compute groups** — the flagship optimization
+(reference :228-308): after the first update, metrics whose post-update states
+compare equal are merged into groups; thereafter only the group leader gets
+``update`` and followers hold *references* to the leader's state. jnp arrays are
+immutable, so "reference" sharing is simply pointing followers' state dicts at
+the same arrays after each leader update — no aliasing hazards, and the
+copy-on-access dance of the reference (:515-549) is unnecessary by construction.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import _flatten_dict
+
+_PREFIX_SUFFIX_ERROR = "Expected input `{}` to be a string, but got {}"
+
+
+class MetricCollection:
+    """Dict-like collection of metrics sharing update calls.
+
+    Args:
+        metrics: single metric, list/tuple of metrics, or dict name→metric.
+        prefix / postfix: added to each output key.
+        compute_groups: True (auto-detect), False (disable), or explicit list of
+            lists of metric names.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._state_is_copy = False
+        self._modules: Dict[str, Metric] = {}
+        self.add_metrics(metrics, *additional_metrics)
+
+    # --------------------------------------------------------------- plumbing
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(_PREFIX_SUFFIX_ERROR.format(name, arg))
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add metrics to the collection (reference collections.py:423-462)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, (list, tuple)):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    def _init_compute_groups(self) -> None:
+        """Initialize compute groups (reference collections.py:462-482)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                        )
+            self._groups_checked = True
+        else:
+            # start with all metrics in their own group; merged after first update
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    # ----------------------------------------------------------- dict protocol
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._modules.keys()
+        return [self._set_name(k) for k in self._modules]
+
+    def values(self, copy_state: bool = False) -> Iterable[Metric]:
+        return self._modules.values()
+
+    def items(self, keep_base: bool = False, copy_state: bool = False) -> Iterable[Tuple[str, Metric]]:
+        if keep_base:
+            return self._modules.items()
+        return [(self._set_name(k), v) for k, v in self._modules.items()]
+
+    def __getitem__(self, key: str) -> Metric:
+        if key in self._modules:
+            return self._modules[key]
+        # try without prefix/postfix
+        for k in self._modules:
+            if self._set_name(k) == key:
+                return self._modules[k]
+        raise KeyError(key)
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules or key in self.keys()
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    # ------------------------------------------------------------- metric API
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric once per compute group (reference :200-226)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            self._compute_groups_create_state_ref()
+        else:
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Union groups whose states compare equal (reference :228-262), O(n²)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            if num_groups == len(self._groups):
+                break
+            num_groups = len(self._groups)
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """True if both metrics hold identical states (reference :264-287)."""
+        if not metric1._defaults or not metric2._defaults:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        if metric1._reductions != metric2._reductions:
+            return False
+        for key in metric1._defaults:
+            s1 = metric1._state[key]
+            s2 = metric2._state[key]
+            if type(s1) != type(s2):  # noqa: E721
+                return False
+            if isinstance(s1, list):
+                if len(s1) != len(s2):
+                    return False
+                if not all(a.shape == b.shape and bool(jnp.array_equal(a, b)) for a, b in zip(s1, s2)):
+                    return False
+            else:
+                if s1.shape != s2.shape or not bool(jnp.array_equal(s1, s2)):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Point follower states at the leader's arrays (reference :289-308)."""
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            for name in cg[1:]:
+                follower = self._modules[name]
+                for state in m0._defaults:
+                    val = m0._state[state]
+                    follower._state[state] = list(val) if isinstance(val, list) else val
+                follower._update_count = m0._update_count
+                follower._computed = None
+        self._state_is_copy = copy
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Batch values for every metric, one shared update per compute group.
+
+        Goes beyond the reference (which disables groups under forward,
+        collections.py:200-226 docs): for groups whose members are all
+        ``full_state_update=False``, the leader's batch state is computed once
+        and every member derives both its batch value and its global-state merge
+        from it — the 2-3× update saving applies to the training-step path too.
+        """
+        res: Dict[str, Any] = {}
+        if self._groups_checked and self._enable_compute_groups:
+            for cg in self._groups.values():
+                members = [(n, self._modules[n]) for n in cg]
+                m0 = members[0][1]
+                if len(cg) > 1 and all(
+                    m.full_state_update is False and not m.dist_sync_on_step for _, m in members
+                ):
+                    batch_state = m0.functional_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
+                    global_state = m0._copy_state_dict()
+                    m0._state = {k: (list(v) if isinstance(v, list) else v) for k, v in batch_state.items()}
+                    m0._update_count += 1
+                    m0._reduce_states(global_state)
+                    m0._computed = None
+                    for name, m in members:
+                        res[name] = m.functional_compute(batch_state)
+                else:
+                    for name, m in members:
+                        res[name] = m(*args, **m._filter_kwargs(**kwargs))
+            self._compute_groups_create_state_ref()
+        else:
+            res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
+            if self._enable_compute_groups and not self._groups_checked:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+        res, _ = _flatten_dict({self._set_name(k): v for k, v in res.items()})
+        return res
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str) -> Dict[str, Any]:
+        """Per metric compute/forward, flatten dict results (reference :314-359)."""
+        result = {}
+        for k, m in self._modules.items():
+            res = getattr(m, method_name)()
+            result[k] = res
+        _, duplicates = _flatten_dict({k: v for k, v in result.items() if isinstance(v, dict)})
+        flat = {}
+        for k, res in result.items():
+            if isinstance(res, dict):
+                for sub_k, sub_v in res.items():
+                    flat[f"{self._set_name(k)}_{sub_k}" if duplicates else self._set_name(sub_k)] = sub_v
+            else:
+                flat[self._set_name(k)] = res
+        return flat
+
+    def reset(self) -> None:
+        for m in self._modules.values():
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix is not None:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix is not None:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, m in self._modules.items():
+            m.state_dict(out, prefix=f"{k}.")
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for k, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{k}.", strict=strict)
+
+    def to(self, device) -> "MetricCollection":
+        for m in self._modules.values():
+            m.to(device)
+        return self
+
+    def sync(self, **kwargs: Any) -> None:
+        for m in self._modules.values():
+            m.sync(**kwargs)
+
+    def unsync(self, **kwargs: Any) -> None:
+        for m in self._modules.values():
+            m.unsync(**kwargs)
+
+    def plot(self, val: Optional[Dict[str, Any]] = None, ax: Any = None, together: bool = False):
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(val, ax=ax)
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for k, v in self._modules.items():
+            repr_str += f"\n  {k}: {v!r},"
+        return repr_str + "\n)"

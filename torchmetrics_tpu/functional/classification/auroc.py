@@ -1,0 +1,189 @@
+"""AUROC (reference functional/classification/auroc.py, 480 LoC).
+
+Trapezoidal area under the ROC built from the shared curve state.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _trapz(y: Array, x: Array) -> Array:
+    """Trapezoid along the last axis."""
+    dx = jnp.diff(x, axis=-1)
+    return ((y[..., :-1] + y[..., 1:]) / 2.0 * dx).sum(-1)
+
+
+def _binary_auroc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    max_fpr: Optional[float] = None,
+    pos_label: int = 1,
+) -> Array:
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
+    if max_fpr is None or max_fpr == 1:
+        return _trapz(tpr, fpr)
+    # McClish correction for partial AUC (reference auroc.py)
+    fpr_np, tpr_np = np.asarray(fpr), np.asarray(tpr)
+    stop = np.searchsorted(fpr_np, max_fpr, "right")
+    x_interp = np.interp(max_fpr, fpr_np[max(stop - 1, 0): stop + 1], tpr_np[max(stop - 1, 0): stop + 1]) if stop < fpr_np.size else tpr_np[-1]
+    fpr_c = np.hstack([fpr_np[:stop], [max_fpr]])
+    tpr_c = np.hstack([tpr_np[:stop], [x_interp]])
+    partial_auc = float(np.trapezoid(tpr_c, fpr_c)) if hasattr(np, "trapezoid") else float(np.trapz(tpr_c, fpr_c))
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return jnp.asarray(0.5 * (1 + (partial_auc - min_area) / (max_area - min_area)), dtype=jnp.float32)
+
+
+def binary_auroc(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _binary_auroc_compute(state, thresholds, max_fpr)
+
+
+def _reduce_auroc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Per-class trapezoids then average (reference auroc.py:_reduce_auroc)."""
+    if isinstance(fpr, (list, tuple)):
+        res = jnp.stack([_trapz(t, f) for f, t in zip(fpr, tpr)])
+    else:
+        res = _trapz(tpr, fpr)
+    if average in (None, "none"):
+        return res
+    if average == "macro":
+        return res.mean()
+    if average == "weighted":
+        assert weights is not None
+        w = _safe_divide(weights.astype(jnp.float32), weights.sum())
+        return (res * w).sum()
+    raise ValueError(f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None) but got {average}")
+
+
+def multiclass_auroc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+        target_for_w = state[1]
+    else:
+        target_for_w = jnp.asarray(np.asarray(target)[np.asarray(valid)])
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    weights = jnp.stack([(target_for_w == c).sum() for c in range(num_classes)]).astype(jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights)
+
+
+def multilabel_auroc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    if average == "micro":
+        if state is None:
+            keep = np.asarray(valid).ravel()
+            return _binary_auroc_compute(
+                (jnp.asarray(np.asarray(preds).ravel()[keep]), jnp.asarray(np.asarray(target).ravel()[keep])), None
+            )
+        return _binary_auroc_compute(state.sum(1), thresholds)
+    if state is None:
+        fpr, tpr, _ = _multilabel_roc_compute((preds, target), num_labels, None, valid)
+    else:
+        fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds)
+    weights = (jnp.asarray(target) * jnp.asarray(valid)).sum(0).astype(jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights)
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

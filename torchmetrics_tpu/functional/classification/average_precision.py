@@ -1,0 +1,169 @@
+"""Average precision (reference functional/classification/average_precision.py, 467 LoC).
+
+AP = Σ (R_n − R_{n+1}) · P_n over the PR curve from the shared state.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _ap_from_curve(precision: Array, recall: Array) -> Array:
+    """AP over one (precision, recall) curve: −Σ ΔR · P."""
+    return -jnp.sum(jnp.diff(recall) * precision[:-1])
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Array:
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return _ap_from_curve(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _reduce_average_precision(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    if isinstance(precision, (list, tuple)):
+        res = jnp.stack([_ap_from_curve(p, r) for p, r in zip(precision, recall)])
+    else:  # (C, T+1) arrays from binned mode
+        res = -jnp.sum(jnp.diff(recall, axis=1) * precision[:, :-1], axis=1)
+    res = jnp.where(jnp.isnan(res), 0.0, res)
+    if average in (None, "none"):
+        return res
+    if average == "macro":
+        return res.mean()
+    if average == "weighted":
+        assert weights is not None
+        w = _safe_divide(weights.astype(jnp.float32), weights.sum())
+        return (res * w).sum()
+    raise ValueError(f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None) but got {average}")
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+        target_for_w = state[1]
+    else:
+        target_for_w = jnp.asarray(np.asarray(target)[np.asarray(valid)])
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    weights = jnp.stack([(target_for_w == c).sum() for c in range(num_classes)]).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights)
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    if average == "micro":
+        if state is None:
+            keep = np.asarray(valid).ravel()
+            return _binary_average_precision_compute(
+                (jnp.asarray(np.asarray(preds).ravel()[keep]), jnp.asarray(np.asarray(target).ravel()[keep])), None
+            )
+        return _binary_average_precision_compute(state.sum(1), thresholds)
+    if state is None:
+        precision, recall, _ = _multilabel_precision_recall_curve_compute((preds, target), num_labels, None, ignore_index, valid)
+    else:
+        precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds)
+    weights = (jnp.asarray(target) * jnp.asarray(valid)).sum(0).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,138 @@
+"""Calibration error ECE/MCE (reference functional/classification/calibration_error.py, 365 LoC).
+
+Binned confidence calibration: state = per-bin (conf_sum, acc_sum, count) built
+with a single scatter-add — jit-native, constant memory.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries_or_n: int
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean confidence, mean accuracy and proportion (reference :36-60)."""
+    n_bins = bin_boundaries_or_n
+    indices = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    count = jnp.zeros(n_bins).at[indices].add(1.0)
+    conf = jnp.zeros(n_bins).at[indices].add(confidences)
+    acc = jnp.zeros(n_bins).at[indices].add(accuracies.astype(jnp.float32))
+    prop_bin = count / count.sum()
+    return _safe_divide(conf, count), _safe_divide(acc, count), prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    n_bins: int,
+    norm: str = "l1",
+) -> Array:
+    conf_bin, acc_bin, prop_bin = _binning_bucketize(confidences, accuracies, n_bins)
+    if norm == "l1":
+        return ((acc_bin - conf_bin).__abs__() * prop_bin).sum()
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin) * (prop_bin > 0))
+    if norm == "l2":
+        ce = ((acc_bin - conf_bin) ** 2 * prop_bin).sum()
+        return jnp.sqrt(ce)
+    raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+
+def _binary_calibration_error_arg_validation(n_bins: int, norm: str, ignore_index: Optional[int]) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+    """Returns (confidences, accuracies) with invalid entries mapped to bin-neutral 0."""
+    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
+    accuracies = jnp.where(preds > 0.5, target == 1, target == 0)
+    return jnp.where(valid, confidences, 0.0), jnp.where(valid, accuracies, False)
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(
+        preds, target, threshold=0.5, ignore_index=ignore_index, convert_to_labels=False
+    )
+    import numpy as np
+
+    keep = np.asarray(valid)
+    confidences, accuracies = _binary_calibration_error_update(
+        jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]), jnp.ones(int(keep.sum()), dtype=bool)
+    )
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    import numpy as np
+
+    from torchmetrics_tpu.functional.classification.stat_scores import _softmax_if_logits
+
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _softmax_if_logits(preds, axis=-1)
+    if ignore_index is not None:
+        keep = np.asarray(target != ignore_index)
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    confidences = preds.max(-1)
+    accuracies = preds.argmax(-1) == target
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

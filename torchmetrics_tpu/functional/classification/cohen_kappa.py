@@ -1,0 +1,92 @@
+"""Cohen's kappa (reference functional/classification/cohen_kappa.py, 271 LoC)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """κ from a confusion matrix with optional 'linear'/'quadratic' weighting."""
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[-1]
+    sum0 = confmat.sum(0, keepdims=True)
+    sum1 = confmat.sum(1, keepdims=True)
+    expected = sum1 @ sum0 / sum0.sum()
+
+    if weights is None:
+        w_mat = jnp.ones((n_classes, n_classes)) - jnp.eye(n_classes)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.arange(n_classes, dtype=jnp.float32)
+        w_mat = jnp.abs(w_mat[:, None] - w_mat[None, :])
+        if weights == "quadratic":
+            w_mat = w_mat**2
+    else:
+        raise ValueError(f"Received an invalid value for argument `weights`, expected one of None, 'linear', 'quadratic' but got {weights}")
+    k = (w_mat * confmat).sum() / (w_mat * expected).sum()
+    return 1 - k
+
+
+def binary_cohen_kappa(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, valid)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def multiclass_cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

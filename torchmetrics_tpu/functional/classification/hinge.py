@@ -1,0 +1,122 @@
+"""Hinge loss (reference functional/classification/hinge.py, 289 LoC)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits, _softmax_if_logits
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    target = target * 2 - 1  # {0,1} → {-1,1}
+    margin = 1 - target * preds
+    losses = jnp.where(margin > 0, margin, 0.0)
+    if squared:
+        losses = losses**2
+    return losses.sum(), jnp.asarray(losses.size, dtype=jnp.float32)
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+    import numpy as np
+
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    # sigmoid-if-logits, like the reference's confusion-matrix format with
+    # convert_to_labels=False (reference hinge.py:118-120)
+    preds = _sigmoid_if_logits(preds)
+    if ignore_index is not None:
+        keep = np.asarray(target != ignore_index)
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array, target: Array, num_classes: int, squared: bool, multiclass_mode: str
+) -> Tuple[Array, Array]:
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.where(target_oh, preds, -jnp.inf).max(-1) - jnp.where(target_oh, -jnp.inf, preds).max(-1)
+        losses = jnp.where(1 - margin > 0, 1 - margin, 0.0)
+        if squared:
+            losses = losses**2
+        return losses.sum(), jnp.asarray(losses.size, dtype=jnp.float32)
+    # one-vs-all
+    t = jnp.where(target_oh, 1.0, -1.0)
+    margin = 1 - t * preds
+    losses = jnp.where(margin > 0, margin, 0.0)
+    if squared:
+        losses = losses**2
+    return losses.sum(0), jnp.asarray(losses.shape[0], dtype=jnp.float32)
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+            raise ValueError(
+                f"Expected argument `multiclass_mode` to be one of 'crammer-singer', 'one-vs-all' but got {multiclass_mode}"
+            )
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+    import numpy as np
+
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _softmax_if_logits(preds, axis=-1)  # reference hinge.py multiclass format
+    if ignore_index is not None:
+        keep = np.asarray(target != ignore_index)
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    measures, total = _multiclass_hinge_loss_update(preds, target, num_classes, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

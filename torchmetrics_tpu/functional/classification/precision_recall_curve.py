@@ -1,0 +1,402 @@
+"""Precision-recall curve machinery (reference functional/classification/precision_recall_curve.py, 1,008 LoC).
+
+Two state modes, exactly as the reference:
+
+- ``thresholds=None`` → exact curve. All preds/targets accumulate (list states);
+  compute sorts + cumsums **eagerly on host** (dynamic output length is illegal
+  under jit, and this path is the reference's memory-unbounded mode anyway).
+- ``thresholds=int|list|Array`` → binned mode, constant memory. State is a
+  ``(T, 2, 2)`` multi-threshold confusion matrix built with one weighted
+  scatter-add over ``preds_t + 2*target + 4*arange(T)`` (reference :211-226) —
+  a single deterministic TPU kernel, fully jit-native. This is the mode to use
+  inside a traced training step.
+
+ROC / AUROC / AveragePrecision reuse this state and post-process.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits, _softmax_if_logits
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Thresholds = Union[int, List[float], Array, None]
+
+
+def _adjust_threshold_arg(thresholds: Thresholds = None) -> Optional[Array]:
+    """Convert threshold arg to a tensor of thresholds (reference :104-112)."""
+    if thresholds is None:
+        return None
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, (list, tuple)):
+        return jnp.asarray(thresholds, dtype=jnp.float32)
+    return jnp.asarray(thresholds)
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    if thresholds is not None and not isinstance(thresholds, (list, tuple, int)) and not hasattr(thresholds, "shape"):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or tensor of floats,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}")
+    if isinstance(thresholds, (list, tuple)) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            f"If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range, but got {thresholds}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Flatten, sigmoid-if-logits; returns (preds, target, valid_mask, thresholds)."""
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _sigmoid_if_logits(preds)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        target = jnp.where(valid, target, 0)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target.astype(jnp.int32), valid, thresholds
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array, target: Array, valid: Array, thresholds: Optional[Array]
+) -> Optional[Array]:
+    """Binned state update: one weighted scatter-add building (T, 2, 2) counts."""
+    if thresholds is None:
+        return None
+    len_t = thresholds.shape[0]
+    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.int32)  # (T, N)
+    unique_mapping = preds_t + 2 * target[None, :] + 4 * jnp.arange(len_t)[:, None]
+    w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :], unique_mapping.shape)
+    bins = jnp.zeros(4 * len_t, dtype=jnp.float32).at[unique_mapping.reshape(-1)].add(w.reshape(-1))
+    return bins.reshape(len_t, 2, 2).astype(jnp.int32)
+
+
+def _binary_clf_curve(
+    preds: Array, target: Array, sample_weights: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Exact fps/tps per distinct threshold, host-side (reference :29-81)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    desc_idx = np.argsort(-preds, kind="stable")
+    preds = preds[desc_idx]
+    target = target[desc_idx]
+    weight = np.asarray(sample_weights)[desc_idx] if sample_weights is not None else 1.0
+    distinct_idx = np.nonzero(np.diff(preds))[0]
+    threshold_idxs = np.concatenate([distinct_idx, [target.size - 1]])
+    tps = np.cumsum(target * weight)[threshold_idxs]
+    if sample_weights is not None:
+        fps = np.cumsum((1 - target) * weight)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return jnp.asarray(fps), jnp.asarray(tps), jnp.asarray(preds[threshold_idxs])
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Compute (precision, recall, thresholds) from binned confmat or raw pair."""
+    if thresholds is not None and isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, tuple):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+    preds, target = state
+    # host path (eager; dynamic shapes fine): reverse so recall is decreasing,
+    # append the (P=1, R=0) endpoint — sklearn>=1.9 / reference semantics
+    fps, tps, thresh = (np.asarray(x) for x in _binary_clf_curve(preds, target))
+    ps = tps + fps
+    precision = np.where(ps != 0, tps / np.where(ps == 0, 1, ps), 0.0)
+    recall = tps / tps[-1] if tps.size and tps[-1] != 0 else np.ones_like(tps, dtype=np.float64)
+    precision = jnp.asarray(np.hstack([precision[::-1], [1.0]]), dtype=jnp.float32)
+    recall = jnp.asarray(np.hstack([recall[::-1], [0.0]]), dtype=jnp.float32)
+    thresh = jnp.asarray(thresh[::-1])
+    return precision, recall, thresh
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Binary PR curve (reference :141+). Returns (precision, recall, thresholds)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    if state is None:
+        # exact mode: drop ignored entries on host
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# ----------------------------------------------------------------- multiclass
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_classes={num_classes}`")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be a float tensor with probabilities/logits")
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _softmax_if_logits(preds, axis=-1)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        target = jnp.where(valid, target, 0)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target.astype(jnp.int32), valid, thresholds
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array, target: Array, valid: Array, num_classes: int, thresholds: Optional[Array]
+) -> Optional[Array]:
+    """Binned state: (T, C, 2, 2) counts via one scatter-add."""
+    if thresholds is None:
+        return None
+    len_t = thresholds.shape[0]
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.int32)  # (T, N, C)
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32)  # (N, C)
+    idx = (
+        preds_t
+        + 2 * target_oh[None, :, :]
+        + 4 * jnp.arange(num_classes)[None, None, :]
+        + 4 * num_classes * jnp.arange(len_t)[:, None, None]
+    )
+    w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :, None], idx.shape)
+    bins = jnp.zeros(4 * num_classes * len_t, dtype=jnp.float32).at[idx.reshape(-1)].add(w.reshape(-1))
+    return bins.reshape(len_t, num_classes, 2, 2).astype(jnp.int32)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)], axis=0).T
+        return precision, recall, thresholds
+    preds, target = state
+    precision_list, recall_list, thresh_list = [], [], []
+    for c in range(num_classes):
+        p, r, t = _binary_precision_recall_curve_compute(
+            (preds[:, c], (target == c).astype(jnp.int32)), None
+        )
+        precision_list.append(p)
+        recall_list.append(r)
+        thresh_list.append(t)
+    return precision_list, recall_list, thresh_list
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Multiclass one-vs-rest PR curves (reference :217+)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+
+
+# ----------------------------------------------------------------- multilabel
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_labels={num_labels}`")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be a float tensor with probabilities/logits")
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(jnp.asarray(target), 1, -1).reshape(-1, num_labels)
+    preds = _sigmoid_if_logits(preds)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        target = jnp.where(valid, target, 0)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target.astype(jnp.int32), valid, thresholds
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array, target: Array, valid: Array, num_labels: int, thresholds: Optional[Array]
+) -> Optional[Array]:
+    if thresholds is None:
+        return None
+    len_t = thresholds.shape[0]
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.int32)  # (T, N, L)
+    idx = (
+        preds_t
+        + 2 * target[None, :, :]
+        + 4 * jnp.arange(num_labels)[None, None, :]
+        + 4 * num_labels * jnp.arange(len_t)[:, None, None]
+    )
+    w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :, :], idx.shape)
+    bins = jnp.zeros(4 * num_labels * len_t, dtype=jnp.float32).at[idx.reshape(-1)].add(w.reshape(-1))
+    return bins.reshape(len_t, num_labels, 2, 2).astype(jnp.int32)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+    valid: Optional[Array] = None,
+):
+    if thresholds is not None and not isinstance(state, tuple):
+        return _multiclass_precision_recall_curve_compute(state, num_labels, thresholds)
+    preds, target = state
+    precision_list, recall_list, thresh_list = [], [], []
+    for lbl in range(num_labels):
+        p_l = np.asarray(preds[:, lbl])
+        t_l = np.asarray(target[:, lbl])
+        if valid is not None:
+            keep = np.asarray(valid[:, lbl])
+            p_l, t_l = p_l[keep], t_l[keep]
+        p, r, t = _binary_precision_recall_curve_compute((jnp.asarray(p_l), jnp.asarray(t_l)), None)
+        precision_list.append(p)
+        recall_list.append(r)
+        thresh_list.append(t)
+    return precision_list, recall_list, thresh_list
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-label PR curves (reference :557+)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    if state is None:
+        return _multilabel_precision_recall_curve_compute((preds, target), num_labels, None, ignore_index, valid)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,105 @@
+"""Multilabel ranking metrics (reference functional/classification/ranking.py, 267 LoC).
+
+coverage_error, label_ranking_average_precision, label_ranking_loss.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _rank_data_max(x: Array) -> Array:
+    """Tie-aware descending 'max' rank: rank[l] = #{l' : x[l'] >= x[l]}.
+
+    Matches scipy's rankdata(-x, method='max') used by sklearn's ranking metrics;
+    the O(L²) pairwise compare is a single fused TPU kernel for typical L.
+    """
+    return (x[:, None, :] >= x[:, :, None]).sum(-1)
+
+
+def _multilabel_ranking_format(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds).reshape(-1, num_labels).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1, num_labels)
+    preds = _sigmoid_if_logits(preds)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, 0, target)
+    return preds, target.astype(jnp.int32)
+
+
+def _coverage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Per-sample: rank of the lowest-scored relevant label (reference :30-45)."""
+    big = jnp.where(target == 1, preds, jnp.inf)
+    min_relevant = big.min(-1, keepdims=True)
+    coverage = (preds >= min_relevant).sum(-1).astype(jnp.float32)
+    has_pos = (target == 1).any(-1)
+    coverage = jnp.where(has_pos, coverage, 0.0)
+    return coverage.sum(), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_coverage_error(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _check_same_shape(preds, target)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    coverage, total = _coverage_error_update(preds, target)
+    return coverage / total
+
+
+def _label_ranking_average_precision_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Mean precision at each relevant label's rank (reference :95-130).
+
+    Tie-aware: both the overall rank and the rank among relevant labels use the
+    'max' convention (#labels with score >= this label's score).
+    """
+    n, L = preds.shape
+    rel = target == 1
+    rank = _rank_data_max(preds)  # (N, L)
+    # rank among relevant: #{l' relevant : preds[l'] >= preds[l]}
+    rank_among_rel = ((preds[:, None, :] >= preds[:, :, None]) & rel[:, None, :]).sum(-1)
+    score_per_label = jnp.where(rel, rank_among_rel / rank, 0.0)
+    n_rel = rel.sum(-1)
+    per_sample = jnp.where(n_rel > 0, score_per_label.sum(-1) / jnp.where(n_rel == 0, 1, n_rel), 1.0)
+    return per_sample.sum(), jnp.asarray(n, dtype=jnp.float32)
+
+
+def multilabel_ranking_average_precision(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _check_same_shape(preds, target)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    score, total = _label_ranking_average_precision_update(preds, target)
+    return score / total
+
+
+def _label_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Average fraction of incorrectly ordered (relevant, irrelevant) pairs."""
+    rel = target == 1
+    n_rel = rel.sum(-1)
+    n_irr = (~rel).sum(-1)
+    # count pairs (r, i) with preds[r] <= preds[i]
+    wrong = (
+        (preds[:, None, :] >= preds[:, :, None]) & (rel[:, :, None] & ~rel[:, None, :])
+    ).sum((-2, -1))
+    denom = n_rel * n_irr
+    per_sample = jnp.where(denom > 0, wrong / jnp.where(denom == 0, 1, denom), 0.0)
+    return per_sample.sum(), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_ranking_loss(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _check_same_shape(preds, target)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    loss, total = _label_ranking_loss_update(preds, target)
+    return loss / total

@@ -1,0 +1,188 @@
+"""ROC curve (reference functional/classification/roc.py), built on the PR-curve state."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """(fpr, tpr, thresholds) with fpr ascending."""
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
+        fpr = jnp.concatenate([jnp.zeros(1, dtype=fpr.dtype), fpr])
+        tpr = jnp.concatenate([jnp.zeros(1, dtype=tpr.dtype), tpr])
+        thresh = jnp.concatenate([jnp.ones(1, dtype=thresholds.dtype), jnp.flip(thresholds, 0)])
+        return fpr, tpr, thresh
+    preds, target = state
+    fps, tps, thresh = (np.asarray(x) for x in _binary_clf_curve(preds, target))
+    # prepend a (0, 0) point at threshold just above the max (sklearn semantics)
+    tps = np.hstack([[0.0], tps])
+    fps = np.hstack([[0.0], fps])
+    thresh = np.hstack([[1.0 + thresh[0] if thresh.size else 1.0], thresh])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        tpr = np.nan_to_num(tps / tps[-1]) if tps[-1] != 0 else np.zeros_like(tps)
+        fpr = np.nan_to_num(fps / fps[-1]) if fps[-1] != 0 else np.zeros_like(fps)
+    return jnp.asarray(fpr, dtype=jnp.float32), jnp.asarray(tpr, dtype=jnp.float32), jnp.asarray(thresh)
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+):
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        fpr = jnp.concatenate([jnp.zeros((num_classes, 1), dtype=fpr.dtype), fpr], axis=1)
+        tpr = jnp.concatenate([jnp.zeros((num_classes, 1), dtype=tpr.dtype), tpr], axis=1)
+        thresh = jnp.concatenate([jnp.ones(1, dtype=thresholds.dtype), jnp.flip(thresholds, 0)])
+        return fpr, tpr, thresh
+    preds, target = state
+    fpr_list, tpr_list, thresh_list = [], [], []
+    for c in range(num_classes):
+        f, t, th = _binary_roc_compute((preds[:, c], (target == c).astype(jnp.int32)), None)
+        fpr_list.append(f)
+        tpr_list.append(t)
+        thresh_list.append(th)
+    return fpr_list, tpr_list, thresh_list
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _multiclass_roc_compute(state, num_classes, thresholds)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    valid: Optional[Array] = None,
+):
+    if thresholds is not None and not isinstance(state, tuple):
+        return _multiclass_roc_compute(state, num_labels, thresholds)
+    preds, target = state
+    fpr_list, tpr_list, thresh_list = [], [], []
+    for lbl in range(num_labels):
+        p_l = np.asarray(preds[:, lbl])
+        t_l = np.asarray(target[:, lbl])
+        if valid is not None:
+            keep = np.asarray(valid[:, lbl])
+            p_l, t_l = p_l[keep], t_l[keep]
+        f, t, th = _binary_roc_compute((jnp.asarray(p_l), jnp.asarray(t_l)), None)
+        fpr_list.append(f)
+        tpr_list.append(t)
+        thresh_list.append(th)
+    return fpr_list, tpr_list, thresh_list
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    if state is None:
+        return _multilabel_roc_compute((preds, target), num_labels, None, valid)
+    return _multilabel_roc_compute(state, num_labels, thresholds)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

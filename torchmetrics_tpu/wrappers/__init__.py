@@ -1,0 +1,8 @@
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric  # noqa: F401
+from torchmetrics_tpu.wrappers.bootstrapping import BootStrapper  # noqa: F401
+from torchmetrics_tpu.wrappers.classwise import ClasswiseWrapper  # noqa: F401
+from torchmetrics_tpu.wrappers.minmax import MinMaxMetric  # noqa: F401
+from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper  # noqa: F401
+from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper  # noqa: F401
+from torchmetrics_tpu.wrappers.running import Running  # noqa: F401
+from torchmetrics_tpu.wrappers.tracker import MetricTracker  # noqa: F401

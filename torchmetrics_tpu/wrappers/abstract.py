@@ -1,0 +1,23 @@
+"""WrapperMetric base (reference wrappers/abstract.py:19-26): disables own sync."""
+from typing import Any
+
+from torchmetrics_tpu.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Abstract base for wrappers; the wrapper itself never syncs (children do)."""
+
+    def _wrap_update(self, update):
+        return super()._wrap_update(update)
+
+    def sync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def unsync(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> Any:
+        raise NotImplementedError

@@ -1,0 +1,107 @@
+"""BootStrapper (reference wrappers/bootstrapping.py:54).
+
+Maintains ``num_bootstraps`` independent copies of the base metric; every update
+feeds each copy a resampled version of the batch (poisson or multinomial
+weights). compute → mean/std/quantile/raw over the copies.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    """Resample indices (reference bootstrapping.py:28-50)."""
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        p = rng.poisson(1, size)
+        return np.repeat(np.arange(size), p)
+    if sampling_strategy == "multinomial":
+        return rng.randint(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling} but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch for each bootstrap copy (reference :129-149)."""
+        args_sizes = [a.shape[0] for a in args if hasattr(a, "shape") and a.ndim > 0]
+        kwargs_sizes = [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and v.ndim > 0]
+        if args_sizes:
+            size = args_sizes[0]
+        elif kwargs_sizes:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained any tensor, so no sampling could be done")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = [jnp.asarray(np.asarray(a)[sample_idx]) if hasattr(a, "shape") and a.ndim > 0 else a for a in args]
+            new_kwargs = {
+                k: jnp.asarray(np.asarray(v)[sample_idx]) if hasattr(v, "shape") and v.ndim > 0 else v
+                for k, v in kwargs.items()
+            }
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean/std/quantile/raw over bootstrap computes (reference :151-172)."""
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

@@ -1,0 +1,49 @@
+"""ClasswiseWrapper (reference wrappers/classwise.py:31): label per-class outputs."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class ClasswiseWrapper(WrapperMetric):
+    def __init__(
+        self,
+        metric: Metric,
+        labels: Optional[List[str]] = None,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+        self._prefix = prefix
+        self._postfix = postfix
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        prefix = self._prefix or (name + "_" if self._prefix is None and self._postfix is None else "")
+        postfix = self._postfix or ""
+        if self.labels is None:
+            return {f"{prefix}{i}{postfix}": val for i, val in enumerate(x)}
+        return {f"{prefix}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()

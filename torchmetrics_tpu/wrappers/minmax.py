@@ -1,0 +1,39 @@
+"""MinMaxMetric (reference wrappers/minmax.py:29): track running min/max of compute."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `torchmetrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not (hasattr(val, "size") and val.size == 1):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
+        self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
+        return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()

@@ -1,0 +1,100 @@
+"""MultioutputWrapper (reference wrappers/multioutput.py:43): per-output clones."""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows containing a NaN in any tensor (reference multioutput.py:24-32)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(sentinel.shape[0], dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(tensor.shape[0], -1)
+        nan_idxs = nan_idxs | jnp.isnan(permuted).any(axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Apply a metric independently per output dimension (last axis by default)."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice inputs along the output dimension (reference :84-108)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [
+                jnp.take(arg, jnp.asarray([i]), axis=self.output_dim) if hasattr(arg, "shape") else arg for arg in args
+            ]
+            selected_kwargs = {
+                k: (jnp.take(v, jnp.asarray([i]), axis=self.output_dim) if hasattr(v, "shape") else v)
+                for k, v in kwargs.items()
+            }
+            if self.remove_nans:
+                tensors = [a for a in selected_args if hasattr(a, "shape")] + [
+                    v for v in selected_kwargs.values() if hasattr(v, "shape")
+                ]
+                if tensors:
+                    nan_idxs = np.asarray(_get_nan_indices(*tensors))
+                    selected_args = [
+                        jnp.asarray(np.asarray(a)[~nan_idxs]) if hasattr(a, "shape") else a for a in selected_args
+                    ]
+                    selected_kwargs = {
+                        k: (jnp.asarray(np.asarray(v)[~nan_idxs]) if hasattr(v, "shape") else v)
+                        for k, v in selected_kwargs.items()
+                    }
+            if self.squeeze_outputs:
+                selected_args = [a.squeeze(self.output_dim) if hasattr(a, "shape") else a for a in selected_args]
+                selected_kwargs = {
+                    k: (v.squeeze(self.output_dim) if hasattr(v, "shape") else v) for k, v in selected_kwargs.items()
+                }
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped)
+        ]
+        if any(r is None for r in results):
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], 0)
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

@@ -1,0 +1,70 @@
+"""MultitaskWrapper (reference wrappers/multitask.py:30): dict of task → metric."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultitaskWrapper(WrapperMetric):
+    def __init__(self, task_metrics: Dict[str, Union[Metric, MetricCollection]], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+        self.task_metrics = task_metrics
+
+    def items(self):
+        return self.task_metrics.items()
+
+    def keys(self):
+        return self.task_metrics.keys()
+
+    def values(self):
+        return self.task_metrics.values()
+
+    def _check_all_tasks_present(self, task_dict: Dict[str, Any]) -> None:
+        if task_dict.keys() != self.task_metrics.keys():
+            raise ValueError(
+                f"Expected arguments to have the same keys as the wrapped `task_metrics`. Found task_preds/targets keys"
+                f" = {task_dict.keys()} and task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+
+    def update(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        self._check_all_tasks_present(task_preds)
+        self._check_all_tasks_present(task_targets)
+        for task_name, metric in self.task_metrics.items():
+            metric.update(task_preds[task_name], task_targets[task_name])
+
+    def compute(self) -> Dict[str, Any]:
+        return {task_name: metric.compute() for task_name, metric in self.task_metrics.items()}
+
+    def forward(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> Dict[str, Any]:
+        self._check_all_tasks_present(task_preds)
+        self._check_all_tasks_present(task_targets)
+        return {
+            task_name: metric(task_preds[task_name], task_targets[task_name])
+            for task_name, metric in self.task_metrics.items()
+        }
+
+    def reset(self) -> None:
+        for metric in self.task_metrics.values():
+            metric.reset()
+        super().reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
+        import copy
+
+        multitask_copy = copy.deepcopy(self)
+        if prefix is not None:
+            multitask_copy.task_metrics = {prefix + k: v for k, v in multitask_copy.task_metrics.items()}
+        if postfix is not None:
+            multitask_copy.task_metrics = {k + postfix: v for k, v in multitask_copy.task_metrics.items()}
+        return multitask_copy

@@ -1,0 +1,64 @@
+"""Running wrapper (reference wrappers/running.py:27).
+
+Sliding window over the last ``window`` update calls: one state-set snapshot per
+slot; compute folds window states back via the base metric's ``_reduce_states``.
+Requires ``full_state_update=False`` on the base metric.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class Running(WrapperMetric):
+    def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._window_states: list = []  # ring of state snapshots, newest last
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Snapshot the state produced by this update alone (reference :99-116)."""
+        batch_state = self.base_metric.functional_update(self.base_metric.init_state(), *args, **kwargs)
+        self._window_states.append(batch_state)
+        if len(self._window_states) > self.window:
+            self._window_states.pop(0)
+        self._computed = None
+        self._update_count += 1
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value + window accumulation."""
+        batch_state = self.base_metric.functional_update(self.base_metric.init_state(), *args, **kwargs)
+        batch_val = self.base_metric.functional_compute(batch_state)
+        self._window_states.append(batch_state)
+        if len(self._window_states) > self.window:
+            self._window_states.pop(0)
+        self._computed = None
+        self._update_count += 1
+        return batch_val
+
+    def compute(self) -> Any:
+        """Fold window states with the base metric's merge protocol."""
+        if not self._window_states:
+            return self.base_metric.functional_compute(self.base_metric.init_state())
+        acc = self._window_states[0]
+        for st in self._window_states[1:]:
+            acc = self.base_metric.merge_states(acc, st)
+        return self.base_metric.functional_compute(acc)
+
+    def reset(self) -> None:
+        super().reset()
+        self._window_states = []
+        self.base_metric.reset()

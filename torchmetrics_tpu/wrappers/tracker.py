@@ -1,0 +1,117 @@
+"""MetricTracker (reference wrappers/tracker.py:31).
+
+Tracks a metric (or collection) over a sequence of steps/epochs: ``increment()``
+clones the base per step; ``best_metric``/``compute_all`` across steps.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if maximize is not None:
+            if not isinstance(maximize, (bool, list)):
+                raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+            if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+                raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+            if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+            if isinstance(metric, Metric) and not isinstance(maximize, bool):
+                raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        else:
+            if isinstance(metric, Metric):
+                maximize = bool(metric.higher_is_better)
+            else:
+                maximize = [bool(m.higher_is_better) for m in metric.values()]
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._steps)
+
+    def increment(self) -> None:
+        """Create a fresh copy of the base metric for a new step (reference :103)."""
+        self._increment_called = True
+        self._steps.append(deepcopy(self._base_metric))
+        self._steps[-1].reset()
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Values across all steps (reference :139-158)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._steps:
+            metric.reset()
+
+    def _best(self, values: Array, maximize: bool) -> Tuple[float, int]:
+        idx = int(jnp.argmax(values)) if maximize else int(jnp.argmin(values))
+        return float(values[idx]), idx
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[float, Tuple[float, int], Dict[str, float], Tuple[Dict[str, float], Dict[str, int]]]:
+        """Best value (and optionally step) over tracked steps (reference :160-208)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            values, steps = {}, {}
+            for (k, v), m in zip(res.items(), maximize):
+                try:
+                    values[k], steps[k] = self._best(v, m)
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}: {error}"
+                    )
+                    values[k], steps[k] = None, None
+            return (values, steps) if return_step else values
+        try:
+            value, step = self._best(res, bool(self.maximize))
+        except (ValueError, TypeError) as error:
+            rank_zero_warn(f"Encountered the following error when trying to get the best metric: {error}")
+            value, step = None, None
+        return (value, step) if return_step else value
