@@ -1,0 +1,265 @@
+"""Regression metric parity tests vs sklearn/scipy."""
+import functools
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats
+from sklearn.metrics import (
+    d2_tweedie_score,
+    explained_variance_score as sk_ev,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    r2_score as sk_r2,
+)
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.testers import MetricTester  # noqa: E402
+
+NUM_BATCHES, BATCH_SIZE = 4, 32
+rng = np.random.RandomState(11)
+PREDS = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) * 10
+TARGET = (PREDS + rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)).clip(0.01)
+PREDS = PREDS.clip(0.01)
+
+
+class TestBasicErrors(MetricTester):
+    def test_mae(self):
+        self.run_functional_metric_test(PREDS, TARGET, F.mean_absolute_error, lambda p, t: sk_mae(t.reshape(-1), p.reshape(-1)))
+        self.run_class_metric_test(PREDS, TARGET, MeanAbsoluteError, lambda p, t: sk_mae(t.reshape(-1), p.reshape(-1)), ddp=True)
+
+    def test_mse(self):
+        self.run_functional_metric_test(PREDS, TARGET, F.mean_squared_error, lambda p, t: sk_mse(t.reshape(-1), p.reshape(-1)))
+        self.run_class_metric_test(PREDS, TARGET, MeanSquaredError, lambda p, t: sk_mse(t.reshape(-1), p.reshape(-1)), ddp=True)
+
+    def test_rmse(self):
+        self.run_class_metric_test(
+            PREDS,
+            TARGET,
+            functools.partial(MeanSquaredError, squared=False),
+            lambda p, t: np.sqrt(sk_mse(t.reshape(-1), p.reshape(-1))),
+            ddp=True,
+        )
+
+    def test_msle(self):
+        self.run_functional_metric_test(PREDS, TARGET, F.mean_squared_log_error, lambda p, t: sk_msle(t.reshape(-1), p.reshape(-1)))
+        self.run_class_metric_test(PREDS, TARGET, MeanSquaredLogError, lambda p, t: sk_msle(t.reshape(-1), p.reshape(-1)), ddp=False)
+
+    def test_mape(self):
+        self.run_functional_metric_test(
+            PREDS, TARGET, F.mean_absolute_percentage_error, lambda p, t: sk_mape(t.reshape(-1), p.reshape(-1)), atol=1e-4
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, MeanAbsolutePercentageError, lambda p, t: sk_mape(t.reshape(-1), p.reshape(-1)), ddp=True, atol=1e-4
+        )
+
+    def test_smape(self):
+        def ref(p, t):
+            p, t = p.reshape(-1), t.reshape(-1)
+            return np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+
+        self.run_functional_metric_test(PREDS, TARGET, F.symmetric_mean_absolute_percentage_error, ref, atol=1e-4)
+
+    def test_wmape(self):
+        def ref(p, t):
+            p, t = p.reshape(-1), t.reshape(-1)
+            return np.abs(p - t).sum() / np.abs(t).sum()
+
+        self.run_functional_metric_test(PREDS, TARGET, F.weighted_mean_absolute_percentage_error, ref, atol=1e-4)
+        self.run_class_metric_test(PREDS, TARGET, WeightedMeanAbsolutePercentageError, ref, ddp=True, atol=1e-4)
+
+    def test_logcosh(self):
+        def ref(p, t):
+            d = p.reshape(-1) - t.reshape(-1)
+            return np.mean(np.log(np.cosh(d)))
+
+        self.run_functional_metric_test(PREDS, TARGET, F.log_cosh_error, ref, atol=1e-4)
+        self.run_class_metric_test(PREDS, TARGET, LogCoshError, ref, ddp=False, atol=1e-4)
+
+    def test_minkowski(self):
+        def ref(p, t):
+            return (np.abs(p.reshape(-1) - t.reshape(-1)) ** 3).sum() ** (1 / 3)
+
+        self.run_functional_metric_test(PREDS, TARGET, functools.partial(F.minkowski_distance, p=3), ref, atol=1e-3)
+
+    @pytest.mark.parametrize("power", [0.0, 1.0, 2.0, 1.5])
+    def test_tweedie(self, power):
+        def ref(p, t):
+            p, t = p.reshape(-1).astype(np.float64), t.reshape(-1).astype(np.float64)
+            if power == 0:
+                return np.mean((p - t) ** 2)
+            if power == 1:
+                return np.mean(2 * (t * np.log(t / p) + p - t))
+            if power == 2:
+                return np.mean(2 * (np.log(p / t) + t / p - 1))
+            return np.mean(
+                2 * (t ** (2 - power) / ((1 - power) * (2 - power)) - t * p ** (1 - power) / (1 - power) + p ** (2 - power) / (2 - power))
+            )
+
+        self.run_functional_metric_test(
+            PREDS, TARGET, functools.partial(F.tweedie_deviance_score, power=power), ref, atol=1e-3
+        )
+
+    def test_csi(self):
+        def ref(p, t):
+            pb, tb = p >= 5.0, t >= 5.0
+            hits = (pb & tb).sum()
+            return hits / (hits + (~pb & tb).sum() + (pb & ~tb).sum())
+
+        self.run_functional_metric_test(PREDS, TARGET, functools.partial(F.critical_success_index, threshold=5.0), ref)
+        self.run_class_metric_test(PREDS, TARGET, functools.partial(CriticalSuccessIndex, threshold=5.0), ref, ddp=True)
+
+
+class TestCorrelations(MetricTester):
+    def test_pearson_functional(self):
+        self.run_functional_metric_test(
+            PREDS, TARGET, F.pearson_corrcoef, lambda p, t: scipy.stats.pearsonr(t.reshape(-1), p.reshape(-1))[0], atol=1e-4
+        )
+
+    def test_pearson_class_streaming(self):
+        m = PearsonCorrCoef()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        ref = scipy.stats.pearsonr(TARGET.reshape(-1), PREDS.reshape(-1))[0]
+        assert abs(float(m.compute()) - ref) < 1e-4
+
+    def test_pearson_chan_merge(self):
+        # per-rank states merged by _final_aggregation must equal global
+        from torchmetrics_tpu.functional.regression.pearson import _final_aggregation
+
+        m = PearsonCorrCoef()
+        states = []
+        for i in range(NUM_BATCHES):
+            st = m.functional_update(m.init_state(), jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+            states.append(st)
+        stacked = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+        _, _, var_x, var_y, corr_xy, nb = _final_aggregation(
+            stacked["mean_x"], stacked["mean_y"], stacked["var_x"], stacked["var_y"], stacked["corr_xy"], stacked["n_total"]
+        )
+        from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+        merged = float(_pearson_corrcoef_compute(var_x, var_y, corr_xy, nb))
+        ref = scipy.stats.pearsonr(TARGET.reshape(-1), PREDS.reshape(-1))[0]
+        assert abs(merged - ref) < 1e-4
+
+    def test_spearman(self):
+        self.run_functional_metric_test(
+            PREDS, TARGET, F.spearman_corrcoef, lambda p, t: scipy.stats.spearmanr(t.reshape(-1), p.reshape(-1))[0], atol=1e-4
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, SpearmanCorrCoef, lambda p, t: scipy.stats.spearmanr(t.reshape(-1), p.reshape(-1))[0], ddp=True, atol=1e-4
+        )
+
+    def test_kendall(self):
+        self.run_functional_metric_test(
+            PREDS, TARGET, F.kendall_rank_corrcoef, lambda p, t: scipy.stats.kendalltau(t.reshape(-1), p.reshape(-1))[0], atol=1e-4
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, KendallRankCorrCoef, lambda p, t: scipy.stats.kendalltau(t.reshape(-1), p.reshape(-1))[0], ddp=False, atol=1e-4
+        )
+
+    def test_concordance(self):
+        def ref_ccc(p, t):
+            p, t = p.reshape(-1), t.reshape(-1)
+            pearson = scipy.stats.pearsonr(t, p)[0]
+            return (2 * pearson * p.std(ddof=1) * t.std(ddof=1)) / (p.var(ddof=1) + t.var(ddof=1) + (p.mean() - t.mean()) ** 2)
+
+        self.run_functional_metric_test(PREDS, TARGET, F.concordance_corrcoef, ref_ccc, atol=1e-4)
+        m = ConcordanceCorrCoef()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        assert abs(float(m.compute()) - ref_ccc(PREDS, TARGET)) < 1e-4
+
+    def test_r2(self):
+        self.run_functional_metric_test(PREDS, TARGET, F.r2_score, lambda p, t: sk_r2(t.reshape(-1), p.reshape(-1)), atol=1e-4)
+        self.run_class_metric_test(PREDS, TARGET, R2Score, lambda p, t: sk_r2(t.reshape(-1), p.reshape(-1)), ddp=True, atol=1e-4)
+
+    def test_explained_variance(self):
+        self.run_functional_metric_test(PREDS, TARGET, F.explained_variance, lambda p, t: sk_ev(t.reshape(-1), p.reshape(-1)), atol=1e-4)
+        self.run_class_metric_test(PREDS, TARGET, ExplainedVariance, lambda p, t: sk_ev(t.reshape(-1), p.reshape(-1)), ddp=True, atol=1e-4)
+
+
+class TestMisc(MetricTester):
+    def test_cosine_similarity(self):
+        p2 = PREDS.reshape(NUM_BATCHES, 8, 4)
+        t2 = TARGET.reshape(NUM_BATCHES, 8, 4)
+
+        def ref(p, t):
+            sims = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+            return sims.sum()
+
+        self.run_functional_metric_test(p2, t2, F.cosine_similarity, ref, atol=1e-4)
+
+    def test_kl_divergence(self):
+        p = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32)
+        q = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32)
+
+        def ref(pp, qq):
+            pp = pp / pp.sum(-1, keepdims=True)
+            qq = qq / qq.sum(-1, keepdims=True)
+            return (pp * np.log(pp / qq)).sum(-1).mean()
+
+        self.run_functional_metric_test(p, q, F.kl_divergence, ref, atol=1e-4)
+
+    def test_pairwise(self):
+        from scipy.spatial.distance import cdist
+
+        x = rng.rand(10, 4).astype(np.float32)
+        y = rng.rand(7, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(F.pairwise_euclidean_distance(jnp.asarray(x), jnp.asarray(y))), cdist(x, y), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(F.pairwise_manhattan_distance(jnp.asarray(x), jnp.asarray(y))), cdist(x, y, "cityblock"), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(F.pairwise_cosine_similarity(jnp.asarray(x), jnp.asarray(y))), 1 - cdist(x, y, "cosine"), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(F.pairwise_minkowski_distance(jnp.asarray(x), jnp.asarray(y), exponent=3)),
+            cdist(x, y, "minkowski", p=3),
+            atol=1e-4,
+        )
+        np.testing.assert_allclose(np.asarray(F.pairwise_linear_similarity(jnp.asarray(x), jnp.asarray(y))), x @ y.T, atol=1e-4)
+
+    def test_minkowski_class(self):
+        m = MinkowskiDistance(p=3)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        ref = (np.abs(PREDS - TARGET) ** 3).sum() ** (1 / 3)
+        assert abs(float(m.compute()) - ref) < 1e-2
+
+    def test_kldiv_class(self):
+        p = rng.rand(64, 5).astype(np.float32)
+        q = rng.rand(64, 5).astype(np.float32)
+        m = KLDivergence()
+        m.update(jnp.asarray(p), jnp.asarray(q))
+        pp = p / p.sum(-1, keepdims=True)
+        qq = q / q.sum(-1, keepdims=True)
+        ref = (pp * np.log(pp / qq)).sum(-1).mean()
+        assert abs(float(m.compute()) - ref) < 1e-4

@@ -49,3 +49,25 @@ from torchmetrics_tpu.wrappers import (  # noqa: F401
     MultitaskWrapper,
     Running,
 )
+from torchmetrics_tpu import regression  # noqa: F401
+from torchmetrics_tpu.regression import (  # noqa: F401
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)

@@ -1,0 +1,7 @@
+from torchmetrics_tpu.functional.pairwise.distances import (  # noqa: F401
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)

@@ -1,0 +1,111 @@
+"""Pairwise distance/similarity helpers (reference functional/pairwise/, 526 LoC).
+
+Batched Gram-matrix computations — pure MXU work: every function is one or two
+matmuls plus elementwise ops, computed with fp32 accumulation (`_safe_matmul`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.compute import _safe_matmul
+
+
+def _check_input(x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None) -> Tuple[Array, Array, bool]:
+    """Validate inputs (reference pairwise/helpers.py)."""
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    if reduction == "mean":
+        return distmat.mean(-1)
+    if reduction == "sum":
+        return distmat.sum(-1)
+    if reduction in (None, "none"):
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    norm_x = jnp.linalg.norm(x, axis=1, keepdims=True)
+    norm_y = jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = _safe_matmul(x / norm_x, (y / norm_y).T)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1]))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = (x * x).sum(1, keepdims=True)
+    y_norm = (y * y).sum(1)
+    distance = x_norm + y_norm - 2 * _safe_matmul(x, y.T)
+    distance = jnp.sqrt(jnp.clip(distance, min=0.0))
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1]))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1]))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y.T)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1]))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: float = 2.0,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise ValueError(f"Argument ``exponent`` expected to be a float larger than 1, but got {exponent}")
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = (jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent).sum(-1) ** (1.0 / exponent)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1]))
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,22 @@
+from torchmetrics_tpu.functional.regression.basic import (  # noqa: F401
+    critical_success_index,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    minkowski_distance,
+    relative_squared_error,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from torchmetrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
+from torchmetrics_tpu.functional.regression.misc import cosine_similarity, kl_divergence  # noqa: F401
+from torchmetrics_tpu.functional.regression.pearson import pearson_corrcoef  # noqa: F401
+from torchmetrics_tpu.functional.regression.r2 import r2_score  # noqa: F401
+from torchmetrics_tpu.functional.regression.rank_based import (  # noqa: F401
+    concordance_corrcoef,
+    kendall_rank_corrcoef,
+    spearman_corrcoef,
+)

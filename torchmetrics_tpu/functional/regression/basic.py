@@ -1,0 +1,223 @@
+"""Elementary error metrics: MAE, MSE, MSLE, MAPE, SMAPE, WMAPE, RSE, LogCosh,
+MinkowskiDistance, TweedieDevianceScore, CriticalSuccessIndex.
+
+Reference: functional/regression/{mae,mse,log_mse,mape,symmetric_mape,wmape,rse,
+log_cosh,minkowski,tweedie_deviance,csi}.py — each decomposed into
+``_update`` (sum + count states) and ``_compute`` (safe divide).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_divide, _safe_xlogy
+
+
+# ------------------------------------------------------------------------ MAE
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32) if not jnp.issubdtype(preds.dtype, jnp.floating) else preds
+    target = target.astype(jnp.float32) if not jnp.issubdtype(target.dtype, jnp.floating) else target
+    return jnp.abs(preds - target).sum(), preds.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_abs_error / num_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    sum_abs_error, num_obs = _mean_absolute_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_absolute_error_compute(sum_abs_error, num_obs)
+
+
+# ------------------------------------------------------------------------ MSE
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = (diff * diff).sum(0) if num_outputs > 1 else (diff * diff).sum()
+    return sum_squared_error, target.shape[0] if num_outputs > 1 else target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Union[int, Array], squared: bool = True) -> Array:
+    mse = sum_squared_error / num_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    sum_squared_error, num_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target), num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
+
+
+# ----------------------------------------------------------------------- MSLE
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = ((jnp.log1p(preds) - jnp.log1p(target)) ** 2).sum()
+    return sum_squared_log_error, preds.size
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    s, n = _mean_squared_log_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
+    return s / n
+
+
+# ----------------------------------------------------------------------- MAPE
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return abs_per_error.sum(), preds.size
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    s, n = _mean_absolute_percentage_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
+    return s / n
+
+
+# ---------------------------------------------------------------------- SMAPE
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * abs_per_error.sum(), preds.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    s, n = _symmetric_mean_absolute_percentage_error_update(
+        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    )
+    return s / n
+
+
+# ---------------------------------------------------------------------- WMAPE
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return jnp.abs(preds - target).sum(), jnp.abs(target).sum()
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    s, t = _weighted_mean_absolute_percentage_error_update(
+        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    )
+    return s / jnp.clip(t, min=1.17e-06)
+
+
+# ------------------------------------------------------------------------ RSE
+def _relative_squared_error_compute(
+    sum_squared_obs: Array, sum_obs: Array, sum_squared_error: Array, num_obs: Union[int, Array], squared: bool = True
+) -> Array:
+    """RSE = Σ(y−ŷ)² / Σ(y−ȳ)² (reference rse.py)."""
+    denom = sum_squared_obs - sum_obs * sum_obs / num_obs
+    rse = sum_squared_error / denom
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return rse.mean()
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    sum_squared_obs = (target * target).sum(0)
+    sum_obs = target.sum(0)
+    sum_squared_error = ((target - preds) ** 2).sum(0)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, sum_squared_error, target.shape[0], squared)
+
+
+# -------------------------------------------------------------------- LogCosh
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log 2
+    vals = diff + jnp.logaddexp(-2 * diff, 0.0) - jnp.log(2.0)
+    return vals.sum(0), preds.shape[0]
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return (s / n).squeeze()
+
+
+# ------------------------------------------------------------------ Minkowski
+def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
+    _check_same_shape(preds, target)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise ValueError(f"Argument ``p`` expected to be a float larger than 1, but got {p}")
+    return (jnp.abs(preds - target) ** p).sum()
+
+
+def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
+    s = _minkowski_distance_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), p)
+    return s ** (1.0 / p)
+
+
+# ------------------------------------------------------------------- Tweedie
+def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if power < 0:
+        deviance_score = 2 * (
+            jnp.power(jnp.clip(target, min=0), 2 - power) / ((1 - power) * (2 - power))
+            - target * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    elif power == 0:
+        deviance_score = (preds - target) ** 2
+    elif 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(target, target / preds) + preds - target)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / target) + target / preds - 1)
+    else:
+        deviance_score = 2 * (
+            jnp.power(jnp.clip(target, min=0), 2 - power) / ((1 - power) * (2 - power))
+            - target * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    return deviance_score.sum(), preds.size
+
+
+def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    s, n = _tweedie_deviance_score_update(
+        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), power
+    )
+    return s / n
+
+
+# ------------------------------------------------------------------------ CSI
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    _check_same_shape(preds, target)
+    if keep_sequence_dim is None:
+        sum_dims = None
+    else:
+        sum_dims = tuple(d for d in range(preds.ndim) if d != keep_sequence_dim)
+    pred_bin = preds >= threshold
+    target_bin = target >= threshold
+    hits = (pred_bin & target_bin).sum(sum_dims)
+    misses = (~pred_bin & target_bin).sum(sum_dims)
+    false_alarms = (pred_bin & ~target_bin).sum(sum_dims)
+    return hits, misses, false_alarms
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Array:
+    hits, misses, false_alarms = _critical_success_index_update(
+        jnp.asarray(preds), jnp.asarray(target), threshold, keep_sequence_dim
+    )
+    return _safe_divide(hits, hits + misses + false_alarms)

@@ -1,0 +1,63 @@
+"""Explained variance (reference functional/regression/explained_variance.py)."""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    num_obs = preds.shape[0]
+    sum_error = (target - preds).sum(0)
+    diff = target - preds
+    sum_squared_error = (diff * diff).sum(0)
+    sum_target = target.sum(0)
+    sum_squared_target = (target * target).sum(0)
+    return num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    num_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / num_obs
+    numerator = sum_squared_error / num_obs - diff_avg * diff_avg
+    target_avg = sum_target / num_obs
+    denominator = sum_squared_target / num_obs - target_avg * target_avg
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    output_scores = jnp.ones_like(diff_avg)
+    valid = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.where(
+        valid, 1.0 - numerator / jnp.where(valid, denominator, 1.0), output_scores
+    )
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return output_scores.mean()
+    if multioutput == "variance_weighted":
+        denom_sum = denominator.sum()
+        return (denominator / denom_sum * output_scores).sum()
+    raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    if multioutput not in ALLOWED_MULTIOUTPUT:
+        raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
+    num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(
+        jnp.asarray(preds), jnp.asarray(target)
+    )
+    return _explained_variance_compute(num_obs, sum_error, ss_error, sum_target, ss_target, multioutput)

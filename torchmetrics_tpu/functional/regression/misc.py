@@ -1,0 +1,62 @@
+"""CosineSimilarity and KLDivergence (reference functional/regression/{cosine_similarity,kl_divergence}.py)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_xlogy
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot = (preds * target).sum(-1)
+    norm = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = dot / norm
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    if reduction in ("none", None):
+        return sim
+    raise ValueError(f"Expected reduction to be one of `['sum', 'mean', 'none', None]` but got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected input to cosine similarity to be 2D tensors of shape `[N,D]` but got {preds.shape}")
+    return _cosine_similarity_compute(preds, target, reduction)
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    total = p.shape[0]
+    if log_prob:
+        measures = (jnp.exp(p) * (p - q)).sum(-1)
+    else:
+        p = p / p.sum(-1, keepdims=True)
+        q = q / q.sum(-1, keepdims=True)
+        measures = _safe_xlogy(p, p / q).sum(-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction in ("none", None):
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """KL(P‖Q) (reference kl_divergence.py)."""
+    measures, total = _kld_update(jnp.asarray(p, dtype=jnp.float32), jnp.asarray(q, dtype=jnp.float32), log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -1,0 +1,99 @@
+"""Pearson correlation (reference functional/regression/pearson.py + regression/pearson.py:28-70).
+
+Streaming mean/var/cov states with the Chan et al. pairwise merge — the template
+for all parallel moment-merging in this framework (also used by the `merge`
+protocol for distributed reduction of per-device moment states).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming update of first/second moments (reference pearson.py:22-77)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    num_obs = preds.shape[0]
+    # weighted running mean; with num_prior == 0 this reduces to the batch mean,
+    # so no branch is needed (and the batch-size-1 case stays correct)
+    mx_new = (num_prior * mean_x + preds.sum(0)) / (num_prior + num_obs)
+    my_new = (num_prior * mean_y + target.sum(0)) / (num_prior + num_obs)
+    num_prior = num_prior + num_obs
+    var_x = var_x + ((preds - mx_new) * (preds - mean_x)).sum(0)
+    var_y = var_y + ((target - my_new) * (target - mean_y)).sum(0)
+    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum(0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_prior
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Chan et al. pairwise merge of per-device moment states (reference pearson.py:28-70).
+
+    Inputs are stacked per-device values with leading axis = world size.
+    """
+    if means_x.ndim == 0:
+        return means_x, means_y, vars_x, vars_y, corrs_xy, nbs
+    if means_x.shape[0] == 1:
+        return means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        # standard Chan et al. pairwise merge: the cross term n1*n2/nb·Δm² folds
+        # the between-shard mean shift into the pooled second moments
+        factor = jnp.where(nb == 0, 0.0, n1 * n2 / jnp.where(nb == 0, 1.0, nb))
+        dx = mx2 - mx1
+        dy = my2 - my1
+        mean_x = jnp.where(nb == 0, 0.0, (n1 * mx1 + n2 * mx2) / jnp.where(nb == 0, 1.0, nb))
+        mean_y = jnp.where(nb == 0, 0.0, (n1 * my1 + n2 * my2) / jnp.where(nb == 0, 1.0, nb))
+        var_x = vx1 + vx2 + factor * dx * dx
+        var_y = vy1 + vy2 + factor * dy * dy
+        corr_xy = cxy1 + cxy2 + factor * dx * dy
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return mx1, my1, vx1, vy1, cxy1, n1
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Correlation from accumulated second moments (reference pearson.py:80-103)."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    denom = jnp.sqrt(var_x * var_y)
+    corrcoef = jnp.where(denom == 0, jnp.nan, corr_xy / jnp.where(denom == 0, 1.0, denom))
+    return jnp.clip(corrcoef, -1.0, 1.0).squeeze()
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute Pearson correlation coefficient (reference pearson.py:106)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d)
+    mean_x, mean_y, var_x = _temp, _temp, _temp
+    var_y, corr_xy, nb = _temp, _temp, _temp
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,76 @@
+"""R² score (reference functional/regression/r2.py)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with dimension {preds.shape}"
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_obs = target.sum(0)
+    sum_squared_obs = (target * target).sum(0)
+    residual = ((target - preds) ** 2).sum(0)
+    return sum_squared_obs, sum_obs, residual, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    residual: Array,
+    num_obs: Union[int, Array],
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² from sufficient statistics (reference r2.py:60-125)."""
+    if isinstance(num_obs, int) and num_obs < 2:
+        rank_zero_warn("Needs at least two samples to calculate r2 score.", UserWarning)
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (residual / tss)
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = tss.sum()
+        r2 = (tss / tss_sum * raw_scores).sum()
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        if isinstance(num_obs, int) and adjusted > num_obs - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+            return r2
+        if isinstance(num_obs, int) and adjusted == num_obs - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+            return r2
+        return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(jnp.asarray(preds), jnp.asarray(target))
+    return _r2_score_compute(sum_squared_obs, sum_obs, residual, num_obs, adjusted, multioutput)

@@ -1,0 +1,24 @@
+"""Shared regression helpers (reference functional/regression/utils.py)."""
+from __future__ import annotations
+
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _check_data_shape_to_num_outputs(
+    preds: Array, target: Array, num_outputs: int, allow_1d_reshape: bool = False
+) -> None:
+    """Check shapes are consistent with ``num_outputs`` (reference utils.py:20-43)."""
+    if preds.ndim > 2 or target.ndim > 2:
+        raise ValueError(
+            f"Expected both predictions and target to be either 1- or 2-dimensional tensors,"
+            f" but got {target.ndim} and {preds.ndim}."
+        )
+    cond1 = False if allow_1d_reshape else (num_outputs == 1 and not (preds.ndim == 1 or preds.shape[1] == 1))
+    cond2 = num_outputs > 1 and (preds.ndim < 2 or num_outputs != preds.shape[1])
+    if cond1 or cond2:
+        raise ValueError(
+            f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
+            f" and {preds.shape[1] if preds.ndim > 1 else 1}."
+        )

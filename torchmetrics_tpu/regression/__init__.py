@@ -1,0 +1,22 @@
+from torchmetrics_tpu.regression.correlations import (  # noqa: F401
+    ConcordanceCorrCoef,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+)
+from torchmetrics_tpu.regression.errors import (  # noqa: F401
+    CriticalSuccessIndex,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    RelativeSquaredError,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from torchmetrics_tpu.regression.misc import CosineSimilarity, KLDivergence  # noqa: F401

@@ -1,0 +1,304 @@
+"""Modular error metrics.
+
+Reference: regression/{mae,mse,log_mse,mape,symmetric_mape,wmape,rse,log_cosh,
+minkowski,tweedie_deviance,csi}.py — sum+count tensor states, psum-synced.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.basic import (
+    _critical_success_index_update,
+    _log_cosh_error_update,
+    _mean_absolute_error_update,
+    _mean_absolute_percentage_error_update,
+    _mean_squared_error_update,
+    _mean_squared_log_error_update,
+    _minkowski_distance_update,
+    _relative_squared_error_compute,
+    _symmetric_mean_absolute_percentage_error_update,
+    _tweedie_deviance_score_update,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+class MeanAbsoluteError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, num_obs = _mean_absolute_error_update(jnp.asarray(preds), jnp.asarray(target))
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return self.sum_abs_error / self.total
+
+
+class MeanSquaredError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_error, num_obs = _mean_squared_error_update(
+            jnp.asarray(preds), jnp.asarray(target), self.num_outputs
+        )
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        mse = self.sum_squared_error / self.total
+        return mse if self.squared else jnp.sqrt(mse)
+
+
+class MeanSquaredLogError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_squared_log_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.sum_squared_log_error = self.sum_squared_log_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.sum_squared_log_error / self.total
+
+
+class MeanAbsolutePercentageError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_absolute_percentage_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.sum_abs_per_error / self.total
+
+
+class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
+    plot_upper_bound: float = 2.0
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _symmetric_mean_absolute_percentage_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, t = _weighted_mean_absolute_percentage_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.sum_abs_error = self.sum_abs_error + s
+        self.sum_scale = self.sum_scale + t
+
+    def compute(self) -> Array:
+        return self.sum_abs_error / jnp.clip(self.sum_scale, min=1.17e-06)
+
+
+class RelativeSquaredError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        self.add_state("sum_squared_obs", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("sum_obs", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        self.sum_squared_obs = self.sum_squared_obs + (target * target).sum(0)
+        self.sum_obs = self.sum_obs + target.sum(0)
+        self.sum_squared_error = self.sum_squared_error + ((target - preds) ** 2).sum(0)
+        self.total = self.total + target.shape[0]
+
+    def compute(self) -> Array:
+        return _relative_squared_error_compute(
+            self.sum_squared_obs, self.sum_obs, self.sum_squared_error, self.total, self.squared
+        )
+
+
+class LogCoshError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _log_cosh_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.num_outputs
+        )
+        self.sum_log_cosh_error = self.sum_log_cosh_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return (self.sum_log_cosh_error / self.total).squeeze()
+
+
+class MinkowskiDistance(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise ValueError(f"Argument ``p`` expected to be a float larger than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.p
+        )
+
+    def compute(self) -> Array:
+        return self.minkowski_dist_sum ** (1.0 / self.p)
+
+
+class TweedieDevianceScore(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _tweedie_deviance_score_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.power
+        )
+        self.sum_deviance_score = self.sum_deviance_score + s
+        self.num_observations = self.num_observations + n
+
+    def compute(self) -> Array:
+        return self.sum_deviance_score / self.num_observations
+
+
+class CriticalSuccessIndex(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is not None and (not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0):
+            raise ValueError(f"Expected argument `keep_sequence_dim` to be an int but got {keep_sequence_dim}")
+        self.keep_sequence_dim = keep_sequence_dim
+        if keep_sequence_dim is None:
+            self.add_state("hits", jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("misses", jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("false_alarms", jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits", [], dist_reduce_fx="cat")
+            self.add_state("misses", [], dist_reduce_fx="cat")
+            self.add_state("false_alarms", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        hits, misses, false_alarms = _critical_success_index_update(
+            jnp.asarray(preds), jnp.asarray(target), self.threshold, self.keep_sequence_dim
+        )
+        if self.keep_sequence_dim is None:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+        else:
+            self.hits.append(hits)
+            self.misses.append(misses)
+            self.false_alarms.append(false_alarms)
+
+    def compute(self) -> Array:
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        if self.keep_sequence_dim is None:
+            hits, misses, fa = self.hits, self.misses, self.false_alarms
+        else:
+            hits, misses, fa = dim_zero_cat(self.hits), dim_zero_cat(self.misses), dim_zero_cat(self.false_alarms)
+        return _safe_divide(hits, hits + misses + fa)
