@@ -1,0 +1,84 @@
+"""Load the PyTorch reference implementation as a test oracle.
+
+The reference tree (read-only, /root/reference) needs `lightning_utilities`,
+which is absent — a 4-name shim makes it importable on CPU torch. Used where
+sklearn/scipy have no equivalent (image metrics, text metrics, etc.).
+"""
+from __future__ import annotations
+
+import sys
+import types
+from enum import Enum
+
+_LOADED = False
+
+
+def load_reference_torchmetrics():
+    """Returns the reference `torchmetrics` module, shimming its dependencies."""
+    global _LOADED
+    if not _LOADED:
+        lu = types.ModuleType("lightning_utilities")
+        core = types.ModuleType("lightning_utilities.core")
+        imports_mod = types.ModuleType("lightning_utilities.core.imports")
+
+        class RequirementCache:
+            def __init__(self, *a, **k):
+                pass
+
+            def __bool__(self):
+                return False
+
+            def __str__(self):
+                return "stubbed"
+
+        imports_mod.RequirementCache = RequirementCache
+        imports_mod.package_available = lambda name: False
+        imports_mod.compare_version = lambda *a, **k: False
+
+        def apply_to_collection(data, dtype, function, *args, **kwargs):
+            if isinstance(data, dtype):
+                return function(data, *args, **kwargs)
+            if isinstance(data, dict):
+                return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+            if isinstance(data, (list, tuple)):
+                return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+            return data
+
+        lu.apply_to_collection = apply_to_collection
+
+        enums_mod = types.ModuleType("lightning_utilities.core.enums")
+
+        class StrEnum(str, Enum):
+            @classmethod
+            def from_str(cls, value, source="key"):
+                for m in cls:
+                    if m.value.lower() == value.lower().replace("-", "_") or m.name.lower() == value.lower().replace(
+                        "-", "_"
+                    ):
+                        return m
+                return None
+
+            def __eq__(self, other):
+                if isinstance(other, str):
+                    return self.value.lower() == other.lower()
+                return Enum.__eq__(self, other)
+
+            def __hash__(self):
+                return hash(self.value.lower())
+
+        enums_mod.StrEnum = StrEnum
+        lu.core = core
+        sys.modules.update(
+            {
+                "lightning_utilities": lu,
+                "lightning_utilities.core": core,
+                "lightning_utilities.core.imports": imports_mod,
+                "lightning_utilities.core.enums": enums_mod,
+            }
+        )
+        if "/root/reference/src" not in sys.path:
+            sys.path.insert(0, "/root/reference/src")
+        _LOADED = True
+    import torchmetrics
+
+    return torchmetrics

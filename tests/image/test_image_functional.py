@@ -1,0 +1,140 @@
+"""Image metric parity tests vs the PyTorch reference implementation."""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+import torchmetrics_tpu.functional.image as FI  # noqa: E402
+
+torchmetrics_ref = load_reference_torchmetrics()
+import torch  # noqa: E402
+
+rng = np.random.RandomState(42)
+PREDS = rng.rand(2, 3, 32, 32).astype(np.float32)
+TARGET = rng.rand(2, 3, 32, 32).astype(np.float32)
+
+
+def _t(x):
+    return torch.from_numpy(x)
+
+
+def _j(x):
+    return jnp.asarray(x)
+
+
+class TestPSNR:
+    def test_basic(self):
+        from torchmetrics.functional.image import peak_signal_noise_ratio as ref_psnr
+
+        ours = float(FI.peak_signal_noise_ratio(_j(PREDS), _j(TARGET), data_range=1.0))
+        ref = float(ref_psnr(_t(PREDS), _t(TARGET), data_range=1.0))
+        assert abs(ours - ref) < 1e-4
+
+    def test_data_range_none(self):
+        from torchmetrics.functional.image import peak_signal_noise_ratio as ref_psnr
+
+        ours = float(FI.peak_signal_noise_ratio(_j(PREDS), _j(TARGET)))
+        ref = float(ref_psnr(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_dim(self):
+        from torchmetrics.functional.image import peak_signal_noise_ratio as ref_psnr
+
+        ours = FI.peak_signal_noise_ratio(_j(PREDS), _j(TARGET), data_range=1.0, dim=(1, 2, 3), reduction="none")
+        ref = ref_psnr(_t(PREDS), _t(TARGET), data_range=1.0, dim=(1, 2, 3), reduction="none")
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4)
+
+
+class TestSSIM:
+    @pytest.mark.parametrize("gaussian_kernel", [True, False])
+    def test_parity(self, gaussian_kernel):
+        from torchmetrics.functional.image import structural_similarity_index_measure as ref_ssim
+
+        ours = float(
+            FI.structural_similarity_index_measure(_j(PREDS), _j(TARGET), gaussian_kernel=gaussian_kernel, data_range=1.0)
+        )
+        ref = float(ref_ssim(_t(PREDS), _t(TARGET), gaussian_kernel=gaussian_kernel, data_range=1.0))
+        assert abs(ours - ref) < 1e-4
+
+    def test_identical_images(self):
+        val = float(FI.structural_similarity_index_measure(_j(PREDS), _j(PREDS), data_range=1.0))
+        assert abs(val - 1.0) < 1e-6
+
+    def test_ms_ssim(self):
+        from torchmetrics.functional.image import (
+            multiscale_structural_similarity_index_measure as ref_ms,
+        )
+
+        p = rng.rand(2, 3, 180, 180).astype(np.float32)
+        t = rng.rand(2, 3, 180, 180).astype(np.float32)
+        ours = float(FI.multiscale_structural_similarity_index_measure(_j(p), _j(t), data_range=1.0))
+        ref = float(ref_ms(_t(p), _t(t), data_range=1.0))
+        assert abs(ours - ref) < 1e-4
+
+
+class TestOthers:
+    def test_tv(self):
+        from torchmetrics.functional.image import total_variation as ref_tv
+
+        ours = float(FI.total_variation(_j(PREDS)))
+        ref = float(ref_tv(_t(PREDS)))
+        assert abs(ours - ref) / max(abs(ref), 1) < 1e-5
+
+    def test_uqi(self):
+        from torchmetrics.functional.image import universal_image_quality_index as ref_uqi
+
+        ours = float(FI.universal_image_quality_index(_j(PREDS), _j(TARGET)))
+        ref = float(ref_uqi(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_sam(self):
+        from torchmetrics.functional.image import spectral_angle_mapper as ref_sam
+
+        ours = float(FI.spectral_angle_mapper(_j(PREDS), _j(TARGET)))
+        ref = float(ref_sam(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_ergas(self):
+        from torchmetrics.functional.image import error_relative_global_dimensionless_synthesis as ref_ergas
+
+        ours = float(FI.error_relative_global_dimensionless_synthesis(_j(PREDS), _j(TARGET)))
+        ref = float(ref_ergas(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) / max(abs(ref), 1) < 1e-4
+
+    def test_rmse_sw(self):
+        from torchmetrics.functional.image import root_mean_squared_error_using_sliding_window as ref_rmse_sw
+
+        ours = float(FI.root_mean_squared_error_using_sliding_window(_j(PREDS), _j(TARGET)))
+        ref = float(ref_rmse_sw(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_rase(self):
+        from torchmetrics.functional.image import relative_average_spectral_error as ref_rase
+
+        ours = float(FI.relative_average_spectral_error(_j(PREDS), _j(TARGET)))
+        ref = float(ref_rase(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) / max(abs(ref), 1) < 1e-4
+
+    def test_scc(self):
+        from torchmetrics.functional.image import spatial_correlation_coefficient as ref_scc
+
+        ours = float(FI.spatial_correlation_coefficient(_j(PREDS), _j(TARGET)))
+        ref = float(ref_scc(_t(PREDS), _t(TARGET)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_scc_self(self):
+        val = float(FI.spatial_correlation_coefficient(_j(PREDS), _j(PREDS)))
+        assert abs(val - 1.0) < 1e-5
+
+    def test_psnrb(self):
+        from torchmetrics.functional.image import peak_signal_noise_ratio_with_blocked_effect as ref_psnrb
+
+        p = rng.rand(2, 1, 16, 16).astype(np.float32)
+        t = rng.rand(2, 1, 16, 16).astype(np.float32)
+        ours = float(FI.peak_signal_noise_ratio_with_blocked_effect(_j(p), _j(t)))
+        ref = float(ref_psnrb(_t(p), _t(t)))
+        assert abs(ours - ref) < 1e-4

@@ -1,0 +1,200 @@
+"""Modular image metric tests: lifecycle + parity + FID/IS/KID machinery."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+import torchmetrics_tpu.image as I  # noqa: E402
+
+torchmetrics_ref = load_reference_torchmetrics()
+import torch  # noqa: E402
+
+rng = np.random.RandomState(17)
+PREDS = [rng.rand(2, 3, 32, 32).astype(np.float32) for _ in range(3)]
+TARGET = [rng.rand(2, 3, 32, 32).astype(np.float32) for _ in range(3)]
+
+
+def _run_both(ours_cls, ref_cls, kwargs_ours=None, kwargs_ref=None, preds=PREDS, target=TARGET, atol=1e-4):
+    ours = ours_cls(**(kwargs_ours or {}))
+    ref = ref_cls(**(kwargs_ref or {}))
+    for p, t in zip(preds, target):
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=atol, rtol=1e-4)
+
+
+def test_psnr_class():
+    from torchmetrics.image import PeakSignalNoiseRatio as RefPSNR
+
+    _run_both(I.PeakSignalNoiseRatio, RefPSNR, {"data_range": 1.0}, {"data_range": 1.0})
+
+
+def test_psnr_class_data_range_none():
+    from torchmetrics.image import PeakSignalNoiseRatio as RefPSNR
+
+    _run_both(I.PeakSignalNoiseRatio, RefPSNR)
+
+
+def test_ssim_class():
+    from torchmetrics.image import StructuralSimilarityIndexMeasure as RefSSIM
+
+    _run_both(I.StructuralSimilarityIndexMeasure, RefSSIM, {"data_range": 1.0}, {"data_range": 1.0})
+
+
+def test_tv_class():
+    from torchmetrics.image import TotalVariation as RefTV
+
+    ours = I.TotalVariation()
+    ref = RefTV()
+    for p in PREDS:
+        ours.update(jnp.asarray(p))
+        ref.update(torch.from_numpy(p))
+    assert abs(float(ours.compute()) - float(ref.compute())) / float(ref.compute()) < 1e-5
+
+
+def test_uqi_class():
+    from torchmetrics.image import UniversalImageQualityIndex as RefUQI
+
+    _run_both(I.UniversalImageQualityIndex, RefUQI)
+
+
+def test_sam_class():
+    from torchmetrics.image import SpectralAngleMapper as RefSAM
+
+    _run_both(I.SpectralAngleMapper, RefSAM)
+
+
+def test_ergas_class():
+    from torchmetrics.image import ErrorRelativeGlobalDimensionlessSynthesis as RefERGAS
+
+    _run_both(I.ErrorRelativeGlobalDimensionlessSynthesis, RefERGAS, atol=1e-2)
+
+
+def test_rmse_sw_class():
+    from torchmetrics.image import RootMeanSquaredErrorUsingSlidingWindow as RefRMSESW
+
+    _run_both(I.RootMeanSquaredErrorUsingSlidingWindow, RefRMSESW)
+
+
+def test_rase_class():
+    from torchmetrics.image import RelativeAverageSpectralError as RefRASE
+
+    _run_both(I.RelativeAverageSpectralError, RefRASE, atol=1e-2)
+
+
+def test_scc_class():
+    from torchmetrics.image import SpatialCorrelationCoefficient as RefSCC
+
+    _run_both(I.SpatialCorrelationCoefficient, RefSCC)
+
+
+def test_vif_class():
+    from torchmetrics.image import VisualInformationFidelity as RefVIF
+
+    p = [rng.rand(2, 3, 48, 48).astype(np.float32) for _ in range(2)]
+    t = [rng.rand(2, 3, 48, 48).astype(np.float32) for _ in range(2)]
+    _run_both(I.VisualInformationFidelity, RefVIF, preds=p, target=t)
+
+
+def test_d_lambda_class():
+    from torchmetrics.image import SpectralDistortionIndex as RefDL
+
+    _run_both(I.SpectralDistortionIndex, RefDL)
+
+
+def test_ms_ssim_class():
+    from torchmetrics.image import MultiScaleStructuralSimilarityIndexMeasure as RefMS
+
+    p = [rng.rand(2, 3, 180, 180).astype(np.float32) for _ in range(2)]
+    t = [rng.rand(2, 3, 180, 180).astype(np.float32) for _ in range(2)]
+    _run_both(
+        I.MultiScaleStructuralSimilarityIndexMeasure,
+        RefMS,
+        {"data_range": 1.0},
+        {"data_range": 1.0},
+        preds=p,
+        target=t,
+    )
+
+
+class TestGenerativeMetrics:
+    """FID/IS/KID with a simple deterministic feature extractor."""
+
+    @staticmethod
+    def _features(imgs):
+        imgs = jnp.asarray(imgs)
+        flat = imgs.reshape(imgs.shape[0], -1)
+        # fixed random projection to 16-d features
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (flat.shape[1], 16))
+        return jnp.tanh(flat @ w)
+
+    def test_fid(self):
+        fid = I.FrechetInceptionDistance(feature_extractor=self._features, num_features=16)
+        real = rng.rand(64, 3, 8, 8).astype(np.float32)
+        fake_same = real + 0.01 * rng.randn(64, 3, 8, 8).astype(np.float32)
+        fake_diff = rng.rand(64, 3, 8, 8).astype(np.float32) * 0.3
+        fid.update(jnp.asarray(real), real=True)
+        fid.update(jnp.asarray(fake_same), real=False)
+        close = float(fid.compute())
+        fid.reset()
+        fid.update(jnp.asarray(real), real=True)
+        fid.update(jnp.asarray(fake_diff), real=False)
+        far = float(fid.compute())
+        assert close < far
+        assert close >= -1e-3
+
+    def test_fid_matches_scipy_sqrtm(self):
+        from scipy import linalg
+
+        from torchmetrics_tpu.image.fid import _compute_fid
+
+        rng2 = np.random.RandomState(5)
+        f1 = rng2.randn(200, 8)
+        f2 = rng2.randn(200, 8) + 0.5
+        mu1, mu2 = f1.mean(0), f2.mean(0)
+        s1, s2 = np.cov(f1, rowvar=False), np.cov(f2, rowvar=False)
+        covmean = linalg.sqrtm(s1 @ s2).real
+        ref_fid = ((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean)
+        ours = float(_compute_fid(jnp.asarray(mu1), jnp.asarray(s1), jnp.asarray(mu2), jnp.asarray(s2)))
+        assert abs(ours - ref_fid) / abs(ref_fid) < 1e-3
+
+    def test_fid_reset_real_features(self):
+        fid = I.FrechetInceptionDistance(feature_extractor=self._features, num_features=16, reset_real_features=False)
+        real = rng.rand(32, 3, 8, 8).astype(np.float32)
+        fid.update(jnp.asarray(real), real=True)
+        n_before = int(fid.real_features_num_samples)
+        fid.reset()
+        assert int(fid.real_features_num_samples) == n_before
+
+    def test_fid_requires_extractor(self):
+        with pytest.raises(ModuleNotFoundError):
+            I.FrechetInceptionDistance()
+
+    def test_inception_score(self):
+        is_metric = I.InceptionScore(feature_extractor=self._features, splits=2)
+        imgs = rng.rand(64, 3, 8, 8).astype(np.float32)
+        is_metric.update(jnp.asarray(imgs))
+        mean, std = is_metric.compute()
+        assert 1.0 <= float(mean) <= 16.0
+
+    def test_kid(self):
+        kid = I.KernelInceptionDistance(feature_extractor=self._features, subsets=5, subset_size=32)
+        real = rng.rand(64, 3, 8, 8).astype(np.float32)
+        fake = rng.rand(64, 3, 8, 8).astype(np.float32) * 0.3
+        kid.update(jnp.asarray(real), real=True)
+        kid.update(jnp.asarray(fake), real=False)
+        mean, std = kid.compute()
+        assert float(mean) > 0
+
+    def test_kid_subset_too_large(self):
+        kid = I.KernelInceptionDistance(feature_extractor=self._features, subsets=2, subset_size=100)
+        kid.update(jnp.asarray(rng.rand(8, 3, 8, 8).astype(np.float32)), real=True)
+        kid.update(jnp.asarray(rng.rand(8, 3, 8, 8).astype(np.float32)), real=False)
+        with pytest.raises(ValueError):
+            kid.compute()

@@ -1,0 +1,23 @@
+from torchmetrics_tpu.functional.image.misc import (  # noqa: F401
+    error_relative_global_dimensionless_synthesis,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spatial_correlation_coefficient,
+    spectral_angle_mapper,
+    total_variation,
+    universal_image_quality_index,
+)
+from torchmetrics_tpu.functional.image.psnr import (  # noqa: F401
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+)
+from torchmetrics_tpu.functional.image.ssim import (  # noqa: F401
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from torchmetrics_tpu.functional.image.pansharpening import (  # noqa: F401
+    quality_with_no_reference,
+    spatial_distortion_index,
+    spectral_distortion_index,
+)
+from torchmetrics_tpu.functional.image.vif import visual_information_fidelity  # noqa: F401

@@ -1,0 +1,272 @@
+"""Pure-tensor image metrics: TV, UQI, SAM, ERGAS, RMSE-SW, RASE, SCC.
+
+Reference: functional/image/{tv,uqi,sam,ergas,rmse_sw,rase,scc}.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.utils import (
+    _conv2d,
+    _conv2d_grouped,
+    _gaussian_kernel_2d,
+    _reflect_pad_2d,
+    _uniform_filter,
+)
+from torchmetrics_tpu.parallel.sync import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+# ------------------------------------------------------------------------- TV
+def _total_variation_update(img: Array) -> Tuple[Array, int]:
+    """Per-image anisotropic total variation (reference tv.py:20-40)."""
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = img[..., 1:, :] - img[..., :-1, :]
+    diff2 = img[..., :, 1:] - img[..., :, :-1]
+    res1 = jnp.abs(diff1).sum((1, 2, 3))
+    res2 = jnp.abs(diff2).sum((1, 2, 3))
+    return res1 + res2, img.shape[0]
+
+
+def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
+    """Compute total variation (reference tv.py:43-77)."""
+    score, num_elements = _total_variation_update(jnp.asarray(img, dtype=jnp.float32))
+    if reduction == "sum":
+        return score.sum()
+    if reduction == "mean":
+        return score.mean()
+    if reduction in ("none", None):
+        return score
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+# ------------------------------------------------------------------------ UQI
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """UQI — SSIM with C1=C2=0 structure (reference uqi.py:84-118)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds_p = _reflect_pad_2d(preds, pad_h, pad_w)
+    target_p = _reflect_pad_2d(target, pad_h, pad_w)
+
+    input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
+    outputs = _conv2d_grouped(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred = outputs[:b]
+    mu_target = outputs[b : 2 * b]
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = jnp.clip(outputs[2 * b : 3 * b] - mu_pred_sq, min=0.0)
+    sigma_target_sq = jnp.clip(outputs[3 * b : 4 * b] - mu_target_sq, min=0.0)
+    sigma_pred_target = outputs[4 * b :] - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    eps = jnp.finfo(sigma_pred_sq.dtype).eps
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return reduce(uqi_idx, reduction)
+
+
+# ------------------------------------------------------------------------ SAM
+def spectral_angle_mapper(
+    preds: Array,
+    target: Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Per-pixel spectral angle over the channel axis, radians (reference sam.py)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if preds.shape[1] <= 1:
+        raise ValueError(f"Expected channel dimension of `preds` and `target` to be larger than 1. Got preds: {preds.shape[1]}.")
+    dot_product = (preds * target).sum(1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.clip(dot_product / (preds_norm * target_norm), -1.0, 1.0)
+    sam_score = jnp.arccos(sam_score)
+    return reduce(sam_score, reduction)
+
+
+# ---------------------------------------------------------------------- ERGAS
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS (reference ergas.py:46-123)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+    diff = preds - target
+    sum_squared_error = (diff * diff).sum(2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = target.mean(2)
+    ergas_score = 100 / ratio * jnp.sqrt(((rmse_per_band / mean_target) ** 2).sum(1) / c)
+    return reduce(ergas_score, reduction)
+
+
+# -------------------------------------------------------------------- RMSE-SW
+def _rmse_sw_single(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
+    """Per-batch (rmse_value, rmse_map-sum) (reference rmse_sw.py:24-87)."""
+    error = (target - preds) ** 2
+    error = _uniform_filter(error, window_size)
+    rmse_map = jnp.sqrt(error)
+    crop = round(window_size / 2)
+    rmse_val = rmse_map[:, :, crop:-crop, crop:-crop].sum(0).mean()
+    return rmse_val, rmse_map
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array,
+    target: Array,
+    window_size: int = 8,
+    return_rmse_map: bool = False,
+):
+    """Sliding-window RMSE (reference rmse_sw.py:111+)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if not isinstance(window_size, int) or (isinstance(window_size, int) and window_size < 1):
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_val, rmse_map = _rmse_sw_single(preds, target, window_size)
+    rmse = rmse_val / preds.shape[0]
+    rmse_map = rmse_map.sum(0) / preds.shape[0]
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse
+
+
+# ----------------------------------------------------------------------- RASE
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE (reference rase.py): 100/μ · RMS of per-band sliding RMSE."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if not isinstance(window_size, int) or (isinstance(window_size, int) and window_size < 1):
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    _, rmse_map = _rmse_sw_single(preds, target, window_size)
+    rmse_map = rmse_map.sum(0) / preds.shape[0]  # (C, H, W)
+    target_mean = (_uniform_filter(target, window_size) / (window_size**2)).sum(0) / preds.shape[0]
+    target_mean = target_mean.mean(0)  # (H, W) mean over channels
+    rase_map = 100 / target_mean * jnp.sqrt((rmse_map**2).mean(0))
+    crop = round(window_size / 2)
+    return rase_map[crop:-crop, crop:-crop].mean()
+
+
+# ------------------------------------------------------------------------ SCC
+def _symmetric_reflect_pad_2d(x: Array, pad: Tuple[int, int, int, int]) -> Array:
+    """Symmetric padding d c b a | a b c d | d c b a (reference scc.py:77-90)."""
+    left = jnp.flip(x[:, :, :, 0 : pad[0]], axis=3)
+    right = jnp.flip(x[:, :, :, x.shape[3] - pad[1] :], axis=3)
+    padded = jnp.concatenate([left, x, right], axis=3)
+    top = jnp.flip(padded[:, :, 0 : pad[2], :], axis=2)
+    bottom = jnp.flip(padded[:, :, padded.shape[2] - pad[3] :, :], axis=2)
+    return jnp.concatenate([top, padded, bottom], axis=2)
+
+
+def _signal_convolve_2d(x: Array, kernel: Array) -> Array:
+    """scipy.signal-style 2D convolution (flip kernel + symmetric pad)."""
+    left = int(math.floor((kernel.shape[3] - 1) / 2))
+    right = int(math.ceil((kernel.shape[3] - 1) / 2))
+    top = int(math.floor((kernel.shape[2] - 1) / 2))
+    bottom = int(math.ceil((kernel.shape[2] - 1) / 2))
+    padded = _symmetric_reflect_pad_2d(x, (left, right, top, bottom))
+    kernel = jnp.flip(kernel, axis=(2, 3))
+    return _conv2d(padded, kernel)
+
+
+def _scc_per_channel(preds: Array, target: Array, hp_filter: Array, window_size: int) -> Array:
+    """Per-channel SCC map (reference scc.py:140-165). preds/target are (B,1,H,W)."""
+    window = jnp.ones((1, 1, window_size, window_size), dtype=preds.dtype) / (window_size**2)
+    preds_hp = _signal_convolve_2d(preds, hp_filter) * 2.0
+    target_hp = _signal_convolve_2d(target, hp_filter) * 2.0
+
+    left = int(math.ceil((window.shape[3] - 1) / 2))
+    right = int(math.floor((window.shape[3] - 1) / 2))
+    pp = jnp.pad(preds_hp, ((0, 0), (0, 0), (left, right), (left, right)))
+    tt = jnp.pad(target_hp, ((0, 0), (0, 0), (left, right), (left, right)))
+    preds_mean = _conv2d(pp, window)
+    target_mean = _conv2d(tt, window)
+    preds_var = _conv2d(pp**2, window) - preds_mean**2
+    target_var = _conv2d(tt**2, window) - target_mean**2
+    cov = _conv2d(tt * pp, window) - target_mean * preds_mean
+
+    preds_var = jnp.clip(preds_var, min=0.0)
+    target_var = jnp.clip(target_var, min=0.0)
+    den = jnp.sqrt(target_var) * jnp.sqrt(preds_var)
+    scc = jnp.where(den == 0, 0.0, cov / jnp.where(den == 0, 1.0, den))
+    return scc
+
+
+def spatial_correlation_coefficient(
+    preds: Array,
+    target: Array,
+    hp_filter: Optional[Array] = None,
+    window_size: int = 8,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """SCC (reference scc.py:169+)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    if hp_filter is None:
+        hp_filter = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    _check_same_shape(preds, target)
+    if not window_size > 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got {window_size}.")
+    if window_size > preds.shape[2] or window_size > preds.shape[3]:
+        raise ValueError(
+            f"Expected `window_size` to be less than or equal to the size of the image."
+            f" Got window_size: {window_size} and image size: {preds.shape[2]}x{preds.shape[3]}."
+        )
+    hp_filter = hp_filter[None, None, :, :]
+    per_channel = [
+        _scc_per_channel(preds[:, c][:, None], target[:, c][:, None], hp_filter, window_size)
+        for c in range(preds.shape[1])
+    ]
+    scc = jnp.concatenate(per_channel, axis=1)
+    if reduction in (None, "none"):
+        return scc.mean(axis=(1, 2, 3))
+    if reduction == "mean":
+        return scc.mean()
+    raise ValueError(f"Expected reduction to be one of 'mean', 'none', None but got {reduction}")

@@ -1,0 +1,124 @@
+"""PSNR (reference functional/image/psnr.py) and PSNR-B (psnrb.py)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.parallel.sync import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    """Sum of squared errors + observation count (reference psnr.py:71-100)."""
+    if dim is None:
+        sum_squared_error = ((preds - target) ** 2).sum()
+        num_obs = jnp.asarray(target.size, dtype=jnp.float32)
+        return sum_squared_error, num_obs
+    diff = preds - target
+    sum_squared_error = (diff * diff).sum(axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    num_obs = jnp.asarray(
+        jnp.prod(jnp.asarray([target.shape[d] for d in dim_list])), dtype=jnp.float32
+    )
+    num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """PSNR from sse/count (reference psnr.py:24-52)."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    return reduce(psnr_vals, reduction)
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Union[float, Tuple[float, float], None] = None,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Compute PSNR (reference psnr.py:103-161)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if dim is None and reduction != "elementwise_mean":
+        from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = target.max() - target.min()  # reference psnr.py: target range only
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0], dtype=jnp.float32)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range, base=base, reduction=reduction)
+
+
+# ------------------------------------------------------------------- PSNR-B
+
+def _compute_bef(x: Array, block_size: int = 8) -> Array:
+    """Blocking effect factor of a (B, 1, H, W) grayscale image (reference psnrb.py:24-66)."""
+    if x.shape[1] > 1:
+        raise ValueError(f"`psnrb` metric expects grayscale images, but got images with {x.shape[1]} channels.")
+    height, width = x.shape[2], x.shape[3]
+    h = jnp.arange(width - 1)
+    h_b = jnp.arange(block_size - 1, width - 1, block_size)
+    mask = jnp.zeros(width - 1, dtype=bool).at[h_b].set(True)
+    v = jnp.arange(height - 1)
+    v_b = jnp.arange(block_size - 1, height - 1, block_size)
+    vmask = jnp.zeros(height - 1, dtype=bool).at[v_b].set(True)
+
+    d_b = ((x[:, :, :, :-1] - x[:, :, :, 1:]) ** 2 * mask[None, None, None, :]).sum()
+    d_bc = ((x[:, :, :, :-1] - x[:, :, :, 1:]) ** 2 * (~mask)[None, None, None, :]).sum()
+    d_b = d_b + ((x[:, :, :-1, :] - x[:, :, 1:, :]) ** 2 * vmask[None, None, :, None]).sum()
+    d_bc = d_bc + ((x[:, :, :-1, :] - x[:, :, 1:, :]) ** 2 * (~vmask)[None, None, :, None]).sum()
+
+    n_hb = height * (width / block_size) - 1
+    n_hbc = (height * (width - 1)) - n_hb
+    n_vb = width * (height / block_size) - 1
+    n_vbc = (width * (height - 1)) - n_vb
+    d_b = d_b / (n_hb + n_vb)
+    d_bc = d_bc / (n_hbc + n_vbc)
+    t = jnp.log2(block_size) / jnp.log2(min(height, width))
+    return jnp.where(d_b > d_bc, t * (d_b - d_bc), 0.0)
+
+
+def peak_signal_noise_ratio_with_blocked_effect(
+    preds: Array,
+    target: Array,
+    block_size: int = 8,
+) -> Array:
+    """PSNR-B: PSNR with blocking-effect penalty (reference psnrb.py:69-109)."""
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    data_range = target.max() - target.min()
+    sum_squared_error = ((preds - target) ** 2).sum()
+    bef = _compute_bef(preds, block_size=block_size)
+    num_obs = jnp.asarray(target.size, dtype=jnp.float32)
+    sum_squared_error = sum_squared_error / num_obs + bef
+    # reference psnrb.py:83-86: unit-range images use 1.0 as the peak
+    return jnp.where(
+        data_range > 2,
+        10 * jnp.log10(data_range**2 / sum_squared_error),
+        10 * jnp.log10(1.0 / sum_squared_error),
+    )

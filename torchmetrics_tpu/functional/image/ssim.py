@@ -1,0 +1,243 @@
+"""SSIM and MS-SSIM (reference functional/image/ssim.py).
+
+Gaussian (or uniform) windowed statistics computed with one grouped conv over a
+5×-batched stack (preds, target, preds², target², preds·target) — a single fused
+conv kernel per update on TPU (mirrors reference ssim.py:135-140).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.utils import (
+    _avg_pool2d,
+    _conv2d_grouped,
+    _gaussian_kernel_2d,
+    _reflect_pad_2d,
+)
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape. Got preds: {preds.shape}."
+        )
+    return preds, target
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Union[float, Tuple[float, float], None] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-image SSIM (and optionally CS / full map) — reference ssim.py:50-200.
+
+    Handles both 2-D (NCHW) and 3-D (NCDHW) inputs, like the reference.
+    """
+    is_3d = preds.ndim == 5
+    ndims = 3 if is_3d else 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = ndims * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = ndims * [sigma]
+    if len(kernel_size) != ndims or len(sigma) != ndims:
+        raise ValueError(
+            f"`kernel_size` has dimension {ndims} for {'3d' if is_3d else '2d'} images"
+            f" but got kernel_size: {kernel_size} and sigma: {sigma}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max(), target.max()) - jnp.minimum(preds.min(), target.min())
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    if is_3d:
+        from torchmetrics_tpu.functional.image.utils import (
+            _conv3d_grouped,
+            _gaussian_kernel_3d,
+            _reflect_pad_3d,
+        )
+
+        if gaussian_kernel:
+            kernel = _gaussian_kernel_3d(channel, kernel_size, sigma, preds.dtype)
+        else:
+            kernel = jnp.ones((channel, 1, *kernel_size), dtype=preds.dtype) / jnp.prod(
+                jnp.asarray(kernel_size, dtype=preds.dtype)
+            )
+        pad_d = (kernel_size[0] - 1) // 2
+        pad_h = (kernel_size[1] - 1) // 2
+        pad_w = (kernel_size[2] - 1) // 2
+        preds_p = _reflect_pad_3d(preds, pad_d, pad_h, pad_w)
+        target_p = _reflect_pad_3d(target, pad_d, pad_h, pad_w)
+        input_list = jnp.concatenate(
+            [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p]
+        )
+        outputs = _conv3d_grouped(input_list, kernel)
+    else:
+        if gaussian_kernel:
+            kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+        else:
+            kernel = jnp.ones((channel, 1, kernel_size[0], kernel_size[1]), dtype=preds.dtype) / (
+                kernel_size[0] * kernel_size[1]
+            )
+        pad_h = (kernel_size[0] - 1) // 2
+        pad_w = (kernel_size[1] - 1) // 2
+        preds_p = _reflect_pad_2d(preds, pad_h, pad_w)
+        target_p = _reflect_pad_2d(target, pad_h, pad_w)
+
+        input_list = jnp.concatenate(
+            [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p]
+        )  # (5B, C, H+2p, W+2p)
+        outputs = _conv2d_grouped(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred = outputs[:b]
+    mu_target = outputs[b : 2 * b]
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = outputs[2 * b : 3 * b] - mu_pred_sq
+    sigma_target_sq = outputs[3 * b : 4 * b] - mu_target_sq
+    sigma_pred_target = outputs[4 * b :] - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_idx_full_image = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    # crop to the valid (unpadded) region
+    def _crop(x: Array) -> Array:
+        if is_3d:
+            return x[..., pad_d:-pad_d, pad_h:-pad_h, pad_w:-pad_w] if pad_d and pad_h and pad_w else x
+        return x[..., pad_h:-pad_h, pad_w:-pad_w] if pad_h and pad_w else x
+
+    ssim_idx = _crop(ssim_idx_full_image)
+    per_image = ssim_idx.reshape(ssim_idx.shape[0], -1).mean(-1)
+    if return_contrast_sensitivity:
+        cs = _crop(upper / lower)
+        return per_image, cs.reshape(cs.shape[0], -1).mean(-1)
+    if return_full_image:
+        return per_image, ssim_idx_full_image
+    return per_image
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Union[float, Tuple[float, float], None] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Compute SSIM (reference ssim.py public entry)."""
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _ssim_update(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        data_range,
+        k1,
+        k2,
+        return_full_image,
+        return_contrast_sensitivity,
+    )
+    if isinstance(out, tuple):
+        similarity, extra = out
+    else:
+        similarity, extra = out, None
+
+    if reduction == "elementwise_mean":
+        similarity = similarity.mean()
+    elif reduction == "sum":
+        similarity = similarity.sum()
+    if extra is not None:
+        return similarity, extra
+    return similarity
+
+
+_MS_SSIM_BETAS = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Union[float, Tuple[float, float], None] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = _MS_SSIM_BETAS,
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """MS-SSIM over len(betas) scales (reference ssim.py:220+)."""
+    preds, target = _ssim_check_inputs(preds, target)
+    if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize not in ("relu", "simple", None):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+
+    _ks = kernel_size if isinstance(kernel_size, Sequence) else [kernel_size, kernel_size]
+    min_size = (_ks[0] - 1) * 2 ** (len(betas) - 1) + 1
+    if preds.shape[-1] < min_size or preds.shape[-2] < min_size:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width should be larger than"
+            f" {min_size} but got height: {preds.shape[-2]} and width: {preds.shape[-1]}"
+        )
+
+    sim_list: List[Array] = []
+    cs_list: List[Array] = []
+    p, t = preds, target
+    for _ in range(len(betas)):
+        sim, cs = _ssim_update(
+            p, t, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, return_contrast_sensitivity=True
+        )
+        sim_list.append(sim)
+        cs_list.append(cs)
+        p = _avg_pool2d(p, 2)
+        t = _avg_pool2d(t, 2)
+
+    mcs_and_ssim = jnp.stack(cs_list[:-1] + [sim_list[-1]], axis=0)  # (S, B)
+    if normalize == "relu":
+        mcs_and_ssim = jnp.maximum(mcs_and_ssim, 0.0)
+    elif normalize == "simple":
+        mcs_and_ssim = (mcs_and_ssim + 1) / 2
+    betas_arr = jnp.asarray(betas)[:, None]
+    ms_ssim = jnp.prod(mcs_and_ssim**betas_arr, axis=0)
+
+    if reduction == "elementwise_mean":
+        return ms_ssim.mean()
+    if reduction == "sum":
+        return ms_ssim.sum()
+    return ms_ssim

@@ -1,0 +1,111 @@
+"""Image-kernel utilities (reference functional/image/utils.py).
+
+Gaussian/uniform separable kernels and scipy-compatible reflection padding,
+expressed with lax.conv_general_dilated (NCHW / OIHW) — grouped convs map onto
+the TPU's convolution units directly.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1-D gaussian kernel (reference utils.py:8-24)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=dtype)
+    gauss = jnp.exp(-jnp.power(dist / sigma, 2) / 2)
+    return (gauss / gauss.sum())[None]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """(C, 1, kh, kw) separable gaussian kernel (reference utils.py:27-56)."""
+    gaussian_kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    gaussian_kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = jnp.matmul(gaussian_kernel_x.T, gaussian_kernel_y)  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """(C, 1, kd, kh, kw) 3-D gaussian kernel (reference utils.py:135-156)."""
+    gaussian_kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    gaussian_kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    gaussian_kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = jnp.matmul(gaussian_kernel_x.T, gaussian_kernel_y)  # (kh, kw)
+    kernel = kernel_xy[None] * gaussian_kernel_z.reshape(-1, 1, 1)  # (kd, kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _conv2d_grouped(x: Array, kernel: Array) -> Array:
+    """Per-channel (grouped) valid conv, NCHW x (C,1,kh,kw)."""
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _conv2d(x: Array, kernel: Array) -> Array:
+    """Plain valid conv, NCHW x (O,I,kh,kw)."""
+    return lax.conv_general_dilated(
+        x, kernel, window_strides=(1, 1), padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )
+
+
+def _reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    """Reflect padding (torch 'reflect' mode: edge not repeated)."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _single_dimension_pad(inputs: Array, dim: int, pad: int, outer_pad: int = 0) -> Array:
+    """Scipy-style symmetric padding over one dim (reference utils.py:76-92)."""
+    _max = inputs.shape[dim]
+    x = jnp.take(inputs, jnp.arange(pad - 1, -1, -1), axis=dim)
+    y = jnp.take(inputs, jnp.arange(_max - 1, _max - pad - outer_pad, -1), axis=dim)
+    return jnp.concatenate((x, inputs, y), axis=dim)
+
+
+def _reflection_pad_2d(inputs: Array, pad: int, outer_pad: int = 0) -> Array:
+    """Symmetric pad over H and W (reference utils.py:95-109)."""
+    for dim in (2, 3):
+        inputs = _single_dimension_pad(inputs, dim, pad, outer_pad)
+    return inputs
+
+
+def _uniform_filter(inputs: Array, window_size: int) -> Array:
+    """Uniform (box) filter with scipy-compatible padding (reference utils.py:112-132)."""
+    inputs = _reflection_pad_2d(inputs, window_size // 2, window_size % 2)
+    kernel = jnp.ones((inputs.shape[1], 1, window_size, window_size), dtype=inputs.dtype) / (window_size**2)
+    return _conv2d_grouped(inputs, kernel)
+
+
+def _conv3d_grouped(x: Array, kernel: Array) -> Array:
+    """Per-channel (grouped) valid conv, NCDHW x (C,1,kd,kh,kw)."""
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _reflect_pad_3d(x: Array, pad_d: int, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_d, pad_d), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _avg_pool2d(x: Array, kernel: int = 2) -> Array:
+    """Average pooling NCHW (for MS-SSIM downsampling)."""
+    return lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, kernel, kernel), (1, 1, kernel, kernel), "VALID"
+    ) / (kernel * kernel)

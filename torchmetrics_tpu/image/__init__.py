@@ -1,0 +1,20 @@
+from torchmetrics_tpu.image.basic import (  # noqa: F401
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    QualityWithNoReference,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpatialCorrelationCoefficient,
+    SpatialDistortionIndex,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
+from torchmetrics_tpu.image.inception import InceptionScore  # noqa: F401
+from torchmetrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401

@@ -1,0 +1,629 @@
+"""Modular pure-tensor image metrics.
+
+Reference: image/{psnr,psnrb,ssim,tv,uqi,sam,ergas,rase,rmse_sw,scc,vif,
+d_lambda,d_s,qnr}.py. State strategy mirrors the reference per metric: scalar
+sum+count accumulators where the metric streams (PSNR/SSIM/TV/VIF/SCC), list
+states where the computation needs all samples (UQI/SAM/ERGAS/RASE/RMSE-SW and
+the pan-sharpening family).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.misc import (
+    _rmse_sw_single,
+    _total_variation_update,
+    error_relative_global_dimensionless_synthesis,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spatial_correlation_coefficient,
+    spectral_angle_mapper,
+    universal_image_quality_index,
+)
+from torchmetrics_tpu.functional.image.pansharpening import (
+    quality_with_no_reference,
+    spatial_distortion_index,
+    spectral_distortion_index,
+)
+from torchmetrics_tpu.functional.image.psnr import (
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+    _compute_bef,
+    _psnr_compute,
+    _psnr_update,
+)
+from torchmetrics_tpu.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+    _ssim_check_inputs,
+    _ssim_update,
+)
+from torchmetrics_tpu.functional.image.vif import _vif_per_channel, visual_information_fidelity
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR (reference image/psnr.py)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        data_range: Union[float, Tuple[float, float], None] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        if dim is None:
+            self.add_state("sum_squared_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+            self.add_state("max_target", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+            self._clamping = None
+        elif isinstance(data_range, tuple):
+            self.data_range = jnp.asarray(data_range[1] - data_range[0], dtype=jnp.float32)
+            self._clamping = data_range
+        else:
+            self.data_range = jnp.asarray(float(data_range))
+            self._clamping = None
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        if self._clamping is not None:
+            preds = jnp.clip(preds, *self._clamping)
+            target = jnp.clip(target, *self._clamping)
+        sum_squared_error, num_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                self.min_target = jnp.minimum(target.min(), self.min_target)
+                self.max_target = jnp.maximum(target.max(), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + num_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error.reshape(-1))
+            self.total.append(num_obs.reshape(-1))
+
+    def compute(self) -> Array:
+        data_range = self.data_range if self.data_range is not None else (self.max_target - self.min_target)
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = dim_zero_cat(self.sum_squared_error)
+            total = dim_zero_cat(self.total)
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNR-B (reference image/psnrb.py)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, block_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("bef", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("data_range", jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        self.sum_squared_error = self.sum_squared_error + ((preds - target) ** 2).sum()
+        self.total = self.total + target.size
+        self.bef = self.bef + _compute_bef(preds, block_size=self.block_size)
+        self.data_range = jnp.maximum(self.data_range, target.max() - target.min())
+
+    def compute(self) -> Array:
+        sum_squared_error = self.sum_squared_error / self.total + self.bef
+        return jnp.where(
+            self.data_range > 2,
+            10 * jnp.log10(self.data_range**2 / sum_squared_error),
+            10 * jnp.log10(1.0 / sum_squared_error),
+        )
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """SSIM (reference image/ssim.py:30)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Union[float, Tuple[float, float], None] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_contrast_sensitivity or return_full_image:
+            self.add_state("image_return", [], dist_reduce_fx="cat")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        out = _ssim_update(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+        if isinstance(out, tuple):
+            similarity, image = out
+            self.image_return.append(image)
+        else:
+            similarity = out
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + similarity.sum()
+        else:
+            self.similarity.append(similarity)
+        self.total = self.total + preds.shape[0]
+
+    def compute(self):
+        if self.reduction == "elementwise_mean":
+            similarity = self.similarity / self.total
+        elif self.reduction == "sum":
+            similarity = self.similarity
+        else:
+            similarity = dim_zero_cat(self.similarity)
+        if self.return_contrast_sensitivity or self.return_full_image:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """MS-SSIM (reference image/ssim.py:220)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Union[float, Tuple[float, float], None] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        similarity = multiscale_structural_similarity_index_measure(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            None,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.betas,
+            self.normalize,
+        )
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + similarity.sum()
+        else:
+            self.similarity.append(similarity)
+        self.total = self.total + jnp.asarray(preds).shape[0]
+
+    def compute(self):
+        if self.reduction == "elementwise_mean":
+            return self.similarity / self.total
+        if self.reduction == "sum":
+            return self.similarity
+        return dim_zero_cat(self.similarity)
+
+
+class TotalVariation(Metric):
+    """TV (reference image/tv.py)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("score", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, num_elements = _total_variation_update(jnp.asarray(img, dtype=jnp.float32))
+        if self.reduction in ("none", None):
+            self.score.append(score)
+        else:
+            self.score = self.score + score.sum()
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.score / self.num_elements
+        if self.reduction == "sum":
+            return self.score
+        return dim_zero_cat(self.score)
+
+
+class _PairListMetric(Metric):
+    """Base for image metrics that accumulate (preds, target) lists."""
+
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(jnp.asarray(preds, dtype=jnp.float32))
+        self.target.append(jnp.asarray(target, dtype=jnp.float32))
+
+    def _cat(self):
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+
+class UniversalImageQualityIndex(_PairListMetric):
+    """UQI (reference image/uqi.py)."""
+
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+
+    def compute(self) -> Array:
+        preds, target = self._cat()
+        return universal_image_quality_index(preds, target, self.kernel_size, self.sigma, self.reduction)
+
+
+class SpectralAngleMapper(_PairListMetric):
+    """SAM (reference image/sam.py)."""
+
+    higher_is_better = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 3.142
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+
+    def compute(self) -> Array:
+        preds, target = self._cat()
+        return spectral_angle_mapper(preds, target, self.reduction)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(_PairListMetric):
+    """ERGAS (reference image/ergas.py)."""
+
+    higher_is_better = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+
+    def compute(self) -> Array:
+        preds, target = self._cat()
+        return error_relative_global_dimensionless_synthesis(preds, target, self.ratio, self.reduction)
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """RMSE-SW (reference image/rmse_sw.py) — streaming rmse-map states."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("rmse_val_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        rmse_val, _ = _rmse_sw_single(preds, target, self.window_size)
+        self.rmse_val_sum = self.rmse_val_sum + rmse_val
+        self.total_images = self.total_images + preds.shape[0]
+
+    def compute(self) -> Array:
+        return self.rmse_val_sum / self.total_images
+
+
+class RelativeAverageSpectralError(_PairListMetric):
+    """RASE (reference image/rase.py)."""
+
+    higher_is_better = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+
+    def compute(self) -> Array:
+        preds, target = self._cat()
+        return relative_average_spectral_error(preds, target, self.window_size)
+
+
+class SpatialCorrelationCoefficient(Metric):
+    """SCC (reference image/scc.py)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, hp_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.hp_filter = hp_filter
+        self.window_size = window_size
+        self.add_state("scc_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        score = spatial_correlation_coefficient(
+            preds, target, self.hp_filter, self.window_size, reduction="none"
+        )
+        self.scc_score = self.scc_score + score.sum()
+        self.total = self.total + score.shape[0]
+
+    def compute(self) -> Array:
+        return self.scc_score / self.total
+
+
+class VisualInformationFidelity(Metric):
+    """VIF-p (reference image/vif.py)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = sigma_n_sq
+        self.add_state("vif_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        channels = preds.shape[1]
+        vif_per_channel = [
+            _vif_per_channel(preds[:, i], target[:, i], self.sigma_n_sq) for i in range(channels)
+        ]
+        vif = jnp.mean(jnp.stack(vif_per_channel, 0), 0) if channels > 1 else vif_per_channel[0]
+        self.vif_score = self.vif_score + vif.sum()
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        return self.vif_score / self.total
+
+
+class SpectralDistortionIndex(_PairListMetric):
+    """D_lambda (reference image/d_lambda.py)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+
+    def compute(self) -> Array:
+        preds, target = self._cat()
+        return spectral_distortion_index(preds, target, self.p, self.reduction)
+
+
+class SpatialDistortionIndex(Metric):
+    """D_s (reference image/d_s.py)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, norm_order: int = 1, window_size: int = 7, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+        self.add_state("pan_lr", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to be a dict with keys 'ms' and 'pan'. Got {list(target)}.")
+        self.preds.append(jnp.asarray(preds, dtype=jnp.float32))
+        self.ms.append(jnp.asarray(target["ms"], dtype=jnp.float32))
+        self.pan.append(jnp.asarray(target["pan"], dtype=jnp.float32))
+        if "pan_lr" in target:
+            self.pan_lr.append(jnp.asarray(target["pan_lr"], dtype=jnp.float32))
+
+    def compute(self) -> Array:
+        return spatial_distortion_index(
+            dim_zero_cat(self.preds),
+            dim_zero_cat(self.ms),
+            dim_zero_cat(self.pan),
+            dim_zero_cat(self.pan_lr) if self.pan_lr else None,
+            self.norm_order,
+            self.window_size,
+            self.reduction,
+        )
+
+
+class QualityWithNoReference(Metric):
+    """QNR (reference image/qnr.py)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        alpha: float = 1,
+        beta: float = 1,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.alpha = alpha
+        self.beta = beta
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+        self.add_state("pan_lr", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to be a dict with keys 'ms' and 'pan'. Got {list(target)}.")
+        self.preds.append(jnp.asarray(preds, dtype=jnp.float32))
+        self.ms.append(jnp.asarray(target["ms"], dtype=jnp.float32))
+        self.pan.append(jnp.asarray(target["pan"], dtype=jnp.float32))
+        if "pan_lr" in target:
+            self.pan_lr.append(jnp.asarray(target["pan_lr"], dtype=jnp.float32))
+
+    def compute(self) -> Array:
+        return quality_with_no_reference(
+            dim_zero_cat(self.preds),
+            dim_zero_cat(self.ms),
+            dim_zero_cat(self.pan),
+            dim_zero_cat(self.pan_lr) if self.pan_lr else None,
+            self.alpha,
+            self.beta,
+            self.norm_order,
+            self.window_size,
+            self.reduction,
+        )
