@@ -1,0 +1,475 @@
+"""Text-domain parity tests against the reference implementation (golden oracle).
+
+Mirrors the reference's test strategy (tests/unittests/text/*): functional and
+modular paths, batched accumulation, against golden values.
+"""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+
+import torchmetrics_tpu.functional.text as F  # noqa: E402
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+PREDS_MT = ["the cat is on the mat", "there is a dog outside the house"]
+TARGET_MT = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["a dog is outside the house", "there is a dog outside"],
+]
+PREDS_ASR = ["this is the prediction", "there is an other sample"]
+TARGET_ASR = ["this is the reference", "there is another one"]
+
+BATCHES = [
+    (["hello there general kenobi"], [["hello there generals kenobi", "hello there general kenobi obi"]]),
+    (["foo bar baz", "the quick brown fox"], [["foo baz bar"], ["the fast brown fox jumps"]]),
+]
+
+
+def _close(a, b, atol=1e-4):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b, dtype=np.float64), atol=atol, rtol=1e-4)
+
+
+class TestBLEU:
+    def test_functional_parity(self):
+        _close(F.bleu_score(PREDS_MT, TARGET_MT), ref_tm.functional.bleu_score(PREDS_MT, TARGET_MT))
+
+    @pytest.mark.parametrize("smooth", [False, True])
+    @pytest.mark.parametrize("n_gram", [2, 4])
+    def test_modular_accumulation(self, smooth, n_gram):
+        metric = BLEUScore(n_gram=n_gram, smooth=smooth)
+        ref = ref_tm.text.BLEUScore(n_gram=n_gram, smooth=smooth)
+        for preds, target in BATCHES:
+            metric.update(preds, target)
+            ref.update(preds, target)
+        _close(metric.compute(), ref.compute())
+
+    def test_weights(self):
+        w = [0.4, 0.3, 0.2, 0.1]
+        _close(
+            F.bleu_score(PREDS_MT, TARGET_MT, weights=w),
+            ref_tm.functional.bleu_score(PREDS_MT, TARGET_MT, weights=w),
+        )
+
+
+class TestSacreBLEU:
+    @pytest.mark.parametrize("tokenize", ["13a", "none", "char"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_parity(self, tokenize, lowercase):
+        preds = ["The cat is on the mat!", "A dog."]
+        target = [["There is a cat on the mat."], ["A dog outside."]]
+        _close(
+            F.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase),
+            ref_tm.functional.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase),
+        )
+
+    def test_modular(self):
+        metric = SacreBLEUScore()
+        ref = ref_tm.text.SacreBLEUScore()
+        for preds, target in BATCHES:
+            metric.update(preds, target)
+            ref.update(preds, target)
+        _close(metric.compute(), ref.compute())
+
+
+class TestCHRF:
+    @pytest.mark.parametrize("n_word_order", [0, 2])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_parity(self, n_word_order, lowercase):
+        _close(
+            F.chrf_score(PREDS_MT, TARGET_MT, n_word_order=n_word_order, lowercase=lowercase),
+            ref_tm.functional.chrf_score(PREDS_MT, TARGET_MT, n_word_order=n_word_order, lowercase=lowercase),
+        )
+
+    def test_modular_accumulation(self):
+        metric = CHRFScore()
+        ref = ref_tm.text.CHRFScore()
+        for preds, target in BATCHES:
+            metric.update(preds, target)
+            ref.update(preds, target)
+        _close(metric.compute(), ref.compute())
+
+    def test_sentence_level(self):
+        corpus, sent = F.chrf_score(PREDS_MT, TARGET_MT, return_sentence_level_score=True)
+        r_corpus, r_sent = ref_tm.functional.chrf_score(PREDS_MT, TARGET_MT, return_sentence_level_score=True)
+        _close(corpus, r_corpus)
+        _close(sent, r_sent)
+
+
+class TestTER:
+    @pytest.mark.parametrize("kwargs", [{}, {"normalize": True}, {"no_punctuation": True}, {"lowercase": False}])
+    def test_parity(self, kwargs):
+        preds = ["the cat is on the mat", "a dog walked into the room and sat"]
+        target = [["the cat sat on the mat"], ["into the room a dog walked, and sat down"]]
+        _close(
+            F.translation_edit_rate(preds, target, **kwargs),
+            ref_tm.functional.translation_edit_rate(preds, target, **kwargs),
+        )
+
+    def test_modular_accumulation(self):
+        metric = TranslationEditRate()
+        ref = ref_tm.text.TranslationEditRate()
+        for preds, target in BATCHES:
+            metric.update(preds, target)
+            ref.update(preds, target)
+        _close(metric.compute(), ref.compute())
+
+
+class TestTERFuzz:
+    """Seeded fuzz parity — catches shift-heuristic and trace-tiebreak drift."""
+
+    def test_fuzz_single_ref(self):
+        rng = np.random.default_rng(0)
+        vocab = list("abcdefg")
+        for _ in range(40):
+            s1 = " ".join(rng.choice(vocab, rng.integers(1, 12)))
+            s2 = " ".join(rng.choice(vocab, rng.integers(1, 12)))
+            _close(F.translation_edit_rate([s1], [[s2]]), ref_tm.functional.translation_edit_rate([s1], [[s2]]))
+
+    def test_fuzz_multi_ref(self):
+        rng = np.random.default_rng(1)
+        vocab = list("abcdefg")
+        for _ in range(10):
+            preds = [" ".join(rng.choice(vocab, rng.integers(1, 14))) for _ in range(2)]
+            tgts = [[" ".join(rng.choice(vocab, rng.integers(1, 14))) for _ in range(2)] for _ in range(2)]
+            _close(F.translation_edit_rate(preds, tgts), ref_tm.functional.translation_edit_rate(preds, tgts))
+
+    def test_beam_path_long_sentences(self):
+        rng = np.random.default_rng(2)
+        vocab = list("abcdefg")
+        s1 = " ".join(rng.choice(vocab, 60))
+        s2 = " ".join(rng.choice(vocab, 70))
+        _close(F.translation_edit_rate([s1], [[s2]]), ref_tm.functional.translation_edit_rate([s1], [[s2]]))
+
+
+class TestEEDFuzz:
+    def test_fuzz_with_punctuation(self):
+        rng = np.random.default_rng(3)
+        vocab = list("abcdefg") + ["!", ".", "e", "gg", "dd"]
+        for _ in range(25):
+            s1 = " ".join(rng.choice(vocab, rng.integers(1, 10)))
+            s2 = " ".join(rng.choice(vocab, rng.integers(1, 10)))
+            _close(
+                F.extended_edit_distance([s1], [[s2]]),
+                ref_tm.functional.extended_edit_distance([s1], [[s2]]),
+            )
+
+
+class TestEED:
+    def test_parity(self):
+        _close(
+            F.extended_edit_distance(PREDS_MT, TARGET_MT),
+            ref_tm.functional.extended_edit_distance(PREDS_MT, TARGET_MT),
+            atol=1e-3,
+        )
+
+    def test_modular(self):
+        metric = ExtendedEditDistance()
+        ref = ref_tm.text.ExtendedEditDistance()
+        for preds, target in BATCHES:
+            metric.update(preds, target)
+            ref.update(preds, target)
+        _close(metric.compute(), ref.compute(), atol=1e-3)
+
+
+class TestEditDistance:
+    @pytest.mark.parametrize("reduction", ["mean", "sum", None])
+    @pytest.mark.parametrize("substitution_cost", [1, 2])
+    def test_parity(self, reduction, substitution_cost):
+        preds = ["rain", "lnaguaeg"]
+        target = ["shine", "language"]
+        _close(
+            F.edit_distance(preds, target, substitution_cost=substitution_cost, reduction=reduction),
+            ref_tm.functional.text.edit_distance(
+                preds, target, substitution_cost=substitution_cost, reduction=reduction
+            ),
+        )
+
+    def test_modular(self):
+        metric = EditDistance()
+        ref = ref_tm.text.EditDistance()
+        metric.update(["rain"], ["shine"])
+        ref.update(["rain"], ["shine"])
+        metric.update(["lnaguaeg"], ["language"])
+        ref.update(["lnaguaeg"], ["language"])
+        _close(metric.compute(), ref.compute())
+
+
+class TestASR:
+    @pytest.mark.parametrize(
+        ("ours", "theirs_fn", "theirs_cls"),
+        [
+            (WordErrorRate, "word_error_rate", "WordErrorRate"),
+            (CharErrorRate, "char_error_rate", "CharErrorRate"),
+            (MatchErrorRate, "match_error_rate", "MatchErrorRate"),
+            (WordInfoLost, "word_information_lost", "WordInfoLost"),
+            (WordInfoPreserved, "word_information_preserved", "WordInfoPreserved"),
+        ],
+    )
+    def test_parity(self, ours, theirs_fn, theirs_cls):
+        fn = {
+            WordErrorRate: F.word_error_rate,
+            CharErrorRate: F.char_error_rate,
+            MatchErrorRate: F.match_error_rate,
+            WordInfoLost: F.word_information_lost,
+            WordInfoPreserved: F.word_information_preserved,
+        }[ours]
+        ref_fn = getattr(ref_tm.functional, theirs_fn)
+        _close(fn(PREDS_ASR, TARGET_ASR), ref_fn(PREDS_ASR, TARGET_ASR))
+
+        metric = ours()
+        ref_metric = getattr(ref_tm.text, theirs_cls)()
+        metric.update(PREDS_ASR[:1], TARGET_ASR[:1])
+        metric.update(PREDS_ASR[1:], TARGET_ASR[1:])
+        ref_metric.update(PREDS_ASR, TARGET_ASR)
+        _close(metric.compute(), ref_metric.compute())
+
+
+class TestSQuAD:
+    PREDS = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    TARGET = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+
+    def test_parity(self):
+        ours = F.squad(self.PREDS, self.TARGET)
+        theirs = ref_tm.functional.squad(self.PREDS, self.TARGET)
+        _close(ours["exact_match"], theirs["exact_match"])
+        _close(ours["f1"], theirs["f1"])
+
+    def test_partial_match(self):
+        preds = [{"prediction_text": "in 1976 it was", "id": "a"}]
+        target = [{"answers": {"answer_start": [1], "text": ["1976 it"]}, "id": "a"}]
+        ours = F.squad(preds, target)
+        theirs = ref_tm.functional.squad(preds, target)
+        _close(ours["exact_match"], theirs["exact_match"])
+        _close(ours["f1"], theirs["f1"])
+
+    def test_modular(self):
+        metric = SQuAD()
+        metric.update(self.PREDS, self.TARGET)
+        out = metric.compute()
+        _close(out["exact_match"], 100.0)
+        _close(out["f1"], 100.0)
+
+
+class TestPerplexity:
+    def test_parity(self):
+        import torch
+
+        rng = np.random.default_rng(7)
+        logits = rng.normal(size=(2, 8, 10)).astype(np.float32)
+        target = rng.integers(0, 10, size=(2, 8))
+        ours = F.perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=None)
+        theirs = ref_tm.functional.text.perplexity(torch.tensor(logits), torch.tensor(target, dtype=torch.long))
+        _close(ours, theirs.item())
+
+    def test_ignore_index(self):
+        import torch
+
+        rng = np.random.default_rng(8)
+        logits = rng.normal(size=(2, 8, 10)).astype(np.float32)
+        target = rng.integers(0, 10, size=(2, 8))
+        target[0, :3] = -100
+        ours = F.perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=-100)
+        theirs = ref_tm.functional.text.perplexity(
+            torch.tensor(logits), torch.tensor(target, dtype=torch.long), ignore_index=-100
+        )
+        _close(ours, theirs.item())
+
+    def test_modular_jit_update(self):
+        import jax
+
+        metric = Perplexity()
+        rng = np.random.default_rng(9)
+        logits = jnp.asarray(rng.normal(size=(2, 6, 12)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 12, size=(2, 6)))
+
+        state = metric.init_state()
+        update = jax.jit(metric.functional_update)
+        state = update(state, logits, target)
+        state = update(state, logits, target)
+        val = metric.functional_compute(state)
+        metric.update(logits, target)
+        metric.update(logits, target)
+        _close(val, metric.compute())
+
+
+class TestROUGE:
+    @pytest.mark.parametrize("accumulate", ["best", "avg"])
+    def test_parity(self, accumulate):
+        preds = ["My name is John", "The cat sat on the mat"]
+        target = [["Is your name John", "My name is indeed John"], ["A cat was on the mat", "The cat sat"]]
+        keys = ("rouge1", "rouge2", "rougeL")
+        ours = F.rouge_score(preds, target, accumulate=accumulate, rouge_keys=keys)
+        theirs = ref_tm.functional.rouge_score(preds, target, accumulate=accumulate, rouge_keys=keys)
+        for k in ours:
+            _close(ours[k], theirs[k])
+
+    def test_modular(self):
+        keys = ("rouge1", "rougeL")
+        metric = ROUGEScore(rouge_keys=keys)
+        ref = ref_tm.text.ROUGEScore(rouge_keys=keys)
+        metric.update("My name is John", "Is your name John")
+        ref.update("My name is John", "Is your name John")
+        metric.update(["The cat sat"], ["The cat sat on the mat"])
+        ref.update(["The cat sat"], ["The cat sat on the mat"])
+        ours, theirs = metric.compute(), ref.compute()
+        for k in ours:
+            _close(ours[k], theirs[k])
+
+
+class TestBERTScore:
+    @staticmethod
+    def _fake_embedder(sentences):
+        """Deterministic per-token embeddings keyed by token hash."""
+        max_len = max(len(s.split()) for s in sentences)
+        dim = 16
+        embs = np.zeros((len(sentences), max_len, dim), dtype=np.float32)
+        mask = np.zeros((len(sentences), max_len), dtype=bool)
+        for i, s in enumerate(sentences):
+            for j, tok in enumerate(s.lower().split()):
+                rng = np.random.default_rng(abs(hash(tok)) % (2**32))
+                embs[i, j] = rng.normal(size=dim)
+                mask[i, j] = True
+        return embs, mask
+
+    def test_identical_sentences_score_one(self):
+        out = F.bert_score(["hello world"], ["hello world"], user_model=self._fake_embedder)
+        _close(out["f1"], [1.0], atol=1e-4)
+
+    def test_orders_precision_recall(self):
+        out = F.bert_score(
+            ["the cat sat on the mat extra words here"], ["the cat sat on the mat"], user_model=self._fake_embedder
+        )
+        # extra pred tokens hurt precision, not recall
+        assert float(out["recall"][0]) > float(out["precision"][0])
+
+    def test_modular_accumulation(self):
+        from torchmetrics_tpu.text import BERTScore
+
+        metric = BERTScore(user_model=self._fake_embedder)
+        metric.update(["hello world"], ["hello world"])
+        metric.update(["a b c"], ["a b d"])
+        out = metric.compute()
+        assert out["f1"].shape == (2,)
+        _close(out["f1"][0], 1.0, atol=1e-4)
+        assert float(out["f1"][1]) < 1.0
+
+    def test_extended_hook_with_token_ids_and_idf(self):
+        """3-tuple hook: token-id-keyed IDF downweights ubiquitous tokens."""
+
+        def embedder_with_ids(sentences):
+            embs, mask = self._fake_embedder(sentences)
+            vocab = {}
+            ids = np.zeros(mask.shape, dtype=np.int64)
+            for i, s in enumerate(sentences):
+                for j, tok in enumerate(s.lower().split()):
+                    ids[i, j] = vocab.setdefault(tok, len(vocab) + 1)
+            return embs, mask, ids
+
+        preds = ["common rare1", "common rare2"]
+        target = ["common rare1", "common rare3"]
+        plain = F.bert_score(preds, target, user_model=embedder_with_ids, idf=False)
+        weighted = F.bert_score(preds, target, user_model=embedder_with_ids, idf=True)
+        # 'common' appears in every reference → near-zero idf → pair 2's score
+        # (which only matches on 'common') drops more under idf
+        assert float(weighted["f1"][1]) < float(plain["f1"][1])
+
+
+class TestInfoLM:
+    @staticmethod
+    def _fake_distribution(sentences):
+        vocab = 32
+        out = np.zeros((len(sentences), vocab), dtype=np.float64)
+        for i, s in enumerate(sentences):
+            rng = np.random.default_rng(abs(hash(s)) % (2**32))
+            row = rng.random(vocab) + 1e-3
+            out[i] = row / row.sum()
+        return out
+
+    @pytest.mark.parametrize(
+        ("measure", "kwargs"),
+        [
+            ("kl_divergence", {}),
+            ("alpha_divergence", {"alpha": 0.5}),
+            ("beta_divergence", {"beta": 0.5}),
+            ("ab_divergence", {"alpha": 0.5, "beta": 0.5}),
+            ("renyi_divergence", {"alpha": 0.5}),
+            ("l1_distance", {}),
+            ("l2_distance", {}),
+            ("l_infinity_distance", {}),
+            ("fisher_rao_distance", {}),
+        ],
+    )
+    def test_measures_match_reference_formulas(self, measure, kwargs):
+        import torch
+        from torchmetrics.functional.text.infolm import _InformationMeasure as RefIM
+
+        from torchmetrics_tpu.functional.text.infolm import _InformationMeasure
+
+        p = self._fake_distribution(["a", "b", "c"])
+        t = self._fake_distribution(["x", "y", "z"])
+        ours = _InformationMeasure(measure, **kwargs)(jnp.asarray(p), jnp.asarray(t))
+        theirs = RefIM(measure, **kwargs)(torch.tensor(p), torch.tensor(t))
+        _close(ours, theirs.numpy(), atol=1e-5)
+
+    def test_identical_distribution_zero(self):
+        out = F.infolm(["same"], ["same"], information_measure="l2_distance", user_model=self._fake_distribution)
+        _close(out, 0.0, atol=1e-6)
+
+
+class TestTextSync:
+    """Distributed: counter states psum over the mesh (SURVEY.md §2.17)."""
+
+    def test_wer_psum_matches_serial(self, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        metric = WordErrorRate()
+        # 8 shards, one sentence pair each — host-side counting, device reduce
+        preds = [f"word{i} common tail" for i in range(8)]
+        target = [f"word{i} common tails" for i in range(8)]
+        per_shard = [metric.init_state() for _ in range(8)]
+        for i in range(8):
+            per_shard[i] = metric.functional_update(per_shard[i], [preds[i]], [target[i]])
+        errors = jnp.stack([s["errors"] for s in per_shard])
+        totals = jnp.stack([s["total"] for s in per_shard])
+
+        @jax.jit
+        def reduce_and_compute(errors, totals):
+            def inner(e, t):
+                import jax.lax as lax
+
+                e = lax.psum(e.sum(), "batch")
+                t = lax.psum(t.sum(), "batch")
+                return e[None], t[None]
+
+            e, t = shard_map(
+                inner, mesh=mesh, in_specs=(P("batch"), P("batch")),
+                out_specs=(P("batch"), P("batch")),
+            )(errors, totals)
+            return e.sum() / 8 / (t.sum() / 8) * 1.0
+
+        synced = reduce_and_compute(errors, totals)
+        serial = F.word_error_rate(preds, target)
+        _close(synced, serial)

@@ -71,7 +71,25 @@ from torchmetrics_tpu.regression import (  # noqa: F401
     TweedieDevianceScore,
     WeightedMeanAbsolutePercentageError,
 )
-from torchmetrics_tpu import image  # noqa: F401
+from torchmetrics_tpu import image, text  # noqa: F401
+from torchmetrics_tpu.text import (  # noqa: F401
+    BERTScore,
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    InfoLM,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
 from torchmetrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
     FrechetInceptionDistance,

@@ -1,0 +1,36 @@
+"""Functional text metrics (reference: functional/text/__init__.py)."""
+from torchmetrics_tpu.functional.text.asr import (  # noqa: F401
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.functional.text.bert import bert_score  # noqa: F401
+from torchmetrics_tpu.functional.text.bleu import bleu_score, sacre_bleu_score  # noqa: F401
+from torchmetrics_tpu.functional.text.chrf import chrf_score  # noqa: F401
+from torchmetrics_tpu.functional.text.edit import edit_distance, extended_edit_distance  # noqa: F401
+from torchmetrics_tpu.functional.text.infolm import infolm  # noqa: F401
+from torchmetrics_tpu.functional.text.perplexity import perplexity  # noqa: F401
+from torchmetrics_tpu.functional.text.rouge import rouge_score  # noqa: F401
+from torchmetrics_tpu.functional.text.squad import squad  # noqa: F401
+from torchmetrics_tpu.functional.text.ter import translation_edit_rate  # noqa: F401
+
+__all__ = [
+    "bert_score",
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "extended_edit_distance",
+    "infolm",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

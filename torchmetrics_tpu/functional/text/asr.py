@@ -1,0 +1,133 @@
+"""Speech-recognition error rates: WER, CER, MER, WIL, WIP.
+
+Reference: functional/text/{wer,cer,mer,wil,wip}.py — each is host-side
+Levenshtein counting into two/three scalar accumulators, divided at compute.
+States are jnp scalars so the modular classes psum-sync them over the mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+
+
+# ------------------------------------------------------------------------- WER
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed word-level edit distance + total reference words (reference wer.py:23-48)."""
+    preds, target = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WER = (S + D + I) / N over the reference words (reference wer.py:51-87)."""
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)
+
+
+# ------------------------------------------------------------------------- CER
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Char-level edit distance + total reference chars (reference cer.py:22-48)."""
+    preds, target = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """CER over reference characters (reference cer.py:51-87)."""
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)
+
+
+# ------------------------------------------------------------------------- MER
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Edit distance + max(len) totals (reference mer.py:23-50)."""
+    preds, target = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate (reference mer.py:66-91)."""
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)
+
+
+# --------------------------------------------------------------------- WIL/WIP
+def _word_info_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Negated hit count + per-side word totals.
+
+    Reference wil.py:22-54 / wip.py:22-54: accumulates ``edit - max_len`` (the
+    negative of the aligned-hit count; squared ratio cancels the sign),
+    reference word total and prediction word total.
+    """
+    preds, target = _validate_text_inputs(preds, target)
+    errors = 0.0
+    total = 0.0
+    target_total = 0.0
+    preds_total = 0.0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return (
+        jnp.asarray(errors - total, dtype=jnp.float32),
+        jnp.asarray(target_total, dtype=jnp.float32),
+        jnp.asarray(preds_total, dtype=jnp.float32),
+    )
+
+
+def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIL = 1 - (H/N_ref)(H/N_hyp) (reference wil.py:57-94)."""
+    errors, target_total, preds_total = _word_info_update(preds, target)
+    return _wil_compute(errors, target_total, preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIP = (H/N_ref)(H/N_hyp) (reference wip.py:57-93)."""
+    errors, target_total, preds_total = _word_info_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

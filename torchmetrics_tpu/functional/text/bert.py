@@ -1,0 +1,210 @@
+"""BERTScore with a pluggable embedding model.
+
+Reference: functional/text/bert.py:243-447 — contextual embeddings of candidate
+and reference sentences, token-pair cosine similarities, greedy matching with
+optional IDF weighting.
+
+TPU design: the *model* is a hook. `user_model` is any callable mapping a list
+of sentences to ``(embeddings [N, L, D], mask [N, L])`` — optionally the
+extended triple ``(embeddings, mask, token_ids [N, L])`` so IDF weights align
+with subword positions (the reference's own escape hatch, bert.py:76-77 +
+examples/bert_score-own_model.py) — typically a flax encoder jitted once and
+shared. When `user_model` is omitted we fall back
+to a HF `transformers` AutoModel on host torch if that wheel + weights are
+available locally (no downloads are attempted). All post-model math — cosine
+similarity matrices, greedy max matching, IDF weighting — is pure jnp and runs
+on device, batched over sentence pairs with static padded shapes.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _simple_tokenize(text: str) -> List[str]:
+    return text.lower().split()
+
+
+def _compute_idf(corpus: Sequence[str], tokenizer: Callable[[str], List[str]]) -> Dict[str, float]:
+    """Smoothed IDF over the reference corpus (reference bert.py:202-214)."""
+    num_docs = len(corpus)
+    df: Counter = Counter()
+    for doc in corpus:
+        df.update(set(tokenizer(doc)))
+    return {tok: math.log((num_docs + 1) / (cnt + 1)) for tok, cnt in df.items()}
+
+
+def _greedy_cosine_scores(
+    pred_emb: Array,  # [Lp, D]
+    pred_mask: Array,  # [Lp]
+    target_emb: Array,  # [Lt, D]
+    target_mask: Array,  # [Lt]
+    pred_idf: Array,  # [Lp]
+    target_idf: Array,  # [Lt]
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matched precision/recall/f1 for one sentence pair — pure jnp.
+
+    Reference bert.py `_get_precision_recall_f1`: every pred token greedily
+    matches its most-similar target token (precision side) and vice versa
+    (recall side); matches are IDF-weighted.
+    """
+    pred_norm = pred_emb / jnp.maximum(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), 1e-12)
+    target_norm = target_emb / jnp.maximum(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), 1e-12)
+    sim = pred_norm @ target_norm.T  # [Lp, Lt] — MXU matmul
+    neg = jnp.asarray(-1e9, sim.dtype)
+    sim = jnp.where(pred_mask[:, None] & target_mask[None, :], sim, neg)
+
+    pred_w = pred_idf * pred_mask
+    target_w = target_idf * target_mask
+    precision = jnp.sum(jnp.max(sim, axis=1) * pred_w) / jnp.maximum(jnp.sum(pred_w), 1e-12)
+    recall = jnp.sum(jnp.max(sim, axis=0) * target_w) / jnp.maximum(jnp.sum(target_w), 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1
+
+
+def _default_transformers_embedder(
+    model_name_or_path: str, max_length: int
+) -> Callable[[List[str]], Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Host-side HF encoder (torch CPU), local weights only (bert.py:359-360).
+
+    Returns the extended 3-tuple ``(embeddings, mask, token_ids)``; special
+    tokens ([CLS]/[SEP]/pad) are masked out of the matching, mirroring the
+    reference's `_process_attention_mask_for_special_tokens`
+    (helper_embedding_metric.py).
+    """
+    try:
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+    except ImportError as err:  # pragma: no cover
+        raise ModuleNotFoundError(
+            "`bert_score` needs either a `user_model` callable or the `transformers` package with local weights."
+        ) from err
+    tok = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+    model = AutoModel.from_pretrained(model_name_or_path, local_files_only=True)
+    model.eval()
+    special_ids = set(tok.all_special_ids)
+
+    def embed(sentences: List[str]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        with torch.no_grad():
+            enc = tok(sentences, return_tensors="pt", padding=True, truncation=True, max_length=max_length)
+            out = model(**enc).last_hidden_state
+        ids = enc["input_ids"].numpy()
+        mask = enc["attention_mask"].numpy().astype(bool)
+        for sid in special_ids:
+            mask &= ids != sid
+        return out.numpy(), mask, ids
+
+    return embed
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_model: Optional[Callable[[List[str]], Tuple[Any, Any]]] = None,
+    user_tokenizer: Optional[Callable[[str], List[str]]] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    max_length: int = 512,
+    batch_size: int = 64,
+    rescale_with_baseline: bool = False,
+    baseline: Optional[Array] = None,
+) -> Dict[str, Array]:
+    """BERTScore precision/recall/f1 (reference bert.py:243-447).
+
+    Args:
+        preds: candidate sentence(s).
+        target: reference sentence(s).
+        user_model: callable ``sentences -> (embeddings [N,L,D], mask [N,L])``;
+            the TPU-native path — supply a jitted flax encoder.
+        model_name_or_path: HF model id/path for the fallback host embedder.
+        idf: weight token matches by reference-corpus IDF.
+        rescale_with_baseline: linear rescale ``(s - b) / (1 - b)`` with a
+            user-supplied ``baseline`` triple (the reference downloads baseline
+            files; here they must be passed in).
+    """
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError(f"Number of predicted and reference sentences must match: {len(preds_l)} != {len(target_l)}")
+    if not preds_l:
+        return {"precision": jnp.zeros(0), "recall": jnp.zeros(0), "f1": jnp.zeros(0)}
+
+    if user_model is None:
+        user_model = _default_transformers_embedder(model_name_or_path or "roberta-large", max_length)
+
+    # hook protocol: (emb, mask) or the extended (emb, mask, token_ids);
+    # token ids keep IDF weights aligned with subword positions.
+    pred_out = user_model(preds_l)
+    target_out = user_model(target_l)
+    pred_ids = np.asarray(pred_out[2]) if len(pred_out) > 2 else None
+    target_ids = np.asarray(target_out[2]) if len(target_out) > 2 else None
+    pred_emb = jnp.asarray(pred_out[0])
+    target_emb = jnp.asarray(target_out[0])
+    pred_mask = jnp.asarray(pred_out[1], dtype=bool)
+    target_mask = jnp.asarray(target_out[1], dtype=bool)
+
+    if idf:
+        if target_ids is not None and pred_ids is not None:
+            # id-keyed IDF over the reference corpus (reference
+            # helper_embedding_metric.py:232: tokens_idf from the model's ids),
+            # broadcast onto each position via its own token id
+            tmask = np.asarray(target_out[1], dtype=bool)
+            num_docs = len(target_l)
+            df: Counter = Counter()
+            for row, mrow in zip(target_ids, tmask):
+                df.update(set(row[mrow].tolist()))
+            default_idf = math.log(num_docs + 1)
+            idf_map_ids = {tid: math.log((num_docs + 1) / (cnt + 1)) for tid, cnt in df.items()}
+
+            def ids_to_idf(ids_mat: np.ndarray) -> np.ndarray:
+                out = np.full(ids_mat.shape, default_idf, dtype=np.float32)
+                for (i, j), tid in np.ndenumerate(ids_mat):
+                    out[i, j] = idf_map_ids.get(int(tid), default_idf)
+                return out
+
+            pred_idf = jnp.asarray(ids_to_idf(pred_ids))
+            target_idf = jnp.asarray(ids_to_idf(target_ids))
+        else:
+            # 2-tuple hook: fall back to word-level IDF, positions assumed to
+            # follow `user_tokenizer` order (document the contract)
+            tok_fn = user_tokenizer or _simple_tokenize
+            idf_map = _compute_idf(target_l, tok_fn)
+            max_lp = pred_emb.shape[1]
+            max_lt = target_emb.shape[1]
+
+            def idf_row(sent: str, width: int) -> np.ndarray:
+                toks = tok_fn(sent)[:width]
+                row = np.ones(width, dtype=np.float32)
+                for i, t in enumerate(toks):
+                    row[i] = idf_map.get(t, math.log(len(target_l) + 1))
+                return row
+
+            pred_idf = jnp.asarray(np.stack([idf_row(s, max_lp) for s in preds_l]))
+            target_idf = jnp.asarray(np.stack([idf_row(s, max_lt) for s in target_l]))
+    else:
+        pred_idf = jnp.ones(pred_emb.shape[:2])
+        target_idf = jnp.ones(target_emb.shape[:2])
+
+    import jax
+
+    p, r, f = jax.vmap(_greedy_cosine_scores)(pred_emb, pred_mask, target_emb, target_mask, pred_idf, target_idf)
+    if rescale_with_baseline:
+        if baseline is None:
+            raise ValueError(
+                "`rescale_with_baseline` requires a `baseline` array [precision_b, recall_b, f1_b]"
+                " (the reference downloads baseline files; zero-egress builds must pass them explicitly)."
+            )
+        b = jnp.asarray(baseline)
+        p = (p - b[0]) / (1 - b[0])
+        r = (r - b[1]) / (1 - b[1])
+        f = (f - b[2]) / (1 - b[2])
+    return {"precision": p, "recall": r, "f1": f}

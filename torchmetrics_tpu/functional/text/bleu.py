@@ -1,0 +1,274 @@
+"""BLEU and SacreBLEU.
+
+Reference: functional/text/bleu.py (clipped n-gram precision + brevity penalty,
+corpus-level counter states) and functional/text/sacre_bleu.py (same update with
+the sacrebleu tokenizer family; tokenizers re-implemented here from the
+sacrebleu spec: none/13a/intl/char/zh; ja/ko-mecab and flores require external
+tokenizer wheels and are gated).
+
+TPU design: n-gram counting is host work (hash maps over tuples of words — no
+tensor representation beats a Counter here, and the reference agrees); the
+states (`numerator`, `denominator`, `preds_len`, `target_len`) are dense jnp
+vectors of shape (n_gram,), psum-synced across the mesh, and the compute stage
+is pure jnp (log/exp/brevity penalty) so it can run under jit.
+"""
+from __future__ import annotations
+
+import re
+import unicodedata
+from collections import Counter
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text.helper import _count_ngrams
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Default whitespace tokenizer (reference bleu.py:47-57)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: Array,
+    denominator: Array,
+    preds_len: Array,
+    target_len: Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Accumulate clipped n-gram matches (reference bleu.py:60-105).
+
+    Returns updated (preds_len, target_len, numerator, denominator) — unlike the
+    reference we cannot mutate tensors in place, so all four come back.
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+    num = [0] * n_gram
+    den = [0] * n_gram
+    p_len = 0
+    t_len = 0
+    for pred, targets in zip(preds_tok, target_tok):
+        p_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        t_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter = _count_ngrams(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngrams(tgt, n_gram)
+        clipped = preds_counter & target_counter
+        for ngram, cnt in clipped.items():
+            num[len(ngram) - 1] += cnt
+        for ngram, cnt in preds_counter.items():
+            den[len(ngram) - 1] += cnt
+    return (
+        preds_len + p_len,
+        target_len + t_len,
+        numerator + jnp.asarray(num, dtype=numerator.dtype),
+        denominator + jnp.asarray(den, dtype=denominator.dtype),
+    )
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Geometric mean of clipped precisions × brevity penalty (bleu.py:108-146).
+
+    Pure jnp, branch-free where the value depends on data (jit-safe): the
+    zero-match early-out and BP condition become `jnp.where`.
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(
+            jnp.where(denominator[0] > 0, numerator[0] / jnp.maximum(denominator[0], 1), 0.0)
+        )
+    else:
+        precision_scores = numerator / jnp.maximum(denominator, 1)
+    log_precision = jnp.asarray(weights) * jnp.log(jnp.maximum(precision_scores, 1e-30))
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity_penalty = jnp.where(
+        preds_len > target_len, 1.0, jnp.exp(1 - (target_len / jnp.maximum(preds_len, 1e-9)))
+    )
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Corpus BLEU of machine-translated text (reference bleu.py:149-209)."""
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+    preds_len, target_len, numerator, denominator = _bleu_score_update(
+        preds_, target_, numerator, denominator, preds_len, target_len, n_gram, _tokenize_fn
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)
+
+
+# ----------------------------------------------------------------- SacreBLEU
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+# CJK codepoint ranges the `zh` tokenizer splits on (sacrebleu tokenizer_zh spec)
+_UCODE_RANGES = (
+    ("㐀", "䶵"), ("一", "龥"), ("龦", "龻"),
+    ("豈", "鶴"), ("侮", "頻"), ("並", "龎"),
+    ("\U00020000", "\U0002a6d6"), ("\U0002f800", "\U0002fa1d"),
+    ("＀", "￯"), ("⺀", "⻿"), ("　", "〿"),
+    ("㇀", "㇯"), ("⼀", "⿟"), ("⿰", "⿿"),
+    ("㄀", "ㄯ"), ("ㆠ", "ㆿ"), ("︐", "︟"),
+    ("︰", "﹏"), ("☀", "⛿"), ("✀", "➿"),
+    ("㈀", "㋿"), ("㌀", "㏿"),
+)
+
+_13A_REGEX = (
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+)
+
+
+class _SacreBLEUTokenizer:
+    """The sacrebleu tokenizer family (reference sacre_bleu.py:98-455).
+
+    The `intl` tokenizer is implemented with unicodedata category checks
+    (`P*`/`S*`/`N*`) instead of the `regex` wheel's \\p classes.
+    """
+
+    def __init__(self, tokenize: str, lowercase: bool = False) -> None:
+        self._check_tokenizers_validity(tokenize)
+        self.tokenize_fn = getattr(self, "_tokenize_" + {"none": "base", "13a": "13a", "zh": "zh", "intl": "international", "char": "char"}[tokenize])
+        self.lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        return self._lower(self.tokenize_fn(line), self.lowercase).split()
+
+    @classmethod
+    def tokenize(cls, line: str, tokenize: str, lowercase: bool = False) -> Sequence[str]:
+        cls._check_tokenizers_validity(tokenize)
+        fn = getattr(cls, "_tokenize_" + {"none": "base", "13a": "13a", "zh": "zh", "intl": "international", "char": "char"}[tokenize])
+        return cls._lower(fn(line), lowercase).split()
+
+    @classmethod
+    def _tokenize_regex(cls, line: str) -> str:
+        for _re, repl in _13A_REGEX:
+            line = _re.sub(repl, line)
+        return " ".join(line.split())
+
+    @staticmethod
+    def _is_chinese_char(uchar: str) -> bool:
+        return any(start <= uchar <= end for start, end in _UCODE_RANGES)
+
+    @classmethod
+    def _tokenize_base(cls, line: str) -> str:
+        return line
+
+    @classmethod
+    def _tokenize_13a(cls, line: str) -> str:
+        line = line.replace("<skipped>", "").replace("-\n", "").replace("\n", " ")
+        if "&" in line:
+            line = line.replace("&quot;", '"').replace("&amp;", "&").replace("&lt;", "<").replace("&gt;", ">")
+        return cls._tokenize_regex(f" {line} ")
+
+    @classmethod
+    def _tokenize_zh(cls, line: str) -> str:
+        line = line.strip()
+        parts = []
+        for ch in line:
+            if cls._is_chinese_char(ch):
+                parts.append(f" {ch} ")
+            else:
+                parts.append(ch)
+        return cls._tokenize_regex("".join(parts))
+
+    @classmethod
+    def _tokenize_international(cls, line: str) -> str:
+        out = []
+        chars = list(line)
+        for i, ch in enumerate(chars):
+            cat = unicodedata.category(ch)
+            if cat.startswith("P"):
+                prev_num = i > 0 and unicodedata.category(chars[i - 1]).startswith("N")
+                next_num = i + 1 < len(chars) and unicodedata.category(chars[i + 1]).startswith("N")
+                # punctuation sticks to digits on both sides (e.g. 1,000 / 3.14)
+                if prev_num and next_num:
+                    out.append(ch)
+                else:
+                    out.append(f" {ch} ")
+            elif cat.startswith("S"):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        return " ".join("".join(out).split())
+
+    @classmethod
+    def _tokenize_char(cls, line: str) -> str:
+        return " ".join(ch for ch in line)
+
+    @staticmethod
+    def _lower(line: str, lowercase: bool) -> str:
+        return line.lower() if lowercase else line
+
+    @classmethod
+    def _check_tokenizers_validity(cls, tokenize: str) -> None:
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(
+                f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}."
+                " (`ja-mecab`/`ko-mecab`/`flores*` require external tokenizer wheels not bundled here.)"
+            )
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """SacreBLEU: BLEU with the standardized tokenizers (sacre_bleu.py:458-532)."""
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+    tokenize_fn = partial(_SacreBLEUTokenizer.tokenize, tokenize=tokenize, lowercase=lowercase)
+    preds_len, target_len, numerator, denominator = _bleu_score_update(
+        preds, [[t] if isinstance(t, str) else t for t in target],
+        numerator, denominator, preds_len, target_len, n_gram, tokenize_fn,
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

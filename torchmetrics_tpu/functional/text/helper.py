@@ -1,0 +1,146 @@
+"""Shared host-side text helpers: Levenshtein DP, n-gram counting.
+
+Reference: functional/text/helper.py:54-295 (`_LevenshteinEditDistance` with row
+caching) and functional/text/bleu.py:19-45 (`_count_ngram`). TPU stance: string
+processing is inherently host work in the reference too — the device only ever
+sees the scalar counters these helpers produce. We therefore keep a lean pure-
+Python DP (no torch/Tensor round-trips per token, unlike the reference) and
+return plain ints that the callers fold into jnp accumulator states.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple, Union
+
+_INT_INFINITY = int(1e16)
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1) -> int:
+    """Word/char-level Levenshtein distance (two-row DP).
+
+    Reference functional/text/helper.py:297-320 (`_edit_distance` free function).
+    """
+    prev = list(range(len(reference_tokens) + 1))
+    for i, p_tok in enumerate(prediction_tokens, start=1):
+        cur = [i] + [0] * len(reference_tokens)
+        for j, r_tok in enumerate(reference_tokens, start=1):
+            sub = prev[j - 1] + (substitution_cost if p_tok != r_tok else 0)
+            cur[j] = min(sub, prev[j] + 1, cur[j - 1] + 1)
+        prev = cur
+    return prev[-1]
+
+
+class _LevenshteinEditDistance:
+    """Edit distance against a fixed reference with full trace, for TER shifts.
+
+    Reference functional/text/helper.py:54-295, itself following sacrebleu's
+    lib_ter: a beam-constrained DP (width 25 around the length-ratio pseudo-
+    diagonal) with tie preference substitute/keep → consume-prediction →
+    consume-reference, whose backtracked trace is then *flipped* so that in
+    the returned string ``'i'`` consumes a hypothesis token and ``'d'``
+    consumes a reference token. Exact tie-breaking matters: the TER shift
+    heuristics read alignments off this trace, so every choice here mirrors
+    the reference (we only drop its row cache — plain host DP is fast enough
+    at sentence scale).
+
+    ``__call__(pred_tokens) -> (distance, trace)``; trace chars:
+    ``'e'`` keep, ``'s'`` substitute, ``'i'`` hyp-consume, ``'d'`` ref-consume.
+    """
+
+    _BEAM_WIDTH = 25
+    _INF = _INT_INFINITY
+
+    def __init__(self, reference_tokens: List[str], op_insert: int = 1, op_delete: int = 1, op_substitute: int = 1) -> None:
+        self.reference_tokens = reference_tokens
+        self.reference_len = len(reference_tokens)
+        self.op_insert = op_insert
+        self.op_delete = op_delete
+        self.op_substitute = op_substitute
+
+    def __call__(self, prediction_tokens: List[str]) -> Tuple[int, str]:
+        import math
+
+        m, n = len(prediction_tokens), self.reference_len
+        # cells: (cost, op) with op in pre-flip convention:
+        # 'd' consumes a prediction token (row step), 'i' a reference token
+        dist = [[(self._INF, "?")] * (n + 1) for _ in range(m + 1)]
+        dist[0] = [(j * self.op_insert, "i") for j in range(n + 1)]
+        length_ratio = n / m if prediction_tokens else 1.0
+        beam = (
+            math.ceil(length_ratio / 2 + self._BEAM_WIDTH)
+            if length_ratio / 2 > self._BEAM_WIDTH
+            else self._BEAM_WIDTH
+        )
+        for i in range(1, m + 1):
+            pseudo_diag = math.floor(i * length_ratio)
+            min_j = max(0, pseudo_diag - beam)
+            max_j = n + 1 if i == m else min(n + 1, pseudo_diag + beam)
+            p_tok = prediction_tokens[i - 1]
+            for j in range(min_j, max_j):
+                if j == 0:
+                    dist[i][j] = (dist[i - 1][j][0] + self.op_delete, "d")
+                else:
+                    if p_tok == self.reference_tokens[j - 1]:
+                        cost_sub, op_sub = self.op_nothing, "e"
+                    else:
+                        cost_sub, op_sub = self.op_substitute, "s"
+                    best = (dist[i - 1][j - 1][0] + cost_sub, op_sub)
+                    cand = dist[i - 1][j][0] + self.op_delete
+                    if cand < best[0]:
+                        best = (cand, "d")
+                    cand = dist[i][j - 1][0] + self.op_insert
+                    if cand < best[0]:
+                        best = (cand, "i")
+                    dist[i][j] = best
+        # backtrack, then flip i<->d (rewrite b->a instead of a->b;
+        # reference helper.py:353-379)
+        trace = []
+        i, j = m, n
+        while i > 0 or j > 0:
+            op = dist[i][j][1]
+            trace.append(op)
+            if op in ("e", "s"):
+                i, j = i - 1, j - 1
+            elif op == "d":
+                i -= 1
+            elif op == "i":
+                j -= 1
+            else:  # beam left this cell unreached; cannot happen on valid paths
+                raise RuntimeError("edit-distance backtrack escaped the beam")
+        flip = {"i": "d", "d": "i"}
+        return dist[m][n][0], "".join(flip.get(op, op) for op in reversed(trace))
+
+    @property
+    def op_nothing(self) -> int:
+        return 0
+
+
+def _count_ngrams(tokens: Sequence, max_n: int) -> Counter:
+    """All n-gram counts for n in [1, max_n] (reference bleu.py:26-45)."""
+    counter: Counter = Counter()
+    for n in range(1, max_n + 1):
+        for j in range(len(tokens) - n + 1):
+            counter[tuple(tokens[j : j + n])] += 1
+    return counter
+
+
+def _ngram_counts_by_order(tokens: Sequence, max_n: int) -> Dict[int, Counter]:
+    """Per-order n-gram counts {n: Counter} (reference chrf.py:134-149)."""
+    out: Dict[int, Counter] = {n: Counter() for n in range(1, max_n + 1)}
+    for n in range(1, max_n + 1):
+        c = out[n]
+        for j in range(len(tokens) - n + 1):
+            c[tuple(tokens[j : j + n])] += 1
+    return out
+
+
+def _validate_text_inputs(
+    preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+) -> Tuple[Sequence[str], Sequence[str]]:
+    preds = [preds] if isinstance(preds, str) else list(preds)
+    target = [target] if isinstance(target, str) else list(target)
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    return preds, target

@@ -1,0 +1,199 @@
+"""InfoLM — information measures over masked-LM token distributions.
+
+Reference: functional/text/infolm.py (657 LoC; Colombo et al. 2021). A masked
+LM assigns each sentence a distribution over the vocabulary (IDF- or
+length-weighted average of per-position masked predictions); the metric is an
+information measure between the candidate and reference distributions.
+
+TPU design: all nine information measures are pure-jnp vectorized functions
+(batched over sentence pairs, vocab axis reduced on device). Getting the
+distributions is the model's job: pass `user_model` — a callable mapping a
+list of sentences to a ``[N, vocab]`` distribution matrix (e.g. a jitted flax
+MLM pipeline) — or rely on the host `transformers` fallback with local
+weights (zero-egress: no downloads are attempted).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """Vectorized information measures (reference infolm.py:72-296).
+
+    ``__call__(preds_distribution [N,V], target_distribution [N,V]) -> [N]``.
+    """
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` expected one of {_ALLOWED_INFORMATION_MEASURE}, got {information_measure}"
+            )
+        self.information_measure = information_measure
+        needs_alpha = ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        if information_measure in needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+        if information_measure in ("beta_divergence", "ab_divergence") and not isinstance(beta, float):
+            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and (not isinstance(alpha, float) or alpha in (0, 1)):
+            raise ValueError(
+                f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}."
+            )
+        if information_measure == "beta_divergence" and (not isinstance(beta, float) or beta in (0, -1)):
+            raise ValueError(
+                f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}."
+            )
+        if information_measure == "ab_divergence" and (
+            alpha is None or beta is None or 0 in (alpha, beta, alpha + beta)
+        ):
+            raise ValueError(
+                f"Parameters `alpha`, `beta` and their sum are expected to be differened from 0 for {information_measure}."
+            )
+        if information_measure == "renyi_divergence" and (not isinstance(alpha, float) or alpha == 1):
+            raise ValueError(f"Parameter `alpha` is expected to be float differened from 1 for {information_measure}.")
+        self.alpha = alpha or 0.0
+        self.beta = beta or 0.0
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        fn = getattr(self, f"_calculate_{self.information_measure}")
+        return jnp.nan_to_num(fn(preds_distribution, target_distribution))
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        alpha_denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / alpha_denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * t), axis=-1), 0.0, 1.0))
+
+
+def _default_transformers_mlm_distribution(
+    model_name_or_path: str, max_length: int, idf: bool
+) -> Callable[[List[str]], np.ndarray]:
+    """Host-side masked-LM distribution builder (reference infolm.py:367-462)."""
+    try:
+        import torch
+        from transformers import AutoModelForMaskedLM, AutoTokenizer
+    except ImportError as err:  # pragma: no cover
+        raise ModuleNotFoundError(
+            "`infolm` needs either a `user_model` callable or the `transformers` package with local weights."
+        ) from err
+    tok = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+    model = AutoModelForMaskedLM.from_pretrained(model_name_or_path, local_files_only=True)
+    model.eval()
+
+    def distribution(sentences: List[str]) -> np.ndarray:
+        # IDF over this call's corpus (the functional path scopes IDF to its
+        # inputs; dataset-level IDF is the class metric's job — reference
+        # infolm.py:580): weight each masked position's prediction by the
+        # IDF of the token it covers (reference infolm.py:409-419).
+        df: dict = {}
+        encodings = []
+        with torch.no_grad():
+            for sent in sentences:
+                enc = tok(sent, return_tensors="pt", truncation=True, max_length=max_length)
+                encodings.append(enc["input_ids"][0])
+            if idf:
+                import math as _math
+
+                for ids in encodings:
+                    for t in set(ids.tolist()):
+                        df[t] = df.get(t, 0) + 1
+                idf_map = {t: _math.log((len(sentences) + 1) / (cnt + 1)) for t, cnt in df.items()}
+            out_rows = []
+            for ids in encodings:
+                n = ids.shape[0]
+                # mask each non-special position in turn, weighted-average predictions
+                rows, weights = [], []
+                for pos in range(n):
+                    if ids[pos].item() in tok.all_special_ids:
+                        continue
+                    masked = ids.clone()
+                    masked[pos] = tok.mask_token_id
+                    logits = model(masked.unsqueeze(0)).logits[0, pos]
+                    rows.append(torch.softmax(logits, dim=-1))
+                    weights.append(idf_map[ids[pos].item()] if idf else 1.0)
+                if not rows:
+                    rows = [torch.full((model.config.vocab_size,), 1.0 / model.config.vocab_size)]
+                    weights = [1.0]
+                w = torch.tensor(weights).unsqueeze(1)
+                out_rows.append(((torch.stack(rows) * w).sum(0) / w.sum()).numpy())
+        return np.stack(out_rows)
+
+    return distribution
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    max_length: Optional[int] = None,
+    user_model: Optional[Callable[[List[str]], Any]] = None,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM score (reference infolm.py:545-657)."""
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError(f"Number of predicted and reference sentences must match: {len(preds_l)} != {len(target_l)}")
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    if user_model is None:
+        user_model = _default_transformers_mlm_distribution(model_name_or_path, max_length or 512, idf)
+    preds_distribution = jnp.asarray(user_model(preds_l)) ** (1.0 / temperature)
+    preds_distribution = preds_distribution / jnp.sum(preds_distribution, axis=-1, keepdims=True)
+    target_distribution = jnp.asarray(user_model(target_l)) ** (1.0 / temperature)
+    target_distribution = target_distribution / jnp.sum(target_distribution, axis=-1, keepdims=True)
+    sentence_scores = measure(preds_distribution, target_distribution)
+    corpus = sentence_scores.mean()
+    if return_sentence_level_score:
+        return corpus, sentence_scores
+    return corpus

@@ -1,0 +1,71 @@
+"""Perplexity — the fully device-native text metric.
+
+Reference: functional/text/perplexity.py:65-126. TPU design: pure jnp with
+`log_softmax` + `take_along_axis` (numerically better than the reference's
+softmax→index→log and a single fused XLA kernel); `ignore_index` handled by a
+mask so shapes stay static under jit. The two outputs are psum-able scalars.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Shape/type validation (reference perplexity.py:21-63)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Σ(-log p[target]) and token count (reference perplexity.py:66-111), jit-safe."""
+    _check_shape_and_type_consistency(preds, target)
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    target_flat = target.reshape(-1)
+
+    if ignore_index is not None:
+        mask = target_flat != ignore_index
+        target_flat = jnp.where(mask, target_flat, 0)
+    else:
+        mask = jnp.ones_like(target_flat, dtype=bool)
+
+    token_log_probs = jnp.take_along_axis(log_probs, target_flat[:, None], axis=1).squeeze(1)
+    total_log_probs = -jnp.sum(token_log_probs * mask)
+    count = jnp.sum(mask)
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    """exp of the mean negative log-likelihood (reference perplexity.py:114-126)."""
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language model's token predictions (reference perplexity.py:129-143).
+
+    Args:
+        preds: logits of shape [batch_size, seq_len, vocab_size]
+        target: token ids of shape [batch_size, seq_len]
+        ignore_index: target id excluded from the score (e.g. padding)
+    """
+    total, count = _perplexity_update(jnp.asarray(preds), jnp.asarray(target), ignore_index)
+    return _perplexity_compute(total, count)

@@ -1,0 +1,259 @@
+"""ROUGE score (rouge-1..9, rougeL, rougeLsum).
+
+Reference: functional/text/rouge.py (524 LoC), itself following the official
+google-research rouge_scorer. Per-sentence precision/recall/fmeasure with
+multi-reference accumulation ('best' by fmeasure of the first key / 'avg').
+
+Host-side text work; per-sentence scores are stacked into jnp arrays so the
+modular class can keep them as `cat` list states and mean-reduce on compute.
+Sentence splitting for Lsum uses a regex splitter (the reference requires the
+`nltk` wheel, rouge.py:62-71 — not bundled here); a custom splitter can be
+passed through the `sentence_splitter` hook.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
+    "rouge1": 1, "rouge2": 2, "rouge3": 3, "rouge4": 4, "rouge5": 5,
+    "rouge6": 6, "rouge7": 7, "rouge8": 8, "rouge9": 9, "rougeL": "L", "rougeLsum": "Lsum",
+}
+ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
+
+_SENTENCE_RE = re.compile(r"(?<=[.!?])\s+")
+
+
+def _split_sentence(x: str) -> Sequence[str]:
+    """Regex sentence splitter (stand-in for nltk.sent_tokenize, rouge.py:62-71)."""
+    x = re.sub("<n>", "", x)
+    return [s for s in _SENTENCE_RE.split(x.strip()) if s]
+
+
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, Array]:
+    """precision/recall/fmeasure triple (reference rouge.py:74-92)."""
+    precision = hits_or_lcs / pred_len
+    recall = hits_or_lcs / target_len
+    if precision == recall == 0.0:
+        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+    fmeasure = 2 * precision * recall / (precision + recall)
+    return {"precision": jnp.asarray(precision), "recall": jnp.asarray(recall), "fmeasure": jnp.asarray(fmeasure)}
+
+
+def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List[List[int]]:
+    table = [[0] * (len(target_tokens) + 1) for _ in range(len(pred_tokens) + 1)]
+    for i in range(1, len(pred_tokens) + 1):
+        for j in range(1, len(target_tokens) + 1):
+            if pred_tokens[i - 1] == target_tokens[j - 1]:
+                table[i][j] = table[i - 1][j - 1] + 1
+            else:
+                table[i][j] = max(table[i - 1][j], table[i][j - 1])
+    return table
+
+
+def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    """Length of the longest common subsequence (reference rouge.py:95-115)."""
+    return _lcs_table(pred_tokens, target_tokens)[-1][-1]
+
+
+def _backtracked_lcs_indices(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List[int]:
+    """Indices into target of one LCS (reference rouge.py:118-141)."""
+    table = _lcs_table(pred_tokens, target_tokens)
+    i, j = len(pred_tokens), len(target_tokens)
+    indices: List[int] = []
+    while i > 0 and j > 0:
+        if pred_tokens[i - 1] == target_tokens[j - 1]:
+            indices.append(j - 1)
+            i -= 1
+            j -= 1
+        elif table[i - 1][j] >= table[i][j - 1]:
+            i -= 1
+        else:
+            j -= 1
+    return indices[::-1]
+
+
+def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> Sequence[str]:
+    """Tokens of the union-LCS of a target sentence vs all pred sentences (rouge.py:144-163)."""
+    union: set = set()
+    for pred_tokens in pred_tokens_list:
+        union |= set(_backtracked_lcs_indices(pred_tokens, target_tokens))
+    return [target_tokens[i] for i in sorted(union)]
+
+
+def _normalize_and_tokenize_text(
+    text: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Sequence[str]:
+    """Lowercase alnum normalization + split + optional stemming (rouge.py:166-199)."""
+    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
+    if stemmer:
+        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
+    return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
+
+
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, Array]:
+    """Rouge-N triple (reference rouge.py:202-225)."""
+
+    def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
+        ngrams: Counter = Counter()
+        for ngram in (tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)):
+            ngrams[ngram] += 1
+        return ngrams
+
+    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
+    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
+    if 0 in (pred_len, target_len):
+        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+    hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
+    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
+
+
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, Array]:
+    """Rouge-L triple (reference rouge.py:228-241)."""
+    pred_len, target_len = len(pred), len(target)
+    if 0 in (pred_len, target_len):
+        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+    return _compute_metrics(_lcs(pred, target), pred_len, target_len)
+
+
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, Array]:
+    """Rouge-Lsum via union-LCS over sentences (reference rouge.py:244-284)."""
+    pred_len = sum(map(len, pred))
+    target_len = sum(map(len, target))
+    if 0 in (pred_len, target_len):
+        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+
+    def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
+        ngrams: Counter = Counter()
+        for sentence in sentences:
+            ngrams.update(sentence)
+        return ngrams
+
+    pred_tokens_count = _get_token_counts(pred)
+    target_tokens_count = _get_token_counts(target)
+    hits = 0
+    for tgt in target:
+        lcs = _union_lcs(pred, tgt)
+        for token in lcs:
+            if pred_tokens_count[token] > 0 and target_tokens_count[token] > 0:
+                hits += 1
+                pred_tokens_count[token] -= 1
+                target_tokens_count[token] -= 1
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    rouge_keys_values: List[Union[int, str]],
+    accumulate: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    sentence_splitter: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Dict[Union[int, str], List[Dict[str, Array]]]:
+    """Per-sentence scores with multi-ref accumulation (reference rouge.py:287-399)."""
+    split_fn = sentence_splitter or _split_sentence
+    results: Dict[Union[int, str], List[Dict[str, Array]]] = {k: [] for k in rouge_keys_values}
+
+    for pred_raw, target_raw in zip(preds, target):
+        target_list = [target_raw] if isinstance(target_raw, str) else list(target_raw)
+        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        pred_lsum: List[Sequence[str]] = []
+        if "Lsum" in rouge_keys_values:
+            pred_lsum = [
+                _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in split_fn(pred_raw)
+            ]
+
+        list_results: List[Dict[Union[int, str], Dict[str, Array]]] = []
+        for target_raw_inner in target_list:
+            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
+            tgt_lsum: List[Sequence[str]] = []
+            if "Lsum" in rouge_keys_values:
+                tgt_lsum = [
+                    _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in split_fn(target_raw_inner)
+                ]
+            result_inner: Dict[Union[int, str], Dict[str, Array]] = {}
+            for rouge_key in rouge_keys_values:
+                if isinstance(rouge_key, int):
+                    score = _rouge_n_score(pred, tgt, rouge_key)
+                elif rouge_key == "L":
+                    score = _rouge_l_score(pred, tgt)
+                else:  # Lsum
+                    score = _rouge_lsum_score(pred_lsum, tgt_lsum)
+                result_inner[rouge_key] = score
+            list_results.append(result_inner)
+
+        if accumulate == "best":
+            key_curr = rouge_keys_values[0]
+            all_fmeasure = [float(v[key_curr]["fmeasure"]) for v in list_results]
+            highest_idx = max(range(len(all_fmeasure)), key=all_fmeasure.__getitem__)
+            for rouge_key in rouge_keys_values:
+                results[rouge_key].append(list_results[highest_idx][rouge_key])
+        elif accumulate == "avg":
+            for rouge_key in rouge_keys_values:
+                avg = {
+                    t: jnp.stack([r[rouge_key][t] for r in list_results]).mean()
+                    for t in ("precision", "recall", "fmeasure")
+                }
+                results[rouge_key].append(avg)
+        else:
+            raise ValueError(f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}")
+    return results
+
+
+def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+    """Mean over sentence-level scores (reference rouge.py:402-417)."""
+    return {k: jnp.stack(v).mean() if len(v) else jnp.asarray(0.0) for k, v in sentence_results.items()}
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    accumulate: str = "best",
+    use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+) -> Dict[str, Array]:
+    """ROUGE score (reference rouge.py:420-524). Returns {key_precision/_recall/_fmeasure}."""
+    if use_stemmer:
+        raise ValueError(
+            "Stemming requires the `nltk` PorterStemmer which is not bundled; pass a custom `normalizer` instead."
+        )
+    stemmer = None
+
+    if not isinstance(rouge_keys, tuple):
+        rouge_keys = (rouge_keys,)
+    for key in rouge_keys:
+        if key not in ALLOWED_ROUGE_KEYS:
+            raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+    rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+
+    if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+        target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [[target]]
+
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate=accumulate,
+        stemmer=stemmer, normalizer=normalizer, tokenizer=tokenizer,
+    )
+    output: Dict[str, List[Array]] = {
+        f"rouge{k}_{t}": [] for k in rouge_keys_values for t in ("fmeasure", "precision", "recall")
+    }
+    for rouge_key, metrics in sentence_results.items():
+        for metric in metrics:
+            for t, value in metric.items():
+                output[f"rouge{rouge_key}_{t}"].append(value)
+    return _rouge_score_compute(output)

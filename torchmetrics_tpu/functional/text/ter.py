@@ -1,0 +1,339 @@
+"""Translation Edit Rate (TER).
+
+Reference: functional/text/ter.py (600 LoC), which follows tercom via
+sacrebleu's lib_ter. TER = (#shifts + word edit distance) / avg reference
+length, where shifts greedily move a contiguous misaligned phrase of the
+hypothesis to its reference position while that reduces edit distance.
+
+Re-implemented here from the tercom algorithm description: a trace-producing
+Levenshtein (helper.py) drives alignment; the shift search enumerates matching
+phrase pairs (capped like tercom: size ≤ 10, distance ≤ 50, ≤ 1000 candidates)
+and ranks candidates by (edit gain, length, earliest). States: two psum-able
+scalars (total edits, total reference length).
+"""
+from __future__ import annotations
+
+import re
+import string
+from functools import lru_cache
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text.helper import _LevenshteinEditDistance
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+_MAX_SHIFT_CANDIDATES = 1000
+
+_PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+_ASIAN_PUNCT = re.compile(r"([、。〈-】〔-〟｡-･・])")
+_TERCOM_TOKENIZE_RE = (
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+)
+
+
+class _TercomTokenizer:
+    """Tercom normalization/tokenization options (reference ter.py:71-188)."""
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    def __call__(self, sentence: str) -> str:
+        if not sentence:
+            return ""
+        if self.lowercase:
+            sentence = sentence.lower()
+        if self.normalize:
+            sentence = self._normalize_general_and_western(sentence)
+            if self.asian_support:
+                sentence = self._normalize_asian(sentence)
+        if self.no_punctuation:
+            sentence = self._remove_punct(sentence)
+            if self.asian_support:
+                sentence = self._remove_asian_punct(sentence)
+        return " ".join(sentence.split())
+
+    @staticmethod
+    def _normalize_general_and_western(sentence: str) -> str:
+        sentence = f" {sentence} "
+        sentence = (
+            sentence.replace("<skipped>", "")
+            .replace("-\n", "")
+            .replace("\n", " ")
+            .replace("&quot;", '"')
+            .replace("&amp;", "&")
+            .replace("&lt;", "<")
+            .replace("&gt;", ">")
+        )
+        for pattern, repl in _TERCOM_TOKENIZE_RE:
+            sentence = pattern.sub(repl, sentence)
+        return sentence
+
+    @staticmethod
+    def _normalize_asian(sentence: str) -> str:
+        # split out CJK ideographs/kana as single tokens
+        sentence = re.sub(r"([一-鿿぀-ゟ゠-ヿ])", r" \1 ", sentence)
+        return _ASIAN_PUNCT.sub(r" \1 ", sentence)
+
+    @staticmethod
+    def _remove_punct(sentence: str) -> str:
+        return sentence.translate(_PUNCT_TABLE)
+
+    @staticmethod
+    def _remove_asian_punct(sentence: str) -> str:
+        return _ASIAN_PUNCT.sub("", sentence)
+
+
+def _preprocess_sentence(sentence: str, tokenizer: _TercomTokenizer) -> str:
+    return tokenizer(sentence.rstrip())
+
+
+def _trace_to_alignment(trace: str) -> Tuple[Dict[int, int], List[int], List[int]]:
+    """Map the edit trace to ref→pred position alignment + per-side error flags.
+
+    Reference ter.py's `_trace_to_alignment`. For each reference position the
+    aligned prediction index (for 'e'/'s' steps); error flags mark positions
+    touched by s/i/d ops.
+    """
+    pred_idx = ref_idx = -1
+    alignments: Dict[int, int] = {}
+    pred_errors: List[int] = []
+    target_errors: List[int] = []
+    for op in trace:
+        if op == "e":  # keep
+            pred_idx += 1
+            ref_idx += 1
+            alignments[ref_idx] = pred_idx
+            pred_errors.append(0)
+            target_errors.append(0)
+        elif op == "s":
+            pred_idx += 1
+            ref_idx += 1
+            alignments[ref_idx] = pred_idx
+            pred_errors.append(1)
+            target_errors.append(1)
+        elif op == "i":  # extra pred token
+            pred_idx += 1
+            pred_errors.append(1)
+        elif op == "d":  # missing pred token — still anchors to current pred pos
+            ref_idx += 1
+            alignments[ref_idx] = pred_idx
+            target_errors.append(1)
+    return alignments, target_errors, pred_errors
+
+
+def _find_shifted_pairs(pred_words: List[str], target_words: List[str]) -> Iterator[Tuple[int, int, int]]:
+    """All matching phrase pairs eligible to shift (tercom caps applied)."""
+    for pred_start in range(len(pred_words)):
+        for target_start in range(len(target_words)):
+            if abs(pred_start - target_start) > _MAX_SHIFT_DIST:
+                continue
+            for length in range(1, _MAX_SHIFT_SIZE):
+                if pred_words[pred_start + length - 1] != target_words[target_start + length - 1]:
+                    break
+                yield pred_start, target_start, length
+                if len(pred_words) == pred_start + length or len(target_words) == target_start + length:
+                    break
+
+
+def _handle_corner_cases_during_shifting(
+    alignments: Dict[int, int],
+    pred_errors: List[int],
+    target_errors: List[int],
+    pred_start: int,
+    target_start: int,
+    length: int,
+) -> bool:
+    """True → skip this candidate (error-free span, or already aligned) — ter.py:244-278."""
+    # no errors in either span → nothing to fix by shifting
+    if sum(pred_errors[pred_start : pred_start + length]) == 0:
+        return True
+    if sum(target_errors[target_start : target_start + length]) == 0:
+        return True
+    # shifting within an already-aligned match is a no-op
+    if pred_start <= alignments[target_start] < pred_start + length:
+        return True
+    return False
+
+
+def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+    """Move words[start:start+length] so it lands at position `target` (ter.py:281-312)."""
+    if target < start:
+        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
+    if target > start + length:
+        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
+    # target within the shifted span: rotate inside
+    return (
+        words[:start]
+        + words[start + length : length + target]
+        + words[start : start + length]
+        + words[length + target :]
+    )
+
+
+def _shift_words(
+    pred_words: List[str],
+    target_words: List[str],
+    cached_edit_distance: _LevenshteinEditDistance,
+    checked_candidates: int,
+) -> Tuple[int, List[str], int]:
+    """One round of the greedy shift search (reference ter.py:315-395)."""
+    edit_distance, trace = cached_edit_distance(pred_words)
+    alignments, target_errors, pred_errors = _trace_to_alignment(trace)
+    best: Optional[Tuple[int, int, int, int, List[str]]] = None
+
+    for pred_start, target_start, length in _find_shifted_pairs(pred_words, target_words):
+        if _handle_corner_cases_during_shifting(
+            alignments, pred_errors, target_errors, pred_start, target_start, length
+        ):
+            continue
+        prev_idx = -1
+        for offset in range(-1, length):
+            if target_start + offset == -1:
+                idx = 0
+            elif target_start + offset in alignments:
+                idx = alignments[target_start + offset] + 1
+            else:
+                break
+            if idx == prev_idx:
+                continue
+            prev_idx = idx
+            shifted_words = _perform_shift(pred_words, pred_start, length, idx)
+            candidate = (
+                edit_distance - cached_edit_distance(shifted_words)[0],
+                length,
+                -pred_start,
+                -idx,
+                shifted_words,
+            )
+            checked_candidates += 1
+            if best is None or candidate[:4] > best[:4]:
+                best = candidate
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES:
+            break
+
+    if best is None:
+        return 0, pred_words, checked_candidates
+    return best[0], best[4], checked_candidates
+
+
+def _translation_edit_rate(pred_words: List[str], target_words: List[str]) -> int:
+    """Edits (shifts + Levenshtein) for one hypothesis/reference pair (ter.py:396-428)."""
+    if len(target_words) == 0:
+        return 0
+    cached_edit_distance = _LevenshteinEditDistance(target_words)
+    num_shifts = 0
+    checked_candidates = 0
+    input_words = list(pred_words)
+    while True:
+        delta, new_input_words, checked_candidates = _shift_words(
+            input_words, target_words, cached_edit_distance, checked_candidates
+        )
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES or delta <= 0:
+            break
+        num_shifts += 1
+        input_words = new_input_words
+    edit_distance, _ = cached_edit_distance(input_words)
+    return num_shifts + edit_distance
+
+
+def _compute_sentence_statistics(
+    pred_words: List[str], target_words_list: List[List[str]]
+) -> Tuple[float, float]:
+    """Best edits over references + avg reference length (ter.py:431-455)."""
+    tgt_lengths = 0.0
+    best_num_edits = float(int(2e16))
+    for tgt_words in target_words_list:
+        # argument order mirrors the reference (ter.py:449): the Levenshtein
+        # cache is built on the prediction and the reference words are shifted
+        num_edits = _translation_edit_rate(tgt_words, pred_words)
+        tgt_lengths += len(tgt_words)
+        if num_edits < best_num_edits:
+            best_num_edits = num_edits
+    avg_tgt_len = tgt_lengths / len(target_words_list)
+    return best_num_edits, avg_tgt_len
+
+
+def _compute_ter_score_from_statistics(num_edits: Array, tgt_length: Array) -> Array:
+    """num_edits/avg_len with the degenerate-length conventions (ter.py:458-473)."""
+    return jnp.where(
+        tgt_length > 0,
+        num_edits / jnp.maximum(tgt_length, 1e-16),
+        jnp.where(num_edits > 0, 1.0, 0.0),
+    )
+
+
+def _ter_update(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+    total_num_edits: Array,
+    total_tgt_length: Array,
+    sentence_ter: Optional[List[Array]] = None,
+) -> Tuple[Array, Array, Optional[List[Array]]]:
+    """Accumulate corpus edits + lengths (reference ter.py:476-517)."""
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds_l) != len(target_l):
+        raise ValueError(f"Corpus has different size {len(preds_l)} != {len(target_l)}")
+    for pred, tgt in zip(preds_l, target_l):
+        tgt_words_ = [_preprocess_sentence(t, tokenizer).split() for t in tgt]
+        pred_words_ = _preprocess_sentence(pred, tokenizer).split()
+        num_edits, tgt_length = _compute_sentence_statistics(pred_words_, tgt_words_)
+        total_num_edits = total_num_edits + num_edits
+        total_tgt_length = total_tgt_length + tgt_length
+        if sentence_ter is not None:
+            sentence_ter.append(
+                _compute_ter_score_from_statistics(jnp.asarray(num_edits), jnp.asarray(tgt_length))
+            )
+    return total_num_edits, total_tgt_length, sentence_ter
+
+
+def _ter_compute(total_num_edits: Array, total_tgt_length: Array) -> Array:
+    return _compute_ter_score_from_statistics(total_num_edits, total_tgt_length)
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """TER of translated text against references (reference ter.py:534-600)."""
+    if not isinstance(normalize, bool):
+        raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+    if not isinstance(no_punctuation, bool):
+        raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+    if not isinstance(lowercase, bool):
+        raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+    if not isinstance(asian_support, bool):
+        raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    total_num_edits = jnp.asarray(0.0)
+    total_tgt_length = jnp.asarray(0.0)
+    sentence_ter: Optional[List[Array]] = [] if return_sentence_level_score else None
+    total_num_edits, total_tgt_length, sentence_ter = _ter_update(
+        preds, target, tokenizer, total_num_edits, total_tgt_length, sentence_ter
+    )
+    corpus = _ter_compute(total_num_edits, total_tgt_length)
+    if return_sentence_level_score and sentence_ter is not None:
+        return corpus, jnp.stack(sentence_ter) if sentence_ter else jnp.zeros(0)
+    return corpus

@@ -1,0 +1,37 @@
+"""Text-domain modular metrics (reference: src/torchmetrics/text/__init__.py)."""
+from torchmetrics_tpu.text.asr import (  # noqa: F401
+    CharErrorRate,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from torchmetrics_tpu.text.counters import (  # noqa: F401
+    BLEUScore,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    SacreBLEUScore,
+    TranslationEditRate,
+)
+from torchmetrics_tpu.text.misc import Perplexity, ROUGEScore, SQuAD  # noqa: F401
+from torchmetrics_tpu.text.model_based import BERTScore, InfoLM  # noqa: F401
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

@@ -1,0 +1,162 @@
+"""Model-backed text metrics: BERTScore, InfoLM.
+
+Reference: text/bert.py:54 and text/infolm.py:41. Sentences are inherently host
+data — the classes accumulate raw strings host-side and run the (pluggable)
+model at compute; the post-model math is jnp on device. Multi-process sync for
+these metrics is host-side (strings can't ride a psum); on a multi-host
+runtime compute() operates on the local shard unless the user all-gathers
+sentences beforehand — same contract as the reference's `dist_reduce_fx="cat"`
+list states.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text.bert import bert_score
+from torchmetrics_tpu.functional.text.infolm import _ALLOWED_INFORMATION_MEASURE, _InformationMeasure, infolm
+from torchmetrics_tpu.metric import Metric
+
+
+class BERTScore(Metric):
+    """BERTScore (reference text/bert.py:54)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_model: Optional[Callable[[List[str]], Tuple[Any, Any]]] = None,
+        user_tokenizer: Optional[Callable[[str], List[str]]] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        rescale_with_baseline: bool = False,
+        baseline: Optional[Array] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_model = user_model
+        self.user_tokenizer = user_tokenizer
+        self.verbose = verbose
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline = baseline
+        # raw sentences are host state, not device state (see module docstring)
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds_l = [preds] if isinstance(preds, str) else list(preds)
+        target_l = [target] if isinstance(target, str) else list(target)
+        if len(preds_l) != len(target_l):
+            raise ValueError(
+                f"Number of predicted and reference sentences must match: {len(preds_l)} != {len(target_l)}"
+            )
+        self._preds.extend(preds_l)
+        self._target.extend(target_l)
+
+    def compute(self) -> Dict[str, Array]:
+        return bert_score(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_model=self.user_model,
+            user_tokenizer=self.user_tokenizer,
+            verbose=self.verbose,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline=self.baseline,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
+
+
+class InfoLM(Metric):
+    """InfoLM (reference text/infolm.py:41)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        max_length: Optional[int] = None,
+        user_model: Optional[Callable[[List[str]], Any]] = None,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        # validate measure/params eagerly (reference infolm.py:104-139)
+        _InformationMeasure(information_measure, alpha, beta)
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.user_model = user_model
+        self.return_sentence_level_score = return_sentence_level_score
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds_l = [preds] if isinstance(preds, str) else list(preds)
+        target_l = [target] if isinstance(target, str) else list(target)
+        if len(preds_l) != len(target_l):
+            raise ValueError(
+                f"Number of predicted and reference sentences must match: {len(preds_l)} != {len(target_l)}"
+            )
+        self._preds.extend(preds_l)
+        self._target.extend(target_l)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        return infolm(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            max_length=self.max_length,
+            user_model=self.user_model,
+            return_sentence_level_score=self.return_sentence_level_score,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
