@@ -1,0 +1,198 @@
+"""Audio metric parity tests vs the PyTorch reference implementation."""
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+from helpers.testers import MetricTester  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+from torchmetrics.functional.audio import (  # noqa: E402
+    permutation_invariant_training as ref_pit,
+    scale_invariant_signal_distortion_ratio as ref_si_sdr,
+    scale_invariant_signal_noise_ratio as ref_si_snr,
+    signal_distortion_ratio as ref_sdr,
+    signal_noise_ratio as ref_snr,
+    source_aggregated_signal_distortion_ratio as ref_sa_sdr,
+)
+
+import torchmetrics_tpu.functional as F  # noqa: E402
+from torchmetrics_tpu import (  # noqa: E402
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+
+NUM_BATCHES, BATCH_SIZE, TIME = 4, 8, 500
+rng = np.random.RandomState(7)
+TARGET = rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+PREDS = (TARGET + 0.3 * rng.randn(NUM_BATCHES, BATCH_SIZE, TIME)).astype(np.float32)
+
+SPK_TARGET = rng.randn(NUM_BATCHES, BATCH_SIZE, 3, TIME).astype(np.float32)
+SPK_PREDS = (SPK_TARGET[:, :, ::-1] + 0.3 * rng.randn(NUM_BATCHES, BATCH_SIZE, 3, TIME)).astype(np.float32)
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+class TestSNR(MetricTester):
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_snr(self, zero_mean):
+        def ref(p, t):
+            return ref_snr(_t(p), _t(t), zero_mean=zero_mean).mean().numpy()
+
+        self.run_functional_metric_test(
+            PREDS, TARGET, lambda p, t: F.signal_noise_ratio(p, t, zero_mean=zero_mean).mean(), ref, atol=1e-4
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, SignalNoiseRatio, ref, metric_args={"zero_mean": zero_mean}, ddp=True, atol=1e-4
+        )
+
+    def test_si_snr(self):
+        def ref(p, t):
+            return ref_si_snr(_t(p), _t(t)).mean().numpy()
+
+        self.run_functional_metric_test(PREDS, TARGET, lambda p, t: F.scale_invariant_signal_noise_ratio(p, t).mean(), ref, atol=1e-4)
+        self.run_class_metric_test(PREDS, TARGET, ScaleInvariantSignalNoiseRatio, ref, ddp=True, atol=1e-4)
+
+    def test_complex_si_snr(self):
+        preds = rng.randn(NUM_BATCHES, BATCH_SIZE, 33, 20, 2).astype(np.float32)
+        target = rng.randn(NUM_BATCHES, BATCH_SIZE, 33, 20, 2).astype(np.float32)
+        from torchmetrics.functional.audio import complex_scale_invariant_signal_noise_ratio as ref_c
+
+        for i in range(NUM_BATCHES):
+            got = F.complex_scale_invariant_signal_noise_ratio(preds[i], target[i])
+            want = ref_c(_t(preds[i]), _t(target[i])).numpy()
+            np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+class TestSDR(MetricTester):
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_si_sdr(self, zero_mean):
+        def ref(p, t):
+            return ref_si_sdr(_t(p), _t(t), zero_mean=zero_mean).mean().numpy()
+
+        self.run_functional_metric_test(
+            PREDS, TARGET, lambda p, t: F.scale_invariant_signal_distortion_ratio(p, t, zero_mean=zero_mean).mean(), ref, atol=1e-4
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, ScaleInvariantSignalDistortionRatio, ref, metric_args={"zero_mean": zero_mean}, ddp=True, atol=1e-4
+        )
+
+    def test_sdr(self):
+        # filter solve in float32 vs reference float64: modest tolerance on dB values
+        def ref(p, t):
+            return ref_sdr(_t(p), _t(t), filter_length=64).mean().numpy()
+
+        self.run_functional_metric_test(
+            PREDS, TARGET, lambda p, t: F.signal_distortion_ratio(p, t, filter_length=64).mean(), ref, atol=1e-2
+        )
+        self.run_class_metric_test(
+            PREDS, TARGET, SignalDistortionRatio, ref, metric_args={"filter_length": 64}, ddp=True, atol=1e-2
+        )
+
+    def test_sdr_default_filter_length(self):
+        t = rng.randn(4, 4000).astype(np.float32)
+        p = (t + 0.3 * rng.randn(4, 4000)).astype(np.float32)
+        got = np.asarray(F.signal_distortion_ratio(p, t))
+        want = ref_sdr(_t(p), _t(t)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-4)
+
+    def test_sdr_near_identical_is_finite(self):
+        t = rng.randn(2, 4000).astype(np.float32)
+        p = (t + 1e-5 * rng.randn(2, 4000)).astype(np.float32)
+        got = np.asarray(F.signal_distortion_ratio(p, t))
+        assert np.all(np.isfinite(got)) and np.all(got > 40), got
+
+    @pytest.mark.parametrize("scale_invariant", [True, False])
+    def test_sa_sdr(self, scale_invariant):
+        def ref(p, t):
+            return ref_sa_sdr(_t(p), _t(t), scale_invariant=scale_invariant).mean().numpy()
+
+        self.run_functional_metric_test(
+            SPK_PREDS,
+            SPK_TARGET,
+            lambda p, t: F.source_aggregated_signal_distortion_ratio(p, t, scale_invariant=scale_invariant).mean(),
+            ref,
+            atol=1e-4,
+        )
+        self.run_class_metric_test(
+            SPK_PREDS, SPK_TARGET, SourceAggregatedSignalDistortionRatio, ref,
+            metric_args={"scale_invariant": scale_invariant}, ddp=True, atol=1e-4,
+        )
+
+
+class TestPIT(MetricTester):
+    @pytest.mark.parametrize("eval_func", ["max", "min"])
+    def test_pit_speaker_wise(self, eval_func):
+        import torchmetrics_tpu.functional as F
+
+        for i in range(NUM_BATCHES):
+            got_metric, got_perm = F.permutation_invariant_training(
+                SPK_PREDS[i], SPK_TARGET[i], F.scale_invariant_signal_distortion_ratio, eval_func=eval_func
+            )
+            want_metric, want_perm = ref_pit(
+                _t(SPK_PREDS[i]), _t(SPK_TARGET[i]),
+                ref_tm.functional.audio.scale_invariant_signal_distortion_ratio, eval_func=eval_func,
+            )
+            np.testing.assert_allclose(np.asarray(got_metric), want_metric.numpy(), atol=1e-4, rtol=1e-4)
+            np.testing.assert_array_equal(np.asarray(got_perm), want_perm.numpy())
+
+    def test_pit_permutation_wise(self):
+        import torchmetrics_tpu.functional as F
+
+        for i in range(2):
+            got_metric, got_perm = F.permutation_invariant_training(
+                SPK_PREDS[i], SPK_TARGET[i], F.source_aggregated_signal_distortion_ratio, mode="permutation-wise"
+            )
+            want_metric, want_perm = ref_pit(
+                _t(SPK_PREDS[i]), _t(SPK_TARGET[i]),
+                ref_tm.functional.audio.source_aggregated_signal_distortion_ratio, mode="permutation-wise",
+            )
+            np.testing.assert_allclose(np.asarray(got_metric), want_metric.numpy(), atol=1e-4, rtol=1e-4)
+            np.testing.assert_array_equal(np.asarray(got_perm), want_perm.numpy())
+
+    def test_pit_many_speakers_host_solver(self):
+        import torchmetrics_tpu.functional as F
+
+        spk = 7
+        t = rng.randn(3, spk, 100).astype(np.float32)
+        p = np.ascontiguousarray(t[:, ::-1]) + 0.05 * rng.randn(3, spk, 100).astype(np.float32)
+        got_metric, got_perm = F.permutation_invariant_training(p, t, F.scale_invariant_signal_distortion_ratio)
+        want_metric, want_perm = ref_pit(
+            _t(p), _t(t), ref_tm.functional.audio.scale_invariant_signal_distortion_ratio
+        )
+        np.testing.assert_allclose(np.asarray(got_metric), want_metric.numpy(), atol=1e-4, rtol=1e-4)
+        np.testing.assert_array_equal(np.asarray(got_perm), want_perm.numpy())
+
+    def test_pit_permutate(self):
+        import torchmetrics_tpu.functional as F
+
+        perm = np.asarray([[1, 0, 2]] * BATCH_SIZE)
+        got = F.pit_permutate(SPK_PREDS[0], perm)
+        np.testing.assert_allclose(np.asarray(got), SPK_PREDS[0][:, [1, 0, 2]], atol=1e-6)
+
+    def test_pit_class(self):
+        import torchmetrics_tpu.functional as F
+
+        def ref(p, t):
+            return ref_pit(
+                _t(p), _t(t), ref_tm.functional.audio.scale_invariant_signal_distortion_ratio, eval_func="max"
+            )[0].mean().numpy()
+
+        self.run_class_metric_test(
+            SPK_PREDS,
+            SPK_TARGET,
+            PermutationInvariantTraining,
+            ref,
+            metric_args={"metric_func": F.scale_invariant_signal_distortion_ratio, "eval_func": "max"},
+            ddp=False,
+            atol=1e-4,
+        )

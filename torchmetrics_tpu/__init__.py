@@ -90,6 +90,16 @@ from torchmetrics_tpu.text import (  # noqa: F401
     WordInfoLost,
     WordInfoPreserved,
 )
+from torchmetrics_tpu import audio  # noqa: F401
+from torchmetrics_tpu.audio import (  # noqa: F401
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
 from torchmetrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
     FrechetInceptionDistance,

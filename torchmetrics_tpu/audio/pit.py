@@ -1,0 +1,63 @@
+"""Modular permutation-invariant training metric (reference audio/pit.py:30-130)."""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
+from torchmetrics_tpu.metric import Metric
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean of the best-permutation metric value over all samples seen."""
+
+    full_state_update = False
+    is_differentiable = True
+    plot_lower_bound: float = -10.0
+    plot_upper_bound: float = 10.0
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k
+            in (
+                "compute_on_cpu",
+                "dist_sync_on_step",
+                "sync_axis",
+                "process_group",
+                "dist_sync_fn",
+                "distributed_available_fn",
+                "sync_on_compute",
+                "compute_with_cache",
+            )
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ["max", "min"]:
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        if mode not in ["speaker-wise", "permutation-wise"]:
+            raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.kwargs = kwargs  # remaining kwargs forward to metric_func
+        self.add_state("sum_pit_metric", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.kwargs
+        )[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,97 @@
+"""Modular SDR metrics (reference audio/sdr.py:37-362): mean over all samples seen."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class SignalDistortionRatio(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -20.0
+    plot_upper_bound: float = 10.0
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+        self.add_state("sum_sdr", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sdr_batch = signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+        self.sum_sdr = self.sum_sdr + jnp.sum(sdr_batch)
+        self.total = self.total + sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_sdr / self.total
+
+
+class ScaleInvariantSignalDistortionRatio(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -20.0
+    plot_upper_bound: float = 10.0
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_si_sdr", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_sdr_batch = scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_si_sdr = self.sum_si_sdr + jnp.sum(si_sdr_batch)
+        self.total = self.total + si_sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_sdr / self.total
+
+
+class SourceAggregatedSignalDistortionRatio(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -20.0
+    plot_upper_bound: float = 10.0
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        self.scale_invariant = scale_invariant
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+        self.add_state("msdr_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        msdr = source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)
+        self.msdr_sum = self.msdr_sum + jnp.sum(msdr)
+        self.total = self.total + msdr.size
+
+    def compute(self) -> Array:
+        return self.msdr_sum / self.total

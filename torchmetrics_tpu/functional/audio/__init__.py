@@ -1,0 +1,25 @@
+from torchmetrics_tpu.functional.audio.pit import (  # noqa: F401
+    permutation_invariant_training,
+    pit_permutate,
+)
+from torchmetrics_tpu.functional.audio.sdr import (  # noqa: F401
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from torchmetrics_tpu.functional.audio.snr import (  # noqa: F401
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+
+__all__ = [
+    "complex_scale_invariant_signal_noise_ratio",
+    "permutation_invariant_training",
+    "pit_permutate",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "signal_distortion_ratio",
+    "signal_noise_ratio",
+    "source_aggregated_signal_distortion_ratio",
+]

@@ -1,0 +1,162 @@
+"""Signal-to-distortion ratio family.
+
+Reference behavior: functional/audio/sdr.py (SDR via Toeplitz-filter projection,
+SI-SDR, SA-SDR). TPU redesign notes:
+
+- The reference builds the symmetric Toeplitz system with ``as_strided`` and
+  solves with LAPACK in float64; strided views don't exist in XLA, so the
+  Toeplitz matrix is materialised with a static ``|i-j|`` gather (one fused
+  XLA gather) and solved batched with ``jnp.linalg.solve`` — one MXU-friendly
+  batched solve instead of a per-sample loop.
+- Correlations come from rFFT exactly as the reference does; FFT length is a
+  static power of two so the kernel caches across steps.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row, shape ``(..., L) -> (..., L, L)``.
+
+    XLA-native equivalent of reference functional/audio/sdr.py:28-53: the strided
+    trick becomes a gather on the static index grid ``|i - j|``.
+    """
+    v_len = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(v_len)[:, None] - jnp.arange(v_len)[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int) -> tuple:
+    """FFT auto-correlation of target and cross-correlation with preds.
+
+    Mirrors reference functional/audio/sdr.py:56-87.
+    """
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR: energy ratio after projecting preds onto ``filter_length`` shifts of target.
+
+    Reference behavior functional/audio/sdr.py:90-200. ``use_cg_iter`` is accepted
+    for API parity; the batched direct solve is already XLA-efficient so conjugate
+    gradient is not used.
+
+    Args:
+        preds: estimate, shape ``(..., time)``.
+        target: reference, shape ``(..., time)``.
+        use_cg_iter: ignored (API parity with the reference's fast-bss-eval path).
+        filter_length: length of the allowed distortion filter.
+        zero_mean: subtract time-axis means first.
+        load_diag: optional diagonal loading for ill-conditioned systems.
+
+    Returns:
+        SDR values in dB with shape ``(...,)``.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    # float64 if enabled (jax.config x64), else best available precision
+    import jax
+
+    solve_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    preds_dtype = preds.dtype
+    preds = preds.astype(solve_dtype)
+    target = target.astype(solve_dtype)
+
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-6)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-6)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+
+    r = _symmetric_toeplitz(r_0)
+    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", b, sol)
+    # clamp the residual energy at dtype resolution: for preds ~= target the float32
+    # solve rounds coh to >= 1, which the reference (float64) never hits; this caps
+    # SDR at ~10*log10(1/eps) instead of returning inf/nan
+    ratio = coh / jnp.clip(1 - coh, jnp.finfo(solve_dtype).eps)
+    val = 10.0 * jnp.log10(ratio)
+    if preds_dtype == jnp.float64:
+        return val
+    return val.astype(jnp.float32)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR (reference functional/audio/sdr.py:302-339)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(val)
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    scale_invariant: bool = True,
+    zero_mean: bool = False,
+) -> Array:
+    """SA-SDR over ``(..., spk, time)`` inputs (reference functional/audio/sdr.py:342-430).
+
+    A single alpha scales all speakers, and signal/distortion energies aggregate
+    over both speaker and time axes before the dB ratio.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    if scale_invariant:
+        alpha = (jnp.sum(preds * target, axis=(-2, -1), keepdims=True) + eps) / (
+            jnp.sum(target**2, axis=(-2, -1), keepdims=True) + eps
+        )
+        target = alpha * target
+
+    distortion = target - preds
+    val = (jnp.sum(target**2, axis=(-2, -1)) + eps) / (jnp.sum(distortion**2, axis=(-2, -1)) + eps)
+    return 10 * jnp.log10(val)

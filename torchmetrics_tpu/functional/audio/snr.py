@@ -1,0 +1,67 @@
+"""Signal-to-noise ratio family.
+
+Reference behavior: functional/audio/snr.py:22-130 (SNR, SI-SNR, C-SI-SNR).
+All three reduce the trailing time axis and return one value per leading index,
+so they batch trivially onto the VPU/MXU under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR in dB: ``10*log10(||target||^2 / ||target - preds||^2)``.
+
+    Args:
+        preds: estimated signal, shape ``(..., time)``.
+        target: reference signal, shape ``(..., time)``.
+        zero_mean: subtract the time-axis mean of both signals first.
+
+    Returns:
+        SNR values with shape ``(...,)``.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR: SI-SDR with forced zero-mean (reference functional/audio/snr.py:64-88)."""
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """C-SI-SNR over complex STFT inputs (reference functional/audio/snr.py:90-130).
+
+    Accepts complex arrays of shape ``(..., freq, time)`` or real arrays of shape
+    ``(..., freq, time, 2)``; flattens the spectral axes and evaluates SI-SDR.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)
