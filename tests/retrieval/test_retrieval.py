@@ -1,0 +1,199 @@
+"""Retrieval metric parity tests vs the PyTorch reference implementation."""
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+from torchmetrics.functional.retrieval import (  # noqa: E402
+    retrieval_auroc as ref_auroc,
+    retrieval_average_precision as ref_ap,
+    retrieval_fall_out as ref_fo,
+    retrieval_hit_rate as ref_hr,
+    retrieval_normalized_dcg as ref_ndcg,
+    retrieval_precision as ref_prec,
+    retrieval_precision_recall_curve as ref_prc,
+    retrieval_r_precision as ref_rprec,
+    retrieval_recall as ref_rec,
+    retrieval_reciprocal_rank as ref_rr,
+)
+from torchmetrics import retrieval as ref_retrieval_mod  # noqa: E402
+
+import torchmetrics_tpu.functional as F  # noqa: E402
+import torchmetrics_tpu as tm  # noqa: E402
+
+rng = np.random.RandomState(13)
+N = 200
+INDEXES = rng.randint(0, 12, size=N).astype(np.int64)
+PREDS = rng.rand(N).astype(np.float32)
+TARGET = (rng.rand(N) > 0.7).astype(np.int64)
+# one query guaranteed positive-free and one guaranteed with positives
+TARGET[INDEXES == 3] = 0
+TARGET[np.where(INDEXES == 5)[0][0]] = 1
+
+QUERY_P = rng.rand(20).astype(np.float32)
+QUERY_T = (rng.rand(20) > 0.6).astype(np.int64)
+
+FUNCTIONAL_CASES = [
+    (F.retrieval_average_precision, ref_ap, {}),
+    (F.retrieval_average_precision, ref_ap, {"top_k": 5}),
+    (F.retrieval_reciprocal_rank, ref_rr, {}),
+    (F.retrieval_reciprocal_rank, ref_rr, {"top_k": 3}),
+    (F.retrieval_precision, ref_prec, {}),
+    (F.retrieval_precision, ref_prec, {"top_k": 4}),
+    (F.retrieval_precision, ref_prec, {"top_k": 40, "adaptive_k": True}),
+    (F.retrieval_recall, ref_rec, {}),
+    (F.retrieval_recall, ref_rec, {"top_k": 4}),
+    (F.retrieval_fall_out, ref_fo, {"top_k": 6}),
+    (F.retrieval_hit_rate, ref_hr, {"top_k": 3}),
+    (F.retrieval_r_precision, ref_rprec, {}),
+    (F.retrieval_normalized_dcg, ref_ndcg, {}),
+    (F.retrieval_normalized_dcg, ref_ndcg, {"top_k": 7}),
+    (F.retrieval_auroc, ref_auroc, {}),
+    (F.retrieval_auroc, ref_auroc, {"top_k": 10}),
+    (F.retrieval_auroc, ref_auroc, {"max_fpr": 0.5}),
+]
+
+
+@pytest.mark.parametrize("ours,ref,kw", FUNCTIONAL_CASES, ids=[f"{r.__name__}-{k}" for _, r, k in FUNCTIONAL_CASES])
+def test_functional_parity(ours, ref, kw):
+    got = np.asarray(ours(QUERY_P, QUERY_T, **kw))
+    want = ref(torch.from_numpy(QUERY_P), torch.from_numpy(QUERY_T), **kw).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_functional_ndcg_nonbinary():
+    t = rng.randint(0, 4, size=20).astype(np.int64)
+    got = np.asarray(F.retrieval_normalized_dcg(QUERY_P, t))
+    want = ref_ndcg(torch.from_numpy(QUERY_P), torch.from_numpy(t)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_functional_ndcg_with_ties():
+    p = np.round(QUERY_P * 4) / 4  # heavy ties
+    got = np.asarray(F.retrieval_normalized_dcg(p.astype(np.float32), QUERY_T))
+    want = ref_ndcg(torch.from_numpy(p.astype(np.float32)), torch.from_numpy(QUERY_T)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_functional_prc():
+    for kw in [{}, {"max_k": 5}, {"max_k": 30, "adaptive_k": True}]:
+        gp, gr, gk = F.retrieval_precision_recall_curve(QUERY_P, QUERY_T, **kw)
+        wp, wr, wk = ref_prc(torch.from_numpy(QUERY_P), torch.from_numpy(QUERY_T), **kw)
+        np.testing.assert_allclose(np.asarray(gp), wp.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gr), wr.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gk), wk.numpy())
+
+
+MODULAR_CASES = [
+    (tm.RetrievalMAP, "RetrievalMAP", {}),
+    (tm.RetrievalMRR, "RetrievalMRR", {}),
+    (tm.RetrievalPrecision, "RetrievalPrecision", {"top_k": 3}),
+    (tm.RetrievalRecall, "RetrievalRecall", {"top_k": 3}),
+    (tm.RetrievalFallOut, "RetrievalFallOut", {"top_k": 3}),
+    (tm.RetrievalHitRate, "RetrievalHitRate", {"top_k": 3}),
+    (tm.RetrievalRPrecision, "RetrievalRPrecision", {}),
+    (tm.RetrievalNormalizedDCG, "RetrievalNormalizedDCG", {}),
+    (tm.RetrievalAUROC, "RetrievalAUROC", {}),
+]
+
+
+@pytest.mark.parametrize("cls,ref_name,kw", MODULAR_CASES, ids=[c[1] for c in MODULAR_CASES])
+@pytest.mark.parametrize("empty_target_action", ["neg", "pos", "skip"])
+def test_modular_parity(cls, ref_name, kw, empty_target_action):
+    ours = cls(empty_target_action=empty_target_action, **kw)
+    ref = getattr(ref_retrieval_mod, ref_name)(empty_target_action=empty_target_action, **kw)
+    # two-batch update
+    half = N // 2
+    for sl in (slice(0, half), slice(half, N)):
+        ours.update(PREDS[sl], TARGET[sl], INDEXES[sl])
+        ref.update(torch.from_numpy(PREDS[sl]), torch.from_numpy(TARGET[sl]), indexes=torch.from_numpy(INDEXES[sl]))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("aggregation", ["median", "min", "max"])
+def test_aggregation_modes(aggregation):
+    ours = tm.RetrievalMAP(aggregation=aggregation)
+    ref = ref_retrieval_mod.RetrievalMAP(aggregation=aggregation)
+    ours.update(PREDS, TARGET, INDEXES)
+    ref.update(torch.from_numpy(PREDS), torch.from_numpy(TARGET), indexes=torch.from_numpy(INDEXES))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+
+def test_empty_target_error():
+    m = tm.RetrievalMAP(empty_target_action="error")
+    m.update(PREDS, TARGET, INDEXES)
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    t = TARGET.copy()
+    t[::7] = -1
+    ours = tm.RetrievalMAP(ignore_index=-1)
+    ref = ref_retrieval_mod.RetrievalMAP(ignore_index=-1)
+    ours.update(PREDS, t, INDEXES)
+    ref.update(torch.from_numpy(PREDS), torch.from_numpy(t), indexes=torch.from_numpy(INDEXES))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+
+def test_prc_modular():
+    for kw in [{"max_k": 4}, {}]:
+        ours = tm.RetrievalPrecisionRecallCurve(**kw)
+        ref = ref_retrieval_mod.RetrievalPrecisionRecallCurve(**kw)
+        ours.update(PREDS, TARGET, INDEXES)
+        ref.update(torch.from_numpy(PREDS), torch.from_numpy(TARGET), indexes=torch.from_numpy(INDEXES))
+        gp, gr, gk = ours.compute()
+        wp, wr, wk = ref.compute()
+        np.testing.assert_allclose(np.asarray(gp), wp.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gr), wr.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gk), wk.numpy())
+
+
+def test_recall_at_fixed_precision():
+    ours = tm.RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=6)
+    ref = ref_retrieval_mod.RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=6)
+    ours.update(PREDS, TARGET, INDEXES)
+    ref.update(torch.from_numpy(PREDS), torch.from_numpy(TARGET), indexes=torch.from_numpy(INDEXES))
+    g_recall, g_k = ours.compute()
+    w_recall, w_k = ref.compute()
+    np.testing.assert_allclose(np.asarray(g_recall), w_recall.numpy(), atol=1e-5)
+    assert int(g_k) == int(w_k)
+
+
+def test_auroc_max_fpr_single_class():
+    # all-positive / all-negative queries must skip the McClish correction
+    p = np.asarray([0.3, 0.2, 0.1], dtype=np.float32)
+    for t in (np.asarray([1, 1, 1]), np.asarray([0, 0, 0])):
+        got = np.asarray(F.retrieval_auroc(p, t, top_k=2, max_fpr=0.5))
+        want = ref_auroc(torch.from_numpy(p), torch.from_numpy(t), top_k=2, max_fpr=0.5).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_ndcg_nonbinary_negative_ragged():
+    # query shorter than max_docs with negative relevance: padding must not
+    # outrank negative values in the ideal ordering
+    idx = np.asarray([0, 0, 0, 0, 0, 0, 1, 1, 1], dtype=np.int64)
+    p = rng.rand(9).astype(np.float32)
+    t = np.asarray([1, 0, 2, 0, 1, 0, 2, -1, 1], dtype=np.int64)
+    ours = tm.RetrievalNormalizedDCG()
+    ref = ref_retrieval_mod.RetrievalNormalizedDCG()
+    ours.update(p, t, idx)
+    ref.update(torch.from_numpy(p), torch.from_numpy(t), indexes=torch.from_numpy(idx))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+
+def test_update_validation():
+    m = tm.RetrievalMAP()
+    with pytest.raises(ValueError, match="cannot be None"):
+        m.update(PREDS, TARGET, None)
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(PREDS[:5], TARGET[:6], INDEXES[:6])
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(PREDS, TARGET, INDEXES.astype(np.float32))
+    with pytest.raises(ValueError, match="binary"):
+        m.update(PREDS, TARGET * 5, INDEXES)

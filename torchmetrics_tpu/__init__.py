@@ -90,7 +90,20 @@ from torchmetrics_tpu.text import (  # noqa: F401
     WordInfoLost,
     WordInfoPreserved,
 )
-from torchmetrics_tpu import audio  # noqa: F401
+from torchmetrics_tpu import audio, retrieval  # noqa: F401
+from torchmetrics_tpu.retrieval import (  # noqa: F401
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
 from torchmetrics_tpu.audio import (  # noqa: F401
     ComplexScaleInvariantSignalNoiseRatio,
     PermutationInvariantTraining,

@@ -48,7 +48,9 @@ def _binary_auroc_compute(
     pos_label: int = 1,
 ) -> Array:
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
-    if max_fpr is None or max_fpr == 1:
+    # degenerate single-class curves (fpr or tpr identically 0) skip the McClish
+    # correction, as the reference does (auroc.py:_binary_auroc_compute)
+    if max_fpr is None or max_fpr == 1 or float(jnp.sum(fpr)) == 0 or float(jnp.sum(tpr)) == 0:
         return _trapz(tpr, fpr)
     # McClish correction for partial AUC (reference auroc.py)
     fpr_np, tpr_np = np.asarray(fpr), np.asarray(tpr)

@@ -1,0 +1,239 @@
+"""Padded per-query retrieval kernels.
+
+Reference behavior: retrieval/base.py:43-180 + functional/retrieval/*.py. The
+reference sorts by query id, splits into ragged per-query chunks and runs a
+Python loop; ragged splits don't trace under XLA, so the TPU design packs all
+queries into one static ``(num_queries, max_docs)`` grid (pad preds with -inf,
+targets with 0) and evaluates EVERY metric as batched masked tensor ops over
+that grid — one fused kernel instead of a per-query loop.
+
+All kernels take the grid pre-sorted per row by descending prediction score
+(``ranked_target``: the target values in retrieval order) plus the per-query
+document counts, and return one value per query.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+def pad_by_query(indexes: Array, preds: Array, target: Array) -> Tuple[Array, Array, Array]:
+    """Pack flat (doc -> query) data into a static ``(Q, L)`` grid.
+
+    Returns ``(preds_pad, target_pad, counts)`` where ``preds_pad`` is -inf and
+    ``target_pad`` 0 beyond each query's document count. Runs on host shapes
+    (list-state compute path), so numpy-style dynamic shapes are fine here.
+    """
+    indexes = jnp.asarray(indexes).reshape(-1)
+    preds = jnp.asarray(preds, dtype=jnp.float32).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+
+    order = jnp.argsort(indexes, stable=True)
+    indexes, preds, target = indexes[order], preds[order], target[order]
+
+    unique, counts = jnp.unique(indexes, return_counts=True)
+    num_queries = int(unique.shape[0])
+    max_docs = int(counts.max())
+
+    row = jnp.searchsorted(unique, indexes)
+    offsets = jnp.concatenate([jnp.zeros(1, dtype=counts.dtype), jnp.cumsum(counts)[:-1]])
+    col = jnp.arange(indexes.shape[0]) - offsets[row]
+
+    preds_pad = jnp.full((num_queries, max_docs), -jnp.inf, dtype=preds.dtype).at[row, col].set(preds)
+    target_pad = jnp.zeros((num_queries, max_docs), dtype=jnp.float32).at[row, col].set(target.astype(jnp.float32))
+    return preds_pad, target_pad, counts.astype(jnp.int32)
+
+
+def rank_by_preds(preds_pad: Array, target_pad: Array) -> Tuple[Array, Array]:
+    """Sort each row by descending score; returns (ranked_preds, ranked_target)."""
+    order = jnp.argsort(-preds_pad, axis=-1, stable=True)
+    return jnp.take_along_axis(preds_pad, order, axis=-1), jnp.take_along_axis(target_pad, order, axis=-1)
+
+
+def _topk_mask(counts: Array, top_k: Optional[int], length: int) -> Array:
+    """(Q, L) mask of ranks < min(top_k, count_q)."""
+    pos = jnp.arange(length)[None, :]
+    k = counts[:, None] if top_k is None else jnp.minimum(top_k, counts[:, None])
+    return pos < k
+
+
+def hit_counts(ranked_target: Array, counts: Array, top_k: Optional[int]) -> Array:
+    """Number of relevant docs retrieved in the top k of each query."""
+    return jnp.sum(ranked_target * _topk_mask(counts, top_k, ranked_target.shape[-1]), axis=-1)
+
+
+def precision_padded(
+    ranked_target: Array, counts: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Precision@k per query (reference functional/retrieval/precision.py)."""
+    hits = hit_counts(ranked_target, counts, top_k)
+    if top_k is None:
+        denom = counts
+    elif adaptive_k:
+        denom = jnp.minimum(top_k, counts)
+    else:
+        denom = jnp.full_like(counts, top_k)
+    return _safe_divide(hits, denom.astype(hits.dtype))
+
+
+def recall_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k per query (reference functional/retrieval/recall.py)."""
+    hits = hit_counts(ranked_target, counts, top_k)
+    total = jnp.sum(ranked_target, axis=-1)
+    return _safe_divide(hits, total)
+
+
+def fall_out_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k per query: non-relevant retrieved / all non-relevant."""
+    pos = jnp.arange(ranked_target.shape[-1])[None, :]
+    valid = pos < counts[:, None]
+    inv = jnp.where(valid, 1.0 - ranked_target, 0.0)
+    hits = jnp.sum(inv * _topk_mask(counts, top_k, ranked_target.shape[-1]), axis=-1)
+    total = jnp.sum(inv, axis=-1)
+    return _safe_divide(hits, total)
+
+
+def hit_rate_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
+    """1.0 if any relevant doc in the top k (reference functional/retrieval/hit_rate.py)."""
+    return (hit_counts(ranked_target, counts, top_k) > 0).astype(jnp.float32)
+
+
+def average_precision_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
+    """AP per query: mean of precision@rank over relevant ranks in the top k."""
+    mask = _topk_mask(counts, top_k, ranked_target.shape[-1])
+    t = ranked_target * mask
+    ranks = jnp.arange(1, ranked_target.shape[-1] + 1)[None, :].astype(jnp.float32)
+    prec_at_rank = jnp.cumsum(t, axis=-1) / ranks
+    return _safe_divide(jnp.sum(t * prec_at_rank, axis=-1), jnp.sum(t, axis=-1))
+
+
+def reciprocal_rank_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
+    """RR per query: 1/rank of the first relevant doc in the top k; 0 if none."""
+    mask = _topk_mask(counts, top_k, ranked_target.shape[-1])
+    ranks = jnp.arange(1, ranked_target.shape[-1] + 1)[None, :].astype(jnp.float32)
+    return jnp.max(jnp.where(mask & (ranked_target > 0), 1.0 / ranks, 0.0), axis=-1)
+
+
+def r_precision_padded(ranked_target: Array, counts: Array) -> Array:
+    """Precision at k = number-of-relevant per query."""
+    total = jnp.sum(ranked_target, axis=-1)
+    pos = jnp.arange(ranked_target.shape[-1])[None, :]
+    hits = jnp.sum(ranked_target * (pos < total[:, None]), axis=-1)
+    return _safe_divide(hits, total)
+
+
+def _row_segment_ids(ranked_preds: Array) -> Array:
+    """Tie-group ids per row: consecutive equal scores share an id."""
+    boundary = ranked_preds[:, 1:] != ranked_preds[:, :-1]
+    return jnp.concatenate([jnp.zeros((ranked_preds.shape[0], 1), dtype=jnp.int32), jnp.cumsum(boundary, axis=-1, dtype=jnp.int32)], axis=-1)
+
+
+def dcg_padded(
+    ranked_preds: Array, ranked_target: Array, counts: Array, top_k: Optional[int], ignore_ties: bool
+) -> Array:
+    """Tie-averaged discounted cumulative gain per query.
+
+    Reference functional/retrieval/ndcg.py:_dcg_sample_scores/_tie_average_dcg:
+    tied scores share the average of their positions' discounts. Per-row tie
+    groups are reduced with ``segment_sum`` (static segment count = row length)
+    instead of the reference's unique/scatter_add, so the whole grid stays one
+    traced kernel.
+    """
+    length = ranked_target.shape[-1]
+    pos = jnp.arange(length)[None, :]
+    discount = jnp.where(
+        pos < (length if top_k is None else min(top_k, length)),
+        1.0 / jnp.log2(pos + 2.0),
+        0.0,
+    ) * jnp.ones((ranked_target.shape[0], 1))
+
+    if ignore_ties:
+        return jnp.sum(discount * ranked_target, axis=-1)
+
+    gid = _row_segment_ids(ranked_preds)
+    seg_sum = jax.vmap(partial(jax.ops.segment_sum, num_segments=length))
+    group_t = seg_sum(ranked_target, gid)
+    group_c = seg_sum(jnp.ones_like(ranked_target), gid)
+    group_d = seg_sum(discount, gid)
+    return jnp.sum(_safe_divide(group_t, group_c) * group_d, axis=-1)
+
+
+def ndcg_padded(
+    ranked_preds: Array, ranked_target: Array, counts: Array, top_k: Optional[int] = None
+) -> Array:
+    """Normalized DCG per query (reference functional/retrieval/ndcg.py)."""
+    gain = dcg_padded(ranked_preds, ranked_target, counts, top_k, ignore_ties=False)
+    # padded slots (rank >= count) must sort BELOW any real relevance value —
+    # including negatives — so key them to -inf for the ideal ordering
+    pos = jnp.arange(ranked_target.shape[-1])[None, :]
+    key = jnp.where(pos < counts[:, None], ranked_target, -jnp.inf)
+    ideal_target = -jnp.sort(-key, axis=-1)
+    ideal_target = jnp.where(jnp.isfinite(ideal_target), ideal_target, 0.0)
+    ideal = dcg_padded(ideal_target, ideal_target, counts, top_k, ignore_ties=True)
+    return _safe_divide(gain, ideal)
+
+
+def auroc_padded(
+    ranked_preds: Array, ranked_target: Array, counts: Array, top_k: Optional[int] = None
+) -> Array:
+    """AUROC per query over the top-k retrieved docs, tie-aware.
+
+    Equivalent to the reference's per-query ``binary_auroc`` (exact ROC
+    trapezoid) via the Mann-Whitney statistic with tie-averaged ranks.
+    """
+    length = ranked_target.shape[-1]
+    mask = _topk_mask(counts, top_k, length)
+    k = jnp.sum(mask, axis=-1, keepdims=True).astype(jnp.float32)  # selected docs per query
+
+    # tie-averaged ascending rank of each selected doc's score
+    gid = _row_segment_ids(ranked_preds)
+    seg_sum = jax.vmap(partial(jax.ops.segment_sum, num_segments=length))
+    # restrict tie groups to the selection: group size/min-position among selected only.
+    sel = mask.astype(jnp.float32)
+    group_c = seg_sum(sel, gid)
+    group_start = jax.vmap(partial(jax.ops.segment_min, num_segments=length))(
+        jnp.where(mask, jnp.arange(length)[None, :], length), gid
+    ).astype(jnp.float32)
+    # descending positions [start, start+c) -> ascending 1-based ranks average
+    group_avg_asc = k - group_start - (group_c - 1.0) / 2.0
+    avg_rank = jnp.take_along_axis(group_avg_asc, gid, axis=-1)  # (Q, L)
+
+    t = ranked_target * sel
+    npos = jnp.sum(t, axis=-1)
+    nneg = jnp.sum(sel, axis=-1) - npos
+    u = jnp.sum(t * avg_rank, axis=-1) - npos * (npos + 1.0) / 2.0
+    return _safe_divide(u, npos * nneg)
+
+
+def precision_recall_curve_padded(
+    ranked_target: Array, counts: Array, max_k: int, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Per-query precision@k / recall@k for k = 1..max_k.
+
+    Reference functional/retrieval/precision_recall_curve.py: cumulative hits
+    over ranks, divided by k (precision; with adaptive_k the per-query document
+    count caps k) and by the relevant count (recall).
+    """
+    length = ranked_target.shape[-1]
+    pos = jnp.arange(length)[None, :]
+    valid = pos < counts[:, None]
+    t = ranked_target * valid
+    cum = jnp.cumsum(t, axis=-1)
+    # hits at k = cum[min(k, n) - 1]
+    ks = jnp.arange(1, max_k + 1)[None, :]  # (1, max_k)
+    idx = jnp.minimum(ks, counts[:, None]) - 1  # (Q, max_k)
+    hits = jnp.take_along_axis(cum, jnp.minimum(idx, length - 1), axis=-1)
+    total = jnp.sum(t, axis=-1, keepdims=True)
+    recall = _safe_divide(hits, total)
+    if adaptive_k:
+        topk = jnp.minimum(ks, counts[:, None]).astype(jnp.float32)
+    else:
+        topk = jnp.broadcast_to(ks, hits.shape).astype(jnp.float32)
+    precision = _safe_divide(hits, topk)
+    return precision, recall, jnp.arange(1, max_k + 1)

@@ -1,0 +1,150 @@
+"""Single-query retrieval functionals (reference functional/retrieval/*.py).
+
+Each takes 1-D ``preds``/``target`` for ONE query, mirroring the reference API;
+all delegate to the padded grid kernels with a single row (so the functional
+and modular paths share one implementation).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.retrieval._padded import (
+    auroc_padded,
+    average_precision_padded,
+    fall_out_padded,
+    hit_rate_padded,
+    ndcg_padded,
+    precision_padded,
+    precision_recall_curve_padded,
+    r_precision_padded,
+    rank_by_preds,
+    recall_padded,
+    reciprocal_rank_padded,
+)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Validate one query's inputs (reference utilities/checks.py:553-582)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and bool(jnp.any((target != 0) & (target != 1))):
+        raise ValueError("`target` must contain binary values")
+    return preds.astype(jnp.float32).reshape(-1), target.astype(jnp.float32).reshape(-1)
+
+
+def _check_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def _one_row(preds: Array, target: Array):
+    preds_pad = preds[None, :]
+    target_pad = target[None, :]
+    counts = jnp.asarray([preds.shape[0]], dtype=jnp.int32)
+    ranked_preds, ranked_target = rank_by_preds(preds_pad, target_pad)
+    return ranked_preds, ranked_target, counts
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return precision_padded(ranked_target, counts, top_k, adaptive_k)[0]
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return recall_padded(ranked_target, counts, top_k)[0]
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return fall_out_padded(ranked_target, counts, top_k)[0]
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return hit_rate_padded(ranked_target, counts, top_k)[0]
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return average_precision_padded(ranked_target, counts, top_k)[0]
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    _, ranked_target, counts = _one_row(preds, target)
+    return reciprocal_rank_padded(ranked_target, counts, top_k)[0]
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _, ranked_target, counts = _one_row(preds, target)
+    return r_precision_padded(ranked_target, counts)[0]
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    _check_top_k(top_k)
+    ranked_preds, ranked_target, counts = _one_row(preds, target)
+    return ndcg_padded(ranked_preds, ranked_target, counts, top_k)[0]
+
+
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _check_top_k(top_k)
+    if max_fpr is not None:
+        if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        # partial AUC needs the full ROC curve; reuse the classification kernel
+        from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+        k = preds.shape[0] if top_k is None else min(top_k, preds.shape[0])
+        order = jnp.argsort(-preds, stable=True)[:k]
+        return binary_auroc(preds[order], target[order].astype(jnp.int32), max_fpr=max_fpr)
+    ranked_preds, ranked_target, counts = _one_row(preds, target)
+    return auroc_padded(ranked_preds, ranked_target, counts, top_k)[0]
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    _, ranked_target, counts = _one_row(preds, target)
+    precision, recall, topk = precision_recall_curve_padded(ranked_target, counts, max_k, adaptive_k)
+    if adaptive_k and max_k > preds.shape[-1]:
+        topk = jnp.clip(topk, None, preds.shape[-1])
+    return precision[0], recall[0], topk

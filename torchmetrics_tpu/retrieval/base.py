@@ -1,0 +1,151 @@
+"""RetrievalMetric base (reference retrieval/base.py:43-180).
+
+State is three growing ``cat`` lists (indexes/preds/target). At compute time the
+ragged per-query groups become one static padded grid evaluated by a single
+batched kernel (see functional/retrieval/_padded.py) — replacing the reference's
+sort + split + per-query Python loop with one XLA dispatch.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.retrieval._padded import pad_by_query, rank_by_preds
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable], dim: int = 0) -> Array:
+    """Aggregate per-query values (reference retrieval/base.py:24-40)."""
+    if aggregation == "mean":
+        return jnp.mean(values, axis=dim)
+    if aggregation == "median":
+        # torch.median semantics: lower of the two middle elements, not their mean
+        n = values.shape[dim]
+        return jnp.take(jnp.sort(values, axis=dim), (n - 1) // 2, axis=dim)
+    if aggregation == "min":
+        return jnp.min(values, axis=dim)
+    if aggregation == "max":
+        return jnp.max(values, axis=dim)
+    return aggregation(values, dim=dim)
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for query-grouped metrics.
+
+    Update accepts ``(preds, target, indexes)`` of equal shape; compute groups
+    by query id and averages the per-query ``_metric_padded`` values, honoring
+    ``empty_target_action`` in {'error','skip','neg','pos'} for queries with no
+    positive target.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    allow_non_binary_target: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable function"
+                f"which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes = jnp.asarray(indexes)
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if indexes.shape != preds.shape or preds.shape != target.shape:
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        if not jnp.issubdtype(indexes.dtype, jnp.integer):
+            raise ValueError("`indexes` must be a tensor of long integers")
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("`preds` must be a tensor of floats")
+
+        if self.ignore_index is not None:
+            valid = (target != self.ignore_index).reshape(-1)
+            indexes = indexes.reshape(-1)[valid]
+            preds = preds.reshape(-1)[valid]
+            target = target.reshape(-1)[valid]
+        if indexes.size == 0:
+            raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+        if not self.allow_non_binary_target and bool(jnp.any((target != 0) & (target != 1))):
+            raise ValueError("`target` must contain binary values")
+
+        self.indexes.append(indexes.reshape(-1).astype(jnp.int32))
+        self.preds.append(preds.reshape(-1).astype(jnp.float32))
+        self.target.append(target.reshape(-1).astype(jnp.float32))
+
+    _empty_target_kind: str = "positive"  # which class being absent makes a query "empty"
+
+    def _grouped_state(self):
+        """Concatenate list states and pack into the padded per-query grid."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return pad_by_query(indexes, preds, target)
+
+    def _empty_mask(self, target_pad: Array, counts: Array) -> Array:
+        """(Q,) mask of queries with no positive target (overridable, e.g. fall-out)."""
+        return jnp.sum(target_pad, axis=-1) == 0
+
+    def _apply_empty_target_action(self, values: Array, empty: Array) -> Optional[Array]:
+        """Resolve empty queries per ``empty_target_action``.
+
+        ``values`` is (Q,) or (Q, K) (curves). Returns None when 'skip' drops
+        every query — callers substitute their zero result.
+        """
+        if self.empty_target_action == "error" and bool(jnp.any(empty)):
+            raise ValueError(
+                f"`compute` method was provided with a query with no {self._empty_target_kind} target."
+            )
+        mask = empty if values.ndim == 1 else empty[:, None]
+        if self.empty_target_action == "pos":
+            return jnp.where(mask, 1.0, values)
+        if self.empty_target_action == "neg":
+            return jnp.where(mask, 0.0, values)
+        if self.empty_target_action == "skip":
+            keep = ~empty
+            if not bool(jnp.any(keep)):
+                return None
+            return values[keep]
+        return values
+
+    def compute(self) -> Array:
+        preds_pad, target_pad, counts = self._grouped_state()
+        ranked_preds, ranked_target = rank_by_preds(preds_pad, target_pad)
+        values = self._metric_padded(ranked_preds, ranked_target, counts)
+        values = self._apply_empty_target_action(values, self._empty_mask(target_pad, counts))
+        if values is None:
+            return jnp.asarray(0.0)
+        return _retrieval_aggregate(values, self.aggregation)
+
+    @abstractmethod
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        """Per-query metric over the ranked padded grid -> (num_queries,)."""

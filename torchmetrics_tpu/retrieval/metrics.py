@@ -1,0 +1,199 @@
+"""Modular retrieval metrics (reference retrieval/*.py, one class per file there).
+
+Each subclass binds one padded kernel; RetrievalPrecisionRecallCurve overrides
+``compute`` since it returns curves rather than per-query scalars.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.retrieval._padded import (
+    auroc_padded,
+    average_precision_padded,
+    fall_out_padded,
+    hit_rate_padded,
+    ndcg_padded,
+    precision_padded,
+    precision_recall_curve_padded,
+    r_precision_padded,
+    rank_by_preds,
+    recall_padded,
+    reciprocal_rank_padded,
+)
+from torchmetrics_tpu.functional.retrieval.metrics import _check_top_k
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _retrieval_aggregate
+
+
+class _TopKRetrievalMetric(RetrievalMetric):
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+
+class RetrievalMAP(_TopKRetrievalMetric):
+    """Mean average precision (reference retrieval/average_precision.py)."""
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return average_precision_padded(ranked_target, counts, self.top_k)
+
+
+class RetrievalMRR(_TopKRetrievalMetric):
+    """Mean reciprocal rank (reference retrieval/reciprocal_rank.py)."""
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return reciprocal_rank_padded(ranked_target, counts, self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k (reference retrieval/precision.py)."""
+
+    def __init__(self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _check_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return precision_padded(ranked_target, counts, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Recall@k (reference retrieval/recall.py)."""
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return recall_padded(ranked_target, counts, self.top_k)
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """Fall-out@k (reference retrieval/fall_out.py). Empty queries = no NEGATIVE target."""
+
+    higher_is_better = False
+    _empty_target_kind = "negative"
+
+    def _empty_mask(self, target_pad: Array, counts: Array) -> Array:
+        pos = jnp.arange(target_pad.shape[-1])[None, :]
+        valid = pos < counts[:, None]
+        return jnp.sum((1.0 - target_pad) * valid, axis=-1) == 0
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return fall_out_padded(ranked_target, counts, self.top_k)
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """Hit rate@k (reference retrieval/hit_rate.py)."""
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return hit_rate_padded(ranked_target, counts, self.top_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision (reference retrieval/r_precision.py)."""
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return r_precision_padded(ranked_target, counts)
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """nDCG with tie-averaged gains (reference retrieval/ndcg.py)."""
+
+    allow_non_binary_target = True
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        return ndcg_padded(ranked_preds, ranked_target, counts, self.top_k)
+
+
+class RetrievalAUROC(_TopKRetrievalMetric):
+    """Per-query AUROC over retrieved docs (reference retrieval/auroc.py)."""
+
+    def __init__(self, top_k: Optional[int] = None, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
+        super().__init__(top_k=top_k, **kwargs)
+        if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.max_fpr = max_fpr
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        if self.max_fpr is not None:
+            # partial AUC needs per-query ROC curves: evaluate query-by-query on host
+            from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+            values = []
+            for q in range(ranked_target.shape[0]):
+                n = int(counts[q])
+                k = n if self.top_k is None else min(self.top_k, n)
+                values.append(
+                    binary_auroc(ranked_preds[q, :k], ranked_target[q, :k].astype(jnp.int32), max_fpr=self.max_fpr)
+                )
+            return jnp.stack(values)
+        return auroc_padded(ranked_preds, ranked_target, counts, self.top_k)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged precision/recall@k curves (reference retrieval/precision_recall_curve.py:63-255)."""
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action, ignore_index=ignore_index, aggregation=aggregation, **kwargs
+        )
+        if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        preds_pad, target_pad, counts = self._grouped_state()
+        _, ranked_target = rank_by_preds(preds_pad, target_pad)
+        max_k = self.max_k if self.max_k is not None else int(counts.max())
+
+        precisions, recalls, top_k = precision_recall_curve_padded(ranked_target, counts, max_k, self.adaptive_k)
+
+        empty = self._empty_mask(target_pad, counts)
+        precisions = self._apply_empty_target_action(precisions, empty)
+        recalls = self._apply_empty_target_action(recalls, empty)
+        if precisions is None or recalls is None:
+            z = jnp.zeros(max_k)
+            return z, z, top_k
+
+        precision = _retrieval_aggregate(precisions, self.aggregation, dim=0)
+        recall = _retrieval_aggregate(recalls, self.aggregation, dim=0)
+        return precision, recall, top_k
+
+    def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
+        raise NotImplementedError  # compute() is fully overridden
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall with precision >= min_precision (reference precision_recall_curve.py:296-391)."""
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(max_k=max_k, **kwargs)
+        if not isinstance(min_precision, float) or not 0.0 <= min_precision <= 1.0:
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precisions, recalls, top_k = super().compute()
+        ok = precisions >= self.min_precision
+        masked_recall = jnp.where(ok, recalls, -jnp.inf)
+        # max recall, breaking ties by larger k (reference max() over (r, k) tuples)
+        best_recall = jnp.max(masked_recall)
+        if not bool(jnp.isfinite(best_recall)) or float(best_recall) == 0.0:
+            return jnp.asarray(0.0), jnp.asarray(int(top_k.shape[0]))
+        is_best = masked_recall == best_recall
+        best_k = jnp.max(jnp.where(is_best, top_k, 0))
+        return best_recall, best_k
