@@ -1,0 +1,272 @@
+"""Detection metric parity tests.
+
+Oracles: the reference's pure-torch code where usable (IoU modular classes,
+panoptic quality, legacy _mean_ap) with a shimmed torchvision providing the
+standard box formulas.
+"""
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+sys.path.insert(0, "/root/repo/tests")
+sys.path.insert(0, "/root/repo/tests/detection")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+import torchvision_shim  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+torchvision_shim.install()
+
+# reference PQ gates on a torch>=1.12 flag that the RequirementCache shim zeroes out
+import torchmetrics.detection.panoptic_qualities as _ref_pq_mod  # noqa: E402
+import torchmetrics.functional.detection._panoptic_quality_common as _ref_pq_common  # noqa: E402
+import torchmetrics.functional.detection.panoptic_qualities as _ref_pq_func  # noqa: E402
+
+for _m in (_ref_pq_mod, _ref_pq_common, _ref_pq_func):
+    if hasattr(_m, "_TORCH_GREATER_EQUAL_1_12"):
+        _m._TORCH_GREATER_EQUAL_1_12 = True
+
+import torchmetrics_tpu as tm  # noqa: E402
+import torchmetrics_tpu.functional as F  # noqa: E402
+
+rng = np.random.RandomState(5)
+
+
+def _rand_boxes(n, size=200.0):
+    xy = rng.rand(n, 2).astype(np.float32) * size
+    wh = (rng.rand(n, 2).astype(np.float32) * 60 + 2)
+    return np.concatenate([xy, xy + wh], axis=1)
+
+
+class TestIoUFunctional:
+    @pytest.mark.parametrize(
+        "ours,shim",
+        [
+            (F.intersection_over_union, torchvision_shim.box_iou),
+            (F.generalized_intersection_over_union, torchvision_shim.generalized_box_iou),
+            (F.distance_intersection_over_union, torchvision_shim.distance_box_iou),
+            (F.complete_intersection_over_union, torchvision_shim.complete_box_iou),
+        ],
+    )
+    def test_pairwise_matrix(self, ours, shim):
+        a, b = _rand_boxes(8), _rand_boxes(6)
+        got = np.asarray(ours(a, b, aggregate=False))
+        want = shim(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_aggregate_and_threshold(self):
+        a, b = _rand_boxes(5), _rand_boxes(5)
+        got = np.asarray(F.intersection_over_union(a, b, iou_threshold=0.3))
+        iou = torchvision_shim.box_iou(torch.from_numpy(a), torch.from_numpy(b))
+        iou[iou < 0.3] = 0
+        np.testing.assert_allclose(got, iou.diag().mean().numpy(), atol=1e-4)
+
+    def test_reference_docstring_value(self):
+        # anchor to the reference's own documented example (functional/detection/iou.py)
+        preds = np.asarray(
+            [[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98], [356.62, 95.47, 372.33, 147.55]],
+            dtype=np.float32,
+        )
+        target = np.asarray(
+            [[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00], [350.00, 100.00, 375.00, 150.00]],
+            dtype=np.float32,
+        )
+        np.testing.assert_allclose(float(F.intersection_over_union(preds, target)), 0.5879, atol=1e-4)
+        np.testing.assert_allclose(float(F.generalized_intersection_over_union(preds, target)), 0.5638, atol=1e-2)
+
+
+class TestIoUModular:
+    def _inputs(self, n_img=4):
+        preds, target = [], []
+        for _ in range(n_img):
+            n_d, n_g = rng.randint(1, 6), rng.randint(1, 6)
+            preds.append(
+                {"boxes": _rand_boxes(n_d), "labels": rng.randint(0, 3, n_d), "scores": rng.rand(n_d).astype(np.float32)}
+            )
+            target.append({"boxes": _rand_boxes(n_g), "labels": rng.randint(0, 3, n_g)})
+        return preds, target
+
+    @pytest.mark.parametrize("cls_name,mod_name", [
+        ("IntersectionOverUnion", "iou"), ("GeneralizedIntersectionOverUnion", "giou"),
+        ("DistanceIntersectionOverUnion", "diou"), ("CompleteIntersectionOverUnion", "ciou"),
+    ])
+    @pytest.mark.parametrize("respect_labels", [True, False])
+    def test_parity(self, cls_name, mod_name, respect_labels):
+        import importlib
+
+        # reference classes gate on torchvision flags; force them on (shim installed)
+        ref_mod = importlib.import_module(f"torchmetrics.detection.{mod_name}")
+        for m_name in (
+            f"torchmetrics.detection.{mod_name}",
+            f"torchmetrics.functional.detection.{mod_name}",
+        ):
+            m = importlib.import_module(m_name)
+            for flag in ("_TORCHVISION_GREATER_EQUAL_0_8", "_TORCHVISION_GREATER_EQUAL_0_13"):
+                if hasattr(m, flag):
+                    setattr(m, flag, True)
+
+        preds, target = self._inputs()
+        ours = getattr(tm, cls_name)(respect_labels=respect_labels, class_metrics=True)
+        ref = getattr(ref_mod, cls_name)(respect_labels=respect_labels, class_metrics=True)
+        ours.update(preds, target)
+        ref.update(
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+        )
+        got = ours.compute()
+        want = ref.compute()
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-3, err_msg=k)
+
+
+class TestPanopticQuality:
+    def _inputs(self):
+        # (B, H, W, 2) category/instance maps
+        b, h, w = 2, 12, 12
+        cats = np.array([0, 1, 6, 7])
+        preds = np.stack(
+            [cats[rng.randint(0, 4, (h, w))], rng.randint(0, 3, (h, w))], axis=-1
+        )
+        preds = np.stack([preds, np.stack([cats[rng.randint(0, 4, (h, w))], rng.randint(0, 3, (h, w))], axis=-1)])
+        target = preds.copy()
+        # perturb some pixels
+        m = rng.rand(b, h, w) < 0.25
+        target[m] = np.stack([cats[rng.randint(0, 4, m.sum())], rng.randint(0, 3, m.sum())], axis=-1)
+        return preds, target
+
+    @pytest.mark.parametrize("return_sq_and_rq", [False, True])
+    @pytest.mark.parametrize("return_per_class", [False, True])
+    def test_parity(self, return_sq_and_rq, return_per_class):
+        preds, target = self._inputs()
+        kw = {"things": {0, 1}, "stuffs": {6, 7}, "return_sq_and_rq": return_sq_and_rq, "return_per_class": return_per_class}
+        ours = tm.PanopticQuality(**kw)
+        ref = ref_tm.detection.PanopticQuality(**kw)
+        ours.update(preds, target)
+        ref.update(torch.from_numpy(preds), torch.from_numpy(target))
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+    def test_modified_pq(self):
+        preds, target = self._inputs()
+        ours = tm.ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+        ref = ref_tm.detection.ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+        ours.update(preds, target)
+        ref.update(torch.from_numpy(preds), torch.from_numpy(target))
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+    def test_functional(self):
+        preds, target = self._inputs()
+        got = F.panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+        want = ref_tm.functional.detection.panoptic_quality(
+            torch.from_numpy(preds), torch.from_numpy(target), things={0, 1}, stuffs={6, 7}
+        )
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="distinct"):
+            tm.PanopticQuality(things={0, 1}, stuffs={1, 2})
+        m = tm.PanopticQuality(things={0}, stuffs={1})
+        with pytest.raises(ValueError, match="Unknown categories"):
+            m.update(np.full((1, 4, 4, 2), 9), np.zeros((1, 4, 4, 2), dtype=int))
+
+
+class TestMeanAveragePrecision:
+    def _inputs(self, n_img=6, seed=17):
+        r = np.random.RandomState(seed)
+
+        def boxes(n):
+            xy = r.rand(n, 2).astype(np.float32) * 150
+            wh = r.rand(n, 2).astype(np.float32) * 80 + 4
+            return np.concatenate([xy, xy + wh], axis=1)
+
+        preds, target = [], []
+        for _ in range(n_img):
+            n_g = r.randint(1, 7)
+            gt = boxes(n_g)
+            gt_labels = r.randint(0, 4, n_g)
+            # detections: jittered gts + noise boxes
+            keep = r.rand(n_g) > 0.25
+            det = gt[keep] + r.randn(keep.sum(), 4).astype(np.float32) * 6
+            det_labels = gt_labels[keep].copy()
+            flip = r.rand(len(det_labels)) < 0.2
+            det_labels[flip] = r.randint(0, 4, flip.sum())
+            extra = boxes(r.randint(0, 4))
+            det = np.concatenate([det, extra]) if len(extra) else det
+            det_labels = np.concatenate([det_labels, r.randint(0, 4, len(extra))])
+            scores = r.rand(len(det)).astype(np.float32)
+            preds.append({"boxes": det.astype(np.float32), "scores": scores, "labels": det_labels})
+            target.append({"boxes": gt, "labels": gt_labels})
+        return preds, target
+
+    def _legacy_oracle(self, class_metrics=False):
+        import torchmetrics.detection._mean_ap as legacy
+
+        legacy._TORCHVISION_GREATER_EQUAL_0_8 = True
+        legacy._PYCOCOTOOLS_AVAILABLE = True  # only guards __init__; bbox path never imports it
+        return legacy.MeanAveragePrecision(class_metrics=class_metrics)
+
+    @pytest.mark.parametrize("class_metrics", [False, True])
+    def test_parity_vs_legacy(self, class_metrics):
+        preds, target = self._inputs()
+        ours = tm.MeanAveragePrecision(class_metrics=class_metrics)
+        ref = self._legacy_oracle(class_metrics=class_metrics)
+        half = len(preds) // 2
+        ours.update(preds[:half], target[:half])
+        ours.update(preds[half:], target[half:])
+        ref.update(
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+        )
+        got = ours.compute()
+        want = ref.compute()
+        for k in ("map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+                  "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large"):
+            np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-5, err_msg=k)
+        if class_metrics:
+            np.testing.assert_allclose(
+                np.asarray(got["map_per_class"]), want["map_per_class"].numpy(), atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(got["mar_100_per_class"]), want["mar_100_per_class"].numpy(), atol=1e-5
+            )
+        np.testing.assert_array_equal(np.asarray(got["classes"]), want["classes"].numpy())
+
+    def test_empty_preds(self):
+        preds = [{"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros(0, np.float32), "labels": np.zeros(0, np.int64)}]
+        target = [{"boxes": _rand_boxes(3), "labels": np.asarray([0, 1, 1])}]
+        m = tm.MeanAveragePrecision()
+        m.update(preds, target)
+        res = m.compute()
+        assert float(res["map"]) == 0.0
+
+    def test_perfect_detection(self):
+        gt = _rand_boxes(4)
+        labels = np.asarray([0, 1, 2, 3])
+        preds = [{"boxes": gt, "scores": np.ones(4, np.float32), "labels": labels}]
+        target = [{"boxes": gt, "labels": labels}]
+        m = tm.MeanAveragePrecision()
+        m.update(preds, target)
+        assert float(m.compute()["map"]) > 0.99
+
+    def test_crowd_absorbs_detections(self):
+        # a det covering a crowd gt must be ignored, not counted as FP
+        gt = _rand_boxes(2)
+        preds = [{"boxes": gt, "scores": np.asarray([0.95, 0.9], np.float32), "labels": np.asarray([0, 0])}]
+        target = [{"boxes": gt, "labels": np.asarray([0, 0]), "iscrowd": np.asarray([1, 0])}]
+        m = tm.MeanAveragePrecision()
+        m.update(preds, target)
+        assert float(m.compute()["map"]) > 0.99
+
+    def test_segm_iou_type(self):
+        h = w = 24
+        masks_gt = np.zeros((2, h, w), bool)
+        masks_gt[0, 2:10, 2:10] = True
+        masks_gt[1, 12:20, 12:22] = True
+        masks_dt = np.zeros((2, h, w), bool)
+        masks_dt[0, 3:10, 2:10] = True
+        masks_dt[1, 12:21, 12:22] = True
+        preds = [{"masks": masks_dt, "scores": np.asarray([0.9, 0.8], np.float32), "labels": np.asarray([0, 1])}]
+        target = [{"masks": masks_gt, "labels": np.asarray([0, 1])}]
+        m = tm.MeanAveragePrecision(iou_type="segm")
+        m.update(preds, target)
+        assert float(m.compute()["map"]) > 0.5

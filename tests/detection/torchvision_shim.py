@@ -1,0 +1,107 @@
+"""Minimal torch-based box-op shim so the reference's pure-torch detection code
+can serve as a test oracle (torchvision is not installed in this image).
+
+These are independent textbook implementations of the standard box formulas,
+used ONLY as the oracle for comparison.
+"""
+import sys
+import types
+
+import torch
+
+
+def box_area(boxes):
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def _inter_union(boxes1, boxes2):
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1, boxes2):
+    inter, union = _inter_union(boxes1, boxes2)
+    return inter / union
+
+
+def generalized_box_iou(boxes1, boxes2):
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / hull
+
+
+def distance_box_iou(boxes1, boxes2, eps: float = 1e-7):
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    diag = wh[..., 0] ** 2 + wh[..., 1] ** 2 + eps
+    c1 = (boxes1[:, :2] + boxes1[:, 2:]) / 2
+    c2 = (boxes2[:, :2] + boxes2[:, 2:]) / 2
+    d = c1[:, None, :] - c2[None, :, :]
+    dist = d[..., 0] ** 2 + d[..., 1] ** 2
+    return iou - dist / diag
+
+
+def complete_box_iou(boxes1, boxes2, eps: float = 1e-7):
+    diou = distance_box_iou(boxes1, boxes2, eps)
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    import math
+
+    v = (4 / math.pi**2) * (torch.atan(w2 / h2)[None, :] - torch.atan(w1 / h1)[:, None]) ** 2
+    alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def box_convert(boxes, in_fmt, out_fmt):
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xywh":
+        x, y, w, h = boxes.unbind(-1)
+        boxes = torch.stack([x, y, x + w, y + h], -1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = boxes.unbind(-1)
+        boxes = torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+    if out_fmt == "xyxy":
+        return boxes
+    x1, y1, x2, y2 = boxes.unbind(-1)
+    if out_fmt == "xywh":
+        return torch.stack([x1, y1, x2 - x1, y2 - y1], -1)
+    return torch.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+
+
+def install():
+    """Register fake `torchvision` (+ inert `pycocotools.mask`) modules."""
+    if "torchvision" not in sys.modules:
+        tv = types.ModuleType("torchvision")
+        ops = types.ModuleType("torchvision.ops")
+        for fn in (box_area, box_iou, generalized_box_iou, distance_box_iou, complete_box_iou, box_convert):
+            setattr(ops, fn.__name__, fn)
+        tv.ops = ops
+        tv.__version__ = "0.15.0"
+        sys.modules["torchvision"] = tv
+        sys.modules["torchvision.ops"] = ops
+    if "pycocotools" not in sys.modules:
+        # the legacy mAP imports pycocotools.mask unconditionally but only calls
+        # it for iou_type="segm", which these tests never use on the oracle
+        pc = types.ModuleType("pycocotools")
+        mask = types.ModuleType("pycocotools.mask")
+        pc.mask = mask
+        sys.modules["pycocotools"] = pc
+        sys.modules["pycocotools.mask"] = mask

@@ -90,7 +90,16 @@ from torchmetrics_tpu.text import (  # noqa: F401
     WordInfoLost,
     WordInfoPreserved,
 )
-from torchmetrics_tpu import audio, retrieval  # noqa: F401
+from torchmetrics_tpu import audio, detection, retrieval  # noqa: F401
+from torchmetrics_tpu.detection import (  # noqa: F401
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
 from torchmetrics_tpu.retrieval import (  # noqa: F401
     RetrievalAUROC,
     RetrievalFallOut,

@@ -1,0 +1,21 @@
+from torchmetrics_tpu.detection.iou import (  # noqa: F401
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision  # noqa: F401
+from torchmetrics_tpu.detection.panoptic_qualities import (  # noqa: F401
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

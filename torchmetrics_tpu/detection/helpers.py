@@ -1,0 +1,65 @@
+"""Input validation for detection metrics (reference detection/helpers.py)."""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fix_empty_tensors(boxes) -> jnp.ndarray:
+    """Empty tensors get a (0, 4) shape so pairwise ops stay well-formed."""
+    boxes = jnp.asarray(boxes, dtype=jnp.float32)
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape(0, 4)
+    return boxes
+
+
+def _input_validator(
+    preds: Sequence[Dict],
+    targets: Sequence[Dict],
+    iou_type: str = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Check list-of-dicts detection inputs (reference detection/helpers.py:24-72)."""
+    item_val_name = "boxes" if iou_type == "bbox" else "masks"
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+
+    for k in [item_val_name, "labels"] + (["scores"] if not ignore_score else []):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in [item_val_name, "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    for i, item in enumerate(targets):
+        n_gt = np.asarray(item[item_val_name]).shape[0] if np.asarray(item[item_val_name]).size else 0
+        n_lab = np.asarray(item["labels"]).reshape(-1).shape[0]
+        if n_gt != n_lab:
+            raise ValueError(
+                f"Input '{item_val_name}' and labels of sample {i} in targets have a"
+                f" different length (expected {n_gt} labels, got {n_lab})"
+            )
+    for i, item in enumerate(preds):
+        n_det = np.asarray(item[item_val_name]).shape[0] if np.asarray(item[item_val_name]).size else 0
+        n_lab = np.asarray(item["labels"]).reshape(-1).shape[0]
+        if not ignore_score:
+            n_sc = np.asarray(item["scores"]).reshape(-1).shape[0]
+            if n_det != n_lab or n_det != n_sc:
+                raise ValueError(
+                    f"Input '{item_val_name}', labels and scores of sample {i} in predictions have a"
+                    f" different length (expected {n_det} labels and scores, got {n_lab} labels and {n_sc})"
+                )
+        elif n_det != n_lab:
+            raise ValueError(
+                f"Input '{item_val_name}' and labels of sample {i} in predictions have a"
+                f" different length (expected {n_det} labels, got {n_lab})"
+            )

@@ -1,0 +1,119 @@
+"""Modular IoU-family metrics (reference detection/{iou,giou,diou,ciou}.py).
+
+One base class parameterised by the pairwise function; states accumulate the
+per-image IoU matrices (list state) plus ground-truth labels for the optional
+per-class breakdown.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from torchmetrics_tpu.functional.detection.iou import (
+    box_convert,
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class IntersectionOverUnion(Metric):
+    """Mean pairwise IoU over matching-label box pairs (reference detection/iou.py:28-200)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+    _pairwise_fn: Callable = staticmethod(box_iou)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("iou_matrix", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        _input_validator(preds, target, ignore_score=True)
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            t_labels = jnp.asarray(t["labels"]).reshape(-1)
+            p_labels = jnp.asarray(p["labels"]).reshape(-1)
+            self.groundtruth_labels.append(t_labels)
+
+            iou_matrix = type(self)._pairwise_fn(det_boxes, gt_boxes)  # N x M
+            if self.iou_threshold is not None:
+                iou_matrix = jnp.where(iou_matrix < self.iou_threshold, self._invalid_val, iou_matrix)
+            if self.respect_labels and iou_matrix.size:
+                label_eq = p_labels[:, None] == t_labels[None, :]
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            self.iou_matrix.append(iou_matrix)
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(boxes)
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def compute(self) -> dict:
+        valid = [mat[mat != self._invalid_val] for mat in self.iou_matrix]
+        flat = jnp.concatenate([v.reshape(-1) for v in valid]) if valid else jnp.zeros(0)
+        score = jnp.mean(flat) if flat.size else jnp.asarray(0.0)
+        results: Dict[str, Array] = {f"{self._iou_type}": score}
+
+        if self.class_metrics:
+            gt_labels = dim_zero_cat(self.groundtruth_labels)
+            classes = np.unique(np.asarray(gt_labels)).tolist() if gt_labels.size else []
+            for cl in classes:
+                masked_iou, observed = jnp.zeros_like(score), jnp.zeros_like(score)
+                for mat, gt_lab in zip(self.iou_matrix, self.groundtruth_labels):
+                    scores = mat[:, np.asarray(gt_lab) == cl]
+                    masked_iou = masked_iou + jnp.sum(jnp.where(scores != self._invalid_val, scores, 0.0))
+                    observed = observed + jnp.sum(scores != self._invalid_val)
+                results.update({f"{self._iou_type}/cl_{int(cl)}": masked_iou / observed})
+        return results
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    _iou_type: str = "giou"
+    _invalid_val: float = -1.0
+    _pairwise_fn = staticmethod(generalized_box_iou)
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    _iou_type: str = "diou"
+    _invalid_val: float = -1.0
+    _pairwise_fn = staticmethod(distance_box_iou)
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    _iou_type: str = "ciou"
+    _invalid_val: float = -2.0
+    _pairwise_fn = staticmethod(complete_box_iou)

@@ -1,0 +1,116 @@
+"""Modular PanopticQuality / ModifiedPanopticQuality (reference detection/panoptic_qualities.py:40-295)."""
+from __future__ import annotations
+
+from typing import Any, Collection
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.detection.panoptic_quality import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+from torchmetrics_tpu.metric import Metric
+
+
+class PanopticQuality(Metric):
+    """Panoptic quality over (category, instance) maps.
+
+    States are the four per-category accumulators (sum-reduced across devices);
+    all segment extraction happens at update time.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things, stuffs = _parse_categories(things, stuffs)
+        self.things = things
+        self.stuffs = stuffs
+        self.void_color = _get_void_color(things, stuffs)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+
+        num_categories = len(things) + len(stuffs)
+        self.add_state("iou_sum", default=jnp.zeros(num_categories), dist_reduce_fx="sum")
+        self.add_state("true_positives", default=jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", default=jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", default=jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_stats(self, preds: Array, target: Array, modified_metric_stuffs=None) -> None:
+        preds = np.asarray(preds)
+        target = np.asarray(target)
+        _validate_inputs(preds, target)
+        flatten_preds = _preprocess_inputs(
+            self.things, self.stuffs, preds, self.void_color, self.allow_unknown_preds_category
+        )
+        flatten_target = _preprocess_inputs(self.things, self.stuffs, target, self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            flatten_preds, flatten_target, self.cat_id_to_continuous_id, self.void_color, modified_metric_stuffs
+        )
+        self.iou_sum = self.iou_sum + iou_sum
+        self.true_positives = self.true_positives + tp.astype(self.true_positives.dtype)
+        self.false_positives = self.false_positives + fp.astype(self.false_positives.dtype)
+        self.false_negatives = self.false_negatives + fn.astype(self.false_negatives.dtype)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_stats(preds, target)
+
+    def compute(self) -> Array:
+        pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+            self.iou_sum, self.true_positives, self.false_positives, self.false_negatives
+        )
+        if self.return_per_class:
+            if self.return_sq_and_rq:
+                return jnp.stack((pq, sq, rq), axis=-1)
+            return pq.reshape(1, -1)
+        if self.return_sq_and_rq:
+            return jnp.stack((pq_avg, sq_avg, rq_avg))
+        return pq_avg
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """PQ with the modified stuff formula (reference detection/panoptic_qualities.py:295+)."""
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            things=things,
+            stuffs=stuffs,
+            allow_unknown_preds_category=allow_unknown_preds_category,
+            **kwargs,
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_stats(preds, target, modified_metric_stuffs=self.stuffs)
+
+    def compute(self) -> Array:
+        _, _, _, pq_avg, _, _ = _panoptic_quality_compute(
+            self.iou_sum, self.true_positives, self.false_positives, self.false_negatives
+        )
+        return pq_avg

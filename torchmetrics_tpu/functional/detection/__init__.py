@@ -1,0 +1,19 @@
+from torchmetrics_tpu.functional.detection.iou import (  # noqa: F401
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+from torchmetrics_tpu.functional.detection.panoptic_quality import (  # noqa: F401
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+__all__ = [
+    "complete_intersection_over_union",
+    "distance_intersection_over_union",
+    "generalized_intersection_over_union",
+    "intersection_over_union",
+    "modified_panoptic_quality",
+    "panoptic_quality",
+]

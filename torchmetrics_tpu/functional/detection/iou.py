@@ -1,0 +1,174 @@
+"""Box algebra + IoU family (reference functional/detection/{iou,giou,diou,ciou}.py).
+
+The reference delegates to torchvision's C++ ops (functional/detection/iou.py:24-29);
+here the box math is plain batched JAX — a handful of fused elementwise ops that XLA
+maps straight onto the VPU, no custom kernel needed.
+
+Boxes are ``(x1, y1, x2, y2)`` rows; all pairwise fns take ``(N, 4), (M, 4)`` and
+return ``(N, M)``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+_EPS = 1e-7
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
+    """Convert between xyxy / xywh / cxcywh box formats."""
+    boxes = jnp.asarray(boxes, dtype=jnp.float32)
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    elif in_fmt != "xyxy":
+        raise ValueError(f"Unknown box format {in_fmt}")
+    if out_fmt == "xyxy":
+        return boxes
+    if out_fmt == "xywh":
+        x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    if out_fmt == "cxcywh":
+        x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+    raise ValueError(f"Unknown box format {out_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    boxes = jnp.asarray(boxes, dtype=jnp.float32)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _inter_union(boxes1: Array, boxes2: Array):
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
+    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
+    inter, union = _inter_union(boxes1, boxes2)
+    return inter / (union + _EPS)
+
+
+def generalized_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """GIoU: IoU - (hull \\ union) / hull."""
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
+    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / (union + _EPS)
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / (hull + _EPS)
+
+
+def distance_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """DIoU: IoU - center-distance^2 / enclosing-diagonal^2."""
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
+    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / (union + _EPS)
+    diag, dist = _diag_and_center_dist(boxes1, boxes2)
+    return iou - dist / diag
+
+
+def _diag_and_center_dist(boxes1: Array, boxes2: Array):
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    diag = wh[..., 0] ** 2 + wh[..., 1] ** 2 + _EPS
+    c1 = (boxes1[:, :2] + boxes1[:, 2:]) / 2
+    c2 = (boxes2[:, :2] + boxes2[:, 2:]) / 2
+    d = c1[:, None, :] - c2[None, :, :]
+    dist = d[..., 0] ** 2 + d[..., 1] ** 2
+    return diag, dist
+
+
+def complete_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """CIoU: DIoU - aspect-ratio penalty alpha*v."""
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
+    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / (union + _EPS)
+    diag, dist = _diag_and_center_dist(boxes1, boxes2)
+    diou = iou - dist / diag
+
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    v = (4 / jnp.pi**2) * (
+        jnp.arctan(w2 / (h2 + _EPS))[None, :] - jnp.arctan(w1 / (h1 + _EPS))[:, None]
+    ) ** 2
+    alpha = v / (1 - iou + v + _EPS)
+    return diou - alpha * v
+
+
+def _iou_family(pairwise_fn, preds, target, iou_threshold, replacement_val, aggregate):
+    preds = jnp.asarray(preds, dtype=jnp.float32).reshape(-1, 4)
+    target = jnp.asarray(target, dtype=jnp.float32).reshape(-1, 4)
+    iou = pairwise_fn(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    if not aggregate:
+        return iou
+    if iou.size == 0:
+        return jnp.asarray(0.0)
+    n = min(iou.shape[0], iou.shape[1])
+    return jnp.mean(jnp.diagonal(iou)[:n])
+
+
+def intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Pairwise (or matched-mean) IoU (reference functional/detection/iou.py:41-95)."""
+    return _iou_family(box_iou, preds, target, iou_threshold, replacement_val, aggregate)
+
+
+def generalized_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _iou_family(generalized_box_iou, preds, target, iou_threshold, replacement_val, aggregate)
+
+
+def distance_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _iou_family(distance_box_iou, preds, target, iou_threshold, replacement_val, aggregate)
+
+
+def complete_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _iou_family(complete_box_iou, preds, target, iou_threshold, replacement_val, aggregate)

@@ -1,0 +1,237 @@
+"""Panoptic quality (reference functional/detection/_panoptic_quality_common.py +
+panoptic_qualities.py).
+
+Redesign: the reference builds Python dicts keyed by (category, instance) "colors"
+and loops over every segment pair. Here segments are relabelled with ``np.unique``
+and ALL pairwise statistics (areas, intersections, IoU, matching, FP/FN filters)
+are dense vectorized array ops over the (num_pred_segments, num_target_segments)
+grid — no per-segment Python loop. Segment extraction is host-side (as the
+reference's dicts are); the per-category accumulators are device arrays.
+"""
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Validate and normalize category sets (reference _panoptic_quality_common.py:65-93)."""
+    things_parsed = set(things)
+    stuffs_parsed = set(stuffs)
+    if not all(isinstance(t, int) or hasattr(t, "item") for t in things_parsed | stuffs_parsed):
+        raise TypeError("Expected arguments `things` and `stuffs` to contain `int` categories")
+    things_parsed = {int(t) for t in things_parsed}
+    stuffs_parsed = {int(s) for s in stuffs_parsed}
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    unused_category_id = 1 + max([0, *list(things), *list(stuffs)])
+    return unused_category_id, 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    thing_id_to_continuous_id = {t: idx for idx, t in enumerate(sorted(things))}
+    stuff_id_to_continuous_id = {s: idx + len(things) for idx, s in enumerate(sorted(stuffs))}
+    return {**thing_id_to_continuous_id, **stuff_id_to_continuous_id}
+
+
+def _validate_inputs(preds, target) -> None:
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError(f"Expected argument `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}")
+    if preds.ndim < 3:
+        raise ValueError(f"Expected argument `preds` to have at least 3 dimensions, got {preds.ndim}")
+    if preds.shape[-1] != 2:
+        raise ValueError(f"Expected the final dimension of `preds` to be of size 2, got {preds.shape[-1]}")
+
+
+def _preprocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims; zero stuff instance ids; map unknowns to void."""
+    out = np.array(inputs, dtype=np.int64, copy=True).reshape(inputs.shape[0], -1, 2)
+    cats = out[:, :, 0]
+    mask_stuffs = np.isin(cats, list(stuffs))
+    mask_things = np.isin(cats, list(things))
+    out[:, :, 1] = np.where(mask_stuffs, 0, out[:, :, 1])
+    known = mask_things | mask_stuffs
+    if not allow_unknown_category and not known.all():
+        raise ValueError(f"Unknown categories found: {np.unique(cats[~known])}")
+    out[~known] = np.asarray(void_color, dtype=np.int64)
+    return out
+
+
+def _panoptic_quality_update_sample(
+    preds: np.ndarray,
+    target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Stat scores for one sample, fully vectorized over segment pairs.
+
+    Matches reference _panoptic_quality_update_sample (:312-394): IoU uses
+    void-corrected unions; things match at IoU > 0.5; modified-PQ stuffs
+    accumulate IoU > 0 with TP = number of target segments; FP/FN filters drop
+    segments that are mostly void.
+    """
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    tp = np.zeros(num_categories, dtype=np.int64)
+    fp = np.zeros(num_categories, dtype=np.int64)
+    fn = np.zeros(num_categories, dtype=np.int64)
+
+    up, pinv = np.unique(preds, axis=0, return_inverse=True)  # (P_seg, 2)
+    ut, tinv = np.unique(target, axis=0, return_inverse=True)  # (T_seg, 2)
+    n_p, n_t = len(up), len(ut)
+    pred_areas = np.bincount(pinv, minlength=n_p).astype(np.float64)
+    target_areas = np.bincount(tinv, minlength=n_t).astype(np.float64)
+    inter = np.bincount(pinv * n_t + tinv, minlength=n_p * n_t).reshape(n_p, n_t).astype(np.float64)
+
+    void = np.asarray(void_color, dtype=np.int64)
+    p_is_void = (up == void).all(axis=1)
+    t_is_void = (ut == void).all(axis=1)
+    pred_void = inter[:, t_is_void].sum(axis=1)  # area of each pred segment overlapping void target
+    void_target = inter[p_is_void, :].sum(axis=0)  # area of each target segment overlapping void pred
+
+    union = pred_areas[:, None] - pred_void[:, None] + target_areas[None, :] - void_target[None, :] - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        iou = np.where(inter > 0, inter / union, 0.0)
+
+    cat_match = up[:, 0:1] == ut[None, :, 0]  # (P_seg, T_seg)
+    considered = cat_match & (inter > 0) & ~t_is_void[None, :] & ~p_is_void[:, None]
+
+    cont_id_t = np.array([cat_id_to_continuous_id.get(int(c), -1) for c in ut[:, 0]])
+    t_modified = np.isin(ut[:, 0], list(stuffs_modified_metric)) if stuffs_modified_metric else np.zeros(n_t, bool)
+    p_modified = np.isin(up[:, 0], list(stuffs_modified_metric)) if stuffs_modified_metric else np.zeros(n_p, bool)
+
+    # things (and plain-PQ stuffs): match at IoU > 0.5 — at most one per row/col
+    matched = considered & (iou > 0.5) & ~t_modified[None, :]
+    pair_p, pair_t = np.nonzero(matched)
+    np.add.at(iou_sum, cont_id_t[pair_t], iou[pair_p, pair_t])
+    np.add.at(tp, cont_id_t[pair_t], 1)
+
+    # modified-PQ stuffs: accumulate every IoU > 0; TP = number of target segments
+    mod_pairs = considered & (iou > 0) & t_modified[None, :]
+    mp, mt = np.nonzero(mod_pairs)
+    np.add.at(iou_sum, cont_id_t[mt], iou[mp, mt])
+    mod_targets = ~t_is_void & t_modified
+    np.add.at(tp, cont_id_t[mod_targets], 1)
+
+    # FN: unmatched non-void target segments not mostly void
+    t_matched = matched.any(axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t_void_frac = np.where(target_areas > 0, void_target / target_areas, 0.0)
+    fns = ~t_matched & ~t_is_void & ~t_modified & (t_void_frac <= 0.5)
+    np.add.at(fn, cont_id_t[fns], 1)
+
+    # FP: unmatched non-void pred segments not mostly void
+    p_matched = matched.any(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p_void_frac = np.where(pred_areas > 0, pred_void / pred_areas, 0.0)
+    cont_id_p = np.array([cat_id_to_continuous_id.get(int(c), -1) for c in up[:, 0]])
+    fps = ~p_matched & ~p_is_void & ~p_modified & (p_void_frac <= 0.5) & (cont_id_p >= 0)
+    np.add.at(fp, cont_id_p[fps], 1)
+
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Batch stat scores: samples are independent (segments never match across frames)."""
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    tp = np.zeros(num_categories, dtype=np.int64)
+    fp = np.zeros(num_categories, dtype=np.int64)
+    fn = np.zeros(num_categories, dtype=np.int64)
+    for p, t in zip(flatten_preds, flatten_target):
+        r = _panoptic_quality_update_sample(p, t, cat_id_to_continuous_id, void_color, modified_metric_stuffs)
+        iou_sum += r[0]
+        tp += r[1]
+        fp += r[2]
+        fn += r[3]
+    return jnp.asarray(iou_sum), jnp.asarray(tp), jnp.asarray(fp), jnp.asarray(fn)
+
+
+def _panoptic_quality_compute(
+    iou_sum: Array, true_positives: Array, false_positives: Array, false_negatives: Array
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Per-class and averaged PQ/SQ/RQ (reference _panoptic_quality_common.py:447-476)."""
+    sq = jnp.where(true_positives > 0.0, iou_sum / jnp.clip(true_positives, 1), 0.0)
+    denominator = true_positives + 0.5 * false_positives + 0.5 * false_negatives
+    rq = jnp.where(denominator > 0.0, true_positives / jnp.clip(denominator, 1e-12), 0.0)
+    pq = sq * rq
+    seen = denominator > 0
+    pq_avg = jnp.mean(pq[seen]) if bool(jnp.any(seen)) else jnp.asarray(jnp.nan)
+    sq_avg = jnp.mean(sq[seen]) if bool(jnp.any(seen)) else jnp.asarray(jnp.nan)
+    rq_avg = jnp.mean(rq[seen]) if bool(jnp.any(seen)) else jnp.asarray(jnp.nan)
+    return pq, sq, rq, pq_avg, sq_avg, rq_avg
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> Array:
+    """Functional PQ over ``(B, *spatial, 2)`` (category, instance) maps."""
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(np.asarray(preds), np.asarray(target))
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, np.asarray(preds), void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, np.asarray(target), void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(flatten_preds, flatten_target, cat_id_to_continuous_id, void_color)
+    pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(iou_sum, tp, fp, fn)
+    if return_per_class:
+        if return_sq_and_rq:
+            return jnp.stack((pq, sq, rq), axis=-1)
+        return pq.reshape(1, -1)
+    if return_sq_and_rq:
+        return jnp.stack((pq_avg, sq_avg, rq_avg))
+    return pq_avg
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    """Modified PQ: stuff classes score mean IoU over all overlaps (reference panoptic_qualities.py:182+)."""
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(np.asarray(preds), np.asarray(target))
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, np.asarray(preds), void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, np.asarray(target), void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flatten_preds, flatten_target, cat_id_to_continuous_id, void_color, modified_metric_stuffs=stuffs
+    )
+    _, _, _, pq_avg, _, _ = _panoptic_quality_compute(iou_sum, tp, fp, fn)
+    return pq_avg
