@@ -1,0 +1,115 @@
+"""Clustering metric parity tests vs sklearn."""
+import sys
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    adjusted_mutual_info_score as sk_ami,
+    adjusted_rand_score as sk_ari,
+    calinski_harabasz_score as sk_ch,
+    completeness_score as sk_completeness,
+    davies_bouldin_score as sk_db,
+    fowlkes_mallows_score as sk_fmi,
+    homogeneity_score as sk_homogeneity,
+    mutual_info_score as sk_mi,
+    normalized_mutual_info_score as sk_nmi,
+    rand_score as sk_rand,
+    v_measure_score as sk_vm,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+import torchmetrics_tpu.functional as F  # noqa: E402
+
+rng = np.random.RandomState(31)
+N = 120
+PREDS = rng.randint(0, 6, N)
+TARGET = rng.randint(0, 5, N)
+DATA = rng.randn(N, 4).astype(np.float32)
+LABELS = rng.randint(0, 4, N)
+
+LABEL_CASES = [
+    (F.mutual_info_score, tm.MutualInfoScore, sk_mi, {}),
+    (F.rand_score, tm.RandScore, sk_rand, {}),
+    (F.adjusted_rand_score, tm.AdjustedRandScore, sk_ari, {}),
+    (F.fowlkes_mallows_index, tm.FowlkesMallowsIndex, sk_fmi, {}),
+    (F.homogeneity_score, tm.HomogeneityScore, sk_homogeneity, {}),
+    (F.completeness_score, tm.CompletenessScore, sk_completeness, {}),
+    (F.v_measure_score, tm.VMeasureScore, sk_vm, {}),
+    (F.normalized_mutual_info_score, tm.NormalizedMutualInfoScore, sk_nmi, {}),
+    (F.adjusted_mutual_info_score, tm.AdjustedMutualInfoScore, sk_ami, {}),
+]
+
+
+@pytest.mark.parametrize("fn,cls,sk,kw", LABEL_CASES, ids=[c[1].__name__ for c in LABEL_CASES])
+def test_label_metrics(fn, cls, sk, kw):
+    got = float(fn(PREDS, TARGET, **kw))
+    want = float(sk(TARGET, PREDS))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    m = cls(**kw)
+    m.update(PREDS[:60], TARGET[:60])
+    m.update(PREDS[60:], TARGET[60:])
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("average_method,sk_name", [("min", "min"), ("geometric", "geometric"), ("max", "max")])
+def test_nmi_average_methods(average_method, sk_name):
+    got = float(F.normalized_mutual_info_score(PREDS, TARGET, average_method))
+    want = float(sk_nmi(TARGET, PREDS, average_method=sk_name))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    got_ami = float(F.adjusted_mutual_info_score(PREDS, TARGET, average_method))
+    want_ami = float(sk_ami(TARGET, PREDS, average_method=sk_name))
+    np.testing.assert_allclose(got_ami, want_ami, atol=1e-4)
+
+
+def test_perfect_and_permuted():
+    assert float(F.rand_score(PREDS, PREDS)) == 1.0
+    assert float(F.adjusted_rand_score(PREDS, PREDS)) == 1.0
+    # label permutation leaves scores invariant
+    perm = rng.permutation(6)
+    np.testing.assert_allclose(
+        float(F.mutual_info_score(perm[PREDS], TARGET)), float(F.mutual_info_score(PREDS, TARGET)), atol=1e-5
+    )
+
+
+def test_intrinsic_metrics():
+    np.testing.assert_allclose(float(F.calinski_harabasz_score(DATA, LABELS)), sk_ch(DATA, LABELS), rtol=1e-4)
+    np.testing.assert_allclose(float(F.davies_bouldin_score(DATA, LABELS)), sk_db(DATA, LABELS), rtol=1e-4)
+
+    m = tm.CalinskiHarabaszScore()
+    m.update(DATA[:60], LABELS[:60])
+    m.update(DATA[60:], LABELS[60:])
+    np.testing.assert_allclose(float(m.compute()), sk_ch(DATA, LABELS), rtol=1e-4)
+
+    m = tm.DaviesBouldinScore()
+    m.update(DATA, LABELS)
+    np.testing.assert_allclose(float(m.compute()), sk_db(DATA, LABELS), rtol=1e-4)
+
+
+def test_dunn_index():
+    # well separated clusters -> dunn via independent numpy computation
+    data = np.concatenate([rng.randn(20, 3) * 0.1 + c for c in (0, 5, 10)]).astype(np.float32)
+    labels = np.repeat([0, 1, 2], 20)
+    got = float(F.dunn_index(data, labels))
+
+    centroids = np.stack([data[labels == k].mean(0) for k in range(3)])
+    inter = min(
+        np.linalg.norm(centroids[i] - centroids[j]) for i in range(3) for j in range(3) if i < j
+    )
+    intra = max(np.linalg.norm(data[labels == k] - centroids[k], axis=1).max() for k in range(3))
+    np.testing.assert_allclose(got, inter / intra, rtol=1e-4)
+
+    m = tm.DunnIndex()
+    m.update(data, labels)
+    np.testing.assert_allclose(float(m.compute()), inter / intra, rtol=1e-4)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="Expected 2D data"):
+        F.calinski_harabasz_score(DATA[:, 0], LABELS)
+    with pytest.raises(ValueError, match="real, discrete"):
+        F.mutual_info_score(PREDS.astype(np.float32), TARGET)
+    with pytest.raises(ValueError, match="average_method"):
+        F.normalized_mutual_info_score(PREDS, TARGET, "harmonic")

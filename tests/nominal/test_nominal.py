@@ -1,0 +1,159 @@
+"""Nominal metric parity tests vs the PyTorch reference."""
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+from torchmetrics.functional.nominal import (  # noqa: E402
+    cramers_v as ref_cramers_v,
+    cramers_v_matrix as ref_cramers_v_matrix,
+    fleiss_kappa as ref_fleiss_kappa,
+    pearsons_contingency_coefficient as ref_pearson,
+    theils_u as ref_theils_u,
+    theils_u_matrix as ref_theils_u_matrix,
+    tschuprows_t as ref_tschuprows_t,
+)
+from torchmetrics import nominal as ref_nominal  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+import torchmetrics_tpu.functional as F  # noqa: E402
+
+rng = np.random.RandomState(23)
+N, C = 200, 5
+PREDS = rng.randint(0, C, N)
+TARGET = np.where(rng.rand(N) < 0.6, PREDS, rng.randint(0, C, N))  # correlated
+MATRIX = rng.randint(0, 4, (80, 4))
+
+FUNCTIONAL_CASES = [
+    (F.cramers_v, ref_cramers_v, {"bias_correction": True}),
+    (F.cramers_v, ref_cramers_v, {"bias_correction": False}),
+    (F.tschuprows_t, ref_tschuprows_t, {"bias_correction": True}),
+    (F.tschuprows_t, ref_tschuprows_t, {"bias_correction": False}),
+    (F.pearsons_contingency_coefficient, ref_pearson, {}),
+    (F.theils_u, ref_theils_u, {}),
+]
+
+
+@pytest.mark.parametrize("ours,ref,kw", FUNCTIONAL_CASES, ids=[f"{r.__name__}-{k}" for _, r, k in FUNCTIONAL_CASES])
+def test_functional_parity(ours, ref, kw):
+    got = float(ours(PREDS, TARGET, **kw))
+    want = float(ref(torch.from_numpy(PREDS), torch.from_numpy(TARGET), **kw))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+MODULAR_CASES = [
+    (tm.CramersV, "CramersV", {}),
+    (tm.TschuprowsT, "TschuprowsT", {}),
+    (tm.PearsonsContingencyCoefficient, "PearsonsContingencyCoefficient", {}),
+    (tm.TheilsU, "TheilsU", {}),
+]
+
+
+@pytest.mark.parametrize("cls,ref_name,kw", MODULAR_CASES, ids=[c[1] for c in MODULAR_CASES])
+def test_modular_parity(cls, ref_name, kw):
+    ours = cls(num_classes=C, **kw)
+    ref = getattr(ref_nominal, ref_name)(num_classes=C, **kw)
+    ours.update(PREDS[:100], TARGET[:100])
+    ours.update(PREDS[100:], TARGET[100:])
+    ref.update(torch.from_numpy(PREDS[:100]), torch.from_numpy(TARGET[:100]))
+    ref.update(torch.from_numpy(PREDS[100:]), torch.from_numpy(TARGET[100:]))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, rtol=1e-4)
+
+
+def test_matrix_variants():
+    got = np.asarray(F.cramers_v_matrix(MATRIX))
+    want = ref_cramers_v_matrix(torch.from_numpy(MATRIX)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    got_u = np.asarray(F.theils_u_matrix(MATRIX))
+    want_u = ref_theils_u_matrix(torch.from_numpy(MATRIX)).numpy()
+    np.testing.assert_allclose(got_u, want_u, atol=1e-4)
+
+
+def test_nan_strategies():
+    p = PREDS.astype(np.float32).copy()
+    t = TARGET.astype(np.float32).copy()
+    p[::11] = np.nan
+    for strategy, replace in (("replace", 0.0), ("drop", None)):
+        kw = {"nan_strategy": strategy}
+        if replace is not None:
+            kw["nan_replace_value"] = replace
+        got = float(F.cramers_v(p, t, **kw))
+        want = float(ref_cramers_v(torch.from_numpy(p), torch.from_numpy(t), **kw))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["counts", "probs"])
+def test_fleiss_kappa(mode):
+    if mode == "counts":
+        ratings = rng.multinomial(10, [0.2, 0.3, 0.5], size=50)
+        ref_in = torch.from_numpy(ratings)
+    else:
+        ratings = rng.rand(50, 3, 10).astype(np.float32)
+        ref_in = torch.from_numpy(ratings)
+    got = float(F.fleiss_kappa(ratings, mode))
+    want = float(ref_fleiss_kappa(ref_in, mode))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+    m = tm.FleissKappa(mode=mode)
+    m.update(ratings)
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-5, rtol=1e-4)
+
+
+def test_noncontiguous_labels():
+    # 1-based / gappy label values must be relabelled, not silently dropped
+    p = PREDS + 1
+    t = TARGET * 2 + 1
+    got = float(F.cramers_v(p, t, bias_correction=False))
+    # reference errors on out-of-range values, so relabel manually for the oracle
+    uniq = np.unique(np.concatenate([p, t]))
+    p_r = np.searchsorted(uniq, p)
+    t_r = np.searchsorted(uniq, t)
+    want = float(ref_cramers_v(torch.from_numpy(p_r), torch.from_numpy(t_r), bias_correction=False))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_modular_out_of_range_raises():
+    m = tm.CramersV(num_classes=3)
+    with pytest.raises(ValueError, match="label values"):
+        m.update(PREDS, TARGET)  # values up to 4 with num_classes=3
+
+
+def test_nan_drop_traces_under_jit():
+    import jax
+
+    p = PREDS.astype(np.float32).copy()
+    p[::9] = np.nan
+    t = TARGET.astype(np.float32)
+    m = tm.CramersV(num_classes=C, nan_strategy="drop")
+    jitted = jax.jit(lambda pp, tt: m.functional_compute(m.functional_update(m.init_state(), pp, tt)))(p, t)
+    eager = tm.CramersV(num_classes=C, nan_strategy="drop")
+    eager.update(p, t)
+    np.testing.assert_allclose(float(jitted), float(eager.compute()), atol=1e-5)
+
+
+def test_compute_traces_under_jit():
+    import jax
+
+    for cls in (tm.CramersV, tm.TschuprowsT, tm.PearsonsContingencyCoefficient, tm.TheilsU):
+        m = cls(num_classes=C)
+        eager = cls(num_classes=C)
+        eager.update(PREDS, TARGET)
+        jitted = jax.jit(
+            lambda p, t, m=m: m.functional_compute(m.functional_update(m.init_state(), p, t))
+        )(PREDS, TARGET)
+        np.testing.assert_allclose(float(jitted), float(eager.compute()), atol=1e-5, err_msg=cls.__name__)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        F.cramers_v(PREDS, TARGET, nan_strategy="zero")
+    with pytest.raises(ValueError, match="num_classes"):
+        tm.CramersV(num_classes=0)
+    with pytest.raises(ValueError, match="mode"):
+        tm.FleissKappa(mode="votes")

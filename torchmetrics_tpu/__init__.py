@@ -90,7 +90,28 @@ from torchmetrics_tpu.text import (  # noqa: F401
     WordInfoLost,
     WordInfoPreserved,
 )
-from torchmetrics_tpu import audio, detection, retrieval  # noqa: F401
+from torchmetrics_tpu import audio, clustering, detection, nominal, retrieval  # noqa: F401
+from torchmetrics_tpu.clustering import (  # noqa: F401
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.nominal import (  # noqa: F401
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
 from torchmetrics_tpu.detection import (  # noqa: F401
     CompleteIntersectionOverUnion,
     DistanceIntersectionOverUnion,

@@ -1,0 +1,160 @@
+"""Modular clustering metrics (reference clustering/*.py).
+
+Two state patterns: label metrics concatenate preds/target; embedding metrics
+concatenate data/labels. Both are ``cat`` list states (compute needs the full
+assignment — there is no streaming sufficient statistic for MI-family scores).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import Array
+
+from torchmetrics_tpu.functional.clustering.extrinsic import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    completeness_score,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.functional.clustering.intrinsic import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+)
+from torchmetrics_tpu.functional.clustering.utils import _validate_average_method_arg
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class _LabelClusteringMetric(Metric):
+    """Base for metrics comparing two label assignments."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _compute_fn_args(self):
+        return ()
+
+    def compute(self) -> Array:
+        return type(self)._fn(dim_zero_cat(self.preds), dim_zero_cat(self.target), *self._compute_fn_args())
+
+
+class MutualInfoScore(_LabelClusteringMetric):
+    _fn = staticmethod(mutual_info_score)
+
+
+class RandScore(_LabelClusteringMetric):
+    _fn = staticmethod(rand_score)
+
+
+class AdjustedRandScore(_LabelClusteringMetric):
+    _fn = staticmethod(adjusted_rand_score)
+    plot_lower_bound: float = -0.5
+
+
+class FowlkesMallowsIndex(_LabelClusteringMetric):
+    _fn = staticmethod(fowlkes_mallows_index)
+    plot_upper_bound: float = 1.0
+
+
+class HomogeneityScore(_LabelClusteringMetric):
+    _fn = staticmethod(homogeneity_score)
+    plot_upper_bound: float = 1.0
+
+
+class CompletenessScore(_LabelClusteringMetric):
+    _fn = staticmethod(completeness_score)
+    plot_upper_bound: float = 1.0
+
+
+class VMeasureScore(_LabelClusteringMetric):
+    _fn = staticmethod(v_measure_score)
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, (int, float)) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def _compute_fn_args(self):
+        return (self.beta,)
+
+
+class NormalizedMutualInfoScore(_LabelClusteringMetric):
+    _fn = staticmethod(normalized_mutual_info_score)
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _compute_fn_args(self):
+        return (self.average_method,)
+
+
+class AdjustedMutualInfoScore(NormalizedMutualInfoScore):
+    _fn = staticmethod(adjusted_mutual_info_score)
+    plot_lower_bound: float = -1.0
+
+
+class _EmbeddingClusteringMetric(Metric):
+    """Base for metrics over (data, labels) embeddings."""
+
+    is_differentiable = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", default=[], dist_reduce_fx="cat")
+        self.add_state("labels", default=[], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        self.data.append(data)
+        self.labels.append(labels)
+
+    def _compute_fn_args(self):
+        return ()
+
+    def compute(self) -> Array:
+        return type(self)._fn(dim_zero_cat(self.data), dim_zero_cat(self.labels), *self._compute_fn_args())
+
+
+class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
+    _fn = staticmethod(calinski_harabasz_score)
+    higher_is_better = True
+
+
+class DaviesBouldinScore(_EmbeddingClusteringMetric):
+    _fn = staticmethod(davies_bouldin_score)
+    higher_is_better = False
+
+
+class DunnIndex(_EmbeddingClusteringMetric):
+    _fn = staticmethod(dunn_index)
+    higher_is_better = True
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _compute_fn_args(self):
+        return (self.p,)

@@ -1,0 +1,74 @@
+"""Embedding-based clustering metrics (reference functional/clustering/
+{calinski_harabasz,davies_bouldin,dunn_index}.py).
+
+The reference loops over clusters in Python; here every per-cluster statistic
+(centroid, dispersion, intra-distance) is a ``segment_sum``/``segment_max``
+over the label vector — one fused reduction regardless of cluster count.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+)
+
+
+def _relabel(data: Array, labels: Array):
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    unique_labels, labels = jnp.unique(labels, return_inverse=True)
+    num_labels = int(unique_labels.shape[0])
+    _validate_intrinsic_labels_to_samples(num_labels, data.shape[0])
+    return data, labels.reshape(-1), num_labels
+
+
+def _centroids_counts(data: Array, labels: Array, num_labels: int):
+    counts = jax.ops.segment_sum(jnp.ones(data.shape[0]), labels, num_segments=num_labels)
+    sums = jax.ops.segment_sum(data, labels, num_segments=num_labels)
+    return sums / counts[:, None], counts
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Variance-ratio criterion: between/within cluster dispersion."""
+    data, labels, num_labels = _relabel(data, labels)
+    num_samples = data.shape[0]
+    mean = data.mean(axis=0)
+    centroids, counts = _centroids_counts(data, labels, num_labels)
+    between = jnp.sum(counts * jnp.sum((centroids - mean) ** 2, axis=1))
+    within = jnp.sum((data - centroids[labels]) ** 2)
+    if bool(within == 0):
+        return jnp.asarray(1.0)
+    return between * (num_samples - num_labels) / (within * (num_labels - 1.0))
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Mean worst-case ratio of intra-cluster spread to centroid separation."""
+    data, labels, num_labels = _relabel(data, labels)
+    centroids, counts = _centroids_counts(data, labels, num_labels)
+    dists = jnp.sqrt(jnp.sum((data - centroids[labels]) ** 2, axis=1))
+    intra = jax.ops.segment_sum(dists, labels, num_segments=num_labels) / counts
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = jnp.sqrt(jnp.sum(diff**2, axis=-1))
+    if bool(jnp.allclose(intra, 0.0)) or bool(jnp.allclose(centroid_distances, 0.0)):
+        return jnp.asarray(0.0)
+    centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    combined = intra[None, :] + intra[:, None]
+    scores = jnp.max(combined / centroid_distances, axis=1)
+    return scores.mean()
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2) -> Array:
+    """Min inter-centroid distance over max intra-cluster radius."""
+    data, labels, num_labels = _relabel(data, labels)
+    centroids, _ = _centroids_counts(data, labels, num_labels)
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    inter = jnp.linalg.norm(diff, ord=p, axis=-1)
+    inter = jnp.where(jnp.eye(num_labels, dtype=bool), jnp.inf, inter)
+    radii = jnp.linalg.norm(data - centroids[labels], ord=p, axis=-1)
+    max_intra = jax.ops.segment_max(radii, labels, num_segments=num_labels)
+    return inter.min() / max_intra.max()

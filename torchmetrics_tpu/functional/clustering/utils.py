@@ -1,0 +1,130 @@
+"""Clustering helpers (reference functional/clustering/utils.py).
+
+The contingency matrix — the one data structure every extrinsic clustering
+metric reduces to — is built dense with a relabel + bincount (one fused gather
+on device) instead of the reference's sparse COO tensor.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+
+def is_nonnegative(x: Array, atol: float = 1e-5) -> bool:
+    return bool(jnp.all(x >= -atol))
+
+
+def _validate_average_method_arg(average_method: str) -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+            f" but got {average_method}"
+        )
+
+
+def calculate_entropy(x: Array) -> Array:
+    """Entropy of a label assignment (reference utils.py:47-76)."""
+    x = jnp.asarray(x).reshape(-1)
+    if x.size == 0:
+        return jnp.asarray(1.0)
+    _, inv = jnp.unique(x, return_inverse=True)
+    p = jnp.bincount(inv.reshape(-1))
+    p = p[p > 0]
+    if p.size == 1:
+        return jnp.asarray(0.0)
+    n = p.sum()
+    return -jnp.sum((p / n) * (jnp.log(p) - jnp.log(n)))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, str]) -> Array:
+    """Power mean (reference utils.py:78-118)."""
+    if jnp.iscomplexobj(x) or not is_nonnegative(x):
+        raise ValueError("`x` must contain positive real numbers")
+    if isinstance(p, str):
+        if p == "min":
+            return x.min()
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(x)))
+        if p == "arithmetic":
+            return x.mean()
+        if p == "max":
+            return x.max()
+        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+    return jnp.mean(jnp.power(x, p)) ** (1.0 / p)
+
+
+def calculate_contingency_matrix(preds: Array, target: Array, eps: Optional[float] = None) -> Array:
+    """Dense contingency matrix of shape (n_classes_target, n_classes_preds)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim != 1 or target.ndim != 1:
+        raise ValueError(f"Expected 1d `preds` and `target` but got {preds.ndim} and {target.ndim}.")
+    _, preds_idx = jnp.unique(preds, return_inverse=True)
+    _, target_idx = jnp.unique(target, return_inverse=True)
+    n_p = int(preds_idx.max()) + 1
+    n_t = int(target_idx.max()) + 1
+    contingency = jnp.bincount(
+        (target_idx * n_p + preds_idx).reshape(-1), length=n_t * n_p
+    ).reshape(n_t, n_p)
+    if eps is not None:
+        contingency = contingency.astype(jnp.float32) + eps
+    return contingency
+
+
+def _is_real_discrete_label(x: Array) -> bool:
+    if x.ndim != 1:
+        raise ValueError(f"Expected arguments to be 1-d tensors but got {x.ndim}-d tensors.")
+    return not (jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(x.dtype, jnp.complexfloating))
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    """Validate 1-d discrete label tensors (reference utils.py:183-194)."""
+    _check_same_shape(preds, target)
+    if not (_is_real_discrete_label(preds) and _is_real_discrete_label(target)):
+        raise ValueError(f"Expected real, discrete values but received {preds.dtype} and {target.dtype}.")
+
+
+def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data, got {data.ndim}D data instead")
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        raise ValueError(f"Expected floating point data, got {data.dtype} data instead")
+    if labels.ndim != 1:
+        raise ValueError(f"Expected 1D labels, got {labels.ndim}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: int) -> None:
+    if not 1 < num_labels < num_samples:
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f"Got {num_labels} clusters and {num_samples} samples."
+        )
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds: Optional[Array] = None,
+    target: Optional[Array] = None,
+    contingency: Optional[Array] = None,
+) -> Array:
+    """2x2 pair confusion matrix over sample pairs (reference utils.py:215-283)."""
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if contingency is None:
+        contingency = calculate_contingency_matrix(preds, target)
+    contingency = contingency.astype(jnp.float64 if contingency.dtype == jnp.float64 else jnp.float32)
+    n_samples = contingency.sum()
+    n_c = contingency.sum(axis=1)
+    n_k = contingency.sum(axis=0)
+    sum_squares = (contingency**2).sum()
+    pair_matrix = jnp.zeros((2, 2), dtype=contingency.dtype)
+    pair_matrix = pair_matrix.at[1, 1].set(sum_squares - n_samples)
+    pair_matrix = pair_matrix.at[0, 1].set((contingency @ n_k).sum() - sum_squares)
+    pair_matrix = pair_matrix.at[1, 0].set((contingency.T @ n_c).sum() - sum_squares)
+    pair_matrix = pair_matrix.at[0, 0].set(n_samples**2 - pair_matrix[0, 1] - pair_matrix[1, 0] - sum_squares)
+    return pair_matrix

@@ -1,0 +1,365 @@
+"""Nominal-association metrics (reference functional/nominal/*.py).
+
+The chi-square-on-confusion-matrix family (Cramer's V, Tschuprow's T,
+Pearson's contingency coefficient, Theil's U) plus Fleiss kappa, with the
+pairwise ``*_matrix`` batch variants. Confusion matrices are built with the
+same bincount trick the classification suite uses.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array, target: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Tuple[Array, Array, Array]:
+    """NaN handling returning a static-shape (preds, target, valid-weight) triple.
+
+    The reference's 'drop' physically removes rows (dynamic shape); here dropped
+    rows get zero weight so the whole update stays jit-traceable.
+    """
+    if nan_strategy == "replace":
+        return (
+            jnp.nan_to_num(preds, nan=nan_replace_value),
+            jnp.nan_to_num(target, nan=nan_replace_value),
+            jnp.ones(preds.shape, dtype=bool),
+        )
+    valid = ~(jnp.isnan(preds) | jnp.isnan(target))
+    return jnp.nan_to_num(preds, nan=0.0), jnp.nan_to_num(target, nan=0.0), valid
+
+
+def _nominal_confmat_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix with fixed ``num_classes`` (modular state path).
+
+    Values must already lie in [0, num_classes); validated eagerly (a traced
+    update cannot raise on data, mirroring every other jit-safe update here).
+    """
+    import jax
+
+    from torchmetrics_tpu.functional.classification.confusion_matrix import (
+        _multiclass_confusion_matrix_update,
+    )
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target, valid = _handle_nan_in_data(
+        preds.astype(jnp.float32), target.astype(jnp.float32), nan_strategy, nan_replace_value
+    )
+    if not isinstance(preds, jax.core.Tracer):
+        vals = jnp.concatenate([preds[valid], target[valid]])
+        if vals.size and (bool(vals.min() < 0) or bool(vals.max() >= num_classes)):
+            raise ValueError(
+                f"Expected label values in [0, {num_classes}), but got values in"
+                f" [{float(vals.min())}, {float(vals.max())}]. Relabel the data or raise `num_classes`."
+            )
+    target_i = jnp.where(valid, target, 0).astype(jnp.int32)
+    return _multiclass_confusion_matrix_update(preds, target_i, valid, num_classes)
+
+
+def _nominal_confmat_from_values(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix over ARBITRARY label values (functional path).
+
+    Joint unique-relabel makes non-contiguous / non-zero-based labels work —
+    the count of distinct values and the index space then coincide.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target, valid = _handle_nan_in_data(
+        preds.astype(jnp.float32), target.astype(jnp.float32), nan_strategy, nan_replace_value
+    )
+    preds, target = preds[valid], target[valid]
+    uniques = jnp.unique(jnp.concatenate([preds, target]))
+    preds_idx = jnp.searchsorted(uniques, preds)
+    target_idx = jnp.searchsorted(uniques, target)
+    num_classes = int(uniques.shape[0])
+    idx = (target_idx * num_classes + preds_idx).reshape(-1)
+    return jnp.bincount(idx, length=num_classes * num_classes).reshape(num_classes, num_classes)
+
+
+def _reduced_stats(confmat: Array):
+    """Chi-square ingredients on the full matrix, zero rows/cols masked.
+
+    The reference physically drops empty rows/columns (nominal/utils.py
+    _drop_empty_rows_and_cols) — a dynamic shape, illegal under jit. All-zero
+    rows/cols contribute nothing to chi-square, so the same numbers fall out of
+    masked full-matrix reductions with TRACED effective row/col counts.
+    """
+    confmat = confmat.astype(jnp.float32)
+    rows = confmat.sum(1)
+    cols = confmat.sum(0)
+    num_rows = jnp.sum(rows != 0)
+    num_cols = jnp.sum(cols != 0)
+    total = confmat.sum()
+    expected = jnp.einsum("r,c->rc", rows, cols) / total
+    return confmat, expected, num_rows.astype(jnp.float32), num_cols.astype(jnp.float32), total
+
+
+def _compute_chi_squared_masked(confmat: Array, expected: Array, num_rows, num_cols, bias_correction: bool) -> Array:
+    """Chi-square test of independence (reference nominal/utils.py, after scipy)."""
+    df = num_rows * num_cols - num_rows - num_cols + 1
+    if bias_correction:
+        diff = expected - confmat
+        direction = jnp.sign(diff)
+        corrected = confmat + direction * jnp.minimum(0.5, jnp.abs(direction))
+        confmat = jnp.where(df == 1, corrected, confmat)
+    chi = jnp.sum(jnp.where(expected > 0, (confmat - expected) ** 2 / jnp.where(expected > 0, expected, 1.0), 0.0))
+    return jnp.where(df == 0, 0.0, chi)
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, num_rows: Array, num_cols: Array, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    phi_squared_corrected = jnp.maximum(
+        0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / (confmat_sum - 1)
+    )
+    rows_corrected = num_rows - (num_rows - 1) ** 2 / (confmat_sum - 1)
+    cols_corrected = num_cols - (num_cols - 1) ** 2 / (confmat_sum - 1)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _bias_correction_warning_if_concrete(cond: Array, metric_name: str) -> None:
+    import jax
+
+    if not isinstance(cond, jax.core.Tracer) and bool(cond):
+        rank_zero_warn(
+            f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`.",
+            UserWarning,
+        )
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    confmat, expected, num_rows, num_cols, cm_sum = _reduced_stats(confmat)
+    chi_squared = _compute_chi_squared_masked(confmat, expected, num_rows, num_cols, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    if bias_correction:
+        phi_sq_c, rows_c, cols_c = _compute_bias_corrected_values(phi_squared, num_rows, num_cols, cm_sum)
+        unusable = jnp.minimum(rows_c, cols_c) == 1
+        _bias_correction_warning_if_concrete(unusable, "Cramer's V")
+        value = jnp.sqrt(phi_sq_c / jnp.clip(jnp.minimum(rows_c - 1, cols_c - 1), 1e-12))
+        return jnp.where(unusable, jnp.nan, jnp.clip(value, 0.0, 1.0))
+    value = jnp.sqrt(phi_squared / jnp.clip(jnp.minimum(num_rows - 1, num_cols - 1), 1e-12))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramer's V: sqrt(phi^2 / min(r-1, k-1))."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    confmat, expected, num_rows, num_cols, cm_sum = _reduced_stats(confmat)
+    chi_squared = _compute_chi_squared_masked(confmat, expected, num_rows, num_cols, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    if bias_correction:
+        phi_sq_c, rows_c, cols_c = _compute_bias_corrected_values(phi_squared, num_rows, num_cols, cm_sum)
+        unusable = jnp.minimum(rows_c, cols_c) == 1
+        _bias_correction_warning_if_concrete(unusable, "Tschuprow's T")
+        value = jnp.sqrt(phi_sq_c / jnp.clip(jnp.sqrt((rows_c - 1) * (cols_c - 1)), 1e-12))
+        return jnp.where(unusable, jnp.nan, jnp.clip(value, 0.0, 1.0))
+    value = jnp.sqrt(phi_squared / jnp.clip(jnp.sqrt((num_rows - 1.0) * (num_cols - 1.0)), 1e-12))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T: sqrt(phi^2 / sqrt((r-1)(k-1)))."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    confmat, expected, num_rows, num_cols, cm_sum = _reduced_stats(confmat)
+    chi_squared = _compute_chi_squared_masked(confmat, expected, num_rows, num_cols, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    return jnp.clip(jnp.sqrt(phi_squared / (1 + phi_squared)), 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient: sqrt(phi^2 / (1 + phi^2))."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    total = confmat.sum()
+    p_xy = confmat / total
+    p_y = confmat.sum(1) / total
+    ratio = jnp.where(p_xy > 0, p_y[:, None] / jnp.where(p_xy > 0, p_xy, 1.0), 1.0)
+    return jnp.sum(jnp.where(p_xy > 0, p_xy * jnp.log(ratio), 0.0))
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    # zero rows/cols contribute nothing to either entropy: masked sums replace
+    # the reference's dynamic-shape row/col dropping
+    confmat = confmat.astype(jnp.float32)
+    s_xy = _conditional_entropy_compute(confmat)
+    total = confmat.sum()
+    p_x = confmat.sum(0) / total
+    s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
+    return jnp.where(s_x == 0, 0.0, (s_x - s_xy) / jnp.where(s_x == 0, 1.0, s_x))
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U (uncertainty coefficient): (H(X) - H(X|Y)) / H(X). Asymmetric."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def _matrix_variant(pair_fn, matrix: Array, symmetric: bool, **kwargs) -> Array:
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = jnp.ones((num_variables, num_variables))
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        if symmetric:
+            v = pair_fn(x, y, **kwargs)
+            out = out.at[i, j].set(v).at[j, i].set(v)
+        else:
+            out = out.at[i, j].set(pair_fn(x, y, **kwargs)).at[j, i].set(pair_fn(y, x, **kwargs))
+    return out
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Cramer's V over feature columns."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _matrix_variant(
+        cramers_v, matrix, True, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Tschuprow's T over feature columns."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _matrix_variant(
+        tschuprows_t, matrix, True, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Pairwise Pearson contingency coefficient over feature columns."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _matrix_variant(
+        pearsons_contingency_coefficient, matrix, True, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def theils_u_matrix(
+    matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> Array:
+    """Pairwise (asymmetric) Theil's U over feature columns."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _matrix_variant(theils_u, matrix, False, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)
+
+
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        num_categories = ratings.shape[1]
+        winners = ratings.argmax(axis=1)  # (n_samples, n_raters)
+        one_hot = jax_one_hot(winners, num_categories)
+        return one_hot.sum(axis=1)  # (n_samples, n_categories)
+    if ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def jax_one_hot(x: Array, num_classes: int) -> Array:
+    return (x[..., None] == jnp.arange(num_classes)).astype(jnp.int32)
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(1).max()
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Fleiss kappa inter-rater agreement over a [n_samples, n_categories] counts matrix."""
+    if mode not in ["counts", "probs"]:
+        raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

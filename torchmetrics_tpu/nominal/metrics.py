@@ -1,0 +1,103 @@
+"""Modular nominal metrics (reference nominal/*.py): a (C, C) confusion-matrix
+sum state per metric; FleissKappa concatenates per-batch counts."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.nominal.metrics import (
+    _cramers_v_compute,
+    _fleiss_kappa_compute,
+    _fleiss_kappa_update,
+    _nominal_confmat_update,
+    _nominal_input_validation,
+    _pearsons_contingency_coefficient_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class _ConfmatNominalMetric(Metric):
+    """Shared state machinery for the chi-square-on-confmat family."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_classes, int) and num_classes > 0):
+            raise ValueError(f"Argument `num_classes` is expected to be a positive integer, but got {num_classes}")
+        self.num_classes = num_classes
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _nominal_confmat_update(
+            preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+        self.confmat = self.confmat + confmat
+
+
+class CramersV(_ConfmatNominalMetric):
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _cramers_v_compute(self.confmat, self.bias_correction)
+
+
+class TschuprowsT(_ConfmatNominalMetric):
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _tschuprows_t_compute(self.confmat, self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
+    def compute(self) -> Array:
+        return _pearsons_contingency_coefficient_compute(self.confmat)
+
+
+class TheilsU(_ConfmatNominalMetric):
+    def compute(self) -> Array:
+        return _theils_u_compute(self.confmat)
+
+
+class FleissKappa(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ["counts", "probs"]:
+            raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+        self.mode = mode
+        self.add_state("counts", default=[], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        counts = _fleiss_kappa_update(ratings, self.mode)
+        self.counts.append(counts)
+
+    def compute(self) -> Array:
+        return _fleiss_kappa_compute(dim_zero_cat(self.counts))
