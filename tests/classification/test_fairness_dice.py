@@ -1,0 +1,211 @@
+"""Group fairness + Dice + FeatureShare parity tests vs the reference."""
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+from torchmetrics.functional.classification import (  # noqa: E402
+    binary_fairness as ref_binary_fairness,
+    binary_groups_stat_rates as ref_bgsr,
+    demographic_parity as ref_dp,
+    dice as ref_dice,
+    equal_opportunity as ref_eo,
+)
+from torchmetrics.classification import BinaryFairness as RefBinaryFairness  # noqa: E402
+from torchmetrics.classification import BinaryGroupStatRates as RefBinaryGroupStatRates  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+import torchmetrics_tpu.functional as F  # noqa: E402
+
+rng = np.random.RandomState(9)
+N = 120
+PREDS = rng.rand(N).astype(np.float32)
+TARGET = rng.randint(0, 2, N)
+GROUPS = rng.randint(0, 3, N)
+
+
+class TestGroupFairness:
+    def test_stat_rates(self):
+        got = F.binary_groups_stat_rates(PREDS, TARGET, GROUPS, 3)
+        want = ref_bgsr(torch.from_numpy(PREDS), torch.from_numpy(TARGET), torch.from_numpy(GROUPS), 3)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5, err_msg=k)
+
+    def test_demographic_parity(self):
+        got = F.demographic_parity(PREDS, GROUPS)
+        want = ref_dp(torch.from_numpy(PREDS), torch.from_numpy(GROUPS))
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5)
+
+    def test_equal_opportunity(self):
+        got = F.equal_opportunity(PREDS, TARGET, GROUPS)
+        want = ref_eo(torch.from_numpy(PREDS), torch.from_numpy(TARGET), torch.from_numpy(GROUPS))
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5)
+
+    def test_binary_fairness_all(self):
+        got = F.binary_fairness(PREDS, TARGET, GROUPS, task="all")
+        want = ref_binary_fairness(
+            torch.from_numpy(PREDS), torch.from_numpy(TARGET), torch.from_numpy(GROUPS), task="all"
+        )
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5)
+
+    def test_modular(self):
+        ours = tm.BinaryFairness(num_groups=3, task="all")
+        ref = RefBinaryFairness(num_groups=3, task="all")
+        half = N // 2
+        for sl in (slice(0, half), slice(half, N)):
+            ours.update(PREDS[sl], TARGET[sl], GROUPS[sl])
+            ref.update(torch.from_numpy(PREDS[sl]), torch.from_numpy(TARGET[sl]), torch.from_numpy(GROUPS[sl]))
+        got, want = ours.compute(), ref.compute()
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5)
+
+        ours_r = tm.BinaryGroupStatRates(num_groups=3)
+        ref_r = RefBinaryGroupStatRates(num_groups=3)
+        ours_r.update(PREDS, TARGET, GROUPS)
+        ref_r.update(torch.from_numpy(PREDS), torch.from_numpy(TARGET), torch.from_numpy(GROUPS))
+        got, want = ours_r.compute(), ref_r.compute()
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), want[k].numpy(), atol=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="task"):
+            F.binary_fairness(PREDS, TARGET, GROUPS, task="parity")
+        with pytest.raises(ValueError, match="dtype"):
+            F.binary_groups_stat_rates(PREDS, TARGET, GROUPS.astype(np.float32), 3)
+
+    def test_noncontiguous_group_ids(self):
+        # ids {0, 2} must keep every sample (compact relabel, not unique-count)
+        groups = GROUPS.copy()
+        groups[groups == 1] = 2
+        got = F.binary_fairness(PREDS, TARGET, groups, task="all")
+        contiguous = F.binary_fairness(PREDS, TARGET, (groups > 0).astype(np.int64), task="all")
+        assert set(got) == set(contiguous)
+        for k in got:
+            np.testing.assert_allclose(np.asarray(got[k]), np.asarray(contiguous[k]), atol=1e-6)
+
+
+BIN_P = np.asarray([0, 1, 1, 0, 1, 0, 1, 1])
+BIN_T = np.asarray([0, 1, 0, 0, 1, 1, 1, 0])
+MC_P = np.asarray([0, 2, 1, 2, 0, 1, 2, 1])
+MC_T = np.asarray([0, 1, 1, 2, 0, 2, 2, 1])
+
+
+class TestDice:
+    @pytest.mark.parametrize(
+        "p,t,kw",
+        [
+            (BIN_P, BIN_T, {}),
+            (BIN_P, BIN_T, {"average": "macro", "num_classes": 2}),
+            (BIN_P, BIN_T, {"average": None, "num_classes": 2}),
+            (MC_P, MC_T, {}),
+            (MC_P, MC_T, {"average": "macro", "num_classes": 3}),
+            (MC_P, MC_T, {"average": "weighted", "num_classes": 3}),
+            (MC_P, MC_T, {"average": None, "num_classes": 3}),
+            (MC_P, MC_T, {"average": "macro", "num_classes": 3, "ignore_index": 0}),
+            (MC_P, MC_T, {"ignore_index": 0}),
+            (MC_P, MC_T, {"average": "samples"}),
+        ],
+        ids=lambda v: str(v) if isinstance(v, dict) else "x",
+    )
+    def test_labels(self, p, t, kw):
+        got = np.asarray(F.dice(p, t, **kw))
+        want = ref_dice(torch.from_numpy(p), torch.from_numpy(t), **kw).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_binary_probs(self):
+        pb = np.asarray([0.2, 0.8, 0.6, 0.3, 0.9, 0.1, 0.7, 0.55], dtype=np.float32)
+        got = float(F.dice(pb, BIN_T))
+        want = float(ref_dice(torch.from_numpy(pb), torch.from_numpy(BIN_T)))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_multiclass_probs(self):
+        probs = rng.rand(8, 3).astype(np.float32)
+        probs = probs / probs.sum(1, keepdims=True)
+        got = float(F.dice(probs, MC_T))
+        want = float(ref_dice(torch.from_numpy(probs), torch.from_numpy(MC_T)))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        got2 = np.asarray(F.dice(probs, MC_T, top_k=2, num_classes=3, average="macro"))
+        want2 = ref_dice(torch.from_numpy(probs), torch.from_numpy(MC_T), top_k=2, num_classes=3, average="macro").numpy()
+        np.testing.assert_allclose(got2, want2, atol=1e-5)
+
+    def test_absent_class_and_zero_division(self):
+        p = np.asarray([0, 1, 3, 0, 1, 3])
+        t = np.asarray([0, 1, 1, 0, 3, 3])
+        for kw in ({"average": None, "num_classes": 4}, {"average": "macro", "num_classes": 4},
+                   {"average": "weighted", "num_classes": 4},
+                   {"average": None, "num_classes": 4, "zero_division": 1}):
+            got = np.asarray(F.dice(p, t, **kw))
+            want = ref_dice(torch.from_numpy(p), torch.from_numpy(t), **kw).numpy()
+            np.testing.assert_allclose(got, want, atol=1e-5, err_msg=str(kw))
+
+    def test_multidim(self):
+        p2 = rng.randint(0, 3, (4, 10))
+        t2 = rng.randint(0, 3, (4, 10))
+        for mdmc in ("global", "samplewise"):
+            got = float(F.dice(p2, t2, mdmc_average=mdmc))
+            want = float(ref_dice(torch.from_numpy(p2), torch.from_numpy(t2), mdmc_average=mdmc))
+            np.testing.assert_allclose(got, want, atol=1e-5, err_msg=mdmc)
+
+    def test_modular(self):
+        for kw in ({}, {"average": "macro", "num_classes": 3}, {"average": "samples"}):
+            m = tm.Dice(**kw)
+            m.update(MC_P[:4], MC_T[:4])
+            m.update(MC_P[4:], MC_T[4:])
+            want = ref_dice(torch.from_numpy(MC_P), torch.from_numpy(MC_T), **kw).numpy()
+            np.testing.assert_allclose(np.asarray(m.compute()), want, atol=1e-5, err_msg=str(kw))
+
+    def test_modular_micro_varying_classes(self):
+        # micro without num_classes must accumulate across batches that infer
+        # different class counts
+        m = tm.Dice()
+        m.update(np.asarray([0, 1, 1]), np.asarray([0, 1, 0]))
+        m.update(np.asarray([0, 3, 2]), np.asarray([0, 3, 3]))
+        all_p = np.asarray([0, 1, 1, 0, 3, 2])
+        all_t = np.asarray([0, 1, 0, 0, 3, 3])
+        want = float(ref_dice(torch.from_numpy(all_p), torch.from_numpy(all_t)))
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+    def test_modular_samplewise(self):
+        p2 = rng.randint(0, 3, (4, 10))
+        t2 = rng.randint(0, 3, (4, 10))
+        m = tm.Dice(mdmc_average="samplewise", average="macro", num_classes=3)
+        m.update(p2[:2], t2[:2])
+        m.update(p2[2:], t2[2:])
+        want = float(F.dice(p2, t2, mdmc_average="samplewise", average="macro", num_classes=3))
+        np.testing.assert_allclose(float(np.asarray(m.compute()).mean()), want, atol=1e-5)
+
+
+class TestFeatureShare:
+    def test_single_extractor_call(self):
+        calls = {"n": 0}
+
+        def extractor(imgs):
+            calls["n"] += 1
+            return np.asarray(imgs).reshape(imgs.shape[0], -1)[:, :8]
+
+        fid = tm.FrechetInceptionDistance(feature_extractor=extractor, num_features=8)
+        kid = tm.KernelInceptionDistance(feature_extractor=extractor, subset_size=4)
+        fs = tm.FeatureShare({"fid": fid, "kid": kid})
+
+        imgs = rng.rand(6, 3, 4, 4).astype(np.float32)
+        fs.update(imgs, real=True)
+        # one shared forward instead of one per metric
+        assert calls["n"] == 1
+        fs.update(imgs * 0.5, real=False)
+        assert calls["n"] == 2
+
+    def test_missing_attribute_raises(self):
+        with pytest.raises(AttributeError, match="no attribute"):
+            tm.FeatureShare([tm.MeanSquaredError()])

@@ -1,0 +1,106 @@
+"""CLIPScore / CLIP-IQA tests against the reference formulas with a fake embedder."""
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu.functional.multimodal import (  # noqa: E402
+    clip_image_quality_assessment,
+    clip_score,
+)
+
+rng = np.random.RandomState(3)
+DIM = 16
+
+
+def _img_embed(images):
+    # deterministic pseudo-embedding from channel statistics
+    images = np.asarray(images)
+    feats = np.stack(
+        [images.mean(axis=(1, 2, 3)) * (k + 1) + np.sin(images.std(axis=(1, 2, 3)) + k) for k in range(DIM)],
+        axis=1,
+    )
+    return feats
+
+
+def _txt_embed(texts):
+    out = []
+    for t in texts:
+        h = np.frombuffer(str(t).encode() * DIM, dtype=np.uint8)[: DIM * 4].astype(np.float64)
+        out.append(np.sin(h.reshape(DIM, 4).sum(1)))
+    return np.stack(out)
+
+
+def _joint_embed(images, texts):
+    return _img_embed(images), _txt_embed(texts)
+
+
+IMAGES = rng.rand(4, 3, 8, 8).astype(np.float32)
+TEXTS = ["a cat", "a dog", "a house", "a tree"]
+
+
+def _expected_clip_score(images, texts):
+    i = _img_embed(images)
+    t = _txt_embed(texts)
+    i = i / np.linalg.norm(i, axis=-1, keepdims=True)
+    t = t / np.linalg.norm(t, axis=-1, keepdims=True)
+    return max(0.0, float((100 * (i * t).sum(-1)).mean()))
+
+
+def test_clip_score_functional():
+    got = float(clip_score(IMAGES, TEXTS, _joint_embed))
+    np.testing.assert_allclose(got, _expected_clip_score(IMAGES, TEXTS), rtol=1e-5)
+
+
+def test_clip_score_modular_accumulation():
+    m = tm.CLIPScore(embedding_fn=_joint_embed)
+    m.update(IMAGES[:2], TEXTS[:2])
+    m.update(IMAGES[2:], TEXTS[2:])
+    np.testing.assert_allclose(float(m.compute()), _expected_clip_score(IMAGES, TEXTS), rtol=1e-5)
+
+
+def test_clip_score_validation():
+    with pytest.raises(ModuleNotFoundError):
+        tm.CLIPScore()
+    m = tm.CLIPScore(embedding_fn=_joint_embed)
+    with pytest.raises(ValueError, match="same"):
+        m.update(IMAGES, TEXTS[:2])
+    with pytest.raises(ValueError, match="3d"):
+        m.update([IMAGES[0][None]], [TEXTS[0]])
+
+
+def test_clip_iqa_functional_single_prompt():
+    probs = clip_image_quality_assessment(IMAGES, _img_embed, _txt_embed, prompts=("quality",))
+    # manual formula
+    i = _img_embed(IMAGES)
+    i = i / np.linalg.norm(i, axis=-1, keepdims=True)
+    a = _txt_embed(["Good photo.", "Bad photo."])
+    a = a / np.linalg.norm(a, axis=-1, keepdims=True)
+    logits = 100 * i @ a.T
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    want = (e / e.sum(-1, keepdims=True))[:, 0]
+    np.testing.assert_allclose(np.asarray(probs), want, rtol=1e-4)
+
+
+def test_clip_iqa_multi_prompt_and_modular():
+    prompts = ("quality", ("Warm photo.", "Cold photo."))
+    probs = clip_image_quality_assessment(IMAGES, _img_embed, _txt_embed, prompts=prompts)
+    assert set(probs.keys()) == {"quality", "user_defined_0"}
+    m = tm.CLIPImageQualityAssessment(_img_embed, _txt_embed, prompts=prompts)
+    m.update(IMAGES[:2])
+    m.update(IMAGES[2:])
+    res = m.compute()
+    # reference semantics: per-image scores, concatenated across updates
+    np.testing.assert_allclose(np.asarray(res["quality"]), np.asarray(probs["quality"]), rtol=1e-5)
+
+
+def test_clip_iqa_validation():
+    with pytest.raises(ValueError, match="prompts"):
+        clip_image_quality_assessment(IMAGES, _img_embed, _txt_embed, prompts=("not_a_prompt",))
+    with pytest.raises(ValueError, match="length 2"):
+        clip_image_quality_assessment(IMAGES, _img_embed, _txt_embed, prompts=(("a", "b", "c"),))
+    with pytest.raises(ModuleNotFoundError):
+        tm.CLIPImageQualityAssessment()

@@ -1,0 +1,109 @@
+"""Segmentation utils parity tests vs reference / scipy.ndimage."""
+import sys
+
+import numpy as np
+import pytest
+import torch
+from scipy import ndimage
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+ref_tm = load_reference_torchmetrics()
+from torchmetrics.functional.segmentation.utils import (  # noqa: E402
+    binary_erosion as ref_erosion,
+    distance_transform as ref_dt,
+    mask_edges as ref_mask_edges,
+    surface_distance as ref_surface_distance,
+)
+
+from torchmetrics_tpu.functional.segmentation import (  # noqa: E402
+    binary_erosion,
+    distance_transform,
+    generate_binary_structure,
+    mask_edges,
+    surface_distance,
+)
+
+rng = np.random.RandomState(44)
+MASK = (rng.rand(1, 1, 16, 16) > 0.4).astype(np.uint8)
+MASK2D = (rng.rand(12, 12) > 0.45).astype(np.uint8)
+
+
+@pytest.mark.parametrize("rank,conn", [(2, 1), (2, 2), (3, 1), (3, 2)])
+def test_binary_structure(rank, conn):
+    got = np.asarray(generate_binary_structure(rank, conn))
+    want = ndimage.generate_binary_structure(rank, conn)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_binary_erosion_vs_scipy_and_reference():
+    got = np.asarray(binary_erosion(MASK))
+    want_scipy = ndimage.binary_erosion(MASK[0, 0]).astype(np.uint8)[None, None]
+    np.testing.assert_array_equal(got, want_scipy)
+    want_ref = ref_erosion(torch.from_numpy(MASK)).numpy()
+    np.testing.assert_array_equal(got, want_ref)
+
+
+def test_binary_erosion_structure_and_border():
+    structure = np.ones((3, 3), dtype=np.uint8)
+    got = np.asarray(binary_erosion(MASK, structure=structure, border_value=1))
+    want = ndimage.binary_erosion(MASK[0, 0], structure=structure, border_value=1).astype(np.uint8)[None, None]
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "chessboard", "taxicab"])
+def test_distance_transform(metric):
+    got = np.asarray(distance_transform(MASK2D, metric=metric))
+    want = ref_dt(torch.from_numpy(MASK2D), metric=metric).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    if metric == "euclidean":
+        np.testing.assert_allclose(got, ndimage.distance_transform_edt(MASK2D), atol=1e-5)
+
+
+def test_distance_transform_sampling_and_scipy_engine():
+    got = np.asarray(distance_transform(MASK2D, sampling=[2.0, 0.5]))
+    want = ndimage.distance_transform_edt(MASK2D, sampling=[2.0, 0.5])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    got_scipy = np.asarray(distance_transform(MASK2D, engine="scipy"))
+    np.testing.assert_allclose(got_scipy, ndimage.distance_transform_edt(MASK2D), atol=1e-5)
+
+
+@pytest.mark.parametrize("crop", [True, False])
+def test_mask_edges_erosion_path(crop):
+    p = MASK2D.astype(bool)
+    t = np.roll(MASK2D, 1, axis=0).astype(bool)
+    got_p, got_t = mask_edges(p, t, crop=crop)[:2]
+    want_p, want_t = ref_mask_edges(torch.from_numpy(p), torch.from_numpy(t), crop=crop)[:2]
+    np.testing.assert_array_equal(np.asarray(got_p), want_p.numpy())
+    np.testing.assert_array_equal(np.asarray(got_t), want_t.numpy())
+
+
+def test_mask_edges_spacing_path():
+    p = MASK2D.astype(bool)
+    t = np.roll(MASK2D, 1, axis=0).astype(bool)
+    got = mask_edges(p, t, crop=True, spacing=(1, 1))
+    want = ref_mask_edges(torch.from_numpy(p), torch.from_numpy(t), crop=True, spacing=(1, 1))
+    # reference returns edge tensors with a leading channel dim squeezed at [0]
+    np.testing.assert_array_equal(np.asarray(got[0]), want[0].numpy().squeeze())
+    np.testing.assert_array_equal(np.asarray(got[1]), want[1].numpy().squeeze())
+    np.testing.assert_allclose(np.asarray(got[2]), want[2].numpy().squeeze(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[3]), want[3].numpy().squeeze(), atol=1e-5)
+
+
+def test_surface_distance():
+    p = np.zeros((9, 9), dtype=bool)
+    p[1:8, 1] = p[1:8, 7] = p[1, 1:8] = p[7, 1:8] = True
+    t = np.roll(p, 1, axis=1)
+    got = np.asarray(surface_distance(p, t))
+    want = ref_surface_distance(torch.from_numpy(p), torch.from_numpy(t)).numpy()
+    np.testing.assert_allclose(np.sort(got), np.sort(want), atol=1e-5)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="binarized"):
+        binary_erosion(MASK * 3)
+    with pytest.raises(ValueError, match="rank 2"):
+        distance_transform(MASK2D[0])
+    with pytest.raises(NotImplementedError):
+        mask_edges(MASK2D.astype(bool), MASK2D.astype(bool), spacing=(1, 1, 1))

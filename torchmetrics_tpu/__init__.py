@@ -90,7 +90,8 @@ from torchmetrics_tpu.text import (  # noqa: F401
     WordInfoLost,
     WordInfoPreserved,
 )
-from torchmetrics_tpu import audio, clustering, detection, nominal, retrieval  # noqa: F401
+from torchmetrics_tpu import audio, clustering, detection, multimodal, nominal, retrieval  # noqa: F401
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore  # noqa: F401
 from torchmetrics_tpu.clustering import (  # noqa: F401
     AdjustedMutualInfoScore,
     AdjustedRandScore,
@@ -163,3 +164,5 @@ from torchmetrics_tpu.image import (  # noqa: F401
     UniversalImageQualityIndex,
     VisualInformationFidelity,
 )
+from torchmetrics_tpu.classification import BinaryFairness, BinaryGroupStatRates, Dice  # noqa: F401
+from torchmetrics_tpu.wrappers import FeatureShare  # noqa: F401

@@ -91,3 +91,8 @@ from torchmetrics_tpu.classification.ranking import (  # noqa: F401
     MultilabelRankingAveragePrecision,
     MultilabelRankingLoss,
 )
+from torchmetrics_tpu.classification.dice import Dice  # noqa: F401
+from torchmetrics_tpu.classification.group_fairness import (  # noqa: F401
+    BinaryFairness,
+    BinaryGroupStatRates,
+)

@@ -1,0 +1,89 @@
+"""Modular Dice (reference classification/dice.py, legacy API)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.dice import _dice_reduce, _dice_stats
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+
+class Dice(Metric):
+    """Accumulating Dice score over per-class (or single-column) stat scores."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        zero_division: float = 0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average in ("macro", "weighted", "none", None) and (num_classes is None or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        if ignore_index is not None and num_classes is not None and not 0 <= ignore_index < num_classes:
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.mdmc_average = mdmc_average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if average == "samples" or mdmc_average == "samplewise":
+            self.add_state("sample_scores", default=[], dist_reduce_fx="cat")
+            self.add_state("sample_count", jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            # micro with unknown num_classes accumulates the class-summed scalars,
+            # so batches may infer different class counts without shape clashes
+            size = 1 if num_classes is None else num_classes - (1 if ignore_index is not None else 0)
+            self.add_state("tp", jnp.zeros(size, dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("fp", jnp.zeros(size, dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("fn", jnp.zeros(size, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if self.average == "samples" or self.mdmc_average == "samplewise" and preds.ndim > 1:
+            inner_avg = "micro" if self.average == "samples" else self.average
+            n = preds.shape[0]
+            vals = [
+                _dice_reduce(
+                    *_dice_stats(preds[i], target[i].reshape(-1), self.threshold, self.top_k, self.num_classes, self.ignore_index),
+                    inner_avg,
+                    self.zero_division,
+                )
+                for i in range(n)
+            ]
+            self.sample_scores.append(jnp.stack(vals))
+            self.sample_count = self.sample_count + n
+            return
+        tp, fp, fn = _dice_stats(preds, target, self.threshold, self.top_k, self.num_classes, self.ignore_index)
+        if self.num_classes is None:
+            tp, fp, fn = tp.sum()[None], fp.sum()[None], fn.sum()[None]
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        if self.average == "samples" or self.mdmc_average == "samplewise":
+            return dim_zero_cat(self.sample_scores).sum(0) / self.sample_count
+        return _dice_reduce(self.tp, self.fp, self.fn, self.average, self.zero_division)

@@ -1,0 +1,119 @@
+"""Modular group-fairness metrics (reference classification/group_fairness.py:35-300)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+class _AbstractGroupStatScores(Metric):
+    """Per-group tp/fp/tn/fn accumulators."""
+
+    def _create_states(self, num_groups: int) -> None:
+        self.add_state("tp", jnp.zeros(num_groups, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fp", jnp.zeros(num_groups, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("tn", jnp.zeros(num_groups, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fn", jnp.zeros(num_groups, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_states(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """tp/fp/tn/fn rates per group (reference classification/group_fairness.py:59-155)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_groups, int) and num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(*stats)
+
+    def compute(self) -> Dict[str, Array]:
+        results = jnp.stack((self.tp, self.fp, self.tn, self.fn), axis=1).astype(jnp.float32)
+        return {f"group_{i}": _safe_divide(results[i], results[i].sum()) for i in range(self.num_groups)}
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity ratios (reference classification/group_fairness.py:157-300)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if not isinstance(num_groups, int) and num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.task = task
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Optional[Array], groups: Array) -> None:
+        if self.task == "demographic_parity":
+            if target is not None:
+                rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+            target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+        stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(*stats)
+
+    def compute(self) -> Dict[str, Array]:
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn)
+        return {
+            **_compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn),
+            **_compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn),
+        }

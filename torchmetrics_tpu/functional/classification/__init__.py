@@ -109,3 +109,10 @@ from torchmetrics_tpu.functional.classification.ranking import (  # noqa: F401
     multilabel_ranking_average_precision,
     multilabel_ranking_loss,
 )
+from torchmetrics_tpu.functional.classification.dice import dice  # noqa: F401
+from torchmetrics_tpu.functional.classification.group_fairness import (  # noqa: F401
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)

@@ -1,0 +1,153 @@
+"""Dice score (reference functional/classification/dice.py, the legacy multi-task path).
+
+Behavioral notes pinned against the reference (see tests/classification/test_dice.py):
+
+- integer label inputs (binary included) evaluate as C-class one-hot stats —
+  binary LABELS give the 2-class micro dice, while binary PROBABILITIES give
+  the single-column dice (the legacy input-classification quirk);
+- ``ignore_index`` removes that class COLUMN from the one-hot stats;
+- macro averaging excludes classes absent from both preds and target;
+- ``mdmc_average='global'`` flattens extra dims, ``'samplewise'`` scores each
+  sample then averages.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _safe_divide
+
+
+def _dice_multihot(
+    preds: Array, target: Array, num_classes: int, top_k: Optional[int], threshold: float
+) -> Tuple[Array, Array]:
+    """Convert inputs to (N, C) multi-hot preds + one-hot target."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        if preds.ndim == target.ndim + 1:
+            # (N, C) class probabilities/logits
+            if top_k is not None and top_k > 1:
+                order = jnp.argsort(-preds, axis=1)[:, :top_k]
+                ph = jnp.zeros((preds.shape[0], num_classes), dtype=jnp.int32)
+                ph = ph.at[jnp.arange(preds.shape[0])[:, None], order].set(1)
+            else:
+                ph = (preds.argmax(axis=1)[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.int32)
+        else:
+            raise ValueError("float preds must have one extra class dimension for multiclass dice")
+    else:
+        ph = (preds[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.int32)
+    th = (target[:, None] == jnp.arange(num_classes)[None, :]).astype(jnp.int32)
+    return ph, th
+
+
+def _dice_stats(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    ignore_index: Optional[int],
+) -> Tuple[Array, Array, Array]:
+    """Per-class (tp, fp, fn) of shape (C,) — or (1,) for binary-probability input."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target).astype(jnp.int32)
+
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim:
+        # binary probabilities -> single column (legacy "binary" case)
+        if bool(jnp.any((preds < 0) | (preds > 1))):
+            preds = 1.0 / (1.0 + jnp.exp(-preds))
+        p = (preds > threshold).astype(jnp.int32).reshape(-1)
+        t = target.reshape(-1)
+        if ignore_index is not None:
+            keep = t != ignore_index
+            p, t = p[keep], t[keep]
+        tp = jnp.sum(p * t)[None]
+        fp = jnp.sum(p * (1 - t))[None]
+        fn = jnp.sum((1 - p) * t)[None]
+        return tp, fp, fn
+
+    if num_classes is None:
+        num_classes = int(jnp.maximum(preds.max() if not jnp.issubdtype(preds.dtype, jnp.floating) else 0, target.max())) + 1
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            num_classes = preds.shape[1]
+
+    ph, th = _dice_multihot(preds.reshape(-1) if not jnp.issubdtype(preds.dtype, jnp.floating) else preds, target.reshape(-1), num_classes, top_k, threshold)
+    tp = jnp.sum(ph * th, axis=0)
+    fp = jnp.sum(ph * (1 - th), axis=0)
+    fn = jnp.sum((1 - ph) * th, axis=0)
+    if ignore_index is not None:
+        if not 0 <= ignore_index < num_classes:
+            raise ValueError(f"ignore_index {ignore_index} is not in [0, {num_classes})")
+        keep = jnp.arange(num_classes) != ignore_index
+        tp, fp, fn = tp[keep], fp[keep], fn[keep]
+    return tp, fp, fn
+
+
+def _dice_reduce(tp: Array, fp: Array, fn: Array, average: Optional[str], zero_division: float) -> Array:
+    if average == "micro":
+        denom = 2 * tp.sum() + fp.sum() + fn.sum()
+        return jnp.where(denom == 0, float(zero_division), 2 * tp.sum() / jnp.where(denom == 0, 1, denom))
+    denom = 2 * tp + fp + fn
+    scores = jnp.where(denom == 0, float(zero_division), 2 * tp / jnp.where(denom == 0, 1, denom))
+    if average in (None, "none"):
+        return scores
+    meaningful = (tp + fp + fn) > 0
+    if average == "macro":
+        return _safe_divide(jnp.sum(jnp.where(meaningful, scores, 0.0)), jnp.sum(meaningful))
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+        return _safe_divide(jnp.sum(weights * scores), jnp.sum(weights))
+    raise ValueError(f"Unsupported average {average}")
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice = 2*TP / (2*TP + FP + FN) with the legacy averaging options."""
+    allowed = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed:
+        raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    is_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    extra_dims = preds.ndim > 1 + (1 if is_float and preds.ndim == target.ndim + 1 else 0)
+
+    if extra_dims and mdmc_average == "samplewise" or average == "samples":
+        # per-sample reduction, then mean across samples
+        if is_float and preds.ndim == target.ndim + 1 and preds.ndim > 2:
+            raise NotImplementedError("samplewise dice with probabilistic multidim preds is not supported")
+        n = preds.shape[0]
+        inner_avg = "micro" if average == "samples" else average
+        vals = [
+            _dice_reduce(
+                *_dice_stats(preds[i].reshape(-1) if not is_float else preds[i], target[i].reshape(-1), threshold, top_k, num_classes, ignore_index),
+                inner_avg,
+                zero_division,
+            )
+            for i in range(n)
+        ]
+        return jnp.mean(jnp.stack(vals), axis=0)
+
+    if extra_dims:  # mdmc global: flatten extra dims
+        if is_float and preds.ndim == target.ndim + 1:
+            c = preds.shape[1]
+            preds = jnp.moveaxis(preds, 1, -1).reshape(-1, c)
+            target = target.reshape(-1)
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+    _check_same_shape(preds if not (is_float and preds.ndim == target.ndim + 1) else target, target)
+
+    tp, fp, fn = _dice_stats(preds, target, threshold, top_k, num_classes, ignore_index)
+    return _dice_reduce(tp, fp, fn, average, zero_division)

@@ -1,0 +1,163 @@
+"""Group-fairness metrics (reference functional/classification/group_fairness.py).
+
+The reference sorts by group id and splits into ragged per-group chunks; here
+per-group tp/fp/tn/fn come from one ``segment_sum`` over the group vector —
+static shapes, jit-safe, one fused reduction.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Group tensor must hold ids in [0, num_groups) (reference :30-45).
+
+    The value check runs eagerly only — data-dependent raises cannot trace.
+    """
+    if not jnp.issubdtype(groups.dtype, jnp.integer):
+        raise ValueError(f"Excpected dtype of argument groups to be int, got {groups.dtype}")
+    if not isinstance(groups, jax.core.Tracer) and bool(jnp.max(groups) > num_groups):
+        raise ValueError(
+            f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified"
+            f"number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-group (tp, fp, tn, fn), each of shape (num_groups,)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    groups = jnp.asarray(groups)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    valid = valid.reshape(-1)
+    groups = groups.reshape(-1)
+
+    w = valid.astype(jnp.int32)
+    tp = jax.ops.segment_sum(w * (preds & target), groups, num_segments=num_groups)
+    fp = jax.ops.segment_sum(w * (preds & (1 - target)), groups, num_segments=num_groups)
+    tn = jax.ops.segment_sum(w * ((1 - preds) & (1 - target)), groups, num_segments=num_groups)
+    fn = jax.ops.segment_sum(w * ((1 - preds) & target), groups, num_segments=num_groups)
+    return tp, fp, tn, fn
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group (tp, fp, tn, fn) rates normalized by group size (reference :105-163)."""
+    tp, fp, tn, fn = _binary_groups_stat_scores(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    stats = jnp.stack([tp, fp, tn, fn], axis=1).astype(jnp.float32)  # (G, 4)
+    totals = stats.sum(axis=1, keepdims=True)
+    rates = _safe_divide(stats, totals)
+    return {f"group_{g}": rates[g] for g in range(num_groups)}
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_id = int(jnp.argmin(pos_rates))
+    max_id = int(jnp.argmax(pos_rates))
+    return {f"DP_{min_id}_{max_id}": _safe_divide(pos_rates[min_id], pos_rates[max_id])}
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    tprs = _safe_divide(tp, tp + fn)
+    min_id = int(jnp.argmin(tprs))
+    max_id = int(jnp.argmax(tprs))
+    return {f"EO_{min_id}_{max_id}": _safe_divide(tprs[min_id], tprs[max_id])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """min/max positivity-rate ratio across groups (reference :177-242)."""
+    return binary_fairness(preds, None, groups, "demographic_parity", threshold, ignore_index, validate_args)
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """min/max true-positive-rate ratio across groups (reference :258+)."""
+    return binary_fairness(preds, target, groups, "equal_opportunity", threshold, ignore_index, validate_args)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Optional[Array],
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity for binary predictions."""
+    if task not in ["demographic_parity", "equal_opportunity", "all"]:
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    preds = jnp.asarray(preds)
+    if task == "demographic_parity":
+        if target is not None:
+            rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+        target = jnp.zeros(preds.shape, dtype=jnp.int32)
+    target = jnp.asarray(target)
+
+    # relabel to compact ids so non-contiguous group identifiers keep every sample
+    # (segment_sum drops out-of-range ids silently)
+    _, groups = jnp.unique(jnp.asarray(groups), return_inverse=True)
+    num_groups = int(groups.max()) + 1
+    tp, fp, tn, fn = _binary_groups_stat_scores(
+        preds, target, groups.astype(jnp.int32), num_groups, threshold, ignore_index, validate_args
+    )
+
+    if task == "demographic_parity":
+        return _compute_binary_demographic_parity(tp, fp, tn, fn)
+    if task == "equal_opportunity":
+        return _compute_binary_equal_opportunity(tp, fp, tn, fn)
+    return {
+        **_compute_binary_demographic_parity(tp, fp, tn, fn),
+        **_compute_binary_equal_opportunity(tp, fp, tn, fn),
+    }

@@ -1,0 +1,6 @@
+from torchmetrics_tpu.multimodal.clip_score import (  # noqa: F401
+    clip_image_quality_assessment,
+    clip_score,
+)
+
+__all__ = ["clip_image_quality_assessment", "clip_score"]

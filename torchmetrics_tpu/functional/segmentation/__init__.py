@@ -1,0 +1,21 @@
+from torchmetrics_tpu.functional.segmentation.utils import (  # noqa: F401
+    binary_erosion,
+    check_if_binarized,
+    distance_transform,
+    generate_binary_structure,
+    get_neighbour_tables,
+    mask_edges,
+    surface_distance,
+    table_contour_length,
+)
+
+__all__ = [
+    "binary_erosion",
+    "check_if_binarized",
+    "distance_transform",
+    "generate_binary_structure",
+    "get_neighbour_tables",
+    "mask_edges",
+    "surface_distance",
+    "table_contour_length",
+]

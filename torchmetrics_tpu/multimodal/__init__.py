@@ -1,0 +1,6 @@
+from torchmetrics_tpu.multimodal.clip_score import (  # noqa: F401
+    CLIPImageQualityAssessment,
+    CLIPScore,
+)
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

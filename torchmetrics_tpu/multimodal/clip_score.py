@@ -1,0 +1,205 @@
+"""CLIPScore and CLIP-IQA (reference multimodal/{clip_score,clip_iqa}.py).
+
+The reference wraps HF ``CLIPModel``/``CLIPProcessor`` (torch). In this build
+the model is a pluggable embedding hook — the same escape hatch the reference
+exposes for BERTScore's ``user_model`` — so any flax/jax CLIP (or any joint
+image-text embedder) drives the metric:
+
+    embedding_fn(images, texts) -> (img_features (N, F), txt_features (N, F))
+
+for CLIPScore, and for CLIP-IQA:
+
+    image_embedding_fn(images) -> (N, F)
+    text_embedding_fn(list_of_prompts) -> (P, F)
+
+Loading pretrained CLIP weights requires network access; in offline
+environments constructing without a hook raises with guidance.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.metric import Metric
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _l2_normalize(x: Array) -> Array:
+    return x / jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+
+def _clip_score_update(images, text, embedding_fn: Callable) -> Tuple[Array, int]:
+    """Per-sample 100*cosine scores (reference functional/multimodal/clip_score.py:59-106)."""
+    if not isinstance(images, (list, tuple)):
+        images = jnp.asarray(images)
+        if images.ndim == 3:
+            images = images[None]
+        images = list(images)
+    else:
+        images = [jnp.asarray(i) for i in images]
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+    img_features, txt_features = embedding_fn(jnp.stack(images), text)
+    img_features = _l2_normalize(jnp.asarray(img_features))
+    txt_features = _l2_normalize(jnp.asarray(txt_features))
+    score = 100 * jnp.sum(img_features * txt_features, axis=-1)
+    return score, len(text)
+
+
+def clip_score(images, text, embedding_fn: Callable) -> Array:
+    """Functional CLIPScore: mean 100*cosine(image, caption), floored at 0."""
+    score, n_samples = _clip_score_update(images, text, embedding_fn)
+    return jnp.maximum(score.sum() / n_samples, 0.0)
+
+
+class CLIPScore(Metric):
+    """Mean CLIP image-caption alignment score (reference multimodal/clip_score.py:43-140)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 100.0
+
+    def __init__(self, embedding_fn: Optional[Callable] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if embedding_fn is None:
+            raise ModuleNotFoundError(
+                "CLIPScore requires an `embedding_fn(images, texts) -> (img_features, txt_features)` callable."
+                " Pretrained CLIP weights cannot be fetched in this environment; pass e.g. a flax CLIP apply"
+                " function (transformers FlaxCLIPModel) or any joint embedder."
+            )
+        self.embedding_fn = embedding_fn
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, images, text) -> None:
+        score, n_samples = _clip_score_update(images, text, self.embedding_fn)
+        self.score = self.score + score.sum(0)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        return jnp.maximum(self.score / self.n_samples, 0.0)
+
+
+def _clip_iqa_format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",)):
+    """Expand prompt keywords / custom pairs (reference functional/multimodal/clip_iqa.py:92-140)."""
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if not isinstance(p, (str, tuple)):
+            raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS.keys())} if not custom tuple prompts, got {p}."
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        else:
+            if len(p) != 2:
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+    return prompts_list, prompts_names
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    image_embedding_fn: Callable,
+    text_embedding_fn: Callable,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+    data_range: float = 1.0,
+) -> Union[Array, Dict[str, Array]]:
+    """CLIP-IQA: softmax over (positive, negative) prompt-anchor similarities.
+
+    Reference functional/multimodal/clip_iqa.py: per prompt pair,
+    ``softmax(100 * [sim_pos, sim_neg])[0]`` is the image's quality probability.
+    """
+    prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
+    images = jnp.asarray(images) / float(data_range)
+    img_features = _l2_normalize(jnp.asarray(image_embedding_fn(images)))
+    anchors = _l2_normalize(jnp.asarray(text_embedding_fn(prompts_list)))
+    logits = 100 * img_features @ anchors.T
+    probs = jax.nn.softmax(logits.reshape(logits.shape[0], -1, 2), axis=-1)[:, :, 0]
+    if len(prompts_names) == 1:
+        return probs.squeeze()
+    return {name: probs[:, i] for i, name in enumerate(prompts_names)}
+
+
+class CLIPImageQualityAssessment(Metric):
+    """Prompt-anchored no-reference image quality (reference multimodal/clip_iqa.py:56+)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        image_embedding_fn: Optional[Callable] = None,
+        text_embedding_fn: Optional[Callable] = None,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        data_range: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if image_embedding_fn is None or text_embedding_fn is None:
+            raise ModuleNotFoundError(
+                "CLIPImageQualityAssessment requires `image_embedding_fn(images) -> (N, F)` and"
+                " `text_embedding_fn(prompts) -> (P, F)` callables; pretrained CLIP weights cannot be"
+                " fetched in this environment."
+            )
+        self.image_embedding_fn = image_embedding_fn
+        self.text_embedding_fn = text_embedding_fn
+        self.prompts_list, self.prompts_names = _clip_iqa_format_prompts(prompts)
+        self._prompts_arg = prompts
+        self.data_range = data_range
+        self.add_state("probs_list", default=[], dist_reduce_fx="cat")
+
+    def update(self, images: Array) -> None:
+        probs = clip_image_quality_assessment(
+            images, self.image_embedding_fn, self.text_embedding_fn, self._prompts_arg, self.data_range
+        )
+        if isinstance(probs, dict):
+            probs = jnp.stack([probs[n] for n in self.prompts_names], axis=1)
+        self.probs_list.append(jnp.atleast_2d(probs.reshape(-1, len(self.prompts_names))))
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        # per-image scores, as the reference returns (multimodal/clip_iqa.py compute)
+        probs = jnp.concatenate(self.probs_list, axis=0)
+        if len(self.prompts_names) == 1:
+            return probs[:, 0].squeeze()
+        return {name: probs[:, i] for i, name in enumerate(self.prompts_names)}

@@ -6,3 +6,4 @@ from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper  # noqa: F4
 from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper  # noqa: F401
 from torchmetrics_tpu.wrappers.running import Running  # noqa: F401
 from torchmetrics_tpu.wrappers.tracker import MetricTracker  # noqa: F401
+from torchmetrics_tpu.wrappers.feature_share import FeatureShare, NetworkCache  # noqa: F401

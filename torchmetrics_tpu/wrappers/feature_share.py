@@ -1,0 +1,71 @@
+"""FeatureShare wrapper (reference wrappers/feature_share.py:26-120).
+
+Model-backed metrics in this build hold a ``feature_extractor`` (or other
+named) callable; FeatureShare replaces every member's callable with ONE shared
+memoizing wrapper so a single forward pass serves FID + KID + IS etc.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+
+
+class NetworkCache:
+    """Memoize a feature function by input object identity (reference :26-42).
+
+    The reference wraps the network forward in ``lru_cache``; jax arrays are
+    unhashable, so the cache keys on ``id`` + shape of the input, which covers
+    the FeatureShare pattern (the SAME batch array passed to several metrics).
+    """
+
+    def __init__(self, network: Callable, max_size: int = 100) -> None:
+        self.network = network
+        self.max_size = max_size
+        self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+
+    def __call__(self, x, *args: Any, **kwargs: Any) -> Any:
+        key = (id(x), getattr(x, "shape", None), args)
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            return self._cache[key][1]
+        out = self.network(x, *args, **kwargs)
+        # keep x alive alongside the result: as long as the entry exists, its id
+        # cannot be recycled by a new allocation
+        self._cache[key] = (x, out)
+        if len(self._cache) > self.max_size:
+            self._cache.popitem(last=False)
+        return out
+
+
+class FeatureShare(MetricCollection):
+    """MetricCollection that shares one cached feature extractor across members."""
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        max_cache_size: Optional[int] = None,
+        extractor_attribute: str = "feature_extractor",
+    ) -> None:
+        super().__init__(metrics)
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+        self.extractor_attribute = extractor_attribute
+
+        extractors: List[Callable] = []
+        for name, metric in self.items(keep_base=True, copy_state=False):
+            fn = getattr(metric, extractor_attribute, None)
+            if fn is None:
+                raise AttributeError(
+                    f"Tried to extract the network to share from the metric {name}, but it had no attribute"
+                    f" {extractor_attribute!r}. Please raise an issue or pick metrics exposing one."
+                )
+            extractors.append(fn)
+
+        shared = NetworkCache(extractors[0], max_size=max_cache_size)
+        for _, metric in self.items(keep_base=True, copy_state=False):
+            setattr(metric, extractor_attribute, shared)
