@@ -177,6 +177,24 @@ class TestDice:
         want = float(ref_dice(torch.from_numpy(all_p), torch.from_numpy(all_t)))
         np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
 
+    def test_modular_samplewise_1d_input(self):
+        # samplewise states must also accept 1-D updates (each element = a sample)
+        m = tm.Dice(mdmc_average="samplewise", average="macro", num_classes=3)
+        m.update(MC_P[:4], MC_T[:4])
+        out = float(np.asarray(m.compute()).mean())
+        assert 0.0 <= out <= 1.0
+
+    def test_modular_samplewise_prob_multidim_raises(self):
+        m = tm.Dice(mdmc_average="samplewise", average="macro", num_classes=3)
+        with pytest.raises(NotImplementedError):
+            m.update(rng.rand(2, 3, 5).astype(np.float32), rng.randint(0, 3, (2, 5)))
+
+    def test_modular_out_of_range_group_raises(self):
+        m = tm.BinaryGroupStatRates(num_groups=2)
+        groups = GROUPS.copy()  # holds ids up to 2
+        with pytest.raises(ValueError, match="largest"):
+            m.update(PREDS, TARGET, groups)
+
     def test_modular_samplewise(self):
         p2 = rng.randint(0, 3, (4, 10))
         t2 = rng.randint(0, 3, (4, 10))
@@ -205,6 +223,30 @@ class TestFeatureShare:
         assert calls["n"] == 1
         fs.update(imgs * 0.5, real=False)
         assert calls["n"] == 2
+
+    def test_cache_distinguishes_kwargs_and_array_args(self):
+        from torchmetrics_tpu.wrappers import NetworkCache
+
+        calls = []
+
+        def net(x, scale=1.0):
+            calls.append(scale)
+            return np.asarray(x) * scale
+
+        cache = NetworkCache(net, max_size=4)
+        x = np.ones((2, 2))
+        a = cache(x, scale=1.0)
+        b = cache(x, scale=2.0)  # different kwargs must MISS
+        assert len(calls) == 2 and float(b.sum()) == 2 * float(a.sum())
+        cache(x, scale=1.0)  # same kwargs hit
+        assert len(calls) == 2
+        # array positional args must not crash the key
+        def net2(x, y):
+            return np.asarray(x) + np.asarray(y)
+
+        cache2 = NetworkCache(net2)
+        out = cache2(x, np.ones((2, 2)))
+        assert float(out.sum()) == 8.0
 
     def test_missing_attribute_raises(self):
         with pytest.raises(AttributeError, match="no attribute"):

@@ -62,12 +62,23 @@ class Dice(Metric):
     def update(self, preds: Array, target: Array) -> None:
         preds = jnp.asarray(preds)
         target = jnp.asarray(target)
-        if self.average == "samples" or self.mdmc_average == "samplewise" and preds.ndim > 1:
+        # the branch must mirror the state layout chosen in __init__
+        if self.average == "samples" or self.mdmc_average == "samplewise":
+            is_float = jnp.issubdtype(preds.dtype, jnp.floating)
+            if is_float and preds.ndim == target.ndim + 1 and preds.ndim > 2:
+                raise NotImplementedError("samplewise dice with probabilistic multidim preds is not supported")
             inner_avg = "micro" if self.average == "samples" else self.average
             n = preds.shape[0]
             vals = [
                 _dice_reduce(
-                    *_dice_stats(preds[i], target[i].reshape(-1), self.threshold, self.top_k, self.num_classes, self.ignore_index),
+                    *_dice_stats(
+                        preds[i] if preds[i].ndim else preds[i : i + 1],
+                        target[i].reshape(-1),
+                        self.threshold,
+                        self.top_k,
+                        self.num_classes,
+                        self.ignore_index,
+                    ),
                     inner_avg,
                     self.zero_division,
                 )

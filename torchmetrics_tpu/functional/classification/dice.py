@@ -21,9 +21,7 @@ from torchmetrics_tpu.utils.checks import _check_same_shape
 from torchmetrics_tpu.utils.compute import _safe_divide
 
 
-def _dice_multihot(
-    preds: Array, target: Array, num_classes: int, top_k: Optional[int], threshold: float
-) -> Tuple[Array, Array]:
+def _dice_multihot(preds: Array, target: Array, num_classes: int, top_k: Optional[int]) -> Tuple[Array, Array]:
     """Convert inputs to (N, C) multi-hot preds + one-hot target."""
     if jnp.issubdtype(preds.dtype, jnp.floating):
         if preds.ndim == target.ndim + 1:
@@ -73,7 +71,7 @@ def _dice_stats(
         if jnp.issubdtype(preds.dtype, jnp.floating):
             num_classes = preds.shape[1]
 
-    ph, th = _dice_multihot(preds.reshape(-1) if not jnp.issubdtype(preds.dtype, jnp.floating) else preds, target.reshape(-1), num_classes, top_k, threshold)
+    ph, th = _dice_multihot(preds.reshape(-1) if not jnp.issubdtype(preds.dtype, jnp.floating) else preds, target.reshape(-1), num_classes, top_k)
     tp = jnp.sum(ph * th, axis=0)
     fp = jnp.sum(ph * (1 - th), axis=0)
     fn = jnp.sum((1 - ph) * th, axis=0)

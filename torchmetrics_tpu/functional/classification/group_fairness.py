@@ -28,7 +28,9 @@ def _groups_validation(groups: Array, num_groups: int) -> None:
     """
     if not jnp.issubdtype(groups.dtype, jnp.integer):
         raise ValueError(f"Excpected dtype of argument groups to be int, got {groups.dtype}")
-    if not isinstance(groups, jax.core.Tracer) and bool(jnp.max(groups) > num_groups):
+    # >= (not the reference's >): out-of-range ids are silently DROPPED by
+    # segment_sum here, whereas the reference's sort/split keeps them
+    if not isinstance(groups, jax.core.Tracer) and bool(jnp.max(groups) >= num_groups):
         raise ValueError(
             f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified"
             f"number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."

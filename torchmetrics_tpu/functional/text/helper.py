@@ -16,18 +16,15 @@ _INT_INFINITY = int(1e16)
 
 
 def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1) -> int:
-    """Word/char-level Levenshtein distance (two-row DP).
+    """Word/char-level Levenshtein distance.
 
-    Reference functional/text/helper.py:297-320 (`_edit_distance` free function).
+    Reference functional/text/helper.py:297-320 (`_edit_distance` free function);
+    dispatches to the first-party C++ kernel (native/edit_distance.cpp) with a
+    pure-Python two-row DP fallback.
     """
-    prev = list(range(len(reference_tokens) + 1))
-    for i, p_tok in enumerate(prediction_tokens, start=1):
-        cur = [i] + [0] * len(reference_tokens)
-        for j, r_tok in enumerate(reference_tokens, start=1):
-            sub = prev[j - 1] + (substitution_cost if p_tok != r_tok else 0)
-            cur[j] = min(sub, prev[j] + 1, cur[j - 1] + 1)
-        prev = cur
-    return prev[-1]
+    from torchmetrics_tpu.native import edit_distance as _native_edit_distance
+
+    return _native_edit_distance(prediction_tokens, reference_tokens, substitution_cost)
 
 
 class _LevenshteinEditDistance:

@@ -1,0 +1,131 @@
+"""First-party native (C++) kernels, loaded via ctypes.
+
+The shared library is compiled once on first use (g++ -O3, cached next to the
+source); every entry point has a pure-Python fallback so the package works
+without a toolchain. See edit_distance.cpp for the kernel inventory.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "edit_distance.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib_path() -> str:
+    cache_dir = os.environ.get("TM_TPU_NATIVE_CACHE", os.path.join(tempfile.gettempdir(), "tm_tpu_native"))
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir, "libtm_edit.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen the kernel library; None when unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    lib_path = _build_lib_path()
+    try:
+        if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", lib_path],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(lib_path)
+        lib.tm_levenshtein.restype = ctypes.c_int64
+        lib.tm_levenshtein.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.tm_levenshtein_batch.restype = None
+        lib.tm_levenshtein_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
+            ctypes.POINTER(ctypes.c_int64)
+        ] * 2 + [ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        _LIB = lib
+    except (OSError, subprocess.SubprocessError, FileNotFoundError):
+        _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _tokens_to_ids(*sequences: Sequence) -> List[np.ndarray]:
+    """Map arbitrary hashable tokens to a shared int-id space."""
+    vocab: dict = {}
+    out = []
+    for seq in sequences:
+        ids = np.empty(len(seq), dtype=np.int64)
+        for i, tok in enumerate(seq):
+            ids[i] = vocab.setdefault(tok, len(vocab))
+        out.append(ids)
+    return out
+
+
+def _py_edit_distance(a: Sequence, b: Sequence, substitution_cost: int = 1) -> int:
+    prev = list(range(len(b) + 1))
+    for i, p_tok in enumerate(a, start=1):
+        cur = [i] + [0] * len(b)
+        for j, r_tok in enumerate(b, start=1):
+            sub = prev[j - 1] + (substitution_cost if p_tok != r_tok else 0)
+            cur[j] = min(sub, prev[j] + 1, cur[j - 1] + 1)
+        prev = cur
+    return prev[-1]
+
+
+def edit_distance(a: Sequence, b: Sequence, substitution_cost: int = 1) -> int:
+    """Levenshtein distance over arbitrary token sequences (native if possible)."""
+    lib = _load()
+    if lib is None:
+        return _py_edit_distance(a, b, substitution_cost)
+    ia, ib = _tokens_to_ids(a, b)
+    pa = ia.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    pb = ib.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    return int(lib.tm_levenshtein(pa, len(ia), pb, len(ib), substitution_cost))
+
+
+def batch_edit_distance(
+    pairs: Sequence[Tuple[Sequence, Sequence]], substitution_cost: int = 1
+) -> np.ndarray:
+    """Edit distances for a batch of (prediction_tokens, reference_tokens) pairs."""
+    lib = _load()
+    if lib is None:
+        return np.asarray([_py_edit_distance(a, b, substitution_cost) for a, b in pairs], dtype=np.int64)
+    seqs: List[Sequence] = []
+    for a, b in pairs:
+        seqs.append(a)
+        seqs.append(b)
+    ids = _tokens_to_ids(*seqs)
+    a_seqs = ids[0::2]
+    b_seqs = ids[1::2]
+    a_flat = np.concatenate(a_seqs) if a_seqs else np.zeros(0, dtype=np.int64)
+    b_flat = np.concatenate(b_seqs) if b_seqs else np.zeros(0, dtype=np.int64)
+    a_off = np.zeros(len(pairs) + 1, dtype=np.int64)
+    b_off = np.zeros(len(pairs) + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in a_seqs], out=a_off[1:])
+    np.cumsum([len(s) for s in b_seqs], out=b_off[1:])
+    out = np.zeros(len(pairs), dtype=np.int64)
+    p = ctypes.POINTER(ctypes.c_int64)
+    lib.tm_levenshtein_batch(
+        a_flat.ctypes.data_as(p),
+        a_off.ctypes.data_as(p),
+        b_flat.ctypes.data_as(p),
+        b_off.ctypes.data_as(p),
+        len(pairs),
+        substitution_cost,
+        out.ctypes.data_as(p),
+    )
+    return out

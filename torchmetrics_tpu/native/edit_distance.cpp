@@ -1,0 +1,50 @@
+// First-party native kernel: batched Levenshtein edit distance.
+//
+// The text-domain host path (WER/CER/MER/WIL/WIP/EditDistance/TER) reduces
+// every sequence pair to an edit distance before anything touches the device.
+// The reference leans on Python DP loops (functional/text/helper.py); this
+// kernel runs the same two-row DP in C++ over a whole batch of tokenized
+// (id-mapped) sequence pairs in one call.
+//
+// Build: g++ -O3 -shared -fPIC edit_distance.cpp -o libtm_edit.so
+// ABI: plain C, driven through ctypes (no pybind11 in this environment).
+
+#include <cstdint>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// Single pair: Levenshtein distance between a[0..n) and b[0..m).
+int64_t tm_levenshtein(const int64_t* a, int64_t n, const int64_t* b, int64_t m,
+                       int64_t substitution_cost) {
+  if (n == 0) return m;
+  if (m == 0) return n;
+  std::vector<int64_t> prev(m + 1), cur(m + 1);
+  for (int64_t j = 0; j <= m; ++j) prev[j] = j;
+  for (int64_t i = 1; i <= n; ++i) {
+    cur[0] = i;
+    const int64_t ai = a[i - 1];
+    for (int64_t j = 1; j <= m; ++j) {
+      const int64_t sub = prev[j - 1] + (ai != b[j - 1] ? substitution_cost : 0);
+      cur[j] = std::min({sub, prev[j] + 1, cur[j - 1] + 1});
+    }
+    std::swap(prev, cur);
+  }
+  return prev[m];
+}
+
+// Batch: flattened sequences with exclusive prefix offsets (len batch+1 each).
+// out[k] = distance(a[ao[k]:ao[k+1]], b[bo[k]:bo[k+1]]).
+void tm_levenshtein_batch(const int64_t* a_flat, const int64_t* a_offsets,
+                          const int64_t* b_flat, const int64_t* b_offsets,
+                          int64_t batch, int64_t substitution_cost,
+                          int64_t* out) {
+  for (int64_t k = 0; k < batch; ++k) {
+    out[k] = tm_levenshtein(a_flat + a_offsets[k], a_offsets[k + 1] - a_offsets[k],
+                            b_flat + b_offsets[k], b_offsets[k + 1] - b_offsets[k],
+                            substitution_cost);
+  }
+}
+
+}  // extern "C"

@@ -26,15 +26,26 @@ class NetworkCache:
         self.max_size = max_size
         self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
 
+    @staticmethod
+    def _key_part(v: Any) -> Any:
+        # arrays are unhashable: key them by identity+shape
+        if hasattr(v, "shape"):
+            return (id(v), v.shape)
+        return v
+
     def __call__(self, x, *args: Any, **kwargs: Any) -> Any:
-        key = (id(x), getattr(x, "shape", None), args)
+        key = (
+            self._key_part(x),
+            tuple(self._key_part(a) for a in args),
+            tuple(sorted((k, self._key_part(v)) for k, v in kwargs.items())),
+        )
         if key in self._cache:
             self._cache.move_to_end(key)
-            return self._cache[key][1]
+            return self._cache[key][-1]
         out = self.network(x, *args, **kwargs)
-        # keep x alive alongside the result: as long as the entry exists, its id
-        # cannot be recycled by a new allocation
-        self._cache[key] = (x, out)
+        # keep the inputs alive alongside the result: as long as the entry
+        # exists their ids cannot be recycled by new allocations
+        self._cache[key] = (x, args, kwargs, out)
         if len(self._cache) > self.max_size:
             self._cache.popitem(last=False)
         return out
