@@ -48,7 +48,7 @@ class BinaryGroupStatRates(_AbstractGroupStatScores):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if not isinstance(num_groups, int) and num_groups < 2:
+        if not isinstance(num_groups, int) or num_groups < 2:  # deliberate fix of the reference's dead `and` check (group_fairness.py:203)
             raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
         self.num_groups = num_groups
         self.threshold = threshold
@@ -89,7 +89,7 @@ class BinaryFairness(_AbstractGroupStatScores):
                 f"Expected argument `task` to either be ``demographic_parity``,"
                 f"``equal_opportunity`` or ``all`` but got {task}."
             )
-        if not isinstance(num_groups, int) and num_groups < 2:
+        if not isinstance(num_groups, int) or num_groups < 2:  # deliberate fix of the reference's dead `and` check (group_fairness.py:203)
             raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
         self.task = task
         self.num_groups = num_groups

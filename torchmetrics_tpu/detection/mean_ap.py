@@ -29,7 +29,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
-from torchmetrics_tpu.functional.detection.iou import box_area, box_convert, box_iou
+from torchmetrics_tpu.functional.detection.iou import _inter_union, box_area, box_convert
 from torchmetrics_tpu.metric import Metric
 
 
@@ -37,51 +37,66 @@ from torchmetrics_tpu.metric import Metric
 def _matching_kernel(num_thresholds: int):
     """Build the jitted greedy matcher for a given threshold count.
 
-    Returns f(ious (E,D,G), gt_ignore (A,E,G), gt_crowd (E,G), det_valid (E,D),
-    thresholds (T,)) -> (det_matches, det_crowd) both (A,E,T,D) bool:
-    whether each detection matched a non-ignored ground truth at each IoU
+    Returns f(ious (E,D,G), crowd_over (E,D,G), gt_ignore (A,E,G), gt_crowd (E,G),
+    det_valid (E,D), thresholds (T,)) -> (det_matches, det_crowd) both (A,E,T,D)
+    bool: whether each detection matched a non-ignored ground truth at each IoU
     threshold per area range, and whether an otherwise-unmatched detection
     overlaps a crowd ground truth above threshold (such detections are ignored,
-    COCO intent). Greedy in detection rank (detections pre-sorted by score),
-    best-IoU ground truth first — reference _mean_ap.py:_find_best_gt_match
-    semantics; crowd absorption is an extension (a crowd can absorb any number
-    of detections).
+    COCO intent). ``crowd_over`` is the COCO crowd overlap — intersection over
+    *detection* area, not symmetric IoU — so a small detection inside a large
+    crowd region is still absorbed. Greedy in detection rank (detections
+    pre-sorted by score), best-IoU ground truth first — reference
+    _mean_ap.py:_find_best_gt_match semantics; crowd absorption is an extension
+    (a crowd can absorb any number of detections).
     """
 
-    def match_one(ious, gt_ignore, gt_crowd, det_valid, thresholds):
-        # ious (D, G); gt_ignore/gt_crowd (G,); det_valid (D,); thresholds (T,)
+    def match_one(ious, crowd_over, gt_ignore, gt_crowd, det_valid, thresholds):
+        # ious/crowd_over (D, G); gt_ignore/gt_crowd (G,); det_valid (D,); thresholds (T,)
         num_gt = ious.shape[1]
 
         def step(gt_matched, inputs):
             # gt_matched (T, G)
-            iou_row, valid = inputs  # (G,), scalar
+            iou_row, crowd_row, valid = inputs  # (G,), (G,), scalar
             cand = iou_row[None, :] * ~(gt_matched | gt_ignore[None, :])  # (T, G)
             m = jnp.argmax(cand, axis=-1)  # (T,)
             val = jnp.take_along_axis(cand, m[:, None], axis=-1)[:, 0]
             ok = (val > thresholds) & valid
             gt_matched = gt_matched | (jax.nn.one_hot(m, num_gt, dtype=bool) & ok[:, None])
             # unmatched detection covering a crowd gt above threshold -> ignore it
-            crowd_val = jnp.max(jnp.where(gt_crowd[None, :], iou_row[None, :], 0.0), axis=-1)
+            crowd_val = jnp.max(jnp.where(gt_crowd[None, :], crowd_row[None, :], 0.0), axis=-1)
             crowd_hit = (crowd_val > thresholds) & valid & ~ok
             return gt_matched, (ok, crowd_hit)
 
         init = jnp.zeros((thresholds.shape[0], num_gt), dtype=bool)
-        _, (det_matches, det_crowd) = jax.lax.scan(step, init, (ious, det_valid))  # (D, T) each
+        _, (det_matches, det_crowd) = jax.lax.scan(step, init, (ious, crowd_over, det_valid))  # (D, T) each
         return det_matches.T, det_crowd.T  # (T, D)
 
     # vmap over pairs (E) then area ranges (A)
-    f = jax.vmap(match_one, in_axes=(0, 0, 0, 0, None))  # over E
-    f = jax.vmap(f, in_axes=(None, 0, None, None, None))  # over A
+    f = jax.vmap(match_one, in_axes=(0, 0, 0, 0, 0, None))  # over E
+    f = jax.vmap(f, in_axes=(None, None, 0, None, None, None))  # over A
     return jax.jit(f)
 
 
-def _mask_iou(masks1: np.ndarray, masks2: np.ndarray) -> Array:
-    """IoU between boolean masks: (N, H, W) x (M, H, W) -> (N, M)."""
+def _mask_iou_ioa(masks1: np.ndarray, masks2: np.ndarray):
+    """(IoU, IoA) between boolean masks, one shared intersection matmul.
+
+    IoA = intersection over the *first* mask's area — COCO's detection-vs-crowd
+    overlap; computed together with IoU so the (N, H*W) @ (H*W, M) product runs once.
+    """
     m1 = jnp.asarray(masks1).reshape(masks1.shape[0], -1).astype(jnp.float32)
     m2 = jnp.asarray(masks2).reshape(masks2.shape[0], -1).astype(jnp.float32)
     inter = m1 @ m2.T
-    union = m1.sum(-1)[:, None] + m2.sum(-1)[None, :] - inter
-    return inter / jnp.clip(union, 1e-9)
+    area1 = m1.sum(-1)[:, None]
+    union = area1 + m2.sum(-1)[None, :] - inter
+    return inter / jnp.clip(union, 1e-9), inter / jnp.clip(area1, 1e-9)
+
+
+def _box_iou_ioa(boxes1: Array, boxes2: Array):
+    """(IoU, IoA) between box sets, one shared intersection computation."""
+    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
+    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
+    inter, union = _inter_union(boxes1, boxes2)
+    return inter / (union + 1e-7), inter / (box_area(boxes1)[:, None] + 1e-7)
 
 
 class MeanAveragePrecision(Metric):
@@ -233,9 +248,11 @@ class MeanAveragePrecision(Metric):
 
         # one batched IoU over all pairs; zero-padded items yield IoU 0 and are
         # masked out of matching anyway (det_valid / gt_ignore)
-        iou_fn = box_iou if self.iou_type == "bbox" else _mask_iou
-        ious = jax.vmap(iou_fn)(jnp.asarray(det_items), jnp.asarray(gt_items))
-        return pair_class, det_scores, det_valid, det_areas, gt_valid, gt_crowd, gt_areas, ious
+        # one batched pass computes both IoU and the crowd overlap (inter / det_area,
+        # COCO semantics) — the intersection product is shared
+        pair_fn = _box_iou_ioa if self.iou_type == "bbox" else _mask_iou_ioa
+        ious, crowd_over = jax.vmap(pair_fn)(jnp.asarray(det_items), jnp.asarray(gt_items))
+        return pair_class, det_scores, det_valid, det_areas, gt_valid, gt_crowd, gt_areas, ious, crowd_over
 
     def compute(self) -> dict:
         classes = self._get_classes()
@@ -270,7 +287,7 @@ class MeanAveragePrecision(Metric):
         built = self._build_pairs(classes)
         if built is None:
             return precision, recall
-        pair_class, det_scores, det_valid, det_areas, gt_valid, gt_crowd, gt_areas, ious = built
+        pair_class, det_scores, det_valid, det_areas, gt_valid, gt_crowd, gt_areas, ious, crowd_over = built
 
         # per-area ground-truth ignore masks (A, E, G)
         ranges = list(self.bbox_area_ranges.values())
@@ -284,6 +301,7 @@ class MeanAveragePrecision(Metric):
         kernel = _matching_kernel(num_t)
         det_matches, det_crowd = kernel(
             ious,
+            crowd_over,
             jnp.asarray(gt_ignore),
             jnp.asarray(gt_crowd),
             jnp.asarray(det_valid),

@@ -103,8 +103,8 @@ def signal_distortion_ratio(
     # SDR at ~10*log10(1/eps) instead of returning inf/nan
     ratio = coh / jnp.clip(1 - coh, jnp.finfo(solve_dtype).eps)
     val = 10.0 * jnp.log10(ratio)
-    if preds_dtype == jnp.float64:
-        return val
+    if jnp.issubdtype(preds_dtype, jnp.floating):
+        return val.astype(preds_dtype)
     return val.astype(jnp.float32)
 
 

@@ -68,9 +68,14 @@ def _nominal_confmat_update(
     target = jnp.asarray(target)
     preds = preds.argmax(1) if preds.ndim == 2 else preds
     target = target.argmax(1) if target.ndim == 2 else target
-    preds, target, valid = _handle_nan_in_data(
-        preds.astype(jnp.float32), target.astype(jnp.float32), nan_strategy, nan_replace_value
-    )
+    # NaNs are impossible in integer inputs, so keep integer labels in the integer
+    # path — a float32 round-trip would corrupt label values above 2**24
+    if jnp.issubdtype(preds.dtype, jnp.floating) or jnp.issubdtype(target.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        preds, target, valid = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    else:
+        valid = jnp.ones(preds.shape, dtype=bool)
     if not isinstance(preds, jax.core.Tracer):
         vals = jnp.concatenate([preds[valid], target[valid]])
         if vals.size and (bool(vals.min() < 0) or bool(vals.max() >= num_classes)):
@@ -97,10 +102,12 @@ def _nominal_confmat_from_values(
     target = jnp.asarray(target)
     preds = preds.argmax(1) if preds.ndim == 2 else preds
     target = target.argmax(1) if target.ndim == 2 else target
-    preds, target, valid = _handle_nan_in_data(
-        preds.astype(jnp.float32), target.astype(jnp.float32), nan_strategy, nan_replace_value
-    )
-    preds, target = preds[valid], target[valid]
+    # integer labels stay integer (no NaNs possible; float32 loses precision > 2**24)
+    if jnp.issubdtype(preds.dtype, jnp.floating) or jnp.issubdtype(target.dtype, jnp.floating):
+        preds, target, valid = _handle_nan_in_data(
+            preds.astype(jnp.float32), target.astype(jnp.float32), nan_strategy, nan_replace_value
+        )
+        preds, target = preds[valid], target[valid]
     uniques = jnp.unique(jnp.concatenate([preds, target]))
     preds_idx = jnp.searchsorted(uniques, preds)
     target_idx = jnp.searchsorted(uniques, target)

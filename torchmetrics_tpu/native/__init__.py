@@ -9,7 +9,6 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import tempfile
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -19,9 +18,31 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _build_lib_path() -> str:
-    cache_dir = os.environ.get("TM_TPU_NATIVE_CACHE", os.path.join(tempfile.gettempdir(), "tm_tpu_native"))
-    os.makedirs(cache_dir, exist_ok=True)
+def _warn_disabled(reason: str) -> None:
+    import warnings
+
+    warnings.warn(
+        f"torchmetrics_tpu native kernels disabled: {reason}. Falling back to the pure-Python "
+        "path; set TM_TPU_NATIVE_CACHE to a directory you own to re-enable.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+def _default_cache_dir() -> str:
+    # Per-user cache (not the world-shared tempdir): on multi-user hosts a shared
+    # /tmp path would let another user pre-plant a .so that ctypes would dlopen.
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "tm_tpu_native")
+
+
+def _build_lib_path() -> Optional[str]:
+    cache_dir = os.environ.get("TM_TPU_NATIVE_CACHE", _default_cache_dir())
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    st = os.stat(cache_dir)
+    if hasattr(os, "geteuid") and st.st_uid != os.geteuid():
+        _warn_disabled(f"cache dir {cache_dir!r} is owned by uid {st.st_uid}, not the current user")
+        return None  # refuse to compile/load from a directory owned by someone else
     return os.path.join(cache_dir, "libtm_edit.so")
 
 
@@ -31,8 +52,11 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    lib_path = _build_lib_path()
     try:
+        lib_path = _build_lib_path()
+        if lib_path is None:
+            _LIB = None
+            return None
         if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(_SRC):
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", lib_path],
@@ -40,6 +64,10 @@ def _load() -> Optional[ctypes.CDLL]:
                 capture_output=True,
                 timeout=120,
             )
+        if hasattr(os, "geteuid") and os.stat(lib_path).st_uid != os.geteuid():
+            _warn_disabled(f"compiled library {lib_path!r} is owned by another user")
+            _LIB = None
+            return None
         lib = ctypes.CDLL(lib_path)
         lib.tm_levenshtein.restype = ctypes.c_int64
         lib.tm_levenshtein.argtypes = [
