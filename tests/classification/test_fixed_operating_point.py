@@ -1,0 +1,216 @@
+"""Parity tests for the *AtFixed* quartet vs the reference torchmetrics implementation."""
+import functools
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    PrecisionAtFixedRecall,
+    RecallAtFixedPrecision,
+    SensitivityAtSpecificity,
+    SpecificityAtSensitivity,
+)
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+from helpers.testers import MetricTester  # noqa: E402
+
+tm_ref = load_reference_torchmetrics()
+import torch  # noqa: E402
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES = 4, 32, 5
+rng = np.random.RandomState(7)
+BIN_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_PROBS = MC_PROBS / MC_PROBS.sum(-1, keepdims=True)
+MC_TARGET = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+ML_PROBS = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+FAMILIES = [
+    # (ours functional prefix, reference functional prefix, min kwarg)
+    ("recall_at_fixed_precision", "recall_at_fixed_precision", 0.5),
+    ("precision_at_fixed_recall", "precision_at_fixed_recall", 0.5),
+    ("sensitivity_at_specificity", "sensitivity_at_specificity", 0.5),
+    ("specificity_at_sensitivity", "specificity_at_sensitivity", 0.5),
+]
+THRESHOLD_MODES = [None, 25]
+
+
+def _ref_fn(name):
+    return getattr(tm_ref.functional.classification, name)
+
+
+def _pair_to_np(res):
+    return tuple(np.asarray(x) for x in res)
+
+
+@pytest.mark.parametrize("family,ref_name,min_v", FAMILIES)
+@pytest.mark.parametrize("thresholds", THRESHOLD_MODES)
+class TestBinaryFixedParity(MetricTester):
+    def test_functional(self, family, ref_name, min_v, thresholds):
+        ours = getattr(F.classification, f"binary_{family}")
+        ref = _ref_fn(f"binary_{ref_name}")
+        for i in range(NUM_BATCHES):
+            got = _pair_to_np(ours(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]), min_v, thresholds=thresholds))
+            exp = _pair_to_np(ref(torch.tensor(BIN_PROBS[i]), torch.tensor(BIN_TARGET[i]), min_v, thresholds=thresholds))
+            np.testing.assert_allclose(got[0], exp[0], atol=1e-5, err_msg=f"value batch={i}")
+            np.testing.assert_allclose(got[1], exp[1], atol=1e-5, err_msg=f"threshold batch={i}")
+
+
+@pytest.mark.parametrize("family,ref_name,min_v", FAMILIES)
+@pytest.mark.parametrize("thresholds", THRESHOLD_MODES)
+class TestMulticlassFixedParity(MetricTester):
+    def test_functional(self, family, ref_name, min_v, thresholds):
+        ours = getattr(F.classification, f"multiclass_{family}")
+        ref = _ref_fn(f"multiclass_{ref_name}")
+        for i in range(NUM_BATCHES):
+            got = _pair_to_np(
+                ours(jnp.asarray(MC_PROBS[i]), jnp.asarray(MC_TARGET[i]), NUM_CLASSES, min_v, thresholds=thresholds)
+            )
+            exp = _pair_to_np(
+                ref(torch.tensor(MC_PROBS[i]), torch.tensor(MC_TARGET[i]), NUM_CLASSES, min_v, thresholds=thresholds)
+            )
+            np.testing.assert_allclose(got[0], exp[0], atol=1e-5, err_msg=f"value batch={i}")
+            np.testing.assert_allclose(got[1], exp[1], atol=1e-5, err_msg=f"threshold batch={i}")
+
+
+@pytest.mark.parametrize("family,ref_name,min_v", FAMILIES)
+@pytest.mark.parametrize("thresholds", THRESHOLD_MODES)
+class TestMultilabelFixedParity(MetricTester):
+    def test_functional(self, family, ref_name, min_v, thresholds):
+        ours = getattr(F.classification, f"multilabel_{family}")
+        ref = _ref_fn(f"multilabel_{ref_name}")
+        for i in range(NUM_BATCHES):
+            got = _pair_to_np(
+                ours(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]), NUM_CLASSES, min_v, thresholds=thresholds)
+            )
+            exp = _pair_to_np(
+                ref(torch.tensor(ML_PROBS[i]), torch.tensor(ML_TARGET[i]), NUM_CLASSES, min_v, thresholds=thresholds)
+            )
+            np.testing.assert_allclose(got[0], exp[0], atol=1e-5, err_msg=f"value batch={i}")
+            np.testing.assert_allclose(got[1], exp[1], atol=1e-5, err_msg=f"threshold batch={i}")
+
+
+class TestClassInterface(MetricTester):
+    def _ref_total(self, cls, kwargs, preds, target):
+        m = cls(**kwargs)
+        m.update(torch.tensor(preds), torch.tensor(target))
+        return tuple(np.asarray(x) for x in m.compute())
+
+    @pytest.mark.parametrize("thresholds", THRESHOLD_MODES)
+    def test_binary_recall_at_fixed_precision_class(self, thresholds):
+        def ref_metric(preds, target):
+            return self._ref_total(
+                tm_ref.classification.BinaryRecallAtFixedPrecision,
+                dict(min_precision=0.5, thresholds=thresholds),
+                preds.reshape(-1),
+                target.reshape(-1),
+            )
+
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            functools.partial(BinaryRecallAtFixedPrecision, min_precision=0.5, thresholds=thresholds),
+            ref_metric,
+            check_batch=False,
+        )
+
+    def test_binary_binned_ddp(self):
+        def ref_metric(preds, target):
+            return self._ref_total(
+                tm_ref.classification.BinaryRecallAtFixedPrecision,
+                dict(min_precision=0.5, thresholds=25),
+                preds.reshape(-1),
+                target.reshape(-1),
+            )
+
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            functools.partial(BinaryRecallAtFixedPrecision, min_precision=0.5, thresholds=25),
+            ref_metric,
+            ddp=True,
+            check_batch=False,
+        )
+
+    def test_multiclass_binned_ddp(self):
+        def ref_metric(preds, target):
+            return self._ref_total(
+                tm_ref.classification.MulticlassRecallAtFixedPrecision,
+                dict(num_classes=NUM_CLASSES, min_precision=0.5, thresholds=25),
+                preds.reshape(-1, NUM_CLASSES),
+                target.reshape(-1),
+            )
+
+        self.run_class_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            functools.partial(MulticlassRecallAtFixedPrecision, num_classes=NUM_CLASSES, min_precision=0.5, thresholds=25),
+            ref_metric,
+            ddp=True,
+            check_batch=False,
+        )
+
+    def test_multilabel_exact_class(self):
+        def ref_metric(preds, target):
+            return self._ref_total(
+                tm_ref.classification.MultilabelRecallAtFixedPrecision,
+                dict(num_labels=NUM_CLASSES, min_precision=0.5, thresholds=None),
+                preds.reshape(-1, NUM_CLASSES),
+                target.reshape(-1, NUM_CLASSES),
+            )
+
+        self.run_class_metric_test(
+            ML_PROBS,
+            ML_TARGET,
+            functools.partial(MultilabelRecallAtFixedPrecision, num_labels=NUM_CLASSES, min_precision=0.5),
+            ref_metric,
+            check_batch=False,
+        )
+
+    def test_binned_jit(self):
+        self.run_jit_test(
+            BIN_PROBS, BIN_TARGET, functools.partial(BinarySensitivityAtSpecificity, min_specificity=0.5, thresholds=25)
+        )
+
+    def test_dispatchers(self):
+        for disp, kw in [
+            (RecallAtFixedPrecision, dict(min_precision=0.5)),
+            (PrecisionAtFixedRecall, dict(min_recall=0.5)),
+            (SensitivityAtSpecificity, dict(min_specificity=0.5)),
+            (SpecificityAtSensitivity, dict(min_sensitivity=0.5)),
+        ]:
+            m = disp(task="binary", thresholds=10, **kw)
+            m.update(jnp.asarray(BIN_PROBS[0]), jnp.asarray(BIN_TARGET[0]))
+            val, thr = m.compute()
+            assert val.shape == () and thr.shape == ()
+            mc = disp(task="multiclass", num_classes=NUM_CLASSES, thresholds=10, **kw)
+            mc.update(jnp.asarray(MC_PROBS[0]), jnp.asarray(MC_TARGET[0]))
+            val, thr = mc.compute()
+            assert val.shape == (NUM_CLASSES,)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_precision"):
+            BinaryRecallAtFixedPrecision(min_precision=2.0)
+        with pytest.raises(ValueError, match="min_recall"):
+            BinaryPrecisionAtFixedRecall(min_recall="x")
+        with pytest.raises(ValueError, match="min_sensitivity"):
+            BinarySpecificityAtSensitivity(min_sensitivity=-0.1)
+
+    def test_unattainable_sentinel(self):
+        # all-negative targets: no precision floor can ever be met -> (0, 1e6)
+        m = BinaryRecallAtFixedPrecision(min_precision=0.9, thresholds=10)
+        m.update(jnp.asarray([0.1, 0.6, 0.8]), jnp.asarray([0, 0, 0]))
+        val, thr = m.compute()
+        assert float(val) == 0.0 and float(thr) == 1e6

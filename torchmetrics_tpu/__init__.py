@@ -35,9 +35,13 @@ from torchmetrics_tpu.classification import (  # noqa: F401
     JaccardIndex,
     MatthewsCorrCoef,
     Precision,
+    PrecisionAtFixedRecall,
     PrecisionRecallCurve,
     Recall,
+    RecallAtFixedPrecision,
+    SensitivityAtSpecificity,
     Specificity,
+    SpecificityAtSensitivity,
     StatScores,
 )
 from torchmetrics_tpu.wrappers import (  # noqa: F401

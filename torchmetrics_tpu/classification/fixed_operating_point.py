@@ -1,0 +1,405 @@
+"""Modular `*AtFixed*` quartet (reference classification/{recall_fixed_precision,
+precision_fixed_recall,sensitivity_specificity,specificity_sensitivity}.py).
+
+Each class is the corresponding PrecisionRecallCurve subclass with a constrained
+operating-point `compute` — the state (binned (T,[C,]2,2) confmat or exact-mode
+preds/target lists) is exactly the curve state, so distributed sync, forward and
+serialization all come for free from the curve base classes.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.fixed_operating_point import (
+    _FAMILIES,
+    _binary_fixed_compute,
+    _min_constraint_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_roc_compute,
+    _multidim_fixed_compute,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import Thresholds
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+
+class _BinaryFixedBase(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    _family: str
+    _min_arg_name: str
+
+    def __init__(
+        self,
+        min_constraint: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        if validate_args:
+            _min_constraint_validation(self._min_arg_name, min_constraint)
+        self.min_constraint = min_constraint
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        return _binary_fixed_compute(self._curve_state(), self.thresholds, self.min_constraint, self._family)
+
+
+class _MulticlassFixedBase(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Class"
+    _family: str
+    _min_arg_name: str
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_constraint: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            thresholds=thresholds,
+            ignore_index=ignore_index,
+            validate_args=validate_args,
+            **kwargs,
+        )
+        if validate_args:
+            _min_constraint_validation(self._min_arg_name, min_constraint)
+        self.min_constraint = min_constraint
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        state = self._curve_state()
+        curves = None
+        if self.thresholds is None:
+            if _FAMILIES[self._family]["pr_curve"]:
+                curves = _multiclass_precision_recall_curve_compute(state, self.num_classes, None)
+            else:
+                curves = _multiclass_roc_compute(state, self.num_classes, None)
+        return _multidim_fixed_compute(
+            state, self.num_classes, self.thresholds, self.min_constraint, self._family, curves
+        )
+
+
+class _MultilabelFixedBase(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name = "Label"
+    _family: str
+    _min_arg_name: str
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_constraint: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels,
+            thresholds=thresholds,
+            ignore_index=ignore_index,
+            validate_args=validate_args,
+            **kwargs,
+        )
+        if validate_args:
+            _min_constraint_validation(self._min_arg_name, min_constraint)
+        self.min_constraint = min_constraint
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        state = self._curve_state()
+        curves = None
+        if self.thresholds is None:
+            if _FAMILIES[self._family]["pr_curve"]:
+                curves = _multilabel_precision_recall_curve_compute(
+                    state, self.num_labels, None, self.ignore_index, self._valid_state()
+                )
+            else:
+                curves = _multilabel_roc_compute(state, self.num_labels, None, self._valid_state())
+        return _multidim_fixed_compute(
+            state, self.num_labels, self.thresholds, self.min_constraint, self._family, curves
+        )
+
+
+class BinaryRecallAtFixedPrecision(_BinaryFixedBase):
+    """Highest recall with precision >= ``min_precision`` (reference
+    classification/recall_fixed_precision.py:47).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryRecallAtFixedPrecision
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5, thresholds=5)
+        >>> metric.update(jnp.asarray([0, 0.5, 0.7, 0.8]), jnp.asarray([0, 1, 1, 0]))
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(0.5, dtype=float32))
+    """
+
+    _family = "recall_at_precision"
+    _min_arg_name = "min_precision"
+
+    def __init__(self, min_precision: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(min_precision, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassRecallAtFixedPrecision(_MulticlassFixedBase):
+    """Per-class recall@precision (reference classification/recall_fixed_precision.py:178)."""
+
+    _family = "recall_at_precision"
+    _min_arg_name = "min_precision"
+
+    def __init__(self, num_classes, min_precision: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_classes, min_precision, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelRecallAtFixedPrecision(_MultilabelFixedBase):
+    """Per-label recall@precision (reference classification/recall_fixed_precision.py:325)."""
+
+    _family = "recall_at_precision"
+    _min_arg_name = "min_precision"
+
+    def __init__(self, num_labels, min_precision: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_labels, min_precision, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class BinaryPrecisionAtFixedRecall(_BinaryFixedBase):
+    """Highest precision with recall >= ``min_recall`` (reference
+    classification/precision_fixed_recall.py:48)."""
+
+    _family = "precision_at_recall"
+    _min_arg_name = "min_recall"
+
+    def __init__(self, min_recall: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(min_recall, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassPrecisionAtFixedRecall(_MulticlassFixedBase):
+    """Per-class precision@recall (reference classification/precision_fixed_recall.py:181)."""
+
+    _family = "precision_at_recall"
+    _min_arg_name = "min_recall"
+
+    def __init__(self, num_classes, min_recall: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_classes, min_recall, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelPrecisionAtFixedRecall(_MultilabelFixedBase):
+    """Per-label precision@recall (reference classification/precision_fixed_recall.py:326)."""
+
+    _family = "precision_at_recall"
+    _min_arg_name = "min_recall"
+
+    def __init__(self, num_labels, min_recall: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class BinarySensitivityAtSpecificity(_BinaryFixedBase):
+    """Highest sensitivity with specificity >= ``min_specificity`` (reference
+    classification/sensitivity_specificity.py:42)."""
+
+    _family = "sensitivity_at_specificity"
+    _min_arg_name = "min_specificity"
+
+    def __init__(self, min_specificity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassSensitivityAtSpecificity(_MulticlassFixedBase):
+    """Per-class sensitivity@specificity (reference classification/sensitivity_specificity.py:146)."""
+
+    _family = "sensitivity_at_specificity"
+    _min_arg_name = "min_specificity"
+
+    def __init__(self, num_classes, min_specificity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_classes, min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelSensitivityAtSpecificity(_MultilabelFixedBase):
+    """Per-label sensitivity@specificity (reference classification/sensitivity_specificity.py:240)."""
+
+    _family = "sensitivity_at_specificity"
+    _min_arg_name = "min_specificity"
+
+    def __init__(self, num_labels, min_specificity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_labels, min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class BinarySpecificityAtSensitivity(_BinaryFixedBase):
+    """Highest specificity with sensitivity >= ``min_sensitivity`` (reference
+    classification/specificity_sensitivity.py:42)."""
+
+    _family = "specificity_at_sensitivity"
+    _min_arg_name = "min_sensitivity"
+
+    def __init__(self, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassSpecificityAtSensitivity(_MulticlassFixedBase):
+    """Per-class specificity@sensitivity (reference classification/specificity_sensitivity.py:146)."""
+
+    _family = "specificity_at_sensitivity"
+    _min_arg_name = "min_sensitivity"
+
+    def __init__(self, num_classes, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelSpecificityAtSensitivity(_MultilabelFixedBase):
+    """Per-label specificity@sensitivity (reference classification/specificity_sensitivity.py:240)."""
+
+    _family = "specificity_at_sensitivity"
+    _min_arg_name = "min_sensitivity"
+
+    def __init__(self, num_labels, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args=True, **kwargs):
+        super().__init__(num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+
+
+class RecallAtFixedPrecision(_ClassificationTaskWrapper):
+    """Task dispatcher (reference classification/recall_fixed_precision.py:471)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_precision: Optional[float] = None,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryRecallAtFixedPrecision(min_precision, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassRecallAtFixedPrecision(
+                num_classes, min_precision, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelRecallAtFixedPrecision(
+                num_labels, min_precision, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")
+
+
+class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
+    """Task dispatcher (reference classification/precision_fixed_recall.py:472)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_recall: Optional[float] = None,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionAtFixedRecall(min_recall, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionAtFixedRecall(
+                num_classes, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionAtFixedRecall(
+                num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")
+
+
+class SensitivityAtSpecificity(_ClassificationTaskWrapper):
+    """Task dispatcher (reference classification/sensitivity_specificity.py:333)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_specificity: Optional[float] = None,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySensitivityAtSpecificity(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassSensitivityAtSpecificity(
+                num_classes, min_specificity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSensitivityAtSpecificity(
+                num_labels, min_specificity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")
+
+
+class SpecificityAtSensitivity(_ClassificationTaskWrapper):
+    """Task dispatcher (reference classification/specificity_sensitivity.py:333)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_sensitivity: Optional[float] = None,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassSpecificityAtSensitivity(
+                num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificityAtSensitivity(
+                num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,592 @@
+"""Fixed-operating-point metrics: the `*AtFixed*` quartet.
+
+Covers recall@fixed-precision, precision@fixed-recall, sensitivity@fixed-specificity
+and specificity@fixed-sensitivity for all three tasks (reference
+functional/classification/{recall_fixed_precision,precision_fixed_recall,
+sensitivity_specificity,specificity_sensitivity}.py — four files of per-task Python
+loops over zipped curve points).
+
+TPU-first redesign: all four are the SAME reduction — "maximize one curve quantity
+subject to another staying above a floor" — so here a single vectorized masked-argmax
+kernel (`_best_operating_point`) serves every family. In binned mode it reads the
+(T, [C,] 2, 2) confusion-matrix state directly (no intermediate curve materialization)
+and is jit-safe with classes vectorized via one `vmap`, where the reference runs a
+Python list comprehension per class. Exact mode consumes the host-side curves.
+
+Tie-breaking matches the reference observably: among qualifying points with maximal
+objective, the largest threshold wins (the reference reaches the same answer via
+lexicographic tuple-max for the PR pair and first-argmax over descending-threshold
+curves for the ROC pair). When nothing qualifies — or, for the PR pair, when the best
+objective is 0 — the returned threshold is the 1e6 sentinel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+_SENTINEL = 1e6
+
+
+def _best_operating_point(
+    objective: Array,
+    constraint: Array,
+    thresholds: Array,
+    min_constraint: float,
+    tiebreak: Optional[Array] = None,
+    zero_to_sentinel: bool = True,
+) -> Tuple[Array, Array]:
+    """max(objective) s.t. constraint >= min_constraint, as fixed-shape masked reductions.
+
+    All inputs are threshold-aligned 1-D arrays. Ties on the objective break toward a
+    larger ``tiebreak`` value (when given), then toward a larger threshold. Returns
+    scalar ``(best_objective, best_threshold)``; the threshold is the 1e6 sentinel when
+    nothing qualifies (and, with ``zero_to_sentinel``, when the best objective is 0 —
+    the PR-pair convention). Traceable: no data-dependent shapes.
+    """
+    neg = -jnp.inf
+    ok = constraint >= min_constraint
+    masked_obj = jnp.where(ok, objective, neg)
+    best = jnp.max(masked_obj)
+    sel = ok & (masked_obj == best)
+    if tiebreak is not None:
+        masked_tb = jnp.where(sel, tiebreak, neg)
+        sel = sel & (masked_tb == jnp.max(masked_tb))
+    best_thr = jnp.max(jnp.where(sel, thresholds, neg))
+    any_ok = jnp.any(ok)
+    best_val = jnp.where(any_ok, best, 0.0).astype(jnp.float32)
+    if zero_to_sentinel:
+        best_thr = jnp.where(best_val == 0.0, _SENTINEL, best_thr)
+    else:
+        best_thr = jnp.where(any_ok, best_thr, _SENTINEL)
+    return best_val, best_thr.astype(jnp.float32)
+
+
+def _binned_pr_quantities(state: Array) -> Tuple[Array, Array]:
+    """(precision, recall) per threshold from a (..., T, 2, 2) confmat, threshold-major."""
+    tps = state[..., 1, 1]
+    fps = state[..., 0, 1]
+    fns = state[..., 1, 0]
+    return _safe_divide(tps, tps + fps), _safe_divide(tps, tps + fns)
+
+
+def _binned_roc_quantities(state: Array) -> Tuple[Array, Array]:
+    """(sensitivity, specificity) per threshold from a (..., T, 2, 2) confmat."""
+    tps = state[..., 1, 1]
+    fps = state[..., 0, 1]
+    fns = state[..., 1, 0]
+    tns = state[..., 0, 0]
+    return _safe_divide(tps, tps + fns), _safe_divide(tns, tns + fps)
+
+
+# Per family: which curve pair it reads, which quantity it maximizes, whether ties on
+# the constraint break before the threshold tie, and whether a 0 objective maps to the
+# sentinel threshold (the PR-pair convention) vs only an empty qualifying set (ROC pair).
+_FAMILIES = {
+    "recall_at_precision": dict(pr_curve=True, tiebreak=True, zero_sentinel=True),
+    "precision_at_recall": dict(pr_curve=True, tiebreak=True, zero_sentinel=True),
+    "sensitivity_at_specificity": dict(pr_curve=False, tiebreak=False, zero_sentinel=False),
+    "specificity_at_sensitivity": dict(pr_curve=False, tiebreak=False, zero_sentinel=False),
+}
+
+
+def _objective_constraint(family: str, precision_or_sens: Array, recall_or_spec: Array) -> Tuple[Array, Array]:
+    """Map the family's curve pair onto (objective, constraint).
+
+    Inputs are (precision, recall) for the PR pair and (sensitivity, specificity)
+    for the ROC pair, threshold-aligned.
+    """
+    if family == "recall_at_precision":
+        return recall_or_spec, precision_or_sens  # maximize recall s.t. precision floor
+    if family == "precision_at_recall":
+        return precision_or_sens, recall_or_spec
+    if family == "sensitivity_at_specificity":
+        return precision_or_sens, recall_or_spec  # maximize sensitivity s.t. specificity floor
+    if family == "specificity_at_sensitivity":
+        return recall_or_spec, precision_or_sens
+    raise ValueError(f"Unknown family {family}")
+
+
+def _reduce_binned(state: Array, thresholds: Array, min_constraint: float, family: str) -> Tuple[Array, Array]:
+    """Binned-mode reduction straight off the (T, 2, 2) or (T, C, 2, 2) state."""
+    cfg = _FAMILIES[family]
+    quantities = _binned_pr_quantities if cfg["pr_curve"] else _binned_roc_quantities
+    first, second = quantities(state)  # threshold-major: (T,) or (T, C)
+    objective, constraint = _objective_constraint(family, first, second)
+    tiebreak = constraint if cfg["tiebreak"] else None
+
+    def reduce_one(obj, con, tie=None):
+        return _best_operating_point(
+            obj, con, thresholds, min_constraint, tie, zero_to_sentinel=cfg["zero_sentinel"]
+        )
+
+    if state.ndim == 3:  # binary (T, 2, 2)
+        return reduce_one(objective, constraint, tiebreak)
+    # (T, C, 2, 2): vectorize the reduction over the class axis
+    if tiebreak is not None:
+        return jax.vmap(reduce_one, in_axes=(1, 1, 1))(objective, constraint, tiebreak)
+    return jax.vmap(reduce_one, in_axes=(1, 1))(objective, constraint)
+
+
+def _reduce_curve(
+    curve_a: Array, curve_b: Array, thresholds: Array, min_constraint: float, family: str
+) -> Tuple[Array, Array]:
+    """Exact-mode reduction over one class's computed curve (host-side, ragged ok).
+
+    ``curve_a``/``curve_b`` are the curve-compute outputs in their natural order:
+    (precision, recall) for the PR pair, (fpr, tpr) for the ROC pair. Lengths may
+    exceed ``thresholds`` by the synthetic endpoint the PR curve appends; candidates
+    are trimmed to the threshold-aligned prefix, exactly as the reference zips them.
+    """
+    cfg = _FAMILIES[family]
+    n = min(curve_a.shape[0], curve_b.shape[0], thresholds.shape[0])
+    if cfg["pr_curve"]:
+        first, second = curve_a[:n], curve_b[:n]  # precision, recall
+    else:
+        first, second = curve_b[:n], 1.0 - curve_a[:n]  # sensitivity=tpr, specificity=1-fpr
+        # the exact ROC's synthetic (0,0) start point sits above the probability range;
+        # report it as threshold 1.0 (preds are probabilities, so only it can exceed 1)
+        thresholds = jnp.minimum(thresholds, 1.0)
+    objective, constraint = _objective_constraint(family, first, second)
+    tiebreak = constraint if cfg["tiebreak"] else None
+    return _best_operating_point(
+        objective, constraint, thresholds[:n], min_constraint, tiebreak, zero_to_sentinel=cfg["zero_sentinel"]
+    )
+
+
+def _min_constraint_validation(name: str, value: float) -> None:
+    if not isinstance(value, float) or not (0 <= value <= 1):
+        # deliberate fix of the reference's dead `and` check (recall_fixed_precision.py:85)
+        raise ValueError(f"Expected argument `{name}` to be a float in the [0,1] range, but got {value}")
+
+
+def _binary_fixed_compute(
+    state, thresholds: Optional[Array], min_constraint: float, family: str
+) -> Tuple[Array, Array]:
+    if thresholds is not None and not isinstance(state, tuple):
+        return _reduce_binned(state, thresholds, min_constraint, family)
+    if _FAMILIES[family]["pr_curve"]:
+        p, r, t = _binary_precision_recall_curve_compute(state, None)
+        return _reduce_curve(p, r, t, min_constraint, family)
+    fpr, tpr, t = _binary_roc_compute(state, None)
+    return _reduce_curve(fpr, tpr, t, min_constraint, family)
+
+
+def _multidim_fixed_compute(
+    state, num_classes: int, thresholds: Optional[Array], min_constraint: float, family: str, curves
+) -> Tuple[Array, Array]:
+    if thresholds is not None and not isinstance(state, tuple):
+        return _reduce_binned(state, thresholds, min_constraint, family)
+    a_list, b_list, t_list = curves
+    res = [
+        _reduce_curve(a, b, t, min_constraint, family)
+        for a, b, t in zip(a_list, b_list, t_list)
+    ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+# --------------------------------------------------------------------- binary
+
+
+def _binary_fixed_functional(preds, target, min_constraint, thresholds, ignore_index, validate_args, name, family):
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _min_constraint_validation(name, min_constraint)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    return _binary_fixed_compute(state, thresholds, min_constraint, family)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest recall whose precision stays >= ``min_precision`` (reference
+    functional/classification/recall_fixed_precision.py:102).
+
+    Returns scalar ``(recall, threshold)``; threshold is 1e6 when unattainable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_recall_at_fixed_precision
+        >>> preds = jnp.asarray([0, 0.5, 0.7, 0.8])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> binary_recall_at_fixed_precision(preds, target, min_precision=0.5, thresholds=5)
+        (Array(1., dtype=float32), Array(0.5, dtype=float32))
+    """
+    return _binary_fixed_functional(
+        preds, target, min_precision, thresholds, ignore_index, validate_args,
+        "min_precision", "recall_at_precision",
+    )
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision whose recall stays >= ``min_recall`` (reference
+    functional/classification/precision_fixed_recall.py:63)."""
+    return _binary_fixed_functional(
+        preds, target, min_recall, thresholds, ignore_index, validate_args,
+        "min_recall", "precision_at_recall",
+    )
+
+
+def binary_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    min_specificity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity (TPR) whose specificity stays >= ``min_specificity``
+    (reference functional/classification/sensitivity_specificity.py:96)."""
+    return _binary_fixed_functional(
+        preds, target, min_specificity, thresholds, ignore_index, validate_args,
+        "min_specificity", "sensitivity_at_specificity",
+    )
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity (TNR) whose sensitivity stays >= ``min_sensitivity``
+    (reference functional/classification/specificity_sensitivity.py:96)."""
+    return _binary_fixed_functional(
+        preds, target, min_sensitivity, thresholds, ignore_index, validate_args,
+        "min_sensitivity", "specificity_at_sensitivity",
+    )
+
+
+# ----------------------------------------------------------------- multiclass
+
+
+def _multiclass_fixed_functional(
+    preds, target, num_classes, min_constraint, thresholds, ignore_index, validate_args, name, family
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _min_constraint_validation(name, min_constraint)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    if state is None:
+        keep = np.asarray(valid)
+        state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
+    curves = None
+    if thresholds is None:
+        if _FAMILIES[family]["pr_curve"]:
+            p, r, t = _multiclass_precision_recall_curve_compute(state, num_classes, None)
+            curves = (p, r, t)
+        else:
+            fpr, tpr, t = _multiclass_roc_compute(state, num_classes, None)
+            curves = (fpr, tpr, t)
+    return _multidim_fixed_compute(state, num_classes, thresholds, min_constraint, family, curves)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest recall with precision >= ``min_precision`` (reference
+    functional/classification/recall_fixed_precision.py:206). Returns ``(C,)`` pairs."""
+    return _multiclass_fixed_functional(
+        preds, target, num_classes, min_precision, thresholds, ignore_index, validate_args,
+        "min_precision", "recall_at_precision",
+    )
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest precision with recall >= ``min_recall`` (reference
+    functional/classification/precision_fixed_recall.py:138)."""
+    return _multiclass_fixed_functional(
+        preds, target, num_classes, min_recall, thresholds, ignore_index, validate_args,
+        "min_recall", "precision_at_recall",
+    )
+
+
+def multiclass_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_specificity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest sensitivity with specificity >= ``min_specificity`` (reference
+    functional/classification/sensitivity_specificity.py:199)."""
+    return _multiclass_fixed_functional(
+        preds, target, num_classes, min_specificity, thresholds, ignore_index, validate_args,
+        "min_specificity", "sensitivity_at_specificity",
+    )
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest specificity with sensitivity >= ``min_sensitivity`` (reference
+    functional/classification/specificity_sensitivity.py:199)."""
+    return _multiclass_fixed_functional(
+        preds, target, num_classes, min_sensitivity, thresholds, ignore_index, validate_args,
+        "min_sensitivity", "specificity_at_sensitivity",
+    )
+
+
+# ----------------------------------------------------------------- multilabel
+
+
+def _multilabel_fixed_functional(
+    preds, target, num_labels, min_constraint, thresholds, ignore_index, validate_args, name, family
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _min_constraint_validation(name, min_constraint)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    curves = None
+    if state is None:
+        if _FAMILIES[family]["pr_curve"]:
+            curves = _multilabel_precision_recall_curve_compute((preds, target), num_labels, None, ignore_index, valid)
+        else:
+            curves = _multilabel_roc_compute((preds, target), num_labels, None, valid)
+        state = (preds, target)
+    return _multidim_fixed_compute(state, num_labels, thresholds, min_constraint, family, curves)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest recall with precision >= ``min_precision`` (reference
+    functional/classification/recall_fixed_precision.py:306)."""
+    return _multilabel_fixed_functional(
+        preds, target, num_labels, min_precision, thresholds, ignore_index, validate_args,
+        "min_precision", "recall_at_precision",
+    )
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest precision with recall >= ``min_recall`` (reference
+    functional/classification/precision_fixed_recall.py:224)."""
+    return _multilabel_fixed_functional(
+        preds, target, num_labels, min_recall, thresholds, ignore_index, validate_args,
+        "min_recall", "precision_at_recall",
+    )
+
+
+def multilabel_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_specificity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest sensitivity with specificity >= ``min_specificity`` (reference
+    functional/classification/sensitivity_specificity.py:305)."""
+    return _multilabel_fixed_functional(
+        preds, target, num_labels, min_specificity, thresholds, ignore_index, validate_args,
+        "min_specificity", "sensitivity_at_specificity",
+    )
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest specificity with sensitivity >= ``min_sensitivity`` (reference
+    functional/classification/specificity_sensitivity.py:305)."""
+    return _multilabel_fixed_functional(
+        preds, target, num_labels, min_sensitivity, thresholds, ignore_index, validate_args,
+        "min_sensitivity", "specificity_at_sensitivity",
+    )
+
+
+# ---------------------------------------------------------------- dispatchers
+
+
+def _fixed_dispatch(binary_fn, multiclass_fn, multilabel_fn):
+    def dispatcher(
+        preds: Array,
+        target: Array,
+        task: str,
+        min_value: float,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ):
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return binary_fn(preds, target, min_value, thresholds, ignore_index, validate_args)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return multiclass_fn(preds, target, num_classes, min_value, thresholds, ignore_index, validate_args)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return multilabel_fn(preds, target, num_labels, min_value, thresholds, ignore_index, validate_args)
+        raise ValueError(f"Not handled value: {task}")
+
+    return dispatcher
+
+
+def recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference functional/classification/recall_fixed_precision.py:401)."""
+    return _fixed_dispatch(
+        binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision, multilabel_recall_at_fixed_precision
+    )(preds, target, task, min_precision, thresholds, num_classes, num_labels, ignore_index, validate_args)
+
+
+def precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_recall: float,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference functional/classification/precision_fixed_recall.py:309)."""
+    return _fixed_dispatch(
+        binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall, multilabel_precision_at_fixed_recall
+    )(preds, target, task, min_recall, thresholds, num_classes, num_labels, ignore_index, validate_args)
+
+
+def sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_specificity: float,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference functional/classification/sensitivity_specificity.py:406)."""
+    return _fixed_dispatch(
+        binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity, multilabel_sensitivity_at_specificity
+    )(preds, target, task, min_specificity, thresholds, num_classes, num_labels, ignore_index, validate_args)
+
+
+def specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference functional/classification/specificity_sensitivity.py:443)."""
+    return _fixed_dispatch(
+        binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity, multilabel_specificity_at_sensitivity
+    )(preds, target, task, min_sensitivity, thresholds, num_classes, num_labels, ignore_index, validate_args)

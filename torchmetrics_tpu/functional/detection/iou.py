@@ -64,14 +64,6 @@ def box_iou(boxes1: Array, boxes2: Array) -> Array:
     return inter / (union + _EPS)
 
 
-def box_ioa(boxes1: Array, boxes2: Array) -> Array:
-    """Intersection over the *first* box's area — COCO's detection-vs-crowd overlap."""
-    boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
-    boxes2 = jnp.asarray(boxes2, dtype=jnp.float32).reshape(-1, 4)
-    inter, _ = _inter_union(boxes1, boxes2)
-    return inter / (box_area(boxes1)[:, None] + _EPS)
-
-
 def generalized_box_iou(boxes1: Array, boxes2: Array) -> Array:
     """GIoU: IoU - (hull \\ union) / hull."""
     boxes1 = jnp.asarray(boxes1, dtype=jnp.float32).reshape(-1, 4)
