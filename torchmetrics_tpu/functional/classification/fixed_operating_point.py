@@ -100,12 +100,16 @@ def _binned_pr_quantities(state: Array) -> Tuple[Array, Array]:
 
 
 def _binned_roc_quantities(state: Array) -> Tuple[Array, Array]:
-    """(sensitivity, specificity) per threshold from a (..., T, 2, 2) confmat."""
+    """(sensitivity, specificity) per threshold from a (..., T, 2, 2) confmat.
+
+    Specificity is 1 - fpr (not tns/(tns+fps) directly): with zero negative samples
+    the safe-division convention must yield specificity 1, matching the ROC path.
+    """
     tps = state[..., 1, 1]
     fps = state[..., 0, 1]
     fns = state[..., 1, 0]
     tns = state[..., 0, 0]
-    return _safe_divide(tps, tps + fns), _safe_divide(tns, tns + fps)
+    return _safe_divide(tps, tps + fns), 1.0 - _safe_divide(fps, fps + tns)
 
 
 # Per family: which curve pair it reads, which quantity it maximizes, whether ties on

@@ -29,7 +29,7 @@ import copy
 import functools
 import inspect
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -451,23 +451,37 @@ class Metric:
         finally:
             object.__setattr__(self, "_state", saved)
 
-    def functional_forward(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> tuple:
-        """Pure forward: ``(state, batch) -> (state', batch_value)``."""
+    def functional_forward(
+        self, state: Dict[str, Any], *args: Any, update_count: Optional[int] = None, **kwargs: Any
+    ) -> tuple:
+        """Pure forward: ``(state, batch) -> (state', batch_value)``.
+
+        For metrics holding ``"mean"``-reduced states, pass ``update_count`` (the
+        number of updates already merged into ``state``) so the running mean is
+        count-weighted like the stateful path (reference metric.py:399-431);
+        without it both sides weigh equally.
+        """
         batch_state = self.functional_update(self.init_state(), *args, **kwargs)
         batch_value = self.functional_compute(batch_state)
-        return self.merge_states(state, batch_state), batch_value
+        counts = (update_count, 1) if update_count is not None else None
+        return self.merge_states(state, batch_state, counts=counts), batch_value
 
     def functional_sync(self, state: Dict[str, Any], axis_name: Optional[Union[str, Sequence[str]]] = None) -> Dict[str, Any]:
         """Pure in-trace sync: apply the declared collectives over ``axis_name``."""
         return sync_states(state, self._reductions, axis_name or self.sync_axis)
 
-    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    def merge_states(
+        self, a: Dict[str, Any], b: Dict[str, Any], counts: Optional[Tuple[int, int]] = None
+    ) -> Dict[str, Any]:
         """Merge two state pytrees per declared reductions (generalised Chan merge).
 
-        Count-weighted "mean" is impossible without counts, so subclasses holding
-        mean states carry explicit weight states (as the reference's MeanMetric
-        does); plain "mean" merges as the unweighted average.
+        ``counts`` gives the number of updates each side accumulated; with it,
+        "mean" states merge count-weighted (the reference's running-mean formula,
+        metric.py:399-431). Without counts, "mean" assumes both sides saw the same
+        number of updates — subclasses needing exact merging under unequal counts
+        carry explicit weight states (as the reference's MeanMetric does).
         """
+        na, nb = counts if counts is not None else (1, 1)
         out: Dict[str, Any] = {}
         for attr in self._defaults:
             fx = self._reductions[attr]
@@ -475,7 +489,7 @@ class Metric:
             if fx == "sum":
                 out[attr] = va + vb
             elif fx == "mean":
-                out[attr] = (va + vb) / 2
+                out[attr] = (na * va + nb * vb) / (na + nb)
             elif fx == "max":
                 out[attr] = jnp.maximum(va, vb)
             elif fx == "min":

@@ -1,5 +1,9 @@
 from torchmetrics_tpu.utils import checks, compute, data, enums, exceptions, prints  # noqa: F401
-from torchmetrics_tpu.utils.checks import _check_same_shape  # noqa: F401
+from torchmetrics_tpu.utils.checks import (  # noqa: F401
+    _check_classification_inputs,
+    _check_same_shape,
+    check_forward_full_state_property,
+)
 from torchmetrics_tpu.utils.compute import _safe_divide, auc, interp  # noqa: F401
 from torchmetrics_tpu.utils.data import (  # noqa: F401
     dim_zero_cat,

@@ -69,6 +69,245 @@ def _check_data_type(preds: Array, target: Array) -> DataType:
     raise ValueError("Could not infer the data type from `preds` and `target` shapes.")
 
 
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Classify the (preds, target) shape/type combination and the implied class count
+    (reference checks.py:74-128)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    preds_float = np.issubdtype(preds.dtype, np.floating)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape, got"
+                f" `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds_float and target.size and target.max() > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(preds[0].size) if preds.size else 0
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = int(preds.shape[1]) if preds.size else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    """num_classes consistency for binary data (reference checks.py:131-146)."""
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None`(default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(
+    preds: Array, target: Array, num_classes: int, multiclass: Optional[bool], implied_classes: int
+) -> None:
+    """num_classes consistency for (multi-dim) multi-class data (reference checks.py:149-176)."""
+    target = np.asarray(target)
+    preds = np.asarray(preds)
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes"
+                " (from shape of inputs) does not match `num_classes`."
+            )
+        if target.size and num_classes <= target.max():
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if preds.shape != target.shape and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    """num_classes consistency for multi-label data (reference checks.py:179-189)."""
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(
+    top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool
+) -> None:
+    """top_k consistency (reference checks.py:192-207)."""
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    top_k: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full input-consistency check for classification (reference checks.py:210-300).
+
+    Validates shapes/dtypes, the implied class count against ``num_classes`` and the
+    ``top_k`` setting; returns the detected input case. Host-side only — a traced
+    input skips validation (the metric's ``validate_args=False`` fast path).
+    """
+    if not (_is_concrete(preds) and _is_concrete(target)):
+        return DataType.BINARY  # cannot inspect traced values; callers skip validation under jit
+    _basic_input_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if preds_np.shape != target_np.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if target_np.size and target_np.max() >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds_np, target_np, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, np.issubdtype(preds_np.dtype, np.floating))
+
+    return case
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    """Recursive allclose over arrays / sequences / mappings (reference checks.py:621-633)."""
+    if hasattr(res1, "shape") and hasattr(res1, "dtype"):
+        return bool(np.allclose(np.asarray(res1), np.asarray(res2), atol=atol))
+    if isinstance(res1, str):
+        return res1 == res2
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    return res1 == res2
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare=(10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Empirically verify a metric is safe with ``full_state_update=False`` and time both
+    forward strategies (reference checks.py:636-738).
+
+    Runs the metric with both flag settings on identical inputs; if every batch value
+    and the final compute agree, the partial-state (1-update) path is safe, and both
+    are benchmarked to print a recommendation.
+    """
+    from time import perf_counter
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    equal = True
+    try:
+        for _ in range(num_update_to_compare[0]):
+            equal = equal and _allclose_recursive(fullstate(**input_args), partstate(**input_args))
+        res1 = fullstate.compute()
+        res2 = partstate.compute()
+        equal = equal and _allclose_recursive(res1, res2)
+    except (RuntimeError, TypeError):  # partial path needed the full state
+        equal = False
+
+    if not equal:
+        print("Recommended setting `full_state_update=True`")
+        return
+
+    timings = np.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate([fullstate, partstate]):
+        for j, steps in enumerate(num_update_to_compare):
+            for r in range(reps):
+                start = perf_counter()
+                for _ in range(steps):
+                    metric(**input_args)
+                timings[i, j, r] = perf_counter() - start
+                metric.reset()
+
+    mean = timings.mean(-1)
+    std = timings.std(-1)
+    for j, steps in enumerate(num_update_to_compare):
+        print(f"Full state for {steps} steps took: {mean[0, j]:0.3f}+-{std[0, j]:0.3f}")
+        print(f"Partial state for {steps} steps took: {mean[1, j]:0.3f}+-{std[1, j]:0.3f}")
+    faster = bool(mean[1, -1] < mean[0, -1])
+    print(f"Recommended setting `full_state_update={not faster}`")
+
+
 def _check_retrieval_inputs(
     indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False, ignore: Optional[int] = None
 ) -> Tuple[Array, Array, Array]:
