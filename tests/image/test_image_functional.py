@@ -138,3 +138,18 @@ class TestOthers:
         ours = float(FI.peak_signal_noise_ratio_with_blocked_effect(_j(p), _j(t)))
         ref = float(ref_psnrb(_t(p), _t(t)))
         assert abs(ours - ref) < 1e-4
+
+
+class TestImageGradients:
+    def test_vs_reference(self):
+        from torchmetrics.functional.image import image_gradients as ref_grads
+
+        img = rng.rand(2, 3, 7, 9).astype(np.float32)
+        dy, dx = FI.image_gradients(_j(img))
+        rdy, rdx = ref_grads(_t(img))
+        assert np.allclose(np.asarray(dy), rdy.numpy(), atol=1e-6)
+        assert np.allclose(np.asarray(dx), rdx.numpy(), atol=1e-6)
+
+    def test_validation(self):
+        with pytest.raises(RuntimeError, match="4D"):
+            FI.image_gradients(jnp.zeros((3, 4, 5)))

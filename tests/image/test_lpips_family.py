@@ -1,0 +1,309 @@
+"""LPIPS / MiFID / PerceptualPathLength parity tests.
+
+Oracles: the reference's importable score-math helpers plus hand-built torch
+replicas of the torchvision backbones (torchvision itself is not installed, so
+pretrained weights are out of reach — weights are synthesized and shared
+bit-exactly between the torch replica and the flax port, which tests the part
+we own: conv/pool semantics, normalization, lin heads, reductions).
+"""
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+torchmetrics_ref = load_reference_torchmetrics()
+import torch  # noqa: E402
+
+from torchmetrics_tpu.functional.image.lpips import (  # noqa: E402
+    _lpips_score,
+    learned_perceptual_image_patch_similarity,
+)
+from torchmetrics_tpu.image.lpips import LearnedPerceptualImagePatchSimilarity  # noqa: E402
+from torchmetrics_tpu.models.lpips import (  # noqa: E402
+    LPIPS_CHANNELS,
+    init_lpips_params,
+    lpips_network,
+    params_from_torch_state_dict,
+)
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------- torch replica
+# torchvision alexnet().features architecture (conv indices 0,3,6,8,10), as
+# sliced by the reference (functional/image/lpips.py:105-152).
+_ALEX_SPEC = [
+    # (state_dict slice, torch features idx, out_ch, in_ch, kernel, stride, pad)
+    ("slice1", 0, 64, 3, 11, 4, 2),
+    ("slice2", 3, 192, 64, 5, 1, 2),
+    ("slice3", 6, 384, 192, 3, 1, 1),
+    ("slice4", 8, 256, 384, 3, 1, 1),
+    ("slice5", 10, 256, 256, 3, 1, 1),
+]
+
+
+def _make_alex_state_dict(seed=0):
+    r = np.random.RandomState(seed)
+    sd = {}
+    for slc, idx, out_c, in_c, k, _, _ in _ALEX_SPEC:
+        sd[f"net.{slc}.{idx}.weight"] = (r.randn(out_c, in_c, k, k) * 0.05).astype(np.float32)
+        sd[f"net.{slc}.{idx}.bias"] = (r.randn(out_c) * 0.05).astype(np.float32)
+    for i, c in enumerate(LPIPS_CHANNELS["alex"]):
+        sd[f"lin{i}.model.1.weight"] = np.abs(r.randn(1, c, 1, 1)).astype(np.float32)
+    return sd
+
+
+def _torch_alex_lpips(img1, img2, sd):
+    """Reference _LPIPS.forward math (lpips.py:338-369) on a torch alex replica."""
+    from torchmetrics.functional.image.lpips import _normalize_tensor, _spatial_average, ScalingLayer
+
+    convs = []
+    for slc, idx, out_c, in_c, k, stride, pad in _ALEX_SPEC:
+        conv = torch.nn.Conv2d(in_c, out_c, k, stride=stride, padding=pad)
+        conv.weight.data = torch.from_numpy(sd[f"net.{slc}.{idx}.weight"])
+        conv.bias.data = torch.from_numpy(sd[f"net.{slc}.{idx}.bias"])
+        convs.append(conv)
+    pool = torch.nn.MaxPool2d(3, 2)
+
+    def features(x):
+        feats = []
+        x = torch.relu(convs[0](x))
+        feats.append(x)
+        x = torch.relu(convs[1](pool(x)))
+        feats.append(x)
+        x = torch.relu(convs[2](pool(x)))
+        feats.append(x)
+        x = torch.relu(convs[3](x))
+        feats.append(x)
+        x = torch.relu(convs[4](x))
+        feats.append(x)
+        return feats
+
+    scaling = ScalingLayer()
+    with torch.no_grad():
+        in0, in1 = scaling(img1), scaling(img2)
+        outs0, outs1 = features(in0), features(in1)
+        res = []
+        for kk, (f0, f1) in enumerate(zip(outs0, outs1)):
+            d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
+            w = torch.from_numpy(sd[f"lin{kk}.model.1.weight"])
+            res.append(_spatial_average((d * w.reshape(1, -1, 1, 1)).sum(1, keepdim=True), keep_dim=True))
+        return sum(res).reshape(-1)
+
+
+class TestLPIPSScoreMath:
+    def test_alex_full_pipeline_vs_torch_replica(self):
+        sd = _make_alex_state_dict()
+        img1 = (rng.rand(4, 3, 64, 64).astype(np.float32) * 2) - 1
+        img2 = (rng.rand(4, 3, 64, 64).astype(np.float32) * 2) - 1
+
+        ref = _torch_alex_lpips(torch.from_numpy(img1), torch.from_numpy(img2), sd).numpy()
+
+        params = params_from_torch_state_dict(sd, net_type="alex")
+        net = lpips_network("alex", params)
+        ours = np.asarray(net(jnp.asarray(img1), jnp.asarray(img2)))
+
+        np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_backbone_channels(self, net_type):
+        params = init_lpips_params(net_type, jax.random.PRNGKey(1))
+        net_chans = LPIPS_CHANNELS[net_type]
+        assert len(params["lins"]) == len(net_chans)
+        for w, c in zip(params["lins"], net_chans):
+            assert w.shape == (c,)
+        # feature maps carry the documented channel counts (reference chns,
+        # lpips.py:296-306)
+        from torchmetrics_tpu.models.lpips import _BACKBONES
+
+        module = _BACKBONES[net_type]()
+        feats = module.apply({"params": params["backbone"]}, jnp.zeros((1, 64, 64, 3)))
+        assert [f.shape[-1] for f in feats] == list(net_chans)
+
+    def test_normalize_flag(self):
+        params = init_lpips_params("alex", jax.random.PRNGKey(2))
+        net = lpips_network("alex", params)
+        img1 = rng.rand(2, 3, 64, 64).astype(np.float32)
+        img2 = rng.rand(2, 3, 64, 64).astype(np.float32)
+        a = learned_perceptual_image_patch_similarity(
+            jnp.asarray(img1), jnp.asarray(img2), net=net, normalize=True
+        )
+        b = learned_perceptual_image_patch_similarity(
+            jnp.asarray(2 * img1 - 1), jnp.asarray(2 * img2 - 1), net=net, normalize=False
+        )
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_invalid_inputs(self):
+        net = lambda a, b: jnp.zeros(a.shape[0])  # noqa: E731
+        with pytest.raises(ValueError, match="normalized tensors"):
+            learned_perceptual_image_patch_similarity(
+                jnp.zeros((2, 1, 8, 8)), jnp.zeros((2, 1, 8, 8)), net=net
+            )
+        with pytest.raises(ValueError, match="normalized tensors"):
+            learned_perceptual_image_patch_similarity(
+                jnp.full((2, 3, 8, 8), 2.0), jnp.zeros((2, 3, 8, 8)), net=net, normalize=True
+            )
+
+
+class TestLPIPSMetric:
+    def test_accumulation_matches_functional(self):
+        params = init_lpips_params("squeeze", jax.random.PRNGKey(3))
+        net = lpips_network("squeeze", params)
+        batches1 = [(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1) for _ in range(3)]
+        batches2 = [(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1) for _ in range(3)]
+        m = LearnedPerceptualImagePatchSimilarity(net=net)
+        for b1, b2 in zip(batches1, batches2):
+            m.update(jnp.asarray(b1), jnp.asarray(b2))
+        expected = learned_perceptual_image_patch_similarity(
+            jnp.asarray(np.concatenate(batches1)), jnp.asarray(np.concatenate(batches2)), net=net
+        )
+        np.testing.assert_allclose(float(m.compute()), float(expected), rtol=1e-5)
+
+    def test_reduction_sum(self):
+        params = init_lpips_params("alex", jax.random.PRNGKey(4))
+        net = lpips_network("alex", params)
+        img1 = rng.rand(3, 3, 64, 64).astype(np.float32) * 2 - 1
+        img2 = rng.rand(3, 3, 64, 64).astype(np.float32) * 2 - 1
+        msum = LearnedPerceptualImagePatchSimilarity(net=net, reduction="sum")
+        mmean = LearnedPerceptualImagePatchSimilarity(net=net, reduction="mean")
+        msum.update(jnp.asarray(img1), jnp.asarray(img2))
+        mmean.update(jnp.asarray(img1), jnp.asarray(img2))
+        np.testing.assert_allclose(float(msum.compute()), 3 * float(mmean.compute()), rtol=1e-5)
+
+    def test_arg_validation(self):
+        with pytest.raises(ValueError, match="net_type"):
+            LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+        with pytest.raises(ValueError, match="reduction"):
+            LearnedPerceptualImagePatchSimilarity(net=lambda a, b: None, reduction="median")
+        with pytest.raises(ValueError, match="normalize"):
+            LearnedPerceptualImagePatchSimilarity(net=lambda a, b: None, normalize=1)
+
+
+class TestMiFID:
+    @staticmethod
+    def _proj(seed=11, feat=8):
+        r = np.random.RandomState(seed)
+        return (r.randn(3 * 16 * 16, feat) * 0.1).astype(np.float32)
+
+    def test_vs_reference(self):
+        from torchmetrics.image.mifid import MemorizationInformedFrechetInceptionDistance as RefMiFID
+
+        proj = self._proj()
+
+        class TorchExtractor(torch.nn.Module):
+            def forward(self, x):
+                return x.reshape(x.shape[0], -1).float() @ torch.from_numpy(proj)
+
+        def jax_extractor(x):
+            return x.reshape(x.shape[0], -1).astype(jnp.float32) @ jnp.asarray(proj)
+
+        from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
+
+        ours = MemorizationInformedFrechetInceptionDistance(feature_extractor=jax_extractor)
+        ref = RefMiFID(feature=TorchExtractor())
+
+        real = rng.rand(24, 3, 16, 16).astype(np.float32)
+        fake = rng.rand(24, 3, 16, 16).astype(np.float32) * 0.8 + 0.1
+        for i in range(0, 24, 8):
+            ours.update(jnp.asarray(real[i : i + 8]), real=True)
+            ours.update(jnp.asarray(fake[i : i + 8]), real=False)
+            ref.update(torch.from_numpy(real[i : i + 8]), real=True)
+            ref.update(torch.from_numpy(fake[i : i + 8]), real=False)
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), rtol=5e-3)
+
+    def test_reset_real_features(self):
+        from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
+
+        def jax_extractor(x):
+            return x.reshape(x.shape[0], -1).astype(jnp.float32) @ jnp.asarray(self._proj())
+
+        m = MemorizationInformedFrechetInceptionDistance(
+            feature_extractor=jax_extractor, reset_real_features=False
+        )
+        m.update(jnp.asarray(rng.rand(8, 3, 16, 16).astype(np.float32)), real=True)
+        m.update(jnp.asarray(rng.rand(8, 3, 16, 16).astype(np.float32)), real=False)
+        m.reset()
+        assert len(m.real_features) == 1
+        assert len(m.fake_features) == 0
+
+
+class TestPerceptualPathLength:
+    def test_interpolate_vs_reference(self):
+        from torchmetrics.functional.image.perceptual_path_length import _interpolate as ref_interp
+
+        from torchmetrics_tpu.functional.image.perceptual_path_length import _interpolate
+
+        l1 = rng.randn(16, 8).astype(np.float32)
+        l2 = rng.randn(16, 8).astype(np.float32)
+        for method in ("lerp", "slerp_any", "slerp_unit"):
+            ref = ref_interp(torch.from_numpy(l1), torch.from_numpy(l2), 1e-2, method).numpy()
+            ours = np.asarray(_interpolate(jnp.asarray(l1), jnp.asarray(l2), 1e-2, method))
+            np.testing.assert_allclose(ours, ref, atol=1e-5, err_msg=method)
+
+    def test_ppl_vs_numpy_oracle(self):
+        from torchmetrics_tpu.functional.image.perceptual_path_length import perceptual_path_length
+
+        z_size, n = 8, 40
+        r = np.random.RandomState(3)
+        w = r.randn(z_size, 3 * 8 * 8).astype(np.float32) * 0.3
+        fixed_latents = [r.randn(n, z_size).astype(np.float32) for _ in range(2)]
+
+        class Gen:
+            def __init__(self):
+                self._calls = 0
+
+            def sample(self, key, num):
+                out = fixed_latents[self._calls % 2]
+                self._calls += 1
+                return jnp.asarray(out[:num])
+
+            def __call__(self, z):
+                img = jax.nn.sigmoid(z @ jnp.asarray(w)).reshape(-1, 3, 8, 8)
+                return 255 * img
+
+        def sim(a, b):  # mean |diff| per sample — any scalar similarity works
+            return jnp.abs(a - b).mean(axis=(1, 2, 3))
+
+        eps = 1e-3
+        mean, std, dists = perceptual_path_length(
+            Gen(), num_samples=n, batch_size=16, epsilon=eps, sim_net=sim,
+            lower_discard=0.1, upper_discard=0.9, key=jax.random.PRNGKey(0),
+        )
+
+        # independent numpy oracle
+        lat1 = fixed_latents[0]
+        lat2 = lat1 + (fixed_latents[1] - lat1) * eps
+        sig = lambda x: 1 / (1 + np.exp(-x))  # noqa: E731
+        img1 = 255 * sig(lat1 @ w).reshape(-1, 3, 8, 8)
+        img2 = 255 * sig(lat2 @ w).reshape(-1, 3, 8, 8)
+        a = 2 * (img1 / 255) - 1
+        b = 2 * (img2 / 255) - 1
+        d = np.abs(a - b).mean(axis=(1, 2, 3)) / eps**2
+        lo = np.quantile(d, 0.1, method="lower")
+        hi = np.quantile(d, 0.9, method="lower")
+        kept = d[(d >= lo) & (d <= hi)]
+        np.testing.assert_allclose(float(mean), kept.mean(), rtol=1e-4)
+        np.testing.assert_allclose(float(std), kept.std(ddof=1), rtol=1e-3)
+
+    def test_generator_validation(self):
+        from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength
+
+        m = PerceptualPathLength(num_samples=4, sim_net=lambda a, b: jnp.zeros(a.shape[0]))
+        with pytest.raises(NotImplementedError, match="sample"):
+            m.update(object())
+        with pytest.raises(RuntimeError, match="No generator"):
+            PerceptualPathLength(sim_net=lambda a, b: None).compute()
+
+    def test_area_resize_matches_torch(self):
+        from torchmetrics_tpu.functional.image.perceptual_path_length import _resize_tensor
+
+        x = rng.rand(2, 3, 37, 41).astype(np.float32)
+        ours = np.asarray(_resize_tensor(jnp.asarray(x), 16))
+        ref = torch.nn.functional.interpolate(torch.from_numpy(x), (16, 16), mode="area").numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)

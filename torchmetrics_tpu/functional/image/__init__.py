@@ -21,3 +21,6 @@ from torchmetrics_tpu.functional.image.pansharpening import (  # noqa: F401
     spectral_distortion_index,
 )
 from torchmetrics_tpu.functional.image.vif import visual_information_fidelity  # noqa: F401
+from torchmetrics_tpu.functional.image.gradients import image_gradients  # noqa: F401
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity  # noqa: F401
+from torchmetrics_tpu.functional.image.perceptual_path_length import perceptual_path_length  # noqa: F401

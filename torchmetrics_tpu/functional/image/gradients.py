@@ -1,0 +1,37 @@
+"""Finite-difference image gradients.
+
+Reference: functional/image/gradients.py:20-80 — 1-step finite difference
+(TF-style): dy[x, y] = I(x+1, y) - I(x, y) with a zero last row; dx likewise
+with a zero last column. Implemented with jnp.pad instead of cat-of-zeros so
+XLA fuses the whole thing into one elementwise kernel.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    """Validate that ``img`` is a 4D array (reference gradients.py:20-25)."""
+    if not hasattr(img, "ndim"):
+        raise TypeError(f"The `img` expects an array type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Per-pixel forward differences, zero-padded on the trailing edge."""
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Compute gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image batch.
+
+    Reference: functional/image/gradients.py:46-80.
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

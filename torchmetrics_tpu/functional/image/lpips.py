@@ -1,0 +1,143 @@
+"""Learned Perceptual Image Patch Similarity (LPIPS) — score math.
+
+Reference: functional/image/lpips.py:205-435 (NoTrainLpips forward + update/
+compute). The score pipeline is re-expressed as a pure function over a
+pluggable *feature stack*:
+
+    score(x, y) = sum_k spatial_mean( w_k · (nhat_k(x) - nhat_k(y))**2 )
+
+where ``nhat_k`` is the channel-unit-normalised k-th backbone activation
+(reference ``_normalize_tensor``, lpips.py:215-219) and ``w_k`` is the 1x1
+"lin" convolution collapsed to a per-channel weight vector (reference
+``NetLinLayer``, lpips.py:242-257 — a bias-free 1x1 conv to one channel is
+exactly a weighted channel sum).
+
+The backbone is a callable ``img -> sequence of (N, C_k, H_k, W_k) feature
+maps``; architecture-faithful flax backbones (alex/vgg/squeeze) live in
+``torchmetrics_tpu.models.lpips``. This keeps the hot path — convs + one
+fused elementwise chain per layer — entirely inside XLA.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utils.checks import _is_concrete
+
+# ImageNet-statistics scaling layer (reference lpips.py:228-239).
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+
+def _normalize_tensor(feat: Array, eps: float = 1e-8) -> Array:
+    """Unit-normalise over the channel axis (reference lpips.py:215-219)."""
+    norm_factor = jnp.sqrt(eps + jnp.sum(feat**2, axis=1, keepdims=True))
+    return feat / norm_factor
+
+
+def _spatial_average(x: Array) -> Array:
+    """Mean over H, W keeping dims (reference lpips.py:205-208)."""
+    return x.mean(axis=(2, 3), keepdims=True)
+
+
+def _scaling_layer(img: Array) -> Array:
+    shift = jnp.asarray(_SHIFT, dtype=img.dtype)[None, :, None, None]
+    scale = jnp.asarray(_SCALE, dtype=img.dtype)[None, :, None, None]
+    return (img - shift) / scale
+
+
+def _valid_img(img: Array, normalize: bool) -> bool:
+    """Range/shape check (reference lpips.py:380-383); range only when concrete."""
+    if img.ndim != 4 or img.shape[1] != 3:
+        return False
+    if not _is_concrete(img):
+        return True
+    if normalize:
+        return bool(img.max() <= 1.0 and img.min() >= 0.0)
+    return bool(img.min() >= -1.0)
+
+
+def _lpips_score(
+    img1: Array,
+    img2: Array,
+    feature_stack: Callable[[Array], Sequence[Array]],
+    lin_weights: Optional[Sequence[Array]] = None,
+    normalize: bool = False,
+) -> Array:
+    """Per-sample LPIPS scores ``(N,)`` (reference _LPIPS.forward, lpips.py:338-369)."""
+    if normalize:  # [0,1] -> [-1,1]
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    in0, in1 = _scaling_layer(img1), _scaling_layer(img2)
+    outs0, outs1 = feature_stack(in0), feature_stack(in1)
+    if lin_weights is None:
+        lin_weights = [None] * len(outs0)
+    if len(lin_weights) != len(outs0):
+        raise ValueError(
+            f"Got {len(lin_weights)} lin weights for a {len(outs0)}-layer feature stack."
+        )
+    total = None
+    for f0, f1, w in zip(outs0, outs1, lin_weights):
+        diff = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
+        if w is None:  # unweighted: plain channel mean-free sum, as lin with ones
+            layer = diff.sum(axis=1, keepdims=True)
+        else:
+            w = jnp.asarray(w, dtype=diff.dtype).reshape(1, -1, 1, 1)
+            layer = (diff * w).sum(axis=1, keepdims=True)
+        layer = _spatial_average(layer)
+        total = layer if total is None else total + layer
+    return total.reshape(total.shape[0])
+
+
+def _lpips_update(
+    img1: Array,
+    img2: Array,
+    net: Callable[[Array, Array], Array],
+    normalize: bool,
+) -> Tuple[Array, Union[int, Array]]:
+    """Validate inputs, score the batch (reference lpips.py:386-396)."""
+    if not (_valid_img(img1, normalize) and _valid_img(img2, normalize)):
+        raise ValueError(
+            "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
+            f" Got input with shape {img1.shape} and {img2.shape} and values outside the"
+            f" expected {[0, 1] if normalize else [-1, 1]} range."
+        )
+    if normalize:  # hook contract: `net` always sees [-1, 1] inputs
+        img1 = 2 * jnp.asarray(img1) - 1
+        img2 = 2 * jnp.asarray(img2) - 1
+    loss = jnp.asarray(net(img1, img2)).reshape(img1.shape[0])
+    return loss, img1.shape[0]
+
+
+def _lpips_compute(sum_scores: Array, total: Union[Array, int], reduction: str = "mean") -> Array:
+    return sum_scores / total if reduction == "mean" else sum_scores
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net: Optional[Callable[[Array, Array], Array]] = None,
+    reduction: str = "mean",
+    normalize: bool = False,
+) -> Array:
+    """LPIPS between two image batches (reference lpips.py:399-435).
+
+    Unlike the reference (which downloads torchvision backbones), the scoring
+    network is explicit: ``net(img1, img2) -> (N,)`` per-sample scores with
+    inputs in [-1, 1]. Build one with
+    :func:`torchmetrics_tpu.models.lpips.lpips_network` (flax alex/vgg/squeeze
+    backbones + lin heads) or pass any callable.
+    """
+    if net is None:
+        raise ModuleNotFoundError(
+            "learned_perceptual_image_patch_similarity requires a `net` callable"
+            " (img1, img2) -> (N,) scores; pretrained torchvision backbones are not"
+            " bundled. Build one via torchmetrics_tpu.models.lpips.lpips_network."
+        )
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"Argument `reduction` must be one of ['mean', 'sum'], got {reduction}")
+    img1, img2 = jnp.asarray(img1), jnp.asarray(img2)
+    loss, total_count = _lpips_update(img1, img2, net, normalize)
+    return _lpips_compute(loss.sum(), total_count, reduction)

@@ -1,0 +1,228 @@
+"""Perceptual Path Length (reference functional/image/perceptual_path_length.py:27-284).
+
+PPL = E[ D(G(I(z1, z2, t)), G(I(z1, z2, t+eps))) / eps² ] with D an LPIPS-style
+similarity. The generator is a user hook (JAX has no nn.Module): any object
+with ``sample(key, num_samples) -> (N, z)`` and ``__call__(z) -> (N, C, H, W)``
+images in [0, 255] (plus ``num_classes`` and ``__call__(z, labels)`` when
+``conditional=True``). Randomness is explicit via a PRNG key instead of global
+torch RNG state.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+class GeneratorType:
+    """Interface stub for generator models (reference perceptual_path_length.py:27-47).
+
+    Subclassing is optional — any object with the right methods works.
+    """
+
+    @property
+    def num_classes(self) -> int:
+        raise NotImplementedError
+
+    def sample(self, key: Array, num_samples: int) -> Array:
+        """Return ``(num_samples, z_size)`` latents."""
+        raise NotImplementedError
+
+
+def _validate_generator_model(generator, conditional: bool = False) -> None:
+    """Reference perceptual_path_length.py:50-69, adapted to the key-taking sample hook."""
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(key, num_samples: int) -> Array` where"
+            " the returned array has shape `(num_samples, z_size)`."
+        )
+    if not callable(generator.sample):
+        raise ValueError("The generator's `sample` method must be callable.")
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`.")
+    if conditional and not isinstance(generator.num_classes, int):
+        raise ValueError("The generator's `num_classes` attribute must be an integer when `conditional=True`.")
+
+
+def _perceptual_path_length_validate_arguments(
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 128,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+) -> None:
+    """Reference perceptual_path_length.py:72-106."""
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not isinstance(conditional, bool):
+        raise ValueError(f"Argument `conditional` must be a boolean, but got {conditional}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if interpolation_method not in ["lerp", "slerp_any", "slerp_unit"]:
+        raise ValueError(
+            f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit',"
+            f"got {interpolation_method}."
+        )
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    if resize is not None and not (isinstance(resize, int) and resize > 0):
+        raise ValueError(f"Argument `resize` must be a positive integer or `None`, but got {resize}.")
+    if lower_discard is not None and not (isinstance(lower_discard, float) and 0 <= lower_discard <= 1):
+        raise ValueError(
+            f"Argument `lower_discard` must be a float between 0 and 1 or `None`, but got {lower_discard}."
+        )
+    if upper_discard is not None and not (isinstance(upper_discard, float) and 0 <= upper_discard <= 1):
+        raise ValueError(
+            f"Argument `upper_discard` must be a float between 0 and 1 or `None`, but got {upper_discard}."
+        )
+
+
+def _interpolate(
+    latents1: Array,
+    latents2: Array,
+    epsilon: float = 1e-4,
+    interpolation_method: str = "lerp",
+) -> Array:
+    """Latent interpolation (reference perceptual_path_length.py:109-152), branch-free slerp."""
+    eps = 1e-7
+    if latents1.shape != latents2.shape:
+        raise ValueError("Latents must have the same shape.")
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * epsilon
+    if interpolation_method in ("slerp_any", "slerp_unit"):
+        latents1_norm = latents1 / jnp.clip(jnp.sqrt((latents1**2).sum(-1, keepdims=True)), eps)
+        latents2_norm = latents2 / jnp.clip(jnp.sqrt((latents2**2).sum(-1, keepdims=True)), eps)
+        d = (latents1_norm * latents2_norm).sum(-1, keepdims=True)
+        mask_zero = (jnp.linalg.norm(latents1_norm, axis=-1, keepdims=True) < eps) | (
+            jnp.linalg.norm(latents2_norm, axis=-1, keepdims=True) < eps
+        )
+        mask_collinear = (d > 1 - eps) | (d < -1 + eps)
+        mask_lerp = mask_zero | mask_collinear
+        omega = jnp.arccos(jnp.clip(d, -1.0, 1.0))
+        denom = jnp.clip(jnp.sin(omega), eps)
+        coef_latents1 = jnp.sin((1 - epsilon) * omega) / denom
+        coef_latents2 = jnp.sin(epsilon * omega) / denom
+        out = coef_latents1 * latents1 + coef_latents2 * latents2
+        lerped = latents1 + (latents2 - latents1) * epsilon
+        out = jnp.where(mask_lerp, lerped, out)
+        if interpolation_method == "slerp_unit":
+            out = out / jnp.clip(jnp.sqrt((out**2).sum(-1, keepdims=True)), eps)
+        return out
+    raise ValueError(
+        f"Interpolation method {interpolation_method} not supported. Choose from 'lerp', 'slerp_any', 'slerp_unit'."
+    )
+
+
+def _area_resize_matrix(in_size: int, out_size: int, dtype) -> Array:
+    """Row-stochastic averaging matrix reproducing torch's adaptive/area resize."""
+    mat = np.zeros((out_size, in_size), dtype=np.float32)
+    for i in range(out_size):
+        start = int(math.floor(i * in_size / out_size))
+        end = int(math.ceil((i + 1) * in_size / out_size))
+        mat[i, start:end] = 1.0 / (end - start)
+    return jnp.asarray(mat, dtype=dtype)
+
+
+def _resize_tensor(x: Array, size: int = 64) -> Array:
+    """Reference lpips.py:222-226: area-downsample when larger, else bilinear."""
+    n, c, h, w = x.shape
+    if h > size and w > size:
+        wh = _area_resize_matrix(h, size, x.dtype)
+        ww = _area_resize_matrix(w, size, x.dtype)
+        return jnp.einsum("oh,nchw,pw->ncop", wh, x, ww)
+    return jax.image.resize(x, (n, c, size, size), method="linear")
+
+
+def perceptual_path_length(
+    generator,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Union[Callable[[Array, Array], Array], str, None] = None,
+    sim_params=None,
+    key: Optional[Array] = None,
+) -> Tuple[Array, Array, Array]:
+    """Perceptual path length of a generator (reference perceptual_path_length.py:155-284).
+
+    ``sim_net``: a callable ``(img1, img2) -> (N,)`` on [-1, 1] inputs, or a
+    net_type string building the flax LPIPS network from ``sim_params``.
+    """
+    _perceptual_path_length_validate_arguments(
+        num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+    )
+    _validate_generator_model(generator, conditional)
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2, klabels = jax.random.split(key, 3)
+
+    latent1 = jnp.asarray(generator.sample(k1, num_samples))
+    latent2 = jnp.asarray(generator.sample(k2, num_samples))
+    latent2 = _interpolate(latent1, latent2, epsilon, interpolation_method=interpolation_method)
+
+    if conditional:
+        labels = jax.random.randint(klabels, (num_samples,), 0, generator.num_classes)
+
+    if callable(sim_net):
+        net = sim_net
+    elif sim_net in ("alex", "vgg", "squeeze") or sim_net is None:
+        if sim_params is None:
+            raise ModuleNotFoundError(
+                "perceptual_path_length with a net_type string requires `sim_params` for the built-in"
+                " flax LPIPS backbone — pretrained torchvision weights are not bundled. Build params via"
+                " models.lpips.init_lpips_params or params_from_torch_state_dict, or pass a callable"
+                " `sim_net`."
+            )
+        from torchmetrics_tpu.models.lpips import lpips_network
+
+        base_net = lpips_network(sim_net or "vgg", sim_params)
+
+        def net(img1: Array, img2: Array) -> Array:
+            if resize is not None:
+                img1, img2 = _resize_tensor(img1, resize), _resize_tensor(img2, resize)
+            return base_net(img1, img2)
+
+    else:
+        raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', 'squeeze', got {sim_net}")
+
+    distances = []
+    num_batches = math.ceil(num_samples / batch_size)
+    for batch_idx in range(num_batches):
+        batch_latent1 = latent1[batch_idx * batch_size : (batch_idx + 1) * batch_size]
+        batch_latent2 = latent2[batch_idx * batch_size : (batch_idx + 1) * batch_size]
+
+        if conditional:
+            batch_labels = labels[batch_idx * batch_size : (batch_idx + 1) * batch_size]
+            outputs = generator(
+                jnp.concatenate((batch_latent1, batch_latent2), axis=0),
+                jnp.concatenate((batch_labels, batch_labels), axis=0),
+            )
+        else:
+            outputs = generator(jnp.concatenate((batch_latent1, batch_latent2), axis=0))
+
+        out1, out2 = jnp.split(outputs, 2, axis=0)
+        # rescale to lpips expected domain: [0, 255] -> [0, 1] -> [-1, 1]
+        out1_rescale = 2 * (out1 / 255) - 1
+        out2_rescale = 2 * (out2 / 255) - 1
+
+        similarity = jnp.asarray(net(out1_rescale, out2_rescale))
+        distances.append(similarity.reshape(-1) / epsilon**2)
+
+    dists = jnp.concatenate(distances)
+
+    lower = jnp.quantile(dists, lower_discard, method="lower") if lower_discard is not None else 0.0
+    upper = jnp.quantile(dists, upper_discard, method="lower") if upper_discard is not None else dists.max()
+    keep = (dists >= lower) & (dists <= upper)
+    kept = dists[keep]
+
+    return kept.mean(), kept.std(ddof=1), kept

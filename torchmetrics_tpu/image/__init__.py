@@ -18,3 +18,6 @@ from torchmetrics_tpu.image.basic import (  # noqa: F401
 from torchmetrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
 from torchmetrics_tpu.image.inception import InceptionScore  # noqa: F401
 from torchmetrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
+from torchmetrics_tpu.image.lpips import LearnedPerceptualImagePatchSimilarity  # noqa: F401
+from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance  # noqa: F401
+from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength  # noqa: F401

@@ -1,0 +1,85 @@
+"""LearnedPerceptualImagePatchSimilarity (reference image/lpip.py:34-188).
+
+States are the reference's scalar running sums (``sum_scores``/``total``,
+dist_reduce_fx="sum", lpip.py:136-137) so the metric psum-syncs in O(1). The
+scoring network is explicit: pass ``net`` (a callable) or ``net_type`` +
+``params`` to build the flax backbone from ``torchmetrics_tpu.models.lpips``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.lpips import _lpips_compute, _lpips_update
+from torchmetrics_tpu.metric import Metric
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS metric with a pluggable scoring network.
+
+    Args:
+        net: callable ``(img1, img2) -> (N,)`` per-sample scores; inputs NCHW
+            in [-1, 1]. Overrides ``net_type``/``params`` when given.
+        net_type: one of ``"alex"``, ``"vgg"``, ``"squeeze"`` — builds the flax
+            backbone (random-init unless ``params`` is supplied).
+        params: param tree for the built-in network (from
+            ``models.lpips.init_lpips_params`` or ``params_from_torch_state_dict``).
+        reduction: ``"mean"`` or ``"sum"`` over accumulated samples.
+        normalize: if True inputs are expected in [0, 1] instead of [-1, 1]
+            (reference lpip.py:131-133).
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        net: Optional[Callable[[Array, Array], Array]] = None,
+        net_type: str = "alex",
+        params: Optional[Dict[str, Any]] = None,
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        if net is None:
+            if params is None:
+                raise ModuleNotFoundError(
+                    "LearnedPerceptualImagePatchSimilarity requires either a `net` callable or `params`"
+                    " for the built-in flax backbone — pretrained torchvision weights are not bundled."
+                    " Build params via models.lpips.init_lpips_params (random) or"
+                    " params_from_torch_state_dict (converted reference weights)."
+                )
+            from torchmetrics_tpu.models.lpips import lpips_network
+
+            net = lpips_network(net_type, params)
+        self.net = net
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be an bool but got {normalize}")
+        self.normalize = normalize
+
+        self.add_state("sum_scores", jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Accumulate per-batch LPIPS scores (reference lpip.py:139-143)."""
+        loss, total = _lpips_update(jnp.asarray(img1), jnp.asarray(img2), self.net, self.normalize)
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Final reduced perceptual similarity (reference lpip.py:145-147)."""
+        return _lpips_compute(self.sum_scores, self.total, self.reduction)

@@ -1,0 +1,99 @@
+"""PerceptualPathLength metric (reference image/perceptual_path_length.py:36-185).
+
+The metric is generator-hook based: ``update`` just registers the generator,
+``compute`` runs the sampling + interpolation + LPIPS pipeline from the
+functional implementation. No accumulating tensor state — matching the
+reference, which re-samples at every compute.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+from jax import Array
+
+from torchmetrics_tpu.functional.image.perceptual_path_length import (
+    GeneratorType,
+    _perceptual_path_length_validate_arguments,
+    _validate_generator_model,
+    perceptual_path_length,
+)
+from torchmetrics_tpu.metric import Metric
+
+__all__ = ["PerceptualPathLength", "GeneratorType"]
+
+
+class PerceptualPathLength(Metric):
+    """PPL of a generator model (reference perceptual_path_length.py:129-185).
+
+    Args:
+        num_samples: number of latent pairs to sample at compute time.
+        conditional: whether the generator takes labels.
+        batch_size: generator/sim-net batch size.
+        interpolation_method: 'lerp', 'slerp_any' or 'slerp_unit'.
+        epsilon: latent-path spacing.
+        resize: image resize before similarity scoring.
+        lower_discard / upper_discard: distance quantiles to trim.
+        sim_net: similarity callable ``(img1, img2) -> (N,)`` or net_type str.
+        key: PRNG key for sampling (explicit JAX randomness).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        sim_net: Union[Callable[[Array, Array], Array], str, None] = "vgg",
+        sim_params=None,
+        key: Optional[Array] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _perceptual_path_length_validate_arguments(
+            num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+        )
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.resize = resize
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.sim_net = sim_net
+        self.sim_params = sim_params
+        self.key = key if key is not None else jax.random.PRNGKey(0)
+        self.generator = None
+
+    def update(self, generator) -> None:
+        """Register the generator model (reference perceptual_path_length.py:167-170)."""
+        _validate_generator_model(generator, self.conditional)
+        self.generator = generator
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Run the PPL pipeline (reference perceptual_path_length.py:172-185)."""
+        if self.generator is None:
+            raise RuntimeError("No generator registered; call `update(generator)` first.")
+        return perceptual_path_length(
+            generator=self.generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
+            sim_params=self.sim_params,
+            key=self.key,
+        )

@@ -1,0 +1,8 @@
+"""Flax model ports backing the model-based metrics.
+
+These replace the third-party native/torch networks the reference leans on
+(SURVEY §2.16): torchvision alex/vgg/squeeze feature stacks for LPIPS,
+torch-fidelity's InceptionV3 for FID/KID/IS/MiFID. Weights are not bundled —
+every consumer metric accepts loadable params or a callable escape hatch.
+"""
+from torchmetrics_tpu.models import lpips  # noqa: F401

@@ -1,0 +1,247 @@
+"""Flax backbones + lin heads for LPIPS.
+
+Architecture-faithful ports of the three torchvision feature stacks the
+reference LPIPS uses (reference functional/image/lpips.py:66-203: SqueezeNet
+slices, Alexnet slices, Vgg16 slices), exposed NCHW like the reference, plus a
+``lpips_network`` factory producing the ``net(img1, img2) -> (N,)`` scoring
+callable the LPIPS metric consumes. Weights are loadable either as a flax
+param tree or converted from a reference ``_LPIPS.state_dict()`` via
+:func:`params_from_torch_state_dict` (OIHW → HWIO transposition + slice-index
+remapping).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+LPIPS_CHANNELS: Dict[str, Tuple[int, ...]] = {
+    "alex": (64, 192, 384, 256, 256),
+    "vgg": (64, 128, 256, 512, 512),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
+
+
+def _max_pool(x: Array, window: int, stride: int, ceil_mode: bool = False) -> Array:
+    """Torch-semantics max pool on NHWC (VALID, optional ceil_mode padding)."""
+    h, w = x.shape[1], x.shape[2]
+    if ceil_mode:
+        out_h = -(-(h - window) // stride) + 1
+        out_w = -(-(w - window) // stride) + 1
+        pad_h = max(0, (out_h - 1) * stride + window - h)
+        pad_w = max(0, (out_w - 1) * stride + window - w)
+        padding = ((0, 0), (0, pad_h), (0, pad_w), (0, 0))
+    else:
+        padding = ((0, 0), (0, 0), (0, 0), (0, 0))
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=padding,
+    )
+
+
+class AlexNetFeatures(nn.Module):
+    """torchvision ``alexnet().features`` sliced at each ReLU (lpips.py:105-152)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        feats = []
+        x = nn.relu(nn.Conv(64, (11, 11), strides=(4, 4), padding=((2, 2), (2, 2)), name="conv1")(x))
+        feats.append(x)
+        x = _max_pool(x, 3, 2)
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)), name="conv2")(x))
+        feats.append(x)
+        x = _max_pool(x, 3, 2)
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x))
+        feats.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv4")(x))
+        feats.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv5")(x))
+        feats.append(x)
+        return feats
+
+
+class VGG16Features(nn.Module):
+    """torchvision ``vgg16().features`` sliced at relu{1_2,2_2,3_3,4_3,5_3} (lpips.py:155-203)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        feats = []
+        cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        idx = 0
+        for block, (ch, n_convs) in enumerate(cfg):
+            if block > 0:
+                x = _max_pool(x, 2, 2)
+            for _ in range(n_convs):
+                idx += 1
+                x = nn.relu(nn.Conv(ch, (3, 3), padding=((1, 1), (1, 1)), name=f"conv{idx}")(x))
+            feats.append(x)
+        return feats
+
+
+class Fire(nn.Module):
+    """SqueezeNet fire module: 1x1 squeeze → parallel 1x1/3x3 expand, concat."""
+
+    squeeze: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = nn.relu(nn.Conv(self.squeeze, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(nn.Conv(self.expand, (1, 1), name="expand1x1")(s))
+        e3 = nn.relu(nn.Conv(self.expand, (3, 3), padding=((1, 1), (1, 1)), name="expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNetFeatures(nn.Module):
+    """torchvision ``squeezenet1_1().features`` in 7 LPIPS slices (lpips.py:66-103)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        feats = []
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2), padding="VALID", name="conv1")(x))
+        feats.append(x)
+        x = _max_pool(x, 3, 2, ceil_mode=True)
+        x = Fire(16, 64, name="fire3")(x)
+        x = Fire(16, 64, name="fire4")(x)
+        feats.append(x)
+        x = _max_pool(x, 3, 2, ceil_mode=True)
+        x = Fire(32, 128, name="fire6")(x)
+        x = Fire(32, 128, name="fire7")(x)
+        feats.append(x)
+        x = _max_pool(x, 3, 2, ceil_mode=True)
+        x = Fire(48, 192, name="fire9")(x)
+        feats.append(x)
+        x = Fire(48, 192, name="fire10")(x)
+        feats.append(x)
+        x = Fire(64, 256, name="fire11")(x)
+        feats.append(x)
+        x = Fire(64, 256, name="fire12")(x)
+        feats.append(x)
+        return feats
+
+
+_BACKBONES = {"alex": AlexNetFeatures, "vgg": VGG16Features, "squeeze": SqueezeNetFeatures}
+
+
+def init_lpips_params(net_type: str = "alex", key: Optional[Array] = None, image_size: int = 64) -> Dict[str, Any]:
+    """Random-init param tree {"backbone": flax params, "lins": [(C_k,) arrays]}.
+
+    Mirrors the reference's ``pretrained=False`` mode (random backbone, random
+    lin heads) — deterministic given ``key``; load real weights for meaningful
+    scores.
+    """
+    if net_type not in _BACKBONES:
+        raise ValueError(f"Argument `net_type` must be one of {list(_BACKBONES)}, got {net_type}")
+    key = key if key is not None else jax.random.PRNGKey(0)
+    bkey, *lkeys = jax.random.split(key, 1 + len(LPIPS_CHANNELS[net_type]))
+    module = _BACKBONES[net_type]()
+    dummy = jnp.zeros((1, image_size, image_size, 3), dtype=jnp.float32)
+    backbone = module.init(bkey, dummy)["params"]
+    lins = [
+        jax.random.uniform(k, (c,), dtype=jnp.float32)
+        for k, c in zip(lkeys, LPIPS_CHANNELS[net_type])
+    ]
+    return {"backbone": backbone, "lins": lins}
+
+
+def lpips_network(
+    net_type: str = "alex",
+    params: Optional[Dict[str, Any]] = None,
+) -> Callable[[Array, Array], Array]:
+    """Build the ``net(img1, img2) -> (N,)`` scoring callable for LPIPS.
+
+    Inputs are NCHW in [-1, 1] (the metric handles the ``normalize`` flag).
+    ``params`` as from :func:`init_lpips_params` /
+    :func:`params_from_torch_state_dict`; random-init if omitted.
+    """
+    from torchmetrics_tpu.functional.image.lpips import _lpips_score
+
+    if net_type not in _BACKBONES:
+        raise ValueError(f"Argument `net_type` must be one of {list(_BACKBONES)}, got {net_type}")
+    if params is None:
+        params = init_lpips_params(net_type)
+    module = _BACKBONES[net_type]()
+    if "backbone" not in params or "lins" not in params:
+        raise KeyError(
+            "LPIPS params must contain both 'backbone' and 'lins' keys"
+            f" (got {sorted(params)}); build them via init_lpips_params or"
+            " params_from_torch_state_dict."
+        )
+    backbone_params = params["backbone"]
+    lins = params["lins"]
+
+    def feature_stack(img_nchw: Array) -> Sequence[Array]:
+        feats = module.apply({"params": backbone_params}, jnp.transpose(img_nchw, (0, 2, 3, 1)))
+        return [jnp.transpose(f, (0, 3, 1, 2)) for f in feats]
+
+    def net(img1: Array, img2: Array) -> Array:
+        return _lpips_score(img1, img2, feature_stack, lin_weights=lins, normalize=False)
+
+    return net
+
+
+# torchvision features-sequence index of each conv, per backbone slice layout
+# (reference lpips.py:74-76,116-126,166-180) — used to translate state-dict keys.
+_TORCH_CONV_INDEX = {
+    "alex": {"conv1": ("slice1", 0), "conv2": ("slice2", 3), "conv3": ("slice3", 6),
+             "conv4": ("slice4", 8), "conv5": ("slice5", 10)},
+    "vgg": {"conv1": ("slice1", 0), "conv2": ("slice1", 2), "conv3": ("slice2", 5),
+            "conv4": ("slice2", 7), "conv5": ("slice3", 10), "conv6": ("slice3", 12),
+            "conv7": ("slice3", 14), "conv8": ("slice4", 17), "conv9": ("slice4", 19),
+            "conv10": ("slice4", 21), "conv11": ("slice5", 24), "conv12": ("slice5", 26),
+            "conv13": ("slice5", 28)},
+}
+_SQUEEZE_FIRES = {"fire3": 3, "fire4": 4, "fire6": 6, "fire7": 7, "fire9": 9,
+                  "fire10": 10, "fire11": 11, "fire12": 12}
+_SQUEEZE_SLICE_OF = {0: "slices.0", 3: "slices.1", 4: "slices.1", 6: "slices.2", 7: "slices.2",
+                     9: "slices.3", 10: "slices.4", 11: "slices.5", 12: "slices.6"}
+
+
+def _oihw_to_hwio(w) -> Array:
+    return jnp.transpose(jnp.asarray(w, dtype=jnp.float32), (2, 3, 1, 0))
+
+
+def params_from_torch_state_dict(state_dict: Dict[str, Any], net_type: str = "alex") -> Dict[str, Any]:
+    """Convert a reference ``_LPIPS.state_dict()`` (as numpy arrays) to our tree.
+
+    Key layout of the source (reference lpips.py:260-331): backbone convs under
+    ``net.slice{K}.{i}.weight/bias`` (``net.slices.{K}.{i}.*`` for squeeze),
+    lin heads under ``lin{k}.model.1.weight`` with shape (1, C, 1, 1).
+    """
+    if net_type not in _BACKBONES:
+        raise ValueError(f"Argument `net_type` must be one of {list(_BACKBONES)}, got {net_type}")
+    backbone: Dict[str, Any] = {}
+    if net_type in ("alex", "vgg"):
+        for ours, (slc, idx) in _TORCH_CONV_INDEX[net_type].items():
+            backbone[ours] = {
+                "kernel": _oihw_to_hwio(state_dict[f"net.{slc}.{idx}.weight"]),
+                "bias": jnp.asarray(state_dict[f"net.{slc}.{idx}.bias"], dtype=jnp.float32),
+            }
+    else:
+        conv_slice = _SQUEEZE_SLICE_OF[0]
+        backbone["conv1"] = {
+            "kernel": _oihw_to_hwio(state_dict[f"net.{conv_slice}.0.weight"]),
+            "bias": jnp.asarray(state_dict[f"net.{conv_slice}.0.bias"], dtype=jnp.float32),
+        }
+        for ours, idx in _SQUEEZE_FIRES.items():
+            slc = _SQUEEZE_SLICE_OF[idx]
+            fire: Dict[str, Any] = {}
+            for part in ("squeeze", "expand1x1", "expand3x3"):
+                fire[part] = {
+                    "kernel": _oihw_to_hwio(state_dict[f"net.{slc}.{idx}.{part}.weight"]),
+                    "bias": jnp.asarray(state_dict[f"net.{slc}.{idx}.{part}.bias"], dtype=jnp.float32),
+                }
+            backbone[ours] = fire
+    n_lins = len(LPIPS_CHANNELS[net_type])
+    lins = [
+        jnp.asarray(state_dict[f"lin{k}.model.1.weight"], dtype=jnp.float32).reshape(-1)
+        for k in range(n_lins)
+    ]
+    return {"backbone": backbone, "lins": lins}
