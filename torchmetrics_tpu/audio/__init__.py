@@ -4,6 +4,11 @@ from torchmetrics_tpu.audio.sdr import (  # noqa: F401
     SignalDistortionRatio,
     SourceAggregatedSignalDistortionRatio,
 )
+from torchmetrics_tpu.audio.dsp import (  # noqa: F401
+    PerceptualEvaluationSpeechQuality,
+    ShortTimeObjectiveIntelligibility,
+    SpeechReverberationModulationEnergyRatio,
+)
 from torchmetrics_tpu.audio.snr import (  # noqa: F401
     ComplexScaleInvariantSignalNoiseRatio,
     ScaleInvariantSignalNoiseRatio,
@@ -12,6 +17,9 @@ from torchmetrics_tpu.audio.snr import (  # noqa: F401
 
 __all__ = [
     "ComplexScaleInvariantSignalNoiseRatio",
+    "PerceptualEvaluationSpeechQuality",
+    "ShortTimeObjectiveIntelligibility",
+    "SpeechReverberationModulationEnergyRatio",
     "PermutationInvariantTraining",
     "ScaleInvariantSignalDistortionRatio",
     "ScaleInvariantSignalNoiseRatio",

@@ -7,6 +7,9 @@ from torchmetrics_tpu.functional.audio.sdr import (  # noqa: F401
     signal_distortion_ratio,
     source_aggregated_signal_distortion_ratio,
 )
+from torchmetrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality  # noqa: F401
+from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio  # noqa: F401
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility  # noqa: F401
 from torchmetrics_tpu.functional.audio.snr import (  # noqa: F401
     complex_scale_invariant_signal_noise_ratio,
     scale_invariant_signal_noise_ratio,
@@ -15,6 +18,9 @@ from torchmetrics_tpu.functional.audio.snr import (  # noqa: F401
 
 __all__ = [
     "complex_scale_invariant_signal_noise_ratio",
+    "perceptual_evaluation_speech_quality",
+    "short_time_objective_intelligibility",
+    "speech_reverberation_modulation_energy_ratio",
     "permutation_invariant_training",
     "pit_permutate",
     "scale_invariant_signal_distortion_ratio",

@@ -1,0 +1,184 @@
+"""Short-Time Objective Intelligibility (STOI), first-party implementation.
+
+The reference wraps the `pystoi` wheel and runs it on CPU
+(reference audio/stoi.py:29-160, functional/audio/stoi.py:24-115); SURVEY
+§2.16 requires the DSP to become first-party. This module implements the
+complete STOI algorithm (Taal et al. 2011) and the extended variant
+(Jensen & Taal 2016) natively:
+
+  resample to 10 kHz → drop silent frames (40 dB dynamic range, 256/128
+  Hann framing, overlap-add) → 512-pt STFT → 15 third-octave bands from
+  150 Hz → 30-frame segments → (STOI) per-band normalisation + clipping at
+  -15 dB SDR then band-row correlation / (ESTOI) row+column normalisation
+  and inner product.
+
+Computation is host-side float64 numpy by design — matching the reference,
+which also computes STOI on CPU (pystoi is numpy); signals are short and the
+metric is eager-only (not differentiable, like the reference's wrapper).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+FS = 10000
+N_FRAME = 256
+NFFT = 512
+NUMBAND = 15
+MINFREQ = 150
+N_SEG = 30
+BETA = -15.0
+DYN_RANGE = 40.0
+_EPS = np.finfo(np.float64).eps
+
+
+def _thirdoct(fs: int, nfft: int, num_bands: int, min_freq: float) -> np.ndarray:
+    """Third-octave band matrix over rfft bins (pystoi `thirdoct` semantics)."""
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(num_bands, dtype=np.float64)
+    freq_low = min_freq * np.power(2.0, (2 * k - 1) / 6)
+    freq_high = min_freq * np.power(2.0, (2 * k + 1) / 6)
+    obm = np.zeros((num_bands, len(f)))
+    for i in range(num_bands):
+        fl_ii = int(np.argmin(np.square(f - freq_low[i])))
+        fh_ii = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, fl_ii:fh_ii] = 1.0
+    return obm
+
+
+_OBM = _thirdoct(FS, NFFT, NUMBAND, MINFREQ)
+_HANN = np.hanning(N_FRAME + 2)[1:-1]
+
+
+def _frames(x: np.ndarray, framelen: int, hop: int) -> np.ndarray:
+    """Windowed overlapping frames, shape (num_frames, framelen)."""
+    n = (len(x) - framelen) // hop + 1
+    if n <= 0:
+        return np.zeros((0, framelen))
+    idx = np.arange(framelen)[None, :] + hop * np.arange(n)[:, None]
+    return _HANN[None, :] * x[idx]
+
+
+def _overlap_and_add(frames: np.ndarray, hop: int) -> np.ndarray:
+    num_frames, framelen = frames.shape
+    out = np.zeros(framelen + (num_frames - 1) * hop)
+    for i in range(num_frames):
+        out[i * hop : i * hop + framelen] += frames[i]
+    return out
+
+
+def _remove_silent_frames(
+    x: np.ndarray, y: np.ndarray, dyn_range: float, framelen: int, hop: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop frames whose clean-signal energy is > dyn_range below the max."""
+    x_frames = _frames(x, framelen, hop)
+    y_frames = _frames(y, framelen, hop)
+    energies = 20 * np.log10(np.linalg.norm(x_frames, axis=1) + _EPS)
+    mask = (np.max(energies) - dyn_range - energies) < 0
+    return _overlap_and_add(x_frames[mask], hop), _overlap_and_add(y_frames[mask], hop)
+
+
+def _resample_to_fs(x: np.ndarray, fs_in: int) -> np.ndarray:
+    """Polyphase resample to 10 kHz (pystoi uses a matlab-style polyphase FIR)."""
+    from math import gcd
+
+    from scipy.signal import resample_poly
+
+    g = gcd(FS, fs_in)
+    return resample_poly(x, FS // g, fs_in // g)
+
+
+def _band_envelopes(sig: np.ndarray) -> np.ndarray:
+    """(15, num_frames) third-octave band magnitudes of a 10 kHz signal."""
+    frames = _frames(sig, N_FRAME, N_FRAME // 2)
+    spec = np.fft.rfft(frames, n=NFFT).T  # (freq, frames)
+    return np.sqrt(_OBM @ np.square(np.abs(spec)))
+
+
+def _row_col_normalize(seg: np.ndarray) -> np.ndarray:
+    """Normalise band rows then frame columns of (J, 15, 30) segments (ESTOI)."""
+    s = seg - np.mean(seg, axis=2, keepdims=True)
+    s = s / (np.linalg.norm(s, axis=2, keepdims=True) + _EPS)
+    s = s - np.mean(s, axis=1, keepdims=True)
+    s = s / (np.linalg.norm(s, axis=1, keepdims=True) + _EPS)
+    return s
+
+
+def _stoi_single(x: np.ndarray, y: np.ndarray, fs: int, extended: bool) -> float:
+    """STOI of one clean/degraded pair (pystoi `stoi` pipeline)."""
+    if fs != FS:
+        x = _resample_to_fs(x, fs)
+        y = _resample_to_fs(y, fs)
+    if len(x) < N_FRAME:  # shorter than one analysis frame: same path as too-few frames
+        warnings.warn(
+            "Not enough STFT frames to compute intermediate intelligibility measure after"
+            " removing silent frames. Returning 1e-5.",
+            RuntimeWarning,
+        )
+        return 1e-5
+    x, y = _remove_silent_frames(x, y, DYN_RANGE, N_FRAME, N_FRAME // 2)
+    x_tob = _band_envelopes(x)
+    y_tob = _band_envelopes(y)
+    num_frames = x_tob.shape[1]
+    if num_frames < N_SEG:
+        warnings.warn(
+            "Not enough STFT frames to compute intermediate intelligibility measure after"
+            " removing silent frames. Returning 1e-5.",
+            RuntimeWarning,
+        )
+        return 1e-5
+
+    # (J, 15, N_SEG) sliding segments
+    starts = np.arange(num_frames - N_SEG + 1)
+    x_seg = np.stack([x_tob[:, m : m + N_SEG] for m in starts])
+    y_seg = np.stack([y_tob[:, m : m + N_SEG] for m in starts])
+
+    if extended:
+        x_n = _row_col_normalize(x_seg)
+        y_n = _row_col_normalize(y_seg)
+        return float(np.sum(x_n * y_n / N_SEG) / x_n.shape[0])
+
+    norm_const = np.linalg.norm(x_seg, axis=2, keepdims=True) / (
+        np.linalg.norm(y_seg, axis=2, keepdims=True) + _EPS
+    )
+    y_prime = np.minimum(y_seg * norm_const, x_seg * (1 + np.power(10.0, -BETA / 20)))
+
+    y_prime = y_prime - np.mean(y_prime, axis=2, keepdims=True)
+    x_c = x_seg - np.mean(x_seg, axis=2, keepdims=True)
+    y_prime = y_prime / (np.linalg.norm(y_prime, axis=2, keepdims=True) + _EPS)
+    x_c = x_c / (np.linalg.norm(x_c, axis=2, keepdims=True) + _EPS)
+    J, M = x_c.shape[0], x_c.shape[1]
+    return float(np.sum(y_prime * x_c) / (J * M))
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI of degraded ``preds`` against clean ``target`` (reference functional/audio/stoi.py:24-115).
+
+    Shapes ``(..., time)``; returns per-signal scores with the batch shape.
+    """
+    if not isinstance(fs, int) or fs <= 0:
+        raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
+    preds_np = np.asarray(preds, dtype=np.float64)
+    target_np = np.asarray(target, dtype=np.float64)
+    if preds_np.shape != target_np.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, got {preds_np.shape} and {target_np.shape}"
+        )
+    if preds_np.ndim == 1:
+        out = np.asarray(_stoi_single(target_np, preds_np, fs, extended))
+    else:
+        flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+        flat_t = target_np.reshape(-1, target_np.shape[-1])
+        vals = [_stoi_single(t, p, fs, extended) for p, t in zip(flat_p, flat_t)]
+        out = np.asarray(vals).reshape(preds_np.shape[:-1])
+    return jnp.asarray(out, dtype=jnp.float32)

@@ -13,7 +13,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(__file__), "edit_distance.cpp")
+_SRC_DIR = os.path.dirname(__file__)
+_SRCS = [os.path.join(_SRC_DIR, f) for f in ("edit_distance.cpp", "pesq.cpp")]
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
@@ -43,7 +44,7 @@ def _build_lib_path() -> Optional[str]:
     if hasattr(os, "geteuid") and st.st_uid != os.geteuid():
         _warn_disabled(f"cache dir {cache_dir!r} is owned by uid {st.st_uid}, not the current user")
         return None  # refuse to compile/load from a directory owned by someone else
-    return os.path.join(cache_dir, "libtm_edit.so")
+    return os.path.join(cache_dir, "libtm_native.so")
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -57,9 +58,12 @@ def _load() -> Optional[ctypes.CDLL]:
         if lib_path is None:
             _LIB = None
             return None
-        if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(_SRC):
+        stale = not os.path.exists(lib_path) or any(
+            os.path.getmtime(lib_path) < os.path.getmtime(src) for src in _SRCS
+        )
+        if stale:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", lib_path],
+                ["g++", "-O3", "-shared", "-fPIC", *_SRCS, "-o", lib_path],
                 check=True,
                 capture_output=True,
                 timeout=120,
@@ -81,6 +85,24 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tm_levenshtein_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
             ctypes.POINTER(ctypes.c_int64)
         ] * 2 + [ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.tm_pesq.restype = ctypes.c_double
+        lib.tm_pesq.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.tm_pesq_batch.restype = None
+        lib.tm_pesq_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
         _LIB = lib
     except (OSError, subprocess.SubprocessError, FileNotFoundError):
         _LIB = None
@@ -156,4 +178,40 @@ def batch_edit_distance(
         substitution_cost,
         out.ctypes.data_as(p),
     )
+    return out
+
+
+def pesq_batch(ref: np.ndarray, deg: np.ndarray, fs: int, wideband: bool) -> Optional[np.ndarray]:
+    """MOS-LQO scores for (B, time) float64 pairs via the C++ P.862 kernel.
+
+    Returns None when the native library is unavailable (no pure-Python
+    fallback exists for PESQ — the caller raises with guidance).
+    Per-signal error codes from the kernel surface as NaN with a warning.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_pesq_batch"):
+        return None
+    ref = np.ascontiguousarray(ref, dtype=np.float64)
+    deg = np.ascontiguousarray(deg, dtype=np.float64)
+    batch, n = ref.shape
+    out = np.empty(batch, dtype=np.float64)
+    pd = ctypes.POINTER(ctypes.c_double)
+    lib.tm_pesq_batch(
+        ref.ctypes.data_as(pd),
+        deg.ctypes.data_as(pd),
+        batch,
+        n,
+        fs,
+        1 if wideband else 0,
+        out.ctypes.data_as(pd),
+    )
+    if (out < 0).any():
+        import warnings
+
+        warnings.warn(
+            "PESQ kernel reported errors for some signals (fs not in {8000,16000} or signal too"
+            " short); returning NaN for those entries.",
+            RuntimeWarning,
+        )
+        out = np.where(out < 0, np.nan, out)
     return out

@@ -1,0 +1,409 @@
+// First-party native kernel: PESQ (ITU-T P.862 / P.862.2 structure).
+//
+// The reference delegates PerceptualEvaluationSpeechQuality to the `pesq` C
+// wheel (reference audio/pesq.py:29-173, functional/audio/pesq.py:24-113);
+// SURVEY §2.16 requires a first-party C++ PESQ. This kernel implements the
+// P.862 pipeline: level alignment to 10^7 active power → band-limit filtering
+// → envelope-correlation delay alignment → perceptual model (32 ms Hann
+// frames, Bark-band pitch power densities, partial frequency compensation,
+// short-term gain compensation, Zwicker loudness, masked symmetric +
+// asymmetric disturbance, L6/L2 time aggregation) → raw score →
+// P.862.1/P.862.2 MOS-LQO mapping.
+//
+// Deliberate simplifications vs the ITU reference code (documented for the
+// caller): single-utterance time alignment (one global delay from envelope
+// cross-correlation instead of per-utterance splitting/realignment), and
+// Bark band edges generated from the Zwicker-style warp used by P.862
+// (z = 6*asinh(f/600)) rather than the standard's hand-tuned tables. For
+// time-aligned test material these do not change the ranking behaviour of
+// the score; treat absolute values as approximate.
+//
+// Build: g++ -O3 -shared -fPIC pesq.cpp -o libtm_native.so
+// ABI: plain C, driven through ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cmath>
+#include <cstring>
+#include <vector>
+#include <complex>
+#include <algorithm>
+
+namespace {
+
+constexpr double kPi = 3.14159265358979323846;
+
+// ------------------------------------------------------------------ FFT
+void fft_radix2(std::vector<std::complex<double>>& a, bool inverse) {
+    const size_t n = a.size();
+    if (n <= 1) return;
+    for (size_t i = 1, j = 0; i < n; ++i) {
+        size_t bit = n >> 1;
+        for (; j & bit; bit >>= 1) j ^= bit;
+        j ^= bit;
+        if (i < j) std::swap(a[i], a[j]);
+    }
+    for (size_t len = 2; len <= n; len <<= 1) {
+        const double ang = 2 * kPi / static_cast<double>(len) * (inverse ? 1 : -1);
+        const std::complex<double> wlen(std::cos(ang), std::sin(ang));
+        for (size_t i = 0; i < n; i += len) {
+            std::complex<double> w(1.0);
+            for (size_t j = 0; j < len / 2; ++j) {
+                std::complex<double> u = a[i + j];
+                std::complex<double> v = a[i + j + len / 2] * w;
+                a[i + j] = u + v;
+                a[i + j + len / 2] = u - v;
+                w *= wlen;
+            }
+        }
+    }
+    if (inverse)
+        for (auto& x : a) x /= static_cast<double>(n);
+}
+
+size_t next_pow2(size_t n) {
+    size_t p = 1;
+    while (p < n) p <<= 1;
+    return p;
+}
+
+// ------------------------------------------------- frequency-domain filter
+// Piecewise-linear magnitude response (dB) applied over the whole signal,
+// the shape P.862 uses for its band-limiting "IRS-like" filtering.
+void apply_filter_db(std::vector<double>& x, double fs, const double* freqs,
+                     const double* gains_db, int npts) {
+    const size_t n = next_pow2(x.size());
+    std::vector<std::complex<double>> spec(n);
+    for (size_t i = 0; i < x.size(); ++i) spec[i] = x[i];
+    fft_radix2(spec, false);
+    for (size_t i = 0; i <= n / 2; ++i) {
+        const double f = fs * static_cast<double>(i) / static_cast<double>(n);
+        double g_db;
+        if (f <= freqs[0]) {
+            g_db = gains_db[0];
+        } else if (f >= freqs[npts - 1]) {
+            g_db = gains_db[npts - 1];
+        } else {
+            int k = 0;
+            while (f > freqs[k + 1]) ++k;
+            const double t = (f - freqs[k]) / (freqs[k + 1] - freqs[k]);
+            g_db = gains_db[k] + t * (gains_db[k + 1] - gains_db[k]);
+        }
+        const double g = std::pow(10.0, g_db / 20.0);
+        spec[i] *= g;
+        if (i > 0 && i < n / 2) spec[n - i] *= g;
+    }
+    fft_radix2(spec, true);
+    for (size_t i = 0; i < x.size(); ++i) x[i] = spec[i].real();
+}
+
+// --------------------------------------------------------- level alignment
+// Scale to the P.862 target active power of 1e7 measured over the 350-3250 Hz
+// band.
+void align_level(std::vector<double>& x, double fs) {
+    const size_t n = next_pow2(x.size());
+    std::vector<std::complex<double>> spec(n);
+    for (size_t i = 0; i < x.size(); ++i) spec[i] = x[i];
+    fft_radix2(spec, false);
+    double band_power = 0.0;
+    for (size_t i = 0; i <= n / 2; ++i) {
+        const double f = fs * static_cast<double>(i) / static_cast<double>(n);
+        if (f >= 350.0 && f <= 3250.0) {
+            const double m = std::abs(spec[i]);
+            band_power += 2.0 * m * m / (static_cast<double>(n) * static_cast<double>(n));
+        }
+    }
+    band_power /= static_cast<double>(x.size());
+    // P.862 calibrates to an active band power of 1e7 in the 16-bit integer
+    // domain; the perceptual constants below (Sp, Sl) assume this domain.
+    const double scale = std::sqrt(1e7 / (band_power + 1e-20));
+    for (auto& v : x) v *= scale;
+}
+
+// ------------------------------------------------------------ delay align
+// One global delay from cross-correlation of 4 ms frame-energy envelopes.
+int64_t estimate_delay(const std::vector<double>& ref, const std::vector<double>& deg, double fs) {
+    const size_t hop = static_cast<size_t>(fs * 0.004);
+    const size_t nr = ref.size() / hop, nd = deg.size() / hop;
+    if (nr < 4 || nd < 4) return 0;
+    std::vector<double> er(nr), ed(nd);
+    for (size_t i = 0; i < nr; ++i) {
+        double s = 0;
+        for (size_t j = 0; j < hop; ++j) s += ref[i * hop + j] * ref[i * hop + j];
+        er[i] = std::log1p(s);
+    }
+    for (size_t i = 0; i < nd; ++i) {
+        double s = 0;
+        for (size_t j = 0; j < hop; ++j) s += deg[i * hop + j] * deg[i * hop + j];
+        ed[i] = std::log1p(s);
+    }
+    const int64_t max_lag = static_cast<int64_t>(std::min(nr, nd) / 2);
+    double best = -1e300;
+    int64_t best_lag = 0;
+    for (int64_t lag = -max_lag; lag <= max_lag; ++lag) {
+        double c = 0;
+        for (size_t i = 0; i < nr; ++i) {
+            const int64_t j = static_cast<int64_t>(i) + lag;
+            if (j >= 0 && j < static_cast<int64_t>(nd)) c += er[i] * ed[j];
+        }
+        if (c > best) {
+            best = c;
+            best_lag = lag;
+        }
+    }
+    return best_lag * static_cast<int64_t>(hop);
+}
+
+// ------------------------------------------------------- perceptual model
+struct BarkBands {
+    std::vector<size_t> lo, hi;   // FFT-bin ranges per band
+    std::vector<double> width;    // bark width per band
+    std::vector<double> centre;   // centre frequency (Hz)
+};
+
+double hz_to_bark(double f) { return 6.0 * std::asinh(f / 600.0); }
+double bark_to_hz(double z) { return 600.0 * std::sinh(z / 6.0); }
+
+BarkBands make_bands(double fs, size_t nfft, int nbands) {
+    const double fmax = (fs >= 16000.0) ? 8000.0 : 4000.0;
+    const double zmax = hz_to_bark(fmax), zmin = hz_to_bark(25.0);
+    BarkBands bb;
+    for (int b = 0; b < nbands; ++b) {
+        const double z0 = zmin + (zmax - zmin) * b / nbands;
+        const double z1 = zmin + (zmax - zmin) * (b + 1) / nbands;
+        const double f0 = bark_to_hz(z0), f1 = bark_to_hz(z1);
+        size_t lo = static_cast<size_t>(std::ceil(f0 * static_cast<double>(nfft) / fs));
+        size_t hi = static_cast<size_t>(std::floor(f1 * static_cast<double>(nfft) / fs));
+        if (hi < lo) hi = lo;
+        if (hi > nfft / 2) hi = nfft / 2;
+        bb.lo.push_back(lo);
+        bb.hi.push_back(hi);
+        bb.width.push_back(z1 - z0);
+        bb.centre.push_back(0.5 * (f0 + f1));
+    }
+    return bb;
+}
+
+// Absolute hearing threshold (Terhardt approximation), in power units matched
+// to the 1e7 level-aligned domain.
+double abs_thresh_power(double f_hz) {
+    const double f = f_hz / 1000.0;
+    const double db = 3.64 * std::pow(f, -0.8) - 6.5 * std::exp(-0.6 * (f - 3.3) * (f - 3.3)) +
+                      1e-3 * std::pow(f, 4.0);
+    return std::pow(10.0, db / 10.0);
+}
+
+struct PesqResult {
+    double raw;
+    int error;  // 0 ok
+};
+
+PesqResult pesq_raw(const double* ref_in, const double* deg_in, int64_t n_in, int64_t fs_in,
+                    bool wideband) {
+    if (fs_in != 8000 && fs_in != 16000) return {0.0, 1};
+    const double fs = static_cast<double>(fs_in);
+    const size_t frame = (fs_in == 8000) ? 256 : 512;  // 32 ms
+    const size_t hop = frame / 2;
+    if (n_in < static_cast<int64_t>(frame * 4)) return {0.0, 2};
+
+    std::vector<double> ref(ref_in, ref_in + n_in), deg(deg_in, deg_in + n_in);
+
+    // 1. level alignment
+    align_level(ref, fs);
+    align_level(deg, fs);
+
+    // 2. band limiting: NB IRS-like bandpass, WB 100 Hz highpass (P.862.2).
+    if (wideband) {
+        const double fr[] = {0.0, 50.0, 100.0, 7950.0, 8000.0};
+        const double gd[] = {-500.0, -40.0, 0.0, 0.0, -3.0};
+        apply_filter_db(ref, fs, fr, gd, 5);
+        apply_filter_db(deg, fs, fr, gd, 5);
+    } else {
+        const double fr[] = {0.0, 100.0, 200.0, 300.0, 3000.0, 3400.0, 4000.0};
+        const double gd[] = {-500.0, -40.0, -10.0, 0.0, 0.0, -10.0, -200.0};
+        apply_filter_db(ref, fs, fr, gd, 7);
+        apply_filter_db(deg, fs, fr, gd, 7);
+    }
+
+    // 3. global delay compensation
+    const int64_t delay = estimate_delay(ref, deg, fs);
+    const int64_t start_r = std::max<int64_t>(0, -delay);
+    const int64_t start_d = std::max<int64_t>(0, delay);
+    const int64_t n = std::min<int64_t>(static_cast<int64_t>(ref.size()) - start_r,
+                                        static_cast<int64_t>(deg.size()) - start_d);
+    if (n < static_cast<int64_t>(frame * 4)) return {0.0, 2};
+
+    // 4. framed power spectra -> bark pitch power densities
+    const int nbands = wideband ? 49 : 42;
+    const BarkBands bb = make_bands(fs, frame, nbands);
+    const size_t nframes = static_cast<size_t>((n - static_cast<int64_t>(frame)) / hop) + 1;
+
+    std::vector<double> hann(frame);
+    for (size_t i = 0; i < frame; ++i)
+        hann[i] = 0.5 * (1.0 - std::cos(2 * kPi * static_cast<double>(i) / static_cast<double>(frame)));
+
+    std::vector<std::vector<double>> pref(nframes, std::vector<double>(nbands, 0.0));
+    std::vector<std::vector<double>> pdeg(nframes, std::vector<double>(nbands, 0.0));
+    std::vector<double> frame_energy_ref(nframes, 0.0);
+
+    std::vector<std::complex<double>> buf(frame);
+    for (size_t t = 0; t < nframes; ++t) {
+        for (int which = 0; which < 2; ++which) {
+            const double* src = which == 0 ? ref.data() + start_r : deg.data() + start_d;
+            for (size_t i = 0; i < frame; ++i) buf[i] = src[t * hop + i] * hann[i];
+            fft_radix2(buf, false);
+            auto& dst = which == 0 ? pref[t] : pdeg[t];
+            for (int b = 0; b < nbands; ++b) {
+                double s = 0.0;
+                for (size_t k = bb.lo[b]; k <= bb.hi[b] && k <= frame / 2; ++k) {
+                    const double m = std::abs(buf[k]);
+                    s += m * m;
+                }
+                // P.862 power scaling factor Sp applied to the raw
+                // windowed-FFT band power
+                dst[b] = s * 6.910853e-6;
+            }
+        }
+        for (int b = 0; b < nbands; ++b) frame_energy_ref[t] += pref[t][b];
+    }
+
+    // silent-frame detection on the reference
+    double max_energy = 1e-20;
+    for (size_t t = 0; t < nframes; ++t) max_energy = std::max(max_energy, frame_energy_ref[t]);
+    std::vector<bool> active(nframes);
+    size_t n_active = 0;
+    for (size_t t = 0; t < nframes; ++t) {
+        active[t] = frame_energy_ref[t] > max_energy * 1e-4;  // 40 dB dynamic range
+        n_active += active[t] ? 1 : 0;
+    }
+    if (n_active < 4) return {0.0, 2};
+
+    // 5. partial frequency compensation: mean deg/ref band ratio clipped to
+    //    [0.01, 100] applied to the reference (P.862 §10.2.3 shape)
+    std::vector<double> mean_ref(nbands, 1e-20), mean_deg(nbands, 1e-20);
+    for (size_t t = 0; t < nframes; ++t) {
+        if (!active[t]) continue;
+        for (int b = 0; b < nbands; ++b) {
+            mean_ref[b] += pref[t][b];
+            mean_deg[b] += pdeg[t][b];
+        }
+    }
+    for (int b = 0; b < nbands; ++b) {
+        double r = mean_deg[b] / mean_ref[b];
+        r = std::min(100.0, std::max(0.01, r));
+        for (size_t t = 0; t < nframes; ++t) pref[t][b] *= r;
+    }
+
+    // 6. short-term gain compensation on the degraded signal
+    for (size_t t = 0; t < nframes; ++t) {
+        double er = 1e5, ed = 1e5;
+        for (int b = 0; b < nbands; ++b) {
+            er += pref[t][b];
+            ed += pdeg[t][b];
+        }
+        double g = er / ed;
+        g = std::min(5.0, std::max(3e-4, g));
+        for (int b = 0; b < nbands; ++b) pdeg[t][b] *= g;
+    }
+
+    // 7. Zwicker loudness per band with the P.862 loudness scaling Sl
+    const double sl = 1.866055e-1;
+    auto loudness = [&](double p, int b) {
+        const double p0 = abs_thresh_power(bb.centre[b]);
+        const double zb = hz_to_bark(bb.centre[b]);
+        const double e = (zb < 4.0) ? 0.23 * 4.0 / std::max(zb, 0.5) : 0.23;  // steeper below 4 bark
+        const double v = std::pow(p0 / 0.5, e) * (std::pow(0.5 + 0.5 * p / p0, e) - 1.0);
+        return (p <= p0) ? 0.0 : sl * v;
+    };
+
+    // 8. masked disturbance per frame
+    std::vector<double> d_frame(nframes, 0.0), da_frame(nframes, 0.0);
+    for (size_t t = 0; t < nframes; ++t) {
+        double d2 = 0.0, da = 0.0;
+        for (int b = 0; b < nbands; ++b) {
+            const double lr = loudness(pref[t][b], b);
+            const double ld = loudness(pdeg[t][b], b);
+            double d = std::fabs(ld - lr);
+            const double mask = 0.25 * std::min(lr, ld);
+            d = std::max(0.0, d - mask);
+            d2 += (d * bb.width[b]) * (d * bb.width[b]);
+            // asymmetry factor: additive noise weighted more than omissions
+            double h = std::pow((pdeg[t][b] + 50.0) / (pref[t][b] + 50.0), 1.2);
+            if (h < 3.0) h = 0.0;
+            if (h > 12.0) h = 12.0;
+            da += d * h * bb.width[b];
+        }
+// Aggregation calibration: the ITU code folds band widths into weighted
+// pseudo-Lp norms whose exact normalisation differs from a plain weighted
+// L2/L1; these factors were fitted so white-noise degradation of a
+// speech-shaped signal produces a monotone, well-spread MOS curve. Absolute
+// scores are approximate (no ITU-licensed oracle available); rankings are
+// what the tests pin down.
+#ifndef TM_PESQ_KSYM
+#define TM_PESQ_KSYM 0.5
+#endif
+#ifndef TM_PESQ_KASYM
+#define TM_PESQ_KASYM 0.05
+#endif
+        d_frame[t] = std::min(45.0, TM_PESQ_KSYM * std::sqrt(d2));
+        da_frame[t] = std::min(45.0, TM_PESQ_KASYM * da);
+    }
+
+    // 9. L6 over 20-frame intervals, then L2 over intervals (active frames only)
+    auto aggregate = [&](const std::vector<double>& df, double p_intra, double p_inter) {
+        const size_t span = 20;
+        std::vector<double> interval_vals;
+        for (size_t s = 0; s < nframes; s += span / 2) {
+            double acc = 0.0;
+            size_t cnt = 0;
+            for (size_t t = s; t < std::min(nframes, s + span); ++t) {
+                if (!active[t]) continue;
+                acc += std::pow(df[t], p_intra);
+                ++cnt;
+            }
+            if (cnt > 0) interval_vals.push_back(std::pow(acc / static_cast<double>(cnt), 1.0 / p_intra));
+        }
+        if (interval_vals.empty()) return 0.0;
+        double acc = 0.0;
+        for (double v : interval_vals) acc += std::pow(v, p_inter);
+        return std::pow(acc / static_cast<double>(interval_vals.size()), 1.0 / p_inter);
+    };
+
+    const double d_sym = aggregate(d_frame, 6.0, 2.0);
+    const double d_asym = aggregate(da_frame, 6.0, 2.0);
+#ifdef TM_PESQ_DEBUG
+    fprintf(stderr, "nframes=%zu n_active=%zu d_sym=%.3f d_asym=%.3f\n", nframes, n_active, d_sym, d_asym);
+    for (size_t t = 0; t < std::min<size_t>(nframes, 6); ++t)
+        fprintf(stderr, "  t=%zu act=%d d=%.3f da=%.3f pref0=%.3g pdeg0=%.3g pref20=%.3g pdeg20=%.3g\n",
+                t, int(active[t]), d_frame[t], da_frame[t], pref[t][0], pdeg[t][0], pref[t][20], pdeg[t][20]);
+#endif
+
+    const double raw = 4.5 - 0.1 * d_sym - 0.0309 * d_asym;
+    return {raw, 0};
+}
+
+double map_mos(double raw, bool wideband) {
+    // P.862.1 (NB) / P.862.2 (WB) logistic output mapping
+    if (wideband) return 0.999 + 4.0 / (1.0 + std::exp(-1.3669 * raw + 3.8224));
+    return 0.999 + 4.0 / (1.0 + std::exp(-1.4945 * raw + 4.6607));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns MOS-LQO; on error returns the negative error code (-1 bad fs,
+// -2 too short).
+double tm_pesq(const double* ref, const double* deg, int64_t n, int64_t fs, int32_t wideband) {
+    const PesqResult r = pesq_raw(ref, deg, n, fs, wideband != 0);
+    if (r.error != 0) return -static_cast<double>(r.error);
+    return map_mos(r.raw, wideband != 0);
+}
+
+void tm_pesq_batch(const double* ref, const double* deg, int64_t batch, int64_t n, int64_t fs,
+                   int32_t wideband, double* out) {
+    for (int64_t i = 0; i < batch; ++i)
+        out[i] = tm_pesq(ref + i * n, deg + i * n, n, fs, wideband);
+}
+
+}  // extern "C"
