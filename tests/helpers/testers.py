@@ -81,13 +81,23 @@ class MetricTester:
         ddp: bool = False,
         check_batch: bool = True,
         atol: Optional[float] = None,
+        host_compute: bool = False,
         **kwargs_update: Any,
     ) -> None:
-        """Full class lifecycle vs reference (reference testers.py:74-228)."""
+        """Full class lifecycle vs reference (reference testers.py:74-228).
+
+        ``host_compute``: declare that the metric's compute needs dynamic
+        shapes (retrieval grouping, contingency matrices) — the ddp path then
+        syncs in-trace but computes on host. Without it, a compute that fails
+        to trace FAILS the test (a jit-compatibility regression signal).
+        """
         atol = atol or self.atol
         metric_args = metric_args or {}
         if ddp:
-            self._ddp_class_test(preds, target, metric_class, reference_metric, metric_args, atol, **kwargs_update)
+            self._ddp_class_test(
+                preds, target, metric_class, reference_metric, metric_args, atol,
+                host_compute=host_compute, **kwargs_update,
+            )
             return
 
         metric = metric_class(**metric_args)
@@ -145,6 +155,7 @@ class MetricTester:
         reference_metric: Callable,
         metric_args: Dict[str, Any],
         atol: float,
+        host_compute: bool = False,
         **kwargs_update: Any,
     ) -> None:
         """Distributed path: per-device accumulation + lax-collective sync.
@@ -176,7 +187,7 @@ class MetricTester:
 
         reductions = metric._reductions
 
-        def sync_and_compute(st):
+        def sync_only(st):
             st = {k: v[0] for k, v in st.items()}  # drop per-device leading axis
             from torchmetrics_tpu.parallel.sync import sync_value
 
@@ -184,19 +195,32 @@ class MetricTester:
             for k, v in st.items():
                 red = reductions.get(k)
                 was_list = isinstance(metric._defaults[k], list)
-                out = sync_value([v] if was_list else v, red if not was_list else (red or "cat"), "batch")
-                synced[k] = out if not was_list else list(out)
-            return metric.functional_compute(synced)
+                synced[k] = sync_value(v, red if not was_list else (red or "cat"), "batch")
+            return synced
 
-        result = jax.jit(
-            jax.shard_map(
-                sync_and_compute,
-                mesh=mesh,
-                in_specs=P("batch"),
-                out_specs=P(),
-                check_vma=False,  # all_gather outputs are replicated but not statically provable
-            )
-        )(stacked)
+        def _rewrap(synced):
+            return {k: ([v] if isinstance(metric._defaults[k], list) else v) for k, v in synced.items()}
+
+        def sync_and_compute(st):
+            return metric.functional_compute(_rewrap(sync_only(st)))
+
+        if host_compute:
+            # declared dynamic-shape compute: sync in-trace, compute on host —
+            # the same split the OO path uses
+            synced = jax.jit(
+                jax.shard_map(sync_only, mesh=mesh, in_specs=P("batch"), out_specs=P(), check_vma=False)
+            )(stacked)
+            result = metric.functional_compute(_rewrap(synced))
+        else:
+            result = jax.jit(
+                jax.shard_map(
+                    sync_and_compute,
+                    mesh=mesh,
+                    in_specs=P("batch"),
+                    out_specs=P(),
+                    check_vma=False,  # all_gather outputs are replicated but not statically provable
+                )
+            )(stacked)
 
         all_preds = np.concatenate([np.asarray(p) for p in preds], axis=0)
         all_target = np.concatenate([np.asarray(t) for t in target], axis=0)
@@ -206,6 +230,90 @@ class MetricTester:
         }
         ref_total = reference_metric(all_preds, all_target, **all_extra)
         _assert_allclose(result, ref_total, atol=atol)
+
+    def run_differentiability_test(
+        self,
+        preds,
+        target,
+        metric_class: type,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Gradients flow through the pure update→compute path (reference testers.py:532-560).
+
+        The reference checks ``.backward()`` through ``forward``; the JAX
+        analogue differentiates ``functional_compute ∘ functional_update`` with
+        respect to preds. For ``is_differentiable`` metrics the gradient must
+        exist, be finite, and match preds' shape; metrics declaring
+        ``is_differentiable = False`` are skipped (nothing to check — JAX would
+        happily differentiate through argmax-like ops and return zeros).
+        """
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        if not metric.is_differentiable:
+            return
+        p0, t0 = jnp.asarray(preds[0], dtype=jnp.float32), jnp.asarray(target[0])
+
+        def scalar_metric(p):
+            st = metric.functional_update(metric.init_state(), p, t0)
+            out = metric.functional_compute(st)
+            if isinstance(out, dict):
+                out = sum(jnp.sum(v) for v in out.values())
+            elif isinstance(out, (tuple, list)):
+                out = sum(jnp.sum(jnp.asarray(v)) for v in out)
+            return jnp.sum(jnp.asarray(out))
+
+        grad = jax.grad(scalar_metric)(p0)
+        assert grad.shape == p0.shape
+        assert bool(jnp.isfinite(grad).all()), "gradient contains non-finite values"
+        assert bool(jnp.any(grad != 0)), "gradient is identically zero"
+        if metric_functional is not None:
+            gfun = jax.grad(lambda p: jnp.sum(jnp.asarray(metric_functional(p, t0, **metric_args))))(p0)
+            assert bool(jnp.isfinite(gfun).all())
+
+    def run_precision_test(
+        self,
+        preds,
+        target,
+        metric_class: type,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: float = 1e-2,
+        rtol: float = 5e-2,
+    ) -> None:
+        """bf16 inputs produce values close to the fp32 path (reference testers.py:464-530).
+
+        On TPU bfloat16 is the default compute dtype; the reference's
+        half-precision harness becomes: run the full update→compute lifecycle
+        with bfloat16 inputs and require agreement with the fp32 run at
+        reduced tolerance.
+        """
+        metric_args = metric_args or {}
+        m32 = metric_class(**metric_args)
+        m16 = metric_class(**metric_args)
+        num_batches = preds.shape[0] if hasattr(preds, "shape") else len(preds)
+        for i in range(num_batches):
+            p = jnp.asarray(preds[i])
+            t = jnp.asarray(target[i])
+            m32.update(p, t)
+            p16 = p.astype(jnp.bfloat16) if jnp.issubdtype(p.dtype, jnp.floating) else p
+            t16 = t.astype(jnp.bfloat16) if jnp.issubdtype(t.dtype, jnp.floating) else t
+            m16.update(p16, t16)
+        r32 = m32.compute()
+        r16 = m16.compute()
+
+        def _cmp(a, b):
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32), atol=atol, rtol=rtol
+            )
+
+        if isinstance(r32, dict):
+            for k in r32:
+                _cmp(r16[k], r32[k])
+        elif isinstance(r32, (tuple, list)):
+            for a, b in zip(r16, r32):
+                _cmp(a, b)
+        else:
+            _cmp(r16, r32)
 
     def run_jit_test(
         self,

@@ -13,7 +13,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits
-from torchmetrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utils.checks import _is_float_dtype, _check_same_shape, _is_concrete
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
@@ -63,7 +63,7 @@ def _binary_confusion_matrix_tensor_validation(
             f" the following values {sorted(allowed)}."
         )
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating):
+    if not _is_float_dtype(p.dtype):
         unique_p = set(np.unique(p).tolist())
         if not unique_p.issubset({0, 1}):
             raise RuntimeError(

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from torchmetrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utils.checks import _is_float_dtype, _check_same_shape, _is_concrete
 from torchmetrics_tpu.utils.compute import _safe_divide
 from torchmetrics_tpu.utils.data import _bincount, select_topk
 from torchmetrics_tpu.utils.enums import ClassificationTask
@@ -74,7 +74,7 @@ def _binary_stat_scores_tensor_validation(
             f" the following values {sorted(allowed)}."
         )
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating):
+    if not _is_float_dtype(p.dtype):
         unique_p = set(np.unique(p).tolist())
         if not unique_p.issubset({0, 1}):
             raise RuntimeError(
@@ -216,7 +216,7 @@ def _multiclass_stat_scores_tensor_validation(
         raise RuntimeError(f"Detected more unique values in `target` than expected. Expected only {check_value} but found"
                            f" {len(num_unique)} in `target`.")
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating) and p.size and p.max() >= num_classes:
+    if not _is_float_dtype(p.dtype) and p.size and p.max() >= num_classes:
         raise RuntimeError(f"Detected more unique values in `preds` than expected. Expected only {num_classes} but found"
                            f" more in `preds`.")
 
@@ -392,7 +392,7 @@ def _multilabel_stat_scores_tensor_validation(
             f" the following values {sorted(allowed)}."
         )
     p = np.asarray(preds)
-    if not np.issubdtype(p.dtype, np.floating):
+    if not _is_float_dtype(p.dtype):
         unique_p = set(np.unique(p).tolist())
         if not unique_p.issubset({0, 1}):
             raise RuntimeError(

@@ -128,7 +128,6 @@ class Metric:
         self._update_count: int = 0
         self._to_sync = self.sync_on_compute
         self._should_unsync = True
-        self._enable_grad = False
         self._dtype_convert = False
 
         self._cache: Optional[Dict[str, Any]] = None
@@ -280,7 +279,6 @@ class Metric:
         self._to_sync = self.dist_sync_on_step
         cache = self._copy_state_dict()
         self._computed = None
-        self._enable_grad = True
         self.reset()
         self.update(*args, **kwargs)
         batch_val = self.compute()
@@ -288,7 +286,6 @@ class Metric:
         self._update_count = _update_count
         self._state = cache
         self._computed = None
-        self._enable_grad = False
         self._to_sync = self.sync_on_compute
         self._should_unsync = True
         return batch_val
@@ -300,7 +297,6 @@ class Metric:
         self.reset()
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
-        self._enable_grad = True
 
         self.update(*args, **kwargs)
         batch_val = self.compute()
@@ -308,7 +304,6 @@ class Metric:
         self._update_count = _update_count + 1
         self._reduce_states(global_state)
         self._computed = None
-        self._enable_grad = False
         self._to_sync = self.sync_on_compute
         self._should_unsync = True
         return batch_val
@@ -364,7 +359,8 @@ class Metric:
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         axis_name = axis_name if axis_name is not None else self.sync_axis
-        in_trace = isinstance(axis_name, str) and in_named_axis_context(axis_name)
+        # str or sequence of axis names (multi-axis data×sequence sync)
+        in_trace = axis_name is not None and in_named_axis_context(axis_name)
         distributed_available = distributed_available or self.distributed_available_fn
         if not should_sync or (not in_trace and not distributed_available()):
             return

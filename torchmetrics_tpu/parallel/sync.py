@@ -27,8 +27,14 @@ Reduction = Union[str, Callable, None]
 _VALID_REDUCTIONS = ("sum", "mean", "max", "min", "cat")
 
 
-def in_named_axis_context(axis_name: str) -> bool:
-    """True when called inside a pmap/shard_map/vmap trace that binds ``axis_name``."""
+def in_named_axis_context(axis_name: Union[str, Sequence[str]]) -> bool:
+    """True when called inside a pmap/shard_map/vmap trace binding ``axis_name``.
+
+    A sequence of names (the multi-axis data×sequence case, SURVEY §5) requires
+    every listed axis to be bound.
+    """
+    if isinstance(axis_name, (tuple, list)):
+        return len(axis_name) > 0 and all(in_named_axis_context(a) for a in axis_name)
     try:
         lax.axis_index(axis_name)
         return True

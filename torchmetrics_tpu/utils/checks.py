@@ -17,6 +17,14 @@ from jax import Array
 from torchmetrics_tpu.utils.enums import DataType
 
 
+def _is_float_dtype(dtype) -> bool:
+    """True for any floating dtype incl. ml_dtypes bfloat16 (which numpy's
+    issubdtype does not classify as np.floating)."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(dtype, jnp.floating))
+
+
 def _is_concrete(x) -> bool:
     """True if ``x`` holds real values (not a tracer) so host checks can read it."""
     import jax.core
@@ -39,19 +47,20 @@ def _basic_input_validation(
     if not _is_concrete(target):
         return
     target = np.asarray(target)
-    if np.issubdtype(target.dtype, np.floating):
+    if _is_float_dtype(target.dtype):
         raise ValueError("The `target` has to be an integer tensor.")
     min_target = target.min() if target.size else 0
     if min_target < 0 and (ignore_index is None or ignore_index >= 0):
         raise ValueError("The `target` has to be a non-negative tensor.")
-    preds_float = np.issubdtype(np.asarray(preds).dtype, np.floating)
-    if not preds_float and np.asarray(preds).size and np.asarray(preds).min() < 0:
+    preds_np = np.asarray(preds)  # one device->host transfer, reused below
+    preds_float = _is_float_dtype(preds_np.dtype)
+    if not preds_float and preds_np.size and preds_np.min() < 0:
         raise ValueError("If `preds` are integers, they have to be non-negative.")
     if not preds.shape[0] == target.shape[0]:
         raise ValueError("The `preds` and `target` should have the same first dimension.")
     if multiclass is False and target.size and target.max() > 1:
         raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
-    if multiclass is False and not preds_float and np.asarray(preds).size and np.asarray(preds).max() > 1:
+    if multiclass is False and not preds_float and preds_np.size and preds_np.max() > 1:
         raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
 
 
@@ -59,7 +68,7 @@ def _check_data_type(preds: Array, target: Array) -> DataType:
     """Infer the classification data type of an input pair (subset of checks.py:207)."""
     preds = np.asarray(preds)
     target = np.asarray(target)
-    preds_float = np.issubdtype(preds.dtype, np.floating)
+    preds_float = _is_float_dtype(preds.dtype)
     if preds.ndim == target.ndim:
         if preds_float and preds.size and preds.max() <= 1 and preds.min() >= 0 and not np.array_equal(preds, preds.round()):
             return DataType.MULTILABEL
@@ -74,7 +83,7 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
     (reference checks.py:74-128)."""
     preds = np.asarray(preds)
     target = np.asarray(target)
-    preds_float = np.issubdtype(preds.dtype, np.floating)
+    preds_float = _is_float_dtype(preds.dtype)
 
     if preds.ndim == target.ndim:
         if preds.shape != target.shape:
@@ -229,7 +238,7 @@ def _check_classification_inputs(
             _check_num_classes_ml(num_classes, multiclass, implied_classes)
 
     if top_k is not None:
-        _check_top_k(top_k, case, implied_classes, multiclass, np.issubdtype(preds_np.dtype, np.floating))
+        _check_top_k(top_k, case, implied_classes, multiclass, _is_float_dtype(preds_np.dtype))
 
     return case
 
