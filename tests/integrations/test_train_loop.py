@@ -1,0 +1,216 @@
+"""Integration surface (L5): metrics inside a real flax/optax training loop.
+
+The JAX analogue of the reference's Lightning integration
+(tests/integrations/test_lightning.py:45-…): a MetricCollection lives inside a
+jitted shard_map train step on the 8-device mesh, metric values are "logged"
+every step (forward semantics), epoch-end compute/reset behaves like the
+reference's epoch hooks, and a mid-epoch checkpoint round-trips through the
+state pytree.
+"""
+import sys
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+NUM_DEVICES = 8
+NUM_CLASSES = 4
+BATCH = 32
+FEATURES = 16
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def _data(seed, n=BATCH * 6):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, FEATURES).astype(np.float32)
+    w = r.randn(FEATURES, NUM_CLASSES).astype(np.float32)
+    y = (x @ w + 0.1 * r.randn(n, NUM_CLASSES)).argmax(-1).astype(np.int64)
+    return x, y
+
+
+class TestTrainLoopIntegration:
+    def _setup(self):
+        model = MLP()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, FEATURES)))
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+        acc = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        f1 = tm.F1Score(task="multiclass", num_classes=NUM_CLASSES, average="macro", validate_args=False)
+        loss_m = tm.MeanMetric()
+        return model, params, opt, opt_state, mesh, acc, f1, loss_m
+
+    def test_metrics_inside_jitted_shard_map_step(self):
+        """Full loop: grads + metric states updated in one traced step; epoch
+        compute equals an eager rerun over the same batches; reset starts a
+        fresh epoch."""
+        model, params, opt, opt_state, mesh, acc, f1, loss_m = self._setup()
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P(), P(), P()),
+            check_vma=False,
+        )
+        def train_step(params, opt_state, x, y):
+            def loss_fn(p):
+                logits = model.apply(p, x)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = jax.lax.pmean(grads, "data")
+            loss = jax.lax.pmean(loss, "data")
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # per-batch metric states, synced across the mesh inside the trace;
+            # the host folds them into the epoch state via the declared-reduction
+            # merge (the functional_forward pattern)
+            acc_b = acc.functional_sync(acc.functional_update(acc.init_state(), logits, y), "data")
+            f1_b = f1.functional_sync(f1.functional_update(f1.init_state(), logits, y), "data")
+            loss_b = loss_m.functional_sync(loss_m.functional_update(loss_m.init_state(), loss), "data")
+            step_acc = acc.functional_compute(acc_b)
+            return params, opt_state, acc_b, f1_b, loss_b, step_acc
+
+        jit_step = jax.jit(train_step)
+
+        x, y = _data(0)
+        acc_st, f1_st, loss_st = None, None, None
+        step_logs = []
+        for i in range(0, len(x), BATCH):
+            xb = jax.device_put(jnp.asarray(x[i : i + BATCH]), NamedSharding(mesh, P("data")))
+            yb = jax.device_put(jnp.asarray(y[i : i + BATCH]), NamedSharding(mesh, P("data")))
+            params, opt_state, acc_b, f1_b, loss_b, step_acc = jit_step(params, opt_state, xb, yb)
+            acc_st = acc_b if acc_st is None else acc.merge_states(acc_st, acc_b)
+            f1_st = f1_b if f1_st is None else f1.merge_states(f1_st, f1_b)
+            loss_st = loss_b if loss_st is None else loss_m.merge_states(loss_st, loss_b)
+            step_logs.append(float(step_acc))
+
+        epoch_acc = float(acc.functional_compute(acc_st))
+        epoch_f1 = float(f1.functional_compute(f1_st))
+        epoch_loss = float(loss_m.functional_compute(loss_st))
+        assert 0.0 <= epoch_acc <= 1.0 and 0.0 <= epoch_f1 <= 1.0 and np.isfinite(epoch_loss)
+        assert 0.0 <= step_logs[-1] <= 1.0
+
+        # the traced accumulation must equal an eager OO rerun over the same data
+        eager = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        p_now = params
+        # logits with the FINAL params differ from the streaming ones — instead
+        # replay eagerly with the same per-step logits by re-running the loop
+        model2, params2, opt2, opt_state2, _, _, _, _ = self._setup()
+        for i in range(0, len(x), BATCH):
+            xb, yb = jnp.asarray(x[i : i + BATCH]), jnp.asarray(y[i : i + BATCH])
+
+            def loss_fn(p):
+                logits = model2.apply(p, xb)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean(), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params2)
+            updates, opt_state2 = opt2.update(grads, opt_state2, params2)
+            params2 = optax.apply_updates(params2, updates)
+            eager.update(logits, yb)
+        np.testing.assert_allclose(float(eager.compute()), epoch_acc, atol=1e-5)
+
+    def test_epoch_reset_semantics(self):
+        """reset() between epochs starts clean accumulation (Lightning epoch hooks)."""
+        coll = tm.MetricCollection(
+            {
+                "acc": tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False),
+                "f1": tm.F1Score(task="multiclass", num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            }
+        )
+        x, y = _data(1)
+        r = np.random.RandomState(2)
+        logits_e1 = jnp.asarray(r.randn(len(y), NUM_CLASSES).astype(np.float32))
+        coll.update(logits_e1, jnp.asarray(y))
+        epoch1 = {k: float(v) for k, v in coll.compute().items()}
+        coll.reset()
+
+        # epoch 2 with perfect predictions
+        perfect = jax.nn.one_hot(jnp.asarray(y), NUM_CLASSES) * 10.0
+        coll.update(perfect, jnp.asarray(y))
+        epoch2 = {k: float(v) for k, v in coll.compute().items()}
+        assert epoch2["acc"] == pytest.approx(1.0)
+        assert epoch2["acc"] > epoch1["acc"]
+
+    def test_mid_epoch_checkpoint_roundtrip(self):
+        """Metric state checkpoints mid-epoch via the state pytree and resumes
+        to bit-identical results (reference saving/loading semantics)."""
+        metric = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        x, y = _data(3)
+        r = np.random.RandomState(4)
+        logits = r.randn(len(y), NUM_CLASSES).astype(np.float32)
+
+        half = len(y) // 2
+        metric.update(jnp.asarray(logits[:half]), jnp.asarray(y[:half]))
+
+        # "checkpoint": serialize the state pytree to host numpy (what orbax
+        # would write) and restore into a fresh metric instance
+        ckpt = jax.tree_util.tree_map(lambda v: np.asarray(v), metric.state())
+        resumed = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        resumed.load_state(jax.tree_util.tree_map(jnp.asarray, ckpt))
+        resumed._update_count = metric.update_count
+
+        metric.update(jnp.asarray(logits[half:]), jnp.asarray(y[half:]))
+        resumed.update(jnp.asarray(logits[half:]), jnp.asarray(y[half:]))
+        assert float(metric.compute()) == float(resumed.compute())
+
+    def test_persistent_state_dict_roundtrip(self):
+        metric = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        metric.persistent(True)
+        x, y = _data(5)
+        r = np.random.RandomState(6)
+        logits = r.randn(len(y), NUM_CLASSES).astype(np.float32)
+        metric.update(jnp.asarray(logits), jnp.asarray(y))
+        sd = metric.state_dict()
+        assert sd  # persistent -> states present
+
+        fresh = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        fresh.persistent(True)
+        fresh.load_state_dict(sd)
+        fresh._update_count = 1
+        assert float(fresh.compute()) == float(metric.compute())
+
+
+class TestProfilerScopes:
+    def test_trace_annotation_names_in_captured_trace(self, tmp_path):
+        """Per-metric scope names appear in a captured jax.profiler trace (SURVEY §5)."""
+        import glob
+        import gzip
+
+        metric = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
+        logits = jnp.asarray(np.random.RandomState(0).randn(16, NUM_CLASSES).astype(np.float32))
+        target = jnp.asarray(np.random.RandomState(1).randint(0, NUM_CLASSES, 16))
+
+        trace_dir = str(tmp_path / "trace")
+        with jax.profiler.trace(trace_dir):
+            st = metric.functional_update(metric.init_state(), logits, target)
+            _ = metric.functional_compute(st)
+            jax.block_until_ready(_)
+
+        blobs = []
+        for pat in ("**/*.json.gz", "**/*.pb", "**/*.json"):
+            for f in glob.glob(f"{trace_dir}/{pat}", recursive=True):
+                raw = open(f, "rb").read()
+                if f.endswith(".gz"):
+                    raw = gzip.decompress(raw)
+                blobs.append(raw)
+        joined = b"".join(blobs)
+        assert b"MulticlassAccuracy.update" in joined
+        assert b"MulticlassAccuracy.compute" in joined

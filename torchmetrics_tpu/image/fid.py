@@ -68,19 +68,18 @@ class FrechetInceptionDistance(Metric):
         num_features: int = 2048,
         reset_real_features: bool = True,
         normalize: bool = False,
+        inception_params: Optional[dict] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if feature_extractor is None:
-            raise ModuleNotFoundError(
-                "FrechetInceptionDistance requires a `feature_extractor` callable mapping images to (N, F)"
-                " features. Bundled pretrained InceptionV3 weights are not available in this environment;"
-                " pass e.g. a flax InceptionV3 apply function (see torchmetrics_tpu.models.inception)."
-            )
-        self.feature_extractor = feature_extractor
         if not isinstance(num_features, int) or num_features < 1:
             raise ValueError("Argument `num_features` expected to be a positive integer")
         self.num_features = num_features
+        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+
+        self.feature_extractor = resolve_inception_extractor(
+            "FrechetInceptionDistance", feature_extractor, inception_params, feature_dim=num_features
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

@@ -25,17 +25,20 @@ class InceptionScore(Metric):
     def __init__(
         self,
         feature_extractor: Optional[Callable[[Array], Array]] = None,
+        inception_params: Optional[dict] = None,
         splits: int = 10,
         normalize: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if feature_extractor is None:
-            raise ModuleNotFoundError(
-                "InceptionScore requires a `feature_extractor` callable mapping images to (N, num_classes)"
-                " logits. Bundled pretrained InceptionV3 weights are not available in this environment."
-            )
-        self.feature_extractor = feature_extractor
+        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+
+        # IS consumes class logits, not pooled features: the built-in path
+        # taps the 1008-class head like the reference's 'logits_unbiased'
+        # (reference image/inception.py:110)
+        self.feature_extractor = resolve_inception_extractor(
+            "InceptionScore", feature_extractor, inception_params, feature_dim="logits_unbiased"
+        )
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` must be positive")
         self.splits = splits

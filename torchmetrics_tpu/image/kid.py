@@ -43,6 +43,7 @@ class KernelInceptionDistance(Metric):
     def __init__(
         self,
         feature_extractor: Optional[Callable[[Array], Array]] = None,
+        inception_params: Optional[dict] = None,
         subsets: int = 100,
         subset_size: int = 1000,
         degree: int = 3,
@@ -53,12 +54,11 @@ class KernelInceptionDistance(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if feature_extractor is None:
-            raise ModuleNotFoundError(
-                "KernelInceptionDistance requires a `feature_extractor` callable mapping images to (N, F)"
-                " features. Bundled pretrained InceptionV3 weights are not available in this environment."
-            )
-        self.feature_extractor = feature_extractor
+        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+
+        self.feature_extractor = resolve_inception_extractor(
+            "KernelInceptionDistance", feature_extractor, inception_params
+        )
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
         self.subsets = subsets

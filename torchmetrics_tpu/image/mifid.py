@@ -64,19 +64,18 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
     def __init__(
         self,
         feature_extractor: Optional[Callable[[Array], Array]] = None,
+        inception_params: Optional[dict] = None,
         reset_real_features: bool = True,
         cosine_distance_eps: float = 0.1,
         normalize: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if feature_extractor is None:
-            raise ModuleNotFoundError(
-                "MemorizationInformedFrechetInceptionDistance requires a `feature_extractor` callable"
-                " mapping images to (N, F) features. Bundled pretrained InceptionV3 weights are not"
-                " available in this environment; pass e.g. a flax InceptionV3 apply function."
-            )
-        self.feature_extractor = feature_extractor
+        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+
+        self.feature_extractor = resolve_inception_extractor(
+            "MemorizationInformedFrechetInceptionDistance", feature_extractor, inception_params
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

@@ -216,7 +216,10 @@ class Metric:
             self._computed = None
             self._update_count += 1
             try:
-                update(*args, **kwargs)
+                # per-metric profiler scope (SURVEY §5: the TPU analogue of the
+                # reference's torch._C._log_api_usage_once telemetry)
+                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                    update(*args, **kwargs)
             except TypeError as err:
                 if "got an unexpected keyword argument" in str(err) or "positional argument" in str(err):
                     raise TypeError(
@@ -251,7 +254,7 @@ class Metric:
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ):
+            ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
                 value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
@@ -433,7 +436,8 @@ class Metric:
         saved = self._state
         try:
             object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
-            self._update_fn(*args, **kwargs)
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                self._update_fn(*args, **kwargs)
             return self._copy_state_dict()
         finally:
             object.__setattr__(self, "_state", saved)
@@ -443,7 +447,8 @@ class Metric:
         saved = self._state
         try:
             object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
-            return _squeeze_if_scalar(self._compute_fn())
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                return _squeeze_if_scalar(self._compute_fn())
         finally:
             object.__setattr__(self, "_state", saved)
 

@@ -5,4 +5,4 @@ These replace the third-party native/torch networks the reference leans on
 torch-fidelity's InceptionV3 for FID/KID/IS/MiFID. Weights are not bundled —
 every consumer metric accepts loadable params or a callable escape hatch.
 """
-from torchmetrics_tpu.models import lpips  # noqa: F401
+from torchmetrics_tpu.models import inception, lpips  # noqa: F401
