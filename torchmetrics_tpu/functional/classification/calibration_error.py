@@ -27,9 +27,11 @@ def _binning_bucketize(
     """Per-bin mean confidence, mean accuracy and proportion (reference :36-60)."""
     n_bins = bin_boundaries_or_n
     indices = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
-    count = jnp.zeros(n_bins).at[indices].add(1.0)
-    conf = jnp.zeros(n_bins).at[indices].add(confidences)
-    acc = jnp.zeros(n_bins).at[indices].add(accuracies.astype(jnp.float32))
+    from torchmetrics_tpu.ops import weighted_bincount
+
+    count = weighted_bincount(indices, jnp.ones_like(confidences), n_bins)
+    conf = weighted_bincount(indices, confidences, n_bins)
+    acc = weighted_bincount(indices, accuracies.astype(jnp.float32), n_bins)
     prop_bin = count / count.sum()
     return _safe_divide(conf, count), _safe_divide(acc, count), prop_bin
 

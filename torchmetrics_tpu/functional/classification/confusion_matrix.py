@@ -166,10 +166,10 @@ def _multiclass_confusion_matrix_update(preds: Array, target: Array, valid: Arra
     w = valid.astype(jnp.float32)
     p = jnp.clip(preds.astype(jnp.int32), 0, num_classes - 1)
     idx = (target * num_classes + p).astype(jnp.int32)
+    from torchmetrics_tpu.ops import weighted_bincount
+
     return (
-        jnp.zeros(num_classes * num_classes, dtype=jnp.float32)
-        .at[idx]
-        .add(w)
+        weighted_bincount(idx, w, num_classes * num_classes)
         .reshape(num_classes, num_classes)
         .astype(jnp.int32)
     )
@@ -247,7 +247,9 @@ def _multilabel_confusion_matrix_update(preds: Array, target: Array, valid: Arra
     w = valid.astype(jnp.float32)
     label_idx = jnp.arange(num_labels)[None, :]
     idx = (label_idx * 4 + target * 2 + preds).astype(jnp.int32)
-    out = jnp.zeros(num_labels * 4, dtype=jnp.float32).at[idx.reshape(-1)].add(w.reshape(-1))
+    from torchmetrics_tpu.ops import weighted_bincount
+
+    out = weighted_bincount(idx.reshape(-1), w.reshape(-1), num_labels * 4)
     return out.reshape(num_labels, 2, 2).astype(jnp.int32)
 
 

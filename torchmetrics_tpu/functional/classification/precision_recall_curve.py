@@ -92,12 +92,11 @@ def _binary_precision_recall_curve_update(
     """Binned state update: one weighted scatter-add building (T, 2, 2) counts."""
     if thresholds is None:
         return None
-    len_t = thresholds.shape[0]
-    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.int32)  # (T, N)
-    unique_mapping = preds_t + 2 * target[None, :] + 4 * jnp.arange(len_t)[:, None]
-    w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :], unique_mapping.shape)
-    bins = jnp.zeros(4 * len_t, dtype=jnp.float32).at[unique_mapping.reshape(-1)].add(w.reshape(-1))
-    return bins.reshape(len_t, 2, 2).astype(jnp.int32)
+    from torchmetrics_tpu.ops import binned_curve_counts
+
+    # fused pallas path on TPU: the (T, N) threshold-compare intermediate
+    # never materialises (ops/binned_curve.py)
+    return binned_curve_counts(preds, target, valid, thresholds).astype(jnp.int32)
 
 
 def _binary_clf_curve(
@@ -224,7 +223,9 @@ def _multiclass_precision_recall_curve_update(
         + 4 * num_classes * jnp.arange(len_t)[:, None, None]
     )
     w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :, None], idx.shape)
-    bins = jnp.zeros(4 * num_classes * len_t, dtype=jnp.float32).at[idx.reshape(-1)].add(w.reshape(-1))
+    from torchmetrics_tpu.ops import weighted_bincount
+
+    bins = weighted_bincount(idx.reshape(-1), w.reshape(-1), 4 * num_classes * len_t)
     return bins.reshape(len_t, num_classes, 2, 2).astype(jnp.int32)
 
 
@@ -329,7 +330,9 @@ def _multilabel_precision_recall_curve_update(
         + 4 * num_labels * jnp.arange(len_t)[:, None, None]
     )
     w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :, :], idx.shape)
-    bins = jnp.zeros(4 * num_labels * len_t, dtype=jnp.float32).at[idx.reshape(-1)].add(w.reshape(-1))
+    from torchmetrics_tpu.ops import weighted_bincount
+
+    bins = weighted_bincount(idx.reshape(-1), w.reshape(-1), 4 * num_labels * len_t)
     return bins.reshape(len_t, num_labels, 2, 2).astype(jnp.int32)
 
 

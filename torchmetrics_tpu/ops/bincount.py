@@ -1,0 +1,110 @@
+"""Pallas TPU kernel: fused weighted bincount (deterministic scatter-add).
+
+The counting core of the classification stack — confusion matrices
+(``num_classes*target + preds`` flattened indices), binned PR-curve states and
+calibration histograms all reduce to ``zeros(L).at[idx].add(w)``. XLA lowers
+that to a serialized scatter on TPU; this kernel instead tiles the index
+stream against the bin axis and accumulates per-tile one-hot partial sums in
+VMEM — an embarrassingly parallel compare+reduce the VPU is built for, with a
+(TILE_N, TILE_C) working set that never leaves on-chip memory.
+
+Grid layout: ``(num_bin_tiles, num_index_tiles)`` with the index axis
+minormost, so each output tile stays resident in VMEM while every index tile
+streams past it (standard revisited-output reduction pattern).
+
+Out-of-range indices contribute nothing (they match no bin tile) — the same
+drop semantics as jnp's default scatter mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+TILE_N = 1024  # indices per step
+TILE_C = 512  # bins per output tile (multiple of 128 lanes)
+
+
+def _wbincount_kernel(x_ref, w_ref, out_ref):
+    ci = pl.program_id(0)
+    ni = pl.program_id(1)
+
+    @pl.when(ni == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:].reshape(TILE_N, 1)  # (TILE_N, 1) int32
+    w = w_ref[:].reshape(TILE_N, 1)  # (TILE_N, 1) f32
+    cols = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, TILE_C), 1) + ci * TILE_C
+    onehot = jnp.where(x == cols, w, 0.0)  # (TILE_N, TILE_C)
+    out_ref[:] += onehot.sum(axis=0).reshape(1, TILE_C)
+
+
+@functools.partial(jax.jit, static_argnames=("length", "interpret"))
+def _wbincount_pallas(x: Array, weights: Array, length: int, interpret: bool = False) -> Array:
+    n = x.shape[0]
+    n_pad = -n % TILE_N
+    c_pad = -length % TILE_C
+    # padded indices point outside every bin tile -> dropped
+    x = jnp.pad(x.astype(jnp.int32), (0, n_pad), constant_values=-1)
+    w = jnp.pad(weights.astype(jnp.float32), (0, n_pad))
+    num_c_tiles = (length + c_pad) // TILE_C
+    num_n_tiles = (n + n_pad) // TILE_N
+
+    out = pl.pallas_call(
+        _wbincount_kernel,
+        grid=(num_c_tiles, num_n_tiles),
+        in_specs=[
+            pl.BlockSpec((TILE_N,), lambda ci, ni: (ni,)),
+            pl.BlockSpec((TILE_N,), lambda ci, ni: (ni,)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_C), lambda ci, ni: (0, ci)),
+        out_shape=jax.ShapeDtypeStruct((1, num_c_tiles * TILE_C), jnp.float32),
+        interpret=interpret,
+    )(x, w)
+    return out.reshape(-1)[:length]
+
+
+def weighted_bincount(
+    x: Array,
+    weights: Array | None = None,
+    length: int = 0,
+    interpret: bool = False,
+    min_pallas_n: int = 1 << 16,
+    max_pallas_length: int = 2048,
+) -> Array:
+    """``zeros(length).at[x].add(weights)`` with a Pallas fast path on TPU.
+
+    The kernel does dense one-hot work (O(N·length)), so it is dispatched only
+    in the regime where that beats XLA's serialized scatter — measured on
+    v5e: 3-6.4x faster for length <= 2048 at N >= 1e5-1e7, slower beyond
+    ~4096 bins. Binned PR-curve states (4·T bins), calibration histograms and
+    small-to-medium confusion matrices all live in the winning regime.
+    Falls back to XLA's scatter-add off-TPU, for small N, or for large bin
+    counts. Returns float32 when weighted, int32 otherwise.
+    """
+    x = jnp.asarray(x).ravel()
+    weighted = weights is not None
+    w = jnp.asarray(weights).ravel() if weighted else jnp.ones(x.shape, dtype=jnp.float32)
+    # axon (the remote-TPU plugin) also registers its backend as "tpu", but
+    # accept both names defensively
+    use_pallas = interpret or (
+        jax.default_backend() in ("tpu", "axon")
+        and x.size >= min_pallas_n
+        and length <= max_pallas_length
+    )
+    if use_pallas:
+        out = _wbincount_pallas(x, w, int(length), interpret=interpret)
+    else:
+        # drop out-of-range indices explicitly to match the kernel: jnp's
+        # scatter wraps negatives numpy-style even under mode="drop"
+        in_range = (x >= 0) & (x < length)
+        out = (
+            jnp.zeros(int(length), dtype=jnp.float32)
+            .at[jnp.where(in_range, x, 0)]
+            .add(jnp.where(in_range, w, 0.0))
+        )
+    return out if weighted else out.astype(jnp.int32)
