@@ -47,9 +47,35 @@ def test_top_level_all_is_valid():
         assert hasattr(tm, name), name
 
 
-def test_classification_namespace_parity():
-    import torchmetrics_tpu.classification as c
+DOMAINS = [
+    "classification",
+    "regression",
+    "image",
+    "audio",
+    "text",
+    "retrieval",
+    "detection",
+    "clustering",
+    "nominal",
+    "multimodal",
+    "wrappers",
+]
 
-    ref = _ref_all("classification/__init__.py")
-    missing = [n for n in ref if not hasattr(c, n)]
-    assert missing == [], f"classification namespace missing: {missing}"
+
+@pytest.mark.parametrize("domain", DOMAINS)
+def test_domain_namespace_parity(domain):
+    """Every name the reference's domain __all__ declares must exist here."""
+    import importlib
+
+    mod = importlib.import_module(f"torchmetrics_tpu.{domain}")
+    ref = _ref_all(f"{domain}/__init__.py")
+    missing = [n for n in ref if not hasattr(mod, n)]
+    assert missing == [], f"{domain} namespace missing: {missing}"
+
+
+def test_top_level_namespace_parity():
+    import torchmetrics_tpu as tm
+
+    ref = _ref_all("__init__.py")
+    missing = [n for n in ref if not hasattr(tm, n)]
+    assert missing == [], f"top-level namespace missing: {missing}"

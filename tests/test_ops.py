@@ -1,14 +1,10 @@
 """Pallas kernel tests (interpret mode — exact kernel logic on the CPU mesh)."""
-import sys
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-sys.path.insert(0, "/root/repo/tests")
-
-from torchmetrics_tpu.ops import weighted_bincount  # noqa: E402
+from torchmetrics_tpu.ops import weighted_bincount, weighted_bincount_multi
 
 rng = np.random.RandomState(33)
 
@@ -49,15 +45,29 @@ class TestWeightedBincount:
         slow = weighted_bincount(jnp.asarray(x), jnp.asarray(w), 64, interpret=False)
         np.testing.assert_allclose(np.asarray(fast), np.asarray(slow), atol=1e-3)
 
-    def test_binned_curve_uses_it_correctly(self):
-        """End-to-end: the binned PR-curve state equals the exact-mode curve counts."""
+    def test_binned_curve_matches_exact_at_thresholds(self):
+        """End-to-end: precision/recall at each binned threshold equal the values
+        computed directly from the data at those thresholds."""
         from torchmetrics_tpu.functional.classification import binary_precision_recall_curve
 
         preds = rng.rand(500).astype(np.float32)
         target = rng.randint(0, 2, 500)
-        p_b, r_b, t_b = binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), thresholds=5)
-        assert bool(jnp.all((p_b >= 0) & (p_b <= 1)))
-        assert bool(jnp.all((r_b >= 0) & (r_b <= 1)))
+        n_thr = 5
+        p_b, r_b, t_b = binary_precision_recall_curve(
+            jnp.asarray(preds), jnp.asarray(target), thresholds=n_thr
+        )
+        thr = np.asarray(t_b)
+        for i, th in enumerate(thr):
+            pred_pos = preds >= th
+            tp = float((pred_pos & (target == 1)).sum())
+            fp = float((pred_pos & (target == 0)).sum())
+            fn = float((~pred_pos & (target == 1)).sum())
+            # _safe_divide semantics: 0 at zero denominator (the (0,1)
+            # curve endpoint is appended separately by compute)
+            exp_p = tp / (tp + fp) if tp + fp else 0.0
+            exp_r = tp / (tp + fn) if tp + fn else 0.0
+            np.testing.assert_allclose(float(p_b[i]), exp_p, atol=1e-6)
+            np.testing.assert_allclose(float(r_b[i]), exp_r, atol=1e-6)
 
 
 class TestBinnedCurveCounts:
@@ -105,3 +115,25 @@ class TestDropSemantics:
         slow = weighted_bincount(x, length=4, interpret=False)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
         np.testing.assert_array_equal(np.asarray(slow), [1, 0, 0, 1])
+
+
+class TestWeightedBincountMulti:
+    def test_vs_numpy(self):
+        x = rng.randint(0, 50, 3000)
+        w = rng.rand(3, 3000).astype(np.float32)
+        out = weighted_bincount_multi(jnp.asarray(x), jnp.asarray(w), 50, interpret=True)
+        ref = np.zeros((3, 50))
+        for k in range(3):
+            np.add.at(ref[k], x, w[k])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    def test_fallback_matches_kernel(self):
+        x = np.concatenate([rng.randint(0, 20, 1000), [-3, 25]])  # incl. out-of-range
+        w = rng.rand(2, 1002).astype(np.float32)
+        fast = weighted_bincount_multi(jnp.asarray(x), jnp.asarray(w), 20, interpret=True)
+        slow = weighted_bincount_multi(jnp.asarray(x), jnp.asarray(w), 20, interpret=False)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(slow), atol=1e-4)
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="weights"):
+            weighted_bincount_multi(jnp.zeros(10, dtype=jnp.int32), jnp.zeros((10,)), 4)

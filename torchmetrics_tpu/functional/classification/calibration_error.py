@@ -27,11 +27,13 @@ def _binning_bucketize(
     """Per-bin mean confidence, mean accuracy and proportion (reference :36-60)."""
     n_bins = bin_boundaries_or_n
     indices = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
-    from torchmetrics_tpu.ops import weighted_bincount
+    from torchmetrics_tpu.ops import weighted_bincount_multi
 
-    count = weighted_bincount(indices, jnp.ones_like(confidences), n_bins)
-    conf = weighted_bincount(indices, confidences, n_bins)
-    acc = weighted_bincount(indices, accuracies.astype(jnp.float32), n_bins)
+    count, conf, acc = weighted_bincount_multi(
+        indices,
+        jnp.stack([jnp.ones_like(confidences), confidences, accuracies.astype(jnp.float32)]),
+        n_bins,
+    )
     prop_bin = count / count.sum()
     return _safe_divide(conf, count), _safe_divide(acc, count), prop_bin
 

@@ -29,6 +29,9 @@ TILE_C = 512  # bins per output tile (multiple of 128 lanes)
 
 
 def _wbincount_kernel(x_ref, w_ref, out_ref):
+    # multi-weight variant: K weight rows share one index stream; the one-hot
+    # tile is built once and contracted against all rows in a single
+    # (K, TILE_N) @ (TILE_N, TILE_C) matmul on the MXU
     ci = pl.program_id(0)
     ni = pl.program_id(1)
 
@@ -37,20 +40,22 @@ def _wbincount_kernel(x_ref, w_ref, out_ref):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     x = x_ref[:].reshape(TILE_N, 1)  # (TILE_N, 1) int32
-    w = w_ref[:].reshape(TILE_N, 1)  # (TILE_N, 1) f32
+    w = w_ref[:]  # (K, TILE_N) f32
     cols = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, TILE_C), 1) + ci * TILE_C
-    onehot = jnp.where(x == cols, w, 0.0)  # (TILE_N, TILE_C)
-    out_ref[:] += onehot.sum(axis=0).reshape(1, TILE_C)
+    onehot = (x == cols).astype(jnp.float32)  # (TILE_N, TILE_C)
+    out_ref[:] += jnp.dot(w, onehot, preferred_element_type=jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("length", "interpret"))
 def _wbincount_pallas(x: Array, weights: Array, length: int, interpret: bool = False) -> Array:
-    n = x.shape[0]
+    """weights (K, N) -> counts (K, length); one index sweep for all K rows."""
+    k, n = weights.shape
     n_pad = -n % TILE_N
     c_pad = -length % TILE_C
+    k_pad = -k % 8  # sublane-aligned weight rows
     # padded indices point outside every bin tile -> dropped
     x = jnp.pad(x.astype(jnp.int32), (0, n_pad), constant_values=-1)
-    w = jnp.pad(weights.astype(jnp.float32), (0, n_pad))
+    w = jnp.pad(weights.astype(jnp.float32), ((0, k_pad), (0, n_pad)))
     num_c_tiles = (length + c_pad) // TILE_C
     num_n_tiles = (n + n_pad) // TILE_N
 
@@ -59,13 +64,13 @@ def _wbincount_pallas(x: Array, weights: Array, length: int, interpret: bool = F
         grid=(num_c_tiles, num_n_tiles),
         in_specs=[
             pl.BlockSpec((TILE_N,), lambda ci, ni: (ni,)),
-            pl.BlockSpec((TILE_N,), lambda ci, ni: (ni,)),
+            pl.BlockSpec((k + k_pad, TILE_N), lambda ci, ni: (0, ni)),
         ],
-        out_specs=pl.BlockSpec((1, TILE_C), lambda ci, ni: (0, ci)),
-        out_shape=jax.ShapeDtypeStruct((1, num_c_tiles * TILE_C), jnp.float32),
+        out_specs=pl.BlockSpec((k + k_pad, TILE_C), lambda ci, ni: (0, ci)),
+        out_shape=jax.ShapeDtypeStruct((k + k_pad, num_c_tiles * TILE_C), jnp.float32),
         interpret=interpret,
     )(x, w)
-    return out.reshape(-1)[:length]
+    return out[:k, :length]
 
 
 def weighted_bincount(
@@ -97,7 +102,7 @@ def weighted_bincount(
         and length <= max_pallas_length
     )
     if use_pallas:
-        out = _wbincount_pallas(x, w, int(length), interpret=interpret)
+        out = _wbincount_pallas(x, w[None, :], int(length), interpret=interpret)[0]
     else:
         # drop out-of-range indices explicitly to match the kernel: jnp's
         # scatter wraps negatives numpy-style even under mode="drop"
@@ -108,3 +113,34 @@ def weighted_bincount(
             .add(jnp.where(in_range, w, 0.0))
         )
     return out if weighted else out.astype(jnp.int32)
+
+
+def weighted_bincount_multi(
+    x: Array,
+    weights: Array,
+    length: int,
+    interpret: bool = False,
+    min_pallas_n: int = 1 << 16,
+    max_pallas_length: int = 2048,
+) -> Array:
+    """K weighted bincounts sharing one index stream: weights (K, N) -> (K, length).
+
+    One VMEM sweep builds each one-hot tile once and contracts it against all
+    K weight rows on the MXU (vs K separate scatter passes) — calibration's
+    count/confidence/accuracy histograms are the canonical K=3 use.
+    """
+    x = jnp.asarray(x).ravel()
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    if weights.ndim != 2 or weights.shape[1] != x.shape[0]:
+        raise ValueError(f"weights must be (K, N={x.shape[0]}), got {weights.shape}")
+    use_pallas = interpret or (
+        jax.default_backend() in ("tpu", "axon")
+        and x.size >= min_pallas_n
+        and length <= max_pallas_length
+    )
+    if use_pallas:
+        return _wbincount_pallas(x, weights, int(length), interpret=interpret)
+    in_range = (x >= 0) & (x < length)
+    xs = jnp.where(in_range, x, 0)
+    ws = jnp.where(in_range[None, :], weights, 0.0)
+    return jnp.zeros((weights.shape[0], int(length)), dtype=jnp.float32).at[:, xs].add(ws)
