@@ -73,6 +73,19 @@ def test_domain_namespace_parity(domain):
     assert missing == [], f"{domain} namespace missing: {missing}"
 
 
+@pytest.mark.parametrize("domain", DOMAINS)
+def test_domain_all_is_valid(domain):
+    """Each domain's own __all__ resolves, has no duplicates, and covers the
+    reference's export list."""
+    import importlib
+
+    mod = importlib.import_module(f"torchmetrics_tpu.{domain}")
+    names = mod.__all__
+    assert len(names) == len(set(names)), f"duplicates in {domain}.__all__"
+    for n in names:
+        assert hasattr(mod, n), f"{domain}.__all__ lists unknown name {n}"
+
+
 def test_top_level_namespace_parity():
     import torchmetrics_tpu as tm
 

@@ -166,7 +166,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference aggregation.py:493): states mean_value+weight."""
+    """Weighted running mean (reference aggregation.py:493): states mean_value+weight.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric
+        >>> m = MeanMetric()
+        >>> m.update(jnp.asarray([1.0, 3.0]))
+        >>> m.update(5.0)
+        >>> float(m.compute())
+        3.0
+    """
 
     full_state_update = False
 

@@ -31,6 +31,17 @@ class MetricCollection:
         prefix / postfix: added to each output key.
         compute_groups: True (auto-detect), False (disable), or explicit list of
             lists of metric names.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection, Accuracy, Precision
+        >>> coll = MetricCollection({
+        ...     "acc": Accuracy(task="binary"),
+        ...     "prec": Precision(task="binary"),
+        ... })
+        >>> coll.update(jnp.asarray([0.9, 0.2, 0.8, 0.4]), jnp.asarray([1, 0, 0, 1]))
+        >>> {k: round(float(v), 4) for k, v in sorted(coll.compute().items())}
+        {'acc': 0.5, 'prec': 0.5}
     """
 
     def __init__(

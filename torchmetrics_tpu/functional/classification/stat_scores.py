@@ -139,7 +139,16 @@ def binary_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Compute tp/fp/tn/fn for binary tasks (reference stat_scores.py:141-214)."""
+    """Compute tp/fp/tn/fn for binary tasks (reference stat_scores.py:141-214).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_stat_scores
+        >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+        >>> target = jnp.asarray([0, 1, 0, 1])
+        >>> [int(v) for v in binary_stat_scores(preds, target)]  # tp fp tn fn sup
+        [1, 1, 1, 1, 2]
+    """
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
         _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)

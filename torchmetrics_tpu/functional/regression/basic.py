@@ -50,6 +50,14 @@ def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Union[int, Ar
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """MSE (or RMSE with ``squared=False``); reference functional/regression/mse.py.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_squared_error
+        >>> round(float(mean_squared_error(jnp.asarray([1., 2., 3.]), jnp.asarray([1., 2., 5.]))), 4)
+        1.3333
+    """
     sum_squared_error, num_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target), num_outputs)
     return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
 

@@ -33,7 +33,13 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 
 
 def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WER = (S + D + I) / N over the reference words (reference wer.py:51-87)."""
+    """WER = (S + D + I) / N over the reference words (reference wer.py:51-87).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_error_rate
+        >>> round(float(word_error_rate(["this is the answer"], ["this was the answer"])), 4)
+        0.25
+    """
     errors, total = _wer_update(preds, target)
     return _wer_compute(errors, total)
 

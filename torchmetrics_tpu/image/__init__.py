@@ -21,3 +21,27 @@ from torchmetrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
 from torchmetrics_tpu.image.lpips import LearnedPerceptualImagePatchSimilarity  # noqa: F401
 from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance  # noqa: F401
 from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength  # noqa: F401
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
+    "PerceptualPathLength",
+    "QualityWithNoReference",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpatialCorrelationCoefficient",
+    "SpatialDistortionIndex",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+    "VisualInformationFidelity",
+]

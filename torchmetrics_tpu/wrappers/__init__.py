@@ -7,3 +7,16 @@ from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper  # noqa: F401
 from torchmetrics_tpu.wrappers.running import Running  # noqa: F401
 from torchmetrics_tpu.wrappers.tracker import MetricTracker  # noqa: F401
 from torchmetrics_tpu.wrappers.feature_share import FeatureShare, NetworkCache  # noqa: F401
+
+__all__ = [
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "FeatureShare",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "NetworkCache",
+    "Running",
+    "WrapperMetric",
+]
