@@ -30,6 +30,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+if "--subbench" in sys.argv:
+    # mesh subbenches must run CPU-only; the env var alone does not reliably
+    # demote the remote-TPU plugin (it can hang when the tunnel is down) —
+    # the config update does
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 
 def _stub_lightning_utilities() -> None:
     """Provide the 4 names the reference imports from lightning_utilities."""
@@ -558,7 +566,36 @@ def _run_in_cpu_subprocess(name: str):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _ensure_backend() -> str:
+    """Probe the accelerator in a subprocess with a timeout; demote to CPU if
+    the remote TPU tunnel is down so the bench always produces its JSON line."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        backend = proc.stdout.strip().splitlines()[-1] if proc.returncode == 0 and proc.stdout.strip() else ""
+    except (subprocess.SubprocessError, OSError):
+        backend = ""
+    if not backend:  # only demote when the probe errored or timed out
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return "cpu (accelerator unavailable)"
+    return backend
+
+
 def main() -> None:
+    backend = _ensure_backend()
     configs = {}
     for name, fn in (
         ("1_accuracy_update", bench_config1),
@@ -583,6 +620,7 @@ def main() -> None:
         "value": primary.get("value"),
         "unit": primary.get("unit", ""),
         "vs_baseline": primary.get("vs_baseline"),
+        "backend": backend,
         "configs": configs,
     }
     print(json.dumps(result))

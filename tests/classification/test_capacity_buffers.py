@@ -1,0 +1,125 @@
+"""Fixed-capacity exact-curve buffers (SURVEY §7 hard part 1b).
+
+``capacity=N`` turns the exact-mode (thresholds=None) curve family's growing
+list states into static (N,) buffers so accumulation is jit/shard_map-
+traceable and syncs via static-shape all_gather.
+"""
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+)
+
+rng = np.random.RandomState(12)
+PREDS = rng.rand(512).astype(np.float32)
+TARGET = rng.randint(0, 2, 512)
+
+
+class TestCapacityBuffers:
+    def test_matches_list_mode(self):
+        m_list = BinaryPrecisionRecallCurve()
+        m_cap = BinaryPrecisionRecallCurve(capacity=1024)
+        for i in range(0, 512, 128):
+            m_list.update(jnp.asarray(PREDS[i : i + 128]), jnp.asarray(TARGET[i : i + 128]))
+            m_cap.update(jnp.asarray(PREDS[i : i + 128]), jnp.asarray(TARGET[i : i + 128]))
+        for a, b in zip(m_list.compute(), m_cap.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    @pytest.mark.parametrize("cls", [BinaryAUROC, BinaryAveragePrecision, BinaryROC])
+    def test_subclasses_inherit_capacity(self, cls):
+        m_cap = cls(capacity=1024)
+        m_ref = cls()
+        m_cap.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        m_ref.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        a, b = m_cap.compute(), m_ref.compute()
+        if isinstance(a, tuple):
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+        else:
+            np.testing.assert_allclose(float(a), float(b), atol=1e-6)
+
+    def test_jit_shard_map_accumulation(self):
+        """Exact-mode update traces under jit + shard_map; cat-synced buffers
+        reproduce the eager full-data curve."""
+        mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+        m = BinaryPrecisionRecallCurve(capacity=64)
+        state0 = m.init_state()
+
+        @jax.jit
+        @partial(
+            jax.shard_map, mesh=mesh, in_specs=(P("batch"), P("batch")), out_specs=P(), check_vma=False
+        )
+        def step(p, t):
+            st = m.functional_update(state0, p, t)
+            return m.functional_sync(st, "batch")
+
+        synced = step(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        assert synced["preds_buffer"].shape == (512,)
+
+        merged = BinaryPrecisionRecallCurve(capacity=512)
+        merged.load_state(synced)
+        merged._update_count = 1
+        ref = BinaryPrecisionRecallCurve()
+        ref.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        for a, b in zip(merged.compute(), ref.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_overflow_warns_and_keeps_first(self):
+        m = BinaryPrecisionRecallCurve(capacity=100)
+        m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m.compute()
+        assert any("overflowed" in str(x.message) for x in w)
+        ref = BinaryPrecisionRecallCurve()
+        ref.update(jnp.asarray(PREDS[:100]), jnp.asarray(TARGET[:100]))
+        for a, b in zip(m.compute(), ref.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_ignore_index_masking(self):
+        t = TARGET.copy()
+        t[:50] = -1
+        m = BinaryPrecisionRecallCurve(capacity=1024, ignore_index=-1)
+        m.update(jnp.asarray(PREDS), jnp.asarray(t))
+        ref = BinaryPrecisionRecallCurve(ignore_index=-1)
+        ref.update(jnp.asarray(PREDS), jnp.asarray(t))
+        for a, b in zip(m.compute(), ref.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_reset_clears_buffers(self):
+        m = BinaryPrecisionRecallCurve(capacity=256)
+        m.update(jnp.asarray(PREDS[:100]), jnp.asarray(TARGET[:100]))
+        m.reset()
+        assert int(m.sample_count) == 0
+        assert not bool(np.asarray(m.valid_buffer).any())
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            BinaryPrecisionRecallCurve(capacity=0)
+
+    def test_invalid_samples_do_not_consume_slots(self):
+        """ignore_index samples are compacted away: the first N VALID samples
+        survive overflow."""
+        t = TARGET.copy()
+        t[:50] = -1  # 50 ignored, 462 valid
+        m = BinaryPrecisionRecallCurve(capacity=462, ignore_index=-1)
+        m.update(jnp.asarray(PREDS), jnp.asarray(t))
+        assert int(m.sample_count) == 462  # counts valid samples only
+        ref = BinaryPrecisionRecallCurve(ignore_index=-1)
+        ref.update(jnp.asarray(PREDS), jnp.asarray(t))
+        for a, b in zip(m.compute(), ref.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_capacity_with_thresholds_raises(self):
+        with pytest.raises(ValueError, match="capacity"):
+            BinaryPrecisionRecallCurve(thresholds=100, capacity=64)

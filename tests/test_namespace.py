@@ -84,6 +84,8 @@ def test_domain_all_is_valid(domain):
     assert len(names) == len(set(names)), f"duplicates in {domain}.__all__"
     for n in names:
         assert hasattr(mod, n), f"{domain}.__all__ lists unknown name {n}"
+    not_exported = [n for n in _ref_all(f"{domain}/__init__.py") if n not in names]
+    assert not_exported == [], f"{domain}.__all__ misses reference names: {not_exported}"
 
 
 def test_top_level_namespace_parity():

@@ -34,10 +34,24 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryPrecisionRecallCurve(Metric):
+    """Exact (``thresholds=None``) or binned binary PR curve.
+
+    ``capacity`` (TPU extension, SURVEY §7 hard part 1b): with
+    ``thresholds=None`` the exact mode normally grows list states on host;
+    passing ``capacity=N`` instead allocates fixed ``(N,)`` sample buffers so
+    the exact-mode ``update`` (and ``functional_update``) is fully jit/
+    shard_map-traceable with static shapes — the first N valid samples are
+    kept, any overflow is dropped with a warning at compute time. Distributed
+    sync concatenates the buffers via ``all_gather`` (the valid mask rides
+    along), exactly like the reference's padded ragged gather but with static
+    shapes.
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -47,18 +61,34 @@ class BinaryPrecisionRecallCurve(Metric):
         thresholds: Thresholds = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if validate_args:
             _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+            if capacity is not None and (not isinstance(capacity, int) or capacity < 1):
+                raise ValueError(f"Argument `capacity` expected to be a positive integer, got {capacity}")
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         thresholds = _adjust_threshold_arg(thresholds)
+        if capacity is not None and thresholds is not None:
+            raise ValueError(
+                "Argument `capacity` only applies to exact mode (`thresholds=None`); the binned mode"
+                " already has constant-memory state."
+            )
+        self.capacity = capacity
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            if self.capacity is not None:
+                n = self.capacity
+                self.add_state("preds_buffer", default=jnp.zeros(n, dtype=jnp.float32), dist_reduce_fx="cat")
+                self.add_state("target_buffer", default=jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="cat")
+                self.add_state("valid_buffer", default=jnp.zeros(n, dtype=bool), dist_reduce_fx="cat")
+                self.add_state("sample_count", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+            else:
+                self.add_state("preds", default=[], dist_reduce_fx="cat")
+                self.add_state("target", default=[], dist_reduce_fx="cat")
         else:
             self.thresholds = thresholds
             self.add_state(
@@ -72,14 +102,42 @@ class BinaryPrecisionRecallCurve(Metric):
             preds, target, None if self.thresholds is None else self.thresholds, self.ignore_index
         )
         if self.thresholds is None:
-            keep = np.asarray(valid)
-            self.preds.append(jnp.asarray(np.asarray(preds)[keep]))
-            self.target.append(jnp.asarray(np.asarray(target)[keep]))
+            if self.capacity is not None:
+                # trace-safe: compact the batch's VALID samples to contiguous
+                # slots at the running offset (invalid/ignored samples consume
+                # nothing); slots beyond capacity fall off via drop-mode
+                # out-of-range indices
+                v = valid.ravel()
+                positions = jnp.where(v, self.sample_count + jnp.cumsum(v) - 1, self.capacity)
+                self.preds_buffer = self.preds_buffer.at[positions].set(
+                    preds.ravel().astype(jnp.float32), mode="drop"
+                )
+                self.target_buffer = self.target_buffer.at[positions].set(
+                    target.ravel().astype(jnp.int32), mode="drop"
+                )
+                self.valid_buffer = self.valid_buffer.at[positions].set(v, mode="drop")
+                self.sample_count = self.sample_count + v.sum().astype(jnp.int32)
+            else:
+                keep = np.asarray(valid)
+                self.preds.append(jnp.asarray(np.asarray(preds)[keep]))
+                self.target.append(jnp.asarray(np.asarray(target)[keep]))
         else:
             self.confmat = self.confmat + _binary_precision_recall_curve_update(preds, target, valid, self.thresholds)
 
     def _curve_state(self) -> Union[Array, Tuple[Array, Array]]:
         if self.thresholds is None:
+            if self.capacity is not None:
+                if int(self.sample_count) > self.preds_buffer.shape[0]:
+                    rank_zero_warn(
+                        f"BinaryPrecisionRecallCurve capacity buffer overflowed: saw {int(self.sample_count)}"
+                        f" valid samples but kept the first {self.preds_buffer.shape[0]}.",
+                        UserWarning,
+                    )
+                keep = np.asarray(self.valid_buffer)
+                return (
+                    jnp.asarray(np.asarray(self.preds_buffer)[keep]),
+                    jnp.asarray(np.asarray(self.target_buffer)[keep]),
+                )
             return dim_zero_cat(self.preds), dim_zero_cat(self.target)
         return self.confmat
 
