@@ -123,3 +123,75 @@ class TestCapacityBuffers:
     def test_capacity_with_thresholds_raises(self):
         with pytest.raises(ValueError, match="capacity"):
             BinaryPrecisionRecallCurve(thresholds=100, capacity=64)
+
+
+class TestRetrievalCapacityBuffers:
+    """The same buffer pattern on RetrievalMetric covers all 12 retrieval metrics."""
+
+    @staticmethod
+    def _data():
+        r = np.random.RandomState(3)
+        return (
+            r.rand(256).astype(np.float32),
+            r.randint(0, 2, 256),
+            r.randint(0, 16, 256),
+        )
+
+    @pytest.mark.parametrize("cls_name", ["RetrievalMAP", "RetrievalMRR", "RetrievalNormalizedDCG", "RetrievalPrecision"])
+    def test_matches_list_mode(self, cls_name):
+        import torchmetrics_tpu.retrieval as R
+
+        cls = getattr(R, cls_name)
+        preds, target, indexes = self._data()
+        m_cap, m_list = cls(capacity=512), cls()
+        for i in range(0, 256, 64):
+            sl = slice(i, i + 64)
+            m_cap.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]), indexes=jnp.asarray(indexes[sl]))
+            m_list.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]), indexes=jnp.asarray(indexes[sl]))
+        np.testing.assert_allclose(float(m_cap.compute()), float(m_list.compute()), atol=1e-6)
+
+    def test_jit_shard_map_accumulation(self):
+        from torchmetrics_tpu.retrieval import RetrievalMAP
+
+        preds, target, indexes = self._data()
+        mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+        m = RetrievalMAP(capacity=32)
+        state0 = m.init_state()
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("batch"),) * 3, out_specs=P(), check_vma=False)
+        def step(p, t, idx):
+            st = m.functional_update(state0, p, t, indexes=idx)
+            return m.functional_sync(st, "batch")
+
+        synced = step(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+        merged = RetrievalMAP(capacity=256)
+        merged.load_state(synced)
+        merged._update_count = 1
+        ref = RetrievalMAP()
+        ref.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        np.testing.assert_allclose(float(merged.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_ignore_index_compaction(self):
+        from torchmetrics_tpu.retrieval import RetrievalMAP
+
+        preds, target, indexes = self._data()
+        t = target.copy()
+        t[:40] = -1
+        m_cap = RetrievalMAP(capacity=216, ignore_index=-1)  # exactly the valid count
+        m_cap.update(jnp.asarray(preds), jnp.asarray(t), indexes=jnp.asarray(indexes))
+        assert int(m_cap.sample_count) == 216
+        ref = RetrievalMAP(ignore_index=-1)
+        ref.update(jnp.asarray(preds), jnp.asarray(t), indexes=jnp.asarray(indexes))
+        np.testing.assert_allclose(float(m_cap.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_overflow_warns(self):
+        from torchmetrics_tpu.retrieval import RetrievalMAP
+
+        preds, target, indexes = self._data()
+        m = RetrievalMAP(capacity=100)
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m.compute()
+        assert any("overflowed" in str(x.message) for x in w)

@@ -33,8 +33,7 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utils.data import dim_zero_cat
-from torchmetrics_tpu.utils.prints import rank_zero_warn
+from torchmetrics_tpu.utils.data import compact_readout, compact_scatter, dim_zero_cat
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
@@ -67,8 +66,9 @@ class BinaryPrecisionRecallCurve(Metric):
         super().__init__(**kwargs)
         if validate_args:
             _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
-            if capacity is not None and (not isinstance(capacity, int) or capacity < 1):
-                raise ValueError(f"Argument `capacity` expected to be a positive integer, got {capacity}")
+        # capacity shapes the state buffers — validate unconditionally
+        if capacity is not None and (not isinstance(capacity, int) or capacity < 1):
+            raise ValueError(f"Argument `capacity` expected to be a positive integer, got {capacity}")
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         thresholds = _adjust_threshold_arg(thresholds)
@@ -103,20 +103,12 @@ class BinaryPrecisionRecallCurve(Metric):
         )
         if self.thresholds is None:
             if self.capacity is not None:
-                # trace-safe: compact the batch's VALID samples to contiguous
-                # slots at the running offset (invalid/ignored samples consume
-                # nothing); slots beyond capacity fall off via drop-mode
-                # out-of-range indices
-                v = valid.ravel()
-                positions = jnp.where(v, self.sample_count + jnp.cumsum(v) - 1, self.capacity)
-                self.preds_buffer = self.preds_buffer.at[positions].set(
-                    preds.ravel().astype(jnp.float32), mode="drop"
+                (self.preds_buffer, self.target_buffer, self.valid_buffer), self.sample_count = compact_scatter(
+                    (self.preds_buffer, self.target_buffer, self.valid_buffer),
+                    (preds, target, valid),
+                    valid,
+                    self.sample_count,
                 )
-                self.target_buffer = self.target_buffer.at[positions].set(
-                    target.ravel().astype(jnp.int32), mode="drop"
-                )
-                self.valid_buffer = self.valid_buffer.at[positions].set(v, mode="drop")
-                self.sample_count = self.sample_count + v.sum().astype(jnp.int32)
             else:
                 keep = np.asarray(valid)
                 self.preds.append(jnp.asarray(np.asarray(preds)[keep]))
@@ -127,17 +119,13 @@ class BinaryPrecisionRecallCurve(Metric):
     def _curve_state(self) -> Union[Array, Tuple[Array, Array]]:
         if self.thresholds is None:
             if self.capacity is not None:
-                if int(self.sample_count) > self.preds_buffer.shape[0]:
-                    rank_zero_warn(
-                        f"BinaryPrecisionRecallCurve capacity buffer overflowed: saw {int(self.sample_count)}"
-                        f" valid samples but kept the first {self.preds_buffer.shape[0]}.",
-                        UserWarning,
-                    )
-                keep = np.asarray(self.valid_buffer)
-                return (
-                    jnp.asarray(np.asarray(self.preds_buffer)[keep]),
-                    jnp.asarray(np.asarray(self.target_buffer)[keep]),
+                p_buf, t_buf = compact_readout(
+                    (self.preds_buffer, self.target_buffer),
+                    self.valid_buffer,
+                    self.sample_count,
+                    type(self).__name__,
                 )
+                return p_buf, t_buf
             return dim_zero_cat(self.preds), dim_zero_cat(self.target)
         return self.confmat
 

@@ -15,7 +15,8 @@ from jax import Array
 
 from torchmetrics_tpu.functional.retrieval._padded import pad_by_query, rank_by_preds
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.checks import _is_concrete
+from torchmetrics_tpu.utils.data import compact_readout, compact_scatter, dim_zero_cat
 
 
 def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable], dim: int = 0) -> Array:
@@ -52,8 +53,13 @@ class RetrievalMetric(Metric, ABC):
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
         aggregation: Union[str, Callable] = "mean",
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
+        """``capacity`` (TPU extension, SURVEY §7 hard part 1b): fixed (N,)
+        sample buffers instead of growing lists, making ``update``/
+        ``functional_update`` jit/shard_map-traceable with static shapes; the
+        first N un-ignored samples are kept, overflow warns at compute."""
         super().__init__(**kwargs)
         empty_target_action_options = ("error", "skip", "neg", "pos")
         if empty_target_action not in empty_target_action_options:
@@ -71,9 +77,19 @@ class RetrievalMetric(Metric, ABC):
             )
         self.aggregation = aggregation
 
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        if capacity is not None and (not isinstance(capacity, int) or capacity < 1):
+            raise ValueError(f"Argument `capacity` expected to be a positive integer, got {capacity}")
+        self.capacity = capacity
+        if capacity is not None:
+            self.add_state("indexes_buffer", default=jnp.zeros(capacity, dtype=jnp.int32), dist_reduce_fx="cat")
+            self.add_state("preds_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat")
+            self.add_state("target_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat")
+            self.add_state("valid_buffer", default=jnp.zeros(capacity, dtype=bool), dist_reduce_fx="cat")
+            self.add_state("sample_count", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("indexes", default=[], dist_reduce_fx=None)
+            self.add_state("preds", default=[], dist_reduce_fx=None)
+            self.add_state("target", default=[], dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
@@ -87,6 +103,29 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError("`indexes` must be a tensor of long integers")
         if not jnp.issubdtype(preds.dtype, jnp.floating):
             raise ValueError("`preds` must be a tensor of floats")
+
+        if self.capacity is not None:
+            # trace-safe path: keep a validity mask instead of boolean indexing
+            valid = (
+                jnp.ones(indexes.size, dtype=bool)
+                if self.ignore_index is None
+                else (target != self.ignore_index).reshape(-1)
+            )
+            if _is_concrete(target):
+                # reference semantics: emptiness judged AFTER ignore_index
+                # filtering (reference utilities/checks.py:573-580)
+                if indexes.size == 0 or not bool(jnp.any(valid)):
+                    raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+                if not self.allow_non_binary_target:
+                    t = target.reshape(-1)
+                    if bool(jnp.any(((t != 0) & (t != 1)) & valid)):
+                        raise ValueError("`target` must contain binary values")
+            bufs = (self.indexes_buffer, self.preds_buffer, self.target_buffer, self.valid_buffer)
+            (
+                (self.indexes_buffer, self.preds_buffer, self.target_buffer, self.valid_buffer),
+                self.sample_count,
+            ) = compact_scatter(bufs, (indexes, preds, target, valid), valid, self.sample_count)
+            return
 
         if self.ignore_index is not None:
             valid = (target != self.ignore_index).reshape(-1)
@@ -105,10 +144,18 @@ class RetrievalMetric(Metric, ABC):
     _empty_target_kind: str = "positive"  # which class being absent makes a query "empty"
 
     def _grouped_state(self):
-        """Concatenate list states and pack into the padded per-query grid."""
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        """Concatenate states and pack into the padded per-query grid."""
+        if self.capacity is not None:
+            indexes, preds, target = compact_readout(
+                (self.indexes_buffer, self.preds_buffer, self.target_buffer),
+                self.valid_buffer,
+                self.sample_count,
+                type(self).__name__,
+            )
+        else:
+            indexes = dim_zero_cat(self.indexes)
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
         return pad_by_query(indexes, preds, target)
 
     def _empty_mask(self, target_pad: Array, counts: Array) -> Array:
