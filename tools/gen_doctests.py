@@ -1,0 +1,709 @@
+"""Doctest-example generator for modular metric classes.
+
+The reference carries an executable ``Example:`` block on every public metric
+(SURVEY §4 doctests). This tool closes that gap mechanically: for each public
+class it builds a small standard input, runs update/compute for real, captures
+the exact output repr, and injects a doctest block into the class docstring.
+Outputs are therefore guaranteed-correct at generation time, and
+``tests/test_doctests.py`` keeps them correct forever after.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/gen_doctests.py --domain classification [--inject]
+
+Without --inject it prints the generated blocks for review.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib
+import pathlib
+import sys
+
+import jax
+import numpy as np
+
+# The JAX_PLATFORMS env var does not demote the axon TPU plugin reliably (it can
+# hang when the tunnel is down); the config update does. Examples must run on CPU.
+jax.config.update("jax_platforms", "cpu")
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+PKG = "torchmetrics_tpu"
+
+# ---------------------------------------------------------------------------
+# standard inputs per task flavour
+# ---------------------------------------------------------------------------
+
+BINARY_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])",
+    "target = jnp.asarray([0, 1, 1, 0])",
+]
+MULTICLASS_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])",
+    "target = jnp.asarray([0, 1, 2, 0])",
+]
+MULTILABEL_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])",
+    "target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])",
+]
+
+# per-class constructor overrides (name -> kwargs source string)
+CTOR: dict[str, str] = {
+    "BinaryRecallAtFixedPrecision": "min_precision=0.5, thresholds=5",
+    "MulticlassRecallAtFixedPrecision": "num_classes=3, min_precision=0.5, thresholds=5",
+    "MultilabelRecallAtFixedPrecision": "num_labels=3, min_precision=0.5, thresholds=5",
+    "BinaryPrecisionAtFixedRecall": "min_recall=0.5, thresholds=5",
+    "MulticlassPrecisionAtFixedRecall": "num_classes=3, min_recall=0.5, thresholds=5",
+    "MultilabelPrecisionAtFixedRecall": "num_labels=3, min_recall=0.5, thresholds=5",
+    "BinarySensitivityAtSpecificity": "min_specificity=0.5, thresholds=5",
+    "MulticlassSensitivityAtSpecificity": "num_classes=3, min_specificity=0.5, thresholds=5",
+    "MultilabelSensitivityAtSpecificity": "num_labels=3, min_specificity=0.5, thresholds=5",
+    "BinarySpecificityAtSensitivity": "min_sensitivity=0.5, thresholds=5",
+    "MulticlassSpecificityAtSensitivity": "num_classes=3, min_sensitivity=0.5, thresholds=5",
+    "MultilabelSpecificityAtSensitivity": "num_labels=3, min_sensitivity=0.5, thresholds=5",
+    "RecallAtFixedPrecision": 'task="binary", min_precision=0.5, thresholds=5',
+    "PrecisionAtFixedRecall": 'task="binary", min_recall=0.5, thresholds=5',
+    "SensitivityAtSpecificity": 'task="binary", min_specificity=0.5, thresholds=5',
+    "SpecificityAtSensitivity": 'task="binary", min_sensitivity=0.5, thresholds=5',
+    "BinaryPrecisionRecallCurve": "thresholds=5",
+    "BinaryROC": "thresholds=5",
+    "MulticlassPrecisionRecallCurve": "num_classes=3, thresholds=5",
+    "MulticlassROC": "num_classes=3, thresholds=5",
+    "MultilabelPrecisionRecallCurve": "num_labels=3, thresholds=5",
+    "MultilabelROC": "num_labels=3, thresholds=5",
+    "PrecisionRecallCurve": 'task="binary", thresholds=5',
+    "ROC": 'task="binary", thresholds=5',
+    "BinaryGroupStatRates": "num_groups=2",
+    "BinaryFairness": "num_groups=2",
+    "Dice": "",
+    "BinaryFBetaScore": "beta=1.0",
+    "MulticlassFBetaScore": "num_classes=3, beta=1.0",
+    "MultilabelFBetaScore": "num_labels=3, beta=1.0",
+    "MinkowskiDistance": "p=3",
+    "CriticalSuccessIndex": "threshold=0.5",
+    "FleissKappa": "",
+    "PerceptualEvaluationSpeechQuality": "fs=8000, mode='nb'",
+    "PermutationInvariantTraining": "scale_invariant_signal_noise_ratio",
+    "ShortTimeObjectiveIntelligibility": "fs=8000",
+    "SpeechReverberationModulationEnergyRatio": "fs=8000",
+    "MultiScaleStructuralSimilarityIndexMeasure": "betas=(0.5, 0.5)",
+}
+
+# classes whose example should use a different flavour's inputs than their name implies
+FLAVOUR_OVERRIDE: dict[str, str] = {
+    "RecallAtFixedPrecision": "binary",
+    "PrecisionAtFixedRecall": "binary",
+    "SensitivityAtSpecificity": "binary",
+    "SpecificityAtSensitivity": "binary",
+    "PrecisionRecallCurve": "binary",
+    "ROC": "binary",
+}
+
+# per-class display-expression overrides
+EXPR_OVERRIDE: dict[str, str] = {
+    "BinaryGroupStatRates": "{k: jnp.round(v, 4).tolist() for k, v in m.compute().items()}",
+    "MulticlassPrecisionRecallCurve": "[tuple(v.shape) for v in m.compute()]",
+    "MultilabelPrecisionRecallCurve": "[tuple(v.shape) for v in m.compute()]",
+    "MulticlassROC": "[tuple(v.shape) for v in m.compute()]",
+    "MultilabelROC": "[tuple(v.shape) for v in m.compute()]",
+}
+# domain defaults: domain -> (setup lines, default ctor kwargs, update args)
+REGRESSION_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])",
+    "target = jnp.asarray([3.0, -0.5, 2.0, 7.0])",
+]
+AUDIO_SETUP = [
+    "import jax.numpy as jnp",
+    "t = jnp.arange(0, 1.0, 1 / 800.0)",
+    "target = jnp.sin(2 * jnp.pi * 100 * t)",
+    "preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)",
+]
+CLUSTERING_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([2, 1, 0, 1, 0])",
+    "target = jnp.asarray([0, 2, 1, 1, 0])",
+]
+NOMINAL_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([0, 1, 2, 2, 1, 0])",
+    "target = jnp.asarray([0, 1, 2, 1, 1, 0])",
+]
+RETRIEVAL_SETUP = [
+    "import jax.numpy as jnp",
+    "indexes = jnp.asarray([0, 0, 0, 1, 1])",
+    "preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])",
+    "target = jnp.asarray([False, False, True, False, True])",
+]
+AGGREGATION_SETUP = [
+    "import jax.numpy as jnp",
+    "values = jnp.asarray([1.0, 2.0, 3.0])",
+]
+IMAGE_SETUP = [
+    "import jax.numpy as jnp",
+    "preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0",
+    "target = preds * 0.75",
+]
+DOMAIN_DEFAULTS: dict[str, tuple[list[str], str, str]] = {
+    "image": (IMAGE_SETUP, "", "preds, target"),
+    "regression": (REGRESSION_SETUP, "", "preds, target"),
+    "audio": (AUDIO_SETUP, "", "preds, target"),
+    "clustering": (CLUSTERING_SETUP, "", "preds, target"),
+    "nominal": (NOMINAL_SETUP, "num_classes=3", "preds, target"),
+    "retrieval": (RETRIEVAL_SETUP, "", "preds, target, indexes=indexes"),
+    "aggregation": (AGGREGATION_SETUP, "", "values"),
+}
+
+# per-class full setup replacement
+SETUP_OVERRIDE_LINES: dict[str, list[str]] = {
+    "CosineSimilarity": [
+        "import jax.numpy as jnp",
+        "preds = jnp.asarray([[1.0, 2.0, 3.0], [0.0, 1.0, 0.5]])",
+        "target = jnp.asarray([[1.0, 2.0, 2.5], [0.0, 1.0, 1.0]])",
+    ],
+    "KLDivergence": [
+        "import jax.numpy as jnp",
+        "p = jnp.asarray([[0.3, 0.3, 0.4]])",
+        "q = jnp.asarray([[0.25, 0.5, 0.25]])",
+    ],
+    "FleissKappa": [
+        "import jax.numpy as jnp",
+        "ratings = jnp.asarray([[2, 1, 0], [1, 2, 0], [0, 1, 2], [3, 0, 0]])",
+    ],
+    "CalinskiHarabaszScore": [
+        "import jax.numpy as jnp",
+        "data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])",
+        "labels = jnp.asarray([0, 0, 1, 1, 2, 2])",
+    ],
+}
+SETUP_OVERRIDE_LINES["DaviesBouldinScore"] = SETUP_OVERRIDE_LINES["CalinskiHarabaszScore"]
+SETUP_OVERRIDE_LINES["DunnIndex"] = SETUP_OVERRIDE_LINES["CalinskiHarabaszScore"]
+SETUP_OVERRIDE_LINES["PermutationInvariantTraining"] = [
+    "import jax.numpy as jnp",
+    "from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio",
+    "t = jnp.arange(0, 0.5, 1 / 800.0)",
+    "target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])[None]",
+    "preds = target[:, ::-1, :] + 0.01 * jnp.cos(2 * jnp.pi * 17 * t)",
+]
+SETUP_OVERRIDE_LINES["SourceAggregatedSignalDistortionRatio"] = [
+    "import jax.numpy as jnp",
+    "t = jnp.arange(0, 0.5, 1 / 800.0)",
+    "target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])",
+    "preds = target + 0.05 * jnp.cos(2 * jnp.pi * 17 * t)",
+]
+SETUP_OVERRIDE_LINES["PeakSignalNoiseRatioWithBlockedEffect"] = [
+    "import jax.numpy as jnp",
+    "preds = (jnp.arange(1 * 1 * 32 * 32).reshape(1, 1, 32, 32) % 255) / 255.0",
+    "target = preds * 0.75",
+]
+SETUP_OVERRIDE_LINES["SpatialDistortionIndex"] = [
+    "import jax.numpy as jnp",
+    "preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0",
+    "target = {'ms': preds[:, :, ::4, ::4] * 0.9, 'pan': preds * 0.95}",
+]
+SETUP_OVERRIDE_LINES["QualityWithNoReference"] = SETUP_OVERRIDE_LINES["SpatialDistortionIndex"]
+SETUP_OVERRIDE_LINES["ComplexScaleInvariantSignalNoiseRatio"] = [
+    "import jax.numpy as jnp",
+    "target = jnp.stack([jnp.cos(jnp.arange(20.0)).reshape(4, 5), jnp.sin(jnp.arange(20.0)).reshape(4, 5)], axis=-1)",
+    "preds = target * 0.9 + 0.01",
+]
+
+# per-class extra update args
+UPDATE_ARGS: dict[str, str] = {
+    "BinaryGroupStatRates": "preds, target, groups",
+    "BinaryFairness": "preds, target, groups",
+    "KLDivergence": "p, q",
+    "FleissKappa": "ratings",
+    "CalinskiHarabaszScore": "data, labels",
+    "DaviesBouldinScore": "data, labels",
+    "DunnIndex": "data, labels",
+    "SpeechReverberationModulationEnergyRatio": "preds",
+    "TotalVariation": "preds",
+}
+# per-class extra setup lines appended after the flavour setup
+EXTRA_SETUP: dict[str, list[str]] = {
+    "BinaryGroupStatRates": ["groups = jnp.asarray([0, 1, 0, 1])"],
+    "BinaryFairness": ["groups = jnp.asarray([0, 1, 0, 1])"],
+}
+# classes to skip (model hooks, abstract, needs custom example)
+SKIP = {
+    "Metric", "CompositionalMetric", "BaseAggregator", "RetrievalMetric",
+}
+
+
+def _flavour(name: str) -> str | None:
+    if name.startswith("Binary"):
+        return "binary"
+    if name.startswith("Multiclass"):
+        return "multiclass"
+    if name.startswith("Multilabel"):
+        return "multilabel"
+    return None
+
+
+def _fmt_value(value, target: str = "m.compute()"):
+    """Pick a display expression + exact expected output for a computed value."""
+    import jax
+
+    if isinstance(value, dict):
+        expr = f"{{k: round(float(v), 4) for k, v in {target}.items()}}"
+    elif isinstance(value, tuple):
+        expr = f"[jnp.round(jnp.asarray(v), 4).tolist() for v in {target}]"
+    elif isinstance(value, (jax.Array, np.ndarray)) and np.asarray(value).ndim == 0:
+        expr = f"round(float({target}), 4)"
+    elif isinstance(value, (jax.Array, np.ndarray)):
+        expr = f"jnp.round({target}, 4).tolist()"
+    elif isinstance(value, float):
+        expr = f"round(float({target}), 4)"
+    else:
+        return None, None
+    return expr, None
+
+
+def build_example(cls_name: str, module_name: str, ctor_kwargs: str, setup: list[str],
+                  update_args: str) -> tuple[list[str], str, str] | None:
+    """Return (code_lines, final_expr, expected_output) or None if it fails."""
+    lines = [f"from {module_name} import {cls_name}"]
+    lines.extend(setup)
+    lines.append(f"m = {cls_name}({ctor_kwargs})")
+    lines.append(f"m.update({update_args})")
+    ns: dict = {}
+    try:
+        for ln in lines:
+            exec(ln, ns)
+        value = ns["m"].compute()
+    except Exception as exc:  # noqa: BLE001
+        print(f"  !! {cls_name}: {type(exc).__name__}: {exc}")
+        return None
+    expr = EXPR_OVERRIDE.get(cls_name)
+    if expr is None:
+        expr, _ = _fmt_value(value)
+    if expr is None:
+        print(f"  !! {cls_name}: unformattable compute type {type(value)}")
+        return None
+    try:
+        expected = repr(eval(expr, ns))
+    except Exception as exc:  # noqa: BLE001
+        print(f"  !! {cls_name}: format expr failed: {exc}")
+        return None
+    if len(expected) > 220:
+        print(f"  !! {cls_name}: output too long ({len(expected)} chars), skipping")
+        return None
+    return lines, expr, expected
+
+
+def make_block(lines: list[str], expr: str, expected: str) -> str:
+    out = ["", "    Example:"]
+    for ln in lines:
+        out.append(f"        >>> {ln}")
+    out.append(f"        >>> {expr}")
+    for part in expected.splitlines():
+        out.append(f"        {part}")
+    return "\n".join(out)
+
+
+def inject(path: pathlib.Path, cls_name: str, block: str, kinds=(ast.ClassDef,)) -> bool:
+    src = path.read_text()
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, kinds) and node.name == cls_name:
+            first = node.body[0]
+            if not (isinstance(first, ast.Expr) and isinstance(first.value, ast.Constant)
+                    and isinstance(first.value.value, str)):
+                # class without a docstring: synthesize one around the example
+                import re as _re
+
+                if cls_name.islower() or "_" in cls_name:
+                    title = cls_name.replace("_", " ")
+                    suffix = "(functional interface)"
+                else:
+                    title = " ".join(_re.findall(r"[A-Z]+(?=[A-Z][a-z])|[A-Z][a-z]+|[A-Z]+|\d+", cls_name))
+                    suffix = "(modular interface, accumulating across updates)"
+                lines = src.splitlines()
+                doc = [f'    """{title} {suffix}.']
+                doc.extend(block.splitlines())
+                doc.append('    """')
+                doc.append("")
+                lines[first.lineno - 1:first.lineno - 1] = doc
+                path.write_text("\n".join(lines) + "\n")
+                return True
+            if ">>>" in first.value.value:
+                return False  # already has an example
+            lines = src.splitlines()
+            end = first.value.end_lineno - 1  # 0-based index of docstring close
+            closing = lines[end]
+            if closing.rstrip().endswith('"""'):
+                body = closing.rstrip()[:-3].rstrip()
+                new_lines = []
+                if body:  # single-line docstring: """text."""
+                    new_lines.append(body)
+                    new_lines.extend(block.splitlines())
+                    new_lines.append('    """')
+                    lines[end:end + 1] = new_lines
+                else:  # closing quotes on their own line
+                    lines[end:end] = block.splitlines()
+                path.write_text("\n".join(lines) + "\n")
+                return True
+    return False
+
+
+def classes_in_module(module_name: str) -> list[str]:
+    mod = importlib.import_module(module_name)
+    path = pathlib.Path(mod.__file__)
+    tree = ast.parse(path.read_text())
+    return [n.name for n in tree.body if isinstance(n, ast.ClassDef) and not n.name.startswith("_")]
+
+
+def run_domain(domain: str, do_inject: bool, only: str | None = None) -> None:
+    pkg_dir = ROOT / PKG / domain
+    files = sorted(pkg_dir.glob("*.py")) if pkg_dir.is_dir() else [ROOT / PKG / f"{domain}.py"]
+    for f in files:
+        if f.name == "__init__.py":
+            continue
+        module_name = f"{PKG}.{domain}.{f.stem}" if pkg_dir.is_dir() else f"{PKG}.{domain}"
+        domain_pkg = f"{PKG}.{domain}" if pkg_dir.is_dir() else PKG
+        public_names = set(getattr(importlib.import_module(domain_pkg), "__all__", []))
+        for cls_name in classes_in_module(module_name):
+            if cls_name in SKIP or (only and cls_name != only):
+                continue
+            import_from = domain_pkg if cls_name in public_names else module_name
+            flavour = FLAVOUR_OVERRIDE.get(cls_name) or _flavour(cls_name)
+            if domain in DOMAIN_DEFAULTS and flavour is None:
+                setup, default_ctor, default_upd = DOMAIN_DEFAULTS[domain]
+            elif flavour == "binary":
+                setup, default_ctor, default_upd = BINARY_SETUP, "", "preds, target"
+            elif flavour == "multiclass":
+                setup, default_ctor, default_upd = MULTICLASS_SETUP, "num_classes=3", "preds, target"
+            elif flavour == "multilabel":
+                setup, default_ctor, default_upd = MULTILABEL_SETUP, "num_labels=3", "preds, target"
+            else:
+                setup, default_ctor, default_upd = MULTICLASS_SETUP, 'task="multiclass", num_classes=3', "preds, target"
+            ctor = CTOR.get(cls_name, default_ctor)
+            setup = SETUP_OVERRIDE_LINES.get(cls_name, setup) + EXTRA_SETUP.get(cls_name, [])
+            upd = UPDATE_ARGS.get(cls_name, default_upd)
+            built = build_example(cls_name, import_from, ctor, setup, upd)
+            if built is None:
+                continue
+            lines, expr, expected = built
+            block = make_block(lines, expr, expected)
+            if do_inject:
+                if inject(f, cls_name, block):
+                    print(f"  ok {cls_name}")
+            else:
+                print(f"--- {cls_name}\n{block}\n")
+
+
+# ---------------------------------------------------------------------------
+# functional-namespace examples
+# ---------------------------------------------------------------------------
+
+TEXT_GEN_SETUP = [
+    'preds = ["the cat sat on the mat"]',
+    'target = [["a cat sat on the mat"]]',
+]
+TEXT_ASR_SETUP = [
+    'preds = ["this is the answer", "hello duck"]',
+    'target = ["this was the answer", "hello world"]',
+]
+FN_DOMAIN_SETUP: dict[str, tuple[list[str], str]] = {
+    "regression": (REGRESSION_SETUP, "preds, target"),
+    "audio": (AUDIO_SETUP, "preds, target"),
+    "clustering": (CLUSTERING_SETUP, "preds, target"),
+    "nominal": (NOMINAL_SETUP, "preds, target, num_classes=3"),
+    "retrieval": (RETRIEVAL_SETUP[:1] + RETRIEVAL_SETUP[2:], "preds, target"),
+    "image": (IMAGE_SETUP, "preds, target"),
+}
+# name-keyed call-argument overrides for functional metrics
+FN_CALL: dict[str, str] = {
+    "binary_fbeta_score": "preds, target, beta=1.0",
+    "multiclass_fbeta_score": "preds, target, beta=1.0, num_classes=3",
+    "multilabel_fbeta_score": "preds, target, beta=1.0, num_labels=3",
+    "fbeta_score": 'preds, target, task="multiclass", num_classes=3, beta=1.0',
+    "binary_fairness": 'preds, target, groups, task="all"',
+    "binary_groups_stat_rates": "preds, target, groups, num_groups=2",
+    "demographic_parity": "preds, groups",
+    "equal_opportunity": "preds, target, groups",
+    "dice": "preds, target",
+    "minkowski_distance": "preds, target, p=3",
+    "critical_success_index": "preds, target, threshold=0.5",
+    "cosine_similarity": "preds, target",
+    "kl_divergence": "p, q",
+    "cramers_v": "preds, target",
+    "tschuprows_t": "preds, target",
+    "pearsons_contingency_coefficient": "preds, target",
+    "theils_u": "preds, target",
+    "cramers_v_matrix": "matrix",
+    "tschuprows_t_matrix": "matrix",
+    "pearsons_contingency_coefficient_matrix": "matrix",
+    "theils_u_matrix": "matrix",
+    "fleiss_kappa": "ratings",
+    "calinski_harabasz_score": "data, labels",
+    "davies_bouldin_score": "data, labels",
+    "dunn_index": "data, labels",
+    "pairwise_cosine_similarity": "x, y",
+    "pairwise_euclidean_distance": "x, y",
+    "pairwise_linear_similarity": "x, y",
+    "pairwise_manhattan_distance": "x, y",
+    "pairwise_minkowski_distance": "x, y, exponent=3",
+    "edit_distance": "preds, target",
+    "perplexity": "probs, target",
+    "squad": "preds, target",
+    "rouge_score": "preds, target",
+    "multiclass_precision_recall_curve": "preds, target, num_classes=3, thresholds=5",
+    "multilabel_precision_recall_curve": "preds, target, num_labels=3, thresholds=5",
+    "multiclass_roc": "preds, target, num_classes=3, thresholds=5",
+    "multilabel_roc": "preds, target, num_labels=3, thresholds=5",
+    "precision_recall_curve": 'preds, target, task="binary", thresholds=5',
+    "roc": 'preds, target, task="binary", thresholds=5',
+    "recall_at_fixed_precision": 'preds, target, task="binary", min_precision=0.5, thresholds=5',
+    "precision_at_fixed_recall": 'preds, target, task="binary", min_recall=0.5, thresholds=5',
+    "sensitivity_at_specificity": 'preds, target, task="binary", min_specificity=0.5, thresholds=5',
+    "specificity_at_sensitivity": 'preds, target, task="binary", min_sensitivity=0.5, thresholds=5',
+    "perceptual_evaluation_speech_quality": "preds, target, fs=8000, mode='nb'",
+    "short_time_objective_intelligibility": "preds, target, fs=8000",
+    "speech_reverberation_modulation_energy_ratio": "preds, fs=8000",
+    "permutation_invariant_training": "preds, target, scale_invariant_signal_noise_ratio",
+    "pit_permutate": "preds, perm",
+    "image_gradients": "img",
+    "total_variation": "preds",
+    "multiscale_structural_similarity_index_measure": "preds, target, betas=(0.5, 0.5)",
+    "spatial_distortion_index": "preds, ms, pan",
+    "quality_with_no_reference": "preds, ms, pan",
+    "panoptic_quality": "preds, target, things={0}, stuffs={1}",
+    "modified_panoptic_quality": "preds, target, things={0}, stuffs={1}",
+    "learned_perceptual_image_patch_similarity":
+        "img1, img2, net=lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3))",
+    "clip_score": "imgs, texts, embedding_fn=embed",
+}
+# name-keyed setup overrides for functional metrics
+_NOMINAL_PAIR = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([0, 1, 2, 2, 1, 0])",
+    "target = jnp.asarray([0, 1, 2, 1, 1, 0])",
+]
+_NOMINAL_MATRIX = [
+    "import jax.numpy as jnp",
+    "matrix = jnp.asarray([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])",
+]
+_PAIRWISE = [
+    "import jax.numpy as jnp",
+    "x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])",
+    "y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])",
+]
+_PANOPTIC = [
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])",
+    "target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])",
+]
+FN_SETUP: dict[str, list[str]] = {
+    "word_error_rate": ["import jax.numpy as jnp"] + TEXT_ASR_SETUP,
+    "char_error_rate": ["import jax.numpy as jnp"] + TEXT_ASR_SETUP,
+    "match_error_rate": ["import jax.numpy as jnp"] + TEXT_ASR_SETUP,
+    "word_information_lost": ["import jax.numpy as jnp"] + TEXT_ASR_SETUP,
+    "word_information_preserved": ["import jax.numpy as jnp"] + TEXT_ASR_SETUP,
+    "edit_distance": ['preds = ["kitten"]', 'target = ["sitting"]'],
+    "perplexity": [
+        "import jax.numpy as jnp",
+        "probs = jnp.full((1, 4, 6), 1 / 6)",
+        "target = jnp.asarray([[0, 1, 2, 3]])",
+    ],
+    "squad": [
+        'preds = [{"prediction_text": "the panda", "id": "1"}]',
+        'target = [{"answers": {"answer_start": [0], "text": ["the panda"]}, "id": "1"}]',
+    ],
+    "binary_fairness": BINARY_SETUP + ["groups = jnp.asarray([0, 1, 0, 1])"],
+    "binary_groups_stat_rates": BINARY_SETUP + ["groups = jnp.asarray([0, 1, 0, 1])"],
+    "demographic_parity": BINARY_SETUP + ["groups = jnp.asarray([0, 1, 0, 1])"],
+    "equal_opportunity": BINARY_SETUP + ["groups = jnp.asarray([0, 1, 0, 1])"],
+    "cosine_similarity": SETUP_OVERRIDE_LINES["CosineSimilarity"],
+    "kl_divergence": SETUP_OVERRIDE_LINES["KLDivergence"],
+    "cramers_v": _NOMINAL_PAIR,
+    "tschuprows_t": _NOMINAL_PAIR,
+    "pearsons_contingency_coefficient": _NOMINAL_PAIR,
+    "theils_u": _NOMINAL_PAIR,
+    "cramers_v_matrix": _NOMINAL_MATRIX,
+    "tschuprows_t_matrix": _NOMINAL_MATRIX,
+    "pearsons_contingency_coefficient_matrix": _NOMINAL_MATRIX,
+    "theils_u_matrix": _NOMINAL_MATRIX,
+    "fleiss_kappa": SETUP_OVERRIDE_LINES["FleissKappa"],
+    "calinski_harabasz_score": SETUP_OVERRIDE_LINES["CalinskiHarabaszScore"],
+    "davies_bouldin_score": SETUP_OVERRIDE_LINES["CalinskiHarabaszScore"],
+    "dunn_index": SETUP_OVERRIDE_LINES["CalinskiHarabaszScore"],
+    "pairwise_cosine_similarity": _PAIRWISE,
+    "pairwise_euclidean_distance": _PAIRWISE,
+    "pairwise_linear_similarity": _PAIRWISE,
+    "pairwise_manhattan_distance": _PAIRWISE,
+    "pairwise_minkowski_distance": _PAIRWISE,
+    "complex_scale_invariant_signal_noise_ratio":
+        SETUP_OVERRIDE_LINES["ComplexScaleInvariantSignalNoiseRatio"],
+    "source_aggregated_signal_distortion_ratio":
+        SETUP_OVERRIDE_LINES["SourceAggregatedSignalDistortionRatio"],
+    "permutation_invariant_training": SETUP_OVERRIDE_LINES["PermutationInvariantTraining"],
+    "pit_permutate": [
+        "import jax.numpy as jnp",
+        "preds = jnp.arange(12.0).reshape(2, 3, 2)",
+        "perm = jnp.asarray([[1, 0, 2], [0, 2, 1]])",
+    ],
+    "perceptual_evaluation_speech_quality": [
+        "import jax.numpy as jnp",
+        "t = jnp.arange(0, 1.0, 1 / 8000.0)",
+        "target = jnp.sin(2 * jnp.pi * 440 * t)",
+        "preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)",
+    ],
+    "image_gradients": [
+        "import jax.numpy as jnp",
+        "img = jnp.arange(1 * 1 * 4 * 4, dtype=jnp.float32).reshape(1, 1, 4, 4)",
+    ],
+    "peak_signal_noise_ratio_with_blocked_effect": SETUP_OVERRIDE_LINES["PeakSignalNoiseRatioWithBlockedEffect"],
+    "visual_information_fidelity": [
+        "import jax.numpy as jnp",
+        "preds = (jnp.arange(1 * 3 * 48 * 48).reshape(1, 3, 48, 48) % 255) / 255.0",
+        "target = preds * 0.75",
+    ],
+    "spatial_distortion_index": [
+        "import jax.numpy as jnp",
+        "preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0",
+        "ms = preds[:, :, ::4, ::4] * 0.9",
+        "pan = preds * 0.95",
+    ],
+    "panoptic_quality": _PANOPTIC,
+    "modified_panoptic_quality": _PANOPTIC,
+    "learned_perceptual_image_patch_similarity": [
+        "import jax.numpy as jnp",
+        "img1 = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0",
+        "img2 = img1 * 0.7",
+    ],
+    "clip_score": [
+        "import jax.numpy as jnp",
+        "def embed(images, texts):",
+        "    img_f = jnp.stack([img.mean(axis=(1, 2)) for img in images])",
+        "    txt_f = jnp.asarray([[len(t), t.count('a'), 1.0] for t in texts], dtype=jnp.float32)",
+        "    return img_f, txt_f",
+        "imgs = (jnp.arange(2 * 3 * 8 * 8).reshape(2, 3, 8, 8) % 255) / 255.0",
+        'texts = ["a photo of a cat", "a photo of a dog"]',
+    ],
+}
+FN_SETUP["quality_with_no_reference"] = FN_SETUP["spatial_distortion_index"]
+FN_SETUP["short_time_objective_intelligibility"] = FN_SETUP["perceptual_evaluation_speech_quality"]
+# per-name display-expression override for functional metrics
+FN_EXPR: dict[str, str] = {
+    "rouge_score": "round(float(result['rouge1_fmeasure']), 4)",
+    "multiclass_precision_recall_curve": "[tuple(v.shape) for v in result]",
+    "multilabel_precision_recall_curve": "[tuple(v.shape) for v in result]",
+    "multiclass_roc": "[tuple(v.shape) for v in result]",
+    "multilabel_roc": "[tuple(v.shape) for v in result]",
+    "precision_recall_curve": "[tuple(v.shape) for v in result]",
+    "roc": "[tuple(v.shape) for v in result]",
+    "image_gradients": "[v.shape for v in result]",
+    "binary_groups_stat_rates": "{k: jnp.round(v, 4).tolist() for k, v in result.items()}",
+}
+for _n in ("recall_at_fixed_precision", "precision_at_fixed_recall", "sensitivity_at_specificity",
+           "specificity_at_sensitivity", "precision_recall_curve", "roc"):
+    FN_SETUP[_n] = BINARY_SETUP
+FN_SKIP: set[str] = {
+    # generator / heavyweight-model hooks: the modular twins carry hook examples
+    "bert_score", "infolm", "perceptual_path_length", "clip_image_quality_assessment",
+}
+
+
+def run_functions(do_inject: bool, only: str | None = None) -> None:
+    import inspect
+
+    F = importlib.import_module(f"{PKG}.functional")
+    for name in F.__all__:
+        if name in FN_SKIP or (only and name != only):
+            continue
+        fn = getattr(F, name)
+        try:
+            mod_file = pathlib.Path(inspect.getsourcefile(fn))
+        except TypeError:
+            print(f"  !! {name}: no source file")
+            continue
+        doc = inspect.getdoc(fn) or ""
+        if ">>>" in doc:
+            continue
+        domain = mod_file.parent.name if mod_file.parent.name != "functional" else ""
+        if name.startswith("binary_"):
+            setup, call = BINARY_SETUP, "preds, target"
+        elif name.startswith("multiclass_"):
+            setup, call = MULTICLASS_SETUP, "preds, target, num_classes=3"
+        elif name.startswith("multilabel_"):
+            setup, call = MULTILABEL_SETUP, "preds, target, num_labels=3"
+        elif domain == "classification":
+            setup, call = MULTICLASS_SETUP, 'preds, target, task="multiclass", num_classes=3'
+        elif domain == "text":
+            setup, call = ["import jax.numpy as jnp"] + TEXT_GEN_SETUP, "preds, target"
+        elif domain in FN_DOMAIN_SETUP:
+            setup, call = FN_DOMAIN_SETUP[domain]
+        else:
+            setup, call = MULTICLASS_SETUP, "preds, target"
+        setup = FN_SETUP.get(name, setup)
+        call = FN_CALL.get(name, call)
+        lines = [f"from {PKG}.functional import {name}"] + list(setup)
+        lines.append(f"result = {name}({call})")
+        ns: dict = {}
+        try:
+            exec("\n".join(lines), ns)
+            value = ns["result"]
+        except Exception as exc:  # noqa: BLE001
+            print(f"  !! {name}: {type(exc).__name__}: {str(exc)[:140]}")
+            continue
+        expr = FN_EXPR.get(name)
+        if expr is None:
+            expr, _ = _fmt_value(value, "result")
+        if expr is None:
+            print(f"  !! {name}: unformattable type {type(value)}")
+            continue
+        if "jnp.round" in expr and "import jax.numpy" not in "\n".join(lines):
+            lines.insert(1, "import jax.numpy as jnp")
+        try:
+            expected = repr(eval(expr, ns))
+        except Exception as exc:  # noqa: BLE001
+            print(f"  !! {name}: format failed: {exc}")
+            continue
+        if len(expected) > 240:
+            print(f"  !! {name}: output too long ({len(expected)})")
+            continue
+        # drop the plain assignment; show the expression form directly
+        body = lines[:-1] + [f"result = {name}({call})"]
+        block = make_block(body, expr, expected)
+        if do_inject:
+            if inject(mod_file, fn.__name__, block, kinds=(ast.FunctionDef,)):
+                print(f"  ok {name}")
+            else:
+                # factory-generated function with no def site: attach the example
+                # as a module-level __doc__ assignment (doctest still collects it)
+                src = mod_file.read_text()
+                if f"\n{name}.__doc__" in src:
+                    continue
+                title = name.replace("_", " ")
+                addition = (
+                    f'\n{name}.__doc__ = """{title} (functional interface).\n'
+                    + block + '\n"""\n'
+                )
+                mod_file.write_text(src + addition)
+                print(f"  ok {name} (via __doc__ assignment)")
+        else:
+            print(f"--- {name}\n{block}\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--domain")
+    ap.add_argument("--functions", action="store_true")
+    ap.add_argument("--inject", action="store_true")
+    ap.add_argument("--only")
+    args = ap.parse_args()
+    if args.functions:
+        run_functions(args.inject, args.only)
+    else:
+        run_domain(args.domain, args.inject, args.only)
+
+
+if __name__ == "__main__":
+    main()

@@ -101,7 +101,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max aggregation (reference aggregation.py:114)."""
+    """Running max aggregation (reference aggregation.py:114).
+
+    Example:
+        >>> from torchmetrics_tpu import MaxMetric
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = MaxMetric()
+        >>> m.update(values)
+        >>> round(float(m.compute()), 4)
+        3.0
+    """
 
     full_state_update = True
     higher_is_better = True
@@ -118,7 +128,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min aggregation (reference aggregation.py:219)."""
+    """Running min aggregation (reference aggregation.py:219).
+
+    Example:
+        >>> from torchmetrics_tpu import MinMetric
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = MinMetric()
+        >>> m.update(values)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     full_state_update = True
     higher_is_better = False
@@ -135,7 +155,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum aggregation (reference aggregation.py:324)."""
+    """Running sum aggregation (reference aggregation.py:324).
+
+    Example:
+        >>> from torchmetrics_tpu import SumMetric
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = SumMetric()
+        >>> m.update(values)
+        >>> round(float(m.compute()), 4)
+        6.0
+    """
 
     full_state_update = False
     higher_is_better = None
@@ -149,7 +179,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenation aggregation (reference aggregation.py:429)."""
+    """Concatenation aggregation (reference aggregation.py:429).
+
+    Example:
+        >>> from torchmetrics_tpu import CatMetric
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = CatMetric()
+        >>> m.update(values)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [1.0, 2.0, 3.0]
+    """
 
     full_state_update = False
 
@@ -206,6 +246,15 @@ class RunningMean(Metric):
 
     Implemented directly (rather than through the Running wrapper) as a
     fixed-capacity ring buffer — static shapes, jit-native.
+
+    Example:
+        >>> from torchmetrics_tpu import RunningMean
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = RunningMean()
+        >>> m.update(values)
+        >>> round(float(m.compute()), 4)
+        2.0
     """
 
     full_state_update = False
@@ -245,7 +294,17 @@ class RunningMean(Metric):
 
 
 class RunningSum(RunningMean):
-    """Sum over the last ``window`` updates (reference aggregation.py:673)."""
+    """Sum over the last ``window`` updates (reference aggregation.py:673).
+
+    Example:
+        >>> from torchmetrics_tpu import RunningSum
+        >>> import jax.numpy as jnp
+        >>> values = jnp.asarray([1.0, 2.0, 3.0])
+        >>> m = RunningSum()
+        >>> m.update(values)
+        >>> round(float(m.compute()), 4)
+        6.0
+    """
 
     def update(self, value: Union[float, Array]) -> None:
         value = self._nan_filter(value).sum()

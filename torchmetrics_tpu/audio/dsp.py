@@ -23,7 +23,19 @@ from torchmetrics_tpu.metric import Metric
 
 
 class PerceptualEvaluationSpeechQuality(Metric):
-    """PESQ MOS-LQO averaged over all signals seen (reference audio/pesq.py:29-173)."""
+    """PESQ MOS-LQO averaged over all signals seen (reference audio/pesq.py:29-173).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 8000.0)
+        >>> target = jnp.sin(2 * jnp.pi * 440 * t)
+        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
+        >>> m = PerceptualEvaluationSpeechQuality(fs=8000, mode='nb')
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        4.4638
+    """
 
     sum_pesq: Array
     total: Array
@@ -68,7 +80,19 @@ class PerceptualEvaluationSpeechQuality(Metric):
 
 
 class ShortTimeObjectiveIntelligibility(Metric):
-    """STOI averaged over all signals seen (reference audio/stoi.py:30-160)."""
+    """STOI averaged over all signals seen (reference audio/stoi.py:30-160).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 8000.0)
+        >>> target = jnp.sin(2 * jnp.pi * 440 * t)
+        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
+        >>> m = ShortTimeObjectiveIntelligibility(fs=8000)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.4694
+    """
 
     sum_stoi: Array
     total: Array
@@ -102,7 +126,19 @@ class ShortTimeObjectiveIntelligibility(Metric):
 
 
 class SpeechReverberationModulationEnergyRatio(Metric):
-    """SRMR averaged over all signals seen (reference audio/srmr.py:33-187)."""
+    """SRMR averaged over all signals seen (reference audio/srmr.py:33-187).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = SpeechReverberationModulationEnergyRatio(fs=8000)
+        >>> m.update(preds)
+        >>> round(float(m.compute()), 4)
+        67.7385
+    """
 
     msum: Array
     total: Array

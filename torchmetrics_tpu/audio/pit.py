@@ -11,7 +11,20 @@ from torchmetrics_tpu.metric import Metric
 
 
 class PermutationInvariantTraining(Metric):
-    """Mean of the best-permutation metric value over all samples seen."""
+    """Mean of the best-permutation metric value over all samples seen.
+
+    Example:
+        >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> t = jnp.arange(0, 0.5, 1 / 800.0)
+        >>> target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])[None]
+        >>> preds = target[:, ::-1, :] + 0.01 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        40.0014
+    """
 
     full_state_update = False
     is_differentiable = True

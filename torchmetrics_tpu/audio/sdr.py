@@ -15,6 +15,20 @@ from torchmetrics_tpu.metric import Metric
 
 
 class SignalDistortionRatio(Metric):
+    """Signal Distortion Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = SignalDistortionRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        21.6639
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -49,6 +63,20 @@ class SignalDistortionRatio(Metric):
 
 
 class ScaleInvariantSignalDistortionRatio(Metric):
+    """Scale Invariant Signal Distortion Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = ScaleInvariantSignalDistortionRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        20.0
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -71,6 +99,20 @@ class ScaleInvariantSignalDistortionRatio(Metric):
 
 
 class SourceAggregatedSignalDistortionRatio(Metric):
+    """Source Aggregated Signal Distortion Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 0.5, 1 / 800.0)
+        >>> target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])
+        >>> preds = target + 0.05 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = SourceAggregatedSignalDistortionRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        26.0254
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

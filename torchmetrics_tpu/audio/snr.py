@@ -15,6 +15,20 @@ from torchmetrics_tpu.metric import Metric
 
 
 class SignalNoiseRatio(Metric):
+    """Signal Noise Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = SignalNoiseRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        20.0
+    """
+
     full_state_update = False
     is_differentiable = True
     higher_is_better = True
@@ -35,6 +49,20 @@ class SignalNoiseRatio(Metric):
 
 
 class ScaleInvariantSignalNoiseRatio(Metric):
+    """Scale Invariant Signal Noise Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> m = ScaleInvariantSignalNoiseRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        20.0
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -56,6 +84,19 @@ class ScaleInvariantSignalNoiseRatio(Metric):
 
 
 class ComplexScaleInvariantSignalNoiseRatio(Metric):
+    """Complex Scale Invariant Signal Noise Ratio (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio
+        >>> import jax.numpy as jnp
+        >>> target = jnp.stack([jnp.cos(jnp.arange(20.0)).reshape(4, 5), jnp.sin(jnp.arange(20.0)).reshape(4, 5)], axis=-1)
+        >>> preds = target * 0.9 + 0.01
+        >>> m = ComplexScaleInvariantSignalNoiseRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        36.0883
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

@@ -17,7 +17,18 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryAccuracy(BinaryStatScores):
-    """Binary accuracy (reference classification/accuracy.py BinaryAccuracy)."""
+    """Binary accuracy (reference classification/accuracy.py BinaryAccuracy).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryAccuracy()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -31,7 +42,18 @@ class BinaryAccuracy(BinaryStatScores):
 
 
 class MulticlassAccuracy(MulticlassStatScores):
-    """Multiclass accuracy."""
+    """Multiclass accuracy.
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassAccuracy(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.8333
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -48,7 +70,18 @@ class MulticlassAccuracy(MulticlassStatScores):
 
 
 class MultilabelAccuracy(MultilabelStatScores):
-    """Multilabel accuracy."""
+    """Multilabel accuracy.
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelAccuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelAccuracy(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -65,7 +98,18 @@ class MultilabelAccuracy(MultilabelStatScores):
 
 
 class Accuracy(_ClassificationTaskWrapper):
-    """Task-dispatching Accuracy (reference classification/accuracy.py Accuracy)."""
+    """Task-dispatching Accuracy (reference classification/accuracy.py Accuracy).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import Accuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = Accuracy(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -23,6 +23,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Binary AUROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryAUROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryAUROC()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
+
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound: float = 0.0
@@ -46,6 +59,19 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """Multiclass AUROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassAUROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassAUROC(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound: float = 0.0
@@ -83,6 +109,19 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    """Multilabel AUROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelAUROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelAUROC(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound: float = 0.0
@@ -134,6 +173,19 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC(_ClassificationTaskWrapper):
+    """AUROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import AUROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = AUROC(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

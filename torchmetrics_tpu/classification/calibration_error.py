@@ -24,6 +24,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
 class BinaryCalibrationError(Metric):
+    """Binary Calibration Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryCalibrationError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.425
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False
@@ -70,6 +83,19 @@ class BinaryCalibrationError(Metric):
 
 
 class MulticlassCalibrationError(Metric):
+    """Multiclass Calibration Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassCalibrationError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassCalibrationError(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.325
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False
@@ -116,6 +142,19 @@ class MulticlassCalibrationError(Metric):
 
 
 class CalibrationError(_ClassificationTaskWrapper):
+    """Calibration Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import CalibrationError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = CalibrationError(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.325
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

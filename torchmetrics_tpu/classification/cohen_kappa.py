@@ -13,6 +13,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
 class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Binary Cohen Kappa (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryCohenKappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryCohenKappa()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -37,6 +50,19 @@ class BinaryCohenKappa(BinaryConfusionMatrix):
 
 
 class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Multiclass Cohen Kappa (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassCohenKappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassCohenKappa(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6364
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -61,6 +87,19 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa(_ClassificationTaskWrapper):
+    """Cohen Kappa (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import CohenKappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = CohenKappa(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6364
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

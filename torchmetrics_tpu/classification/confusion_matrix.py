@@ -29,6 +29,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryConfusionMatrix(Metric):
+    """Binary Confusion Matrix (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryConfusionMatrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryConfusionMatrix()
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [[1, 1], [1, 1]]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -67,6 +80,19 @@ class BinaryConfusionMatrix(Metric):
 
 
 class MulticlassConfusionMatrix(Metric):
+    """Multiclass Confusion Matrix (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassConfusionMatrix(num_classes=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -105,6 +131,19 @@ class MulticlassConfusionMatrix(Metric):
 
 
 class MultilabelConfusionMatrix(Metric):
+    """Multilabel Confusion Matrix (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelConfusionMatrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelConfusionMatrix(num_labels=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [[[2, 0], [0, 1]], [[1, 0], [0, 2]], [[1, 0], [0, 2]]]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -141,6 +180,19 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):
+    """Confusion Matrix (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import ConfusionMatrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = ConfusionMatrix(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

@@ -12,7 +12,18 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class Dice(Metric):
-    """Accumulating Dice score over per-class (or single-column) stat scores."""
+    """Accumulating Dice score over per-class (or single-column) stat scores.
+
+    Example:
+        >>> from torchmetrics_tpu.classification import Dice
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = Dice()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

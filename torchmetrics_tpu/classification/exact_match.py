@@ -26,6 +26,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTaskNoBinary
 
 
 class MulticlassExactMatch(Metric):
+    """Multiclass Exact Match (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassExactMatch
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassExactMatch(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -76,6 +89,19 @@ class MulticlassExactMatch(Metric):
 
 
 class MultilabelExactMatch(Metric):
+    """Multilabel Exact Match (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelExactMatch
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelExactMatch(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -131,6 +157,19 @@ class MultilabelExactMatch(Metric):
 
 
 class ExactMatch(_ClassificationTaskWrapper):
+    """Exact Match (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import ExactMatch
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = ExactMatch(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

@@ -17,6 +17,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryFBetaScore(BinaryStatScores):
+    """Binary F Beta Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryFBetaScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryFBetaScore(beta=1.0)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -50,6 +63,19 @@ class BinaryFBetaScore(BinaryStatScores):
 
 
 class MulticlassFBetaScore(MulticlassStatScores):
+    """Multiclass F Beta Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassFBetaScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassFBetaScore(num_classes=3, beta=1.0)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.7778
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -90,6 +116,19 @@ class MulticlassFBetaScore(MulticlassStatScores):
 
 
 class MultilabelFBetaScore(MultilabelStatScores):
+    """Multilabel F Beta Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelFBetaScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelFBetaScore(num_labels=3, beta=1.0)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -130,6 +169,19 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
+    """Binary F 1 Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryF1Score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryF1Score()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -149,6 +201,19 @@ class BinaryF1Score(BinaryFBetaScore):
 
 
 class MulticlassF1Score(MulticlassFBetaScore):
+    """Multiclass F 1 Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassF1Score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassF1Score(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.7778
+    """
+
     def __init__(
         self,
         num_classes: int,
@@ -172,6 +237,19 @@ class MulticlassF1Score(MulticlassFBetaScore):
 
 
 class MultilabelF1Score(MultilabelFBetaScore):
+    """Multilabel F 1 Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelF1Score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelF1Score(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     def __init__(
         self,
         num_labels: int,
@@ -195,6 +273,19 @@ class MultilabelF1Score(MultilabelFBetaScore):
 
 
 class FBetaScore(_ClassificationTaskWrapper):
+    """F Beta Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import FBetaScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = FBetaScore(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,
@@ -232,6 +323,19 @@ class FBetaScore(_ClassificationTaskWrapper):
 
 
 class F1Score(_ClassificationTaskWrapper):
+    """F 1 Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import F1Score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = F1Score(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.75
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

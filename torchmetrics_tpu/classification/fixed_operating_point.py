@@ -165,7 +165,18 @@ class BinaryRecallAtFixedPrecision(_BinaryFixedBase):
 
 
 class MulticlassRecallAtFixedPrecision(_MulticlassFixedBase):
-    """Per-class recall@precision (reference classification/recall_fixed_precision.py:178)."""
+    """Per-class recall@precision (reference classification/recall_fixed_precision.py:178).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassRecallAtFixedPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassRecallAtFixedPrecision(num_classes=3, min_precision=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.25, 0.75, 0.5]]
+    """
 
     _family = "recall_at_precision"
     _min_arg_name = "min_precision"
@@ -175,7 +186,18 @@ class MulticlassRecallAtFixedPrecision(_MulticlassFixedBase):
 
 
 class MultilabelRecallAtFixedPrecision(_MultilabelFixedBase):
-    """Per-label recall@precision (reference classification/recall_fixed_precision.py:325)."""
+    """Per-label recall@precision (reference classification/recall_fixed_precision.py:325).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelRecallAtFixedPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.75, 0.5, 0.5]]
+    """
 
     _family = "recall_at_precision"
     _min_arg_name = "min_precision"
@@ -186,7 +208,18 @@ class MultilabelRecallAtFixedPrecision(_MultilabelFixedBase):
 
 class BinaryPrecisionAtFixedRecall(_BinaryFixedBase):
     """Highest precision with recall >= ``min_recall`` (reference
-    classification/precision_fixed_recall.py:48)."""
+    classification/precision_fixed_recall.py:48).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryPrecisionAtFixedRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryPrecisionAtFixedRecall(min_recall=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.75]
+    """
 
     _family = "precision_at_recall"
     _min_arg_name = "min_recall"
@@ -196,7 +229,18 @@ class BinaryPrecisionAtFixedRecall(_BinaryFixedBase):
 
 
 class MulticlassPrecisionAtFixedRecall(_MulticlassFixedBase):
-    """Per-class precision@recall (reference classification/precision_fixed_recall.py:181)."""
+    """Per-class precision@recall (reference classification/precision_fixed_recall.py:181).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassPrecisionAtFixedRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassPrecisionAtFixedRecall(num_classes=3, min_recall=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.25, 0.75, 0.5]]
+    """
 
     _family = "precision_at_recall"
     _min_arg_name = "min_recall"
@@ -206,7 +250,18 @@ class MulticlassPrecisionAtFixedRecall(_MulticlassFixedBase):
 
 
 class MultilabelPrecisionAtFixedRecall(_MultilabelFixedBase):
-    """Per-label precision@recall (reference classification/precision_fixed_recall.py:326)."""
+    """Per-label precision@recall (reference classification/precision_fixed_recall.py:326).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelPrecisionAtFixedRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelPrecisionAtFixedRecall(num_labels=3, min_recall=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.75, 0.5, 0.5]]
+    """
 
     _family = "precision_at_recall"
     _min_arg_name = "min_recall"
@@ -217,7 +272,18 @@ class MultilabelPrecisionAtFixedRecall(_MultilabelFixedBase):
 
 class BinarySensitivityAtSpecificity(_BinaryFixedBase):
     """Highest sensitivity with specificity >= ``min_specificity`` (reference
-    classification/sensitivity_specificity.py:42)."""
+    classification/sensitivity_specificity.py:42).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinarySensitivityAtSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinarySensitivityAtSpecificity(min_specificity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.25]
+    """
 
     _family = "sensitivity_at_specificity"
     _min_arg_name = "min_specificity"
@@ -227,7 +293,18 @@ class BinarySensitivityAtSpecificity(_BinaryFixedBase):
 
 
 class MulticlassSensitivityAtSpecificity(_MulticlassFixedBase):
-    """Per-class sensitivity@specificity (reference classification/sensitivity_specificity.py:146)."""
+    """Per-class sensitivity@specificity (reference classification/sensitivity_specificity.py:146).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassSensitivityAtSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassSensitivityAtSpecificity(num_classes=3, min_specificity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.25, 0.75, 0.5]]
+    """
 
     _family = "sensitivity_at_specificity"
     _min_arg_name = "min_specificity"
@@ -237,7 +314,18 @@ class MulticlassSensitivityAtSpecificity(_MulticlassFixedBase):
 
 
 class MultilabelSensitivityAtSpecificity(_MultilabelFixedBase):
-    """Per-label sensitivity@specificity (reference classification/sensitivity_specificity.py:240)."""
+    """Per-label sensitivity@specificity (reference classification/sensitivity_specificity.py:240).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelSensitivityAtSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelSensitivityAtSpecificity(num_labels=3, min_specificity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.75, 0.5, 0.5]]
+    """
 
     _family = "sensitivity_at_specificity"
     _min_arg_name = "min_specificity"
@@ -248,7 +336,18 @@ class MultilabelSensitivityAtSpecificity(_MultilabelFixedBase):
 
 class BinarySpecificityAtSensitivity(_BinaryFixedBase):
     """Highest specificity with sensitivity >= ``min_sensitivity`` (reference
-    classification/specificity_sensitivity.py:42)."""
+    classification/specificity_sensitivity.py:42).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinarySpecificityAtSensitivity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinarySpecificityAtSensitivity(min_sensitivity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.75]
+    """
 
     _family = "specificity_at_sensitivity"
     _min_arg_name = "min_sensitivity"
@@ -258,7 +357,18 @@ class BinarySpecificityAtSensitivity(_BinaryFixedBase):
 
 
 class MulticlassSpecificityAtSensitivity(_MulticlassFixedBase):
-    """Per-class specificity@sensitivity (reference classification/specificity_sensitivity.py:146)."""
+    """Per-class specificity@sensitivity (reference classification/specificity_sensitivity.py:146).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassSpecificityAtSensitivity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassSpecificityAtSensitivity(num_classes=3, min_sensitivity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.5, 0.75, 0.5]]
+    """
 
     _family = "specificity_at_sensitivity"
     _min_arg_name = "min_sensitivity"
@@ -268,7 +378,18 @@ class MulticlassSpecificityAtSensitivity(_MulticlassFixedBase):
 
 
 class MultilabelSpecificityAtSensitivity(_MultilabelFixedBase):
-    """Per-label specificity@sensitivity (reference classification/specificity_sensitivity.py:240)."""
+    """Per-label specificity@sensitivity (reference classification/specificity_sensitivity.py:240).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelSpecificityAtSensitivity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelSpecificityAtSensitivity(num_labels=3, min_sensitivity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 1.0, 1.0], [0.75, 0.5, 0.75]]
+    """
 
     _family = "specificity_at_sensitivity"
     _min_arg_name = "min_sensitivity"
@@ -278,7 +399,18 @@ class MultilabelSpecificityAtSensitivity(_MultilabelFixedBase):
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):
-    """Task dispatcher (reference classification/recall_fixed_precision.py:471)."""
+    """Task dispatcher (reference classification/recall_fixed_precision.py:471).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import RecallAtFixedPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = RecallAtFixedPrecision(task="binary", min_precision=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.25]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -310,7 +442,18 @@ class RecallAtFixedPrecision(_ClassificationTaskWrapper):
 
 
 class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
-    """Task dispatcher (reference classification/precision_fixed_recall.py:472)."""
+    """Task dispatcher (reference classification/precision_fixed_recall.py:472).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import PrecisionAtFixedRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = PrecisionAtFixedRecall(task="binary", min_recall=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.75]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -342,7 +485,18 @@ class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
 
 
 class SensitivityAtSpecificity(_ClassificationTaskWrapper):
-    """Task dispatcher (reference classification/sensitivity_specificity.py:333)."""
+    """Task dispatcher (reference classification/sensitivity_specificity.py:333).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import SensitivityAtSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = SensitivityAtSpecificity(task="binary", min_specificity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.25]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -374,7 +528,18 @@ class SensitivityAtSpecificity(_ClassificationTaskWrapper):
 
 
 class SpecificityAtSensitivity(_ClassificationTaskWrapper):
-    """Task dispatcher (reference classification/specificity_sensitivity.py:333)."""
+    """Task dispatcher (reference classification/specificity_sensitivity.py:333).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import SpecificityAtSensitivity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = SpecificityAtSensitivity(task="binary", min_sensitivity=0.5, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 0.75]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

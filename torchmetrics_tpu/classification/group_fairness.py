@@ -33,7 +33,19 @@ class _AbstractGroupStatScores(Metric):
 
 
 class BinaryGroupStatRates(_AbstractGroupStatScores):
-    """tp/fp/tn/fn rates per group (reference classification/group_fairness.py:59-155)."""
+    """tp/fp/tn/fn rates per group (reference classification/group_fairness.py:59-155).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryGroupStatRates
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> m = BinaryGroupStatRates(num_groups=2)
+        >>> m.update(preds, target, groups)
+        >>> {k: jnp.round(v, 4).tolist() for k, v in m.compute().items()}
+        {'group_0': [0.0, 0.0, 0.5, 0.5], 'group_1': [0.5, 0.5, 0.0, 0.0]}
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -68,7 +80,19 @@ class BinaryGroupStatRates(_AbstractGroupStatScores):
 
 
 class BinaryFairness(_AbstractGroupStatScores):
-    """Demographic parity / equal opportunity ratios (reference classification/group_fairness.py:157-300)."""
+    """Demographic parity / equal opportunity ratios (reference classification/group_fairness.py:157-300).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryFairness
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> m = BinaryFairness(num_groups=2)
+        >>> m.update(preds, target, groups)
+        >>> {k: round(float(v), 4) for k, v in m.compute().items()}
+        {'DP_0_1': 0.0, 'EO_0_1': 0.0}
+    """
 
     is_differentiable = False
     higher_is_better = False

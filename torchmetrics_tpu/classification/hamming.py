@@ -13,6 +13,19 @@ from torchmetrics_tpu.functional.classification.hamming import _hamming_distance
 
 
 class BinaryHammingDistance(BinaryStatScores):
+    """Binary Hamming Distance (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryHammingDistance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryHammingDistance()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False
@@ -25,6 +38,19 @@ class BinaryHammingDistance(BinaryStatScores):
 
 
 class MulticlassHammingDistance(MulticlassStatScores):
+    """Multiclass Hamming Distance (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassHammingDistance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassHammingDistance(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.1667
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False
@@ -40,6 +66,19 @@ class MulticlassHammingDistance(MulticlassStatScores):
 
 
 class MultilabelHammingDistance(MultilabelStatScores):
+    """Multilabel Hamming Distance (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelHammingDistance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelHammingDistance(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False

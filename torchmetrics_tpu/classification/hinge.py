@@ -18,6 +18,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
 class BinaryHingeLoss(Metric):
+    """Binary Hinge Loss (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryHingeLoss()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.925
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update: bool = False
@@ -59,6 +72,19 @@ class BinaryHingeLoss(Metric):
 
 
 class MulticlassHingeLoss(Metric):
+    """Multiclass Hinge Loss (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassHingeLoss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassHingeLoss(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.625
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update: bool = False
@@ -115,6 +141,19 @@ class MulticlassHingeLoss(Metric):
 
 
 class HingeLoss(_ClassificationTaskWrapper):
+    """Hinge Loss (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import HingeLoss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = HingeLoss(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.625
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

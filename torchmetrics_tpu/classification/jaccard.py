@@ -17,6 +17,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryJaccardIndex(BinaryStatScores):
+    """Binary Jaccard Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryJaccardIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryJaccardIndex()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.3333
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -32,6 +45,19 @@ class BinaryJaccardIndex(BinaryStatScores):
 
 
 class MulticlassJaccardIndex(MulticlassStatScores):
+    """Multiclass Jaccard Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassJaccardIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassJaccardIndex(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6667
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -67,6 +93,19 @@ class MulticlassJaccardIndex(MulticlassStatScores):
 
 
 class MultilabelJaccardIndex(MultilabelStatScores):
+    """Multilabel Jaccard Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelJaccardIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelJaccardIndex(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -100,6 +139,19 @@ class MultilabelJaccardIndex(MultilabelStatScores):
 
 
 class JaccardIndex(_ClassificationTaskWrapper):
+    """Jaccard Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import JaccardIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = JaccardIndex(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6667
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

@@ -17,6 +17,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryPrecision(BinaryStatScores):
+    """Binary Precision (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryPrecision()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -29,6 +42,19 @@ class BinaryPrecision(BinaryStatScores):
 
 
 class MulticlassPrecision(MulticlassStatScores):
+    """Multiclass Precision (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassPrecision(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.8333
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -44,6 +70,19 @@ class MulticlassPrecision(MulticlassStatScores):
 
 
 class MultilabelPrecision(MultilabelStatScores):
+    """Multilabel Precision (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelPrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelPrecision(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -59,6 +98,19 @@ class MultilabelPrecision(MultilabelStatScores):
 
 
 class BinaryRecall(BinaryStatScores):
+    """Binary Recall (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryRecall()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -71,6 +123,19 @@ class BinaryRecall(BinaryStatScores):
 
 
 class MulticlassRecall(MulticlassStatScores):
+    """Multiclass Recall (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassRecall(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.8333
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -86,6 +151,19 @@ class MulticlassRecall(MulticlassStatScores):
 
 
 class MultilabelRecall(MultilabelStatScores):
+    """Multilabel Recall (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelRecall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelRecall(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

@@ -49,6 +49,16 @@ class BinaryPrecisionRecallCurve(Metric):
     sync concatenates the buffers via ``all_gather`` (the valid mask rides
     along), exactly like the reference's padded ragged gather but with static
     shapes.
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryPrecisionRecallCurve(thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[0.5, 0.666700005531311, 0.5, 1.0, 0.0, 1.0], [1.0, 1.0, 0.5, 0.5, 0.0, 0.0], [0.0, 0.25, 0.5, 0.75, 1.0]]
     """
 
     is_differentiable = False
@@ -143,6 +153,19 @@ class BinaryPrecisionRecallCurve(Metric):
 
 
 class MulticlassPrecisionRecallCurve(Metric):
+    """Multiclass Precision Recall Curve (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [tuple(v.shape) for v in m.compute()]
+        [(3, 6), (3, 6), (5,)]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -200,6 +223,19 @@ class MulticlassPrecisionRecallCurve(Metric):
 
 
 class MultilabelPrecisionRecallCurve(Metric):
+    """Multilabel Precision Recall Curve (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelPrecisionRecallCurve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelPrecisionRecallCurve(num_labels=3, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [tuple(v.shape) for v in m.compute()]
+        [(3, 6), (3, 6), (5,)]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False
@@ -265,6 +301,19 @@ class MultilabelPrecisionRecallCurve(Metric):
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    """Precision Recall Curve (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import PrecisionRecallCurve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = PrecisionRecallCurve(task="binary", thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[0.5, 0.666700005531311, 0.5, 1.0, 0.0, 1.0], [1.0, 1.0, 0.5, 0.5, 0.0, 0.0], [0.0, 0.25, 0.5, 0.75, 1.0]]
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

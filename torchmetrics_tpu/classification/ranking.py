@@ -51,6 +51,19 @@ class _MultilabelRankingBase(Metric):
 
 
 class MultilabelCoverageError(_MultilabelRankingBase):
+    """Multilabel Coverage Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelCoverageError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelCoverageError(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.6667
+    """
+
     higher_is_better = False
 
     def _ranking_update(self, preds: Array, target: Array):
@@ -58,6 +71,19 @@ class MultilabelCoverageError(_MultilabelRankingBase):
 
 
 class MultilabelRankingAveragePrecision(_MultilabelRankingBase):
+    """Multilabel Ranking Average Precision (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelRankingAveragePrecision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     higher_is_better = True
 
     def _ranking_update(self, preds: Array, target: Array):
@@ -65,6 +91,19 @@ class MultilabelRankingAveragePrecision(_MultilabelRankingBase):
 
 
 class MultilabelRankingLoss(_MultilabelRankingBase):
+    """Multilabel Ranking Loss (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelRankingLoss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelRankingLoss(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0
+    """
+
     higher_is_better = False
 
     def _ranking_update(self, preds: Array, target: Array):

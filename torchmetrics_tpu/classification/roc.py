@@ -21,6 +21,19 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
+    """Binary ROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryROC(thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[0.0, 0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 1.0, 0.75, 0.5, 0.25, 0.0]]
+    """
+
     def compute(self):
         return _binary_roc_compute(self._curve_state(), self.thresholds)
 
@@ -34,11 +47,37 @@ class BinaryROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Multiclass ROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassROC(num_classes=3, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [tuple(v.shape) for v in m.compute()]
+        [(3, 6), (3, 6), (6,)]
+    """
+
     def compute(self):
         return _multiclass_roc_compute(self._curve_state(), self.num_classes, self.thresholds)
 
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Multilabel ROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelROC(num_labels=3, thresholds=5)
+        >>> m.update(preds, target)
+        >>> [tuple(v.shape) for v in m.compute()]
+        [(3, 6), (3, 6), (6,)]
+    """
+
     def compute(self):
         if self.thresholds is None:
             return _multilabel_roc_compute(self._curve_state(), self.num_labels, None, self._valid_state())
@@ -46,6 +85,19 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
 
 
 class ROC(_ClassificationTaskWrapper):
+    """ROC (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import ROC
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = ROC(task="binary", thresholds=5)
+        >>> m.update(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[0.0, 0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 1.0, 0.75, 0.5, 0.25, 0.0]]
+    """
+
     def __new__(  # type: ignore[misc]
         cls,
         task: str,

@@ -13,6 +13,19 @@ from torchmetrics_tpu.functional.classification.specificity import _specificity_
 
 
 class BinarySpecificity(BinaryStatScores):
+    """Binary Specificity (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinarySpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinarySpecificity()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -25,6 +38,19 @@ class BinarySpecificity(BinaryStatScores):
 
 
 class MulticlassSpecificity(MulticlassStatScores):
+    """Multiclass Specificity (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassSpecificity(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.8889
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False
@@ -40,6 +66,19 @@ class MulticlassSpecificity(MulticlassStatScores):
 
 
 class MultilabelSpecificity(MultilabelStatScores):
+    """Multilabel Specificity (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelSpecificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelSpecificity(num_labels=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

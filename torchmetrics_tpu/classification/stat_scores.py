@@ -72,7 +72,18 @@ class _AbstractStatScores(Metric):
 
 
 class BinaryStatScores(_AbstractStatScores):
-    """Binary tp/fp/tn/fn (reference classification/stat_scores.py:91+)."""
+    """Binary tp/fp/tn/fn (reference classification/stat_scores.py:91+).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import BinaryStatScores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> m = BinaryStatScores()
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [1, 1, 1, 1, 2]
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
@@ -108,7 +119,18 @@ class BinaryStatScores(_AbstractStatScores):
 
 
 class MulticlassStatScores(_AbstractStatScores):
-    """Multiclass tp/fp/tn/fn (reference classification/stat_scores.py:213+)."""
+    """Multiclass tp/fp/tn/fn (reference classification/stat_scores.py:213+).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MulticlassStatScores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = MulticlassStatScores(num_classes=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [1.0, 0.33329999446868896, 2.3332998752593994, 0.33329999446868896, 1.333299994468689]
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
@@ -155,7 +177,18 @@ class MulticlassStatScores(_AbstractStatScores):
 
 
 class MultilabelStatScores(_AbstractStatScores):
-    """Multilabel tp/fp/tn/fn (reference classification/stat_scores.py:360+)."""
+    """Multilabel tp/fp/tn/fn (reference classification/stat_scores.py:360+).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import MultilabelStatScores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> m = MultilabelStatScores(num_labels=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [1.666700005531311, 0.0, 1.333299994468689, 0.0, 1.666700005531311]
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
@@ -199,7 +232,18 @@ class MultilabelStatScores(_AbstractStatScores):
 
 
 class StatScores(_ClassificationTaskWrapper):
-    """Task-dispatching entry (reference classification/stat_scores.py:518-552)."""
+    """Task-dispatching entry (reference classification/stat_scores.py:518-552).
+
+    Example:
+        >>> from torchmetrics_tpu.classification import StatScores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> m = StatScores(task="multiclass", num_classes=3)
+        >>> m.update(preds, target)
+        >>> jnp.round(m.compute(), 4).tolist()
+        [3, 1, 7, 1, 4]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -56,34 +56,125 @@ class _LabelClusteringMetric(Metric):
 
 
 class MutualInfoScore(_LabelClusteringMetric):
+    """Mutual Info Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import MutualInfoScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = MutualInfoScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5004
+    """
+
     _fn = staticmethod(mutual_info_score)
 
 
 class RandScore(_LabelClusteringMetric):
+    """Rand Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import RandScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = RandScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6
+    """
+
     _fn = staticmethod(rand_score)
 
 
 class AdjustedRandScore(_LabelClusteringMetric):
+    """Adjusted Rand Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import AdjustedRandScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = AdjustedRandScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        -0.25
+    """
+
     _fn = staticmethod(adjusted_rand_score)
     plot_lower_bound: float = -0.5
 
 
 class FowlkesMallowsIndex(_LabelClusteringMetric):
+    """Fowlkes Mallows Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import FowlkesMallowsIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = FowlkesMallowsIndex()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0
+    """
+
     _fn = staticmethod(fowlkes_mallows_index)
     plot_upper_bound: float = 1.0
 
 
 class HomogeneityScore(_LabelClusteringMetric):
+    """Homogeneity Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import HomogeneityScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = HomogeneityScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.4744
+    """
+
     _fn = staticmethod(homogeneity_score)
     plot_upper_bound: float = 1.0
 
 
 class CompletenessScore(_LabelClusteringMetric):
+    """Completeness Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import CompletenessScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = CompletenessScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.4744
+    """
+
     _fn = staticmethod(completeness_score)
     plot_upper_bound: float = 1.0
 
 
 class VMeasureScore(_LabelClusteringMetric):
+    """V Measure Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import VMeasureScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = VMeasureScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.4744
+    """
+
     _fn = staticmethod(v_measure_score)
     plot_upper_bound: float = 1.0
 
@@ -98,6 +189,19 @@ class VMeasureScore(_LabelClusteringMetric):
 
 
 class NormalizedMutualInfoScore(_LabelClusteringMetric):
+    """Normalized Mutual Info Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = NormalizedMutualInfoScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.4744
+    """
+
     _fn = staticmethod(normalized_mutual_info_score)
     plot_upper_bound: float = 1.0
 
@@ -111,6 +215,19 @@ class NormalizedMutualInfoScore(_LabelClusteringMetric):
 
 
 class AdjustedMutualInfoScore(NormalizedMutualInfoScore):
+    """Adjusted Mutual Info Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import AdjustedMutualInfoScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> m = AdjustedMutualInfoScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        -0.25
+    """
+
     _fn = staticmethod(adjusted_mutual_info_score)
     plot_lower_bound: float = -1.0
 
@@ -139,16 +256,55 @@ class _EmbeddingClusteringMetric(Metric):
 
 
 class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
+    """Calinski Harabasz Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> m = CalinskiHarabaszScore()
+        >>> m.update(data, labels)
+        >>> round(float(m.compute()), 4)
+        6399.9868
+    """
+
     _fn = staticmethod(calinski_harabasz_score)
     higher_is_better = True
 
 
 class DaviesBouldinScore(_EmbeddingClusteringMetric):
+    """Davies Bouldin Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> m = DaviesBouldinScore()
+        >>> m.update(data, labels)
+        >>> round(float(m.compute()), 4)
+        0.025
+    """
+
     _fn = staticmethod(davies_bouldin_score)
     higher_is_better = False
 
 
 class DunnIndex(_EmbeddingClusteringMetric):
+    """Dunn Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.clustering import DunnIndex
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> m = DunnIndex()
+        >>> m.update(data, labels)
+        >>> round(float(m.compute()), 4)
+        79.9997
+    """
+
     _fn = staticmethod(dunn_index)
     higher_is_better = True
 

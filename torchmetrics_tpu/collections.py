@@ -26,6 +26,15 @@ _PREFIX_SUFFIX_ERROR = "Expected input `{}` to be a string, but got {}"
 class MetricCollection:
     """Dict-like collection of metrics sharing update calls.
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy, BinaryPrecision
+        >>> collection = MetricCollection([BinaryAccuracy(), BinaryPrecision()])
+        >>> collection.update(jnp.asarray([0.2, 0.8, 0.3, 0.6]), jnp.asarray([0, 1, 1, 0]))
+        >>> {k: round(float(v), 4) for k, v in collection.compute().items()}
+        {'BinaryAccuracy': 0.5, 'BinaryPrecision': 0.5}
+
     Args:
         metrics: single metric, list/tuple of metrics, or dict name→metric.
         prefix / postfix: added to each output key.

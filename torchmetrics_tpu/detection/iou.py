@@ -25,7 +25,20 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class IntersectionOverUnion(Metric):
-    """Mean pairwise IoU over matching-label box pairs (reference detection/iou.py:28-200)."""
+    """Mean pairwise IoU over matching-label box pairs (reference detection/iou.py:28-200).
+
+    Example:
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> import jax.numpy as jnp
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),
+        ...           "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]),
+        ...            "labels": jnp.asarray([0])}]
+        >>> iou = IntersectionOverUnion()
+        >>> iou.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in iou.compute().items()}
+        {'iou': 0.6667}
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True
@@ -102,18 +115,63 @@ class IntersectionOverUnion(Metric):
 
 
 class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIOU variant of :class:`IntersectionOverUnion`.
+
+    Example:
+        >>> from torchmetrics_tpu.detection import GeneralizedIntersectionOverUnion
+        >>> import jax.numpy as jnp
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),
+        ...           "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]),
+        ...            "labels": jnp.asarray([0])}]
+        >>> m = GeneralizedIntersectionOverUnion()
+        >>> m.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in m.compute().items()}
+        {'giou': 0.6667}
+    """
+
     _iou_type: str = "giou"
     _invalid_val: float = -1.0
     _pairwise_fn = staticmethod(generalized_box_iou)
 
 
 class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIOU variant of :class:`IntersectionOverUnion`.
+
+    Example:
+        >>> from torchmetrics_tpu.detection import DistanceIntersectionOverUnion
+        >>> import jax.numpy as jnp
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),
+        ...           "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]),
+        ...            "labels": jnp.asarray([0])}]
+        >>> m = DistanceIntersectionOverUnion()
+        >>> m.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in m.compute().items()}
+        {'diou': 0.6503}
+    """
+
     _iou_type: str = "diou"
     _invalid_val: float = -1.0
     _pairwise_fn = staticmethod(distance_box_iou)
 
 
 class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIOU variant of :class:`IntersectionOverUnion`.
+
+    Example:
+        >>> from torchmetrics_tpu.detection import CompleteIntersectionOverUnion
+        >>> import jax.numpy as jnp
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),
+        ...           "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]),
+        ...            "labels": jnp.asarray([0])}]
+        >>> m = CompleteIntersectionOverUnion()
+        >>> m.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in m.compute().items()}
+        {'ciou': 0.6503}
+    """
+
     _iou_type: str = "ciou"
     _invalid_val: float = -2.0
     _pairwise_fn = staticmethod(complete_box_iou)

@@ -107,6 +107,19 @@ class MeanAveragePrecision(Metric):
     optional ``iscrowd``. Compute returns the COCO summary dict (map, map_50,
     map_75, map_small/medium/large, mar_1/10/100, mar_small/medium/large,
     map_per_class, mar_100_per_class, classes).
+
+    Example:
+        >>> from torchmetrics_tpu.detection import MeanAveragePrecision
+        >>> import jax.numpy as jnp
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),
+        ...           "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]),
+        ...            "labels": jnp.asarray([0])}]
+        >>> m = MeanAveragePrecision()
+        >>> m.update(preds, target)
+        >>> result = m.compute()
+        >>> round(float(result["map"]), 4), round(float(result["map_50"]), 4)
+        (0.4, 1.0)
     """
 
     is_differentiable: bool = False

@@ -24,6 +24,16 @@ class PanopticQuality(Metric):
 
     States are the four per-category accumulators (sum-reduced across devices);
     all segment extraction happens at update time.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import PanopticQuality
+        >>> preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])
+        >>> pq = PanopticQuality(things={0}, stuffs={1})
+        >>> pq.update(preds, target)
+        >>> round(float(pq.compute()), 4)
+        0.5
     """
 
     is_differentiable: bool = False
@@ -90,7 +100,18 @@ class PanopticQuality(Metric):
 
 
 class ModifiedPanopticQuality(PanopticQuality):
-    """PQ with the modified stuff formula (reference detection/panoptic_qualities.py:295+)."""
+    """PQ with the modified stuff formula (reference detection/panoptic_qualities.py:295+).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import ModifiedPanopticQuality
+        >>> preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])
+        >>> mpq = ModifiedPanopticQuality(things={0}, stuffs={1})
+        >>> mpq.update(preds, target)
+        >>> round(float(mpq.compute()), 4)
+        0.625
+    """
 
     def __init__(
         self,

@@ -28,6 +28,16 @@ def perceptual_evaluation_speech_quality(
 
     Reference functional/audio/pesq.py:24-113: same signature; ``n_processes``
     is accepted for parity (the native kernel is already batched).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import perceptual_evaluation_speech_quality
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 8000.0)
+        >>> target = jnp.sin(2 * jnp.pi * 440 * t)
+        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
+        >>> result = perceptual_evaluation_speech_quality(preds, target, fs=8000, mode='nb')
+        >>> round(float(result), 4)
+        4.4638
     """
     if fs not in (8000, 16000):
         raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")

@@ -51,6 +51,17 @@ def permutation_invariant_training(
 
     Returns:
         ``(best_metric, best_perm)`` with shapes ``(batch,)`` and ``(batch, spk)``.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import permutation_invariant_training
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> t = jnp.arange(0, 0.5, 1 / 800.0)
+        >>> target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])[None]
+        >>> preds = target[:, ::-1, :] + 0.01 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [[40.001399993896484], [[1, 0]]]
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -130,7 +141,17 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
-    """Reorder ``preds`` by the per-sample permutation (reference pit.py:216-229)."""
+    """Reorder ``preds`` by the per-sample permutation (reference pit.py:216-229).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pit_permutate
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.arange(12.0).reshape(2, 3, 2)
+        >>> perm = jnp.asarray([[1, 0, 2], [0, 2, 1]])
+        >>> result = pit_permutate(preds, perm)
+        >>> jnp.round(result, 4).tolist()
+        [[[2.0, 3.0], [0.0, 1.0], [4.0, 5.0]], [[6.0, 7.0], [10.0, 11.0], [8.0, 9.0]]]
+    """
     preds = jnp.asarray(preds)
     perm = jnp.asarray(perm)
     return jnp.take_along_axis(preds, perm.reshape(*perm.shape, *([1] * (preds.ndim - 2))), axis=1)

@@ -70,6 +70,16 @@ def signal_distortion_ratio(
 
     Returns:
         SDR values in dB with shape ``(...,)``.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import signal_distortion_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = signal_distortion_ratio(preds, target)
+        >>> round(float(result), 4)
+        21.6639
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -109,7 +119,18 @@ def signal_distortion_ratio(
 
 
 def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SI-SDR (reference functional/audio/sdr.py:302-339)."""
+    """SI-SDR (reference functional/audio/sdr.py:302-339).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = scale_invariant_signal_distortion_ratio(preds, target)
+        >>> round(float(result), 4)
+        20.0
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     _check_same_shape(preds, target)
@@ -138,6 +159,16 @@ def source_aggregated_signal_distortion_ratio(
 
     A single alpha scales all speakers, and signal/distortion energies aggregate
     over both speaker and time axes before the dB ratio.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import source_aggregated_signal_distortion_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 0.5, 1 / 800.0)
+        >>> target = jnp.stack([jnp.sin(2 * jnp.pi * 100 * t), jnp.sin(2 * jnp.pi * 150 * t)])
+        >>> preds = target + 0.05 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = source_aggregated_signal_distortion_ratio(preds, target)
+        >>> round(float(result), 4)
+        26.0254
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

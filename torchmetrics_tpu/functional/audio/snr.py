@@ -23,6 +23,16 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
 
     Returns:
         SNR values with shape ``(...,)``.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import signal_noise_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = signal_noise_ratio(preds, target)
+        >>> round(float(result), 4)
+        20.0
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -39,7 +49,18 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
 
 
 def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
-    """SI-SNR: SI-SDR with forced zero-mean (reference functional/audio/snr.py:64-88)."""
+    """SI-SNR: SI-SDR with forced zero-mean (reference functional/audio/snr.py:64-88).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = scale_invariant_signal_noise_ratio(preds, target)
+        >>> round(float(result), 4)
+        20.0
+    """
     return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
 
 
@@ -48,6 +69,15 @@ def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero
 
     Accepts complex arrays of shape ``(..., freq, time)`` or real arrays of shape
     ``(..., freq, time, 2)``; flattens the spectral axes and evaluates SI-SDR.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import complex_scale_invariant_signal_noise_ratio
+        >>> import jax.numpy as jnp
+        >>> target = jnp.stack([jnp.cos(jnp.arange(20.0)).reshape(4, 5), jnp.sin(jnp.arange(20.0)).reshape(4, 5)], axis=-1)
+        >>> preds = target * 0.9 + 0.01
+        >>> result = complex_scale_invariant_signal_noise_ratio(preds, target)
+        >>> round(float(result), 4)
+        36.0883
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

@@ -197,6 +197,16 @@ def speech_reverberation_modulation_energy_ratio(
     but falls back to the exact filterbank path with a warning. A 1-D input
     returns a shape-(1,) array, matching the reference's documented behaviour
     (srmr.py:228-230: ``tensor([0.3354])``) rather than a scalar.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import speech_reverberation_modulation_energy_ratio
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 800.0)
+        >>> target = jnp.sin(2 * jnp.pi * 100 * t)
+        >>> preds = target + 0.1 * jnp.cos(2 * jnp.pi * 17 * t)
+        >>> result = speech_reverberation_modulation_energy_ratio(preds, fs=8000)
+        >>> jnp.round(result, 4).tolist()
+        [67.73849487304688]
     """
     _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
     if fast:

@@ -165,6 +165,16 @@ def short_time_objective_intelligibility(
     """STOI of degraded ``preds`` against clean ``target`` (reference functional/audio/stoi.py:24-115).
 
     Shapes ``(..., time)``; returns per-signal scores with the batch shape.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import short_time_objective_intelligibility
+        >>> import jax.numpy as jnp
+        >>> t = jnp.arange(0, 1.0, 1 / 8000.0)
+        >>> target = jnp.sin(2 * jnp.pi * 440 * t)
+        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
+        >>> result = short_time_objective_intelligibility(preds, target, fs=8000)
+        >>> round(float(result), 4)
+        0.4694
     """
     if not isinstance(fs, int) or fs <= 0:
         raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")

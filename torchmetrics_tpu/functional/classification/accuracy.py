@@ -49,6 +49,18 @@ def binary_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary accuracy (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_accuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_accuracy(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _accuracy_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
 
@@ -63,6 +75,18 @@ def multiclass_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass accuracy (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_accuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_accuracy(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.8333
+    """
+
     tp, fp, tn, fn = _multiclass_stats(
         preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
     )
@@ -79,6 +103,18 @@ def multilabel_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel accuracy (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_accuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_accuracy(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     tp, fp, tn, fn = _multilabel_stats(
         preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
     )
@@ -98,7 +134,17 @@ def accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching accuracy."""
+    """Task-dispatching accuracy.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import accuracy
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = accuracy(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_accuracy(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -72,6 +72,18 @@ def binary_auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary auroc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_auroc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_auroc(preds, target)
+        >>> round(float(result), 4)
+        0.75
+    """
+
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -116,6 +128,18 @@ def multiclass_auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass auroc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_auroc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_auroc(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -143,6 +167,18 @@ def multilabel_auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel auroc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_auroc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_auroc(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
@@ -177,6 +213,18 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """auroc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import auroc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = auroc(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)

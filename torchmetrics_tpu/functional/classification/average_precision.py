@@ -53,6 +53,18 @@ def binary_average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_average_precision(preds, target)
+        >>> round(float(result), 4)
+        0.8333
+    """
+
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -95,6 +107,18 @@ def multiclass_average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_average_precision(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -122,6 +146,18 @@ def multilabel_average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_average_precision(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
@@ -155,6 +191,18 @@ def average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = average_precision(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)

@@ -79,6 +79,18 @@ def binary_calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary calibration error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_calibration_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_calibration_error(preds, target)
+        >>> round(float(result), 4)
+        0.425
+    """
+
     if validate_args:
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -103,6 +115,18 @@ def multiclass_calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass calibration error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_calibration_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_calibration_error(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.325
+    """
+
     if validate_args:
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -132,6 +156,18 @@ def calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """calibration error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import calibration_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = calibration_error(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.325
+    """
+
     task = ClassificationTaskNoMultilabel.from_str(task)
     if task == ClassificationTaskNoMultilabel.BINARY:
         return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)

@@ -48,6 +48,18 @@ def binary_cohen_kappa(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary cohen kappa (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_cohen_kappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_cohen_kappa(preds, target)
+        >>> round(float(result), 4)
+        0.0
+    """
+
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -64,6 +76,18 @@ def multiclass_cohen_kappa(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass cohen kappa (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_cohen_kappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_cohen_kappa(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.6364
+    """
+
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -82,6 +106,18 @@ def cohen_kappa(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """cohen kappa (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import cohen_kappa
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = cohen_kappa(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.6364
+    """
+
     task = ClassificationTaskNoMultilabel.from_str(task)
     if task == ClassificationTaskNoMultilabel.BINARY:
         return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)

@@ -109,6 +109,18 @@ def binary_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary confusion matrix (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_confusion_matrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_confusion_matrix(preds, target)
+        >>> jnp.round(result, 4).tolist()
+        [[1, 1], [1, 1]]
+    """
+
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -187,6 +199,18 @@ def multiclass_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass confusion matrix (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_confusion_matrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_confusion_matrix(preds, target, num_classes=3)
+        >>> jnp.round(result, 4).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -266,6 +290,18 @@ def multilabel_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel confusion matrix (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_confusion_matrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_confusion_matrix(preds, target, num_labels=3)
+        >>> jnp.round(result, 4).tolist()
+        [[[2, 0], [0, 1]], [[1, 0], [0, 2]], [[1, 0], [0, 2]]]
+    """
+
     if validate_args:
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
         _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
@@ -285,6 +321,18 @@ def confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """confusion matrix (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import confusion_matrix
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = confusion_matrix(preds, target, task="multiclass", num_classes=3)
+        >>> jnp.round(result, 4).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)

@@ -112,7 +112,17 @@ def dice(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Dice = 2*TP / (2*TP + FP + FN) with the legacy averaging options."""
+    """Dice = 2*TP / (2*TP + FP + FN) with the legacy averaging options.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import dice
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = dice(preds, target)
+        >>> round(float(result), 4)
+        0.75
+    """
     allowed = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed:
         raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")

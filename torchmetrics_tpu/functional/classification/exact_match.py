@@ -48,6 +48,18 @@ def multiclass_exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass exact match (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_exact_match
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_exact_match(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+    """
+
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
@@ -78,6 +90,18 @@ def multilabel_exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel exact match (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_exact_match
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_exact_match(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
@@ -97,6 +121,18 @@ def exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """exact match (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import exact_match
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = exact_match(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+    """
+
     task = ClassificationTaskNoBinary.from_str(task)
     if task == ClassificationTaskNoBinary.MULTICLASS:
         if not isinstance(num_classes, int):

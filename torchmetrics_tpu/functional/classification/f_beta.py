@@ -41,6 +41,18 @@ def _fbeta_reduce(
 def binary_fbeta_score(
     preds, target, beta: float, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """binary fbeta score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_fbeta_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_fbeta_score(preds, target, beta=1.0)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     if validate_args and (not isinstance(beta, float) or beta <= 0):
         raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, multidim_average, ignore_index, validate_args)
@@ -50,6 +62,18 @@ def binary_fbeta_score(
 def multiclass_fbeta_score(
     preds, target, beta: float, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multiclass fbeta score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_fbeta_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_fbeta_score(preds, target, beta=1.0, num_classes=3)
+        >>> round(float(result), 4)
+        0.7778
+    """
+
     if validate_args and (not isinstance(beta, float) or beta <= 0):
         raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
     tp, fp, tn, fn = _multiclass_stats(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
@@ -59,6 +83,18 @@ def multiclass_fbeta_score(
 def multilabel_fbeta_score(
     preds, target, beta: float, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multilabel fbeta score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_fbeta_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_fbeta_score(preds, target, beta=1.0, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args and (not isinstance(beta, float) or beta <= 0):
         raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
     tp, fp, tn, fn = _multilabel_stats(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
@@ -66,12 +102,36 @@ def multilabel_fbeta_score(
 
 
 def binary_f1_score(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    """binary f1 score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_f1_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_f1_score(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     return binary_fbeta_score(preds, target, 1.0, threshold, multidim_average, ignore_index, validate_args)
 
 
 def multiclass_f1_score(
     preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multiclass f1 score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_f1_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_f1_score(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.7778
+    """
+
     return multiclass_fbeta_score(
         preds, target, 1.0, num_classes, average, top_k, multidim_average, ignore_index, validate_args
     )
@@ -80,6 +140,18 @@ def multiclass_f1_score(
 def multilabel_f1_score(
     preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multilabel f1 score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_f1_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_f1_score(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     return multilabel_fbeta_score(
         preds, target, 1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args
     )
@@ -99,6 +171,18 @@ def fbeta_score(
     ignore_index=None,
     validate_args=True,
 ):
+    """fbeta score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import fbeta_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = fbeta_score(preds, target, task="multiclass", num_classes=3, beta=1.0)
+        >>> round(float(result), 4)
+        0.75
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args)
@@ -132,6 +216,18 @@ def f1_score(
     ignore_index=None,
     validate_args=True,
 ):
+    """f1 score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import f1_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = f1_score(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+    """
+
     return fbeta_score(
         preds, target, task, 1.0, threshold, num_classes, num_labels, average, multidim_average, top_k, ignore_index, validate_args
     )

@@ -539,7 +539,17 @@ def recall_at_fixed_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task dispatcher (reference functional/classification/recall_fixed_precision.py:401)."""
+    """Task dispatcher (reference functional/classification/recall_fixed_precision.py:401).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import recall_at_fixed_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = recall_at_fixed_precision(preds, target, task="binary", min_precision=0.5, thresholds=5)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [1.0, 0.25]
+    """
     return _fixed_dispatch(
         binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision, multilabel_recall_at_fixed_precision
     )(preds, target, task, min_precision, thresholds, num_classes, num_labels, ignore_index, validate_args)
@@ -556,7 +566,17 @@ def precision_at_fixed_recall(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task dispatcher (reference functional/classification/precision_fixed_recall.py:309)."""
+    """Task dispatcher (reference functional/classification/precision_fixed_recall.py:309).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import precision_at_fixed_recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = precision_at_fixed_recall(preds, target, task="binary", min_recall=0.5, thresholds=5)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [1.0, 0.75]
+    """
     return _fixed_dispatch(
         binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall, multilabel_precision_at_fixed_recall
     )(preds, target, task, min_recall, thresholds, num_classes, num_labels, ignore_index, validate_args)
@@ -573,7 +593,17 @@ def sensitivity_at_specificity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task dispatcher (reference functional/classification/sensitivity_specificity.py:406)."""
+    """Task dispatcher (reference functional/classification/sensitivity_specificity.py:406).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import sensitivity_at_specificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = sensitivity_at_specificity(preds, target, task="binary", min_specificity=0.5, thresholds=5)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [1.0, 0.25]
+    """
     return _fixed_dispatch(
         binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity, multilabel_sensitivity_at_specificity
     )(preds, target, task, min_specificity, thresholds, num_classes, num_labels, ignore_index, validate_args)
@@ -590,7 +620,17 @@ def specificity_at_sensitivity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task dispatcher (reference functional/classification/specificity_sensitivity.py:443)."""
+    """Task dispatcher (reference functional/classification/specificity_sensitivity.py:443).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import specificity_at_sensitivity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = specificity_at_sensitivity(preds, target, task="binary", min_sensitivity=0.5, thresholds=5)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [1.0, 0.75]
+    """
     return _fixed_dispatch(
         binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity, multilabel_specificity_at_sensitivity
     )(preds, target, task, min_sensitivity, thresholds, num_classes, num_labels, ignore_index, validate_args)

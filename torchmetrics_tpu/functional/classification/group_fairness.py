@@ -78,7 +78,18 @@ def binary_groups_stat_rates(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Dict[str, Array]:
-    """Per-group (tp, fp, tn, fn) rates normalized by group size (reference :105-163)."""
+    """Per-group (tp, fp, tn, fn) rates normalized by group size (reference :105-163).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_groups_stat_rates
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> result = binary_groups_stat_rates(preds, target, groups, num_groups=2)
+        >>> {k: jnp.round(v, 4).tolist() for k, v in result.items()}
+        {'group_0': [0.0, 0.0, 0.5, 0.5], 'group_1': [0.5, 0.5, 0.0, 0.0]}
+    """
     tp, fp, tn, fn = _binary_groups_stat_scores(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
     )
@@ -109,7 +120,18 @@ def demographic_parity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Dict[str, Array]:
-    """min/max positivity-rate ratio across groups (reference :177-242)."""
+    """min/max positivity-rate ratio across groups (reference :177-242).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import demographic_parity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> result = demographic_parity(preds, groups)
+        >>> {k: round(float(v), 4) for k, v in result.items()}
+        {'DP_0_1': 0.0}
+    """
     return binary_fairness(preds, None, groups, "demographic_parity", threshold, ignore_index, validate_args)
 
 
@@ -121,7 +143,18 @@ def equal_opportunity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Dict[str, Array]:
-    """min/max true-positive-rate ratio across groups (reference :258+)."""
+    """min/max true-positive-rate ratio across groups (reference :258+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import equal_opportunity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> result = equal_opportunity(preds, target, groups)
+        >>> {k: round(float(v), 4) for k, v in result.items()}
+        {'EO_0_1': 0.0}
+    """
     return binary_fairness(preds, target, groups, "equal_opportunity", threshold, ignore_index, validate_args)
 
 
@@ -134,7 +167,18 @@ def binary_fairness(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Dict[str, Array]:
-    """Demographic parity and/or equal opportunity for binary predictions."""
+    """Demographic parity and/or equal opportunity for binary predictions.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_fairness
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> groups = jnp.asarray([0, 1, 0, 1])
+        >>> result = binary_fairness(preds, target, groups, task="all")
+        >>> {k: round(float(v), 4) for k, v in result.items()}
+        {'DP_0_1': 0.0, 'EO_0_1': 0.0}
+    """
     if task not in ["demographic_parity", "equal_opportunity", "all"]:
         raise ValueError(
             f"Expected argument `task` to either be ``demographic_parity``,"

@@ -41,6 +41,18 @@ def _hamming_distance_reduce(
 
 
 def binary_hamming_distance(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    """binary hamming distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_hamming_distance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_hamming_distance(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _hamming_distance_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
 
@@ -48,6 +60,18 @@ def binary_hamming_distance(preds, target, threshold=0.5, multidim_average="glob
 def multiclass_hamming_distance(
     preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multiclass hamming distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_hamming_distance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_hamming_distance(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.1667
+    """
+
     tp, fp, tn, fn = _multiclass_stats(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
     return _hamming_distance_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, top_k=top_k)
 
@@ -55,6 +79,18 @@ def multiclass_hamming_distance(
 def multilabel_hamming_distance(
     preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multilabel hamming distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_hamming_distance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_hamming_distance(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        0.0
+    """
+
     tp, fp, tn, fn = _multilabel_stats(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
     return _hamming_distance_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
 
@@ -72,6 +108,18 @@ def hamming_distance(
     ignore_index=None,
     validate_args=True,
 ):
+    """hamming distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import hamming_distance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = hamming_distance(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.25
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_hamming_distance(preds, target, threshold, multidim_average, ignore_index, validate_args)

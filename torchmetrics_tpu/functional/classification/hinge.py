@@ -38,6 +38,18 @@ def binary_hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary hinge loss (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_hinge_loss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_hinge_loss(preds, target)
+        >>> round(float(result), 4)
+        0.925
+    """
+
     if validate_args:
         _binary_hinge_loss_arg_validation(squared, ignore_index)
     import numpy as np
@@ -83,6 +95,18 @@ def multiclass_hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass hinge loss (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_hinge_loss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_hinge_loss(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.625
+    """
+
     if validate_args:
         if multiclass_mode not in ("crammer-singer", "one-vs-all"):
             raise ValueError(
@@ -112,6 +136,18 @@ def hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """hinge loss (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import hinge_loss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = hinge_loss(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.625
+    """
+
     task = ClassificationTaskNoMultilabel.from_str(task)
     if task == ClassificationTaskNoMultilabel.BINARY:
         return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)

@@ -50,16 +50,52 @@ def _jaccard_index_reduce(
 
 
 def binary_jaccard_index(preds, target, threshold=0.5, ignore_index=None, validate_args=True):
+    """binary jaccard index (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_jaccard_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_jaccard_index(preds, target)
+        >>> round(float(result), 4)
+        0.3333
+    """
+
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, "global", ignore_index, validate_args)
     return _jaccard_index_reduce(tp, fp, tn, fn, average="binary")
 
 
 def multiclass_jaccard_index(preds, target, num_classes, average="macro", ignore_index=None, validate_args=True):
+    """multiclass jaccard index (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_jaccard_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_jaccard_index(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.6667
+    """
+
     tp, fp, tn, fn = _multiclass_stats(preds, target, num_classes, average, 1, "global", ignore_index, validate_args)
     return _jaccard_index_reduce(tp, fp, tn, fn, average=average, ignore_index=ignore_index)
 
 
 def multilabel_jaccard_index(preds, target, num_labels, threshold=0.5, average="macro", ignore_index=None, validate_args=True):
+    """multilabel jaccard index (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_jaccard_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_jaccard_index(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     tp, fp, tn, fn = _multilabel_stats(preds, target, num_labels, threshold, average, "global", ignore_index, validate_args)
     return _jaccard_index_reduce(tp, fp, tn, fn, average=average)
 
@@ -75,6 +111,18 @@ def jaccard_index(
     ignore_index=None,
     validate_args=True,
 ):
+    """jaccard index (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import jaccard_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = jaccard_index(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.6667
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)

@@ -60,6 +60,18 @@ def binary_matthews_corrcoef(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """binary matthews corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_matthews_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_matthews_corrcoef(preds, target)
+        >>> round(float(result), 4)
+        0.0
+    """
+
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -75,6 +87,18 @@ def multiclass_matthews_corrcoef(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multiclass matthews corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_matthews_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_matthews_corrcoef(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.7
+    """
+
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -91,6 +115,18 @@ def multilabel_matthews_corrcoef(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """multilabel matthews corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_matthews_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_matthews_corrcoef(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize=None)
         _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
@@ -109,6 +145,18 @@ def matthews_corrcoef(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """matthews corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import matthews_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = matthews_corrcoef(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.7
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)

@@ -114,3 +114,99 @@ precision = _dispatch(binary_precision, multiclass_precision, multilabel_precisi
 precision.__name__ = "precision"
 recall = _dispatch(binary_recall, multiclass_recall, multilabel_recall)
 recall.__name__ = "recall"
+
+binary_precision.__doc__ = """binary precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_precision(preds, target)
+        >>> round(float(result), 4)
+        0.5
+"""
+
+binary_recall.__doc__ = """binary recall (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_recall(preds, target)
+        >>> round(float(result), 4)
+        0.5
+"""
+
+multiclass_precision.__doc__ = """multiclass precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_precision(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.8333
+"""
+
+multiclass_recall.__doc__ = """multiclass recall (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_recall(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.8333
+"""
+
+multilabel_precision.__doc__ = """multilabel precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_precision(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+"""
+
+multilabel_recall.__doc__ = """multilabel recall (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_recall(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+"""
+
+precision.__doc__ = """precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = precision(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+"""
+
+recall.__doc__ = """recall (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = recall(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.75
+"""

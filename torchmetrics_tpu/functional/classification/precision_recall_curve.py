@@ -154,7 +154,17 @@ def binary_precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
-    """Binary PR curve (reference :141+). Returns (precision, recall, thresholds)."""
+    """Binary PR curve (reference :141+). Returns (precision, recall, thresholds).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_precision_recall_curve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_precision_recall_curve(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [[0.5, 0.666700005531311, 0.5, 1.0, 1.0], [1.0, 1.0, 0.5, 0.5, 0.0], [0.19999998807907104, 0.29999998211860657, 0.5999999642372131, 0.7999999523162842]]
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -263,7 +273,17 @@ def multiclass_precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Multiclass one-vs-rest PR curves (reference :217+)."""
+    """Multiclass one-vs-rest PR curves (reference :217+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_precision_recall_curve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_precision_recall_curve(preds, target, num_classes=3, thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(3, 6), (3, 6), (5,)]
+    """
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -368,7 +388,17 @@ def multilabel_precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Per-label PR curves (reference :557+)."""
+    """Per-label PR curves (reference :557+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_precision_recall_curve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_precision_recall_curve(preds, target, num_labels=3, thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(3, 6), (3, 6), (5,)]
+    """
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
@@ -391,6 +421,18 @@ def precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """precision recall curve (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import precision_recall_curve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = precision_recall_curve(preds, target, task="binary", thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(6,), (6,), (5,)]
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)

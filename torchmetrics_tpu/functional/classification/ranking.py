@@ -47,6 +47,18 @@ def _coverage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 def multilabel_coverage_error(
     preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """multilabel coverage error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_coverage_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_coverage_error(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.6667
+    """
+
     if validate_args:
         _check_same_shape(preds, target)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
@@ -74,6 +86,18 @@ def _label_ranking_average_precision_update(preds: Array, target: Array) -> Tupl
 def multilabel_ranking_average_precision(
     preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """multilabel ranking average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_ranking_average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_ranking_average_precision(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     if validate_args:
         _check_same_shape(preds, target)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
@@ -98,6 +122,18 @@ def _label_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array, Arra
 def multilabel_ranking_loss(
     preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """multilabel ranking loss (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_ranking_loss
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_ranking_loss(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        0.0
+    """
+
     if validate_args:
         _check_same_shape(preds, target)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)

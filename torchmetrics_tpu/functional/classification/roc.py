@@ -63,6 +63,18 @@ def binary_roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
+    """binary roc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_roc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_roc(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [[0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.5, 0.5, 1.0, 1.0], [1.7999999523162842, 0.7999999523162842, 0.5999999642372131, 0.29999998211860657, 0.19999998807907104]]
+    """
+
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -108,6 +120,18 @@ def multiclass_roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """multiclass roc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_roc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_roc(preds, target, num_classes=3, thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(3, 6), (3, 6), (6,)]
+    """
+
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -152,6 +176,18 @@ def multilabel_roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """multilabel roc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_roc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_roc(preds, target, num_labels=3, thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(3, 6), (3, 6), (6,)]
+    """
+
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
@@ -174,6 +210,18 @@ def roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """roc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import roc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = roc(preds, target, task="binary", thresholds=5)
+        >>> [tuple(v.shape) for v in result]
+        [(6,), (6,), (6,)]
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_roc(preds, target, thresholds, ignore_index, validate_args)

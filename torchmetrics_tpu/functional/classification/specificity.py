@@ -36,6 +36,18 @@ def _specificity_reduce(
 
 
 def binary_specificity(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    """binary specificity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import binary_specificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> result = binary_specificity(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _specificity_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
 
@@ -43,6 +55,18 @@ def binary_specificity(preds, target, threshold=0.5, multidim_average="global", 
 def multiclass_specificity(
     preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multiclass specificity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_specificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_specificity(preds, target, num_classes=3)
+        >>> round(float(result), 4)
+        0.8889
+    """
+
     tp, fp, tn, fn = _multiclass_stats(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
     return _specificity_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, top_k=top_k)
 
@@ -50,6 +74,18 @@ def multiclass_specificity(
 def multilabel_specificity(
     preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True
 ):
+    """multilabel specificity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_specificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_specificity(preds, target, num_labels=3)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     tp, fp, tn, fn = _multilabel_stats(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
     return _specificity_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
 
@@ -67,6 +103,18 @@ def specificity(
     ignore_index=None,
     validate_args=True,
 ):
+    """specificity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import specificity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = specificity(preds, target, task="multiclass", num_classes=3)
+        >>> round(float(result), 4)
+        0.875
+    """
+
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_specificity(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -344,7 +344,17 @@ def multiclass_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Compute tp/fp/tn/fn for multiclass tasks (reference stat_scores.py:217-555)."""
+    """Compute tp/fp/tn/fn for multiclass tasks (reference stat_scores.py:217-555).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiclass_stat_scores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = multiclass_stat_scores(preds, target, num_classes=3)
+        >>> jnp.round(result, 4).tolist()
+        [1.0, 0.33329999446868896, 2.3332998752593994, 0.33329999446868896, 1.333299994468689]
+    """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
@@ -469,7 +479,17 @@ def multilabel_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Compute tp/fp/tn/fn for multilabel tasks (reference stat_scores.py:557-810)."""
+    """Compute tp/fp/tn/fn for multilabel tasks (reference stat_scores.py:557-810).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multilabel_stat_scores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.4, 0.7, 0.3], [0.1, 0.6, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> result = multilabel_stat_scores(preds, target, num_labels=3)
+        >>> jnp.round(result, 4).tolist()
+        [1.666700005531311, 0.0, 1.333299994468689, 0.0, 1.666700005531311]
+    """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
@@ -493,7 +513,17 @@ def stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatching stat scores (reference stat_scores.py public entry)."""
+    """Task-dispatching stat scores (reference stat_scores.py public entry).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import stat_scores
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = stat_scores(preds, target, task="multiclass", num_classes=3)
+        >>> jnp.round(result, 4).tolist()
+        [3, 1, 7, 1, 4]
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -41,7 +41,17 @@ def _mutual_info_score_compute(contingency: Array) -> Array:
 
 
 def mutual_info_score(preds: Array, target: Array) -> Array:
-    """MI between two label assignments."""
+    """MI between two label assignments.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import mutual_info_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = mutual_info_score(preds, target)
+        >>> round(float(result), 4)
+        0.5004
+    """
     check_cluster_labels(jnp.asarray(preds), jnp.asarray(target))
     return _mutual_info_score_compute(calculate_contingency_matrix(preds, target))
 
@@ -49,7 +59,17 @@ def mutual_info_score(preds: Array, target: Array) -> Array:
 def normalized_mutual_info_score(
     preds: Array, target: Array, average_method: str = "arithmetic"
 ) -> Array:
-    """NMI: MI / generalized-mean of entropies."""
+    """NMI: MI / generalized-mean of entropies.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import normalized_mutual_info_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = normalized_mutual_info_score(preds, target)
+        >>> round(float(result), 4)
+        0.4744
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     check_cluster_labels(preds, target)
@@ -127,7 +147,17 @@ def expected_mutual_info_score(contingency: Array, n_samples: int) -> Array:
 def adjusted_mutual_info_score(
     preds: Array, target: Array, average_method: str = "arithmetic"
 ) -> Array:
-    """AMI: (MI - E[MI]) / (normalizer - E[MI])."""
+    """AMI: (MI - E[MI]) / (normalizer - E[MI]).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import adjusted_mutual_info_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = adjusted_mutual_info_score(preds, target)
+        >>> round(float(result), 4)
+        -0.25
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     _validate_average_method_arg(average_method)
@@ -147,7 +177,17 @@ def adjusted_mutual_info_score(
 
 
 def rand_score(preds: Array, target: Array) -> Array:
-    """Rand index from the 2x2 pair confusion matrix."""
+    """Rand index from the 2x2 pair confusion matrix.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import rand_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = rand_score(preds, target)
+        >>> round(float(result), 4)
+        0.6
+    """
     check_cluster_labels(jnp.asarray(preds), jnp.asarray(target))
     contingency = calculate_contingency_matrix(preds, target)
     pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
@@ -159,7 +199,17 @@ def rand_score(preds: Array, target: Array) -> Array:
 
 
 def adjusted_rand_score(preds: Array, target: Array) -> Array:
-    """ARI from the 2x2 pair confusion matrix."""
+    """ARI from the 2x2 pair confusion matrix.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import adjusted_rand_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = adjusted_rand_score(preds, target)
+        >>> round(float(result), 4)
+        -0.25
+    """
     check_cluster_labels(jnp.asarray(preds), jnp.asarray(target))
     contingency = calculate_contingency_matrix(preds, target)
     pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
@@ -170,7 +220,17 @@ def adjusted_rand_score(preds: Array, target: Array) -> Array:
 
 
 def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
-    """FMI: geometric mean of pairwise precision and recall."""
+    """FMI: geometric mean of pairwise precision and recall.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import fowlkes_mallows_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = fowlkes_mallows_index(preds, target)
+        >>> round(float(result), 4)
+        0.0
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     check_cluster_labels(preds, target)
@@ -197,18 +257,48 @@ def _homogeneity_score_compute(preds: Array, target: Array) -> Tuple[Array, Arra
 
 
 def homogeneity_score(preds: Array, target: Array) -> Array:
-    """Each predicted cluster contains only members of a single class."""
+    """Each predicted cluster contains only members of a single class.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import homogeneity_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = homogeneity_score(preds, target)
+        >>> round(float(result), 4)
+        0.4744
+    """
     return _homogeneity_score_compute(jnp.asarray(preds), jnp.asarray(target))[0]
 
 
 def completeness_score(preds: Array, target: Array) -> Array:
-    """All members of a class are assigned to the same cluster."""
+    """All members of a class are assigned to the same cluster.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import completeness_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = completeness_score(preds, target)
+        >>> round(float(result), 4)
+        0.4744
+    """
     homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(jnp.asarray(preds), jnp.asarray(target))
     return mutual_info / entropy_preds if bool(entropy_preds) else jnp.ones_like(entropy_preds)
 
 
 def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
-    """Weighted harmonic mean of homogeneity and completeness."""
+    """Weighted harmonic mean of homogeneity and completeness.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import v_measure_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> result = v_measure_score(preds, target)
+        >>> round(float(result), 4)
+        0.4744
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(preds, target)

@@ -34,7 +34,17 @@ def _centroids_counts(data: Array, labels: Array, num_labels: int):
 
 
 def calinski_harabasz_score(data: Array, labels: Array) -> Array:
-    """Variance-ratio criterion: between/within cluster dispersion."""
+    """Variance-ratio criterion: between/within cluster dispersion.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import calinski_harabasz_score
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> result = calinski_harabasz_score(data, labels)
+        >>> round(float(result), 4)
+        6399.9868
+    """
     data, labels, num_labels = _relabel(data, labels)
     num_samples = data.shape[0]
     mean = data.mean(axis=0)
@@ -47,7 +57,17 @@ def calinski_harabasz_score(data: Array, labels: Array) -> Array:
 
 
 def davies_bouldin_score(data: Array, labels: Array) -> Array:
-    """Mean worst-case ratio of intra-cluster spread to centroid separation."""
+    """Mean worst-case ratio of intra-cluster spread to centroid separation.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import davies_bouldin_score
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> result = davies_bouldin_score(data, labels)
+        >>> round(float(result), 4)
+        0.025
+    """
     data, labels, num_labels = _relabel(data, labels)
     centroids, counts = _centroids_counts(data, labels, num_labels)
     dists = jnp.sqrt(jnp.sum((data - centroids[labels]) ** 2, axis=1))
@@ -63,7 +83,17 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
 
 
 def dunn_index(data: Array, labels: Array, p: float = 2) -> Array:
-    """Min inter-centroid distance over max intra-cluster radius."""
+    """Min inter-centroid distance over max intra-cluster radius.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import dunn_index
+        >>> import jax.numpy as jnp
+        >>> data = jnp.asarray([[0.0, 0.1], [0.1, 0.0], [4.0, 4.1], [4.1, 4.0], [8.0, 8.1], [8.1, 8.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> result = dunn_index(data, labels)
+        >>> round(float(result), 4)
+        79.9997
+    """
     data, labels, num_labels = _relabel(data, labels)
     centroids, _ = _centroids_counts(data, labels, num_labels)
     diff = centroids[:, None, :] - centroids[None, :, :]

@@ -140,7 +140,17 @@ def intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> Array:
-    """Pairwise (or matched-mean) IoU (reference functional/detection/iou.py:41-95)."""
+    """Pairwise (or matched-mean) IoU (reference functional/detection/iou.py:41-95).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import intersection_over_union
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = intersection_over_union(preds, target)
+        >>> round(float(result), 4)
+        -0.0
+    """
     return _iou_family(box_iou, preds, target, iou_threshold, replacement_val, aggregate)
 
 
@@ -151,6 +161,18 @@ def generalized_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> Array:
+    """generalized intersection over union (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import generalized_intersection_over_union
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = generalized_intersection_over_union(preds, target)
+        >>> round(float(result), 4)
+        -19400000.0
+    """
+
     return _iou_family(generalized_box_iou, preds, target, iou_threshold, replacement_val, aggregate)
 
 
@@ -161,6 +183,18 @@ def distance_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> Array:
+    """distance intersection over union (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import distance_intersection_over_union
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = distance_intersection_over_union(preds, target)
+        >>> round(float(result), 4)
+        -0.1206
+    """
+
     return _iou_family(distance_box_iou, preds, target, iou_threshold, replacement_val, aggregate)
 
 
@@ -171,4 +205,16 @@ def complete_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> Array:
+    """complete intersection over union (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import complete_intersection_over_union
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> result = complete_intersection_over_union(preds, target)
+        >>> round(float(result), 4)
+        -1.9606
+    """
+
     return _iou_family(complete_box_iou, preds, target, iou_threshold, replacement_val, aggregate)

@@ -198,7 +198,17 @@ def panoptic_quality(
     return_sq_and_rq: bool = False,
     return_per_class: bool = False,
 ) -> Array:
-    """Functional PQ over ``(B, *spatial, 2)`` (category, instance) maps."""
+    """Functional PQ over ``(B, *spatial, 2)`` (category, instance) maps.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import panoptic_quality
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])
+        >>> result = panoptic_quality(preds, target, things={0}, stuffs={1})
+        >>> round(float(result), 4)
+        0.5
+    """
     things, stuffs = _parse_categories(things, stuffs)
     _validate_inputs(np.asarray(preds), np.asarray(target))
     void_color = _get_void_color(things, stuffs)
@@ -223,7 +233,17 @@ def modified_panoptic_quality(
     stuffs: Collection[int],
     allow_unknown_preds_category: bool = False,
 ) -> Array:
-    """Modified PQ: stuff classes score mean IoU over all overlaps (reference panoptic_qualities.py:182+)."""
+    """Modified PQ: stuff classes score mean IoU over all overlaps (reference panoptic_qualities.py:182+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import modified_panoptic_quality
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])
+        >>> result = modified_panoptic_quality(preds, target, things={0}, stuffs={1})
+        >>> round(float(result), 4)
+        0.625
+    """
     things, stuffs = _parse_categories(things, stuffs)
     _validate_inputs(np.asarray(preds), np.asarray(target))
     void_color = _get_void_color(things, stuffs)

@@ -32,6 +32,14 @@ def image_gradients(img: Array) -> Tuple[Array, Array]:
     """Compute gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image batch.
 
     Reference: functional/image/gradients.py:46-80.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import image_gradients
+        >>> import jax.numpy as jnp
+        >>> img = jnp.arange(1 * 1 * 4 * 4, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        >>> result = image_gradients(img)
+        >>> [v.shape for v in result]
+        [(1, 1, 4, 4), (1, 1, 4, 4)]
     """
     _image_gradients_validate(img)
     return _compute_image_gradients(img)

@@ -129,6 +129,15 @@ def learned_perceptual_image_patch_similarity(
     inputs in [-1, 1]. Build one with
     :func:`torchmetrics_tpu.models.lpips.lpips_network` (flax alex/vgg/squeeze
     backbones + lin heads) or pass any callable.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import learned_perceptual_image_patch_similarity
+        >>> import jax.numpy as jnp
+        >>> img1 = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> img2 = img1 * 0.7
+        >>> result = learned_perceptual_image_patch_similarity(img1, img2, net=lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3)))
+        >>> round(float(result), 4)
+        0.0297
     """
     if net is None:
         raise ModuleNotFoundError(

@@ -34,7 +34,17 @@ def _total_variation_update(img: Array) -> Tuple[Array, int]:
 
 
 def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
-    """Compute total variation (reference tv.py:43-77)."""
+    """Compute total variation (reference tv.py:43-77).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import total_variation
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = total_variation(preds)
+        >>> round(float(result), 4)
+        1288.4155
+    """
     score, num_elements = _total_variation_update(jnp.asarray(img, dtype=jnp.float32))
     if reduction == "sum":
         return score.sum()
@@ -53,7 +63,17 @@ def universal_image_quality_index(
     sigma: Sequence[float] = (1.5, 1.5),
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """UQI — SSIM with C1=C2=0 structure (reference uqi.py:84-118)."""
+    """UQI — SSIM with C1=C2=0 structure (reference uqi.py:84-118).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import universal_image_quality_index
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = universal_image_quality_index(preds, target)
+        >>> round(float(result), 4)
+        0.9216
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -103,7 +123,17 @@ def spectral_angle_mapper(
     target: Array,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """Per-pixel spectral angle over the channel axis, radians (reference sam.py)."""
+    """Per-pixel spectral angle over the channel axis, radians (reference sam.py).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import spectral_angle_mapper
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = spectral_angle_mapper(preds, target)
+        >>> round(float(result), 4)
+        0.0001
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -126,7 +156,17 @@ def error_relative_global_dimensionless_synthesis(
     ratio: float = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS (reference ergas.py:46-123)."""
+    """ERGAS (reference ergas.py:46-123).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = error_relative_global_dimensionless_synthesis(preds, target)
+        >>> round(float(result), 4)
+        9.6476
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -160,7 +200,17 @@ def root_mean_squared_error_using_sliding_window(
     window_size: int = 8,
     return_rmse_map: bool = False,
 ):
-    """Sliding-window RMSE (reference rmse_sw.py:111+)."""
+    """Sliding-window RMSE (reference rmse_sw.py:111+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import root_mean_squared_error_using_sliding_window
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = root_mean_squared_error_using_sliding_window(preds, target)
+        >>> round(float(result), 4)
+        0.1445
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -176,7 +226,17 @@ def root_mean_squared_error_using_sliding_window(
 
 # ----------------------------------------------------------------------- RASE
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
-    """RASE (reference rase.py): 100/μ · RMS of per-band sliding RMSE."""
+    """RASE (reference rase.py): 100/μ · RMS of per-band sliding RMSE.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import relative_average_spectral_error
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = relative_average_spectral_error(preds, target)
+        >>> round(float(result), 4)
+        2460.3965
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -243,7 +303,17 @@ def spatial_correlation_coefficient(
     window_size: int = 8,
     reduction: Optional[str] = "mean",
 ) -> Array:
-    """SCC (reference scc.py:169+)."""
+    """SCC (reference scc.py:169+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import spatial_correlation_coefficient
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = spatial_correlation_coefficient(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     if hp_filter is None:

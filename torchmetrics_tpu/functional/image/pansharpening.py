@@ -21,7 +21,17 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """D_lambda: inter-band UQI difference between fused and MS image (reference d_lambda.py)."""
+    """D_lambda: inter-band UQI difference between fused and MS image (reference d_lambda.py).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import spectral_distortion_index
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = spectral_distortion_index(preds, target)
+        >>> round(float(result), 4)
+        0.0
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     if preds.ndim != 4:
@@ -81,7 +91,18 @@ def spatial_distortion_index(
     window_size: int = 7,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """D_s: per-band UQI difference against the pan image (reference d_s.py)."""
+    """D_s: per-band UQI difference against the pan image (reference d_s.py).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import spatial_distortion_index
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0
+        >>> ms = preds[:, :, ::4, ::4] * 0.9
+        >>> pan = preds * 0.95
+        >>> result = spatial_distortion_index(preds, ms, pan)
+        >>> round(float(result), 4)
+        nan
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     ms = jnp.asarray(ms, dtype=jnp.float32)
     pan = jnp.asarray(pan, dtype=jnp.float32)
@@ -121,7 +142,18 @@ def quality_with_no_reference(
     window_size: int = 7,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """QNR = (1−D_λ)^α · (1−D_s)^β (reference qnr.py)."""
+    """QNR = (1−D_λ)^α · (1−D_s)^β (reference qnr.py).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import quality_with_no_reference
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0
+        >>> ms = preds[:, :, ::4, ::4] * 0.9
+        >>> pan = preds * 0.95
+        >>> result = quality_with_no_reference(preds, ms, pan)
+        >>> round(float(result), 4)
+        nan
+    """
     if not isinstance(alpha, (int, float)) or alpha < 0:
         raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
     if not isinstance(beta, (int, float)) or beta < 0:

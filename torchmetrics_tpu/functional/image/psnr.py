@@ -51,7 +51,17 @@ def peak_signal_noise_ratio(
     reduction: str = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """Compute PSNR (reference psnr.py:103-161)."""
+    """Compute PSNR (reference psnr.py:103-161).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import peak_signal_noise_ratio
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = peak_signal_noise_ratio(preds, target)
+        >>> round(float(result), 4)
+        14.322
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -107,7 +117,17 @@ def peak_signal_noise_ratio_with_blocked_effect(
     target: Array,
     block_size: int = 8,
 ) -> Array:
-    """PSNR-B: PSNR with blocking-effect penalty (reference psnrb.py:69-109)."""
+    """PSNR-B: PSNR with blocking-effect penalty (reference psnrb.py:69-109).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import peak_signal_noise_ratio_with_blocked_effect
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 1 * 32 * 32).reshape(1, 1, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = peak_signal_noise_ratio_with_blocked_effect(preds, target)
+        >>> round(float(result), 4)
+        7.5802
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)

@@ -157,7 +157,17 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ):
-    """Compute SSIM (reference ssim.py public entry)."""
+    """Compute SSIM (reference ssim.py public entry).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import structural_similarity_index_measure
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = structural_similarity_index_measure(preds, target)
+        >>> round(float(result), 4)
+        0.922
+    """
     preds, target = _ssim_check_inputs(preds, target)
     out = _ssim_update(
         preds,
@@ -201,7 +211,17 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = _MS_SSIM_BETAS,
     normalize: Optional[str] = "relu",
 ) -> Array:
-    """MS-SSIM over len(betas) scales (reference ssim.py:220+)."""
+    """MS-SSIM over len(betas) scales (reference ssim.py:220+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import multiscale_structural_similarity_index_measure
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = multiscale_structural_similarity_index_measure(preds, target, betas=(0.5, 0.5))
+        >>> round(float(result), 4)
+        0.941
+    """
     preds, target = _ssim_check_inputs(preds, target)
     if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
         raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")

@@ -64,7 +64,17 @@ def _vif_per_channel(preds: Array, target: Array, sigma_n_sq: float) -> Array:
 
 
 def visual_information_fidelity(preds: Array, target: Array, sigma_n_sq: float = 2.0) -> Array:
-    """Compute VIF-p (reference vif.py:85+)."""
+    """Compute VIF-p (reference vif.py:85+).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import visual_information_fidelity
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 3 * 48 * 48).reshape(1, 3, 48, 48) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> result = visual_information_fidelity(preds, target)
+        >>> round(float(result), 4)
+        1.7622
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     if preds.shape[-1] < 41 or preds.shape[-2] < 41:

@@ -188,7 +188,17 @@ def cramers_v(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Cramer's V: sqrt(phi^2 / min(r-1, k-1))."""
+    """Cramer's V: sqrt(phi^2 / min(r-1, k-1)).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import cramers_v
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> result = cramers_v(preds, target)
+        >>> round(float(result), 4)
+        0.6667
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
     return _cramers_v_compute(confmat, bias_correction)
@@ -215,7 +225,17 @@ def tschuprows_t(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Tschuprow's T: sqrt(phi^2 / sqrt((r-1)(k-1)))."""
+    """Tschuprow's T: sqrt(phi^2 / sqrt((r-1)(k-1))).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import tschuprows_t
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> result = tschuprows_t(preds, target)
+        >>> round(float(result), 4)
+        0.6667
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
     return _tschuprows_t_compute(confmat, bias_correction)
@@ -234,7 +254,17 @@ def pearsons_contingency_coefficient(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pearson's contingency coefficient: sqrt(phi^2 / (1 + phi^2))."""
+    """Pearson's contingency coefficient: sqrt(phi^2 / (1 + phi^2)).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pearsons_contingency_coefficient
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> result = pearsons_contingency_coefficient(preds, target)
+        >>> round(float(result), 4)
+        0.7559
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
     return _pearsons_contingency_coefficient_compute(confmat)
@@ -265,7 +295,17 @@ def theils_u(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Theil's U (uncertainty coefficient): (H(X) - H(X|Y)) / H(X). Asymmetric."""
+    """Theil's U (uncertainty coefficient): (H(X) - H(X|Y)) / H(X). Asymmetric.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import theils_u
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> result = theils_u(preds, target)
+        >>> round(float(result), 4)
+        0.7103
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_confmat_from_values(preds, target, nan_strategy, nan_replace_value)
     return _theils_u_compute(confmat)
@@ -291,7 +331,16 @@ def cramers_v_matrix(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pairwise Cramer's V over feature columns."""
+    """Pairwise Cramer's V over feature columns.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import cramers_v_matrix
+        >>> import jax.numpy as jnp
+        >>> matrix = jnp.asarray([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> result = cramers_v_matrix(matrix)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 0.0], [0.0, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     return _matrix_variant(
         cramers_v, matrix, True, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
@@ -304,7 +353,16 @@ def tschuprows_t_matrix(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pairwise Tschuprow's T over feature columns."""
+    """Pairwise Tschuprow's T over feature columns.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import tschuprows_t_matrix
+        >>> import jax.numpy as jnp
+        >>> matrix = jnp.asarray([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> result = tschuprows_t_matrix(matrix)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 0.0], [0.0, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     return _matrix_variant(
         tschuprows_t, matrix, True, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
@@ -314,7 +372,16 @@ def tschuprows_t_matrix(
 def pearsons_contingency_coefficient_matrix(
     matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Pairwise Pearson contingency coefficient over feature columns."""
+    """Pairwise Pearson contingency coefficient over feature columns.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pearsons_contingency_coefficient_matrix
+        >>> import jax.numpy as jnp
+        >>> matrix = jnp.asarray([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> result = pearsons_contingency_coefficient_matrix(matrix)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 0.5773999691009521], [0.5773999691009521, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     return _matrix_variant(
         pearsons_contingency_coefficient, matrix, True, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
@@ -324,7 +391,16 @@ def pearsons_contingency_coefficient_matrix(
 def theils_u_matrix(
     matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> Array:
-    """Pairwise (asymmetric) Theil's U over feature columns."""
+    """Pairwise (asymmetric) Theil's U over feature columns.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import theils_u_matrix
+        >>> import jax.numpy as jnp
+        >>> matrix = jnp.asarray([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> result = theils_u_matrix(matrix)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 0.36910000443458557], [0.36910000443458557, 1.0]]
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     return _matrix_variant(theils_u, matrix, False, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)
 
@@ -365,7 +441,16 @@ def _fleiss_kappa_compute(counts: Array) -> Array:
 
 
 def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
-    """Fleiss kappa inter-rater agreement over a [n_samples, n_categories] counts matrix."""
+    """Fleiss kappa inter-rater agreement over a [n_samples, n_categories] counts matrix.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import fleiss_kappa
+        >>> import jax.numpy as jnp
+        >>> ratings = jnp.asarray([[2, 1, 0], [1, 2, 0], [0, 1, 2], [3, 0, 0]])
+        >>> result = fleiss_kappa(ratings)
+        >>> round(float(result), 4)
+        0.1818
+    """
     if mode not in ["counts", "probs"]:
         raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
     counts = _fleiss_kappa_update(ratings, mode)

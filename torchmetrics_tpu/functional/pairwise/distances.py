@@ -43,6 +43,18 @@ def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> 
 def pairwise_cosine_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
+    """pairwise cosine similarity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pairwise_cosine_similarity
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        >>> result = pairwise_cosine_similarity(x, y)
+        >>> jnp.round(result, 4).tolist()
+        [[0.948699951171875, 0.948699951171875, 0.948699951171875], [0.9898999929428101, 0.9898999929428101, 0.9898999929428101]]
+    """
+
     x = jnp.asarray(x, dtype=jnp.float32)
     y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
@@ -57,6 +69,18 @@ def pairwise_cosine_similarity(
 def pairwise_euclidean_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
+    """pairwise euclidean distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pairwise_euclidean_distance
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        >>> result = pairwise_euclidean_distance(x, y)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 1.0, 2.2360999584198], [3.605599880218506, 2.2360999584198, 1.0]]
+    """
+
     x = jnp.asarray(x, dtype=jnp.float32)
     y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
@@ -72,6 +96,18 @@ def pairwise_euclidean_distance(
 def pairwise_manhattan_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
+    """pairwise manhattan distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pairwise_manhattan_distance
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        >>> result = pairwise_manhattan_distance(x, y)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 1.0, 3.0], [5.0, 3.0, 1.0]]
+    """
+
     x = jnp.asarray(x, dtype=jnp.float32)
     y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
@@ -84,6 +120,18 @@ def pairwise_manhattan_distance(
 def pairwise_linear_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
+    """pairwise linear similarity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pairwise_linear_similarity
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        >>> result = pairwise_linear_similarity(x, y)
+        >>> jnp.round(result, 4).tolist()
+        [[3.0, 6.0, 9.0], [7.0, 14.0, 21.0]]
+    """
+
     x = jnp.asarray(x, dtype=jnp.float32)
     y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
@@ -100,6 +148,18 @@ def pairwise_minkowski_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
+    """pairwise minkowski distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pairwise_minkowski_distance
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        >>> result = pairwise_minkowski_distance(x, y, exponent=3)
+        >>> jnp.round(result, 4).tolist()
+        [[1.0, 1.0, 2.0801000595092773], [3.271099805831909, 2.0801000595092773, 1.0]]
+    """
+
     x = jnp.asarray(x, dtype=jnp.float32)
     y = jnp.asarray(y, dtype=jnp.float32) if y is not None else None
     if not (isinstance(exponent, (float, int)) and exponent >= 1):

@@ -29,6 +29,18 @@ def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Union[int, Array
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """mean absolute error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import mean_absolute_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = mean_absolute_error(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     sum_abs_error, num_obs = _mean_absolute_error_update(jnp.asarray(preds), jnp.asarray(target))
     return _mean_absolute_error_compute(sum_abs_error, num_obs)
 
@@ -70,6 +82,18 @@ def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, 
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """mean squared log error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import mean_squared_log_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = mean_squared_log_error(preds, target)
+        >>> round(float(result), 4)
+        0.128
+    """
+
     s, n = _mean_squared_log_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
     return s / n
 
@@ -84,6 +108,18 @@ def _mean_absolute_percentage_error_update(
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """mean absolute percentage error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import mean_absolute_percentage_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = mean_absolute_percentage_error(preds, target)
+        >>> round(float(result), 4)
+        0.3274
+    """
+
     s, n = _mean_absolute_percentage_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
     return s / n
 
@@ -98,6 +134,18 @@ def _symmetric_mean_absolute_percentage_error_update(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """symmetric mean absolute percentage error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = symmetric_mean_absolute_percentage_error(preds, target)
+        >>> round(float(result), 4)
+        0.5788
+    """
+
     s, n = _symmetric_mean_absolute_percentage_error_update(
         jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
     )
@@ -111,6 +159,18 @@ def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array)
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """weighted mean absolute percentage error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = weighted_mean_absolute_percentage_error(preds, target)
+        >>> round(float(result), 4)
+        0.16
+    """
+
     s, t = _weighted_mean_absolute_percentage_error_update(
         jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
     )
@@ -130,6 +190,18 @@ def _relative_squared_error_compute(
 
 
 def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """relative squared error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import relative_squared_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = relative_squared_error(preds, target)
+        >>> round(float(result), 4)
+        0.0514
+    """
+
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -152,6 +224,18 @@ def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tup
 
 
 def log_cosh_error(preds: Array, target: Array) -> Array:
+    """log cosh error (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import log_cosh_error
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = log_cosh_error(preds, target)
+        >>> round(float(result), 4)
+        0.1685
+    """
+
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
@@ -168,6 +252,18 @@ def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
 
 
 def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
+    """minkowski distance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import minkowski_distance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = minkowski_distance(preds, target, p=3)
+        >>> round(float(result), 4)
+        1.0772
+    """
+
     s = _minkowski_distance_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), p)
     return s ** (1.0 / p)
 
@@ -199,6 +295,18 @@ def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0
 
 
 def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    """tweedie deviance score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import tweedie_deviance_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = tweedie_deviance_score(preds, target)
+        >>> round(float(result), 4)
+        0.375
+    """
+
     s, n = _tweedie_deviance_score_update(
         jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), power
     )
@@ -225,6 +333,18 @@ def _critical_success_index_update(
 def critical_success_index(
     preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
 ) -> Array:
+    """critical success index (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import critical_success_index
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = critical_success_index(preds, target, threshold=0.5)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     hits, misses, false_alarms = _critical_success_index_update(
         jnp.asarray(preds), jnp.asarray(target), threshold, keep_sequence_dim
     )

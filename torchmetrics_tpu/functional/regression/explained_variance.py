@@ -55,6 +55,18 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """explained variance (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import explained_variance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = explained_variance(preds, target)
+        >>> round(float(result), 4)
+        0.9572
+    """
+
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
     num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(

@@ -24,6 +24,18 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """cosine similarity (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import cosine_similarity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [0.0, 1.0, 0.5]])
+        >>> target = jnp.asarray([[1.0, 2.0, 2.5], [0.0, 1.0, 1.0]])
+        >>> result = cosine_similarity(preds, target)
+        >>> round(float(result), 4)
+        1.9447
+    """
+
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -57,6 +69,16 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 
 
 def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
-    """KL(P‖Q) (reference kl_divergence.py)."""
+    """KL(P‖Q) (reference kl_divergence.py).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import kl_divergence
+        >>> import jax.numpy as jnp
+        >>> p = jnp.asarray([[0.3, 0.3, 0.4]])
+        >>> q = jnp.asarray([[0.25, 0.5, 0.25]])
+        >>> result = kl_divergence(p, q)
+        >>> round(float(result), 4)
+        0.0895
+    """
     measures, total = _kld_update(jnp.asarray(p, dtype=jnp.float32), jnp.asarray(q, dtype=jnp.float32), log_prob)
     return _kld_compute(measures, total, reduction)

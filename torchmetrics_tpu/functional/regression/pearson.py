@@ -86,7 +86,17 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Compute Pearson correlation coefficient (reference pearson.py:106)."""
+    """Compute Pearson correlation coefficient (reference pearson.py:106).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import pearson_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = pearson_corrcoef(preds, target)
+        >>> round(float(result), 4)
+        0.9849
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     d = preds.shape[1] if preds.ndim == 2 else 1

@@ -72,5 +72,17 @@ def r2_score(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
+    """r2 score (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import r2_score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = r2_score(preds, target)
+        >>> round(float(result), 4)
+        0.9486
+    """
+
     sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(jnp.asarray(preds), jnp.asarray(target))
     return _r2_score_compute(sum_squared_obs, sum_obs, residual, num_obs, adjusted, multioutput)

@@ -50,6 +50,18 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-0
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """spearman corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import spearman_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = spearman_corrcoef(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -89,7 +101,17 @@ def kendall_rank_corrcoef(
     t_test: bool = False,
     alternative: Optional[str] = "two-sided",
 ) -> Array:
-    """Kendall rank correlation (reference kendall.py). ``t_test`` returns (tau, p)."""
+    """Kendall rank correlation (reference kendall.py). ``t_test`` returns (tau, p).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import kendall_rank_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = kendall_rank_corrcoef(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     _check_same_shape(preds, target)
@@ -127,6 +149,18 @@ def _concordance_corrcoef_compute(
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """concordance corrcoef (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import concordance_corrcoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> result = concordance_corrcoef(preds, target)
+        >>> round(float(result), 4)
+        0.9777
+    """
+
     preds = jnp.asarray(preds, dtype=jnp.float32)
     target = jnp.asarray(target, dtype=jnp.float32)
     d = preds.shape[1] if preds.ndim == 2 else 1

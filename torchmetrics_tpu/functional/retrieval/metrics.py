@@ -59,6 +59,18 @@ def _one_row(preds: Array, target: Array):
 def retrieval_precision(
     preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Array:
+    """retrieval precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_precision(preds, target)
+        >>> round(float(result), 4)
+        0.4
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
@@ -68,6 +80,18 @@ def retrieval_precision(
 
 
 def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval recall (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_recall
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_recall(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     _, ranked_target, counts = _one_row(preds, target)
@@ -75,6 +99,18 @@ def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -
 
 
 def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval fall out (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_fall_out
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_fall_out(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     _, ranked_target, counts = _one_row(preds, target)
@@ -82,6 +118,18 @@ def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None)
 
 
 def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval hit rate (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_hit_rate
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_hit_rate(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     _, ranked_target, counts = _one_row(preds, target)
@@ -89,6 +137,18 @@ def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None)
 
 
 def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval average precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_average_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_average_precision(preds, target)
+        >>> round(float(result), 4)
+        0.8333
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     _, ranked_target, counts = _one_row(preds, target)
@@ -96,6 +156,18 @@ def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval reciprocal rank (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_reciprocal_rank
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_reciprocal_rank(preds, target)
+        >>> round(float(result), 4)
+        1.0
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     _, ranked_target, counts = _one_row(preds, target)
@@ -103,12 +175,36 @@ def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] 
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """retrieval r precision (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_r_precision
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_r_precision(preds, target)
+        >>> round(float(result), 4)
+        0.5
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _, ranked_target, counts = _one_row(preds, target)
     return r_precision_padded(ranked_target, counts)[0]
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """retrieval normalized dcg (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_normalized_dcg
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_normalized_dcg(preds, target)
+        >>> round(float(result), 4)
+        0.9599
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     _check_top_k(top_k)
     ranked_preds, ranked_target, counts = _one_row(preds, target)
@@ -118,6 +214,18 @@ def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] =
 def retrieval_auroc(
     preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
 ) -> Array:
+    """retrieval auroc (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_auroc
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_auroc(preds, target)
+        >>> round(float(result), 4)
+        0.9167
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _check_top_k(top_k)
     if max_fpr is not None:
@@ -136,6 +244,18 @@ def retrieval_auroc(
 def retrieval_precision_recall_curve(
     preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Tuple[Array, Array, Array]:
+    """retrieval precision recall curve (functional interface).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import retrieval_precision_recall_curve
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> result = retrieval_precision_recall_curve(preds, target)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
+        [[1.0, 0.5, 0.666700005531311, 0.5, 0.3999999761581421], [0.5, 0.5, 1.0, 1.0, 1.0], [1, 2, 3, 4, 5]]
+    """
+
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")

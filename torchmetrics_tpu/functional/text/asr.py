@@ -61,7 +61,17 @@ def _cer_compute(errors: Array, total: Array) -> Array:
 
 
 def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """CER over reference characters (reference cer.py:51-87)."""
+    """CER over reference characters (reference cer.py:51-87).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import char_error_rate
+        >>> import jax.numpy as jnp
+        >>> preds = ["this is the answer", "hello duck"]
+        >>> target = ["this was the answer", "hello world"]
+        >>> result = char_error_rate(preds, target)
+        >>> round(float(result), 4)
+        0.2333
+    """
     errors, total = _cer_update(preds, target)
     return _cer_compute(errors, total)
 
@@ -85,7 +95,17 @@ def _mer_compute(errors: Array, total: Array) -> Array:
 
 
 def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """Match error rate (reference mer.py:66-91)."""
+    """Match error rate (reference mer.py:66-91).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import match_error_rate
+        >>> import jax.numpy as jnp
+        >>> preds = ["this is the answer", "hello duck"]
+        >>> target = ["this was the answer", "hello world"]
+        >>> result = match_error_rate(preds, target)
+        >>> round(float(result), 4)
+        0.3333
+    """
     errors, total = _mer_update(preds, target)
     return _mer_compute(errors, total)
 
@@ -128,12 +148,32 @@ def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 
 def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WIL = 1 - (H/N_ref)(H/N_hyp) (reference wil.py:57-94)."""
+    """WIL = 1 - (H/N_ref)(H/N_hyp) (reference wil.py:57-94).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_information_lost
+        >>> import jax.numpy as jnp
+        >>> preds = ["this is the answer", "hello duck"]
+        >>> target = ["this was the answer", "hello world"]
+        >>> result = word_information_lost(preds, target)
+        >>> round(float(result), 4)
+        0.5556
+    """
     errors, target_total, preds_total = _word_info_update(preds, target)
     return _wil_compute(errors, target_total, preds_total)
 
 
 def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WIP = (H/N_ref)(H/N_hyp) (reference wip.py:57-93)."""
+    """WIP = (H/N_ref)(H/N_hyp) (reference wip.py:57-93).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_information_preserved
+        >>> import jax.numpy as jnp
+        >>> preds = ["this is the answer", "hello duck"]
+        >>> target = ["this was the answer", "hello world"]
+        >>> result = word_information_preserved(preds, target)
+        >>> round(float(result), 4)
+        0.4444
+    """
     errors, target_total, preds_total = _word_info_update(preds, target)
     return _wip_compute(errors, target_total, preds_total)

@@ -112,7 +112,17 @@ def bleu_score(
     smooth: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """Corpus BLEU of machine-translated text (reference bleu.py:149-209)."""
+    """Corpus BLEU of machine-translated text (reference bleu.py:149-209).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import bleu_score
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = bleu_score(preds, target)
+        >>> round(float(result), 4)
+        0.7598
+    """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds_) != len(target_):
@@ -254,7 +264,17 @@ def sacre_bleu_score(
     lowercase: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """SacreBLEU: BLEU with the standardized tokenizers (sacre_bleu.py:458-532)."""
+    """SacreBLEU: BLEU with the standardized tokenizers (sacre_bleu.py:458-532).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import sacre_bleu_score
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = sacre_bleu_score(preds, target)
+        >>> round(float(result), 4)
+        0.7598
+    """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
     if weights is not None and len(weights) != n_gram:

@@ -157,7 +157,17 @@ def chrf_score(
     whitespace: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """chrF/chrF++ score (reference chrf.py:477-649)."""
+    """chrF/chrF++ score (reference chrf.py:477-649).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import chrf_score
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = chrf_score(preds, target)
+        >>> round(float(result), 4)
+        0.8713
+    """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
     if not isinstance(n_word_order, int) or n_word_order < 0:

@@ -60,7 +60,16 @@ def edit_distance(
     substitution_cost: int = 1,
     reduction: Optional[str] = "mean",
 ) -> Array:
-    """Char-level Levenshtein distance over a batch (reference edit.py:65-119)."""
+    """Char-level Levenshtein distance over a batch (reference edit.py:65-119).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import edit_distance
+        >>> preds = ["kitten"]
+        >>> target = ["sitting"]
+        >>> result = edit_distance(preds, target)
+        >>> round(float(result), 4)
+        3.0
+    """
     distance = _edit_distance_update(preds, target, substitution_cost)
     return _edit_distance_compute(distance, num_elements=distance.size, reduction=reduction)
 
@@ -181,7 +190,17 @@ def extended_edit_distance(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Extended Edit Distance (reference eed.py:364-414)."""
+    """Extended Edit Distance (reference eed.py:364-414).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import extended_edit_distance
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = extended_edit_distance(preds, target)
+        >>> round(float(result), 4)
+        0.1452
+    """
     scores = _eed_update(preds, target, language, alpha, rho, deletion, insertion)
     corpus = _eed_compute(scores)
     if return_sentence_level_score:

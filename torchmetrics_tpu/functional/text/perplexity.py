@@ -66,6 +66,15 @@ def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) 
         preds: logits of shape [batch_size, seq_len, vocab_size]
         target: token ids of shape [batch_size, seq_len]
         ignore_index: target id excluded from the score (e.g. padding)
+
+    Example:
+        >>> from torchmetrics_tpu.functional import perplexity
+        >>> import jax.numpy as jnp
+        >>> probs = jnp.full((1, 4, 6), 1 / 6)
+        >>> target = jnp.asarray([[0, 1, 2, 3]])
+        >>> result = perplexity(probs, target)
+        >>> round(float(result), 4)
+        6.0
     """
     total, count = _perplexity_update(jnp.asarray(preds), jnp.asarray(target), ignore_index)
     return _perplexity_compute(total, count)

@@ -224,7 +224,17 @@ def rouge_score(
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ) -> Dict[str, Array]:
-    """ROUGE score (reference rouge.py:420-524). Returns {key_precision/_recall/_fmeasure}."""
+    """ROUGE score (reference rouge.py:420-524). Returns {key_precision/_recall/_fmeasure}.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import rouge_score
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = rouge_score(preds, target)
+        >>> round(float(result['rouge1_fmeasure']), 4)
+        0.8333
+    """
     if use_stemmer:
         raise ValueError(
             "Stemming requires the `nltk` PorterStemmer which is not bundled; pass a custom `normalizer` instead."

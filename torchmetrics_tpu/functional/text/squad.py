@@ -136,7 +136,16 @@ def _squad_compute(f1: Array, exact_match: Array, total: Array) -> Dict[str, Arr
 
 
 def squad(preds: PREDS_TYPE, target: TARGETS_TYPE) -> Dict[str, Array]:
-    """SQuAD EM/F1 (reference squad.py:241-252)."""
+    """SQuAD EM/F1 (reference squad.py:241-252).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import squad
+        >>> preds = [{"prediction_text": "the panda", "id": "1"}]
+        >>> target = [{"answers": {"answer_start": [0], "text": ["the panda"]}, "id": "1"}]
+        >>> result = squad(preds, target)
+        >>> {k: round(float(v), 4) for k, v in result.items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
     preds_dict, target_dicts = _squad_input_check(preds, target)
     f1, exact_match, total = _squad_update(preds_dict, target_dicts)
     return _squad_compute(f1, exact_match, total)

@@ -316,7 +316,17 @@ def translation_edit_rate(
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """TER of translated text against references (reference ter.py:534-600)."""
+    """TER of translated text against references (reference ter.py:534-600).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import translation_edit_rate
+        >>> import jax.numpy as jnp
+        >>> preds = ["the cat sat on the mat"]
+        >>> target = [["a cat sat on the mat"]]
+        >>> result = translation_edit_rate(preds, target)
+        >>> round(float(result), 4)
+        0.1667
+    """
     if not isinstance(normalize, bool):
         raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
     if not isinstance(no_punctuation, bool):

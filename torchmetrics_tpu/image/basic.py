@@ -47,7 +47,18 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class PeakSignalNoiseRatio(Metric):
-    """PSNR (reference image/psnr.py)."""
+    """PSNR (reference image/psnr.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = PeakSignalNoiseRatio()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        14.322
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -119,7 +130,18 @@ class PeakSignalNoiseRatio(Metric):
 
 
 class PeakSignalNoiseRatioWithBlockedEffect(Metric):
-    """PSNR-B (reference image/psnrb.py)."""
+    """PSNR-B (reference image/psnrb.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 1 * 32 * 32).reshape(1, 1, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = PeakSignalNoiseRatioWithBlockedEffect()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        7.5802
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -154,7 +176,18 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
 
 
 class StructuralSimilarityIndexMeasure(Metric):
-    """SSIM (reference image/ssim.py:30)."""
+    """SSIM (reference image/ssim.py:30).
+
+    Example:
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = StructuralSimilarityIndexMeasure()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.922
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -234,7 +267,18 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM (reference image/ssim.py:220)."""
+    """MS-SSIM (reference image/ssim.py:220).
+
+    Example:
+        >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = MultiScaleStructuralSimilarityIndexMeasure(betas=(0.5, 0.5))
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.941
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -303,7 +347,18 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
 
 
 class TotalVariation(Metric):
-    """TV (reference image/tv.py)."""
+    """TV (reference image/tv.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import TotalVariation
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = TotalVariation()
+        >>> m.update(preds)
+        >>> round(float(m.compute()), 4)
+        1288.4155
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -357,7 +412,18 @@ class _PairListMetric(Metric):
 
 
 class UniversalImageQualityIndex(_PairListMetric):
-    """UQI (reference image/uqi.py)."""
+    """UQI (reference image/uqi.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import UniversalImageQualityIndex
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = UniversalImageQualityIndex()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.9216
+    """
 
     higher_is_better = True
     plot_lower_bound: float = 0.0
@@ -381,7 +447,18 @@ class UniversalImageQualityIndex(_PairListMetric):
 
 
 class SpectralAngleMapper(_PairListMetric):
-    """SAM (reference image/sam.py)."""
+    """SAM (reference image/sam.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import SpectralAngleMapper
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = SpectralAngleMapper()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0001
+    """
 
     higher_is_better = False
     plot_lower_bound: float = 0.0
@@ -397,7 +474,18 @@ class SpectralAngleMapper(_PairListMetric):
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(_PairListMetric):
-    """ERGAS (reference image/ergas.py)."""
+    """ERGAS (reference image/ergas.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        9.6476
+    """
 
     higher_is_better = False
     plot_lower_bound: float = 0.0
@@ -413,7 +501,18 @@ class ErrorRelativeGlobalDimensionlessSynthesis(_PairListMetric):
 
 
 class RootMeanSquaredErrorUsingSlidingWindow(Metric):
-    """RMSE-SW (reference image/rmse_sw.py) — streaming rmse-map states."""
+    """RMSE-SW (reference image/rmse_sw.py) — streaming rmse-map states.
+
+    Example:
+        >>> from torchmetrics_tpu.image import RootMeanSquaredErrorUsingSlidingWindow
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = RootMeanSquaredErrorUsingSlidingWindow()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.1445
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -440,7 +539,18 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
 
 
 class RelativeAverageSpectralError(_PairListMetric):
-    """RASE (reference image/rase.py)."""
+    """RASE (reference image/rase.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import RelativeAverageSpectralError
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = RelativeAverageSpectralError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        2460.3965
+    """
 
     higher_is_better = False
     plot_lower_bound: float = 0.0
@@ -457,7 +567,18 @@ class RelativeAverageSpectralError(_PairListMetric):
 
 
 class SpatialCorrelationCoefficient(Metric):
-    """SCC (reference image/scc.py)."""
+    """SCC (reference image/scc.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import SpatialCorrelationCoefficient
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = SpatialCorrelationCoefficient()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -484,7 +605,18 @@ class SpatialCorrelationCoefficient(Metric):
 
 
 class VisualInformationFidelity(Metric):
-    """VIF-p (reference image/vif.py)."""
+    """VIF-p (reference image/vif.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import VisualInformationFidelity
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = VisualInformationFidelity()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.7622
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -515,7 +647,18 @@ class VisualInformationFidelity(Metric):
 
 
 class SpectralDistortionIndex(_PairListMetric):
-    """D_lambda (reference image/d_lambda.py)."""
+    """D_lambda (reference image/d_lambda.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import SpectralDistortionIndex
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> target = preds * 0.75
+        >>> m = SpectralDistortionIndex()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -538,7 +681,18 @@ class SpectralDistortionIndex(_PairListMetric):
 
 
 class SpatialDistortionIndex(Metric):
-    """D_s (reference image/d_s.py)."""
+    """D_s (reference image/d_s.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import SpatialDistortionIndex
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0
+        >>> target = {'ms': preds[:, :, ::4, ::4] * 0.9, 'pan': preds * 0.95}
+        >>> m = SpatialDistortionIndex()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        nan
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -578,7 +732,18 @@ class SpatialDistortionIndex(Metric):
 
 
 class QualityWithNoReference(Metric):
-    """QNR (reference image/qnr.py)."""
+    """QNR (reference image/qnr.py).
+
+    Example:
+        >>> from torchmetrics_tpu.image import QualityWithNoReference
+        >>> import jax.numpy as jnp
+        >>> preds = (jnp.arange(1 * 3 * 32 * 32).reshape(1, 3, 32, 32) % 255) / 255.0
+        >>> target = {'ms': preds[:, :, ::4, ::4] * 0.9, 'pan': preds * 0.95}
+        >>> m = QualityWithNoReference()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        nan
+    """
 
     is_differentiable = True
     higher_is_better = True

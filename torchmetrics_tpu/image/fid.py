@@ -55,6 +55,18 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: keep real-image statistics across ``reset`` calls
             (reference fid.py:393-404).
         normalize: if True, expects float images in [0, 1].
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import FrechetInceptionDistance
+        >>> real = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> fake = real * 0.7
+        >>> fid = FrechetInceptionDistance(
+        ...     feature_extractor=lambda x: x.mean(axis=(2, 3)), num_features=3)
+        >>> fid.update(real, real=True)
+        >>> fid.update(fake, real=False)
+        >>> round(float(fid.compute()), 4)
+        0.0928
     """
 
     is_differentiable = False

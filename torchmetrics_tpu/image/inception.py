@@ -17,6 +17,20 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class InceptionScore(Metric):
+    """Inception Score over a pluggable logits extractor (reference image/inception.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import InceptionScore
+        >>> imgs = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> inception = InceptionScore(
+        ...     feature_extractor=lambda x: x.reshape(x.shape[0], -1)[:, :5], splits=2)
+        >>> inception.update(imgs)
+        >>> mean, std = inception.compute()
+        >>> round(float(mean), 4), round(float(std), 4)
+        (1.0, 0.0)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -35,6 +35,22 @@ def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:
 
 
 class KernelInceptionDistance(Metric):
+    """KID (polynomial-kernel MMD) over a pluggable feature extractor (reference image/kid.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import KernelInceptionDistance
+        >>> real = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> fake = real * 0.7
+        >>> kid = KernelInceptionDistance(
+        ...     feature_extractor=lambda x: x.mean(axis=(2, 3)), subsets=2, subset_size=3)
+        >>> kid.update(real, real=True)
+        >>> kid.update(fake, real=False)
+        >>> mean, std = kid.compute()
+        >>> round(float(mean), 4), round(float(std), 4)
+        (-0.072, 0.0)
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

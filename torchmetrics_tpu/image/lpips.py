@@ -29,6 +29,17 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         reduction: ``"mean"`` or ``"sum"`` over accumulated samples.
         normalize: if True inputs are expected in [0, 1] instead of [-1, 1]
             (reference lpip.py:131-133).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+        >>> img1 = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> img2 = img1 * 0.7
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(
+        ...     net=lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3)))
+        >>> lpips.update(img1, img2)
+        >>> round(float(lpips.compute()), 4)
+        0.0297
     """
 
     is_differentiable = True

@@ -54,6 +54,18 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         reset_real_features: keep real-feature cache across ``reset`` calls.
         cosine_distance_eps: penalty threshold (reference mifid.py:47).
         normalize: if True, expects float images in [0, 1].
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import MemorizationInformedFrechetInceptionDistance
+        >>> real = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> fake = 1.0 - real
+        >>> mifid = MemorizationInformedFrechetInceptionDistance(
+        ...     feature_extractor=lambda x: x.mean(axis=(2, 3)))
+        >>> mifid.update(real, real=True)
+        >>> mifid.update(fake, real=False)
+        >>> round(float(mifid.compute()), 4)
+        0.0032
     """
 
     is_differentiable = False

@@ -36,6 +36,24 @@ class PerceptualPathLength(Metric):
         lower_discard / upper_discard: distance quantiles to trim.
         sim_net: similarity callable ``(img1, img2) -> (N,)`` or net_type str.
         key: PRNG key for sampling (explicit JAX randomness).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PerceptualPathLength
+        >>> class ToyGen:
+        ...     def sample(self, key, n):
+        ...         return jax.random.normal(key, (n, 4))
+        ...     def __call__(self, z):  # images in [0, 255], NCHW
+        ...         return 127.5 * (1 + jnp.tanh(z[:, :3, None, None] * jnp.ones((1, 3, 8, 8))))
+        >>> ppl = PerceptualPathLength(
+        ...     num_samples=8, batch_size=4, resize=None,
+        ...     lower_discard=None, upper_discard=None,
+        ...     sim_net=lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3)),
+        ...     key=jax.random.PRNGKey(0))
+        >>> ppl.update(ToyGen())
+        >>> mean, std, raw = ppl.compute()
+        >>> round(float(mean), 4), round(float(std), 4)
+        (0.4552, 0.3889)
     """
 
     is_differentiable = False

@@ -81,6 +81,22 @@ class Metric:
             - ``distributed_available_fn``: override the world check.
             - ``sync_on_compute``: sync state automatically in ``compute`` (default True).
             - ``compute_with_cache``: cache the result of ``compute`` (default True).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Metric
+        >>> class SumAbsError(Metric):
+        ...     def __init__(self, **kwargs):
+        ...         super().__init__(**kwargs)
+        ...         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        ...     def update(self, preds, target):
+        ...         self.total = self.total + jnp.abs(preds - target).sum()
+        ...     def compute(self):
+        ...         return self.total
+        >>> metric = SumAbsError()
+        >>> metric.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5]))
+        >>> float(metric.compute())
+        1.0
     """
 
     __jit_unused_properties__: List[str] = ["is_differentiable"]
@@ -823,6 +839,14 @@ class CompositionalMetric(Metric):
     Reference metric.py:1109-1231: fans update/forward/reset/persistent out to
     child metrics and applies ``op`` to their compute results; its own sync is a
     no-op (children sync themselves).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy, BinaryPrecision
+        >>> combo = BinaryAccuracy() + BinaryPrecision()  # CompositionalMetric
+        >>> combo.update(jnp.asarray([0.2, 0.8, 0.3, 0.6]), jnp.asarray([0, 1, 1, 0]))
+        >>> round(float(combo.compute()), 4)
+        1.0
     """
 
     full_state_update: Optional[bool] = True

@@ -74,13 +74,45 @@ def _clip_score_update(images, text, embedding_fn: Callable) -> Tuple[Array, int
 
 
 def clip_score(images, text, embedding_fn: Callable) -> Array:
-    """Functional CLIPScore: mean 100*cosine(image, caption), floored at 0."""
+    """Functional CLIPScore: mean 100*cosine(image, caption), floored at 0.
+
+    Example:
+        >>> from torchmetrics_tpu.functional import clip_score
+        >>> import jax.numpy as jnp
+        >>> def embed(images, texts):
+        ...     img_f = jnp.stack([img.mean(axis=(1, 2)) for img in images])
+        ...     txt_f = jnp.asarray([[len(t), t.count('a'), 1.0] for t in texts], dtype=jnp.float32)
+        ...     return img_f, txt_f
+        >>> imgs = (jnp.arange(2 * 3 * 8 * 8).reshape(2, 3, 8, 8) % 255) / 255.0
+        >>> texts = ["a photo of a cat", "a photo of a dog"]
+        >>> result = clip_score(imgs, texts, embedding_fn=embed)
+        >>> round(float(result), 4)
+        62.4327
+    """
     score, n_samples = _clip_score_update(images, text, embedding_fn)
     return jnp.maximum(score.sum() / n_samples, 0.0)
 
 
 class CLIPScore(Metric):
-    """Mean CLIP image-caption alignment score (reference multimodal/clip_score.py:43-140)."""
+    """Mean CLIP image-caption alignment score (reference multimodal/clip_score.py:43-140).
+
+    ``embedding_fn(images, texts) -> (img_features, txt_features)`` supplies the
+    joint embedder — e.g. a transformers FlaxCLIPModel apply function, or any
+    callable as below.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.multimodal import CLIPScore
+        >>> def embed(images, texts):  # toy joint embedder
+        ...     img_f = jnp.stack([img.mean(axis=(1, 2)) for img in images])
+        ...     txt_f = jnp.asarray([[len(t), t.count("a"), 1.0] for t in texts], dtype=jnp.float32)
+        ...     return img_f, txt_f
+        >>> score = CLIPScore(embedding_fn=embed)
+        >>> imgs = (jnp.arange(2 * 3 * 8 * 8).reshape(2, 3, 8, 8) % 255) / 255.0
+        >>> score.update(imgs, ["a photo of a cat", "a photo of a dog"])
+        >>> round(float(score.compute()), 4)
+        62.4327
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True
@@ -159,7 +191,20 @@ def clip_image_quality_assessment(
 
 
 class CLIPImageQualityAssessment(Metric):
-    """Prompt-anchored no-reference image quality (reference multimodal/clip_iqa.py:56+)."""
+    """Prompt-anchored no-reference image quality (reference multimodal/clip_iqa.py:56+).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+        >>> iqa = CLIPImageQualityAssessment(
+        ...     image_embedding_fn=lambda imgs: imgs.mean(axis=(2, 3)),
+        ...     text_embedding_fn=lambda texts: jnp.asarray(
+        ...         [[len(t), t.count("o"), 1.0] for t in texts], dtype=jnp.float32))
+        >>> imgs = (jnp.arange(2 * 3 * 8 * 8).reshape(2, 3, 8, 8) % 255) / 255.0
+        >>> iqa.update(imgs)
+        >>> [round(float(x), 4) for x in iqa.compute()]
+        [0.9965, 0.1062]
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -54,6 +54,19 @@ class _ConfmatNominalMetric(Metric):
 
 
 class CramersV(_ConfmatNominalMetric):
+    """Cramers V (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> m = CramersV(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6667
+    """
+
     def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
         super().__init__(num_classes=num_classes, **kwargs)
         self.bias_correction = bias_correction
@@ -63,6 +76,19 @@ class CramersV(_ConfmatNominalMetric):
 
 
 class TschuprowsT(_ConfmatNominalMetric):
+    """Tschuprows T (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.nominal import TschuprowsT
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> m = TschuprowsT(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.6667
+    """
+
     def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
         super().__init__(num_classes=num_classes, **kwargs)
         self.bias_correction = bias_correction
@@ -72,16 +98,54 @@ class TschuprowsT(_ConfmatNominalMetric):
 
 
 class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
+    """Pearsons Contingency Coefficient (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.nominal import PearsonsContingencyCoefficient
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> m = PearsonsContingencyCoefficient(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.7559
+    """
+
     def compute(self) -> Array:
         return _pearsons_contingency_coefficient_compute(self.confmat)
 
 
 class TheilsU(_ConfmatNominalMetric):
+    """Theils U (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.nominal import TheilsU
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0])
+        >>> m = TheilsU(num_classes=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.7103
+    """
+
     def compute(self) -> Array:
         return _theils_u_compute(self.confmat)
 
 
 class FleissKappa(Metric):
+    """Fleiss Kappa (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.nominal import FleissKappa
+        >>> import jax.numpy as jnp
+        >>> ratings = jnp.asarray([[2, 1, 0], [1, 2, 0], [0, 1, 2], [3, 0, 0]])
+        >>> m = FleissKappa()
+        >>> m.update(ratings)
+        >>> round(float(m.compute()), 4)
+        0.1818
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -33,7 +33,18 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class PearsonCorrCoef(Metric):
-    """Pearson correlation (reference regression/pearson.py:73)."""
+    """Pearson correlation (reference regression/pearson.py:73).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = PearsonCorrCoef()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.9849
+    """
 
     is_differentiable = True
     higher_is_better = None
@@ -82,7 +93,18 @@ class PearsonCorrCoef(Metric):
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman correlation (reference regression/spearman.py): rank + Pearson."""
+    """Spearman correlation (reference regression/spearman.py): rank + Pearson.
+
+    Example:
+        >>> from torchmetrics_tpu.regression import SpearmanCorrCoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = SpearmanCorrCoef()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -109,7 +131,18 @@ class SpearmanCorrCoef(Metric):
 
 
 class KendallRankCorrCoef(Metric):
-    """Kendall tau (reference regression/kendall.py): list states, O(n²) kernel."""
+    """Kendall tau (reference regression/kendall.py): list states, O(n²) kernel.
+
+    Example:
+        >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = KendallRankCorrCoef()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = None
@@ -152,7 +185,18 @@ class KendallRankCorrCoef(Metric):
 
 
 class ConcordanceCorrCoef(Metric):
-    """Lin's concordance correlation (reference regression/concordance.py)."""
+    """Lin's concordance correlation (reference regression/concordance.py).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = ConcordanceCorrCoef()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.9777
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -208,7 +252,18 @@ class ConcordanceCorrCoef(Metric):
 
 
 class R2Score(Metric):
-    """R² (reference regression/r2.py)."""
+    """R² (reference regression/r2.py).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = R2Score()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.9486
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -245,7 +300,18 @@ class R2Score(Metric):
 
 
 class ExplainedVariance(Metric):
-    """Explained variance (reference regression/explained_variance.py)."""
+    """Explained variance (reference regression/explained_variance.py).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import ExplainedVariance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = ExplainedVariance()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.9572
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -28,6 +28,19 @@ from torchmetrics_tpu.utils.compute import _safe_divide
 
 
 class MeanAbsoluteError(Metric):
+    """Mean Absolute Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = MeanAbsoluteError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -51,6 +64,19 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredError(Metric):
+    """Mean Squared Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = MeanSquaredError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.375
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -80,6 +106,19 @@ class MeanSquaredError(Metric):
 
 
 class MeanSquaredLogError(Metric):
+    """Mean Squared Log Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = MeanSquaredLogError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.128
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -102,6 +141,19 @@ class MeanSquaredLogError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
+    """Mean Absolute Percentage Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import MeanAbsolutePercentageError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = MeanAbsolutePercentageError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.3274
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -124,6 +176,19 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
+    """Symmetric Mean Absolute Percentage Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import SymmetricMeanAbsolutePercentageError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = SymmetricMeanAbsolutePercentageError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.5788
+    """
+
     plot_upper_bound: float = 2.0
 
     def update(self, preds: Array, target: Array) -> None:
@@ -135,6 +200,19 @@ class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
+    """Weighted Mean Absolute Percentage Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import WeightedMeanAbsolutePercentageError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = WeightedMeanAbsolutePercentageError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.16
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -157,6 +235,19 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
 
 class RelativeSquaredError(Metric):
+    """Relative Squared Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import RelativeSquaredError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = RelativeSquaredError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.0514
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -186,6 +277,19 @@ class RelativeSquaredError(Metric):
 
 
 class LogCoshError(Metric):
+    """Log Cosh Error (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import LogCoshError
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = LogCoshError()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.1685
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -211,6 +315,19 @@ class LogCoshError(Metric):
 
 
 class MinkowskiDistance(Metric):
+    """Minkowski Distance (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = MinkowskiDistance(p=3)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0772
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -233,6 +350,19 @@ class MinkowskiDistance(Metric):
 
 
 class TweedieDevianceScore(Metric):
+    """Tweedie Deviance Score (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = TweedieDevianceScore()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        0.375
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -258,6 +388,19 @@ class TweedieDevianceScore(Metric):
 
 
 class CriticalSuccessIndex(Metric):
+    """Critical Success Index (modular interface, accumulating across updates).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import CriticalSuccessIndex
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> m = CriticalSuccessIndex(threshold=0.5)
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -12,7 +12,18 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class CosineSimilarity(Metric):
-    """Cosine similarity with list states (reference regression/cosine_similarity.py)."""
+    """Cosine similarity with list states (reference regression/cosine_similarity.py).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import CosineSimilarity
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [0.0, 1.0, 0.5]])
+        >>> target = jnp.asarray([[1.0, 2.0, 2.5], [0.0, 1.0, 1.0]])
+        >>> m = CosineSimilarity()
+        >>> m.update(preds, target)
+        >>> round(float(m.compute()), 4)
+        1.9447
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -40,7 +51,18 @@ class CosineSimilarity(Metric):
 
 
 class KLDivergence(Metric):
-    """KL divergence (reference regression/kl_divergence.py)."""
+    """KL divergence (reference regression/kl_divergence.py).
+
+    Example:
+        >>> from torchmetrics_tpu.regression import KLDivergence
+        >>> import jax.numpy as jnp
+        >>> p = jnp.asarray([[0.3, 0.3, 0.4]])
+        >>> q = jnp.asarray([[0.25, 0.5, 0.25]])
+        >>> m = KLDivergence()
+        >>> m.update(p, q)
+        >>> round(float(m.compute()), 4)
+        0.0895
+    """
 
     is_differentiable = True
     higher_is_better = False

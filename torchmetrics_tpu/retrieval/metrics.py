@@ -35,21 +35,57 @@ class _TopKRetrievalMetric(RetrievalMetric):
 
 
 class RetrievalMAP(_TopKRetrievalMetric):
-    """Mean average precision (reference retrieval/average_precision.py)."""
+    """Mean average precision (reference retrieval/average_precision.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalMAP()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
         return average_precision_padded(ranked_target, counts, self.top_k)
 
 
 class RetrievalMRR(_TopKRetrievalMetric):
-    """Mean reciprocal rank (reference retrieval/reciprocal_rank.py)."""
+    """Mean reciprocal rank (reference retrieval/reciprocal_rank.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalMRR()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
         return reciprocal_rank_padded(ranked_target, counts, self.top_k)
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """Precision@k (reference retrieval/precision.py)."""
+    """Precision@k (reference retrieval/precision.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecision
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalPrecision()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        0.4167
+    """
 
     def __init__(self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -64,14 +100,38 @@ class RetrievalPrecision(RetrievalMetric):
 
 
 class RetrievalRecall(_TopKRetrievalMetric):
-    """Recall@k (reference retrieval/recall.py)."""
+    """Recall@k (reference retrieval/recall.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecall
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalRecall()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
         return recall_padded(ranked_target, counts, self.top_k)
 
 
 class RetrievalFallOut(_TopKRetrievalMetric):
-    """Fall-out@k (reference retrieval/fall_out.py). Empty queries = no NEGATIVE target."""
+    """Fall-out@k (reference retrieval/fall_out.py). Empty queries = no NEGATIVE target.
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalFallOut
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalFallOut()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     higher_is_better = False
     _empty_target_kind = "negative"
@@ -86,21 +146,57 @@ class RetrievalFallOut(_TopKRetrievalMetric):
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
-    """Hit rate@k (reference retrieval/hit_rate.py)."""
+    """Hit rate@k (reference retrieval/hit_rate.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalHitRate
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalHitRate()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
         return hit_rate_padded(ranked_target, counts, self.top_k)
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-precision (reference retrieval/r_precision.py)."""
+    """R-precision (reference retrieval/r_precision.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalRPrecision
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalRPrecision()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def _metric_padded(self, ranked_preds: Array, ranked_target: Array, counts: Array) -> Array:
         return r_precision_padded(ranked_target, counts)
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
-    """nDCG with tie-averaged gains (reference retrieval/ndcg.py)."""
+    """nDCG with tie-averaged gains (reference retrieval/ndcg.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalNormalizedDCG()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     allow_non_binary_target = True
 
@@ -109,7 +205,19 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
 
 
 class RetrievalAUROC(_TopKRetrievalMetric):
-    """Per-query AUROC over retrieved docs (reference retrieval/auroc.py)."""
+    """Per-query AUROC over retrieved docs (reference retrieval/auroc.py).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalAUROC
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalAUROC()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> round(float(m.compute()), 4)
+        1.0
+    """
 
     def __init__(self, top_k: Optional[int] = None, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
         super().__init__(top_k=top_k, **kwargs)
@@ -134,7 +242,19 @@ class RetrievalAUROC(_TopKRetrievalMetric):
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
-    """Averaged precision/recall@k curves (reference retrieval/precision_recall_curve.py:63-255)."""
+    """Averaged precision/recall@k curves (reference retrieval/precision_recall_curve.py:63-255).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalPrecisionRecallCurve()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [[1.0, 0.5, 0.33329999446868896], [1.0, 1.0, 1.0], [1, 2, 3]]
+    """
 
     def __init__(
         self,
@@ -178,7 +298,19 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
-    """Max recall with precision >= min_precision (reference precision_recall_curve.py:296-391)."""
+    """Max recall with precision >= min_precision (reference precision_recall_curve.py:296-391).
+
+    Example:
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3])
+        >>> target = jnp.asarray([False, False, True, False, True])
+        >>> m = RetrievalRecallAtFixedPrecision()
+        >>> m.update(preds, target, indexes=indexes)
+        >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
+        [1.0, 3]
+    """
 
     def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(max_k=max_k, **kwargs)

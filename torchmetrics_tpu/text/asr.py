@@ -24,7 +24,16 @@ from torchmetrics_tpu.metric import Metric
 
 
 class WordErrorRate(Metric):
-    """Word error rate (reference text/wer.py:28)."""
+    """Word error rate (reference text/wer.py:28).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> wer = WordErrorRate()
+        >>> wer.update(["this is the answer", "hello duck"],
+        ...            ["this was the answer", "hello world"])
+        >>> round(float(wer.compute()), 4)
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -46,7 +55,16 @@ class WordErrorRate(Metric):
 
 
 class CharErrorRate(Metric):
-    """Character error rate (reference text/cer.py:28)."""
+    """Character error rate (reference text/cer.py:28).
+
+    Example:
+        >>> from torchmetrics_tpu.text import CharErrorRate
+        >>> cer = CharErrorRate()
+        >>> cer.update(["this is the answer", "hello duck"],
+        ...            ["this was the answer", "hello world"])
+        >>> round(float(cer.compute()), 4)
+        0.2333
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -68,7 +86,16 @@ class CharErrorRate(Metric):
 
 
 class MatchErrorRate(Metric):
-    """Match error rate (reference text/mer.py:28)."""
+    """Match error rate (reference text/mer.py:28).
+
+    Example:
+        >>> from torchmetrics_tpu.text import MatchErrorRate
+        >>> mer = MatchErrorRate()
+        >>> mer.update(["this is the answer", "hello duck"],
+        ...            ["this was the answer", "hello world"])
+        >>> round(float(mer.compute()), 4)
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -91,7 +118,16 @@ class MatchErrorRate(Metric):
 
 
 class WordInfoLost(Metric):
-    """Word information lost (reference text/wil.py:27)."""
+    """Word information lost (reference text/wil.py:27).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoLost
+        >>> wil = WordInfoLost()
+        >>> wil.update(["this is the answer", "hello duck"],
+        ...            ["this was the answer", "hello world"])
+        >>> round(float(wil.compute()), 4)
+        0.5556
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -116,7 +152,16 @@ class WordInfoLost(Metric):
 
 
 class WordInfoPreserved(Metric):
-    """Word information preserved (reference text/wip.py:27)."""
+    """Word information preserved (reference text/wip.py:27).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoPreserved
+        >>> wip = WordInfoPreserved()
+        >>> wip.update(["this is the answer", "hello duck"],
+        ...            ["this was the answer", "hello world"])
+        >>> round(float(wip.compute()), 4)
+        0.4444
+    """
 
     is_differentiable = False
     higher_is_better = True

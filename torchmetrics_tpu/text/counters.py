@@ -32,7 +32,15 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 class BLEUScore(Metric):
-    """BLEU (reference text/bleu.py:33)."""
+    """BLEU (reference text/bleu.py:33).
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> bleu = BLEUScore()
+        >>> bleu.update(["the cat sat on the mat"], [["a cat sat on the mat"]])
+        >>> round(float(bleu.compute()), 4)
+        0.7598
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -78,7 +86,15 @@ class BLEUScore(Metric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """SacreBLEU (reference text/sacre_bleu.py:34) — BLEU + standardized tokenizers."""
+    """SacreBLEU (reference text/sacre_bleu.py:34) — BLEU + standardized tokenizers.
+
+    Example:
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> bleu = SacreBLEUScore(tokenize="13a")
+        >>> bleu.update(["the cat sat on the mat"], [["a cat sat on the mat"]])
+        >>> round(float(bleu.compute()), 4)
+        0.7598
+    """
 
     def __init__(
         self,
@@ -103,6 +119,13 @@ class CHRFScore(Metric):
 
     State layout redesign: six dense per-order vectors instead of the
     reference's 6×order scalar dict states — one psum each.
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> chrf = CHRFScore()
+        >>> chrf.update(["the cat sat on the mat"], [["a cat sat on the mat"]])
+        >>> round(float(chrf.compute()), 4)
+        0.8713
     """
 
     is_differentiable = False
@@ -176,7 +199,15 @@ class CHRFScore(Metric):
 
 
 class TranslationEditRate(Metric):
-    """TER (reference text/ter.py:29)."""
+    """TER (reference text/ter.py:29).
+
+    Example:
+        >>> from torchmetrics_tpu.text import TranslationEditRate
+        >>> ter = TranslationEditRate()
+        >>> ter.update(["the cat sat on the mat"], [["a cat sat on the mat"]])
+        >>> round(float(ter.compute()), 4)
+        0.1667
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -225,7 +256,15 @@ class TranslationEditRate(Metric):
 
 
 class EditDistance(Metric):
-    """Levenshtein edit distance (reference text/edit.py:29)."""
+    """Levenshtein edit distance (reference text/edit.py:29).
+
+    Example:
+        >>> from torchmetrics_tpu.text import EditDistance
+        >>> ed = EditDistance()
+        >>> ed.update(["kitten"], ["sitting"])
+        >>> float(ed.compute())
+        3.0
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -269,7 +308,15 @@ class EditDistance(Metric):
 
 
 class ExtendedEditDistance(Metric):
-    """EED (reference text/eed.py:28)."""
+    """EED (reference text/eed.py:28).
+
+    Example:
+        >>> from torchmetrics_tpu.text import ExtendedEditDistance
+        >>> eed = ExtendedEditDistance()
+        >>> eed.update(["the cat sat on the mat"], [["a cat sat on the mat"]])
+        >>> round(float(eed.compute()), 4)
+        0.1452
+    """
 
     is_differentiable = False
     higher_is_better = False

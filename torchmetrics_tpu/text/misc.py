@@ -31,6 +31,14 @@ class Perplexity(Metric):
     """Perplexity — fully device-native; update traces into jitted steps.
 
     Reference text/perplexity.py:28-110.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> ppl = Perplexity()
+        >>> ppl.update(jnp.full((1, 4, 6), 1 / 6), jnp.asarray([[0, 1, 2, 3]]))
+        >>> round(float(ppl.compute()), 2)  # uniform over 6 tokens
+        6.0
     """
 
     is_differentiable = True
@@ -56,7 +64,17 @@ class Perplexity(Metric):
 
 
 class SQuAD(Metric):
-    """SQuAD EM/F1 (reference text/squad.py:34)."""
+    """SQuAD EM/F1 (reference text/squad.py:34).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SQuAD
+        >>> squad = SQuAD()
+        >>> preds = [{"prediction_text": "the panda", "id": "1"}]
+        >>> target = [{"answers": {"answer_start": [0], "text": ["the panda"]}, "id": "1"}]
+        >>> squad.update(preds, target)
+        >>> {k: float(v) for k, v in squad.compute().items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -82,7 +100,15 @@ class SQuAD(Metric):
 
 
 class ROUGEScore(Metric):
-    """ROUGE (reference text/rouge.py:36). Per-key score list states (cat)."""
+    """ROUGE (reference text/rouge.py:36). Per-key score list states (cat).
+
+    Example:
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> rouge.update(["the cat sat on the mat"], ["a cat sat on the mat"])
+        >>> round(float(rouge.compute()["rouge1_fmeasure"]), 4)
+        0.8333
+    """
 
     is_differentiable = False
     higher_is_better = True

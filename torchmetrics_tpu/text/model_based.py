@@ -21,7 +21,33 @@ from torchmetrics_tpu.metric import Metric
 
 
 class BERTScore(Metric):
-    """BERTScore (reference text/bert.py:54)."""
+    """BERTScore (reference text/bert.py:54).
+
+    Runs with any embedder via ``user_model`` (the reference's own escape hatch,
+    bert.py:76-77) or a local-cache HF checkpoint via ``model_name_or_path``.
+
+    Example:
+        >>> import jax.numpy as jnp, zlib
+        >>> from torchmetrics_tpu.text import BERTScore
+        >>> def user_model(sentences):  # deterministic toy embedder
+        ...     embs, masks = [], []
+        ...     max_len = max(len(s.split()) for s in sentences)
+        ...     for s in sentences:
+        ...         toks = s.split()
+        ...         vecs = []
+        ...         for t in toks:
+        ...             h = zlib.crc32(t.encode())
+        ...             v = jnp.asarray([(h >> i) & 0xFF for i in (0, 8, 16)], dtype=jnp.float32)
+        ...             vecs.append(v / jnp.linalg.norm(v))
+        ...         pad = [jnp.zeros(3)] * (max_len - len(toks))
+        ...         embs.append(jnp.stack(vecs + pad))
+        ...         masks.append(jnp.asarray([1] * len(toks) + [0] * (max_len - len(toks))))
+        ...     return jnp.stack(embs), jnp.stack(masks)
+        >>> bert = BERTScore(user_model=user_model)
+        >>> bert.update(["the cat sat"], ["a cat sat"])
+        >>> {k: round(float(v), 4) for k, v in bert.compute().items()}
+        {'f1': 0.9739, 'precision': 0.9918, 'recall': 0.9567}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -97,7 +123,26 @@ class BERTScore(Metric):
 
 
 class InfoLM(Metric):
-    """InfoLM (reference text/infolm.py:41)."""
+    """InfoLM (reference text/infolm.py:41).
+
+    ``user_model`` maps a list of sentences to per-sentence masked-LM
+    distributions; any information measure from the reference set applies.
+
+    Example:
+        >>> import jax.numpy as jnp, zlib
+        >>> from torchmetrics_tpu.text import InfoLM
+        >>> def mlm_dist(sentences):  # deterministic toy distribution
+        ...     out = []
+        ...     for s in sentences:
+        ...         h = zlib.crc32(s.encode())
+        ...         logits = jnp.asarray([(h >> i) & 0xFF for i in (0, 4, 8, 12)], dtype=jnp.float32)
+        ...         out.append(logits / logits.sum())
+        ...     return jnp.stack(out)
+        >>> ilm = InfoLM(user_model=mlm_dist, idf=False)
+        >>> ilm.update(["the cat sat"], ["a cat sat"])
+        >>> round(float(ilm.compute()), 4)
+        -4.8643
+    """
 
     is_differentiable = False
     higher_is_better = True

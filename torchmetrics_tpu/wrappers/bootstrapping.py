@@ -29,6 +29,23 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 
 class BootStrapper(WrapperMetric):
+    """Bootstrapped confidence estimates of a base metric (reference wrappers/bootstrapping.py:54).
+
+    Each update feeds every internal copy a poisson/multinomial resample of the
+    batch; compute reports mean/std (and optional quantile/raw) across copies.
+
+    Example:
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> boot = BootStrapper(BinaryAccuracy(), num_bootstraps=4, seed=42)
+        >>> boot.update(preds, target)
+        >>> sorted(boot.compute().keys())
+        ['mean', 'std']
+    """
+
     full_state_update: Optional[bool] = True
 
     def __init__(

@@ -10,6 +10,19 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class ClasswiseWrapper(WrapperMetric):
+    """Split a per-class vector output into a labeled dict (reference wrappers/classwise.py:31).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import ClasswiseWrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> wrapped = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        >>> wrapped.update(preds, jnp.asarray([0, 1, 2, 0]))
+        >>> {k: round(float(v), 4) for k, v in wrapped.compute().items()}
+        {'multiclassaccuracy_0': 0.5, 'multiclassaccuracy_1': 1.0, 'multiclassaccuracy_2': 1.0}
+    """
+
     def __init__(
         self,
         metric: Metric,

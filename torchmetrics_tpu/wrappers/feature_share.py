@@ -52,7 +52,23 @@ class NetworkCache:
 
 
 class FeatureShare(MetricCollection):
-    """MetricCollection that shares one cached feature extractor across members."""
+    """MetricCollection that shares one cached feature extractor across members.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import FeatureShare
+        >>> from torchmetrics_tpu.image import FrechetInceptionDistance, KernelInceptionDistance
+        >>> extractor = lambda x: x.mean(axis=(2, 3))
+        >>> fs = FeatureShare([
+        ...     FrechetInceptionDistance(feature_extractor=extractor, num_features=3),
+        ...     KernelInceptionDistance(feature_extractor=extractor, subsets=2, subset_size=3),
+        ... ])  # one extractor pass serves both metrics
+        >>> real = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0
+        >>> fs.update(real, real=True)
+        >>> fs.update(real * 0.7, real=False)
+        >>> sorted(fs.compute().keys())
+        ['FrechetInceptionDistance', 'KernelInceptionDistance']
+    """
 
     def __init__(
         self,

@@ -11,6 +11,20 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MinMaxMetric(WrapperMetric):
+    """Track the running min/max of a base metric's compute (reference wrappers/minmax.py:29).
+
+    Example:
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> mm = MinMaxMetric(BinaryAccuracy())
+        >>> mm.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in mm.compute().items()}
+        {'max': 0.5, 'min': 0.5, 'raw': 0.5}
+    """
+
     full_state_update: Optional[bool] = True
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:

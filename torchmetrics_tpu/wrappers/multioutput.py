@@ -25,7 +25,17 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(WrapperMetric):
-    """Apply a metric independently per output dimension (last axis by default)."""
+    """Apply a metric independently per output dimension (last axis by default).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultioutputWrapper
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> mo.update(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]), jnp.asarray([[1.0, 1.0], [4.0, 3.0]]))
+        >>> jnp.round(mo.compute(), 4).tolist()
+        [0.5, 1.0]
+    """
 
     is_differentiable = False
 

@@ -9,6 +9,22 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MultitaskWrapper(WrapperMetric):
+    """Dict of task name → metric, updated from per-task preds/target dicts (reference wrappers/multitask.py:30).
+
+    Example:
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> mt = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        >>> mt.update({"cls": preds, "reg": preds},
+        ...           {"cls": target, "reg": target.astype(jnp.float32)})
+        >>> {k: round(float(v), 4) for k, v in mt.compute().items()}
+        {'cls': 0.5, 'reg': 0.2325}
+    """
+
     def __init__(self, task_metrics: Dict[str, Union[Metric, MetricCollection]], **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(task_metrics, dict):

@@ -13,6 +13,18 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class Running(WrapperMetric):
+    """Sliding-window view of the last ``window`` updates (reference wrappers/running.py:27).
+
+    Example:
+        >>> from torchmetrics_tpu.wrappers import Running
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> running = Running(SumMetric(), window=2)
+        >>> for v in [1.0, 2.0, 3.0]:
+        ...     running.update(v)
+        >>> float(running.compute())  # only the last two updates
+        5.0
+    """
+
     def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(base_metric, Metric):

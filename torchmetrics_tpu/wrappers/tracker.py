@@ -18,6 +18,22 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricTracker:
+    """Track a metric (or collection) over epochs/steps (reference wrappers/tracker.py:31).
+
+    Example:
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> tracker = MetricTracker(BinaryAccuracy())
+        >>> for epoch in range(2):
+        ...     tracker.increment()
+        ...     tracker.update(preds, target)
+        >>> round(float(tracker.best_metric()), 4)
+        0.5
+    """
+
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):
             raise TypeError(
