@@ -1,0 +1,60 @@
+"""BERTScore with a user-defined embedder (TPU-native counterpart of the
+reference's examples/bert_score-own_model.py).
+
+The metric's math (greedy cosine matching, IDF weighting) is model-agnostic:
+``user_model`` is any callable mapping a list of sentences to
+``(embeddings (N, L, D), attention_mask (N, L))``. Here we build a tiny
+deterministic hashing embedder; swap in a flax transformer (e.g.
+``transformers.FlaxAutoModel``) for real use.
+
+To run: JAX_PLATFORMS=cpu python examples/bert_score-own_model.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
+
+from pprint import pprint
+import zlib
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.text import BERTScore
+
+_EMBED_DIM = 8
+
+
+def simple_tokenizer(text: str) -> list:
+    return text.lower().split()
+
+
+def hash_embed(token: str) -> jnp.ndarray:
+    h = zlib.crc32(token.encode())
+    vec = jnp.asarray([(h >> (4 * i)) & 0xF for i in range(_EMBED_DIM)], dtype=jnp.float32)
+    return vec / jnp.linalg.norm(vec)
+
+
+def user_model(sentences):
+    """Map sentences -> (embeddings, mask); the BERTScore user-model contract."""
+    tokenized = [simple_tokenizer(s) for s in sentences]
+    max_len = max(len(t) for t in tokenized)
+    embeddings, masks = [], []
+    for toks in tokenized:
+        vecs = [hash_embed(t) for t in toks]
+        vecs += [jnp.zeros(_EMBED_DIM)] * (max_len - len(toks))
+        embeddings.append(jnp.stack(vecs))
+        masks.append(jnp.asarray([1] * len(toks) + [0] * (max_len - len(toks))))
+    return jnp.stack(embeddings), jnp.stack(masks)
+
+
+def main() -> None:
+    preds = ["hello there", "the cat sat on the mat"]
+    target = ["hello there", "a cat sat on a mat"]
+
+    score = BERTScore(user_model=user_model, user_tokenizer=simple_tokenizer, idf=True)
+    score.update(preds, target)
+    pprint({k: jnp.round(jnp.atleast_1d(v), 4).tolist() for k, v in score.compute().items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""COCO mean-average-precision on synthetic detections (TPU-native counterpart
+of the reference's examples/detection_map.py).
+
+The mAP pipeline (batched IoU, greedy threshold matching, 101-point PR
+interpolation) is pure JAX/numpy — no pycocotools.
+
+To run: JAX_PLATFORMS=cpu python examples/detection_map.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
+
+from pprint import pprint
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    preds = [
+        {
+            "boxes": jnp.asarray([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 90.0, 90.0]]),
+            "scores": jnp.asarray([0.9, 0.6]),
+            "labels": jnp.asarray([0, 1]),
+        },
+        {
+            "boxes": jnp.asarray([[15.0, 20.0, 45.0, 55.0]]),
+            "scores": jnp.asarray([0.8]),
+            "labels": jnp.asarray([0]),
+        },
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray([[12.0, 10.0, 52.0, 50.0], [61.0, 62.0, 88.0, 92.0]]),
+            "labels": jnp.asarray([0, 1]),
+        },
+        {
+            "boxes": jnp.asarray([[14.0, 18.0, 46.0, 56.0]]),
+            "labels": jnp.asarray([0]),
+        },
+    ]
+
+    metric = MeanAveragePrecision(iou_type="bbox")
+    metric.update(preds, target)
+    pprint({k: (v.tolist() if hasattr(v, "tolist") else v) for k, v in metric.compute().items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Metrics inside a jitted, sharded training step — the TPU-native flagship
+pattern this framework is designed around (no reference counterpart: the
+reference syncs via torch.distributed outside the step).
+
+A MetricCollection's pure core (``functional_update`` / ``functional_sync``)
+traces straight into a ``shard_map``-ped train step over a device mesh; state
+reductions ride ``lax.psum`` on ICI. Run on any machine — the script forces an
+8-device virtual CPU mesh.
+
+To run: python examples/metrics_in_sharded_train_step.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+
+def main() -> None:
+    num_classes, batch, dim = 5, 64, 16
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    acc = MulticlassAccuracy(num_classes=num_classes, sync_axis="data")
+    f1 = MulticlassF1Score(num_classes=num_classes, sync_axis="data")
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(dim, num_classes).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(batch, dim).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, num_classes, size=(batch,)))
+
+    acc_state = acc.init_state()
+    f1_state = f1.init_state()
+
+    @jax.jit
+    def train_step(w, x, y, acc_state, f1_state):
+        def step(w, x, y, acc_state, f1_state):
+            def loss_fn(w):
+                logits = x @ w
+                onehot = jax.nn.one_hot(y, num_classes)
+                return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, axis=-1))
+
+            loss, grads = jax.value_and_grad(loss_fn)(w)
+            grads = jax.lax.pmean(grads, "data")
+            w = w - 0.1 * grads
+            logits = x @ w
+            # metric accumulation fuses into the compiled step; sync is one psum
+            acc_state = acc.functional_update(acc_state, logits, y)
+            acc_state = acc.functional_sync(acc_state, "data")
+            f1_state = f1.functional_update(f1_state, logits, y)
+            f1_state = f1.functional_sync(f1_state, "data")
+            return w, loss, acc_state, f1_state
+
+        return shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_rep=False,
+        )(w, x, y, acc_state, f1_state)
+
+    for step_idx in range(3):
+        w, loss, acc_state, f1_state = train_step(w, x, y, acc_state, f1_state)
+        print(f"step {step_idx}: loss={float(loss):.4f}")
+
+    print("accuracy:", float(acc.functional_compute(acc_state)))
+    print("f1:      ", float(f1.functional_compute(f1_state)))
+
+
+if __name__ == "__main__":
+    main()

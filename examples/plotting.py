@@ -1,0 +1,62 @@
+"""Plotting metric values (TPU-native counterpart of the reference's
+examples/plotting.py).
+
+Every metric exposes ``.plot()`` (single value, multi value, confusion
+matrices, curves). Figures are saved instead of shown so the script works
+headless.
+
+To run: JAX_PLATFORMS=cpu python examples/plotting.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
+
+import jax.numpy as jnp
+
+
+def accuracy_over_steps() -> None:
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    metric = BinaryAccuracy()
+    values = []
+    batches = [
+        (jnp.asarray([0.2, 0.8, 0.6, 0.4]), jnp.asarray([0, 1, 1, 0])),
+        (jnp.asarray([0.3, 0.7, 0.2, 0.9]), jnp.asarray([0, 1, 1, 1])),
+        (jnp.asarray([0.6, 0.9, 0.1, 0.2]), jnp.asarray([1, 1, 0, 0])),
+    ]
+    for preds, target in batches:
+        values.append(metric(preds, target))  # forward returns the batch value
+    fig, ax = metric.plot(values)
+    fig.savefig("accuracy_over_steps.png")
+    print("wrote accuracy_over_steps.png")
+
+
+def confusion_matrix_plot() -> None:
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    metric = MulticlassConfusionMatrix(num_classes=3)
+    metric.update(jnp.asarray([0, 1, 2, 2, 1, 0]), jnp.asarray([0, 2, 2, 1, 1, 0]))
+    fig, ax = metric.plot()
+    fig.savefig("confusion_matrix.png")
+    print("wrote confusion_matrix.png")
+
+
+def roc_curve_plot() -> None:
+    from torchmetrics_tpu.classification import BinaryROC
+
+    metric = BinaryROC(thresholds=20)
+    metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8, 0.9, 0.55]), jnp.asarray([0, 0, 1, 1, 1, 0]))
+    fig, ax = metric.plot()
+    fig.savefig("roc_curve.png")
+    print("wrote roc_curve.png")
+
+
+if __name__ == "__main__":
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError:
+        raise SystemExit("plotting examples require matplotlib")
+    accuracy_over_steps()
+    confusion_matrix_plot()
+    roc_curve_plot()

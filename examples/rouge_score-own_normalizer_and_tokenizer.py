@@ -1,0 +1,51 @@
+"""ROUGE with a custom normalizer and tokenizer (TPU-native counterpart of the
+reference's examples/rouge_score-own_normalizer_and_tokenizer.py).
+
+Useful whenever the default whitespace tokenization does not fit the language
+or domain (e.g. aggressive punctuation stripping, subword schemes).
+
+To run: JAX_PLATFORMS=cpu python examples/rouge_score-own_normalizer_and_tokenizer.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
+
+import re
+from pprint import pprint
+
+from torchmetrics_tpu.text import ROUGEScore
+
+
+def lowercase_alnum_normalizer(text: str) -> str:
+    """Keep only lowercase alphanumerics and spaces."""
+    return re.sub(r"[^a-z0-9 ]", "", text.lower())
+
+
+def char_bigram_tokenizer(text: str) -> list:
+    """Tokenize into character bigrams — robust for agglutinative scripts."""
+    squashed = text.replace(" ", "")
+    return [squashed[i : i + 2] for i in range(0, len(squashed) - 1)] or [squashed]
+
+
+def main() -> None:
+    preds = ["The Cat sat; on the mat!"]
+    target = ["A cat sat on the mat."]
+
+    default = ROUGEScore(rouge_keys="rouge1")
+    default.update(preds, target)
+    print("default tokenization:")
+    pprint({k: float(v) for k, v in default.compute().items()})
+
+    custom = ROUGEScore(
+        rouge_keys="rouge1",
+        normalizer=lowercase_alnum_normalizer,
+        tokenizer=char_bigram_tokenizer,
+    )
+    custom.update(preds, target)
+    print("custom normalizer + char-bigram tokenizer:")
+    pprint({k: float(v) for k, v in custom.compute().items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -220,3 +220,36 @@ class TestPansharpening:
             FI.spatial_distortion_index(_j(preds), _j(ms), _j(pan), norm_order=0)
         with pytest.raises(ValueError, match="alpha"):
             FI.quality_with_no_reference(_j(preds), _j(ms), _j(pan), alpha=-1)
+
+
+class TestSeparableWindowDispatch:
+    """The windowed-sum helper dispatches GEMM vs 1-D-conv by image size; both
+    paths must agree (the >2048-edge conv path is otherwise untested)."""
+
+    def test_2d_paths_equivalent(self):
+        import torchmetrics_tpu.functional.image.utils as U
+
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 60, 52).astype(np.float32))
+        g = U._gaussian(11, 1.5)[0]
+        gemm = U._separable_window_2d(x, g, g)
+        old = U._WINDOW_GEMM_MAX_DIM
+        try:
+            U._WINDOW_GEMM_MAX_DIM = 8  # force the large-image conv path
+            conv = U._separable_window_2d(x, g, g)
+        finally:
+            U._WINDOW_GEMM_MAX_DIM = old
+        np.testing.assert_allclose(np.asarray(gemm), np.asarray(conv), atol=1e-6)
+
+    def test_3d_paths_equivalent(self):
+        import torchmetrics_tpu.functional.image.utils as U
+
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 2, 18, 20, 22).astype(np.float32))
+        g = U._gaussian(5, 1.0)[0]
+        gemm = U._separable_window_3d(x, g, g, g)
+        old = U._WINDOW_GEMM_MAX_DIM
+        try:
+            U._WINDOW_GEMM_MAX_DIM = 8
+            conv = U._separable_window_3d(x, g, g, g)
+        finally:
+            U._WINDOW_GEMM_MAX_DIM = old
+        np.testing.assert_allclose(np.asarray(gemm), np.asarray(conv), atol=1e-6)

@@ -12,9 +12,9 @@ from jax import Array
 
 from torchmetrics_tpu.functional.image.utils import (
     _conv2d,
-    _conv2d_grouped,
-    _gaussian_kernel_2d,
+    _gaussian,
     _reflect_pad_2d,
+    _separable_window_2d,
     _uniform_filter,
 )
 from torchmetrics_tpu.parallel.sync import reduce
@@ -89,15 +89,15 @@ def universal_image_quality_index(
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
-    channel = preds.shape[1]
-    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+    g_h = _gaussian(kernel_size[0], sigma[0], preds.dtype)[0]
+    g_w = _gaussian(kernel_size[1], sigma[1], preds.dtype)[0]
     pad_h = (kernel_size[0] - 1) // 2
     pad_w = (kernel_size[1] - 1) // 2
     preds_p = _reflect_pad_2d(preds, pad_h, pad_w)
     target_p = _reflect_pad_2d(target, pad_h, pad_w)
 
     input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
-    outputs = _conv2d_grouped(input_list, kernel)
+    outputs = _separable_window_2d(input_list, g_h, g_w)
     b = preds.shape[0]
     mu_pred = outputs[:b]
     mu_target = outputs[b : 2 * b]
@@ -275,19 +275,19 @@ def _signal_convolve_2d(x: Array, kernel: Array) -> Array:
 
 def _scc_per_channel(preds: Array, target: Array, hp_filter: Array, window_size: int) -> Array:
     """Per-channel SCC map (reference scc.py:140-165). preds/target are (B,1,H,W)."""
-    window = jnp.ones((1, 1, window_size, window_size), dtype=preds.dtype) / (window_size**2)
     preds_hp = _signal_convolve_2d(preds, hp_filter) * 2.0
     target_hp = _signal_convolve_2d(target, hp_filter) * 2.0
 
-    left = int(math.ceil((window.shape[3] - 1) / 2))
-    right = int(math.floor((window.shape[3] - 1) / 2))
+    left = int(math.ceil((window_size - 1) / 2))
+    right = int(math.floor((window_size - 1) / 2))
     pp = jnp.pad(preds_hp, ((0, 0), (0, 0), (left, right), (left, right)))
     tt = jnp.pad(target_hp, ((0, 0), (0, 0), (left, right), (left, right)))
-    preds_mean = _conv2d(pp, window)
-    target_mean = _conv2d(tt, window)
-    preds_var = _conv2d(pp**2, window) - preds_mean**2
-    target_var = _conv2d(tt**2, window) - target_mean**2
-    cov = _conv2d(tt * pp, window) - target_mean * preds_mean
+    uniform = jnp.full((window_size,), 1.0 / window_size, dtype=preds.dtype)
+    preds_mean = _separable_window_2d(pp, uniform, uniform)
+    target_mean = _separable_window_2d(tt, uniform, uniform)
+    preds_var = _separable_window_2d(pp**2, uniform, uniform) - preds_mean**2
+    target_var = _separable_window_2d(tt**2, uniform, uniform) - target_mean**2
+    cov = _separable_window_2d(tt * pp, uniform, uniform) - target_mean * preds_mean
 
     preds_var = jnp.clip(preds_var, min=0.0)
     target_var = jnp.clip(target_var, min=0.0)

@@ -1,8 +1,11 @@
 """SSIM and MS-SSIM (reference functional/image/ssim.py).
 
-Gaussian (or uniform) windowed statistics computed with one grouped conv over a
-5×-batched stack (preds, target, preds², target², preds·target) — a single fused
-conv kernel per update on TPU (mirrors reference ssim.py:135-140).
+Gaussian (or uniform) windowed statistics computed over a 5×-batched stack
+(preds, target, preds², target², preds·target). The separable window runs
+through `utils._separable_window_2d`, which dispatches between banded matmuls
+(GEMM — MXU-tiled on TPU, BLAS on CPU) for typical image sizes and 1-D grouped
+convs for very large ones (reference ssim.py:135-140 uses one dense grouped
+torch conv).
 """
 from __future__ import annotations
 
@@ -13,9 +16,11 @@ from jax import Array
 
 from torchmetrics_tpu.functional.image.utils import (
     _avg_pool2d,
-    _conv2d_grouped,
-    _gaussian_kernel_2d,
+    _gaussian,
     _reflect_pad_2d,
+    _reflect_pad_3d,
+    _separable_window_2d,
+    _separable_window_3d,
 )
 from torchmetrics_tpu.utils.checks import _check_same_shape
 
@@ -73,20 +78,13 @@ def _ssim_update(
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
-    channel = preds.shape[1]
+    # Both gaussian and uniform windows are separable: run 1-D passes per axis
+    # instead of one dense k^2 (k^3) kernel — ~k/2x fewer MACs, same math.
     if is_3d:
-        from torchmetrics_tpu.functional.image.utils import (
-            _conv3d_grouped,
-            _gaussian_kernel_3d,
-            _reflect_pad_3d,
-        )
-
         if gaussian_kernel:
-            kernel = _gaussian_kernel_3d(channel, kernel_size, sigma, preds.dtype)
+            k1d = [_gaussian(k, s, preds.dtype)[0] for k, s in zip(kernel_size, sigma)]
         else:
-            kernel = jnp.ones((channel, 1, *kernel_size), dtype=preds.dtype) / jnp.prod(
-                jnp.asarray(kernel_size, dtype=preds.dtype)
-            )
+            k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
         pad_d = (kernel_size[0] - 1) // 2
         pad_h = (kernel_size[1] - 1) // 2
         pad_w = (kernel_size[2] - 1) // 2
@@ -95,14 +93,12 @@ def _ssim_update(
         input_list = jnp.concatenate(
             [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p]
         )
-        outputs = _conv3d_grouped(input_list, kernel)
+        outputs = _separable_window_3d(input_list, k1d[0], k1d[1], k1d[2])
     else:
         if gaussian_kernel:
-            kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+            k1d = [_gaussian(k, s, preds.dtype)[0] for k, s in zip(kernel_size, sigma)]
         else:
-            kernel = jnp.ones((channel, 1, kernel_size[0], kernel_size[1]), dtype=preds.dtype) / (
-                kernel_size[0] * kernel_size[1]
-            )
+            k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
         pad_h = (kernel_size[0] - 1) // 2
         pad_w = (kernel_size[1] - 1) // 2
         preds_p = _reflect_pad_2d(preds, pad_h, pad_w)
@@ -111,7 +107,7 @@ def _ssim_update(
         input_list = jnp.concatenate(
             [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p]
         )  # (5B, C, H+2p, W+2p)
-        outputs = _conv2d_grouped(input_list, kernel)
+        outputs = _separable_window_2d(input_list, k1d[0], k1d[1])
     b = preds.shape[0]
     mu_pred = outputs[:b]
     mu_target = outputs[b : 2 * b]

@@ -1,8 +1,9 @@
 """Image-kernel utilities (reference functional/image/utils.py).
 
-Gaussian/uniform separable kernels and scipy-compatible reflection padding,
-expressed with lax.conv_general_dilated (NCHW / OIHW) — grouped convs map onto
-the TPU's convolution units directly.
+Gaussian/uniform separable windows and scipy-compatible reflection padding.
+Windowed sums dispatch between banded matmuls (GEMM: MXU on TPU, BLAS on CPU)
+and 1-D grouped `lax.conv_general_dilated` passes depending on image size —
+see `_separable_window_2d`.
 """
 from __future__ import annotations
 
@@ -20,38 +21,73 @@ def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
     return (gauss / gauss.sum())[None]  # (1, kernel_size)
 
 
-def _gaussian_kernel_2d(
-    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
-) -> Array:
-    """(C, 1, kh, kw) separable gaussian kernel (reference utils.py:27-56)."""
-    gaussian_kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    gaussian_kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    kernel = jnp.matmul(gaussian_kernel_x.T, gaussian_kernel_y)  # (kh, kw)
-    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+def _band_matrix(g: Array, out_len: int) -> Array:
+    """(out_len + k - 1, out_len) banded matrix B with B[o + d, o] = g[d].
+
+    ``x_padded @ B`` equals the valid 1-D cross-correlation of ``x_padded``
+    with ``g`` — the separable-window trick expressed as a GEMM so it rides the
+    MXU on TPU (and BLAS on CPU) instead of XLA's slow small-kernel conv path.
+    """
+    k = g.shape[0]
+    rows = jnp.arange(out_len + k - 1)[:, None]
+    cols = jnp.arange(out_len)[None, :]
+    d = rows - cols
+    return jnp.where((d >= 0) & (d < k), g[jnp.clip(d, 0, k - 1)], jnp.zeros((), dtype=g.dtype))
 
 
-def _gaussian_kernel_3d(
-    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
-) -> Array:
-    """(C, 1, kd, kh, kw) 3-D gaussian kernel (reference utils.py:135-156)."""
-    gaussian_kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    gaussian_kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    gaussian_kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
-    kernel_xy = jnp.matmul(gaussian_kernel_x.T, gaussian_kernel_y)  # (kh, kw)
-    kernel = kernel_xy[None] * gaussian_kernel_z.reshape(-1, 1, 1)  # (kd, kh, kw)
-    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+# Above this edge length the banded matrices' O(H^2) MACs/memory overtake the
+# 1-D conv path; below it the GEMM lowering wins on every backend (measured on
+# XLA CPU: 17x at 256, still 2.4x at 2048; on TPU the GEMM rides the MXU).
+_WINDOW_GEMM_MAX_DIM = 2048
 
 
-def _conv2d_grouped(x: Array, kernel: Array) -> Array:
-    """Per-channel (grouped) valid conv, NCHW x (C,1,kh,kw)."""
+def _grouped_conv1d_axis(x: Array, g: Array, axis: int) -> Array:
+    """Valid per-channel conv with 1-D kernel ``g`` along one spatial axis of NCHW/NCDHW."""
+    nspatial = x.ndim - 2
+    shape = [1, 1] + [1] * nspatial
+    shape[axis] = g.shape[0]
+    kernel = jnp.broadcast_to(g.reshape(shape), (x.shape[1], 1, *shape[2:]))
+    dn = ("NCHW", "OIHW", "NCHW") if nspatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
     return lax.conv_general_dilated(
-        x,
-        kernel,
-        window_strides=(1, 1),
-        padding="VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=x.shape[1],
+        x, kernel, window_strides=(1,) * nspatial, padding="VALID",
+        dimension_numbers=dn, feature_group_count=x.shape[1],
     )
+
+
+def _separable_window_2d(x: Array, g_h: Array, g_w: Array) -> Array:
+    """Valid separable windowed sum of NCHW ``x`` (≡ per-channel VALID conv with
+    the rank-1 kernel ``outer(g_h, g_w)``), k²→2k MACs vs the dense kernel.
+
+    Dispatch: banded matmuls (`_band_matrix`) up to `_WINDOW_GEMM_MAX_DIM` —
+    a GEMM lowering that is MXU-tiled on TPU and BLAS-backed on CPU, far faster
+    than XLA's small-kernel conv despite costing O(H+W) MACs/pixel — and two
+    1-D grouped convs (O(k)/pixel, O(1) extra memory) beyond it.
+    """
+    if max(x.shape[2], x.shape[3]) > _WINDOW_GEMM_MAX_DIM:
+        return _grouped_conv1d_axis(_grouped_conv1d_axis(x, g_h.astype(x.dtype), 2), g_w.astype(x.dtype), 3)
+    ho = x.shape[2] - g_h.shape[0] + 1
+    wo = x.shape[3] - g_w.shape[0] + 1
+    bh = _band_matrix(g_h.astype(x.dtype), ho)  # (Hp, Ho)
+    bw = _band_matrix(g_w.astype(x.dtype), wo)  # (Wp, Wo)
+    out = jnp.einsum("nchw,hi->nciw", x, bh)
+    return jnp.einsum("nciw,wj->ncij", out, bw)
+
+
+def _separable_window_3d(x: Array, g_d: Array, g_h: Array, g_w: Array) -> Array:
+    """Valid separable windowed sum of NCDHW ``x``; same dispatch as the 2-D case."""
+    if max(x.shape[2:]) > _WINDOW_GEMM_MAX_DIM:
+        out = _grouped_conv1d_axis(x, g_d.astype(x.dtype), 2)
+        out = _grouped_conv1d_axis(out, g_h.astype(x.dtype), 3)
+        return _grouped_conv1d_axis(out, g_w.astype(x.dtype), 4)
+    do = x.shape[2] - g_d.shape[0] + 1
+    ho = x.shape[3] - g_h.shape[0] + 1
+    wo = x.shape[4] - g_w.shape[0] + 1
+    bd = _band_matrix(g_d.astype(x.dtype), do)
+    bh = _band_matrix(g_h.astype(x.dtype), ho)
+    bw = _band_matrix(g_w.astype(x.dtype), wo)
+    out = jnp.einsum("ncdhw,de->ncehw", x, bd)
+    out = jnp.einsum("ncehw,hi->nceiw", out, bh)
+    return jnp.einsum("nceiw,wj->nceij", out, bw)
 
 
 def _conv2d(x: Array, kernel: Array) -> Array:
@@ -84,20 +120,8 @@ def _reflection_pad_2d(inputs: Array, pad: int, outer_pad: int = 0) -> Array:
 def _uniform_filter(inputs: Array, window_size: int) -> Array:
     """Uniform (box) filter with scipy-compatible padding (reference utils.py:112-132)."""
     inputs = _reflection_pad_2d(inputs, window_size // 2, window_size % 2)
-    kernel = jnp.ones((inputs.shape[1], 1, window_size, window_size), dtype=inputs.dtype) / (window_size**2)
-    return _conv2d_grouped(inputs, kernel)
-
-
-def _conv3d_grouped(x: Array, kernel: Array) -> Array:
-    """Per-channel (grouped) valid conv, NCDHW x (C,1,kd,kh,kw)."""
-    return lax.conv_general_dilated(
-        x,
-        kernel,
-        window_strides=(1, 1, 1),
-        padding="VALID",
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        feature_group_count=x.shape[1],
-    )
+    uniform = jnp.full((window_size,), 1.0 / window_size, dtype=inputs.dtype)
+    return _separable_window_2d(inputs, uniform, uniform)
 
 
 def _reflect_pad_3d(x: Array, pad_d: int, pad_h: int, pad_w: int) -> Array:

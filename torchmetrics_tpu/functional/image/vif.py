@@ -4,13 +4,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.functional.image.utils import _conv2d
+from torchmetrics_tpu.functional.image.utils import _separable_window_2d
 
 
-def _filter(win_size: int, sigma: float, dtype=jnp.float32) -> Array:
+def _filter_1d(win_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1-D factor of the separable VIF gaussian; outer(g, g) is the 2-D filter."""
     coords = jnp.arange(win_size, dtype=dtype) - (win_size - 1) / 2
-    g = coords**2
-    g = jnp.exp(-(g[None, :] + g[:, None]) / (2.0 * sigma**2))
+    g = jnp.exp(-(coords**2) / (2.0 * sigma**2))
     return g / g.sum()
 
 
@@ -24,21 +24,21 @@ def _vif_per_channel(preds: Array, target: Array, sigma_n_sq: float) -> Array:
     target_vif = jnp.zeros(preds.shape[0])
     for scale in range(4):
         n = int(2.0 ** (4 - scale) + 1)
-        kernel = _filter(n, n / 5, preds.dtype)[None, None]
+        g1 = _filter_1d(n, n / 5, preds.dtype)
 
         if scale > 0:
-            target = _conv2d(target, kernel)[:, :, ::2, ::2]
-            preds = _conv2d(preds, kernel)[:, :, ::2, ::2]
+            target = _separable_window_2d(target, g1, g1)[:, :, ::2, ::2]
+            preds = _separable_window_2d(preds, g1, g1)[:, :, ::2, ::2]
 
-        mu_target = _conv2d(target, kernel)
-        mu_preds = _conv2d(preds, kernel)
+        mu_target = _separable_window_2d(target, g1, g1)
+        mu_preds = _separable_window_2d(preds, g1, g1)
         mu_target_sq = mu_target**2
         mu_preds_sq = mu_preds**2
         mu_target_preds = mu_target * mu_preds
 
-        sigma_target_sq = jnp.clip(_conv2d(target**2, kernel) - mu_target_sq, min=0.0)
-        sigma_preds_sq = jnp.clip(_conv2d(preds**2, kernel) - mu_preds_sq, min=0.0)
-        sigma_target_preds = _conv2d(target * preds, kernel) - mu_target_preds
+        sigma_target_sq = jnp.clip(_separable_window_2d(target**2, g1, g1) - mu_target_sq, min=0.0)
+        sigma_preds_sq = jnp.clip(_separable_window_2d(preds**2, g1, g1) - mu_preds_sq, min=0.0)
+        sigma_target_preds = _separable_window_2d(target * preds, g1, g1) - mu_target_preds
 
         g = sigma_target_preds / (sigma_target_sq + eps)
         sigma_v_sq = sigma_preds_sq - g * sigma_target_preds
