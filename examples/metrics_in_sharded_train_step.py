@@ -44,12 +44,9 @@ def main() -> None:
     x = jnp.asarray(rng.randn(batch, dim).astype(np.float32))
     y = jnp.asarray(rng.randint(0, num_classes, size=(batch,)))
 
-    acc_state = acc.init_state()
-    f1_state = f1.init_state()
-
     @jax.jit
-    def train_step(w, x, y, acc_state, f1_state):
-        def step(w, x, y, acc_state, f1_state):
+    def train_step(w, x, y):
+        def step(w, x, y):
             def loss_fn(w):
                 logits = x @ w
                 onehot = jax.nn.one_hot(y, num_classes)
@@ -59,23 +56,27 @@ def main() -> None:
             grads = jax.lax.pmean(grads, "data")
             w = w - 0.1 * grads
             logits = x @ w
-            # metric accumulation fuses into the compiled step; sync is one psum
-            acc_state = acc.functional_update(acc_state, logits, y)
-            acc_state = acc.functional_sync(acc_state, "data")
-            f1_state = f1.functional_update(f1_state, logits, y)
-            f1_state = f1.functional_sync(f1_state, "data")
-            return w, loss, acc_state, f1_state
+            # fresh per-batch metric states, psum-synced inside the trace; the
+            # host folds them into the run state with the declared-reduction
+            # merge. (Syncing a state that is carried across steps would re-psum
+            # already-global totals — never do that.)
+            acc_b = acc.functional_sync(acc.functional_update(acc.init_state(), logits, y), "data")
+            f1_b = f1.functional_sync(f1.functional_update(f1.init_state(), logits, y), "data")
+            return w, loss, acc_b, f1_b
 
         return shard_map(
             step,
             mesh=mesh,
-            in_specs=(P(), P("data"), P("data"), P(), P()),
+            in_specs=(P(), P("data"), P("data")),
             out_specs=(P(), P(), P(), P()),
             check_rep=False,
-        )(w, x, y, acc_state, f1_state)
+        )(w, x, y)
 
+    acc_state = f1_state = None
     for step_idx in range(3):
-        w, loss, acc_state, f1_state = train_step(w, x, y, acc_state, f1_state)
+        w, loss, acc_b, f1_b = train_step(w, x, y)
+        acc_state = acc_b if acc_state is None else acc.merge_states(acc_state, acc_b)
+        f1_state = f1_b if f1_state is None else f1.merge_states(f1_state, f1_b)
         print(f"step {step_idx}: loss={float(loss):.4f}")
 
     print("accuracy:", float(acc.functional_compute(acc_state)))

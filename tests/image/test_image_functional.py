@@ -230,7 +230,7 @@ class TestSeparableWindowDispatch:
         import torchmetrics_tpu.functional.image.utils as U
 
         x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 60, 52).astype(np.float32))
-        g = U._gaussian(11, 1.5)[0]
+        g = U._gaussian(11, 1.5)
         gemm = U._separable_window_2d(x, g, g)
         old = U._WINDOW_GEMM_MAX_DIM
         try:
@@ -244,7 +244,7 @@ class TestSeparableWindowDispatch:
         import torchmetrics_tpu.functional.image.utils as U
 
         x = jnp.asarray(np.random.RandomState(1).rand(1, 2, 18, 20, 22).astype(np.float32))
-        g = U._gaussian(5, 1.0)[0]
+        g = U._gaussian(5, 1.0)
         gemm = U._separable_window_3d(x, g, g, g)
         old = U._WINDOW_GEMM_MAX_DIM
         try:

@@ -89,8 +89,8 @@ def universal_image_quality_index(
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
-    g_h = _gaussian(kernel_size[0], sigma[0], preds.dtype)[0]
-    g_w = _gaussian(kernel_size[1], sigma[1], preds.dtype)[0]
+    g_h = _gaussian(kernel_size[0], sigma[0], preds.dtype)
+    g_w = _gaussian(kernel_size[1], sigma[1], preds.dtype)
     pad_h = (kernel_size[0] - 1) // 2
     pad_w = (kernel_size[1] - 1) // 2
     preds_p = _reflect_pad_2d(preds, pad_h, pad_w)

@@ -82,7 +82,7 @@ def _ssim_update(
     # instead of one dense k^2 (k^3) kernel — ~k/2x fewer MACs, same math.
     if is_3d:
         if gaussian_kernel:
-            k1d = [_gaussian(k, s, preds.dtype)[0] for k, s in zip(kernel_size, sigma)]
+            k1d = [_gaussian(k, s, preds.dtype) for k, s in zip(kernel_size, sigma)]
         else:
             k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
         pad_d = (kernel_size[0] - 1) // 2
@@ -96,7 +96,7 @@ def _ssim_update(
         outputs = _separable_window_3d(input_list, k1d[0], k1d[1], k1d[2])
     else:
         if gaussian_kernel:
-            k1d = [_gaussian(k, s, preds.dtype)[0] for k, s in zip(kernel_size, sigma)]
+            k1d = [_gaussian(k, s, preds.dtype) for k, s in zip(kernel_size, sigma)]
         else:
             k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
         pad_h = (kernel_size[0] - 1) // 2

@@ -7,18 +7,15 @@ see `_separable_window_2d`.
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
-
-import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
 
 def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
-    """1-D gaussian kernel (reference utils.py:8-24)."""
+    """1-D gaussian kernel, shape (kernel_size,) (reference utils.py:8-24)."""
     dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=dtype)
     gauss = jnp.exp(-jnp.power(dist / sigma, 2) / 2)
-    return (gauss / gauss.sum())[None]  # (1, kernel_size)
+    return gauss / gauss.sum()
 
 
 def _band_matrix(g: Array, out_len: int) -> Array:

@@ -11,24 +11,7 @@ from typing import List, Sequence, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.functional.text.helper import _validate_text_inputs
-
-
-def _batch_distances(preds: List[str], target: List[str], char_level: bool = False):
-    """Tokenize every pair and run ONE batched C++ Levenshtein call.
-
-    One ctypes crossing for the whole batch (native/edit_distance.cpp
-    tm_levenshtein_batch) instead of a per-pair call — the per-call overhead
-    dominates for typical sentence lengths.
-    """
-    from torchmetrics_tpu.native import batch_edit_distance
-
-    if char_level:
-        pairs = [(list(p_), list(t_)) for p_, t_ in zip(preds, target)]
-    else:
-        pairs = [(p_.split(), t_.split()) for p_, t_ in zip(preds, target)]
-    dists = batch_edit_distance(pairs)
-    return pairs, dists
+from torchmetrics_tpu.functional.text.helper import _batch_distances, _validate_text_inputs
 
 
 # ------------------------------------------------------------------------- WER

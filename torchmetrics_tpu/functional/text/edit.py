@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+from torchmetrics_tpu.functional.text.helper import _batch_distances
 
 
 # --------------------------------------------------------------- EditDistance
@@ -34,7 +34,12 @@ def _edit_distance_update(
         raise ValueError(
             f"Expected argument `preds` and `target` to have same length, but got {len(preds_l)} and {len(target_l)}"
         )
-    distances = [_edit_distance(list(p), list(t), substitution_cost) for p, t in zip(preds_l, target_l)]
+    if substitution_cost == 1:
+        _, distances = _batch_distances(preds_l, target_l, char_level=True)
+    else:
+        from torchmetrics_tpu.native import batch_edit_distance
+
+        distances = batch_edit_distance([(list(p), list(t)) for p, t in zip(preds_l, target_l)], substitution_cost)
     return jnp.asarray(distances, dtype=jnp.int32)
 
 

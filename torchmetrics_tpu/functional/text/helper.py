@@ -15,6 +15,22 @@ from typing import Dict, List, Sequence, Tuple, Union
 _INT_INFINITY = int(1e16)
 
 
+def _batch_distances(preds: Sequence[str], target: Sequence[str], char_level: bool = False):
+    """Tokenize every (pred, target) pair and run ONE batched C++ Levenshtein call.
+
+    One ctypes crossing for the whole batch (native/edit_distance.cpp
+    tm_levenshtein_batch) instead of a per-pair call — the per-call overhead
+    dominates for typical sentence lengths. Returns (token pairs, distances).
+    """
+    from torchmetrics_tpu.native import batch_edit_distance
+
+    if char_level:
+        pairs = [(list(p_), list(t_)) for p_, t_ in zip(preds, target)]
+    else:
+        pairs = [(p_.split(), t_.split()) for p_, t_ in zip(preds, target)]
+    return pairs, batch_edit_distance(pairs)
+
+
 def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1) -> int:
     """Word/char-level Levenshtein distance.
 
