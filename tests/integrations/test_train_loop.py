@@ -171,6 +171,40 @@ class TestTrainLoopIntegration:
         resumed.update(jnp.asarray(logits[half:]), jnp.asarray(y[half:]))
         assert float(metric.compute()) == float(resumed.compute())
 
+    def test_orbax_checkpoint_roundtrip(self, tmp_path):
+        """Metric state pytrees round-trip through orbax — the real checkpoint
+        backend on TPU pods (SURVEY §5: states-as-pytree -> orbax for free)."""
+        import orbax.checkpoint as ocp
+
+        coll = tm.MetricCollection({
+            "acc": tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False),
+            "conf": tm.ConfusionMatrix(task="multiclass", num_classes=NUM_CLASSES, validate_args=False),
+        })
+        x, y = _data(7)
+        r = np.random.RandomState(8)
+        logits = r.randn(len(y), NUM_CLASSES).astype(np.float32)
+        half = len(y) // 2
+        coll.update(jnp.asarray(logits[:half]), jnp.asarray(y[:half]))
+
+        state = {name: m.state() for name, m in coll.items(copy_state=False)}
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(tmp_path / "metrics", state)
+        restored = ckptr.restore(tmp_path / "metrics")
+
+        resumed = tm.MetricCollection({
+            "acc": tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False),
+            "conf": tm.ConfusionMatrix(task="multiclass", num_classes=NUM_CLASSES, validate_args=False),
+        })
+        for name, m in resumed.items(copy_state=False):
+            m.load_state(jax.tree_util.tree_map(jnp.asarray, restored[name]))
+            m._update_count = 1
+
+        coll.update(jnp.asarray(logits[half:]), jnp.asarray(y[half:]))
+        resumed.update(jnp.asarray(logits[half:]), jnp.asarray(y[half:]))
+        a, b = coll.compute(), resumed.compute()
+        assert float(a["acc"]) == float(b["acc"])
+        np.testing.assert_array_equal(np.asarray(a["conf"]), np.asarray(b["conf"]))
+
     def test_persistent_state_dict_roundtrip(self):
         metric = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES, validate_args=False)
         metric.persistent(True)

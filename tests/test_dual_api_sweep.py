@@ -1,0 +1,139 @@
+"""Dual-API invariant swept across every buildable metric class.
+
+SURVEY §1: every metric exists twice — a functional ``f(preds, target, ...)``
+and a modular class that is a state-holding shell over the same stages. This
+sweep asserts that invariant broadly: for each registry-buildable class whose
+snake_case twin exists in ``torchmetrics_tpu.functional``, a single
+update+compute through the class must equal the direct functional call on the
+same inputs.
+
+A second pass asserts jit-traceability of the pure core: ``functional_update``
+runs under ``jax.jit`` for every metric whose example inputs are arrays.
+"""
+import pathlib
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+import gen_doctests as reg  # noqa: E402
+
+import torchmetrics_tpu.functional as F  # noqa: E402
+from test_lifecycle_sweep import CASES, _build, _tree_allclose  # noqa: E402
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z][a-z])|(?<=[a-z0-9])(?=[A-Z])", "_", name).lower()
+
+
+# class name -> functional name where snake_case doesn't match
+NAME_MAP = {
+    "BinaryAUROC": "binary_auroc",
+    "MulticlassAUROC": "multiclass_auroc",
+    "MultilabelAUROC": "multilabel_auroc",
+    "AUROC": "auroc",
+    "BinaryROC": "binary_roc",
+    "MulticlassROC": "multiclass_roc",
+    "MultilabelROC": "multilabel_roc",
+    "ROC": "roc",
+    "SQuAD": "squad",
+    "BLEUScore": "bleu_score",
+    "SacreBLEUScore": "sacre_bleu_score",
+    "CHRFScore": "chrf_score",
+    "ROUGEScore": "rouge_score",
+    "RetrievalMAP": "retrieval_average_precision",
+    "RetrievalMRR": "retrieval_reciprocal_rank",
+    "RetrievalRPrecision": "retrieval_r_precision",
+    "RetrievalNormalizedDCG": "retrieval_normalized_dcg",
+    "RetrievalHitRate": "retrieval_hit_rate",
+    "RetrievalFallOut": "retrieval_fall_out",
+    "RetrievalAUROC": "retrieval_auroc",
+    "RetrievalPrecision": "retrieval_precision",
+    "RetrievalRecall": "retrieval_recall",
+}
+
+# accumulation semantics differ from one functional call by design, the
+# functional twin takes different arguments, or compute output shapes differ
+DUAL_SKIP = {
+    # aggregation metrics have no functional twin
+    "MaxMetric", "MinMetric", "SumMetric", "CatMetric", "MeanMetric",
+    "RunningMean", "RunningSum",
+    # retrieval classes group by indexes; functional twins are single-query
+    *{k for k in NAME_MAP if k.startswith("Retrieval")},
+    # class applies averaging over accumulated sentence scores; functional
+    # returns the per-call corpus value on different normalization
+    "ExtendedEditDistance",
+    # fixed-op dispatchers return (value, threshold) in a tuple-vs-list shape
+    # already covered by tests/classification/test_fixed_operating_point.py
+    # functional PIT returns (best_metric, permutation); the class folds to the mean
+    "PermutationInvariantTraining",
+}
+
+
+def _dual_cases():
+    out = []
+    for c in CASES:
+        (module_name, cls_name, ctor, setup, upd) = c.values
+        if cls_name in DUAL_SKIP:
+            continue
+        fn_name = NAME_MAP.get(cls_name, _snake(cls_name))
+        fn = getattr(F, fn_name, None)
+        if fn is None:
+            continue
+        out.append(pytest.param(module_name, cls_name, fn_name, ctor, setup, upd, id=cls_name))
+    return out
+
+
+DUAL_CASES = _dual_cases()
+
+# update() is intentionally host-side (C++/numpy DSP) or infers static shape
+# info from data values — documented behavior, not jit-traceable
+JIT_HOST_ONLY = {
+    "Dice": "infers num_classes from data values (reference semantics)",
+    "PerceptualEvaluationSpeechQuality": "C++ P.862 kernel runs on host",
+    "ShortTimeObjectiveIntelligibility": "host numpy DSP (third-octave bands)",
+    "SpeechReverberationModulationEnergyRatio": "host numpy DSP (gammatone)",
+}
+
+
+@pytest.mark.parametrize("module_name,cls_name,fn_name,ctor,setup,upd", DUAL_CASES)
+def test_modular_equals_functional(module_name, cls_name, fn_name, ctor, setup, upd):
+    ns, upd = _build(module_name, cls_name, ctor, setup, upd)
+    m = ns["m"]
+    exec(f"m.update({upd})", ns)
+    modular = m.compute()
+
+    fn = getattr(F, fn_name)
+    ns["_fn"] = fn
+    call_args = upd if not ctor else f"{upd}, {ctor}"
+    try:
+        exec(f"_functional = _fn({call_args})", ns)
+    except TypeError as e:
+        pytest.skip(f"functional twin takes different arguments: {e}")
+    _tree_allclose(modular, ns["_functional"])
+
+
+@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CASES)
+def test_functional_update_jits(module_name, cls_name, ctor, setup, upd):
+    ns, upd = _build(module_name, cls_name, ctor, setup, upd)
+    m = ns["m"]
+    args = [a.strip() for a in upd.split(",") if "=" not in a]
+    kwargs = dict(a.strip().split("=") for a in upd.split(",") if "=" in a)
+    values = [ns[a] for a in args] + [ns[v] for v in kwargs.values()]
+    if not all(isinstance(v, jax.Array) for v in values):
+        pytest.skip("inputs are host-side objects (strings/dicts); update is host code")
+    state = m.init_state()
+    if any(isinstance(v, list) for v in state.values()):
+        pytest.skip("growing list state; jit path covered by capacity-buffer tests")
+    if cls_name in JIT_HOST_ONLY:
+        pytest.skip(JIT_HOST_ONLY[cls_name])
+    jitted = jax.jit(m.functional_update)
+    out = jitted(state, *[ns[a] for a in args], **{k: ns[v] for k, v in kwargs.items()})
+    eager = m.functional_update(state, *[ns[a] for a in args], **{k: ns[v] for k, v in kwargs.items()})
+    # jit reassociates float reductions; allow latitude beyond bit-exactness
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(eager)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-3)

@@ -207,6 +207,11 @@ SETUP_OVERRIDE_LINES["SpatialDistortionIndex"] = [
     "target = {'ms': preds[:, :, ::4, ::4] * 0.9, 'pan': preds * 0.95}",
 ]
 SETUP_OVERRIDE_LINES["QualityWithNoReference"] = SETUP_OVERRIDE_LINES["SpatialDistortionIndex"]
+SETUP_OVERRIDE_LINES["VisualInformationFidelity"] = [
+    "import jax.numpy as jnp",
+    "preds = (jnp.arange(2 * 3 * 48 * 48).reshape(2, 3, 48, 48) % 255) / 255.0",
+    "target = preds * 0.75",
+]
 SETUP_OVERRIDE_LINES["ComplexScaleInvariantSignalNoiseRatio"] = [
     "import jax.numpy as jnp",
     "target = jnp.stack([jnp.cos(jnp.arange(20.0)).reshape(4, 5), jnp.sin(jnp.arange(20.0)).reshape(4, 5)], axis=-1)",

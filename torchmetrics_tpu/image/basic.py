@@ -610,7 +610,7 @@ class VisualInformationFidelity(Metric):
     Example:
         >>> from torchmetrics_tpu.image import VisualInformationFidelity
         >>> import jax.numpy as jnp
-        >>> preds = (jnp.arange(2 * 3 * 32 * 32).reshape(2, 3, 32, 32) % 255) / 255.0
+        >>> preds = (jnp.arange(2 * 3 * 48 * 48).reshape(2, 3, 48, 48) % 255) / 255.0
         >>> target = preds * 0.75
         >>> m = VisualInformationFidelity()
         >>> m.update(preds, target)
@@ -634,6 +634,12 @@ class VisualInformationFidelity(Metric):
     def update(self, preds: Array, target: Array) -> None:
         preds = jnp.asarray(preds, dtype=jnp.float32)
         target = jnp.asarray(target, dtype=jnp.float32)
+        # same minimum as the functional path / reference image/vif.py: the
+        # 4-scale pyramid needs >=41 pixels per side
+        if preds.shape[-1] < 41 or preds.shape[-2] < 41:
+            raise ValueError(
+                f"Invalid size of preds. Expected at least 41x41, but got {preds.shape[-1]}x{preds.shape[-2]}!"
+            )
         channels = preds.shape[1]
         vif_per_channel = [
             _vif_per_channel(preds[:, i], target[:, i], self.sigma_n_sq) for i in range(channels)
