@@ -78,7 +78,7 @@ def _dual_cases():
     out = []
     for c in CASES:
         (module_name, cls_name, ctor, setup, upd) = c.values
-        if cls_name in DUAL_SKIP:
+        if cls_name in DUAL_SKIP or not isinstance(upd, str):
             continue
         fn_name = NAME_MAP.get(cls_name, _snake(cls_name))
         fn = getattr(F, fn_name, None)
@@ -97,6 +97,8 @@ JIT_HOST_ONLY = {
     "PerceptualEvaluationSpeechQuality": "C++ P.862 kernel runs on host",
     "ShortTimeObjectiveIntelligibility": "host numpy DSP (third-octave bands)",
     "SpeechReverberationModulationEnergyRatio": "host numpy DSP (gammatone)",
+    "PanopticQuality": "segment extraction is host-side at update time",
+    "ModifiedPanopticQuality": "segment extraction is host-side at update time",
 }
 
 
@@ -119,6 +121,10 @@ def test_modular_equals_functional(module_name, cls_name, fn_name, ctor, setup, 
 
 @pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CASES)
 def test_functional_update_jits(module_name, cls_name, ctor, setup, upd):
+    if not isinstance(upd, str):
+        pytest.skip("multi-round update (real/fake phases); jit covered by domain tests")
+    if module_name.startswith("torchmetrics_tpu.wrappers"):
+        pytest.skip("wrappers delegate update to child metrics; no own functional state")
     ns, upd = _build(module_name, cls_name, ctor, setup, upd)
     m = ns["m"]
     args = [a.strip() for a in upd.split(",") if "=" not in a]

@@ -75,7 +75,111 @@ def _collect_cases():
 _TEXT_FLAT = {"WordErrorRate", "CharErrorRate", "MatchErrorRate", "WordInfoLost",
               "WordInfoPreserved", "EditDistance"}
 
-CASES = _collect_cases()
+
+# ---------------------------------------------------------------------------
+# hand-specified cases for domains outside the registry: detection, multimodal,
+# model-backed image (picklable module-level hooks), and wrappers
+# ---------------------------------------------------------------------------
+
+def _feat(x):
+    """Picklable toy feature extractor for the inception-family metrics."""
+    return x.mean(axis=(2, 3))
+
+
+def _img_embed(images, texts):
+    """Picklable toy joint embedder for CLIPScore."""
+    img_f = jnp.stack([img.mean(axis=(1, 2)) for img in images])
+    txt_f = jnp.asarray([[len(t), t.count("a"), 1.0] for t in texts], dtype=jnp.float32)
+    return img_f, txt_f
+
+
+def _txt_embed(texts):
+    return jnp.asarray([[len(t), t.count("o"), 1.0] for t in texts], dtype=jnp.float32)
+
+
+def _lpips_net(a, b):
+    return jnp.mean((a - b) ** 2, axis=(1, 2, 3))
+
+
+_DET_SETUP = (
+    "import jax.numpy as jnp",
+    'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 20.0, 20.0]]),'
+    ' "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}]',
+    'target = [{"boxes": jnp.asarray([[12.0, 10.0, 22.0, 20.0]]), "labels": jnp.asarray([0])}]',
+)
+_PANOPTIC_SETUP = (
+    "import jax.numpy as jnp",
+    "preds = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])",
+    "target = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [0, 0], [1, 0]]])",
+)
+_IMG8 = (
+    "import jax.numpy as jnp",
+    "real = (jnp.arange(4 * 3 * 8 * 8).reshape(4, 3, 8, 8) % 255) / 255.0",
+    "fake = 1.0 - real",
+)
+_CLS_SETUP = (
+    "import jax.numpy as jnp",
+    "from torchmetrics_tpu.classification import BinaryAccuracy",
+    "preds = jnp.asarray([0.2, 0.8, 0.3, 0.6])",
+    "target = jnp.asarray([0, 1, 1, 0])",
+)
+
+EXTRA_CASES = [
+    ("torchmetrics_tpu.detection", "IntersectionOverUnion", "", _DET_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "GeneralizedIntersectionOverUnion", "", _DET_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "DistanceIntersectionOverUnion", "", _DET_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "CompleteIntersectionOverUnion", "", _DET_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "MeanAveragePrecision", "", _DET_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "PanopticQuality", "things={0}, stuffs={1}", _PANOPTIC_SETUP, "preds, target"),
+    ("torchmetrics_tpu.detection", "ModifiedPanopticQuality", "things={0}, stuffs={1}", _PANOPTIC_SETUP,
+     "preds, target"),
+    ("torchmetrics_tpu.multimodal", "CLIPScore", "embedding_fn=_img_embed",
+     _IMG8 + ("from test_lifecycle_sweep import _img_embed",
+              'texts = ["a photo of a cat", "a photo of a dog", "a bird", "a fish"]'), "real, texts"),
+    ("torchmetrics_tpu.multimodal", "CLIPImageQualityAssessment",
+     "image_embedding_fn=_feat, text_embedding_fn=_txt_embed",
+     _IMG8 + ("from test_lifecycle_sweep import _feat, _txt_embed",), "real"),
+    ("torchmetrics_tpu.image", "FrechetInceptionDistance", "feature_extractor=_feat, num_features=3",
+     _IMG8 + ("from test_lifecycle_sweep import _feat",), ("real, real=True", "fake, real=False")),
+    ("torchmetrics_tpu.image", "InceptionScore", "feature_extractor=_feat, splits=2",
+     _IMG8 + ("from test_lifecycle_sweep import _feat",), "real"),
+    ("torchmetrics_tpu.image", "KernelInceptionDistance",
+     "feature_extractor=_feat, subsets=2, subset_size=3",
+     _IMG8 + ("from test_lifecycle_sweep import _feat",), ("real, real=True", "fake, real=False")),
+    ("torchmetrics_tpu.image", "MemorizationInformedFrechetInceptionDistance", "feature_extractor=_feat",
+     _IMG8 + ("from test_lifecycle_sweep import _feat",), ("real, real=True", "fake, real=False")),
+    ("torchmetrics_tpu.image", "LearnedPerceptualImagePatchSimilarity", "net=_lpips_net",
+     _IMG8 + ("from test_lifecycle_sweep import _lpips_net",), "real, fake"),
+    ("torchmetrics_tpu.wrappers", "BootStrapper", "BinaryAccuracy(), num_bootstraps=4, seed=42",
+     _CLS_SETUP, "preds, target"),
+    ("torchmetrics_tpu.wrappers", "MinMaxMetric", "BinaryAccuracy()", _CLS_SETUP, "preds, target"),
+    ("torchmetrics_tpu.wrappers", "ClasswiseWrapper", "MulticlassAccuracy(num_classes=3, average=None)",
+     ("import jax.numpy as jnp",
+      "from torchmetrics_tpu.classification import MulticlassAccuracy",
+      "preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])",
+      "target = jnp.asarray([0, 1, 2, 0])"), "preds, target"),
+    ("torchmetrics_tpu.wrappers", "MultioutputWrapper", "MeanSquaredError(), num_outputs=2",
+     ("import jax.numpy as jnp",
+      "from torchmetrics_tpu.regression import MeanSquaredError",
+      "preds = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])",
+      "target = jnp.asarray([[1.0, 1.0], [4.0, 3.0]])"), "preds, target"),
+    ("torchmetrics_tpu.wrappers", "MultitaskWrapper",
+     '{"cls": BinaryAccuracy(), "reg": MeanSquaredError()}',
+     _CLS_SETUP + ("from torchmetrics_tpu.regression import MeanSquaredError",
+                   'pd = {"cls": preds, "reg": preds}',
+                   'td = {"cls": target, "reg": target.astype(jnp.float32)}'), "pd, td"),
+    ("torchmetrics_tpu.wrappers", "Running", "SumMetric(), window=2",
+     ("import jax.numpy as jnp", "from torchmetrics_tpu.aggregation import SumMetric",
+      "values = jnp.asarray([1.0, 2.0, 3.0])"), "values"),
+]
+
+CASES = _collect_cases() + [
+    pytest.param(mod, cls, ctor, tuple(setup), upd, id=cls) for mod, cls, ctor, setup, upd in EXTRA_CASES
+]
+
+# stochastic wrappers resample per update (RNG advances across calls, like the
+# reference's global-RNG bootstrap), so reset+update is not value-reproducible
+STOCHASTIC = {"BootStrapper"}
 
 
 def _build(module_name, cls_name, ctor, setup, upd):
@@ -105,11 +209,17 @@ def _tree_allclose(a, b):
 def test_lifecycle(module_name, cls_name, ctor, setup, upd):
     ns, upd = _build(module_name, cls_name, ctor, setup, upd)
     m = ns["m"]
+    rounds = (upd,) if isinstance(upd, str) else upd
+
+    def do_update(metric):
+        nsx = dict(ns); nsx["m"] = metric
+        for r in rounds:
+            exec(f"m.update({r})", nsx)
 
     # 1. repeated update + compute
-    exec(f"m.update({upd})", ns)
+    do_update(m)
     v1 = m.compute()
-    exec(f"m.update({upd})", ns)
+    do_update(m)
     v2 = m.compute()
 
     # 2. pickle round-trip preserves the computed value
@@ -117,12 +227,12 @@ def test_lifecycle(module_name, cls_name, ctor, setup, upd):
     _tree_allclose(m2.compute(), v2)
 
     # 3. clone is independent: updating the clone leaves the original unchanged
-    c = m.clone()
-    ns_c = dict(ns); ns_c["m"] = c
-    exec(f"m.update({upd})", ns_c)
+    do_update(m.clone())
     _tree_allclose(m.compute(), v2)
 
-    # 4. reset + single update reproduces the first value
-    m.reset()
-    exec(f"m.update({upd})", ns)
-    _tree_allclose(m.compute(), v1)
+    # 4. reset + single update reproduces the first value (stochastic
+    # resamplers advance their RNG per call and are exempt)
+    if cls_name not in STOCHASTIC:
+        m.reset()
+        do_update(m)
+        _tree_allclose(m.compute(), v1)

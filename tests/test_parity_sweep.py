@@ -67,7 +67,13 @@ def _compare(ours, theirs, atol):
         )
 
 
-PARITY_CASES = [c for c in CASES if c.id not in PARITY_SKIP]
+# wrapper ctor strings instantiate nested metrics, which would hand OUR classes
+# to the reference wrapper; wrappers have dedicated parity tests elsewhere
+PARITY_CASES = [
+    c for c in CASES
+    if c.id not in PARITY_SKIP and isinstance(c.values[4], str)
+    and not c.values[0].startswith("torchmetrics_tpu.wrappers")
+]
 
 
 @pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", PARITY_CASES)

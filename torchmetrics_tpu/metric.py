@@ -484,8 +484,15 @@ class Metric:
         return self.merge_states(state, batch_state, counts=counts), batch_value
 
     def functional_sync(self, state: Dict[str, Any], axis_name: Optional[Union[str, Sequence[str]]] = None) -> Dict[str, Any]:
-        """Pure in-trace sync: apply the declared collectives over ``axis_name``."""
-        return sync_states(state, self._reductions, axis_name or self.sync_axis)
+        """Pure in-trace sync: apply the declared collectives over ``axis_name``.
+
+        Honors ``dist_sync_fn`` (e.g. ``parallel.quantized_sync``) like the OO
+        :meth:`sync` path does.
+        """
+        axis = axis_name or self.sync_axis
+        if self.dist_sync_fn is not None:
+            return {k: self.dist_sync_fn(v, self._reductions.get(k), axis) for k, v in state.items()}
+        return sync_states(state, self._reductions, axis)
 
     def merge_states(
         self, a: Dict[str, Any], b: Dict[str, Any], counts: Optional[Tuple[int, int]] = None

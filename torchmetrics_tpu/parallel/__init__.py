@@ -1,3 +1,7 @@
+from torchmetrics_tpu.parallel.quantized import (  # noqa: F401
+    quantized_all_gather,
+    quantized_sync,
+)
 from torchmetrics_tpu.parallel.sync import (  # noqa: F401
     Reduction,
     class_reduce,
@@ -8,3 +12,16 @@ from torchmetrics_tpu.parallel.sync import (  # noqa: F401
     sync_states,
     sync_value,
 )
+
+__all__ = [
+    "Reduction",
+    "class_reduce",
+    "gather_all_tensors",
+    "host_sync_value",
+    "in_named_axis_context",
+    "quantized_all_gather",
+    "quantized_sync",
+    "reduce",
+    "sync_states",
+    "sync_value",
+]

@@ -1,0 +1,84 @@
+"""Quantized gather for large metric states — an ICI-bandwidth optimization.
+
+Concatenation-reduced ("cat"/None) states are the one sync path whose cost grows
+with O(world · |state|): feature buffers (KID/IS), capacity-buffered curves and
+retrieval grids can reach megabytes per chip. Following the EQuARX direction
+(quantized collectives in XLA, arxiv 2506.17615), `quantized_all_gather` moves
+int8/int16 payloads over the mesh instead of float32 — 4x/2x fewer bytes on the
+wire — with one max-abs scale per source shard gathered alongside.
+
+Sum/mean/max/min reductions stay exact `psum`-family ops (already O(|state|);
+quantizing them would change results for no bandwidth win at metric-state
+sizes). Opt in per metric:
+
+    metric = KernelInceptionDistance(..., dist_sync_fn=quantized_sync(bits=8))
+
+The error of a gathered value is bounded by ``max|x| / (2**(bits-1) - 1)`` per
+source shard (half a quantization step after rounding).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+from torchmetrics_tpu.parallel.sync import Reduction, sync_value
+
+_INT_DTYPES = {8: jnp.int8, 16: jnp.int16}
+
+
+def _encode(x: Array, bits: int):
+    """Max-abs symmetric quantization: (codes, scale)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(x / scale), -qmax, qmax).astype(_INT_DTYPES[bits])
+    return codes, scale
+
+
+def quantized_all_gather(x: Array, axis_name: Union[str, Sequence[str]], bits: int = 8) -> Array:
+    """All-gather ``x`` over ``axis_name`` with an int payload on the wire.
+
+    Each shard sends its values quantized against its own max-abs scale plus one
+    f32 scalar; the receiver dequantizes per source shard. Output matches
+    ``lax.all_gather(x, axis_name, axis=0)`` up to quantization error.
+    """
+    if bits not in _INT_DTYPES:
+        raise ValueError(f"bits must be one of {sorted(_INT_DTYPES)}, got {bits}")
+    x = jnp.atleast_1d(x)
+    codes, scale = _encode(x, bits)
+    gathered_codes = lax.all_gather(codes, axis_name, axis=0)      # (W, *x.shape)
+    gathered_scales = lax.all_gather(scale, axis_name, axis=0)     # (W,)
+    expand = (-1,) + (1,) * x.ndim
+    return gathered_codes.astype(x.dtype) * gathered_scales.reshape(expand).astype(x.dtype)
+
+
+def quantized_sync(bits: int = 8) -> Callable[[Any, Reduction, Union[str, Sequence[str]]], Any]:
+    """A drop-in ``dist_sync_fn``: quantized gather for float cat/None states.
+
+    Everything else (exact psum-family reductions, integer/bool payloads,
+    custom callables) defers to the exact :func:`sync_value` path.
+    """
+
+    def _sync(value: Any, reduction: Reduction, axis_name: Union[str, Sequence[str]]) -> Any:
+        is_list = isinstance(value, (list, tuple))
+        if reduction in ("cat", None) and not callable(reduction):
+            payload = value
+            if is_list:
+                if len(payload) == 0:
+                    return payload
+                payload = jnp.concatenate([jnp.atleast_1d(v) for v in payload], axis=0)
+            if jnp.issubdtype(payload.dtype, jnp.floating):
+                gathered = quantized_all_gather(payload, axis_name, bits=bits)
+                out = gathered.reshape((-1,) + gathered.shape[2:]) if reduction == "cat" else gathered
+                return [out] if is_list else out
+        return sync_value(value, reduction, axis_name)
+
+    _sync.__name__ = f"quantized_sync_{bits}"
+    return _sync
+
+
+quantized_sync_int8 = partial(quantized_sync, 8)
+quantized_sync_int16 = partial(quantized_sync, 16)
