@@ -9,6 +9,7 @@ plus algebraic unit checks of their DSP building blocks.
 """
 import sys
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -265,3 +266,51 @@ class TestShortSignals:
         x = rng.randn(1600) * 0.1  # 0.2 s @ 8 kHz < the 0.256 s analysis window
         v = FA.speech_reverberation_modulation_energy_ratio(jnp.asarray(x), 8000)
         assert np.isfinite(np.asarray(v)).all()
+
+
+class TestDeviceSTOI:
+    """The on_device STOI pipeline (jit/vmap-able float32) must track the host
+    float64 path across sample rates, silent-frame dropping, and both variants."""
+
+    def _signals(self, fs, seconds=2.0, seed=0):
+        rng = np.random.RandomState(seed)
+        n = int(fs * seconds)
+        t = np.arange(n) / fs
+        clean = np.sin(2 * np.pi * 440 * t) * (1 + 0.3 * np.sin(2 * np.pi * 3 * t))
+        clean[: n // 8] *= 0.001  # leading silence exercises frame dropping
+        deg = clean + 0.2 * rng.randn(n)
+        return jnp.asarray(deg, jnp.float32), jnp.asarray(clean, jnp.float32)
+
+    @pytest.mark.parametrize("fs", [10000, 8000, 16000])
+    @pytest.mark.parametrize("extended", [False, True])
+    def test_matches_host_path(self, fs, extended):
+        from torchmetrics_tpu.functional.audio.stoi import (
+            short_time_objective_intelligibility as stoi,
+        )
+
+        deg, clean = self._signals(fs)
+        host = float(stoi(deg, clean, fs=fs, extended=extended))
+        device = float(stoi(deg, clean, fs=fs, extended=extended, on_device=True))
+        assert abs(host - device) < 1e-3
+
+    def test_jit_and_vmap(self):
+        from torchmetrics_tpu.functional.audio.stoi import stoi_on_device
+
+        deg, clean = self._signals(10000)
+        batch_d = jnp.stack([deg, deg * 0.5])
+        batch_c = jnp.stack([clean, clean])
+        f = jax.jit(lambda p, t: stoi_on_device(p, t, fs=10000))
+        out = f(batch_d, batch_c)
+        assert out.shape == (2,)
+        single = stoi_on_device(deg, clean, fs=10000)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(single), atol=1e-5)
+
+    def test_class_on_device_matches(self):
+        from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+
+        deg, clean = self._signals(8000)
+        host_m = ShortTimeObjectiveIntelligibility(fs=8000)
+        dev_m = ShortTimeObjectiveIntelligibility(fs=8000, on_device=True)
+        host_m.update(deg, clean)
+        dev_m.update(deg, clean)
+        assert abs(float(host_m.compute()) - float(dev_m.compute())) < 1e-3

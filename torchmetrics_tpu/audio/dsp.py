@@ -102,7 +102,10 @@ class ShortTimeObjectiveIntelligibility(Metric):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 
-    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+    def __init__(self, fs: int, extended: bool = False, on_device: bool = False, **kwargs: Any) -> None:
+        """``on_device=True`` (TPU extension) runs the jit/vmap-able float32 STOI
+        pipeline so ``update`` can trace into a compiled step; the default host
+        float64 path matches pystoi bit-for-bit."""
         super().__init__(**kwargs)
         if not isinstance(fs, int) or fs <= 0:
             raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
@@ -110,13 +113,16 @@ class ShortTimeObjectiveIntelligibility(Metric):
         if not isinstance(extended, bool):
             raise ValueError(f"Expected argument `extended` to be a bool, but got {extended}")
         self.extended = extended
+        self.on_device = on_device
 
         self.add_state("sum_stoi", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate per-signal STOI (reference stoi.py:103-110)."""
-        scores = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        scores = short_time_objective_intelligibility(
+            preds, target, self.fs, self.extended, on_device=self.on_device
+        )
         self.sum_stoi = self.sum_stoi + jnp.sum(scores)
         self.total = self.total + jnp.atleast_1d(scores).size
 
