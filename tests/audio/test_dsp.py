@@ -314,3 +314,48 @@ class TestDeviceSTOI:
         host_m.update(deg, clean)
         dev_m.update(deg, clean)
         assert abs(float(host_m.compute()) - float(dev_m.compute())) < 1e-3
+
+
+class TestDeviceSRMR:
+    """The on_device SRMR (FIR-approximated filterbanks, FFT pipeline) must
+    track the host float64 IIR path."""
+
+    def _signal(self, fs, seconds=2.0, seed=0):
+        rng = np.random.RandomState(seed)
+        n = int(fs * seconds)
+        t = np.arange(n) / fs
+        sig = np.sin(2 * np.pi * 220 * t) * (1 + 0.5 * np.sin(2 * np.pi * 4 * t))
+        return jnp.asarray(sig + 0.05 * rng.randn(n), jnp.float32)
+
+    @pytest.mark.parametrize("fs", [8000, 16000])
+    @pytest.mark.parametrize("norm", [False, True])
+    def test_matches_host_path(self, fs, norm):
+        from torchmetrics_tpu.functional.audio.srmr import (
+            speech_reverberation_modulation_energy_ratio as srmr,
+        )
+
+        sig = self._signal(fs)
+        host = float(jnp.atleast_1d(srmr(sig, fs=fs, norm=norm))[0])
+        device = float(jnp.atleast_1d(srmr(sig, fs=fs, norm=norm, on_device=True))[0])
+        assert abs(host - device) / abs(host) < 1e-3
+
+    def test_jit_and_batch(self):
+        from torchmetrics_tpu.functional.audio.srmr import srmr_on_device
+
+        sig = self._signal(8000)
+        batch = jnp.stack([sig, sig * 0.5])
+        f = jax.jit(lambda x: srmr_on_device(x, fs=8000))
+        out = f(batch)
+        assert out.shape == (2,)
+        single = srmr_on_device(sig, fs=8000)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(jnp.atleast_1d(single)[0]), rtol=1e-5)
+
+    def test_class_on_device_matches(self):
+        from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+
+        sig = self._signal(8000)
+        host_m = SpeechReverberationModulationEnergyRatio(fs=8000)
+        dev_m = SpeechReverberationModulationEnergyRatio(fs=8000, on_device=True)
+        host_m.update(sig)
+        dev_m.update(sig)
+        assert abs(float(host_m.compute()) - float(dev_m.compute())) / float(host_m.compute()) < 1e-3

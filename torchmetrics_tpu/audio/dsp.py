@@ -161,6 +161,7 @@ class SpeechReverberationModulationEnergyRatio(Metric):
         max_cf: Optional[float] = None,
         norm: bool = False,
         fast: bool = False,
+        on_device: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -172,6 +173,7 @@ class SpeechReverberationModulationEnergyRatio(Metric):
         self.max_cf = max_cf
         self.norm = norm
         self.fast = fast
+        self.on_device = on_device
 
         self.add_state("msum", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
@@ -187,6 +189,7 @@ class SpeechReverberationModulationEnergyRatio(Metric):
             max_cf=self.max_cf,
             norm=self.norm,
             fast=self.fast,
+            on_device=self.on_device,
         )
         self.msum = self.msum + jnp.sum(scores)
         self.total = self.total + jnp.atleast_1d(scores).size
