@@ -358,6 +358,12 @@ class MetricCollection:
         for m in self._modules.values():
             m.unsync(**kwargs)
 
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        """Cast every member's states (reference collections.py:582 analogue)."""
+        for m in self._modules.values():
+            m.set_dtype(dst_type)
+        return self
+
     def plot(self, val: Optional[Dict[str, Any]] = None, ax: Any = None, together: bool = False):
         from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
 

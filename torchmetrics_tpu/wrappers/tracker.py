@@ -131,3 +131,14 @@ class MetricTracker:
             rank_zero_warn(f"Encountered the following error when trying to get the best metric: {error}")
             value, step = None, None
         return (value, step) if return_step else value
+
+    def plot(self, val=None, ax=None):
+        """Plot tracked values over steps (reference wrappers/tracker.py:273-330).
+
+        Without ``val``, plots ``compute_all()`` — one line per metric for a
+        tracked collection, a single series otherwise.
+        """
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        return plot_single_or_multi_val(val, ax=ax, name=type(self._base_metric).__name__)
