@@ -199,7 +199,12 @@ class MeanAveragePrecision(Metric):
 
     def _areas(self, items: np.ndarray) -> np.ndarray:
         if self.iou_type == "bbox":
-            return np.asarray(box_area(items)) if items.size else np.zeros(0, dtype=np.float32)
+            if not items.size:
+                return np.zeros(0, dtype=np.float32)
+            # plain numpy: this runs once per (image, class) pair in the host
+            # loop, where a jnp box_area call would cost a device round-trip
+            b = np.asarray(items, dtype=np.float32).reshape(-1, 4)
+            return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
         return items.reshape(items.shape[0], -1).sum(-1).astype(np.float32) if items.shape[0] else np.zeros(0)
 
     def _build_pairs(self, classes: List[int]):
