@@ -27,7 +27,7 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
 
@@ -69,7 +69,7 @@ def main() -> None:
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),
             out_specs=(P(), P(), P(), P()),
-            check_rep=False,
+            check_vma=False,
         )(w, x, y)
 
     acc_state = f1_state = None

@@ -3,7 +3,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchmetrics_tpu.parallel import quantized_all_gather, quantized_sync, sync_value
@@ -28,7 +28,7 @@ def test_quantized_gather_error_bound(mesh8, bits, tol_factor):
         return exact, quant.reshape(exact.shape)
 
     exact, quant = jax.jit(
-        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_rep=False)
+        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
     )(x)
     # per-shard bound: half a step of that shard's scale; use the global max
     # as a conservative bound across all shards
@@ -46,7 +46,7 @@ def test_quantized_sync_defers_exact_reductions(mesh8):
         return fn(x, "sum", "data"), fn(x.astype(jnp.int32), "cat", "data")
 
     s, gathered_int = jax.jit(
-        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_rep=False)
+        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
     )(x)
     np.testing.assert_allclose(np.asarray(s), np.asarray(x.sum(0, keepdims=True)).repeat(1, 0), rtol=1e-6)
     assert gathered_int.dtype == jnp.int32  # exact path, no float round-trip
@@ -70,7 +70,7 @@ def test_metric_with_quantized_dist_sync_fn(mesh8):
         return exact_m.functional_compute(se), quant_m.functional_compute(sq)
 
     exact, quant = jax.jit(
-        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_rep=False)
+        shard_map(inner, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
     )(vals)
     assert exact.shape == quant.shape
     bound = float(jnp.max(jnp.abs(vals))) / 32767

@@ -445,7 +445,7 @@ class TestTextSync:
     def test_wer_psum_matches_serial(self, mesh):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         metric = WordErrorRate()
         # 8 shards, one sentence pair each — host-side counting, device reduce
