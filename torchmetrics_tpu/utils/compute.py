@@ -85,7 +85,14 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """Public AUC entrypoint (reference utilities/compute.py:118)."""
+    """Public AUC entrypoint (reference utilities/compute.py:118).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.compute import auc
+        >>> round(float(auc(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.8, 1.0]))), 4)
+        0.65
+    """
     return _auc_compute(jnp.asarray(x), jnp.asarray(y), reorder=reorder)
 
 
@@ -93,5 +100,12 @@ def interp(x: Array, xp: Array, fp: Array) -> Array:
     """1-D linear interpolation, same semantics as reference utilities/compute.py:134.
 
     ``jnp.interp`` is XLA-native and matches numpy semantics (clamping at the ends).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.compute import interp
+        >>> interp(jnp.asarray([0.25, 0.75]), jnp.asarray([0.0, 0.5, 1.0]),
+        ...        jnp.asarray([0.0, 1.0, 0.0])).tolist()
+        [0.5, 0.5]
     """
     return jnp.interp(jnp.asarray(x), jnp.asarray(xp), jnp.asarray(fp))

@@ -69,6 +69,12 @@ def to_onehot(label_tensor: Array, num_classes: int) -> Array:
 
     Reference utilities/data.py:80. One-hot via broadcast-compare is an MXU/VPU
     friendly pattern on TPU.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.data import to_onehot
+        >>> to_onehot(jnp.asarray([0, 2]), num_classes=3).tolist()
+        [[1, 0, 0], [0, 0, 1]]
     """
     label_tensor = jnp.asarray(label_tensor)
     oh = jnp.asarray(label_tensor[:, None, ...] == jnp.arange(num_classes).reshape(
@@ -78,7 +84,14 @@ def to_onehot(label_tensor: Array, num_classes: int) -> Array:
 
 
 def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
-    """0/1 mask of the top-k entries along ``dim`` (reference utilities/data.py:125)."""
+    """0/1 mask of the top-k entries along ``dim`` (reference utilities/data.py:125).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.data import select_topk
+        >>> select_topk(jnp.asarray([[0.1, 0.7, 0.2], [0.6, 0.1, 0.3]]), topk=2).tolist()
+        [[0, 1, 1], [1, 0, 1]]
+    """
     prob_tensor = jnp.asarray(prob_tensor)
     if topk == 1:  # fast path: argmax one-hot
         idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
@@ -91,7 +104,14 @@ def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
 
 
 def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
-    """Probabilities/logits to integer labels via argmax (reference utilities/data.py:152)."""
+    """Probabilities/logits to integer labels via argmax (reference utilities/data.py:152).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.data import to_categorical
+        >>> to_categorical(jnp.asarray([[0.1, 0.7, 0.2], [0.6, 0.1, 0.3]])).tolist()
+        [1, 0]
+    """
     return jnp.argmax(jnp.asarray(x), axis=argmax_dim)
 
 
