@@ -17,6 +17,7 @@ from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
@@ -34,14 +35,19 @@ def _split_sentence(x: str) -> Sequence[str]:
     return [s for s in _SENTENCE_RE.split(x.strip()) if s]
 
 
-def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, Array]:
-    """precision/recall/fmeasure triple (reference rouge.py:74-92)."""
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    """precision/recall/fmeasure triple (reference rouge.py:74-92).
+
+    Plain floats: scores are per-sentence host values (hundreds per call), so
+    materialising a device scalar each would dominate the runtime; they become
+    one array at aggregation time.
+    """
     precision = hits_or_lcs / pred_len
     recall = hits_or_lcs / target_len
     if precision == recall == 0.0:
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     fmeasure = 2 * precision * recall / (precision + recall)
-    return {"precision": jnp.asarray(precision), "recall": jnp.asarray(recall), "fmeasure": jnp.asarray(fmeasure)}
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
 
 
 def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List[List[int]]:
@@ -111,7 +117,7 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
     pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
     pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
     return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
 
@@ -120,7 +126,7 @@ def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, Arra
     """Rouge-L triple (reference rouge.py:228-241)."""
     pred_len, target_len = len(pred), len(target)
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     return _compute_metrics(_lcs(pred, target), pred_len, target_len)
 
 
@@ -129,7 +135,7 @@ def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[s
     pred_len = sum(map(len, pred))
     target_len = sum(map(len, target))
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
 
     def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
         ngrams: Counter = Counter()
@@ -201,7 +207,7 @@ def _rouge_score_update(
         elif accumulate == "avg":
             for rouge_key in rouge_keys_values:
                 avg = {
-                    t: jnp.stack([r[rouge_key][t] for r in list_results]).mean()
+                    t: sum(r[rouge_key][t] for r in list_results) / len(list_results)
                     for t in ("precision", "recall", "fmeasure")
                 }
                 results[rouge_key].append(avg)
@@ -212,7 +218,10 @@ def _rouge_score_update(
 
 def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
     """Mean over sentence-level scores (reference rouge.py:402-417)."""
-    return {k: jnp.stack(v).mean() if len(v) else jnp.asarray(0.0) for k, v in sentence_results.items()}
+    return {
+        k: jnp.asarray(np.mean([float(x) for x in v]), dtype=jnp.float32) if len(v) else jnp.asarray(0.0)
+        for k, v in sentence_results.items()
+    }
 
 
 def rouge_score(
@@ -228,7 +237,6 @@ def rouge_score(
 
     Example:
         >>> from torchmetrics_tpu.functional import rouge_score
-        >>> import jax.numpy as jnp
         >>> preds = ["the cat sat on the mat"]
         >>> target = [["a cat sat on the mat"]]
         >>> result = rouge_score(preds, target)
