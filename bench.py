@@ -12,7 +12,8 @@ shim):
 3. Image: SSIM + PSNR on 256x256 batches.
 4. Detection: COCO mAP on synthetic boxes (reference: its pure-torch legacy
    _mean_ap path — pycocotools is not installed).
-5. Text: WER + Perplexity.
+5. Text: Perplexity + WER + ROUGE (BASELINE's text config; BERTScore via hooks
+   is parity-tested separately).
 Plus psum/all_gather sync latency vs state size on the 8-device mesh.
 
 The primary line stays config 1 (matching previous rounds' BENCH numbers);
