@@ -143,3 +143,37 @@ def test_functional_update_jits(module_name, cls_name, ctor, setup, upd):
     # jit reassociates float reductions; allow latitude beyond bit-exactness
     for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(eager)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-3)
+
+
+# merge semantics differ by design: stochastic resampling, sliding windows,
+# or running variants whose state is positional
+MERGE_SKIP = {"BootStrapper", "Running", "RunningMean", "RunningSum"}
+
+
+@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CASES)
+def test_merge_states_matches_sequential_updates(module_name, cls_name, ctor, setup, upd):
+    """merge_states(one-batch, one-batch) must equal updating twice in sequence
+    — the contract the sharded train-step examples and dryrun rely on."""
+    if not isinstance(upd, str):
+        pytest.skip("multi-round update phases")
+    if cls_name in MERGE_SKIP:
+        pytest.skip("stochastic or positional state; merge is not defined this way")
+    ns, upd = _build(module_name, cls_name, ctor, setup, upd)
+    m = ns["m"]
+
+    exec(f"m.update({upd})", ns)
+    state_a = m.state()
+    m.reset()
+    exec(f"m.update({upd})", ns)
+    state_b = m.state()
+    merged = m.merge_states(state_a, state_b, counts=(1, 1))
+    merged_value = m.functional_compute(merged)
+
+    m.reset()
+    exec(f"m.update({upd})", ns)
+    exec(f"m.update({upd})", ns)
+    sequential_value = m.compute()
+
+    # compare computed VALUES, not raw states: dist_reduce_fx=None metrics
+    # (e.g. Pearson) stack per-side moments and fold them at compute time
+    _tree_allclose(merged_value, sequential_value)

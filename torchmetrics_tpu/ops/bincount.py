@@ -90,6 +90,15 @@ def weighted_bincount(
     small-to-medium confusion matrices all live in the winning regime.
     Falls back to XLA's scatter-add off-TPU, for small N, or for large bin
     counts. Returns float32 when weighted, int32 otherwise.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.ops.bincount import weighted_bincount
+        >>> weighted_bincount(jnp.asarray([0, 1, 1, 3]), length=4).tolist()
+        [1, 2, 0, 1]
+        >>> weighted_bincount(jnp.asarray([0, 1, 1, 3]),
+        ...                   weights=jnp.asarray([0.5, 1.0, 2.0, 0.25]), length=4).tolist()
+        [0.5, 3.0, 0.0, 0.25]
     """
     x = jnp.asarray(x).ravel()
     weighted = weights is not None

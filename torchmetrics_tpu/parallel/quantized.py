@@ -60,6 +60,13 @@ def quantized_sync(bits: int = 8) -> Callable[[Any, Reduction, Union[str, Sequen
 
     Everything else (exact psum-family reductions, integer/bool payloads,
     custom callables) defers to the exact :func:`sync_value` path.
+
+    Example:
+        >>> from torchmetrics_tpu.parallel import quantized_sync
+        >>> from torchmetrics_tpu.aggregation import CatMetric
+        >>> metric = CatMetric(dist_sync_fn=quantized_sync(bits=8))  # opt in per metric
+        >>> metric.dist_sync_fn.__name__
+        'quantized_sync_8'
     """
 
     def _sync(value: Any, reduction: Reduction, axis_name: Union[str, Sequence[str]]) -> Any:
