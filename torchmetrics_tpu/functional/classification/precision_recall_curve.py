@@ -224,11 +224,19 @@ def _multiclass_precision_recall_curve_update(
     if thresholds is None:
         return None
     len_t = thresholds.shape[0]
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32)  # (N, C)
+    if jax.default_backend() not in ("tpu", "axon"):
+        # O(N·C·log T) bucketing beats the (T, N, C) materialization off-TPU
+        # (bench config 6 history: ops/binned_curve.py)
+        from torchmetrics_tpu.ops import binned_curve_counts_classwise
+
+        w = valid.astype(jnp.float32)[:, None]
+        counts = binned_curve_counts_classwise(preds, target_oh * w, (1.0 - target_oh) * w, thresholds)
+        return counts.astype(jnp.int32)
     preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.int32)  # (T, N, C)
-    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32)  # (N, C)
     idx = (
         preds_t
-        + 2 * target_oh[None, :, :]
+        + 2 * target_oh.astype(jnp.int32)[None, :, :]
         + 4 * jnp.arange(num_classes)[None, None, :]
         + 4 * num_classes * jnp.arange(len_t)[:, None, None]
     )
@@ -342,6 +350,13 @@ def _multilabel_precision_recall_curve_update(
     if thresholds is None:
         return None
     len_t = thresholds.shape[0]
+    if jax.default_backend() not in ("tpu", "axon"):
+        from torchmetrics_tpu.ops import binned_curve_counts_classwise
+
+        w = valid.astype(jnp.float32)  # (N, L) per-label mask
+        tgt = target.astype(jnp.float32)
+        counts = binned_curve_counts_classwise(preds, tgt * w, (1.0 - tgt) * w, thresholds)
+        return counts.astype(jnp.int32)
     preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.int32)  # (T, N, L)
     idx = (
         preds_t

@@ -6,6 +6,6 @@ beats the default lowering (SURVEY §7: "pallas kernels for the hot ops").
 Every kernel has an XLA fallback and is dispatched by backend + problem size.
 """
 from torchmetrics_tpu.ops.bincount import weighted_bincount, weighted_bincount_multi  # noqa: F401
-from torchmetrics_tpu.ops.binned_curve import binned_curve_counts  # noqa: F401
+from torchmetrics_tpu.ops.binned_curve import binned_curve_counts, binned_curve_counts_classwise  # noqa: F401
 
-__all__ = ["binned_curve_counts", "weighted_bincount", "weighted_bincount_multi"]
+__all__ = ["binned_curve_counts", "binned_curve_counts_classwise", "weighted_bincount", "weighted_bincount_multi"]

@@ -98,7 +98,7 @@ def binned_curve_counts(
     """(T, 2, 2) threshold-binned confusion counts with a fused Pallas path.
 
     ``valid`` is the per-sample weight (0 masks ignore_index samples).
-    Falls back to the materialise+scatter path off-TPU / for small N / large T.
+    Falls back to the searchsorted+suffix-sum path off-TPU / for small N / large T.
     """
     preds = jnp.asarray(preds).ravel()
     target = jnp.asarray(target).ravel()
@@ -110,10 +110,63 @@ def binned_curve_counts(
     )
     if use_pallas:
         return _binned_counts_pallas(preds, target, valid, thresholds, interpret=interpret)
-    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.int32)
-    unique_mapping = preds_t + 2 * target.astype(jnp.int32)[None, :] + 4 * jnp.arange(len_t)[:, None]
-    w = jnp.broadcast_to(valid.astype(jnp.float32)[None, :], unique_mapping.shape)
-    from torchmetrics_tpu.ops.bincount import weighted_bincount
+    return _binned_counts_searchsorted(preds, target, valid, thresholds)
 
-    bins = weighted_bincount(unique_mapping.reshape(-1), w.reshape(-1), 4 * len_t)
-    return bins.reshape(len_t, 2, 2)
+
+@jax.jit
+def binned_curve_counts_classwise(preds: Array, pos_w: Array, neg_w: Array, thresholds: Array) -> Array:
+    """(T, C, 2, 2) per-column threshold-binned counts, O(N·C·log T).
+
+    The column-wise generalization of the searchsorted fallback below: each of
+    the C columns (one-vs-rest classes or labels) gets its own (T, 2, 2) count
+    block from a single bucketing pass + suffix sum. ``pos_w``/``neg_w`` are
+    the per-sample-per-column positive/negative weights (already masked for
+    ignore_index). Preferred off-TPU over the (T, N, C) one-hot materialization
+    used by the MXU bincount path.
+    """
+    n, c = preds.shape
+    len_t = thresholds.shape[0]
+    order = jnp.argsort(thresholds)
+    thr_sorted = thresholds[order]
+    k = jnp.searchsorted(thr_sorted, preds.ravel(), side="right")
+    k = jnp.where(jnp.isnan(preds.ravel()), 0, k)
+    col = jnp.broadcast_to(jnp.arange(c), (n, c)).ravel()
+    idx = k * c + col  # bucket-major so the (T+1, C) reshape is direct
+    w = jnp.stack([neg_w.astype(jnp.float32).ravel(), pos_w.astype(jnp.float32).ravel()])
+    hist = jnp.zeros((2, (len_t + 1) * c), dtype=jnp.float32).at[:, idx].add(w)
+    hist = hist.reshape(2, len_t + 1, c)
+    totals = hist.sum(axis=1, keepdims=True)  # (2, 1, C)
+    pred1_sorted = totals - jnp.cumsum(hist, axis=1)[:, :len_t]  # (2, T, C)
+    pred1 = jnp.zeros_like(pred1_sorted).at[:, order].set(pred1_sorted)
+    pred0 = jnp.broadcast_to(totals, pred1.shape) - pred1
+    # (2 target, T, C) x (2 pred) -> (T, C, 2 target, 2 pred)
+    return jnp.stack([pred0, pred1], axis=-1).transpose(1, 2, 0, 3)
+
+
+@jax.jit
+def _binned_counts_searchsorted(preds: Array, target: Array, valid: Array, thresholds: Array) -> Array:
+    """O(N log T) fallback: bucket each sample once, then suffix-sum over bins.
+
+    ``pred >= thr[t]`` holds exactly for the first ``k`` sorted thresholds,
+    where ``k = searchsorted(thr, pred, 'right')`` — so one histogram of ``k``
+    plus a reversed cumulative sum yields the positive count at every
+    threshold simultaneously. Replaces the old (T, N) one-hot contraction
+    (O(N·T) work and memory; 2x slower than torch's bincount path at N=1M on
+    CPU — round-3 bench config 6) with two O(N) scatter-adds.
+    """
+    len_t = thresholds.shape[0]
+    order = jnp.argsort(thresholds)
+    thr_sorted = thresholds[order]
+    k = jnp.searchsorted(thr_sorted, preds, side="right")  # thresholds passed per sample
+    # searchsorted sorts NaN past every threshold; `pred >= thr` (the Pallas
+    # kernel and the reference semantics) is False for NaN -> passes none
+    k = jnp.where(jnp.isnan(preds), 0, k)
+    pos_w = target.astype(jnp.float32) * valid.astype(jnp.float32)
+    neg_w = (1.0 - target.astype(jnp.float32)) * valid.astype(jnp.float32)
+    hist = jnp.zeros((2, len_t + 1), dtype=jnp.float32).at[:, k].add(jnp.stack([neg_w, pos_w]))
+    totals = hist.sum(axis=1, keepdims=True)  # (2, 1): n_neg, n_pos
+    # count at sorted threshold t = samples with k > t = total - cumsum(hist)[t]
+    pred1_sorted = totals - jnp.cumsum(hist, axis=1)[:, :len_t]  # (2, T)
+    pred1 = jnp.zeros_like(pred1_sorted).at[:, order].set(pred1_sorted)
+    # (T, 2 target, 2 pred): [..., 0] = total - passed, [..., 1] = passed
+    return jnp.stack([jnp.broadcast_to(totals, pred1.shape) - pred1, pred1], axis=-1).transpose(1, 0, 2)
