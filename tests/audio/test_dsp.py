@@ -3,9 +3,11 @@
 The external oracles (pystoi, pesq wheel, SRMRpy/gammatone) are not installed
 in this environment — the reference itself cannot run these metrics here.
 STOI is checked against an independent straight-loop numpy re-derivation of
-the published algorithm; PESQ and SRMR are pinned by invariants (identity
-scores, monotonicity under increasing degradation, mode/argument validation)
-plus algebraic unit checks of their DSP building blocks.
+the published algorithm; PESQ is pinned to ITU ground truth via the committed
+anchor fixtures (deterministic signals whose reference-docstring scores were
+computed by the ITU-validated wheel) plus invariants; SRMR is pinned by
+invariants (identity scores, monotonicity under increasing degradation,
+mode/argument validation) plus algebraic unit checks of its DSP blocks.
 """
 import sys
 
@@ -148,6 +150,33 @@ class TestSTOI:
 
 # ------------------------------------------------------------------ PESQ
 class TestPESQ:
+    def test_itu_anchor_conformance(self):
+        """Pin MOS-LQO to ITU ground truth: the committed fixture pair is the
+        deterministic torch.manual_seed(1) randn signal from the reference's
+        PESQ docstring (reference functional/audio/pesq.py:70-84), whose
+        scores there were computed by the ITU-validated `pesq` wheel."""
+        import os
+
+        fdir = os.path.join(os.path.dirname(__file__), "fixtures")
+        ref = np.load(os.path.join(fdir, "pesq_anchor_ref.npy"))
+        deg = np.load(os.path.join(fdir, "pesq_anchor_deg.npy"))
+        nb = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(ref), 8000, "nb"))
+        wb = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(ref), 16000, "wb"))
+        np.testing.assert_allclose(nb, 2.2076, atol=0.05)
+        np.testing.assert_allclose(wb, 1.7359, atol=0.05)
+
+    def test_anchor_fixture_generation(self):
+        """The committed fixtures are exactly the docstring's generator output."""
+        import os
+
+        torch = pytest.importorskip("torch")
+        torch.manual_seed(1)
+        preds = torch.randn(8000).double().numpy()
+        target = torch.randn(8000).double().numpy()
+        fdir = os.path.join(os.path.dirname(__file__), "fixtures")
+        np.testing.assert_array_equal(preds, np.load(os.path.join(fdir, "pesq_anchor_deg.npy")))
+        np.testing.assert_array_equal(target, np.load(os.path.join(fdir, "pesq_anchor_ref.npy")))
+
     def test_identity_max(self):
         fs = 8000
         clean = _speech_like(2 * fs, fs, seed=8)

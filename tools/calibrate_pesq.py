@@ -1,0 +1,72 @@
+"""Solve the PESQ kernel's per-mode disturbance-scale constants.
+
+The C++ P.862 pipeline (torchmetrics_tpu/native/pesq.cpp) is structurally
+faithful but cannot reproduce the ITU code's hand-tuned per-mode band tables,
+whose normalisation is absorbed into two per-mode constants (KSYM, KASYM).
+This script solves them against the only ITU-ground-truth values available
+offline: the reference docstring anchors (reference
+functional/audio/pesq.py:70-84), where a deterministic torch.manual_seed(1)
+randn signal pair is scored by the ITU-validated `pesq` wheel:
+
+    pesq(8000,  target, preds, 'nb') = 2.2076
+    pesq(16000, target, preds, 'wb') = 1.7359
+
+One anchor per mode pins one scalar per mode, so the KASYM/KSYM ratio is held
+fixed (at the 0.1 the pre-calibration defaults used) and the overall scale is
+solved by bisection. Run after any change to the perceptual model, then bake
+the printed values into the TM_PESQ_K* defaults in pesq.cpp.
+
+Usage: python tools/calibrate_pesq.py
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+from scipy.optimize import brentq
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "torchmetrics_tpu", "native", "pesq.cpp")
+ANCHORS = {"nb": (8000, 0, 2.2076), "wb": (16000, 1, 1.7359)}
+ASYM_RATIO = 0.1  # KASYM = ASYM_RATIO * KSYM per mode
+
+
+def anchor_signals() -> tuple[np.ndarray, np.ndarray]:
+    import torch
+
+    torch.manual_seed(1)
+    preds = torch.randn(8000).double().numpy()  # degraded
+    target = torch.randn(8000).double().numpy()  # reference
+    return target, preds
+
+
+def main() -> None:
+    lib_path = os.path.join(tempfile.mkdtemp(prefix="pesq_cal_"), "libpesq_cal.so")
+    subprocess.run(["g++", "-O3", "-shared", "-fPIC", SRC, "-o", lib_path], check=True)
+    lib = ctypes.CDLL(lib_path)
+    lib.tm_pesq.restype = ctypes.c_double
+    lib.tm_pesq.argtypes = [ctypes.POINTER(ctypes.c_double)] * 2 + [ctypes.c_int64] * 2 + [ctypes.c_int32]
+    lib.tm_pesq_set_calibration.argtypes = [ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+
+    ref, deg = anchor_signals()
+    pd = ctypes.POINTER(ctypes.c_double)
+
+    def mos(mode: str, ksym: float) -> float:
+        fs, wb, _ = ANCHORS[mode]
+        lib.tm_pesq_set_calibration(wb, ksym, ASYM_RATIO * ksym)
+        return lib.tm_pesq(ref.ctypes.data_as(pd), deg.ctypes.data_as(pd), len(ref), fs, wb)
+
+    for mode, (fs, wb, target_mos) in ANCHORS.items():
+        ksym = brentq(lambda k: mos(mode, k) - target_mos, 1e-4, 50.0, xtol=1e-10)
+        achieved = mos(mode, ksym)
+        macro = mode.upper()
+        print(f"#define TM_PESQ_KSYM_{macro} {ksym:.9f}")
+        print(f"#define TM_PESQ_KASYM_{macro} {ASYM_RATIO * ksym:.9f}")
+        print(f"// {mode}: anchor {target_mos}, achieved {achieved:.6f}")
+
+
+if __name__ == "__main__":
+    main()

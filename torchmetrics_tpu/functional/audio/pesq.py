@@ -5,9 +5,11 @@ here the ITU-T P.862 pipeline runs in the first-party native kernel
 (``torchmetrics_tpu/native/pesq.cpp``) via ctypes — level alignment, band-limit
 filtering, delay estimation, Bark-loudness perceptual model and the
 P.862.1/P.862.2 MOS-LQO mapping. See the kernel header for the documented
-simplifications (single-utterance alignment, generated Bark tables, fitted
-aggregation normalisation): scores rank degradations like PESQ but absolute
-values are approximate.
+simplifications (single-utterance alignment, generated Bark tables): their
+normalisation is absorbed into per-mode constants solved against
+ITU-wheel-computed anchor scores (tools/calibrate_pesq.py), so MOS-LQO values
+are pinned to the ITU scale at those anchors (conformance test:
+tests/audio/test_dsp.py) and degradation rankings are pinned by property tests.
 """
 from __future__ import annotations
 
@@ -37,7 +39,7 @@ def perceptual_evaluation_speech_quality(
         >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
         >>> result = perceptual_evaluation_speech_quality(preds, target, fs=8000, mode='nb')
         >>> round(float(result), 4)
-        4.4638
+        4.3889
     """
     if fs not in (8000, 16000):
         raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")

@@ -14,9 +14,12 @@
 // caller): single-utterance time alignment (one global delay from envelope
 // cross-correlation instead of per-utterance splitting/realignment), and
 // Bark band edges generated from the Zwicker-style warp used by P.862
-// (z = 6*asinh(f/600)) rather than the standard's hand-tuned tables. For
-// time-aligned test material these do not change the ranking behaviour of
-// the score; treat absolute values as approximate.
+// (z = 6*asinh(f/600)) rather than the standard's hand-tuned tables. The
+// tables' normalisation is absorbed into per-mode disturbance-scale
+// constants solved against ITU-wheel-computed anchor scores
+// (tools/calibrate_pesq.py; conformance test tests/audio/test_dsp.py), so
+// absolute MOS-LQO values are pinned to the ITU scale at those anchors and
+// rankings are pinned by the property tests.
 //
 // Build: g++ -O3 -shared -fPIC pesq.cpp -o libtm_native.so
 // ABI: plain C, driven through ctypes.
@@ -28,6 +31,21 @@
 #include <vector>
 #include <complex>
 #include <algorithm>
+
+// Values solved by tools/calibrate_pesq.py against the ITU-wheel anchor
+// scores (see the calibration comment in pesq_raw below).
+#ifndef TM_PESQ_KSYM_NB
+#define TM_PESQ_KSYM_NB 1.154065961
+#endif
+#ifndef TM_PESQ_KASYM_NB
+#define TM_PESQ_KASYM_NB 0.115406596
+#endif
+#ifndef TM_PESQ_KSYM_WB
+#define TM_PESQ_KSYM_WB 0.079861207
+#endif
+#ifndef TM_PESQ_KASYM_WB
+#define TM_PESQ_KASYM_WB 0.007986121
+#endif
 
 namespace {
 
@@ -198,6 +216,18 @@ struct PesqResult {
     int error;  // 0 ok
 };
 
+// Disturbance scale calibration, per mode. The ITU code folds band widths
+// into weighted pseudo-Lp norms whose normalisation is defined by its
+// hand-tuned per-mode band tables (narrowband and wideband each have their
+// own); these factors absorb that normalisation, so they are mode-specific
+// too. Solved (tools/calibrate_pesq.py) so the kernel reproduces the
+// ITU-wheel-computed anchor scores committed in tests/audio/fixtures
+// (seed-1 torch.randn signal pair: NB 2.2076, WB 1.7359 — reference
+// functional/audio/pesq.py:70-84 docstring); runtime-settable only for the
+// calibration harness.
+double g_ksym[2] = {TM_PESQ_KSYM_NB, TM_PESQ_KSYM_WB};
+double g_kasym[2] = {TM_PESQ_KASYM_NB, TM_PESQ_KASYM_WB};
+
 PesqResult pesq_raw(const double* ref_in, const double* deg_in, int64_t n_in, int64_t fs_in,
                     bool wideband) {
     if (fs_in != 8000 && fs_in != 16000) return {0.0, 1};
@@ -306,20 +336,24 @@ PesqResult pesq_raw(const double* ref_in, const double* deg_in, int64_t n_in, in
         for (int b = 0; b < nbands; ++b) pdeg[t][b] *= g;
     }
 
-    // 7. Zwicker loudness per band with the P.862 loudness scaling Sl
+    // 7. Zwicker loudness per band with the P.862 loudness scaling Sl.
+    // Below 4 bark the exponent is raised by h = 6/(z+2), capped at 2 —
+    // the standard's "modified Zwicker power" low-frequency correction.
     const double sl = 1.866055e-1;
     auto loudness = [&](double p, int b) {
         const double p0 = abs_thresh_power(bb.centre[b]);
         const double zb = hz_to_bark(bb.centre[b]);
-        const double e = (zb < 4.0) ? 0.23 * 4.0 / std::max(zb, 0.5) : 0.23;  // steeper below 4 bark
+        const double h = (zb < 4.0) ? std::min(6.0 / (zb + 2.0), 2.0) : 1.0;
+        const double e = 0.23 * h;
         const double v = std::pow(p0 / 0.5, e) * (std::pow(0.5 + 0.5 * p / p0, e) - 1.0);
         return (p <= p0) ? 0.0 : sl * v;
     };
 
-    // 8. masked disturbance per frame
+    // 8. masked disturbance per frame, weighted by reference frame loudness
+    //    (quiet-reference frames contribute less: h = ((E_ref+1e5)/1e7)^0.04)
     std::vector<double> d_frame(nframes, 0.0), da_frame(nframes, 0.0);
     for (size_t t = 0; t < nframes; ++t) {
-        double d2 = 0.0, da = 0.0;
+        double d2 = 0.0, da = 0.0, e_ref = 0.0;
         for (int b = 0; b < nbands; ++b) {
             const double lr = loudness(pref[t][b], b);
             const double ld = loudness(pdeg[t][b], b);
@@ -332,21 +366,11 @@ PesqResult pesq_raw(const double* ref_in, const double* deg_in, int64_t n_in, in
             if (h < 3.0) h = 0.0;
             if (h > 12.0) h = 12.0;
             da += d * h * bb.width[b];
+            e_ref += pref[t][b];
         }
-// Aggregation calibration: the ITU code folds band widths into weighted
-// pseudo-Lp norms whose exact normalisation differs from a plain weighted
-// L2/L1; these factors were fitted so white-noise degradation of a
-// speech-shaped signal produces a monotone, well-spread MOS curve. Absolute
-// scores are approximate (no ITU-licensed oracle available); rankings are
-// what the tests pin down.
-#ifndef TM_PESQ_KSYM
-#define TM_PESQ_KSYM 0.5
-#endif
-#ifndef TM_PESQ_KASYM
-#define TM_PESQ_KASYM 0.05
-#endif
-        d_frame[t] = std::min(45.0, TM_PESQ_KSYM * std::sqrt(d2));
-        da_frame[t] = std::min(45.0, TM_PESQ_KASYM * da);
+        const double wt = std::pow((e_ref + 1e5) / 1e7, 0.04);
+        d_frame[t] = std::min(45.0, g_ksym[wideband] * std::sqrt(d2) / wt);
+        da_frame[t] = std::min(45.0, g_kasym[wideband] * da / wt);
     }
 
     // 9. L6 over 20-frame intervals, then L2 over intervals (active frames only)
@@ -404,6 +428,13 @@ void tm_pesq_batch(const double* ref, const double* deg, int64_t batch, int64_t 
                    int32_t wideband, double* out) {
     for (int64_t i = 0; i < batch; ++i)
         out[i] = tm_pesq(ref + i * n, deg + i * n, n, fs, wideband);
+}
+
+// Calibration-harness hook (tools/calibrate_pesq.py); production code never
+// calls this — the fitted values are baked in as the defaults above.
+void tm_pesq_set_calibration(int32_t wideband, double ksym, double kasym) {
+    g_ksym[wideband != 0] = ksym;
+    g_kasym[wideband != 0] = kasym;
 }
 
 }  // extern "C"

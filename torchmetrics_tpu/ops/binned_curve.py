@@ -117,9 +117,9 @@ def binned_curve_counts(
 def binned_curve_counts_classwise(preds: Array, pos_w: Array, neg_w: Array, thresholds: Array) -> Array:
     """(T, C, 2, 2) per-column threshold-binned counts, O(N·C·log T).
 
-    The column-wise generalization of the searchsorted fallback below: each of
-    the C columns (one-vs-rest classes or labels) gets its own (T, 2, 2) count
-    block from a single bucketing pass + suffix sum. ``pos_w``/``neg_w`` are
+    Each of the C columns (one-vs-rest classes or labels) gets its own
+    (T, 2, 2) count block from a single bucketing pass + suffix sum (see
+    ``_binned_counts_searchsorted`` for the algorithm). ``pos_w``/``neg_w`` are
     the per-sample-per-column positive/negative weights (already masked for
     ignore_index). Preferred off-TPU over the (T, N, C) one-hot materialization
     used by the MXU bincount path.
@@ -153,20 +153,8 @@ def _binned_counts_searchsorted(preds: Array, target: Array, valid: Array, thres
     threshold simultaneously. Replaces the old (T, N) one-hot contraction
     (O(N·T) work and memory; 2x slower than torch's bincount path at N=1M on
     CPU — round-3 bench config 6) with two O(N) scatter-adds.
+    Single-column case of :func:`binned_curve_counts_classwise`.
     """
-    len_t = thresholds.shape[0]
-    order = jnp.argsort(thresholds)
-    thr_sorted = thresholds[order]
-    k = jnp.searchsorted(thr_sorted, preds, side="right")  # thresholds passed per sample
-    # searchsorted sorts NaN past every threshold; `pred >= thr` (the Pallas
-    # kernel and the reference semantics) is False for NaN -> passes none
-    k = jnp.where(jnp.isnan(preds), 0, k)
-    pos_w = target.astype(jnp.float32) * valid.astype(jnp.float32)
-    neg_w = (1.0 - target.astype(jnp.float32)) * valid.astype(jnp.float32)
-    hist = jnp.zeros((2, len_t + 1), dtype=jnp.float32).at[:, k].add(jnp.stack([neg_w, pos_w]))
-    totals = hist.sum(axis=1, keepdims=True)  # (2, 1): n_neg, n_pos
-    # count at sorted threshold t = samples with k > t = total - cumsum(hist)[t]
-    pred1_sorted = totals - jnp.cumsum(hist, axis=1)[:, :len_t]  # (2, T)
-    pred1 = jnp.zeros_like(pred1_sorted).at[:, order].set(pred1_sorted)
-    # (T, 2 target, 2 pred): [..., 0] = total - passed, [..., 1] = passed
-    return jnp.stack([jnp.broadcast_to(totals, pred1.shape) - pred1, pred1], axis=-1).transpose(1, 0, 2)
+    tgt = target.astype(jnp.float32) * valid.astype(jnp.float32)
+    neg = (1.0 - target.astype(jnp.float32)) * valid.astype(jnp.float32)
+    return binned_curve_counts_classwise(preds[:, None], tgt[:, None], neg[:, None], thresholds)[:, 0]
