@@ -163,3 +163,126 @@ class TestLogitsHead:
             jnp.zeros((1, 299, 299, 3), dtype=jnp.float32),
         )[2048]
         np.testing.assert_allclose(np.asarray(via_extractor), np.asarray(direct_zero), atol=1e-6)
+
+
+class TestWeightConverter:
+    """params_from_torch_fidelity_state_dict: the offline weight-loading path."""
+
+    @staticmethod
+    def _tree_to_torch_sd(params):
+        """Independent inverse mapping: flax tree -> torch-fidelity key layout."""
+        sd = {}
+
+        def walk(node, stats, prefix):
+            for name, child in node.items():
+                if name == "fc":
+                    sd["fc.weight"] = np.asarray(child["kernel"]).T
+                elif name == "fc_bias":
+                    sd["fc.bias"] = np.asarray(child)
+                elif name == "conv":
+                    sd[f"{prefix}conv.weight"] = np.asarray(child["kernel"]).transpose(3, 2, 0, 1)
+                elif name == "bn":
+                    sd[f"{prefix}bn.weight"] = np.asarray(child["scale"])
+                    sd[f"{prefix}bn.bias"] = np.asarray(child["bias"])
+                    sd[f"{prefix}bn.running_mean"] = np.asarray(stats[name]["mean"])
+                    sd[f"{prefix}bn.running_var"] = np.asarray(stats[name]["var"])
+                    sd[f"{prefix}bn.num_batches_tracked"] = np.asarray(0)
+                else:
+                    walk(child, stats[name], f"{prefix}{name}.")
+
+        walk(params["params"], params["batch_stats"], "")
+        return sd
+
+    def test_round_trip(self, params):
+        """torch-fidelity-layout state dict converts back to the exact tree."""
+        from torchmetrics_tpu.models.inception import params_from_torch_fidelity_state_dict
+
+        sd = self._tree_to_torch_sd(params)
+        converted = params_from_torch_fidelity_state_dict(sd)
+        flat_a = jax.tree_util.tree_leaves_with_path(params)
+        flat_b = jax.tree_util.tree_leaves_with_path(converted)
+        assert [p for p, _ in flat_a] == [p for p, _ in flat_b]
+        for (_, a), (_, b) in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # converted weights drive the extractor to identical features
+        imgs = jnp.asarray(rng.randint(0, 255, (2, 3, 48, 48)).astype(np.float32))
+        fa = inception_feature_extractor(params)(imgs)
+        fb = inception_feature_extractor(converted)(imgs)
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+    def test_rejects_unknown_and_incomplete(self, params):
+        from torchmetrics_tpu.models.inception import params_from_torch_fidelity_state_dict
+
+        sd = self._tree_to_torch_sd(params)
+        with pytest.raises(ValueError, match="Unrecognised"):
+            params_from_torch_fidelity_state_dict({**sd, "Mixed_9z.conv2.weight": np.zeros(3)})
+        sd.pop("Mixed_5b.branch1x1.conv.weight")
+        with pytest.raises(ValueError, match="missing"):
+            params_from_torch_fidelity_state_dict(sd)
+
+    def test_rejects_wrong_shape(self, params):
+        from torchmetrics_tpu.models.inception import params_from_torch_fidelity_state_dict
+
+        sd = self._tree_to_torch_sd(params)
+        sd["fc.weight"] = sd["fc.weight"][:, :100]
+        with pytest.raises(ValueError, match="[Ss]hape"):
+            params_from_torch_fidelity_state_dict(sd)
+
+
+class TestGoldenActivations:
+    """Fixed-seed params + fixed input -> committed features: pins the
+    architecture (a changed resize matrix, pool quirk or BN epsilon fails).
+    Regenerate after intentional changes: tools/gen_model_goldens.py."""
+
+    def test_inception_golden(self, params):
+        import os
+
+        golden = np.load(os.path.join(os.path.dirname(__file__), "fixtures", "golden_model_activations.npz"))
+        g = np.random.RandomState(1234)
+        imgs = jnp.asarray(g.randint(0, 256, (2, 3, 64, 64)).astype(np.float32))
+        for dim in (64, 192, 768, 2048, "logits"):
+            f = inception_feature_extractor(params, feature_dim=dim)(imgs)
+            np.testing.assert_allclose(
+                np.asarray(f[:, :8], dtype=np.float64),
+                golden[f"inception_{dim}"],
+                rtol=1e-4,
+                atol=1e-6,
+                err_msg=f"inception tap {dim} drifted from committed golden",
+            )
+
+
+class TestReferenceFeatureArgument:
+    """The reference's `feature` first argument (int tap / str head / module)."""
+
+    def test_int_tap_and_str_head(self, params):
+        from torchmetrics_tpu.image import FrechetInceptionDistance, InceptionScore
+
+        fid = FrechetInceptionDistance(feature=64, inception_params=params)
+        assert fid.num_features == 64
+        imgs = jnp.asarray(rng.randint(0, 255, (4, 3, 32, 32)), dtype=jnp.uint8)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert np.isfinite(float(fid.compute()))
+        is_metric = InceptionScore(feature="logits", inception_params=params, splits=2)
+        is_metric.update(imgs)
+        mean, _ = is_metric.compute()
+        assert np.isfinite(float(mean))
+
+    def test_callable_feature(self):
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        fid = FrechetInceptionDistance(feature=lambda x: x.mean(axis=(2, 3)), num_features=3)
+        x = jnp.asarray(rng.rand(4, 3, 8, 8).astype(np.float32))
+        fid.update(x, real=True)
+        fid.update(x * 0.5, real=False)
+        assert np.isfinite(float(fid.compute()))
+
+    def test_invalid_feature_rejected(self, params):
+        from torchmetrics_tpu.image import FrechetInceptionDistance, KernelInceptionDistance
+
+        with pytest.raises(ValueError, match="feature"):
+            FrechetInceptionDistance(feature=13, inception_params=params)
+        with pytest.raises(ValueError, match="feature"):
+            KernelInceptionDistance(feature="bogus", inception_params=params)
+        with pytest.raises(ValueError, match="not both"):
+            FrechetInceptionDistance(feature=lambda x: x, feature_extractor=lambda x: x)

@@ -307,3 +307,33 @@ class TestPerceptualPathLength:
         ours = np.asarray(_resize_tensor(jnp.asarray(x), 16))
         ref = torch.nn.functional.interpolate(torch.from_numpy(x), (16, 16), mode="area").numpy()
         np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+class TestGoldenActivations:
+    """Fixed-seed params + fixed inputs -> committed LPIPS scores, pinning the
+    flax backbones against silent drift (regenerate after intentional
+    architecture changes with tools/gen_model_goldens.py; same .npz as the
+    inception goldens)."""
+
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_lpips_golden(self, net_type):
+        import os
+
+        from torchmetrics_tpu.models.lpips import lpips_network
+
+        golden = np.load(
+            os.path.join(os.path.dirname(__file__), "fixtures", "golden_model_activations.npz")
+        )
+        g = np.random.RandomState(1234)
+        g.randint(0, 256, (2, 3, 64, 64))  # keep the stream position of the generator script
+        a = jnp.asarray(g.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+        b = jnp.asarray(g.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+        params = init_lpips_params(net_type, jax.random.PRNGKey(0))
+        score = lpips_network(net_type, params)(a, b)
+        np.testing.assert_allclose(
+            np.asarray(score, dtype=np.float64),
+            golden[f"lpips_{net_type}"],
+            rtol=1e-4,
+            atol=1e-6,
+            err_msg=f"lpips {net_type} drifted from committed golden",
+        )

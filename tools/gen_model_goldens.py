@@ -1,0 +1,61 @@
+"""Regenerate tests/image/fixtures/golden_model_activations.npz.
+
+The golden-activation tests (tests/image/test_inception.py TestGoldenActivations,
+tests/image/test_lpips_family.py TestGoldenActivations) pin the flax
+InceptionV3 and LPIPS backbones against silent architectural drift: fixed-seed
+params + fixed inputs -> committed feature slices. Run this ONLY after an
+intentional architecture change, and say so in the commit message — a golden
+update that accompanies an unintentional numerical change is exactly what the
+tests exist to catch.
+
+The input streams below are consumed in a fixed order; the consuming tests
+replay the same RandomState(1234) stream, so keep the draw order in sync with
+them if you edit either side.
+
+Usage: python tools/gen_model_goldens.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from torchmetrics_tpu.models.inception import inception_feature_extractor, init_inception_params  # noqa: E402
+from torchmetrics_tpu.models.lpips import init_lpips_params, lpips_network  # noqa: E402
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "image", "fixtures", "golden_model_activations.npz",
+)
+
+
+def main() -> None:
+    rng = np.random.RandomState(1234)
+    imgs = rng.randint(0, 256, (2, 3, 64, 64)).astype(np.float32)  # draw 1: inception input
+    out = {"input_seed": np.asarray([1234])}
+
+    params = init_inception_params(jax.random.PRNGKey(0))
+    for dim in (64, 192, 768, 2048, "logits"):
+        f = inception_feature_extractor(params, feature_dim=dim)(jnp.asarray(imgs))
+        out[f"inception_{dim}"] = np.asarray(f[:, :8], dtype=np.float64)
+
+    a = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)  # draw 2: lpips input A
+    b = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)  # draw 3: lpips input B
+    for net in ("alex", "vgg", "squeeze"):
+        lp = init_lpips_params(net, jax.random.PRNGKey(0))
+        out[f"lpips_{net}"] = np.asarray(lpips_network(net, lp)(a, b), dtype=np.float64)
+
+    np.savez(OUT, **out)
+    print(f"wrote {OUT}: {sorted(out)}")
+
+
+if __name__ == "__main__":
+    main()

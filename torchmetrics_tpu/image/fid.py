@@ -50,11 +50,18 @@ class FrechetInceptionDistance(Metric):
     """FID with a pluggable feature extractor.
 
     Args:
-        feature_extractor: callable mapping an image batch to (N, F) features.
-        num_features: feature dimensionality F (static, defines state shapes).
+        feature: reference-compatible first argument (reference fid.py:298):
+            an InceptionV3 tap (64/192/768/2048, needs ``inception_params``)
+            or a callable mapping an image batch to (N, F) features.
+        num_features: feature dimensionality F (static, defines state shapes);
+            inferred from ``feature`` when that is a tap selector.
         reset_real_features: keep real-image statistics across ``reset`` calls
             (reference fid.py:393-404).
         normalize: if True, expects float images in [0, 1].
+        inception_params: params tree for the built-in flax InceptionV3
+            (models/inception.py — convert the torch-fidelity checkpoint with
+            ``params_from_torch_fidelity_state_dict``).
+        feature_extractor: explicit spelling of the callable form of ``feature``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -76,22 +83,27 @@ class FrechetInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature_extractor: Optional[Callable[[Array], Array]] = None,
-        num_features: int = 2048,
+        feature: Any = None,
+        num_features: Optional[int] = None,
         reset_real_features: bool = True,
         normalize: bool = False,
         inception_params: Optional[dict] = None,
+        feature_extractor: Optional[Callable[[Array], Array]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        from torchmetrics_tpu.models.inception import NUM_LOGITS, resolve_feature_argument
+
+        if feature is None and feature_extractor is None and num_features is not None:
+            feature = num_features  # explicit num_features selects the matching tap
+        self.feature_extractor, dim = resolve_feature_argument(
+            "FrechetInceptionDistance", feature, feature_extractor, inception_params
+        )
+        if num_features is None:
+            num_features = NUM_LOGITS if isinstance(dim, str) else (dim if dim is not None else 2048)
         if not isinstance(num_features, int) or num_features < 1:
             raise ValueError("Argument `num_features` expected to be a positive integer")
         self.num_features = num_features
-        from torchmetrics_tpu.models.inception import resolve_inception_extractor
-
-        self.feature_extractor = resolve_inception_extractor(
-            "FrechetInceptionDistance", feature_extractor, inception_params, feature_dim=num_features
-        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

@@ -38,20 +38,22 @@ class InceptionScore(Metric):
 
     def __init__(
         self,
-        feature_extractor: Optional[Callable[[Array], Array]] = None,
-        inception_params: Optional[dict] = None,
+        feature: Any = None,
         splits: int = 10,
         normalize: bool = False,
+        inception_params: Optional[dict] = None,
+        feature_extractor: Optional[Callable[[Array], Array]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+        from torchmetrics_tpu.models.inception import resolve_feature_argument
 
-        # IS consumes class logits, not pooled features: the built-in path
-        # taps the 1008-class head like the reference's 'logits_unbiased'
-        # (reference image/inception.py:110)
-        self.feature_extractor = resolve_inception_extractor(
-            "InceptionScore", feature_extractor, inception_params, feature_dim="logits_unbiased"
+        # `feature` (reference inception.py:108-110): IS consumes class
+        # logits, not pooled features — the built-in default taps the
+        # 1008-class head like the reference's 'logits_unbiased'
+        self.feature_extractor, _ = resolve_feature_argument(
+            "InceptionScore", feature, feature_extractor, inception_params,
+            default_dim="logits_unbiased",
         )
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` must be positive")

@@ -58,8 +58,7 @@ class KernelInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature_extractor: Optional[Callable[[Array], Array]] = None,
-        inception_params: Optional[dict] = None,
+        feature: Any = None,
         subsets: int = 100,
         subset_size: int = 1000,
         degree: int = 3,
@@ -67,13 +66,16 @@ class KernelInceptionDistance(Metric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         normalize: bool = False,
+        inception_params: Optional[dict] = None,
+        feature_extractor: Optional[Callable[[Array], Array]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+        from torchmetrics_tpu.models.inception import resolve_feature_argument
 
-        self.feature_extractor = resolve_inception_extractor(
-            "KernelInceptionDistance", feature_extractor, inception_params
+        # `feature` (reference kid.py:176-178): int/str tap or extractor callable
+        self.feature_extractor, _ = resolve_feature_argument(
+            "KernelInceptionDistance", feature, feature_extractor, inception_params
         )
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

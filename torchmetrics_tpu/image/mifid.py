@@ -75,18 +75,20 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature_extractor: Optional[Callable[[Array], Array]] = None,
-        inception_params: Optional[dict] = None,
+        feature: Any = None,
         reset_real_features: bool = True,
-        cosine_distance_eps: float = 0.1,
         normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        inception_params: Optional[dict] = None,
+        feature_extractor: Optional[Callable[[Array], Array]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.models.inception import resolve_inception_extractor
+        from torchmetrics_tpu.models.inception import resolve_feature_argument
 
-        self.feature_extractor = resolve_inception_extractor(
-            "MemorizationInformedFrechetInceptionDistance", feature_extractor, inception_params
+        # `feature` (reference mifid.py:156-158): int/str tap or extractor callable
+        self.feature_extractor, _ = resolve_feature_argument(
+            "MemorizationInformedFrechetInceptionDistance", feature, feature_extractor, inception_params
         )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")

@@ -13,10 +13,10 @@ image/fid.py:30-157), including its quirks:
 - feature taps at 64 (first pool), 192 (second pool), 768 (Mixed_6e) and
   2048 (global average pool) — the reference's `feature` integer choices
 
-Pretrained weights are not bundled (zero-egress environment): pass a params
-tree (e.g. converted from the torch-fidelity checkpoint offline) to
-:func:`inception_feature_extractor`; random init gives architecture-correct
-shapes for testing.
+Pretrained weights are not bundled (zero-egress environment):
+:func:`params_from_torch_fidelity_state_dict` converts the torch-fidelity
+checkpoint offline into the params tree :func:`inception_feature_extractor`
+takes; random init gives architecture-correct shapes for testing.
 """
 from __future__ import annotations
 
@@ -244,6 +244,84 @@ def init_inception_params(key: Optional[Array] = None, image_size: int = 299) ->
     return {"params": variables["params"], "batch_stats": variables.get("batch_stats", {})}
 
 
+def params_from_torch_fidelity_state_dict(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert a torch-fidelity ``FeatureExtractorInceptionV3.state_dict()`` to our tree.
+
+    The reference auto-loads exactly that network (reference image/fid.py:30-44);
+    this is the offline conversion path the module docstring promises, mirroring
+    ``models/lpips.py:params_from_torch_state_dict``. Accepts the state dict as
+    numpy arrays (or anything ``jnp.asarray`` takes) keyed by the torch module
+    paths, e.g. ``Mixed_5b.branch1x1.conv.weight``. Mapping:
+
+    - ``<block>.conv.weight`` (OIHW) -> ``params/<block>/conv/kernel`` (HWIO)
+    - ``<block>.bn.{weight,bias}`` -> ``params/<block>/bn/{scale,bias}``
+    - ``<block>.bn.running_{mean,var}`` -> ``batch_stats/<block>/bn/{mean,var}``
+    - ``fc.weight`` (1008, 2048) -> ``params/fc/kernel`` (2048, 1008);
+      ``fc.bias`` -> ``params/fc_bias`` (the split head that exposes
+      torch-fidelity's pre-bias ``logits_unbiased`` tap)
+
+    Procedure (offline, outside this zero-egress environment)::
+
+        net = torch_fidelity.feature_extractor_inceptionv3.FeatureExtractorInceptionV3(
+            'inception-v3-compat', ['2048'])
+        sd = {k: v.numpy() for k, v in net.state_dict().items()}
+        params = params_from_torch_fidelity_state_dict(sd)
+        # persist with orbax:
+        import orbax.checkpoint as ocp
+        ocp.StandardCheckpointer().save(path, params)
+
+    The result's structure is validated leaf-by-leaf (names and shapes) against
+    the architecture's init tree; missing or mismatched entries raise.
+    """
+    template = init_inception_params()
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+    converted: Dict[str, Any] = {"params": params, "batch_stats": batch_stats}
+    suffix_map = {
+        "conv.weight": ("params", "kernel", lambda w: jnp.transpose(jnp.asarray(w, jnp.float32), (2, 3, 1, 0))),
+        "bn.weight": ("params", "scale", lambda w: jnp.asarray(w, jnp.float32)),
+        "bn.bias": ("params", "bias", lambda w: jnp.asarray(w, jnp.float32)),
+        "bn.running_mean": ("batch_stats", "mean", lambda w: jnp.asarray(w, jnp.float32)),
+        "bn.running_var": ("batch_stats", "var", lambda w: jnp.asarray(w, jnp.float32)),
+    }
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        if key == "fc.weight":
+            params["fc"] = {"kernel": jnp.transpose(jnp.asarray(value, jnp.float32), (1, 0))}
+            continue
+        if key == "fc.bias":
+            params["fc_bias"] = jnp.asarray(value, jnp.float32)
+            continue
+        for suffix, (collection, leaf, fn) in suffix_map.items():
+            if key.endswith("." + suffix):
+                module_path = key[: -len(suffix) - 1].split(".")  # e.g. [Mixed_5b, branch1x1]
+                node = converted[collection]
+                for part in module_path:
+                    node = node.setdefault(part, {})
+                sub = "conv" if suffix.startswith("conv") else "bn"
+                node.setdefault(sub, {})[leaf] = fn(value)
+                break
+        else:
+            raise ValueError(f"Unrecognised torch-fidelity state-dict key: {key!r}")
+
+    def _check(tmpl: Any, got: Any, path: str) -> None:
+        if isinstance(tmpl, dict):
+            if not isinstance(got, dict):
+                raise ValueError(f"Missing subtree {path!r} in converted params")
+            missing = set(tmpl) - set(got)
+            extra = set(got) - set(tmpl)
+            if missing or extra:
+                raise ValueError(f"At {path!r}: missing {sorted(missing)}, unexpected {sorted(extra)}")
+            for k in tmpl:
+                _check(tmpl[k], got[k], f"{path}/{k}")
+        elif tuple(jnp.shape(tmpl)) != tuple(jnp.shape(got)):
+            raise ValueError(f"Shape mismatch at {path!r}: expected {jnp.shape(tmpl)}, got {jnp.shape(got)}")
+
+    _check(template, converted, "")
+    return converted
+
+
 def inception_feature_extractor(
     params: Optional[Dict[str, Any]] = None,
     feature_dim=2048,
@@ -298,3 +376,39 @@ def resolve_inception_extractor(
             " available in this environment."
         )
     return inception_feature_extractor(inception_params, feature_dim=feature_dim)
+
+
+def resolve_feature_argument(
+    metric_name: str,
+    feature,
+    feature_extractor,
+    inception_params: Optional[Dict[str, Any]],
+    default_dim=2048,
+):
+    """Reference-compatible ``feature`` argument for FID/KID/IS/MiFID.
+
+    The reference's first constructor argument (reference image/fid.py:298,
+    kid.py:176-178, inception.py:108-110, mifid.py:156-158) is
+    ``feature: Union[str, int, Module]`` — an integer/string selecting the
+    InceptionV3 tap, or a module used as the extractor. Here a callable plays
+    the module role; int/str taps route to the built-in flax InceptionV3
+    (which needs ``inception_params``). Returns ``(extractor, feature_dim)``
+    where ``feature_dim`` is None when a callable was supplied (its output
+    width is the caller's contract).
+    """
+    if feature is not None and feature_extractor is not None:
+        raise ValueError(f"{metric_name}: pass either `feature` or `feature_extractor`, not both")
+    if feature is not None and callable(feature):
+        return feature, None
+    feature_dim = default_dim if feature is None else feature
+    if feature_dim not in VALID_FEATURE_KEYS:
+        raise ValueError(
+            f"Integer input to argument `feature` must be one of {list(VALID_FEATURE_DIMS)},"
+            f" string input must be 'logits' or 'logits_unbiased', but got {feature_dim}"
+        )
+    extractor = resolve_inception_extractor(
+        metric_name, feature_extractor, inception_params, feature_dim=feature_dim
+    )
+    if feature_extractor is not None:
+        return extractor, None
+    return extractor, feature_dim

@@ -350,7 +350,8 @@ PesqResult pesq_raw(const double* ref_in, const double* deg_in, int64_t n_in, in
     };
 
     // 8. masked disturbance per frame, weighted by reference frame loudness
-    //    (quiet-reference frames contribute less: h = ((E_ref+1e5)/1e7)^0.04)
+    //    (dividing by h = ((E_ref+1e5)/1e7)^0.04 down-weights disturbance in
+    //    LOUD reference frames, where it is less audible — ITU semantics)
     std::vector<double> d_frame(nframes, 0.0), da_frame(nframes, 0.0);
     for (size_t t = 0; t < nframes; ++t) {
         double d2 = 0.0, da = 0.0, e_ref = 0.0;
