@@ -25,7 +25,6 @@ import json
 import os
 import sys
 import time
-import types
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -41,79 +40,17 @@ if "--subbench" in sys.argv:
 
 
 def _stub_lightning_utilities() -> None:
-    """Provide the 4 names the reference imports from lightning_utilities."""
-    from enum import Enum
+    """Install the lightning_utilities shim (single source of truth lives in
+    tests/helpers/reference.py; kept as a name because the verify-skill notes
+    reference it)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from helpers.reference import load_reference_torchmetrics
 
-    lu = types.ModuleType("lightning_utilities")
-    core = types.ModuleType("lightning_utilities.core")
-    imports_mod = types.ModuleType("lightning_utilities.core.imports")
-
-    class RequirementCache:
-        def __init__(self, *a, **k):
-            pass
-
-        def __bool__(self):
-            return False
-
-        def __str__(self):
-            return "stubbed"
-
-    imports_mod.RequirementCache = RequirementCache
-    imports_mod.package_available = lambda name: False
-    imports_mod.compare_version = lambda *a, **k: False
-
-    def apply_to_collection(data, dtype, function, *args, **kwargs):
-        if isinstance(data, dtype):
-            return function(data, *args, **kwargs)
-        if isinstance(data, dict):
-            return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
-        if isinstance(data, (list, tuple)):
-            return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
-        return data
-
-    lu.apply_to_collection = apply_to_collection
-
-    enums_mod = types.ModuleType("lightning_utilities.core.enums")
-
-    class StrEnum(str, Enum):
-        @classmethod
-        def from_str(cls, value, source="key"):
-            for m in cls:
-                if m.value.lower() == value.lower().replace("-", "_") or m.name.lower() == value.lower().replace(
-                    "-", "_"
-                ):
-                    return m
-            return None
-
-        def __eq__(self, other):
-            if isinstance(other, str):
-                return self.value.lower() == other.lower()
-            return Enum.__eq__(self, other)
-
-        def __hash__(self):
-            return hash(self.value.lower())
-
-    enums_mod.StrEnum = StrEnum
-    lu.core = core
-    sys.modules.update(
-        {
-            "lightning_utilities": lu,
-            "lightning_utilities.core": core,
-            "lightning_utilities.core.imports": imports_mod,
-            "lightning_utilities.core.enums": enums_mod,
-        }
-    )
-
-
-_REF_READY = False
+    load_reference_torchmetrics()
 
 
 def _ref():
-    global _REF_READY
-    if not _REF_READY:
-        _stub_lightning_utilities()
-        sys.path.insert(0, "/root/reference/src")
-        _REF_READY = True
+    _stub_lightning_utilities()
     import torchmetrics  # noqa: F401
 
     return torchmetrics

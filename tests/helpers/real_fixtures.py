@@ -1,0 +1,65 @@
+"""Loaders + deterministic degradations for the real-data fixture pack.
+
+Shared between the golden generator (tools/gen_real_fixture_goldens.py, which
+runs the reference implementation offline) and the consuming tests
+(tests/test_real_fixtures.py) so both sides see bit-identical inputs.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "fixtures_real")
+GOLDENS_PATH = os.path.join(FIXTURE_DIR, "goldens.json")
+
+
+def load_images() -> dict:
+    """{'china', 'flower'}: (H, W, 3) uint8 natural photos (sklearn sample images)."""
+    with np.load(os.path.join(FIXTURE_DIR, "images.npz")) as z:
+        return {k: z[k] for k in z.files}
+
+
+def load_speech() -> dict:
+    """{'clip1', 'clip2', 'fs'}: 16 kHz float32 speech-like clips."""
+    with np.load(os.path.join(FIXTURE_DIR, "speech.npz")) as z:
+        return {k: z[k] for k in z.files}
+
+
+def load_text() -> dict:
+    with open(os.path.join(FIXTURE_DIR, "text_corpus.json"), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def load_goldens() -> dict:
+    with open(GOLDENS_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def degraded_image(img: np.ndarray, kind: str) -> np.ndarray:
+    """Deterministic float degradations of an (H, W, 3) uint8 image in [0, 1]."""
+    x = img.astype(np.float64) / 255.0
+    if kind == "noise":
+        r = np.random.RandomState(77)
+        return np.clip(x + 0.08 * r.randn(*x.shape), 0.0, 1.0)
+    if kind == "blur":  # 5-tap box blur per axis, reflect edges
+        pad = np.pad(x, ((2, 2), (2, 2), (0, 0)), mode="reflect")
+        out = np.zeros_like(x)
+        for dy in range(5):
+            for dx in range(5):
+                out += pad[dy : dy + x.shape[0], dx : dx + x.shape[1]]
+        return out / 25.0
+    if kind == "contrast":
+        return np.clip(0.6 * (x - 0.5) + 0.5, 0.0, 1.0)
+    raise ValueError(kind)
+
+
+def degraded_speech(clip: np.ndarray, snr_db: float) -> np.ndarray:
+    """Add white noise at a fixed SNR (deterministic seed per SNR level)."""
+    r = np.random.RandomState(int(1000 + snr_db))
+    noise = r.randn(len(clip)).astype(np.float64)
+    p_sig = float(np.mean(clip.astype(np.float64) ** 2))
+    p_noise = float(np.mean(noise**2))
+    sigma = np.sqrt(p_sig / (p_noise * 10 ** (snr_db / 10)))
+    return (clip.astype(np.float64) + sigma * noise).astype(np.float32)

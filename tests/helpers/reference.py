@@ -21,18 +21,34 @@ def load_reference_torchmetrics():
         core = types.ModuleType("lightning_utilities.core")
         imports_mod = types.ModuleType("lightning_utilities.core.imports")
 
-        class RequirementCache:
-            def __init__(self, *a, **k):
-                pass
+        def _module_importable(name):
+            import importlib.util
 
-            def __bool__(self):
+            try:
+                return importlib.util.find_spec(name) is not None
+            except (ImportError, ValueError):
                 return False
 
+        class RequirementCache:
+            """Truthful for plain module requirements that are importable here
+            (regex, nltk, ...); conservatively False for versioned requirements
+            so the reference keeps the legacy code paths it was loaded with."""
+
+            def __init__(self, requirement="", module=None):
+                self._requirement = requirement
+                self._module = module
+
+            def __bool__(self):
+                name = self._module or self._requirement
+                if any(op in name for op in ("<", ">", "=", "~")):
+                    return False
+                return _module_importable(name.strip().replace("-", "_"))
+
             def __str__(self):
-                return "stubbed"
+                return f"stubbed({self._requirement})"
 
         imports_mod.RequirementCache = RequirementCache
-        imports_mod.package_available = lambda name: False
+        imports_mod.package_available = lambda name: _module_importable(str(name).replace("-", "_"))
         imports_mod.compare_version = lambda *a, **k: False
 
         def apply_to_collection(data, dtype, function, *args, **kwargs):

@@ -1,0 +1,208 @@
+"""Real-data fixture tests: our metrics vs reference-oracle goldens.
+
+The committed asset pack (tests/fixtures_real/: natural photos from sklearn's
+bundled sample images, deterministic formant-synthesized speech clips, a
+multilingual EN/ZH/JA text corpus) plays the role of the reference's S3 data
+pack (reference Makefile:43-46). Goldens were computed offline by running the
+reference implementation itself on CPU torch
+(tools/gen_real_fixture_goldens.py) — so these tests compare our JAX
+implementations against the actual reference behavior on natural-image
+statistics, CJK tokenization corner cases, and speech-shaped signals rather
+than synthetic arrays.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo/tests")
+
+from functools import lru_cache  # noqa: E402
+
+from helpers.real_fixtures import (  # noqa: E402
+    degraded_image,
+    degraded_speech,
+    load_goldens,
+    load_images,
+    load_speech,
+    load_text,
+)
+
+# lazy: a missing/corrupt goldens.json should fail the tests that need it,
+# not abort collection of the whole module
+gold = lru_cache(maxsize=1)(load_goldens)
+
+
+class TestRealImages:
+    """SSIM/PSNR/UQI/VIF/... on natural photos vs reference values."""
+
+    # (golden key, our functional name, kwargs, rtol)
+    # UQI gets a wider tolerance: it has no SSIM-style C1/C2 stabilisers, so
+    # flat windows (blurred sky/background) give ~0/(0+eps) ratios where any
+    # float32 conv-ordering difference vs torch is amplified; the deviation is
+    # the metric's documented ill-conditioning, not an implementation gap
+    CASES = [
+        ("ssim", "structural_similarity_index_measure", {"data_range": 1.0}, 1e-3),
+        ("psnr", "peak_signal_noise_ratio", {"data_range": 1.0}, 1e-3),
+        ("uqi", "universal_image_quality_index", {}, 1e-2),
+        ("vif", "visual_information_fidelity", {}, 5e-3),
+        ("sam", "spectral_angle_mapper", {}, 1e-3),
+        ("ergas", "error_relative_global_dimensionless_synthesis", {}, 1e-3),
+        ("scc", "spatial_correlation_coefficient", {}, 1e-3),
+        ("rmse_sw", "root_mean_squared_error_using_sliding_window", {}, 1e-3),
+        ("ms_ssim", "multiscale_structural_similarity_index_measure", {"data_range": 1.0}, 1e-3),
+    ]
+
+    @pytest.mark.parametrize("image_name", ["china", "flower"])
+    @pytest.mark.parametrize("kind", ["noise", "blur", "contrast"])
+    def test_image_metrics(self, image_name, kind):
+        import torchmetrics_tpu.functional.image as FI
+
+        img = load_images()[image_name]
+        clean = jnp.asarray((img.astype(np.float64) / 255.0).transpose(2, 0, 1)[None], dtype=jnp.float32)
+        deg = jnp.asarray(degraded_image(img, kind).transpose(2, 0, 1)[None], dtype=jnp.float32)
+        golden = gold()["image"][f"{image_name}_{kind}"]
+        for key, fn_name, kwargs, rtol in self.CASES:
+            if key not in golden:
+                continue
+            ours = float(getattr(FI, fn_name)(deg, clean, **kwargs))
+            np.testing.assert_allclose(
+                ours, golden[key], rtol=rtol, atol=1e-4, err_msg=f"{fn_name} on {image_name}_{kind}"
+            )
+
+    @pytest.mark.parametrize("image_name", ["china", "flower"])
+    def test_total_variation(self, image_name):
+        import torchmetrics_tpu.functional.image as FI
+
+        img = load_images()[image_name]
+        clean = jnp.asarray((img.astype(np.float64) / 255.0).transpose(2, 0, 1)[None], dtype=jnp.float32)
+        ours = float(FI.total_variation(clean))
+        np.testing.assert_allclose(ours, gold()["image"][f"{image_name}_tv"], rtol=1e-3)
+
+
+class TestRealText:
+    def test_english_suite(self):
+        import torchmetrics_tpu.functional.text as FT
+
+        corpus = load_text()["english"]
+        golden = gold()["text"]["english"]
+        preds, targets = corpus["preds"], corpus["targets"]
+        listed = [[t] for t in targets]
+        results = {
+            "bleu": float(FT.bleu_score(preds, listed)),
+            "sacre_bleu_13a": float(FT.sacre_bleu_score(preds, listed, tokenize="13a")),
+            "sacre_bleu_intl": float(FT.sacre_bleu_score(preds, listed, tokenize="intl")),
+            "chrf": float(FT.chrf_score(preds, listed)),
+            "ter": float(FT.translation_edit_rate(preds, listed)),
+            "wer": float(FT.word_error_rate(preds, targets)),
+            "cer": float(FT.char_error_rate(preds, targets)),
+            "mer": float(FT.match_error_rate(preds, targets)),
+            "wil": float(FT.word_information_lost(preds, targets)),
+        }
+        for key, ours in results.items():
+            np.testing.assert_allclose(ours, golden[key], rtol=1e-4, err_msg=f"english {key}")
+
+    def test_english_edit_distance(self):
+        """Ours is exact Levenshtein; the reference's banded TER helper
+        (reference functional/text/helper.py:54-295) overestimates by 1 on one
+        heavily-reordered pair (54.75 vs the true 54.5 mean) — assert exactness
+        against an independent DP and stay within that band of the golden."""
+        import torchmetrics_tpu.functional.text as FT
+
+        corpus = load_text()["english"]
+
+        def lev(a, b):
+            prev = list(range(len(b) + 1))
+            for i, ca in enumerate(a, 1):
+                cur = [i] + [0] * len(b)
+                for j, cb in enumerate(b, 1):
+                    cur[j] = min(prev[j - 1] + (ca != cb), prev[j] + 1, cur[j - 1] + 1)
+                prev = cur
+            return prev[-1]
+
+        exact = np.mean([lev(p, t) for p, t in zip(corpus["preds"], corpus["targets"])])
+        ours = float(FT.edit_distance(corpus["preds"], corpus["targets"]))
+        np.testing.assert_allclose(ours, exact, rtol=0, atol=0)
+        assert abs(ours - gold()["text"]["english"]["edit"]) <= 1.0 / len(corpus["preds"]) + 1e-9
+
+    def test_english_rouge(self):
+        import torchmetrics_tpu.functional.text as FT
+
+        corpus = load_text()["english"]
+        rouge = FT.rouge_score(corpus["preds"], corpus["targets"], rouge_keys=("rouge1", "rouge2", "rougeL"))
+        for key, val in gold()["text"]["english"]["rouge"].items():
+            np.testing.assert_allclose(float(rouge[key]), val, rtol=1e-4, err_msg=f"rouge {key}")
+
+    @pytest.mark.parametrize("lang", ["chinese", "japanese"])
+    def test_cjk_suite(self, lang):
+        """CJK tokenization corner cases: char-level SacreBLEU, chrF, CER."""
+        import torchmetrics_tpu.functional.text as FT
+
+        corpus = load_text()[lang]
+        golden = gold()["text"][lang]
+        preds, targets = corpus["preds"], corpus["targets"]
+        listed = [[t] for t in targets]
+        np.testing.assert_allclose(
+            float(FT.sacre_bleu_score(preds, listed, tokenize="char")),
+            golden["sacre_bleu_char"], rtol=1e-4, err_msg=f"{lang} sacre_bleu char",
+        )
+        np.testing.assert_allclose(
+            float(FT.chrf_score(preds, listed)), golden["chrf"], rtol=1e-4, err_msg=f"{lang} chrf"
+        )
+        np.testing.assert_allclose(
+            float(FT.char_error_rate(preds, targets)), golden["cer"], rtol=1e-4, err_msg=f"{lang} cer"
+        )
+
+    def test_chinese_zh_tokenizer(self):
+        import torchmetrics_tpu.functional.text as FT
+
+        corpus = load_text()["chinese"]
+        np.testing.assert_allclose(
+            float(FT.sacre_bleu_score(corpus["preds"], [[t] for t in corpus["targets"]], tokenize="zh")),
+            gold()["text"]["chinese"]["sacre_bleu_zh"], rtol=1e-4,
+        )
+
+
+class TestRealAudio:
+    @pytest.mark.parametrize("clip", ["clip1", "clip2"])
+    @pytest.mark.parametrize("snr_db", [20, 5])
+    def test_snr_family(self, clip, snr_db):
+        import torchmetrics_tpu.functional.audio as FA
+
+        speech = load_speech()
+        clean = jnp.asarray(speech[clip])
+        deg = jnp.asarray(degraded_speech(speech[clip], snr_db))
+        golden = gold()["audio"][f"{clip}_snr{snr_db}"]
+        np.testing.assert_allclose(float(FA.signal_noise_ratio(deg, clean)), golden["snr"], rtol=1e-3)
+        np.testing.assert_allclose(
+            float(FA.scale_invariant_signal_noise_ratio(deg, clean)), golden["si_snr"], rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            float(FA.scale_invariant_signal_distortion_ratio(deg, clean)), golden["si_sdr"], rtol=1e-3
+        )
+        # sdr keeps the batch axis ((1,) for (1, T) input, like the reference)
+        np.testing.assert_allclose(
+            float(FA.signal_distortion_ratio(deg[None], clean[None])[0]), golden["sdr"], rtol=5e-3
+        )
+
+    @pytest.mark.parametrize("clip", ["clip1", "clip2"])
+    def test_stoi_monotone_and_srmr_runs(self, clip):
+        """The wheel-backed reference can't run STOI/SRMR here; on real-shaped
+        speech, pin the behavioral invariant instead: STOI degrades with SNR
+        and SRMR produces a finite score (their numeric parity is covered by
+        the oracle tests in tests/audio/test_dsp.py)."""
+        import torchmetrics_tpu.functional.audio as FA
+
+        speech = load_speech()
+        fs = int(speech["fs"])
+        clean = jnp.asarray(speech[clip])
+        stoi_vals = [
+            float(FA.short_time_objective_intelligibility(jnp.asarray(degraded_speech(speech[clip], s)), clean, fs))
+            for s in (20, 5)
+        ]
+        assert stoi_vals[0] > stoi_vals[1], f"STOI not monotone in SNR: {stoi_vals}"
+        # (1,) return for 1-D input is deliberate reference-quirk parity
+        srmr = float(FA.speech_reverberation_modulation_energy_ratio(clean, fs)[0])
+        assert np.isfinite(srmr)

@@ -1,0 +1,135 @@
+"""Generate tests/fixtures_real/goldens.json by running the reference offline.
+
+Computes reference-torchmetrics values (CPU torch, /root/reference/src via the
+lightning_utilities shim) for the committed real-data fixture pack: natural
+images (SSIM/MS-SSIM/PSNR/UQI/VIF/SAM/ERGAS/SCC/TV/RMSE-SW), multilingual text
+(BLEU, SacreBLEU 13a/intl/char, CHRF, TER, ROUGE-1/2/L, WER/CER/MER/WIL,
+edit distance), and speech clips (SNR/SI-SNR/SI-SDR/SDR at two noise levels).
+Mirrors the role of the reference's S3 asset pack + domain-package oracles
+(reference Makefile:43-46, tests/unittests/*/test_*.py reference_metric
+fields). Audio metrics whose reference needs uninstalled wheels (STOI, PESQ,
+SRMR) are covered elsewhere: STOI by the independent in-test numpy oracle,
+PESQ by the ITU anchor fixtures (tests/audio/fixtures).
+
+Rerun only if the fixture assets change. Usage: python tools/gen_real_fixture_goldens.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from helpers.real_fixtures import (  # noqa: E402
+    GOLDENS_PATH,
+    degraded_image,
+    degraded_speech,
+    load_images,
+    load_speech,
+    load_text,
+)
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+load_reference_torchmetrics()
+
+import torch  # noqa: E402
+
+
+def image_goldens() -> dict:
+    import torchmetrics.functional.image as FI
+
+    images = load_images()
+    out: dict = {}
+    # float32 throughout: that is the dtype our framework computes in (JAX
+    # x64 disabled), and eps-guarded metrics (UQI) take finfo(dtype).eps —
+    # float64 goldens would encode a different epsilon semantics
+    for name, img in images.items():
+        clean = torch.from_numpy(img.astype("float32") / 255.0).permute(2, 0, 1)[None]
+        for kind in ("noise", "blur", "contrast"):
+            deg = torch.from_numpy(degraded_image(img, kind).astype("float32")).permute(2, 0, 1)[None]
+            key = f"{name}_{kind}"
+            vals = {
+                "ssim": float(FI.structural_similarity_index_measure(deg, clean, data_range=1.0)),
+                "psnr": float(FI.peak_signal_noise_ratio(deg, clean, data_range=1.0)),
+                "uqi": float(FI.universal_image_quality_index(deg, clean)),
+                "vif": float(FI.visual_information_fidelity(deg.float(), clean.float())),
+                "sam": float(FI.spectral_angle_mapper(deg, clean)),
+                "ergas": float(FI.error_relative_global_dimensionless_synthesis(deg, clean)),
+                "scc": float(FI.spatial_correlation_coefficient(deg, clean)),
+                "rmse_sw": float(FI.root_mean_squared_error_using_sliding_window(deg, clean)),
+                "ms_ssim": float(
+                    FI.multiscale_structural_similarity_index_measure(deg, clean, data_range=1.0)
+                ),
+            }
+            # e.g. SAM is NaN when clipping zeroes a pixel vector — a NaN
+            # golden asserts nothing, so keep finite values only
+            out[key] = {k: v for k, v in vals.items() if v == v}
+        out[f"{name}_tv"] = float(
+            FI.total_variation(torch.from_numpy(img.astype("float32") / 255.0).permute(2, 0, 1)[None])
+        )
+    return out
+
+
+def text_goldens() -> dict:
+    import torchmetrics.functional.text as FT
+
+    corpus = load_text()
+    out: dict = {}
+    en_p, en_t = corpus["english"]["preds"], [[t] for t in corpus["english"]["targets"]]
+    out["english"] = {
+        "bleu": float(FT.bleu_score(en_p, en_t)),
+        "sacre_bleu_13a": float(FT.sacre_bleu_score(en_p, en_t, tokenize="13a")),
+        "sacre_bleu_intl": float(FT.sacre_bleu_score(en_p, en_t, tokenize="intl")),
+        "chrf": float(FT.chrf_score(en_p, en_t)),
+        "ter": float(FT.translation_edit_rate(en_p, en_t)),
+        "wer": float(FT.word_error_rate(en_p, corpus["english"]["targets"])),
+        "cer": float(FT.char_error_rate(en_p, corpus["english"]["targets"])),
+        "mer": float(FT.match_error_rate(en_p, corpus["english"]["targets"])),
+        "wil": float(FT.word_information_lost(en_p, corpus["english"]["targets"])),
+        "edit": float(FT.edit_distance(en_p, corpus["english"]["targets"])),
+    }
+    rouge = FT.rouge_score(en_p, corpus["english"]["targets"], rouge_keys=("rouge1", "rouge2", "rougeL"))
+    out["english"]["rouge"] = {k: float(v) for k, v in rouge.items()}
+    for lang in ("chinese", "japanese"):
+        p, t = corpus[lang]["preds"], [[x] for x in corpus[lang]["targets"]]
+        out[lang] = {
+            "sacre_bleu_char": float(FT.sacre_bleu_score(p, t, tokenize="char")),
+            "chrf": float(FT.chrf_score(p, t)),
+            "cer": float(FT.char_error_rate(p, corpus[lang]["targets"])),
+        }
+    out["chinese"]["sacre_bleu_zh"] = float(
+        FT.sacre_bleu_score(corpus["chinese"]["preds"], [[x] for x in corpus["chinese"]["targets"]], tokenize="zh")
+    )
+    return out
+
+
+def audio_goldens() -> dict:
+    import torchmetrics.functional.audio as FA
+
+    speech = load_speech()
+    out: dict = {}
+    for name in ("clip1", "clip2"):
+        clean_np = speech[name]
+        clean = torch.from_numpy(clean_np.astype("float64"))
+        for snr_db in (20, 5):
+            deg = torch.from_numpy(degraded_speech(clean_np, snr_db).astype("float64"))
+            out[f"{name}_snr{snr_db}"] = {
+                "snr": float(FA.signal_noise_ratio(deg, clean)),
+                "si_snr": float(FA.scale_invariant_signal_noise_ratio(deg, clean)),
+                "si_sdr": float(FA.scale_invariant_signal_distortion_ratio(deg, clean)),
+                "sdr": float(FA.signal_distortion_ratio(deg[None], clean[None])),
+            }
+    return out
+
+
+def main() -> None:
+    goldens = {"image": image_goldens(), "text": text_goldens(), "audio": audio_goldens()}
+    with open(GOLDENS_PATH, "w", encoding="utf-8") as f:
+        json.dump(goldens, f, indent=1, ensure_ascii=False, sort_keys=True)
+    print(f"wrote {GOLDENS_PATH}")
+
+
+if __name__ == "__main__":
+    main()

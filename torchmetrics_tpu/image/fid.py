@@ -99,8 +99,14 @@ class FrechetInceptionDistance(Metric):
         self.feature_extractor, dim = resolve_feature_argument(
             "FrechetInceptionDistance", feature, feature_extractor, inception_params
         )
+        resolved = NUM_LOGITS if isinstance(dim, str) else dim
         if num_features is None:
-            num_features = NUM_LOGITS if isinstance(dim, str) else (dim if dim is not None else 2048)
+            num_features = resolved if resolved is not None else 2048
+        elif resolved is not None and num_features != resolved:
+            raise ValueError(
+                f"Argument `num_features`={num_features} contradicts the {resolved}-wide tap"
+                f" selected by `feature`={feature!r}"
+            )
         if not isinstance(num_features, int) or num_features < 1:
             raise ValueError("Argument `num_features` expected to be a positive integer")
         self.num_features = num_features

@@ -273,7 +273,12 @@ def params_from_torch_fidelity_state_dict(state_dict: Dict[str, Any]) -> Dict[st
     The result's structure is validated leaf-by-leaf (names and shapes) against
     the architecture's init tree; missing or mismatched entries raise.
     """
-    template = init_inception_params()
+    # shapes only — eval_shape traces init without running the 21.8M-param
+    # forward pass a real init would pay
+    abstract = jax.eval_shape(
+        InceptionV3Features().init, jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), dtype=jnp.float32)
+    )
+    template = {"params": abstract["params"], "batch_stats": abstract.get("batch_stats", {})}
     params: Dict[str, Any] = {}
     batch_stats: Dict[str, Any] = {}
     converted: Dict[str, Any] = {"params": params, "batch_stats": batch_stats}
