@@ -175,14 +175,16 @@ class MulticlassPrecisionRecallCurve(Metric):
         self,
         num_classes: int,
         thresholds: Thresholds = None,
+        average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if validate_args:
-            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
         self.num_classes = num_classes
+        self.average = average
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         thresholds = _adjust_threshold_arg(thresholds)
@@ -192,17 +194,16 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.add_state("target", default=[], dist_reduce_fx="cat")
         else:
             self.thresholds = thresholds
-            self.add_state(
-                "confmat",
-                default=jnp.zeros((len(thresholds), num_classes, 2, 2), dtype=jnp.int32),
-                dist_reduce_fx="sum",
-            )
+            # micro flattens one-vs-rest into a single binary curve -> binary state
+            shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
+            self.add_state("confmat", default=jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multiclass_precision_recall_curve_tensor_validation(preds, target, self.num_classes, self.ignore_index)
         preds, target, valid, _ = _multiclass_precision_recall_curve_format(
-            preds, target, self.num_classes, None if self.thresholds is None else self.thresholds, self.ignore_index
+            preds, target, self.num_classes, None if self.thresholds is None else self.thresholds,
+            self.ignore_index, self.average,
         )
         if self.thresholds is None:
             keep = np.asarray(valid)
@@ -210,7 +211,7 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.target.append(jnp.asarray(np.asarray(target)[keep]))
         else:
             self.confmat = self.confmat + _multiclass_precision_recall_curve_update(
-                preds, target, valid, self.num_classes, self.thresholds
+                preds, target, valid, self.num_classes, self.thresholds, self.average
             )
 
     def _curve_state(self):
@@ -219,7 +220,9 @@ class MulticlassPrecisionRecallCurve(Metric):
         return self.confmat
 
     def compute(self):
-        return _multiclass_precision_recall_curve_compute(self._curve_state(), self.num_classes, self.thresholds)
+        return _multiclass_precision_recall_curve_compute(
+            self._curve_state(), self.num_classes, self.thresholds, self.average
+        )
 
 
 class MultilabelPrecisionRecallCurve(Metric):

@@ -31,7 +31,7 @@ class BinaryROC(BinaryPrecisionRecallCurve):
         >>> m = BinaryROC(thresholds=5)
         >>> m.update(preds, target)
         >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
-        [[0.0, 0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 1.0, 0.75, 0.5, 0.25, 0.0]]
+        [[0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 0.75, 0.5, 0.25, 0.0]]
     """
 
     def compute(self):
@@ -57,11 +57,11 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
         >>> m = MulticlassROC(num_classes=3, thresholds=5)
         >>> m.update(preds, target)
         >>> [tuple(v.shape) for v in m.compute()]
-        [(3, 6), (3, 6), (6,)]
+        [(3, 5), (3, 5), (5,)]
     """
 
     def compute(self):
-        return _multiclass_roc_compute(self._curve_state(), self.num_classes, self.thresholds)
+        return _multiclass_roc_compute(self._curve_state(), self.num_classes, self.thresholds, self.average)
 
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
@@ -75,7 +75,7 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
         >>> m = MultilabelROC(num_labels=3, thresholds=5)
         >>> m.update(preds, target)
         >>> [tuple(v.shape) for v in m.compute()]
-        [(3, 6), (3, 6), (6,)]
+        [(3, 5), (3, 5), (5,)]
     """
 
     def compute(self):
@@ -95,7 +95,7 @@ class ROC(_ClassificationTaskWrapper):
         >>> m = ROC(task="binary", thresholds=5)
         >>> m.update(preds, target)
         >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in m.compute()]
-        [[0.0, 0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 1.0, 0.75, 0.5, 0.25, 0.0]]
+        [[0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 0.75, 0.5, 0.25, 0.0]]
     """
 
     def __new__(  # type: ignore[misc]

@@ -24,7 +24,7 @@ from jax import Array
 
 from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits, _softmax_if_logits
 from torchmetrics_tpu.utils.checks import _check_same_shape
-from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.compute import _safe_divide, interp
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 Thresholds = Union[int, List[float], Array, None]
@@ -180,10 +180,15 @@ def binary_precision_recall_curve(
 # ----------------------------------------------------------------- multiclass
 
 def _multiclass_precision_recall_curve_arg_validation(
-    num_classes: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
 ) -> None:
     if not isinstance(num_classes, int) or num_classes < 2:
         raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if average not in (None, "micro", "macro"):
+        raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
 
 
@@ -204,6 +209,7 @@ def _multiclass_precision_recall_curve_format(
     num_classes: int,
     thresholds: Thresholds = None,
     ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
 ) -> Tuple[Array, Array, Array, Optional[Array]]:
     preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
     target = jnp.asarray(target).reshape(-1)
@@ -213,16 +219,29 @@ def _multiclass_precision_recall_curve_format(
         target = jnp.where(valid, target, 0)
     else:
         valid = jnp.ones_like(target, dtype=bool)
+    if average == "micro":
+        # one-vs-rest flattening: the task becomes binary over N*C pairs
+        # (reference precision_recall_curve.py:457-459)
+        target = jax.nn.one_hot(target, num_classes, dtype=jnp.int32).reshape(-1)
+        valid = jnp.broadcast_to(valid[:, None], (valid.shape[0], num_classes)).reshape(-1)
+        preds = preds.reshape(-1)
     thresholds = _adjust_threshold_arg(thresholds)
     return preds, target.astype(jnp.int32), valid, thresholds
 
 
 def _multiclass_precision_recall_curve_update(
-    preds: Array, target: Array, valid: Array, num_classes: int, thresholds: Optional[Array]
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
 ) -> Optional[Array]:
-    """Binned state: (T, C, 2, 2) counts via one scatter-add."""
+    """Binned state: (T, C, 2, 2) counts via one scatter-add ((T, 2, 2) for micro)."""
     if thresholds is None:
         return None
+    if average == "micro":
+        return _binary_precision_recall_curve_update(preds, target, valid, thresholds)
     len_t = thresholds.shape[0]
     target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32)  # (N, C)
     if jax.default_backend() not in ("tpu", "axon"):
@@ -251,7 +270,10 @@ def _multiclass_precision_recall_curve_compute(
     state: Union[Array, Tuple[Array, Array]],
     num_classes: int,
     thresholds: Optional[Array],
+    average: Optional[str] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if average == "micro":
+        return _binary_precision_recall_curve_compute(state, thresholds)
     if thresholds is not None and not isinstance(state, tuple):
         tps = state[:, :, 1, 1]
         fps = state[:, :, 0, 1]
@@ -260,6 +282,8 @@ def _multiclass_precision_recall_curve_compute(
         recall = _safe_divide(tps, tps + fns)
         precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)], axis=0).T
         recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)], axis=0).T
+        if average == "macro":
+            return _macro_interp_merge(precision, recall, jnp.tile(thresholds, num_classes), descending=False)
         return precision, recall, thresholds
     preds, target = state
     precision_list, recall_list, thresh_list = [], [], []
@@ -270,7 +294,23 @@ def _multiclass_precision_recall_curve_compute(
         precision_list.append(p)
         recall_list.append(r)
         thresh_list.append(t)
+    if average == "macro":
+        return _macro_interp_merge(precision_list, recall_list, jnp.concatenate(thresh_list), descending=False)
     return precision_list, recall_list, thresh_list
+
+
+def _macro_interp_merge(xs, ys, all_thresholds: Array, descending: bool):
+    """Average per-class curves onto a shared sorted x grid via interpolation
+    (reference precision_recall_curve.py:574-588, roc.py:189-201)."""
+    num = len(xs)
+    thresh = jnp.sort(all_thresholds)
+    if descending:
+        thresh = jnp.flip(thresh, 0)
+    mean_x = jnp.sort(jnp.concatenate([jnp.asarray(x).reshape(-1) for x in xs]))
+    mean_y = jnp.zeros_like(mean_x)
+    for i in range(num):
+        mean_y = mean_y + interp(mean_x, jnp.asarray(xs[i]), jnp.asarray(ys[i]))
+    return mean_x, mean_y / num, thresh
 
 
 def multiclass_precision_recall_curve(
@@ -278,10 +318,14 @@ def multiclass_precision_recall_curve(
     target: Array,
     num_classes: int,
     thresholds: Thresholds = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
     """Multiclass one-vs-rest PR curves (reference :217+).
+
+    ``average``: ``"micro"`` one-hot-flattens into a single binary curve;
+    ``"macro"`` interpolation-merges the per-class curves (reference :593-601).
 
     Example:
         >>> from torchmetrics_tpu.functional import multiclass_precision_recall_curve
@@ -295,14 +339,16 @@ def multiclass_precision_recall_curve(
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+        if average not in (None, "micro", "macro"):
+            raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
     preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
-        preds, target, num_classes, thresholds, ignore_index
+        preds, target, num_classes, thresholds, ignore_index, average
     )
-    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds, average)
     if state is None:
         keep = np.asarray(valid)
         state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
-    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average)
 
 
 # ----------------------------------------------------------------- multilabel

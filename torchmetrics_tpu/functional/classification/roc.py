@@ -10,6 +10,7 @@ from jax import Array
 from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     Thresholds,
     _binary_clf_curve,
+    _macro_interp_merge,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_format,
     _binary_precision_recall_curve_tensor_validation,
@@ -38,18 +39,17 @@ def _binary_roc_compute(
         fps = state[:, 0, 1]
         fns = state[:, 1, 0]
         tns = state[:, 0, 0]
+        # binned mode returns exactly T points, no synthetic (0, 0) endpoint
+        # (reference roc.py:45-52)
         tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
         fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
-        fpr = jnp.concatenate([jnp.zeros(1, dtype=fpr.dtype), fpr])
-        tpr = jnp.concatenate([jnp.zeros(1, dtype=tpr.dtype), tpr])
-        thresh = jnp.concatenate([jnp.ones(1, dtype=thresholds.dtype), jnp.flip(thresholds, 0)])
-        return fpr, tpr, thresh
+        return fpr, tpr, jnp.flip(thresholds, 0)
     preds, target = state
     fps, tps, thresh = (np.asarray(x) for x in _binary_clf_curve(preds, target))
-    # prepend a (0, 0) point at threshold just above the max (sklearn semantics)
+    # prepend a (0, 0) point at threshold 1.0 (reference roc.py:55-58)
     tps = np.hstack([[0.0], tps])
     fps = np.hstack([[0.0], fps])
-    thresh = np.hstack([[1.0 + thresh[0] if thresh.size else 1.0], thresh])
+    thresh = np.hstack([[1.0], thresh])
     with np.errstate(divide="ignore", invalid="ignore"):
         tpr = np.nan_to_num(tps / tps[-1]) if tps[-1] != 0 else np.zeros_like(tps)
         fpr = np.nan_to_num(fps / fps[-1]) if fps[-1] != 0 else np.zeros_like(fps)
@@ -72,7 +72,7 @@ def binary_roc(
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> result = binary_roc(preds, target)
         >>> [jnp.round(jnp.asarray(v), 4).tolist() for v in result]
-        [[0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.5, 0.5, 1.0, 1.0], [1.7999999523162842, 0.7999999523162842, 0.5999999642372131, 0.29999998211860657, 0.19999998807907104]]
+        [[0.0, 0.0, 0.5, 0.5, 1.0], [0.0, 0.5, 0.5, 1.0, 1.0], [1.0, 0.7999999523162842, 0.5999999642372131, 0.29999998211860657, 0.19999998807907104]]
     """
 
     if validate_args:
@@ -90,18 +90,22 @@ def _multiclass_roc_compute(
     state: Union[Array, Tuple[Array, Array]],
     num_classes: int,
     thresholds: Optional[Array],
+    average: Optional[str] = None,
 ):
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds)
     if thresholds is not None and not isinstance(state, tuple):
         tps = state[:, :, 1, 1]
         fps = state[:, :, 0, 1]
         fns = state[:, :, 1, 0]
         tns = state[:, :, 0, 0]
+        # exactly T points per class, no synthetic (0, 0) endpoint
+        # (reference roc.py:171-178)
         tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
         fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
-        fpr = jnp.concatenate([jnp.zeros((num_classes, 1), dtype=fpr.dtype), fpr], axis=1)
-        tpr = jnp.concatenate([jnp.zeros((num_classes, 1), dtype=tpr.dtype), tpr], axis=1)
-        thresh = jnp.concatenate([jnp.ones(1, dtype=thresholds.dtype), jnp.flip(thresholds, 0)])
-        return fpr, tpr, thresh
+        if average == "macro":
+            return _macro_interp_merge(fpr, tpr, jnp.tile(thresholds, num_classes), descending=True)
+        return fpr, tpr, jnp.flip(thresholds, 0)
     preds, target = state
     fpr_list, tpr_list, thresh_list = [], [], []
     for c in range(num_classes):
@@ -109,6 +113,8 @@ def _multiclass_roc_compute(
         fpr_list.append(f)
         tpr_list.append(t)
         thresh_list.append(th)
+    if average == "macro":
+        return _macro_interp_merge(fpr_list, tpr_list, jnp.concatenate(thresh_list), descending=True)
     return fpr_list, tpr_list, thresh_list
 
 
@@ -117,10 +123,14 @@ def multiclass_roc(
     target: Array,
     num_classes: int,
     thresholds: Thresholds = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
     """multiclass roc (functional interface).
+
+    ``average``: ``"micro"`` one-hot-flattens into a single binary ROC;
+    ``"macro"`` interpolation-merges the per-class curves (reference roc.py:207-215).
 
     Example:
         >>> from torchmetrics_tpu.functional import multiclass_roc
@@ -129,20 +139,20 @@ def multiclass_roc(
         >>> target = jnp.asarray([0, 1, 2, 0])
         >>> result = multiclass_roc(preds, target, num_classes=3, thresholds=5)
         >>> [tuple(v.shape) for v in result]
-        [(3, 6), (3, 6), (6,)]
+        [(3, 5), (3, 5), (5,)]
     """
 
     if validate_args:
-        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
     preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
-        preds, target, num_classes, thresholds, ignore_index
+        preds, target, num_classes, thresholds, ignore_index, average
     )
-    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds, average)
     if state is None:
         keep = np.asarray(valid)
         state = (jnp.asarray(np.asarray(preds)[keep]), jnp.asarray(np.asarray(target)[keep]))
-    return _multiclass_roc_compute(state, num_classes, thresholds)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
 
 
 def _multilabel_roc_compute(
@@ -185,7 +195,7 @@ def multilabel_roc(
         >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
         >>> result = multilabel_roc(preds, target, num_labels=3, thresholds=5)
         >>> [tuple(v.shape) for v in result]
-        [(3, 6), (3, 6), (6,)]
+        [(3, 5), (3, 5), (5,)]
     """
 
     if validate_args:
@@ -219,7 +229,7 @@ def roc(
         >>> target = jnp.asarray([0, 1, 1, 0])
         >>> result = roc(preds, target, task="binary", thresholds=5)
         >>> [tuple(v.shape) for v in result]
-        [(6,), (6,), (6,)]
+        [(5,), (5,), (5,)]
     """
 
     task = ClassificationTask.from_str(task)

@@ -461,11 +461,16 @@ def _multilabel_stat_scores_compute(
     sum_axis = 0 if multidim_average == "global" else 1
     if average == "micro":
         return res.sum(sum_axis)
-    if average in ("macro", "weighted"):
+    if average == "macro":
+        return res.astype(jnp.float32).mean(sum_axis)
+    if average == "weighted":
+        # the reference normalises multilabel weights by the GLOBAL support
+        # sum even samplewise (reference stat_scores.py:695-697) — unlike the
+        # per-sample normalisation of the multiclass variant
         res = res.astype(jnp.float32)
-        weights = (tp + fn).astype(jnp.float32) if average == "weighted" else jnp.ones_like(tp, dtype=jnp.float32)
-        w = _safe_divide(weights, weights.sum(-1, keepdims=True) if weights.ndim else weights.sum())
-        return (res * (w[..., None] if res.ndim > w.ndim else w)).sum(sum_axis)
+        weights = (tp + fn).astype(jnp.float32)
+        w = _safe_divide(weights, weights.sum())
+        return (res * w[..., None]).sum(sum_axis)
     return res
 
 

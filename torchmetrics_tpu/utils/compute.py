@@ -41,8 +41,12 @@ def _adjust_weights_safe_divide(
         weights = (tp + fn).astype(jnp.float32)
     else:  # macro
         weights = jnp.ones_like(score, dtype=jnp.float32)
-        if not is_multilabel and top_k == 1:
-            weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+        if not is_multilabel:
+            # classes absent from the data carry no weight; with top_k > 1 a
+            # class can have fp without true instances, so the absence test
+            # drops fp (reference compute.py:68)
+            absent = (tp + fp + fn == 0) if top_k == 1 else (tp + fn == 0)
+            weights = jnp.where(absent, 0.0, weights)
     return _safe_divide(weights * score, weights.sum(-1, keepdims=True)).sum(-1)
 
 
@@ -97,9 +101,12 @@ def auc(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def interp(x: Array, xp: Array, fp: Array) -> Array:
-    """1-D linear interpolation, same semantics as reference utilities/compute.py:134.
+    """1-D linear interpolation, exact reference semantics (utilities/compute.py:134-157).
 
-    ``jnp.interp`` is XLA-native and matches numpy semantics (clamping at the ends).
+    NOT ``jnp.interp``: the reference picks the segment by counting how many
+    ``xp`` values are <= x (which also defines its behavior on the unsorted
+    ``xp`` the macro curve merges feed it), and extrapolates past the ends
+    with the first/last segment's line instead of clamping to ``fp``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -108,4 +115,13 @@ def interp(x: Array, xp: Array, fp: Array) -> Array:
         ...        jnp.asarray([0.0, 1.0, 0.0])).tolist()
         [0.5, 0.5]
     """
-    return jnp.interp(jnp.asarray(x), jnp.asarray(xp), jnp.asarray(fp))
+    x, xp, fp = jnp.asarray(x), jnp.asarray(xp), jnp.asarray(fp)
+    # reference _safe_divide replaces a zero denominator with 1 WITHOUT
+    # zeroing the numerator (compute.py:52), so a zero-width (tied) segment
+    # gets slope fp_diff, not 0 — replicate that, not our zero_division=0
+    dx = xp[1:] - xp[:-1]
+    m = (fp[1:] - fp[:-1]) / jnp.where(dx == 0, jnp.ones_like(dx), dx)
+    b = fp[:-1] - m * xp[:-1]
+    indices = jnp.sum(x[:, None] >= xp[None, :], axis=1) - 1
+    indices = jnp.clip(indices, 0, m.shape[0] - 1)
+    return m[indices] * x + b[indices]
