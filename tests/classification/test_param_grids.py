@@ -218,3 +218,23 @@ def test_multiclass_curve_average_grid(fn, thresholds, average):
             np.asarray(a, dtype=np.float64), b.numpy().astype(np.float64),
             atol=1e-5, rtol=1e-4, err_msg=f"{fn} {kwargs}",
         )
+
+
+@pytest.mark.parametrize("fn", ["roc", "precision_recall_curve"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_task_wrapper_forwards_kwargs(fn, ignore_index):
+    """Regression: the task= wrappers must forward ignore_index/validate_args
+    by keyword — a positional call against the average-extended signatures
+    silently bound validate_args=True to ignore_index (dropping class-1
+    samples) or raised on explicit ignore_index."""
+    target = MC_TARGET.copy()
+    if ignore_index is not None:
+        target[np.random.RandomState(14).rand(*target.shape) < 0.1] = ignore_index
+    kwargs = {"num_classes": C, "thresholds": 7, "ignore_index": ignore_index}
+    ours = getattr(OC, fn)(jnp.asarray(MC_PROBS), jnp.asarray(target), task="multiclass", **kwargs)
+    theirs = getattr(RC, fn)(torch.from_numpy(MC_PROBS), torch.from_numpy(target), task="multiclass", **kwargs)
+    for a, b in zip(ours, theirs):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float64), b.numpy().astype(np.float64),
+            atol=1e-5, rtol=1e-4, err_msg=f"{fn} wrapper {kwargs}",
+        )

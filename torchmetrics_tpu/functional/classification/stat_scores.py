@@ -469,7 +469,9 @@ def _multilabel_stat_scores_compute(
         # per-sample normalisation of the multiclass variant
         res = res.astype(jnp.float32)
         weights = (tp + fn).astype(jnp.float32)
-        w = _safe_divide(weights, weights.sum())
+        # plain division like the reference: zero total support yields NaN
+        # there too (w / w.sum(), stat_scores.py:697) — parity over safety
+        w = weights / weights.sum()
         return (res * w[..., None]).sum(sum_axis)
     return res
 
