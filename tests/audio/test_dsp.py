@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # FFT-heavy DSP oracles; run with --runslow
+
 sys.path.insert(0, "/root/repo/tests")
 
 import torchmetrics_tpu.functional.audio as FA  # noqa: E402

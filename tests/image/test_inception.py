@@ -33,6 +33,7 @@ def params():
     return init_inception_params(jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestArchitecture:
     def test_feature_taps_at_299(self, params):
         """Spatial map shapes of the FID InceptionV3 at its native 299 input."""
@@ -96,6 +97,7 @@ class TestTF1Resize:
         np.testing.assert_array_equal(np.asarray(tf1_bilinear_resize(x, 299)), np.asarray(x))
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestConsumerMetrics:
     def test_fid_with_inception_params(self, params):
         from torchmetrics_tpu.image import FrechetInceptionDistance
@@ -139,6 +141,7 @@ class TestConsumerMetrics:
             FrechetInceptionDistance()
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestLogitsHead:
     def test_logits_taps(self, params):
         from torchmetrics_tpu.models.inception import NUM_LOGITS
@@ -165,6 +168,7 @@ class TestLogitsHead:
         np.testing.assert_allclose(np.asarray(via_extractor), np.asarray(direct_zero), atol=1e-6)
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestWeightConverter:
     """params_from_torch_fidelity_state_dict: the offline weight-loading path."""
 
@@ -251,6 +255,7 @@ class TestGoldenActivations:
             )
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestReferenceFeatureArgument:
     """The reference's `feature` first argument (int tap / str head / module)."""
 

@@ -97,6 +97,7 @@ def _torch_alex_lpips(img1, img2, sd):
         return sum(res).reshape(-1)
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestLPIPSScoreMath:
     def test_alex_full_pipeline_vs_torch_replica(self):
         sd = _make_alex_state_dict()
@@ -151,6 +152,7 @@ class TestLPIPSScoreMath:
             )
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestLPIPSMetric:
     def test_accumulation_matches_functional(self):
         params = init_lpips_params("squeeze", jax.random.PRNGKey(3))
@@ -185,6 +187,7 @@ class TestLPIPSMetric:
             LearnedPerceptualImagePatchSimilarity(net=lambda a, b: None, normalize=1)
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestMiFID:
     @staticmethod
     def _proj(seed=11, feat=8):
@@ -233,6 +236,7 @@ class TestMiFID:
         assert len(m.fake_features) == 0
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestPerceptualPathLength:
     def test_interpolate_vs_reference(self):
         from torchmetrics.functional.image.perceptual_path_length import _interpolate as ref_interp

@@ -91,6 +91,7 @@ class TestDifferentiability(MetricTester):
         assert tm.AUROC(task="binary").is_differentiable is False
         self.run_differentiability_test(preds, target, tm.AUROC, None, {"task": "binary"})
 
+    @pytest.mark.slow  # runs the full flax alexnet backbone; run with --runslow
     def test_lpips_grad(self):
         """LPIPS is the reference's flagship differentiable image metric."""
         import jax

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # real-data asset pack oracles; run with --runslow
+
 sys.path.insert(0, "/root/repo/tests")
 
 from functools import lru_cache  # noqa: E402
