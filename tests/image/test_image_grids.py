@@ -1,0 +1,124 @@
+"""Image parameter-grid parity vs the reference oracle.
+
+Depth complement for the windowed image statistics: the reference enumerates
+kernel/sigma/data_range/reduction axes per metric (reference
+tests/unittests/image/test_ssim.py, test_psnr.py, test_ms_ssim.py); this
+sweeps the same axes against live CPU torch, exercising the banded-matmul
+window lowering (functional/image/utils.py:_separable_window_2d) across
+kernel shapes it doesn't hit at defaults.
+"""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # oracle parameter grids; run with --runslow
+
+sys.path.insert(0, "/root/repo/tests")
+
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+load_reference_torchmetrics()
+
+import torch  # noqa: E402
+import torchmetrics.functional.image as RI  # noqa: E402
+
+import torchmetrics_tpu.functional.image as OI  # noqa: E402
+
+rng = np.random.RandomState(321)
+PREDS = rng.rand(2, 3, 48, 48).astype(np.float32)
+TARGET = np.clip(PREDS + 0.1 * rng.randn(2, 3, 48, 48).astype(np.float32), 0, 1)
+
+
+def _both(name, kwargs, atol=1e-4, args=None):
+    args = args if args is not None else (PREDS, TARGET)
+    ours = getattr(OI, name)(*[jnp.asarray(a) for a in args], **kwargs)
+    theirs = getattr(RI, name)(*[torch.from_numpy(np.asarray(a)) for a in args], **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(ours, dtype=np.float64),
+        theirs.numpy().astype(np.float64),
+        atol=atol, rtol=1e-3, err_msg=f"{name} {kwargs}",
+    )
+
+
+@pytest.mark.parametrize("kernel_size", [7, 11, (9, 5)])
+@pytest.mark.parametrize("sigma", [1.0, 1.5])
+@pytest.mark.parametrize("gaussian_kernel", [True, False])
+def test_ssim_kernel_grid(kernel_size, sigma, gaussian_kernel):
+    kwargs = {
+        "gaussian_kernel": gaussian_kernel,
+        "kernel_size": kernel_size,
+        "sigma": sigma,
+        "data_range": 1.0,
+    }
+    _both("structural_similarity_index_measure", kwargs)
+
+
+@pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.03, 0.1)])
+def test_ssim_stability_constants(k1, k2):
+    _both("structural_similarity_index_measure", {"data_range": 1.0, "k1": k1, "k2": k2})
+
+
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
+def test_ssim_reduction_grid(reduction):
+    _both("structural_similarity_index_measure", {"data_range": 1.0, "reduction": reduction})
+
+
+def test_ssim_data_range_tuple():
+    _both("structural_similarity_index_measure", {"data_range": (0.0, 1.0)})
+
+
+@pytest.mark.parametrize("data_range", [1.0, 255.0])
+@pytest.mark.parametrize("base", [10.0, 2.0])
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum"])
+def test_psnr_grid(data_range, base, reduction):
+    scale = data_range
+    args = (PREDS * scale, TARGET * scale)
+    _both(
+        "peak_signal_noise_ratio",
+        {"data_range": data_range, "base": base, "reduction": reduction},
+        args=args,
+        atol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("dim", [None, (1, 2, 3)])
+def test_psnr_dim_grid(dim):
+    kwargs = {"data_range": 1.0}
+    if dim is not None:
+        kwargs["dim"] = dim
+    _both("peak_signal_noise_ratio", kwargs, atol=1e-3)
+
+
+@pytest.mark.parametrize("kernel_size", [5, 7])
+@pytest.mark.parametrize("sigma", [1.0, 1.5])
+def test_ms_ssim_kernel_grid(kernel_size, sigma):
+    # the 5-scale stack needs deepest-scale size (160/16=10) >= kernel_size,
+    # hence 160x160 inputs and kernels <= 7 (kernel 11 at defaults is covered
+    # by tests/image/test_image_functional.py)
+    big_p = rng.rand(1, 1, 160, 160).astype(np.float32)
+    big_t = np.clip(big_p + 0.05 * rng.randn(1, 1, 160, 160).astype(np.float32), 0, 1)
+    _both(
+        "multiscale_structural_similarity_index_measure",
+        {"kernel_size": kernel_size, "sigma": sigma, "data_range": 1.0},
+        args=(big_p, big_t),
+        atol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("window_size", [5, 9])
+def test_uqi_window_grid(window_size):
+    _both("universal_image_quality_index", {"kernel_size": (window_size, window_size)})
+
+
+@pytest.mark.parametrize("window_size", [4, 8])
+def test_rase_window_grid(window_size):
+    _both("relative_average_spectral_error", {"window_size": window_size}, atol=1e-2)
+
+
+@pytest.mark.parametrize("sigma_nsq", [1.0, 2.0])
+def test_vif_sigma_grid(sigma_nsq):
+    big_p = rng.rand(1, 1, 96, 96).astype(np.float32) * 255
+    big_t = np.clip(big_p + 5 * rng.randn(1, 1, 96, 96).astype(np.float32), 0, 255)
+    _both("visual_information_fidelity", {"sigma_n_sq": sigma_nsq}, args=(big_p, big_t), atol=1e-3)

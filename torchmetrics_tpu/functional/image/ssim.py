@@ -80,14 +80,20 @@ def _ssim_update(
 
     # Both gaussian and uniform windows are separable: run 1-D passes per axis
     # instead of one dense k^2 (k^3) kernel — ~k/2x fewer MACs, same math.
+    #
+    # Reference quirk (ssim.py:125-143): the GAUSSIAN window's size is derived
+    # from sigma — int(3.5*s + 0.5)*2 + 1 per axis — and `kernel_size` only
+    # sizes the UNIFORM window; padding/cropping always use the sigma-derived
+    # size in both modes.
+    gauss_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    if gaussian_kernel:
+        k1d = [_gaussian(k, s, preds.dtype) for k, s in zip(gauss_size, sigma)]
+    else:
+        k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
     if is_3d:
-        if gaussian_kernel:
-            k1d = [_gaussian(k, s, preds.dtype) for k, s in zip(kernel_size, sigma)]
-        else:
-            k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
-        pad_d = (kernel_size[0] - 1) // 2
-        pad_h = (kernel_size[1] - 1) // 2
-        pad_w = (kernel_size[2] - 1) // 2
+        pad_d = (gauss_size[0] - 1) // 2
+        pad_h = (gauss_size[1] - 1) // 2
+        pad_w = (gauss_size[2] - 1) // 2
         preds_p = _reflect_pad_3d(preds, pad_d, pad_h, pad_w)
         target_p = _reflect_pad_3d(target, pad_d, pad_h, pad_w)
         input_list = jnp.concatenate(
@@ -95,12 +101,8 @@ def _ssim_update(
         )
         outputs = _separable_window_3d(input_list, k1d[0], k1d[1], k1d[2])
     else:
-        if gaussian_kernel:
-            k1d = [_gaussian(k, s, preds.dtype) for k, s in zip(kernel_size, sigma)]
-        else:
-            k1d = [jnp.full((k,), 1.0 / k, dtype=preds.dtype) for k in kernel_size]
-        pad_h = (kernel_size[0] - 1) // 2
-        pad_w = (kernel_size[1] - 1) // 2
+        pad_h = (gauss_size[0] - 1) // 2
+        pad_w = (gauss_size[1] - 1) // 2
         preds_p = _reflect_pad_2d(preds, pad_h, pad_w)
         target_p = _reflect_pad_2d(target, pad_h, pad_w)
 

@@ -66,8 +66,11 @@ def _separable_window_2d(x: Array, g_h: Array, g_w: Array) -> Array:
     wo = x.shape[3] - g_w.shape[0] + 1
     bh = _band_matrix(g_h.astype(x.dtype), ho)  # (Hp, Ho)
     bw = _band_matrix(g_w.astype(x.dtype), wo)  # (Wp, Wo)
-    out = jnp.einsum("nchw,hi->nciw", x, bh)
-    return jnp.einsum("nciw,wj->ncij", out, bw)
+    # HIGHEST: the TPU MXU's default f32 einsum truncates operands to bf16,
+    # which is far too coarse for windowed moment statistics (E[x^2]-mu^2
+    # cancellation); full-precision passes keep metric values backend-stable.
+    out = jnp.einsum("nchw,hi->nciw", x, bh, precision=lax.Precision.HIGHEST)
+    return jnp.einsum("nciw,wj->ncij", out, bw, precision=lax.Precision.HIGHEST)
 
 
 def _separable_window_3d(x: Array, g_d: Array, g_h: Array, g_w: Array) -> Array:
@@ -82,9 +85,9 @@ def _separable_window_3d(x: Array, g_d: Array, g_h: Array, g_w: Array) -> Array:
     bd = _band_matrix(g_d.astype(x.dtype), do)
     bh = _band_matrix(g_h.astype(x.dtype), ho)
     bw = _band_matrix(g_w.astype(x.dtype), wo)
-    out = jnp.einsum("ncdhw,de->ncehw", x, bd)
-    out = jnp.einsum("ncehw,hi->nceiw", out, bh)
-    return jnp.einsum("nceiw,wj->nceij", out, bw)
+    out = jnp.einsum("ncdhw,de->ncehw", x, bd, precision=lax.Precision.HIGHEST)
+    out = jnp.einsum("ncehw,hi->nceiw", out, bh, precision=lax.Precision.HIGHEST)
+    return jnp.einsum("nceiw,wj->nceij", out, bw, precision=lax.Precision.HIGHEST)
 
 
 def _conv2d(x: Array, kernel: Array) -> Array:
