@@ -6,6 +6,8 @@ sklearn/scipy have no equivalent (image metrics, text metrics, etc.).
 """
 from __future__ import annotations
 
+import operator
+import re
 import sys
 import types
 from enum import Enum
@@ -29,10 +31,32 @@ def load_reference_torchmetrics():
             except (ImportError, ValueError):
                 return False
 
+        def compare_version(package, op, version, use_base_version=False):
+            """Real version compare (an earlier blanket-False stub made the
+            reference think torch<1.12 and refuse e.g. PanopticQuality)."""
+            try:
+                import importlib
+
+                from packaging.version import Version
+
+                pkg_version = Version(importlib.import_module(package).__version__)
+                if use_base_version:
+                    pkg_version = Version(pkg_version.base_version)
+                return op(pkg_version, Version(version))
+            except Exception:
+                return False
+
+        _OPS = {
+            "<": operator.lt, "<=": operator.le, ">": operator.gt,
+            ">=": operator.ge, "==": operator.eq, "!=": operator.ne, "~=": operator.ge,
+        }
+
         class RequirementCache:
             """Truthful for plain module requirements that are importable here
-            (regex, nltk, ...); conservatively False for versioned requirements
-            so the reference keeps the legacy code paths it was loaded with."""
+            (regex, nltk, ...); versioned requirements like ``torch>=1.12`` are
+            genuinely evaluated against the installed package (via
+            ``compare_version``), so the reference takes the same code paths it
+            would on a real install."""
 
             def __init__(self, requirement="", module=None):
                 self._requirement = requirement
@@ -40,6 +64,10 @@ def load_reference_torchmetrics():
 
             def __bool__(self):
                 name = self._module or self._requirement
+                m = re.match(r"^\s*([A-Za-z0-9_.\-]+)\s*(<=|>=|==|!=|~=|<|>)\s*([\w.]+)\s*$", name)
+                if m:
+                    pkg, op_s, ver = m.groups()
+                    return compare_version(pkg.replace("-", "_"), _OPS[op_s], ver)
                 if any(op in name for op in ("<", ">", "=", "~")):
                     return False
                 return _module_importable(name.strip().replace("-", "_"))
@@ -49,7 +77,7 @@ def load_reference_torchmetrics():
 
         imports_mod.RequirementCache = RequirementCache
         imports_mod.package_available = lambda name: _module_importable(str(name).replace("-", "_"))
-        imports_mod.compare_version = lambda *a, **k: False
+        imports_mod.compare_version = compare_version
 
         def apply_to_collection(data, dtype, function, *args, **kwargs):
             if isinstance(data, dtype):

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # reference-oracle sweep over ~175 classes; run with --runslow
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
 import gen_doctests as reg  # noqa: E402
 
@@ -30,19 +32,37 @@ PARITY_SKIP = {
     # external wheels the reference imports lazily
     "PerceptualEvaluationSpeechQuality", "ShortTimeObjectiveIntelligibility",
     "SpeechReverberationModulationEnergyRatio",
-    # registry ctor uses our TPU-specific argument spelling
-    "PermutationInvariantTraining",
+    # registry ctor uses our TPU-specific argument spelling (PIT's batched
+    # metric_func; CLIP's embedding_fn hook replacing the HF-download path)
+    "PermutationInvariantTraining", "CLIPScore", "CLIPImageQualityAssessment",
     # the reference's exact-mode curve classes return ragged lists; covered by
     # dedicated tests in tests/classification/test_curves.py
     "RetrievalPrecisionRecallCurve", "RetrievalRecallAtFixedPrecision",
+    # reference's default rouge_keys include rougeLsum -> needs the nltk punkt
+    # asset (zero-egress env); value parity covered by tests/text/test_text.py
+    # and the real-fixture goldens (tests/test_real_fixtures.py)
+    "ROUGEScore",
+    # reference derives pan_lr via torchvision (not installed) when the update
+    # omits it; value parity with explicit pan_lr covered in
+    # tests/image/test_image_functional.py::TestPansharpening
+    "SpatialDistortionIndex", "QualityWithNoReference",
 }
 # classes where float32-vs-float64 accumulation differences need a looser bound
-LOOSE = {"KendallRankCorrCoef": 1e-3, "FleissKappa": 1e-3}
+LOOSE = {
+    "KendallRankCorrCoef": 1e-3,
+    "FleissKappa": 1e-3,
+    # registry case has preds~=target: acos(dot~=1) sits at float32's noise
+    # floor (~1e-4 rad), so both implementations return O(1e-4) with O(1e-5)
+    # rounding scatter; dedicated tests cover the regime away from the floor
+    "SpectralAngleMapper": 1e-4,
+}
 
 
 def _to_torch(v):
     if isinstance(v, jax.Array):
-        return torch.from_numpy(np.asarray(v).copy())
+        t = torch.from_numpy(np.asarray(v).copy())
+        # jax defaults to int32; the reference validates for torch's int64
+        return t.long() if t.dtype in (torch.int32, torch.int16, torch.uint8) else t
     if isinstance(v, dict):
         return {k: _to_torch(x) for k, x in v.items()}
     if isinstance(v, (list, tuple)):
@@ -76,8 +96,17 @@ PARITY_CASES = [
 ]
 
 
-@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", PARITY_CASES)
-def test_reference_parity(module_name, cls_name, ctor, setup, upd):
+def _construct_reference(module_name, cls_name, ctor, ns):
+    """Resolve the same-named reference class and construct it with OUR ctor
+    kwargs (the constructor-signature half of the parity claim). Returns the
+    torch-converted namespace with ``ref_m`` bound, or skips.
+
+    NB the ctor expression must be exec'd with ``cls_name`` bound to the
+    REFERENCE class in the one namespace used for name resolution: the build
+    namespace also holds OUR class under the same name, and an earlier version
+    that passed it as exec locals shadowed the reference — silently turning
+    the whole sweep into ours-vs-ours.
+    """
     import importlib
 
     load_reference_torchmetrics()
@@ -91,15 +120,41 @@ def test_reference_parity(module_name, cls_name, ctor, setup, upd):
         ref_cls = getattr(importlib.import_module("torchmetrics"), cls_name, None)
     if ref_cls is None:
         pytest.skip(f"{cls_name} not exported by the reference")
+    ref_ns = {k: _to_torch(v) for k, v in ns.items() if not k.startswith("__")}
+    ref_ns[cls_name] = ref_cls
+    try:
+        exec(f"ref_m = {cls_name}(" + ctor + ")", ref_ns)
+    except ModuleNotFoundError as e:
+        pytest.skip(f"reference needs external wheel: {e}")
+    assert type(ref_ns["ref_m"]).__module__.startswith("torchmetrics."), "must construct the reference class"
+    return ref_ns
+
+
+# Value parity for PARITY_SKIP classes lives in dedicated tests, but the
+# constructor-signature half of the parity claim still applies to them —
+# except where the TPU argument spelling differs by design.
+_CTOR_DIFFERENT = {"PermutationInvariantTraining", "CLIPScore", "CLIPImageQualityAssessment"}
+CTOR_ONLY_CASES = [
+    c for c in CASES
+    if c.id in (PARITY_SKIP - _CTOR_DIFFERENT) and isinstance(c.values[4], str)
+]
+
+
+@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CTOR_ONLY_CASES)
+def test_ctor_signature_parity_excluded(module_name, cls_name, ctor, setup, upd):
+    """The reference class must accept the same constructor kwargs, even where
+    value parity is delegated to dedicated tests (external wheels, ragged
+    exact-mode outputs)."""
+    ns, _ = _build(module_name, cls_name, ctor, setup, upd)
+    _construct_reference(module_name, cls_name, ctor, ns)
+
+
+@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", PARITY_CASES)
+def test_reference_parity(module_name, cls_name, ctor, setup, upd):
     ns, upd = _build(module_name, cls_name, ctor, setup, upd)
     m = ns["m"]
 
-    # same ctor kwargs must be accepted by the reference class (API parity)
-    ref_ns = {k: _to_torch(v) for k, v in ns.items() if not k.startswith("__")}
-    try:
-        exec(f"ref_m = {cls_name}(" + ctor + ")", {**ref_ns, cls_name: ref_cls}, ref_ns)
-    except ModuleNotFoundError as e:
-        pytest.skip(f"reference needs external wheel: {e}")
+    ref_ns = _construct_reference(module_name, cls_name, ctor, ns)
     ref_m = ref_ns["ref_m"]
 
     exec(f"m.update({upd})", ns)
