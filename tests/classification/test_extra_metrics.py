@@ -89,10 +89,12 @@ class TestCalibration(MetricTester):
     @pytest.mark.parametrize("norm", ["l1", "max"])
     def test_binary_ece(self, norm):
         def ref_ce(preds, target):
+            # binary task: confidence = RAW positive-class probability and
+            # accuracy = raw 0/1 target (reference calibration_error.py:136-138)
+            # — NOT the multiclass top-label max(p,1-p)/correctness convention
             n_bins = 15
-            bins = np.clip((preds * n_bins).astype(int), 0, n_bins - 1)
-            conf = np.where(preds > 0.5, preds, 1 - preds)
-            acc = np.where(preds > 0.5, target == 1, target == 0)
+            conf = preds
+            acc = (target == 1).astype(float)
             bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
             ce = []
             props = []

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # oracle parameter grids; run with --runslow
+
 sys.path.insert(0, "/root/repo/tests")
 
 from helpers.reference import load_reference_torchmetrics  # noqa: E402
@@ -62,7 +64,14 @@ def _both(name, ours_args, ref_args, kwargs, atol=1e-5):
 BINARY_GRID = list(itertools.product([None, -1], ["global", "samplewise"]))
 
 
-@pytest.mark.parametrize("fn", ["binary_stat_scores", "binary_accuracy", "binary_f1_score"])
+@pytest.mark.parametrize(
+    "fn",
+    [
+        "binary_stat_scores", "binary_accuracy", "binary_f1_score",
+        "binary_precision", "binary_recall", "binary_specificity",
+        "binary_hamming_distance",
+    ],
+)
 @pytest.mark.parametrize(("ignore_index", "multidim_average"), BINARY_GRID)
 def test_binary_grid(fn, ignore_index, multidim_average):
     target = BIN_TARGET_MD.copy()
@@ -94,7 +103,13 @@ def test_multiclass_accuracy_grid(average, ignore_index, multidim_average, top_k
     _both("multiclass_accuracy", (MC_PROBS_MD, target), (MC_PROBS_MD, target), kwargs)
 
 
-@pytest.mark.parametrize("fn", ["multiclass_stat_scores", "multiclass_f1_score"])
+@pytest.mark.parametrize(
+    "fn",
+    [
+        "multiclass_stat_scores", "multiclass_f1_score", "multiclass_precision",
+        "multiclass_recall", "multiclass_specificity", "multiclass_hamming_distance",
+    ],
+)
 @pytest.mark.parametrize(
     ("average", "ignore_index", "multidim_average"),
     list(itertools.product(["micro", "macro", "weighted", "none"], [None, 0], ["global", "samplewise"])),
@@ -112,7 +127,14 @@ def test_multiclass_grid(fn, average, ignore_index, multidim_average):
     _both(fn, (MC_PROBS_MD, target), (MC_PROBS_MD, target), kwargs)
 
 
-@pytest.mark.parametrize("fn", ["multilabel_stat_scores", "multilabel_accuracy", "multilabel_f1_score"])
+@pytest.mark.parametrize(
+    "fn",
+    [
+        "multilabel_stat_scores", "multilabel_accuracy", "multilabel_f1_score",
+        "multilabel_precision", "multilabel_recall", "multilabel_specificity",
+        "multilabel_hamming_distance",
+    ],
+)
 @pytest.mark.parametrize(
     ("average", "ignore_index", "multidim_average"),
     list(itertools.product(["micro", "macro", "weighted", "none"], [None, -1], ["global", "samplewise"])),
@@ -188,6 +210,60 @@ def test_multilabel_auroc_grid(thresholds, ignore_index):
         target[np.random.RandomState(13).rand(*target.shape) < 0.1] = ignore_index
     kwargs = {"num_labels": L, "thresholds": thresholds, "ignore_index": ignore_index, "average": "macro"}
     _both("multilabel_auroc", (ML_PROBS, target), (ML_PROBS, target), kwargs)
+
+
+# ------------------------------------------------------- derived-score axes
+@pytest.mark.parametrize("beta", [0.5, 2.0])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_multiclass_fbeta_beta_grid(beta, average):
+    kwargs = {"num_classes": C, "beta": beta, "average": average}
+    _both("multiclass_fbeta_score", (MC_PROBS, MC_TARGET), (MC_PROBS, MC_TARGET), kwargs)
+
+
+@pytest.mark.parametrize("beta", [0.5, 2.0])
+@pytest.mark.parametrize("task", ["binary", "multilabel"])
+def test_fbeta_beta_grid(task, beta):
+    if task == "binary":
+        kwargs = {"beta": beta}
+        _both("binary_fbeta_score", (BIN_PROBS, BIN_TARGET), (BIN_PROBS, BIN_TARGET), kwargs)
+    else:
+        kwargs = {"num_labels": L, "beta": beta, "average": "macro"}
+        _both("multilabel_fbeta_score", (ML_PROBS, ML_TARGET), (ML_PROBS, ML_TARGET), kwargs)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+def test_multiclass_jaccard_grid(average, ignore_index):
+    target = MC_TARGET.copy()
+    if ignore_index is not None:
+        target[np.random.RandomState(15).rand(*target.shape) < 0.1] = ignore_index
+    kwargs = {"num_classes": C, "average": average, "ignore_index": ignore_index}
+    _both("multiclass_jaccard_index", (MC_PROBS, target), (MC_PROBS, target), kwargs)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_multiclass_cohen_kappa_weights_grid(weights):
+    kwargs = {"num_classes": C, "weights": weights}
+    _both("multiclass_cohen_kappa", (MC_PROBS, MC_TARGET), (MC_PROBS, MC_TARGET), kwargs)
+
+
+@pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
+@pytest.mark.parametrize("fn,extra", [("multiclass_exact_match", {"num_classes": C}), ("multilabel_exact_match", {"num_labels": L})])
+def test_exact_match_grid(fn, extra, multidim_average):
+    kwargs = {**extra, "multidim_average": multidim_average}
+    if fn.startswith("multiclass"):
+        _both(fn, (MC_PROBS_MD, MC_TARGET_MD), (MC_PROBS_MD, MC_TARGET_MD), kwargs)
+    else:
+        _both(fn, (ML_PROBS_MD, ML_TARGET_MD), (ML_PROBS_MD, ML_TARGET_MD), kwargs)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_bins", [10, 30])
+def test_calibration_error_norm_grid(norm, n_bins):
+    kwargs = {"n_bins": n_bins, "norm": norm}
+    _both("binary_calibration_error", (BIN_PROBS, BIN_TARGET), (BIN_PROBS, BIN_TARGET), kwargs)
+    kwargs = {"num_classes": C, "n_bins": n_bins, "norm": norm}
+    _both("multiclass_calibration_error", (MC_PROBS, MC_TARGET), (MC_PROBS, MC_TARGET), kwargs)
 
 
 def test_grid_dimensions_covered():

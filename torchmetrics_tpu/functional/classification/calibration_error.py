@@ -65,10 +65,15 @@ def _binary_calibration_error_arg_validation(n_bins: int, norm: str, ignore_inde
 
 
 def _binary_calibration_error_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
-    """Returns (confidences, accuracies) with invalid entries mapped to bin-neutral 0."""
-    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
-    accuracies = jnp.where(preds > 0.5, target == 1, target == 0)
-    return jnp.where(valid, confidences, 0.0), jnp.where(valid, accuracies, False)
+    """Returns (confidences, accuracies) with invalid entries mapped to bin-neutral 0.
+
+    Reference semantics (calibration_error.py:136-138): for the binary task the
+    confidence is the RAW positive-class probability and the "accuracy" is the
+    raw 0/1 target — NOT the top-label max(p, 1-p)/correctness convention
+    (which the multiclass task uses). Binning by p vs by max(p, 1-p) groups
+    samples into different bins, so the two conventions genuinely differ.
+    """
+    return jnp.where(valid, preds, 0.0), jnp.where(valid, target == 1, False)
 
 
 def binary_calibration_error(
