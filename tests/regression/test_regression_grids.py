@@ -1,0 +1,111 @@
+"""Regression parameter-grid parity vs the reference oracle.
+
+Depth complement to the registry sweeps for the regression domain: enumerates
+the reference's own test axes (reference tests/unittests/regression/
+test_mean_error.py, test_r2.py, test_explained_variance.py,
+test_tweedie_deviance.py, test_kl_divergence.py) — ``squared``/``num_outputs``,
+``adjusted``/``multioutput``, Tweedie ``power``, KL ``log_prob``/``reduction``,
+Minkowski ``p`` — against live CPU torch.
+"""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # oracle parameter grids; run with --runslow
+
+sys.path.insert(0, "/root/repo/tests")
+
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+load_reference_torchmetrics()
+
+import torch  # noqa: E402
+import torchmetrics.functional.regression as RR  # noqa: E402
+
+import torchmetrics_tpu.functional.regression as OR  # noqa: E402
+
+N, D = 64, 3
+rng = np.random.RandomState(123)
+PREDS = rng.randn(N, D).astype(np.float32)
+TARGET = (PREDS + 0.3 * rng.randn(N, D)).astype(np.float32)
+PREDS_1D = PREDS[:, 0]
+TARGET_1D = TARGET[:, 0]
+POS_PREDS = np.abs(PREDS) + 0.1
+POS_TARGET = np.abs(TARGET) + 0.1
+POS_PREDS_1D = POS_PREDS[:, 0]
+POS_TARGET_1D = POS_TARGET[:, 0]
+PROBS = rng.dirichlet(np.ones(D), N).astype(np.float32)
+PROBS2 = rng.dirichlet(np.ones(D), N).astype(np.float32)
+
+
+def _both(name, args, kwargs, atol=1e-5):
+    ours = getattr(OR, name)(*[jnp.asarray(a) for a in args], **kwargs)
+    theirs = getattr(RR, name)(*[torch.from_numpy(np.asarray(a)) for a in args], **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(ours, dtype=np.float64),
+        theirs.numpy().astype(np.float64),
+        atol=atol, rtol=1e-4, err_msg=f"{name} {kwargs}",
+    )
+
+
+@pytest.mark.parametrize("squared", [True, False])
+@pytest.mark.parametrize("num_outputs", [1, D])
+def test_mse_grid(squared, num_outputs):
+    args = (PREDS_1D, TARGET_1D) if num_outputs == 1 else (PREDS, TARGET)
+    _both("mean_squared_error", args, {"squared": squared, "num_outputs": num_outputs})
+
+
+@pytest.mark.parametrize("adjusted", [0, 5])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+def test_r2_grid(adjusted, multioutput):
+    _both("r2_score", (PREDS, TARGET), {"adjusted": adjusted, "multioutput": multioutput})
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+def test_explained_variance_grid(multioutput):
+    _both("explained_variance", (PREDS, TARGET), {"multioutput": multioutput})
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0])
+def test_tweedie_power_grid(power):
+    # power in (1,2) needs strictly positive preds & targets; >=2 positive targets
+    _both("tweedie_deviance_score", (POS_PREDS_1D, POS_TARGET_1D), {"power": power}, atol=1e-4)
+
+
+@pytest.mark.parametrize("log_prob", [True, False])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_kl_divergence_grid(log_prob, reduction):
+    p = np.log(PROBS) if log_prob else PROBS
+    q = np.log(PROBS2) if log_prob else PROBS2
+    _both("kl_divergence", (p, q), {"log_prob": log_prob, "reduction": reduction})
+
+
+@pytest.mark.parametrize("p", [1.0, 2.0, 3.0, 4.5])
+def test_minkowski_grid(p):
+    _both("minkowski_distance", (PREDS_1D, TARGET_1D), {"p": p})
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "mean_absolute_error", "mean_absolute_percentage_error",
+        "symmetric_mean_absolute_percentage_error",
+        "weighted_mean_absolute_percentage_error", "log_cosh_error",
+        "relative_squared_error",
+    ],
+)
+def test_error_multioutput_default(name):
+    args = (POS_PREDS, POS_TARGET)
+    _both(name, args, {}, atol=1e-4)
+
+
+@pytest.mark.parametrize("squared", [True, False])
+def test_relative_squared_error_squared(squared):
+    _both("relative_squared_error", (PREDS, TARGET), {"squared": squared}, atol=1e-4)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_cosine_similarity_reduction(reduction):
+    _both("cosine_similarity", (PREDS, TARGET), {"reduction": reduction})

@@ -1,0 +1,98 @@
+"""Retrieval parameter-grid parity vs the reference oracle.
+
+Depth complement for the retrieval domain: the reference enumerates
+``empty_target_action x ignore_index x top_k`` per metric (reference
+tests/unittests/retrieval/helpers.py:_default_metric_class_input_arguments and
+the per-metric test modules); this sweeps the same axes through the modular
+classes, which exercises the padded per-query grid
+(functional/retrieval/_padded.py) against torch's per-query group loop.
+"""
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # oracle parameter grids; run with --runslow
+
+sys.path.insert(0, "/root/repo/tests")
+
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+load_reference_torchmetrics()
+
+import torch  # noqa: E402
+
+import torchmetrics_tpu.retrieval as ORM  # noqa: E402
+
+N_Q, N_DOCS = 12, 96
+rng = np.random.RandomState(77)
+PREDS = rng.rand(N_DOCS).astype(np.float32)
+TARGET = rng.randint(0, 2, N_DOCS)
+INDEXES = np.sort(rng.randint(0, N_Q, N_DOCS))
+# make two query groups all-negative so empty_target_action branches differ
+for q in (2, 7):
+    TARGET[INDEXES == q] = 0
+
+CLASSES = [
+    ("RetrievalMAP", {}),
+    ("RetrievalMRR", {}),
+    ("RetrievalPrecision", {"top_k": 4}),
+    ("RetrievalRecall", {"top_k": 4}),
+    ("RetrievalHitRate", {"top_k": 4}),
+    ("RetrievalFallOut", {"top_k": 4}),
+    ("RetrievalNormalizedDCG", {"top_k": 4}),
+    ("RetrievalRPrecision", {}),
+    ("RetrievalAUROC", {}),
+]
+
+
+def _run_pair(cls_name, kwargs):
+    import torchmetrics.retrieval as RRM
+
+    ours = getattr(ORM, cls_name)(**kwargs)
+    theirs = getattr(RRM, cls_name)(**kwargs)
+    ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(INDEXES))
+    theirs.update(
+        torch.from_numpy(PREDS), torch.from_numpy(TARGET), indexes=torch.from_numpy(INDEXES)
+    )
+    return np.asarray(ours.compute(), dtype=np.float64), theirs.compute().numpy().astype(np.float64)
+
+
+@pytest.mark.parametrize("cls_name,extra", CLASSES)
+@pytest.mark.parametrize("empty_target_action", ["skip", "neg", "pos"])
+def test_empty_target_action_grid(cls_name, extra, empty_target_action):
+    # NB for RetrievalFallOut "empty" means all-POSITIVE queries; the axis
+    # still applies verbatim, the reference just triggers it on that condition
+    kwargs = {"empty_target_action": empty_target_action, **extra}
+    a, b = _run_pair(cls_name, kwargs)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} {kwargs}")
+
+
+@pytest.mark.parametrize("cls_name,extra", CLASSES)
+def test_ignore_index_grid(cls_name, extra):
+    target = TARGET.copy()
+    target[rng.rand(N_DOCS) < 0.1] = -1
+    import torchmetrics.retrieval as RRM
+
+    kwargs = {"ignore_index": -1, "empty_target_action": "skip", **extra}
+    ours = getattr(ORM, cls_name)(**kwargs)
+    theirs = getattr(RRM, cls_name)(**kwargs)
+    ours.update(jnp.asarray(PREDS), jnp.asarray(target), indexes=jnp.asarray(INDEXES))
+    theirs.update(
+        torch.from_numpy(PREDS), torch.from_numpy(target), indexes=torch.from_numpy(INDEXES)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours.compute(), dtype=np.float64),
+        theirs.compute().numpy().astype(np.float64),
+        atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} ignore_index",
+    )
+
+
+@pytest.mark.parametrize("cls_name", ["RetrievalPrecision", "RetrievalRecall", "RetrievalNormalizedDCG"])
+@pytest.mark.parametrize("top_k", [1, 2, 8, None])
+def test_top_k_grid(cls_name, top_k):
+    kwargs = {} if top_k is None else {"top_k": top_k}
+    kwargs["empty_target_action"] = "neg"
+    a, b = _run_pair(cls_name, kwargs)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} top_k={top_k}")
