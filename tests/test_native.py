@@ -8,8 +8,10 @@ sys.path.insert(0, "/root/repo/tests")
 
 from torchmetrics_tpu.native import (  # noqa: E402
     _py_edit_distance,
+    _py_lcs,
     batch_edit_distance,
     edit_distance,
+    lcs_length,
     native_available,
 )
 
@@ -35,6 +37,41 @@ def test_string_tokens():
 
 def test_substitution_cost():
     assert edit_distance("ab", "cd", substitution_cost=2) == 4  # 2 subs at cost 2 == del+ins
+
+
+def test_lcs_parity():
+    rng = random.Random(11)
+    for _ in range(200):
+        a = [rng.randint(0, 6) for _ in range(rng.randint(0, 25))]
+        b = [rng.randint(0, 6) for _ in range(rng.randint(0, 25))]
+        assert lcs_length(a, b) == _py_lcs(a, b)
+    assert lcs_length("abcde", "ace") == 3
+    assert lcs_length([], ["x"]) == 0
+
+
+def test_rouge_l_uses_lcs_kernel(monkeypatch):
+    """rouge_score with rougeL must route ALL pairs through one batch_lcs
+    call (and _lcs through lcs_length); recorded via monkeypatch, with the
+    values checked against a hand LCS ('the cat sat' vs 'the cat on the mat'
+    -> LCS 2 = 'the cat')."""
+    import torchmetrics_tpu.functional.text.rouge as rouge_mod
+    import torchmetrics_tpu.native as native
+
+    assert rouge_mod._lcs("the cat sat".split(), "the cat on the mat".split()) == 2
+
+    calls = []
+    real_batch_lcs = native.batch_lcs
+
+    def recording_batch_lcs(pairs):
+        calls.append(len(pairs))
+        return real_batch_lcs(pairs)
+
+    monkeypatch.setattr(native, "batch_lcs", recording_batch_lcs)
+    scores = rouge_mod.rouge_score(
+        ["the cat sat", "a dog"], ["the cat on the mat", "a dog barks"], rouge_keys=("rougeL",)
+    )
+    assert calls == [2], "expected exactly one batched LCS crossing for the whole call"
+    assert abs(float(scores["rougeL_fmeasure"]) - ((2 * (2 / 3) * (2 / 5) / (2 / 3 + 2 / 5)) + (2 * 1.0 * (2 / 3) / (1.0 + 2 / 3))) / 2) < 1e-6
 
 
 def test_batch_parity():

@@ -62,8 +62,15 @@ def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List
 
 
 def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
-    """Length of the longest common subsequence (reference rouge.py:95-115)."""
-    return _lcs_table(pred_tokens, target_tokens)[-1][-1]
+    """Length of the longest common subsequence (reference rouge.py:95-115).
+
+    Dispatches to the first-party C++ kernel (native/edit_distance.cpp:tm_lcs)
+    — the Python DP table is only built when a backtracked LCS is needed
+    (rougeLsum) or the toolchain is unavailable.
+    """
+    from torchmetrics_tpu.native import lcs_length
+
+    return lcs_length(pred_tokens, target_tokens)
 
 
 def _backtracked_lcs_indices(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List[int]:
@@ -166,33 +173,58 @@ def _rouge_score_update(
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     sentence_splitter: Optional[Callable[[str], Sequence[str]]] = None,
 ) -> Dict[Union[int, str], List[Dict[str, Array]]]:
-    """Per-sentence scores with multi-ref accumulation (reference rouge.py:287-399)."""
+    """Per-sentence scores with multi-ref accumulation (reference rouge.py:287-399).
+
+    Two passes: tokenize every (pred, target) pair first, so the ROUGE-L LCS
+    lengths for the whole batch go through ONE native kernel crossing
+    (native/edit_distance.cpp:tm_lcs_batch) instead of a Python DP per pair.
+    """
     split_fn = sentence_splitter or _split_sentence
     results: Dict[Union[int, str], List[Dict[str, Array]]] = {k: [] for k in rouge_keys_values}
 
+    def _tok(text: str) -> Sequence[str]:
+        return _normalize_and_tokenize_text(text, stemmer, normalizer, tokenizer)
+
+    tokenized: List[Tuple[Sequence[str], List[Sequence[str]], List[Tuple[Sequence[str], List[Sequence[str]]]]]] = []
     for pred_raw, target_raw in zip(preds, target):
         target_list = [target_raw] if isinstance(target_raw, str) else list(target_raw)
-        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        pred = _tok(pred_raw)
         pred_lsum: List[Sequence[str]] = []
         if "Lsum" in rouge_keys_values:
-            pred_lsum = [
-                _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in split_fn(pred_raw)
-            ]
-
-        list_results: List[Dict[Union[int, str], Dict[str, Array]]] = []
+            pred_lsum = [_tok(s) for s in split_fn(pred_raw)]
+        tgt_entries: List[Tuple[Sequence[str], List[Sequence[str]]]] = []
         for target_raw_inner in target_list:
-            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
+            tgt = _tok(target_raw_inner)
             tgt_lsum: List[Sequence[str]] = []
             if "Lsum" in rouge_keys_values:
-                tgt_lsum = [
-                    _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in split_fn(target_raw_inner)
-                ]
+                tgt_lsum = [_tok(s) for s in split_fn(target_raw_inner)]
+            tgt_entries.append((tgt, tgt_lsum))
+        tokenized.append((pred, pred_lsum, tgt_entries))
+
+    lcs_iter = None
+    if "L" in rouge_keys_values:
+        from torchmetrics_tpu.native import batch_lcs
+
+        lcs_pairs = [
+            (pred, tgt)
+            for pred, _, tgt_entries in tokenized
+            for tgt, _ in tgt_entries
+            if pred and tgt
+        ]
+        lcs_iter = iter(batch_lcs(lcs_pairs))
+
+    for pred, pred_lsum, tgt_entries in tokenized:
+        list_results: List[Dict[Union[int, str], Dict[str, Array]]] = []
+        for tgt, tgt_lsum in tgt_entries:
             result_inner: Dict[Union[int, str], Dict[str, Array]] = {}
             for rouge_key in rouge_keys_values:
                 if isinstance(rouge_key, int):
                     score = _rouge_n_score(pred, tgt, rouge_key)
                 elif rouge_key == "L":
-                    score = _rouge_l_score(pred, tgt)
+                    if 0 in (len(pred), len(tgt)):
+                        score = {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+                    else:
+                        score = _compute_metrics(int(next(lcs_iter)), len(pred), len(tgt))
                 else:  # Lsum
                     score = _rouge_lsum_score(pred_lsum, tgt_lsum)
                 result_inner[rouge_key] = score

@@ -61,18 +61,32 @@ def _load() -> Optional[ctypes.CDLL]:
         stale = not os.path.exists(lib_path) or any(
             os.path.getmtime(lib_path) < os.path.getmtime(src) for src in _SRCS
         )
-        if stale:
+
+        def _compile() -> None:
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", *_SRCS, "-o", lib_path],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
+
+        if stale:
+            _compile()
         if hasattr(os, "geteuid") and os.stat(lib_path).st_uid != os.geteuid():
             _warn_disabled(f"compiled library {lib_path!r} is owned by another user")
             _LIB = None
             return None
         lib = ctypes.CDLL(lib_path)
+        # a cached .so from an older package version can predate newer entry
+        # points while passing the mtime staleness check (wheel-extracted
+        # sources carry archive mtimes) — detect and rebuild once. Unlink
+        # first: the stale library is already mapped, and both in-place linker
+        # writes (same inode: mapping corruption) and dlopen's by-identity
+        # caching are avoided by giving the rebuild a fresh inode.
+        if not all(hasattr(lib, sym) for sym in ("tm_levenshtein", "tm_lcs", "tm_pesq")):
+            os.remove(lib_path)
+            _compile()
+            lib = ctypes.CDLL(lib_path)
         lib.tm_levenshtein.restype = ctypes.c_int64
         lib.tm_levenshtein.argtypes = [
             ctypes.POINTER(ctypes.c_int64),
@@ -85,6 +99,17 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tm_levenshtein_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
             ctypes.POINTER(ctypes.c_int64)
         ] * 2 + [ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.tm_lcs.restype = ctypes.c_int64
+        lib.tm_lcs.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.tm_lcs_batch.restype = None
+        lib.tm_lcs_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
+            ctypes.POINTER(ctypes.c_int64)
+        ] * 2 + [ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
         lib.tm_pesq.restype = ctypes.c_double
         lib.tm_pesq.argtypes = [
             ctypes.POINTER(ctypes.c_double),
@@ -104,7 +129,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),
         ]
         _LIB = lib
-    except (OSError, subprocess.SubprocessError, FileNotFoundError):
+    except (OSError, subprocess.SubprocessError, FileNotFoundError, AttributeError):
         _LIB = None
     return _LIB
 
@@ -147,6 +172,30 @@ def edit_distance(a: Sequence, b: Sequence, substitution_cost: int = 1) -> int:
     return int(lib.tm_levenshtein(pa, len(ia), pb, len(ib), substitution_cost))
 
 
+def _py_lcs(a: Sequence, b: Sequence) -> int:
+    prev = [0] * (len(b) + 1)
+    for p_tok in a:
+        cur = [0] * (len(b) + 1)
+        for j, r_tok in enumerate(b, start=1):
+            cur[j] = prev[j - 1] + 1 if p_tok == r_tok else max(prev[j], cur[j - 1])
+        prev = cur
+    return prev[-1]
+
+
+def lcs_length(a: Sequence, b: Sequence) -> int:
+    """Longest-common-subsequence length over arbitrary token sequences
+    (native if possible) — the ROUGE-L hot op."""
+    if not a or not b:
+        return 0
+    lib = _load()
+    if lib is None:
+        return _py_lcs(a, b)
+    ia, ib = _tokens_to_ids(a, b)
+    pa = ia.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    pb = ib.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    return int(lib.tm_lcs(pa, len(ia), pb, len(ib)))
+
+
 def batch_edit_distance(
     pairs: Sequence[Tuple[Sequence, Sequence]], substitution_cost: int = 1
 ) -> np.ndarray:
@@ -176,6 +225,38 @@ def batch_edit_distance(
         b_off.ctypes.data_as(p),
         len(pairs),
         substitution_cost,
+        out.ctypes.data_as(p),
+    )
+    return out
+
+
+def batch_lcs(pairs: Sequence[Tuple[Sequence, Sequence]]) -> np.ndarray:
+    """LCS lengths for a batch of (prediction_tokens, reference_tokens) pairs —
+    one ctypes crossing for the whole ROUGE-L batch."""
+    lib = _load()
+    if lib is None:
+        return np.asarray([_py_lcs(a, b) for a, b in pairs], dtype=np.int64)
+    seqs: List[Sequence] = []
+    for a, b in pairs:
+        seqs.append(a)
+        seqs.append(b)
+    ids = _tokens_to_ids(*seqs)
+    a_seqs = ids[0::2]
+    b_seqs = ids[1::2]
+    a_flat = np.concatenate(a_seqs) if a_seqs else np.zeros(0, dtype=np.int64)
+    b_flat = np.concatenate(b_seqs) if b_seqs else np.zeros(0, dtype=np.int64)
+    a_off = np.zeros(len(pairs) + 1, dtype=np.int64)
+    b_off = np.zeros(len(pairs) + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in a_seqs], out=a_off[1:])
+    np.cumsum([len(s) for s in b_seqs], out=b_off[1:])
+    out = np.zeros(len(pairs), dtype=np.int64)
+    p = ctypes.POINTER(ctypes.c_int64)
+    lib.tm_lcs_batch(
+        a_flat.ctypes.data_as(p),
+        a_off.ctypes.data_as(p),
+        b_flat.ctypes.data_as(p),
+        b_off.ctypes.data_as(p),
+        len(pairs),
         out.ctypes.data_as(p),
     )
     return out

@@ -47,4 +47,30 @@ void tm_levenshtein_batch(const int64_t* a_flat, const int64_t* a_offsets,
   }
 }
 
+// Length of the longest common subsequence of a[0..n) and b[0..m).
+// Two-row DP, same layout as tm_levenshtein; serves the ROUGE-L host path
+// (reference rouge.py:95-115 runs this table as a Python double loop).
+int64_t tm_lcs(const int64_t* a, int64_t n, const int64_t* b, int64_t m) {
+  if (n == 0 || m == 0) return 0;
+  std::vector<int64_t> prev(m + 1, 0), cur(m + 1, 0);
+  for (int64_t i = 1; i <= n; ++i) {
+    const int64_t ai = a[i - 1];
+    for (int64_t j = 1; j <= m; ++j) {
+      cur[j] = (ai == b[j - 1]) ? prev[j - 1] + 1 : std::max(prev[j], cur[j - 1]);
+    }
+    std::swap(prev, cur);
+  }
+  return prev[m];
+}
+
+// Batch variant, same flattened offsets convention as tm_levenshtein_batch.
+void tm_lcs_batch(const int64_t* a_flat, const int64_t* a_offsets,
+                  const int64_t* b_flat, const int64_t* b_offsets,
+                  int64_t batch, int64_t* out) {
+  for (int64_t k = 0; k < batch; ++k) {
+    out[k] = tm_lcs(a_flat + a_offsets[k], a_offsets[k + 1] - a_offsets[k],
+                    b_flat + b_offsets[k], b_offsets[k + 1] - b_offsets[k]);
+  }
+}
+
 }  // extern "C"
