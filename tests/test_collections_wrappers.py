@@ -134,6 +134,23 @@ class TestWrappers:
         assert float(r2["max"]) >= float(r1["raw"])
         assert float(r2["min"]) <= float(r2["raw"])
 
+    def test_minmax_forward_invalidates_compute_cache(self):
+        """Regression: the forward override must count the update and clear the
+        compute cache — a compute() between forwards once returned stale values
+        (and warned 'compute before update')."""
+        import warnings
+
+        mm = MinMaxMetric(MeanMetric())
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no compute-before-update warning
+            mm.forward(jnp.asarray([1.0]))
+            r1 = mm.compute()
+            mm.forward(jnp.asarray([9.0]))
+            r2 = mm.compute()
+        assert float(r1["raw"]) == 1.0
+        assert float(r2["raw"]) == 5.0  # accumulated mean, not the stale cache
+        assert float(r2["max"]) == 9.0 and float(r2["min"]) == 1.0
+
     def test_multioutput(self):
         mo = MultioutputWrapper(MeanMetric(), num_outputs=2)
         x = jnp.asarray([[1.0, 10.0], [3.0, 30.0]])

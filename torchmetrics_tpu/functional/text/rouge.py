@@ -129,12 +129,16 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
     return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
 
 
-def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, Array]:
-    """Rouge-L triple (reference rouge.py:228-241)."""
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str], lcs: Optional[int] = None) -> Dict[str, Array]:
+    """Rouge-L triple (reference rouge.py:228-241).
+
+    ``lcs`` carries a precomputed LCS length from the batched native kernel
+    (see ``_rouge_score_update``); without it the per-pair path is used.
+    """
     pred_len, target_len = len(pred), len(target)
     if 0 in (pred_len, target_len):
         return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    return _compute_metrics(_lcs(pred, target), pred_len, target_len)
+    return _compute_metrics(lcs if lcs is not None else _lcs(pred, target), pred_len, target_len)
 
 
 def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, Array]:
@@ -221,10 +225,8 @@ def _rouge_score_update(
                 if isinstance(rouge_key, int):
                     score = _rouge_n_score(pred, tgt, rouge_key)
                 elif rouge_key == "L":
-                    if 0 in (len(pred), len(tgt)):
-                        score = {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-                    else:
-                        score = _compute_metrics(int(next(lcs_iter)), len(pred), len(tgt))
+                    lcs_val = int(next(lcs_iter)) if (pred and tgt) else None
+                    score = _rouge_l_score(pred, tgt, lcs=lcs_val)
                 else:  # Lsum
                     score = _rouge_lsum_score(pred_lsum, tgt_lsum)
                 result_inner[rouge_key] = score

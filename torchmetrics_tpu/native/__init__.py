@@ -62,16 +62,16 @@ def _load() -> Optional[ctypes.CDLL]:
             os.path.getmtime(lib_path) < os.path.getmtime(src) for src in _SRCS
         )
 
-        def _compile() -> None:
+        def _compile(out_path: str) -> None:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", *_SRCS, "-o", lib_path],
+                ["g++", "-O3", "-shared", "-fPIC", *_SRCS, "-o", out_path],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
 
         if stale:
-            _compile()
+            _compile(lib_path)
         if hasattr(os, "geteuid") and os.stat(lib_path).st_uid != os.geteuid():
             _warn_disabled(f"compiled library {lib_path!r} is owned by another user")
             _LIB = None
@@ -79,13 +79,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(lib_path)
         # a cached .so from an older package version can predate newer entry
         # points while passing the mtime staleness check (wheel-extracted
-        # sources carry archive mtimes) — detect and rebuild once. Unlink
-        # first: the stale library is already mapped, and both in-place linker
-        # writes (same inode: mapping corruption) and dlopen's by-identity
-        # caching are avoided by giving the rebuild a fresh inode.
+        # sources carry archive mtimes) — detect and rebuild once. Build to a
+        # temp path and rename over: the old (mapped) library survives a
+        # failed rebuild, in-place linker writes over the mapping are avoided,
+        # and the fresh inode sidesteps dlopen's by-identity caching.
         if not all(hasattr(lib, sym) for sym in ("tm_levenshtein", "tm_lcs", "tm_pesq")):
-            os.remove(lib_path)
-            _compile()
+            tmp_path = lib_path + ".rebuild"
+            _compile(tmp_path)
+            os.replace(tmp_path, lib_path)
             lib = ctypes.CDLL(lib_path)
         lib.tm_levenshtein.restype = ctypes.c_int64
         lib.tm_levenshtein.argtypes = [
@@ -196,13 +197,11 @@ def lcs_length(a: Sequence, b: Sequence) -> int:
     return int(lib.tm_lcs(pa, len(ia), pb, len(ib)))
 
 
-def batch_edit_distance(
-    pairs: Sequence[Tuple[Sequence, Sequence]], substitution_cost: int = 1
-) -> np.ndarray:
-    """Edit distances for a batch of (prediction_tokens, reference_tokens) pairs."""
-    lib = _load()
-    if lib is None:
-        return np.asarray([_py_edit_distance(a, b, substitution_cost) for a, b in pairs], dtype=np.int64)
+def _flatten_pairs(
+    pairs: Sequence[Tuple[Sequence, Sequence]],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Marshal token-sequence pairs into the kernels' flattened-offsets ABI:
+    (a_flat, a_offsets, b_flat, b_offsets) with a shared id space."""
     seqs: List[Sequence] = []
     for a, b in pairs:
         seqs.append(a)
@@ -216,6 +215,17 @@ def batch_edit_distance(
     b_off = np.zeros(len(pairs) + 1, dtype=np.int64)
     np.cumsum([len(s) for s in a_seqs], out=a_off[1:])
     np.cumsum([len(s) for s in b_seqs], out=b_off[1:])
+    return a_flat, a_off, b_flat, b_off
+
+
+def batch_edit_distance(
+    pairs: Sequence[Tuple[Sequence, Sequence]], substitution_cost: int = 1
+) -> np.ndarray:
+    """Edit distances for a batch of (prediction_tokens, reference_tokens) pairs."""
+    lib = _load()
+    if lib is None:
+        return np.asarray([_py_edit_distance(a, b, substitution_cost) for a, b in pairs], dtype=np.int64)
+    a_flat, a_off, b_flat, b_off = _flatten_pairs(pairs)
     out = np.zeros(len(pairs), dtype=np.int64)
     p = ctypes.POINTER(ctypes.c_int64)
     lib.tm_levenshtein_batch(
@@ -236,19 +246,7 @@ def batch_lcs(pairs: Sequence[Tuple[Sequence, Sequence]]) -> np.ndarray:
     lib = _load()
     if lib is None:
         return np.asarray([_py_lcs(a, b) for a, b in pairs], dtype=np.int64)
-    seqs: List[Sequence] = []
-    for a, b in pairs:
-        seqs.append(a)
-        seqs.append(b)
-    ids = _tokens_to_ids(*seqs)
-    a_seqs = ids[0::2]
-    b_seqs = ids[1::2]
-    a_flat = np.concatenate(a_seqs) if a_seqs else np.zeros(0, dtype=np.int64)
-    b_flat = np.concatenate(b_seqs) if b_seqs else np.zeros(0, dtype=np.int64)
-    a_off = np.zeros(len(pairs) + 1, dtype=np.int64)
-    b_off = np.zeros(len(pairs) + 1, dtype=np.int64)
-    np.cumsum([len(s) for s in a_seqs], out=a_off[1:])
-    np.cumsum([len(s) for s in b_seqs], out=b_off[1:])
+    a_flat, a_off, b_flat, b_off = _flatten_pairs(pairs)
     out = np.zeros(len(pairs), dtype=np.int64)
     p = ctypes.POINTER(ctypes.c_int64)
     lib.tm_lcs_batch(

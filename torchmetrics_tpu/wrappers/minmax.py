@@ -40,12 +40,37 @@ class MinMaxMetric(WrapperMetric):
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
 
-    def compute(self) -> Dict[str, Array]:
-        val = self._base_metric.compute()
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Batch value + running extrema.
+
+        The reference routes through ``Metric.forward``'s full-state path with
+        UNREGISTERED min/max tensors (reference minmax.py:78-79): min/max are
+        monotone over every compute (batch computes included), but the batch
+        reset/restore cycle silently LOSES the base metric's accumulated state
+        after each forward — ``compute()`` after N forwards returns the last
+        batch, not the accumulation. We keep per-forward outputs identical
+        (raw = batch value, min/max = extrema over batch values) while the
+        base metric's own forward preserves global accumulation, so a final
+        ``compute()`` reports the accumulated value — a deliberate fix of the
+        reference's multi-forward state loss.
+        """
+        batch_raw = self._base_metric.forward(*args, **kwargs)
+        # the override bypasses Metric.forward's bookkeeping: count the update
+        # and invalidate any cached compute() result ourselves
+        self._update_count += 1
+        self._computed = None
+        self._track(batch_raw)
+        return {"raw": jnp.asarray(batch_raw), "max": self.max_val, "min": self.min_val}
+
+    def _track(self, val: Array) -> None:
         if not (hasattr(val, "size") and val.size == 1):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
         self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
         self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        self._track(val)
         return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:
