@@ -14,6 +14,14 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-root import
 
+import os as _os
+
+import jax as _jax
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin ignores the env var; the config update works
+    _jax.config.update("jax_platforms", "cpu")
+
 from pprint import pprint
 import zlib
 

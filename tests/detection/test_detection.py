@@ -231,6 +231,39 @@ class TestMeanAveragePrecision:
             )
         np.testing.assert_array_equal(np.asarray(got["classes"]), want["classes"].numpy())
 
+    @pytest.mark.parametrize("iou_thresholds", [None, [0.5], [0.3, 0.55, 0.8]])
+    @pytest.mark.parametrize("box_format", ["xyxy", "xywh", "cxcywh"])
+    def test_parity_thresholds_and_format_grid(self, iou_thresholds, box_format):
+        """Legacy-oracle grid over iou_thresholds x box_format (reference
+        detection/_mean_ap.py accepts the same axes)."""
+
+        def conv(b):
+            if box_format == "xyxy":
+                return b
+            wh = b[:, 2:] - b[:, :2]
+            if box_format == "xywh":
+                return np.concatenate([b[:, :2], wh], axis=1)
+            return np.concatenate([b[:, :2] + wh / 2, wh], axis=1)  # cxcywh
+
+        preds, target = self._inputs(n_img=4)
+        preds = [{**p, "boxes": conv(p["boxes"])} for p in preds]
+        target = [{**t, "boxes": conv(t["boxes"])} for t in target]
+        ours = tm.MeanAveragePrecision(box_format=box_format, iou_thresholds=iou_thresholds)
+        ref = self._legacy_oracle()
+        ref.box_format = box_format
+        if iou_thresholds is not None:
+            ref.iou_thresholds = list(iou_thresholds)
+        ours.update(preds, target)
+        ref.update(
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+        )
+        got, want = ours.compute(), ref.compute()
+        for k in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100"):
+            np.testing.assert_allclose(
+                float(got[k]), float(want[k]), atol=1e-5, err_msg=f"{k} {box_format} {iou_thresholds}"
+            )
+
     def test_empty_preds(self):
         preds = [{"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros(0, np.float32), "labels": np.zeros(0, np.int64)}]
         target = [{"boxes": _rand_boxes(3), "labels": np.asarray([0, 1, 1])}]

@@ -84,9 +84,13 @@ def _load() -> Optional[ctypes.CDLL]:
         # failed rebuild, in-place linker writes over the mapping are avoided,
         # and the fresh inode sidesteps dlopen's by-identity caching.
         if not all(hasattr(lib, sym) for sym in ("tm_levenshtein", "tm_lcs", "tm_pesq")):
-            tmp_path = lib_path + ".rebuild"
-            _compile(tmp_path)
-            os.replace(tmp_path, lib_path)
+            tmp_path = f"{lib_path}.{os.getpid()}.rebuild"  # pid-unique: concurrent rebuilds must not interleave
+            try:
+                _compile(tmp_path)
+                os.replace(tmp_path, lib_path)
+            finally:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
             lib = ctypes.CDLL(lib_path)
         lib.tm_levenshtein.restype = ctypes.c_int64
         lib.tm_levenshtein.argtypes = [

@@ -1,7 +1,7 @@
 """MinMaxMetric (reference wrappers/minmax.py:29): track running min/max of compute."""
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax.numpy as jnp
 from jax import Array
@@ -25,7 +25,8 @@ class MinMaxMetric(WrapperMetric):
         {'max': 0.5, 'min': 0.5, 'raw': 0.5}
     """
 
-    full_state_update: Optional[bool] = True
+    # NB no full_state_update flag: Metric.forward's routing is bypassed by the
+    # explicit forward() override below
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
         super().__init__(**kwargs)
