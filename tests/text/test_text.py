@@ -119,10 +119,50 @@ class TestCHRF:
 
 
 class TestTER:
-    @pytest.mark.parametrize("kwargs", [{}, {"normalize": True}, {"no_punctuation": True}, {"lowercase": False}])
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            {},
+            {"normalize": True},
+            {"no_punctuation": True},
+            {"lowercase": False},
+            {"asian_support": True},
+            {"asian_support": True, "normalize": True},
+            {"normalize": True, "no_punctuation": True, "lowercase": False},
+        ],
+    )
     def test_parity(self, kwargs):
         preds = ["the cat is on the mat", "a dog walked into the room and sat"]
         target = [["the cat sat on the mat"], ["into the room a dog walked, and sat down"]]
+        _close(
+            F.translation_edit_rate(preds, target, **kwargs),
+            ref_tm.functional.translation_edit_rate(preds, target, **kwargs),
+        )
+
+    @pytest.mark.parametrize(
+        "preds,target,kwargs",
+        [
+            # reference removes ONLY [.,?:;!"()]; '>' must survive as a token
+            (["a > b c"], [["a b c"]], {"no_punctuation": True}),
+            # possessive splitting: "it's" -> "it 's" under normalize
+            (["it's a dog <here>"], [["it's a cat <here>"]], {"normalize": True}),
+            (["the cat's mat"], [["the cats mat"]], {"normalize": True}),
+        ],
+    )
+    def test_parity_punct_and_possessive(self, preds, target, kwargs):
+        """Regression for two tokenizer divergences found by review fuzzing."""
+        _close(
+            F.translation_edit_rate(preds, target, **kwargs),
+            ref_tm.functional.translation_edit_rate(preds, target, **kwargs),
+        )
+
+    @pytest.mark.parametrize("asian_support", [False, True])
+    def test_parity_cjk(self, asian_support):
+        """asian_support changes tokenization around CJK codepoints — exercise
+        it on text where it matters (reference ter.py:126-190)."""
+        preds = ["猫はマットの上に座った", "犬が部屋に入ってきた。"]
+        target = [["猫はマットの上にいる"], ["犬が部屋へ入ってきた。"]]
+        kwargs = {"asian_support": asian_support, "normalize": True}
         _close(
             F.translation_edit_rate(preds, target, **kwargs),
             ref_tm.functional.translation_edit_rate(preds, target, **kwargs),

@@ -14,7 +14,6 @@ scalars (total edits, total reference length).
 from __future__ import annotations
 
 import re
-import string
 from functools import lru_cache
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
@@ -27,10 +26,16 @@ _MAX_SHIFT_SIZE = 10
 _MAX_SHIFT_DIST = 50
 _MAX_SHIFT_CANDIDATES = 1000
 
-_PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+# the reference removes ONLY this set (reference ter.py:180-182), not all of
+# string.punctuation — tokens like <, >, #, - must survive no_punctuation
+_PUNCT_RE = re.compile(r"[\.,\?:;!\"\(\)]")
 _ASIAN_PUNCT = re.compile(r"([、。〈-】〔-〟｡-･・])")
+_FULL_WIDTH_PUNCT = re.compile(r"([．，？：；！＂（）])")
 _TERCOM_TOKENIZE_RE = (
     (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    # possessive splitting, in the reference's rule order (reference ter.py:136-138)
+    (re.compile(r"'s "), r" 's "),
+    (re.compile(r"'s$"), r" 's"),
     (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
     (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
     (re.compile(r"([0-9])(-)"), r"\1 \2 "),
@@ -85,17 +90,32 @@ class _TercomTokenizer:
 
     @staticmethod
     def _normalize_asian(sentence: str) -> str:
-        # split out CJK ideographs/kana as single tokens
-        sentence = re.sub(r"([一-鿿぀-ゟ゠-ヿ])", r" \1 ", sentence)
-        return _ASIAN_PUNCT.sub(r" \1 ", sentence)
+        """Split ideographs to character level, kana runs kept joined —
+        rule-for-rule the reference tokenizer (reference ter.py:152-176; its
+        kana regexes are start-anchored and near-no-op, reproduced verbatim
+        because tercom parity means matching them, quirks included)."""
+        # CJK Unified Ideographs + Extension A
+        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
+        # CJK Strokes + Radicals Supplement
+        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
+        # CJK Compatibility (+Ideographs, +Forms)
+        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
+        # Enclosed CJK Letters and Months (reference's over-wide ㈀-㼢)
+        sentence = re.sub(r"([㈀-㼢])", r" \1 ", sentence)
+        sentence = re.sub(r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 ", sentence)
+        sentence = _ASIAN_PUNCT.sub(r" \1 ", sentence)
+        return _FULL_WIDTH_PUNCT.sub(r" \1 ", sentence)
 
     @staticmethod
     def _remove_punct(sentence: str) -> str:
-        return sentence.translate(_PUNCT_TABLE)
+        return _PUNCT_RE.sub("", sentence)
 
     @staticmethod
     def _remove_asian_punct(sentence: str) -> str:
-        return _ASIAN_PUNCT.sub("", sentence)
+        sentence = _ASIAN_PUNCT.sub("", sentence)
+        return _FULL_WIDTH_PUNCT.sub("", sentence)
 
 
 def _preprocess_sentence(sentence: str, tokenizer: _TercomTokenizer) -> str:
