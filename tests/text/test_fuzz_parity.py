@@ -1,0 +1,113 @@
+"""Seeded fuzz parity for the text domain's host-side pipelines.
+
+Tokenization/normalization code is where silent divergences hide (the TER
+tokenizer shipped three — CJK splitting, punctuation sets, possessives —
+each found by fuzzing against the live reference). This module fuzzes the
+FULL functional outputs over mixed ASCII/punctuation/CJK strings for every
+text metric whose reference runs in this environment.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # live-oracle fuzz; run with --runslow
+
+sys.path.insert(0, "/root/repo/tests")
+
+from helpers.reference import load_reference_torchmetrics  # noqa: E402
+
+load_reference_torchmetrics()
+
+import torchmetrics.functional.text as RF  # noqa: E402
+
+import torchmetrics_tpu.functional.text as OF  # noqa: E402
+
+_POOL = (
+    list("abcde fgh 0123 .,<>'-#$\"()!?:; ")
+    + ["it's ", "the ", "cat ", "12.5 ", "a-b ", "猫", "犬は", "。", "，", "　", "ー"]
+    # line-join and sgm-marker material: the TER normalize rules for "\n-"
+    # and the literal tokenization of <skipped> diverged undetected until
+    # these entered the pool
+    + ["\n-", "x\n", "<skipped> ", "&gt;", "€"]
+)
+
+
+def _corpus(seed, n=24, min_len=2, max_len=14):
+    rng = np.random.default_rng(seed)
+    mk = lambda: "".join(rng.choice(_POOL, rng.integers(min_len, max_len))).strip() or "a"
+    preds = [mk() for _ in range(n)]
+    # targets share material with preds so scores are non-degenerate
+    target = [[p[: max(1, len(p) // 2)] + mk(), mk()] for p in preds]
+    return preds, target
+
+
+PREDS, TARGET = _corpus(7)
+SINGLE_TARGET = [t[0] for t in TARGET]
+
+
+def _close(ours, theirs, atol=1e-4):
+    np.testing.assert_allclose(
+        np.asarray(ours, dtype=np.float64),
+        np.asarray(theirs.detach() if hasattr(theirs, "detach") else theirs, dtype=np.float64),
+        atol=atol, rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("n_gram", [1, 2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_fuzz(n_gram, smooth):
+    _close(
+        OF.bleu_score(PREDS, TARGET, n_gram=n_gram, smooth=smooth),
+        RF.bleu_score(PREDS, TARGET, n_gram=n_gram, smooth=smooth),
+    )
+
+
+@pytest.mark.parametrize("tokenize", ["none", "13a", "zh", "intl", "char"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_fuzz(tokenize, lowercase):
+    _close(
+        OF.sacre_bleu_score(PREDS, TARGET, tokenize=tokenize, lowercase=lowercase),
+        RF.sacre_bleu_score(PREDS, TARGET, tokenize=tokenize, lowercase=lowercase),
+    )
+
+
+@pytest.mark.parametrize("n_word_order", [0, 2])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf_fuzz(n_word_order, whitespace):
+    _close(
+        OF.chrf_score(PREDS, TARGET, n_word_order=n_word_order, whitespace=whitespace),
+        RF.chrf_score(PREDS, TARGET, n_word_order=n_word_order, whitespace=whitespace),
+    )
+
+
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_fuzz(accumulate):
+    ours = OF.rouge_score(PREDS, TARGET, accumulate=accumulate, rouge_keys=("rouge1", "rouge2", "rougeL"))
+    theirs = RF.rouge_score(PREDS, TARGET, accumulate=accumulate, rouge_keys=("rouge1", "rouge2", "rougeL"))
+    assert set(ours) == set(theirs)
+    for k in ours:
+        _close(ours[k], theirs[k])
+
+
+@pytest.mark.parametrize(
+    "name", ["word_error_rate", "char_error_rate", "match_error_rate", "word_information_lost", "word_information_preserved"]
+)
+def test_asr_rates_fuzz(name):
+    _close(getattr(OF, name)(PREDS, SINGLE_TARGET), getattr(RF, name)(PREDS, SINGLE_TARGET))
+
+
+@pytest.mark.parametrize("kwargs", [{}, {"normalize": True, "asian_support": True}, {"no_punctuation": True, "lowercase": False}])
+def test_ter_fuzz(kwargs):
+    _close(
+        OF.translation_edit_rate(PREDS, TARGET, **kwargs),
+        RF.translation_edit_rate(PREDS, TARGET, **kwargs),
+    )
+
+
+@pytest.mark.parametrize("alpha,rho", [(2.0, 0.3), (1.0, 0.5)])
+def test_extended_edit_distance_fuzz(alpha, rho):
+    _close(
+        OF.extended_edit_distance(PREDS, SINGLE_TARGET, alpha=alpha, rho=rho),
+        RF.extended_edit_distance(PREDS, SINGLE_TARGET, alpha=alpha, rho=rho),
+    )

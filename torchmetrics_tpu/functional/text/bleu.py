@@ -18,7 +18,7 @@ import re
 import unicodedata
 from collections import Counter
 from functools import partial
-from typing import Callable, Optional, Sequence, Tuple, Union
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array
@@ -149,7 +149,11 @@ AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
 _UCODE_RANGES = (
     ("㐀", "䶵"), ("一", "龥"), ("龦", "龻"),
     ("豈", "鶴"), ("侮", "頻"), ("並", "龎"),
-    ("\U00020000", "\U0002a6d6"), ("\U0002f800", "\U0002fa1d"),
+    # NB kept as the reference writes them (reference sacre_bleu.py:70-71):
+    # "\\u20000" parses as the TWO-char string "\\u2000"+"0", so the
+    # lexicographic range check treats the whole U+2000..U+2A6D band (e.g.
+    # '\u20ac') as Chinese - a reference quirk reproduced for parity
+    ("\u20000", "\u2a6d6"), ("\u2f800", "\u2fa1d"),
     ("＀", "￯"), ("⺀", "⻿"), ("　", "〿"),
     ("㇀", "㇯"), ("⼀", "⿟"), ("⿰", "⿿"),
     ("㄀", "ㄯ"), ("ㆠ", "ㆿ"), ("︐", "︟"),
@@ -218,25 +222,50 @@ class _SacreBLEUTokenizer:
                 parts.append(ch)
         return cls._tokenize_regex("".join(parts))
 
+    @staticmethod
+    def _sub_pairs(line: str, rule: str) -> str:
+        """One non-overlapping left-to-right pass of the reference's intl
+        regex rules (reference sacre_bleu.py:122-129), expressed with
+        unicodedata category checks instead of the `regex` wheel's \\p
+        classes. ``rule``: "nonnum_punct" = (\\P{N})(\\p{P}) -> "\\1 \\2 ",
+        "punct_nonnum" = (\\p{P})(\\P{N}) -> " \\1 \\2", "symbol" =
+        (\\p{S}) -> " \\1 "."""
+        cat = unicodedata.category
+        out: List[str] = []
+        i = 0
+        n = len(line)
+        while i < n:
+            ch = line[i]
+            if rule == "symbol":
+                if cat(ch).startswith("S"):
+                    out.append(f" {ch} ")
+                else:
+                    out.append(ch)
+                i += 1
+                continue
+            if i + 1 < n:
+                nxt = line[i + 1]
+                if rule == "nonnum_punct" and not cat(ch).startswith("N") and cat(nxt).startswith("P"):
+                    out.append(f"{ch} {nxt} ")
+                    i += 2
+                    continue
+                if rule == "punct_nonnum" and cat(ch).startswith("P") and not cat(nxt).startswith("N"):
+                    out.append(f" {ch} {nxt}")
+                    i += 2
+                    continue
+            out.append(ch)
+            i += 1
+        return "".join(out)
+
     @classmethod
     def _tokenize_international(cls, line: str) -> str:
-        out = []
-        chars = list(line)
-        for i, ch in enumerate(chars):
-            cat = unicodedata.category(ch)
-            if cat.startswith("P"):
-                prev_num = i > 0 and unicodedata.category(chars[i - 1]).startswith("N")
-                next_num = i + 1 < len(chars) and unicodedata.category(chars[i + 1]).startswith("N")
-                # punctuation sticks to digits on both sides (e.g. 1,000 / 3.14)
-                if prev_num and next_num:
-                    out.append(ch)
-                else:
-                    out.append(f" {ch} ")
-            elif cat.startswith("S"):
-                out.append(f" {ch} ")
-            else:
-                out.append(ch)
-        return " ".join("".join(out).split())
+        # three cascaded passes, exactly the reference's rule order — spaces
+        # inserted by earlier passes participate in later ones (space is
+        # \P{N}), which a single char loop cannot reproduce
+        line = cls._sub_pairs(line, "nonnum_punct")
+        line = cls._sub_pairs(line, "punct_nonnum")
+        line = cls._sub_pairs(line, "symbol")
+        return " ".join(line.split())
 
     @classmethod
     def _tokenize_char(cls, line: str) -> str:

@@ -29,7 +29,9 @@ def _get_characters(sentence: str, whitespace: bool) -> List[str]:
     """Character stream, optionally stripping spaces (reference chrf.py:82-95)."""
     if whitespace:
         return list(sentence)
-    return list("".join(sentence.split()))
+    # NB only ASCII spaces are removed (after a strip): unicode whitespace
+    # like U+3000 stays a character, exactly as the reference does
+    return list(sentence.strip().replace(" ", ""))
 
 
 def _separate_word_and_punctuation(word: str) -> List[str]:

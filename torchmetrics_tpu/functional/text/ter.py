@@ -75,9 +75,10 @@ class _TercomTokenizer:
     @staticmethod
     def _normalize_general_and_western(sentence: str) -> str:
         sentence = f" {sentence} "
+        # NB the reference joins "\n-" (not the sgm-era "-\n") and has NO
+        # <skipped> rule — it tokenizes that literally (reference ter.py:125-133)
         sentence = (
-            sentence.replace("<skipped>", "")
-            .replace("-\n", "")
+            sentence.replace("\n-", "")
             .replace("\n", " ")
             .replace("&quot;", '"')
             .replace("&amp;", "&")
