@@ -4,6 +4,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.functional.classification.confusion_matrix import (
@@ -24,7 +25,13 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 def _matthews_corrcoef_reduce(confmat: Array) -> Array:
-    """Generalized R_k statistic from a (C, C) confusion matrix (reference :25-65)."""
+    """Generalized R_k statistic from a (C, C) confusion matrix (reference :37-78).
+
+    The degenerate ladder mirrors the reference exactly: binary perfect
+    (no fp/fn) → 1, binary all-wrong (no tp/tn) → -1, binary zero
+    denominator → the eps-regularized estimate, multiclass zero denominator
+    → 0. All branches are where-selected so the reduce stays trace-safe.
+    """
     if confmat.ndim == 3:  # multilabel (L, 2, 2) → sum into one binary confmat
         confmat = confmat.sum(0)
     confmat = confmat.astype(jnp.float32)
@@ -35,22 +42,21 @@ def _matthews_corrcoef_reduce(confmat: Array) -> Array:
     cov_ytyp = c * s - (tk * pk).sum()
     cov_ypyp = s**2 - (pk * pk).sum()
     cov_ytyt = s**2 - (tk * tk).sum()
-    denom = jnp.sqrt(cov_ytyt * cov_ypyp)
-    # degenerate cases (reference :47-62): single row/col filled → 0 or ±1
-    numerator = cov_ytyp
-    mcc = jnp.where(denom == 0, 0.0, numerator / jnp.where(denom == 0, 1.0, denom))
+    denom = cov_ypyp * cov_ytyt
+    general = cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom))
+    if confmat.shape[0] != 2:
+        return jnp.where(denom == 0, 0.0, general)
 
-    # reference handles the all-in-one-cell edge cases explicitly
-    unit = jnp.zeros_like(confmat)
-    tp_only = unit.at[1, 1].set(s) if confmat.shape[0] == 2 else None
-    if confmat.shape[0] == 2:
-        tn_only = unit.at[0, 0].set(s)
-        fp_only = unit.at[0, 1].set(s)
-        fn_only = unit.at[1, 0].set(s)
-        all_tp_tn = jnp.all(confmat == tp_only) | jnp.all(confmat == tn_only)
-        all_fp_fn = jnp.all(confmat == fp_only) | jnp.all(confmat == fn_only)
-        mcc = jnp.where(all_tp_tn, 1.0, jnp.where(all_fp_fn, -1.0, mcc))
-    return mcc
+    tn, fp, fn, tp = confmat.reshape(-1)
+    eps = float(np.finfo(np.float32).eps)
+    # reference :66-75 — only the zeroed side contributes to the estimate
+    a = jnp.where((tp == 0) | (tn == 0), tp + tn, 0.0)
+    b = jnp.where((fp == 0) | (fn == 0), fp + fn, 0.0)
+    eps_num = np.sqrt(eps) * (a - b)
+    eps_den = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+    mcc = jnp.where(denom == 0, eps_num / jnp.sqrt(eps_den), general)
+    mcc = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, mcc)
+    return jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, mcc)
 
 
 def binary_matthews_corrcoef(

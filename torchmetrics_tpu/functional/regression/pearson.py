@@ -8,10 +8,13 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 def _pearson_corrcoef_update(
@@ -76,10 +79,24 @@ def _final_aggregation(
 
 
 def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
-    """Correlation from accumulated second moments (reference pearson.py:80-103)."""
+    """Correlation from accumulated second moments (reference pearson.py:80-114)."""
     var_x = var_x / (nb - 1)
     var_y = var_y / (nb - 1)
     corr_xy = corr_xy / (nb - 1)
+    # reference pearson.py:104-111: near-zero variance makes the estimate
+    # numerically meaningless (the reference returns clamped float noise, we
+    # return NaN for the exactly-zero case) — both sides warn about it. The
+    # warning is host-side only; skip it under jit where values are traced.
+    try:
+        bound = float(np.sqrt(np.finfo(np.float32).eps))
+        if bool((var_x < bound).any() | (var_y < bound).any()):
+            rank_zero_warn(
+                "The variance of predictions or target is close to zero. This can cause instability in Pearson"
+                " correlation coefficient, leading to wrong results.",
+                UserWarning,
+            )
+    except jax.errors.TracerBoolConversionError:
+        pass
     denom = jnp.sqrt(var_x * var_y)
     corrcoef = jnp.where(denom == 0, jnp.nan, corr_xy / jnp.where(denom == 0, 1.0, denom))
     return jnp.clip(corrcoef, -1.0, 1.0).squeeze()

@@ -32,12 +32,21 @@ def _r2_score_compute(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """R² from sufficient statistics (reference r2.py:60-125)."""
+    """R² from sufficient statistics (reference r2.py:47-105)."""
     if isinstance(num_obs, int) and num_obs < 2:
-        rank_zero_warn("Needs at least two samples to calculate r2 score.", UserWarning)
+        raise ValueError("Needs at least two samples to calculate r2 score.")
     mean_obs = sum_obs / num_obs
     tss = sum_squared_obs - sum_obs * mean_obs
-    raw_scores = 1 - (residual / tss)
+    # near-constant handling (reference r2.py:82-91): rss≈0 → perfect fit
+    # scores 1 even if tss is also ~0; rss nonzero against a ~constant
+    # target scores 0 (both at the reference's atol=1e-4 isclose)
+    cond_rss = ~jnp.isclose(residual, 0.0, atol=1e-4)
+    cond_tss = ~jnp.isclose(tss, 0.0, atol=1e-4)
+    raw_scores = jnp.where(
+        cond_rss & cond_tss,
+        1 - (residual / jnp.where(cond_tss, tss, 1.0)),
+        jnp.where(cond_rss & ~cond_tss, 0.0, 1.0),
+    )
     if multioutput == "raw_values":
         r2 = raw_scores
     elif multioutput == "uniform_average":

@@ -157,7 +157,7 @@ def concordance_corrcoef(preds: Array, target: Array) -> Array:
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> result = concordance_corrcoef(preds, target)
-        >>> round(float(result), 4)
+        >>> round(float(result[0]), 4)  # shape (1,), like the reference
         0.9777
     """
 
@@ -170,4 +170,6 @@ def concordance_corrcoef(preds: Array, target: Array) -> Array:
     mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
         preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
     )
-    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb).squeeze()
+    # NB unlike pearson, the reference does NOT squeeze here — 1-D input
+    # yields shape (1,) (reference concordance.py doctest: tensor([0.9777]))
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -248,7 +249,8 @@ class ConcordanceCorrCoef(Metric):
                 self.corr_xy,
                 self.n_total,
             )
-        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total).squeeze()
+        # reference shape semantics: (num_outputs,) even for single output
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)
 
 
 class R2Score(Metric):
@@ -294,8 +296,16 @@ class R2Score(Metric):
         self.total = self.total + num_obs
 
     def compute(self) -> Array:
+        # concretize the count when possible so the n<2 and adjusted-r2
+        # guards in _r2_score_compute apply to the class path too (they are
+        # host-side checks; a traced count under jit skips them)
+        total = self.total
+        try:
+            total = int(total)
+        except (TypeError, jax.errors.TracerIntegerConversionError):
+            pass
         return _r2_score_compute(
-            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+            self.sum_squared_error, self.sum_error, self.residual, total, self.adjusted, self.multioutput
         )
 
 
