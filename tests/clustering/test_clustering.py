@@ -113,3 +113,14 @@ def test_validation():
         F.mutual_info_score(PREDS.astype(np.float32), TARGET)
     with pytest.raises(ValueError, match="average_method"):
         F.normalized_mutual_info_score(PREDS, TARGET, "harmonic")
+
+
+def test_single_cluster_degenerate_follows_reference():
+    """Identical single-cluster labelings: sklearn special-cases this to 1.0,
+    but the reference torchmetrics returns 0.0 (zero entropy -> zero NMI/AMI
+    without the special case) — we pin the REFERENCE behavior, which is the
+    parity target."""
+    same = np.zeros(30, dtype=int)
+    assert float(F.normalized_mutual_info_score(same, same)) == 0.0
+    assert float(F.adjusted_mutual_info_score(same, same)) == 0.0
+    assert float(sk_nmi(same, same)) == 1.0  # documents the sklearn difference
