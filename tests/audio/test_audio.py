@@ -3,6 +3,7 @@ import sys
 
 import numpy as np
 import pytest
+import jax.numpy as jnp
 import torch
 
 sys.path.insert(0, "/root/repo/tests")
@@ -196,3 +197,36 @@ class TestPIT(MetricTester):
             ddp=False,
             atol=1e-4,
         )
+
+
+class TestDegenerateConventions:
+    """Documented conventions on degenerate inputs.
+
+    The SNR family floors its log with eps of the input dtype — at float32
+    (the TPU design point) identical signals cap near 96 dB, matching the
+    reference on the same float32 inputs (only float64 inputs move either
+    side to ~184 dB). Degenerate SDR inputs make the reference's float64
+    Toeplitz solve raise (silent target) or NaN (identical signals); ours
+    returns a coherence-clamped finite value for identical signals
+    (sdr.py:110-113) and NaN for a silent target — it never raises.
+    """
+
+    def test_identical_signals_hit_f32_eps_floor(self):
+        x = jnp.asarray(np.random.RandomState(9).randn(2, 512).astype(np.float32))
+        snr = F.signal_noise_ratio(x, x)
+        # 80 < snr < 120: a silent promotion to float64 (~184 dB) must fail
+        assert bool(jnp.all((snr > 80.0) & (snr < 120.0)))
+        si_sdr = F.scale_invariant_signal_distortion_ratio(x, x)
+        assert bool(jnp.all((si_sdr > 80.0) & (si_sdr < 120.0)))
+
+    def test_silence_target_large_negative(self):
+        x = jnp.asarray(np.random.RandomState(9).randn(2, 512).astype(np.float32))
+        snr = F.signal_noise_ratio(x, jnp.zeros_like(x))
+        assert bool(jnp.all((snr < -80.0) & (snr > -120.0)))
+
+    def test_degenerate_sdr_never_raises(self):
+        x = jnp.asarray(np.random.RandomState(9).randn(2, 2048).astype(np.float32))
+        out = F.signal_distortion_ratio(x, x)  # reference NaNs here
+        assert out.shape == (2,) and bool(jnp.all(jnp.isfinite(out)))
+        out2 = F.signal_distortion_ratio(x, jnp.zeros_like(x))  # reference raises
+        assert out2.shape == (2,)  # NaN allowed, raising is not
