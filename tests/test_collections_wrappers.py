@@ -207,3 +207,100 @@ class TestWrappers:
         tr.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
         res = tr.best_metric()
         assert "MulticlassAccuracy" in res
+
+
+class TestFunctionalCollection:
+    """Pure/functional MetricCollection path: compute groups inside traced steps."""
+
+    def _make(self, **kwargs):
+        return MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            ],
+            **kwargs,
+        )
+
+    def test_resolve_groups_matches_oo_probe(self):
+        mc = self._make()
+        groups = mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        assert sorted(len(v) for v in groups.values()) == [1, 2]
+        # the probe must not touch live metric state
+        assert all(m._update_count == 0 for m in mc.values())
+        # idempotent
+        assert mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0])) == groups
+
+    def test_functional_lifecycle_matches_oo(self):
+        import jax
+
+        mc = self._make()
+        mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        states = mc.functional_init()
+        assert len(states) == 2  # one state pytree per group leader
+
+        step = jax.jit(mc.functional_update)
+        for i in range(4):
+            states = step(states, jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        res = mc.functional_compute(states)
+
+        oo = self._make()
+        for i in range(4):
+            oo.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        expected = oo.compute()
+        assert set(res) == set(expected)
+        for k in expected:
+            np.testing.assert_allclose(np.asarray(res[k]), np.asarray(expected[k]), atol=1e-6)
+
+    def test_functional_sync_on_mesh(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from functools import partial
+
+        mc = self._make()
+        mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        states0 = mc.functional_init()
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        def dist_step(p, t):
+            st = mc.functional_update(states0, p, t)
+            st = mc.functional_sync(st, "data")
+            return mc.functional_compute(st)
+
+        flat_p, flat_t = PREDS.reshape(-1), TARGET.reshape(-1)
+        res = dist_step(jnp.asarray(flat_p), jnp.asarray(flat_t))
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(flat_t, flat_p)) < 1e-6
+        assert (
+            abs(float(res["MulticlassRecall"]) - sk_recall(flat_t, flat_p, average="macro", zero_division=0)) < 1e-6
+        )
+
+    def test_functional_forward_batch_values(self):
+        mc = self._make()
+        mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        states = mc.functional_init()
+        states, batch_vals = mc.functional_forward(states, jnp.asarray(PREDS[1]), jnp.asarray(TARGET[1]))
+        assert abs(float(batch_vals["MulticlassAccuracy"]) - sk_accuracy(TARGET[1], PREDS[1])) < 1e-6
+        # accumulated state reflects the merged batch
+        res = mc.functional_compute(states)
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(TARGET[1], PREDS[1])) < 1e-6
+
+    def test_functional_without_resolve_is_ungrouped_but_correct(self):
+        mc = self._make()
+        states = mc.functional_init()
+        assert len(states) == 3
+        states = mc.functional_update(states, jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = mc.functional_compute(states)
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(TARGET[0], PREDS[0])) < 1e-6
+
+    def test_functional_explicit_groups_and_prefix(self):
+        mc = self._make(
+            compute_groups=[["MulticlassPrecision", "MulticlassRecall"], ["MulticlassAccuracy"]],
+            prefix="val_",
+        )
+        states = mc.functional_init()
+        assert set(states) == {"MulticlassPrecision", "MulticlassAccuracy"}
+        states = mc.functional_update(states, jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        res = mc.functional_compute(states)
+        assert set(res) == {"val_MulticlassAccuracy", "val_MulticlassPrecision", "val_MulticlassRecall"}

@@ -94,3 +94,56 @@ def test_wer_uses_native_path():
 
     val = float(word_error_rate(["hello world"], ["hello there world"]))
     np.testing.assert_allclose(val, 1 / 3)
+
+
+def test_ngram_hits_parity():
+    """Native tm_ngram_hits_batch matches the Counter-based fallback."""
+    import numpy as np
+    from torchmetrics_tpu.native import _py_ngram_hits, batch_ngram_hits
+
+    rng = np.random.RandomState(7)
+    pairs = []
+    for _ in range(40):
+        la, lb = rng.randint(0, 20), rng.randint(0, 20)
+        pairs.append((list(rng.randint(0, 6, la)), list(rng.randint(0, 6, lb))))
+    pairs.append(([], []))  # empty both
+    pairs.append(([1, 2, 3], []))  # empty one side
+    pairs.append(([1], [1]))  # shorter than bigram window
+    for n in (1, 2, 3):
+        hits, ca, cb = batch_ngram_hits(pairs, n)
+        want = [_py_ngram_hits(a, b, n) for a, b in pairs]
+        np.testing.assert_array_equal(hits, [w[0] for w in want])
+        np.testing.assert_array_equal(ca, [w[1] for w in want])
+        np.testing.assert_array_equal(cb, [w[2] for w in want])
+
+
+def test_rouge_n_uses_ngram_kernel(monkeypatch):
+    """rouge1/rouge2 route through the batched native n-gram kernel."""
+    import numpy as np
+    import torchmetrics_tpu.native as native
+    from torchmetrics_tpu.functional.text import rouge_score
+
+    calls = []
+    real = native.batch_ngram_hits_multi
+
+    def recording(pairs, ns):
+        calls.append((len(pairs), tuple(ns)))
+        return real(pairs, ns)
+
+    monkeypatch.setattr(native, "batch_ngram_hits_multi", recording)
+    preds = ["the cat sat on the mat", "a dog"]
+    target = [["a cat sat on the mat"], ["the dog barked"]]
+    res = rouge_score(preds, target, rouge_keys=("rouge1", "rouge2"))
+    assert calls == [(2, (1, 2))]  # one flatten, both n values
+    assert abs(float(res["rouge1_fmeasure"]) - np.mean([10 / 12, 2 / 5])) < 1e-6
+
+
+def test_rouge_duplicate_keys():
+    """Repeated rouge keys must not desync the precomputed per-pair results."""
+    from torchmetrics_tpu.functional.text import rouge_score
+
+    a = rouge_score(["the cat sat"], [["the cat on mat"]], rouge_keys=("rouge1", "rougeL", "rouge1", "rougeL"))
+    b = rouge_score(["the cat sat"], [["the cat on mat"]], rouge_keys=("rouge1", "rougeL"))
+    assert set(a) == set(b)
+    for k in b:
+        assert float(a[k]) == float(b[k])

@@ -199,17 +199,28 @@ class MetricCollection:
                 self._compute_groups_create_state_ref()
                 self._groups_checked = True
 
-    def _merge_compute_groups(self) -> None:
-        """Union groups whose states compare equal (reference :228-262), O(n²)."""
+    def _merge_compute_groups(self, trial_states: Optional[Dict[str, Dict[str, Any]]] = None) -> None:
+        """Union groups whose states compare equal (reference :228-262), O(n²).
+
+        With ``trial_states`` (name → state pytree) the comparison runs on those
+        pytrees instead of the metrics' live state — used by
+        :meth:`resolve_compute_groups` to probe grouping without mutating anything.
+        """
         num_groups = len(self._groups)
         while True:
             for cg_idx1, cg_members1 in deepcopy(self._groups).items():
                 for cg_idx2, cg_members2 in deepcopy(self._groups).items():
                     if cg_idx1 == cg_idx2:
                         continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
+                    n1, n2 = cg_members1[0], cg_members2[0]
+                    metric1 = self._modules[n1]
+                    metric2 = self._modules[n2]
+                    if self._equal_metric_states(
+                        metric1,
+                        metric2,
+                        None if trial_states is None else trial_states[n1],
+                        None if trial_states is None else trial_states[n2],
+                    ):
                         self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
                         break
                 else:
@@ -221,7 +232,12 @@ class MetricCollection:
         self._groups = {i: v for i, v in enumerate(self._groups.values())}
 
     @staticmethod
-    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+    def _equal_metric_states(
+        metric1: Metric,
+        metric2: Metric,
+        state1: Optional[Dict[str, Any]] = None,
+        state2: Optional[Dict[str, Any]] = None,
+    ) -> bool:
         """True if both metrics hold identical states (reference :264-287)."""
         if not metric1._defaults or not metric2._defaults:
             return False
@@ -229,9 +245,11 @@ class MetricCollection:
             return False
         if metric1._reductions != metric2._reductions:
             return False
+        state1 = state1 if state1 is not None else metric1._state
+        state2 = state2 if state2 is not None else metric2._state
         for key in metric1._defaults:
-            s1 = metric1._state[key]
-            s2 = metric2._state[key]
+            s1 = state1[key]
+            s2 = state2[key]
             if type(s1) != type(s2):  # noqa: E721
                 return False
             if isinstance(s1, list):
@@ -307,6 +325,10 @@ class MetricCollection:
         for k, m in self._modules.items():
             res = getattr(m, method_name)()
             result[k] = res
+        return self._flatten_results(result)
+
+    def _flatten_results(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten dict-valued metric results with prefix dedup (reference :340-359)."""
         _, duplicates = _flatten_dict({k: v for k, v in result.items() if isinstance(v, dict)})
         flat = {}
         for k, res in result.items():
@@ -316,6 +338,88 @@ class MetricCollection:
             else:
                 flat[self._set_name(k)] = res
         return flat
+
+    # ------------------------------------------------------ pure/functional API
+    #
+    # The in-trace analogue of the OO path: collection states live in a pytree
+    # keyed by compute-group leader, so a jitted/shard_map'd train step pays one
+    # `update` and one set of collectives per GROUP, not per metric — the
+    # reference's flagship 2-3x compute-group saving
+    # (reference collections.py:228-308, docs/source/pages/overview.rst:392-397)
+    # carried into the compiled-step world where the OO runtime probe can't go.
+    #
+    # Auto-grouping compares post-update states, which is impossible on tracers;
+    # call `resolve_compute_groups(example_batch)` once, eagerly, before tracing
+    # (or pass an explicit `compute_groups=[[...]]` list at construction).
+
+    def resolve_compute_groups(self, *args: Any, **kwargs: Any) -> Dict[int, List[str]]:
+        """Eagerly resolve compute groups from one concrete example batch.
+
+        Runs every metric's pure ``functional_update`` on a fresh default state
+        (live metric state is untouched) and unions metrics whose resulting
+        states compare equal — the same probe the OO ``update`` path performs on
+        its first call (reference collections.py:228-262), made explicit so it
+        can happen host-side before ``jit`` tracing. Idempotent.
+        """
+        if self._enable_compute_groups and not self._groups_checked:
+            trial = {
+                name: m.functional_update(m.init_state(), *args, **m._filter_kwargs(**kwargs))
+                for name, m in self._modules.items()
+            }
+            self._merge_compute_groups(trial_states=trial)
+            self._groups_checked = True
+        return self._groups
+
+    def functional_init(self) -> Dict[str, Dict[str, Any]]:
+        """Fresh default states, one pytree per compute-group leader."""
+        return {cg[0]: self._modules[cg[0]].init_state() for cg in self._groups.values()}
+
+    def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure update: one leader ``functional_update`` per compute group."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            out[cg[0]] = m0.functional_update(states[cg[0]], *args, **m0._filter_kwargs(**kwargs))
+        return out
+
+    def functional_sync(
+        self, states: Dict[str, Dict[str, Any]], axis_name: Optional[Union[str, Sequence[str]]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Pure in-trace sync: one set of collectives per compute group."""
+        return {leader: self._modules[leader].functional_sync(st, axis_name) for leader, st in states.items()}
+
+    def functional_compute(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Pure compute: every member reads its group leader's state; results are
+        flattened/renamed exactly like :meth:`compute`."""
+        result: Dict[str, Any] = {}
+        for cg in self._groups.values():
+            st = states[cg[0]]
+            for name in cg:
+                result[name] = self._modules[name].functional_compute(st)
+        return self._flatten_results(result)
+
+    def functional_forward(
+        self, states: Dict[str, Dict[str, Any]], *args: Any, update_count: Optional[int] = None, **kwargs: Any
+    ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, Any]]:
+        """Pure forward: ``(states, batch) -> (states', batch_values)``.
+
+        One leader update per group; each member's batch value derives from the
+        leader's batch state; the batch state merges into the accumulated state
+        via the leader's declared reductions. As with
+        :meth:`Metric.functional_forward`, pass ``update_count`` (the number of
+        updates already merged into ``states``) so ``"mean"``-reduced states
+        merge count-weighted.
+        """
+        new_states: Dict[str, Dict[str, Any]] = {}
+        result: Dict[str, Any] = {}
+        counts = (update_count, 1) if update_count is not None else None
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            batch_state = m0.functional_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
+            new_states[cg[0]] = m0.merge_states(states[cg[0]], batch_state, counts=counts)
+            for name in cg:
+                result[name] = self._modules[name].functional_compute(batch_state)
+        return new_states, self._flatten_results(result)
 
     def reset(self) -> None:
         for m in self._modules.values():

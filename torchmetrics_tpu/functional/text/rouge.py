@@ -112,23 +112,6 @@ def _normalize_and_tokenize_text(
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
 
 
-def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, Array]:
-    """Rouge-N triple (reference rouge.py:202-225)."""
-
-    def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
-        ngrams: Counter = Counter()
-        for ngram in (tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)):
-            ngrams[ngram] += 1
-        return ngrams
-
-    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
-    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
-    if 0 in (pred_len, target_len):
-        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
-    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
-
-
 def _rouge_l_score(pred: Sequence[str], target: Sequence[str], lcs: Optional[int] = None) -> Dict[str, Array]:
     """Rouge-L triple (reference rouge.py:228-241).
 
@@ -205,32 +188,46 @@ def _rouge_score_update(
             tgt_entries.append((tgt, tgt_lsum))
         tokenized.append((pred, pred_lsum, tgt_entries))
 
-    lcs_iter = None
+    # the LCS lengths and clipped n-gram overlaps for the whole batch each go
+    # through ONE native kernel crossing; results are indexed by pair position
+    # so repeated keys in rouge_keys_values read the same precomputed entry
+    all_pairs = [(pred, tgt) for pred, _, tgt_entries in tokenized for tgt, _ in tgt_entries]
+    lcs_by_pair: List[Optional[int]] = []
     if "L" in rouge_keys_values:
         from torchmetrics_tpu.native import batch_lcs
 
-        lcs_pairs = [
-            (pred, tgt)
-            for pred, _, tgt_entries in tokenized
-            for tgt, _ in tgt_entries
-            if pred and tgt
-        ]
-        lcs_iter = iter(batch_lcs(lcs_pairs))
+        nonempty = [(a, b) for a, b in all_pairs if a and b]
+        it = iter(batch_lcs(nonempty).tolist())
+        lcs_by_pair = [int(next(it)) if (a and b) else None for a, b in all_pairs]
 
+    ngram_by_pair: Dict[int, List[Tuple[int, int, int]]] = {}
+    int_keys = sorted({k for k in rouge_keys_values if isinstance(k, int)})
+    if int_keys:
+        from torchmetrics_tpu.native import batch_ngram_hits_multi
+
+        per_n = batch_ngram_hits_multi(all_pairs, int_keys)
+        for n in int_keys:
+            ngram_by_pair[n] = list(zip(*(arr.tolist() for arr in per_n[n])))
+
+    pair_idx = 0
     for pred, pred_lsum, tgt_entries in tokenized:
         list_results: List[Dict[Union[int, str], Dict[str, Array]]] = []
         for tgt, tgt_lsum in tgt_entries:
             result_inner: Dict[Union[int, str], Dict[str, Array]] = {}
             for rouge_key in rouge_keys_values:
                 if isinstance(rouge_key, int):
-                    score = _rouge_n_score(pred, tgt, rouge_key)
+                    hits, pred_len, target_len = ngram_by_pair[rouge_key][pair_idx]
+                    if 0 in (pred_len, target_len):
+                        score = {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+                    else:
+                        score = _compute_metrics(hits, pred_len, target_len)
                 elif rouge_key == "L":
-                    lcs_val = int(next(lcs_iter)) if (pred and tgt) else None
-                    score = _rouge_l_score(pred, tgt, lcs=lcs_val)
+                    score = _rouge_l_score(pred, tgt, lcs=lcs_by_pair[pair_idx])
                 else:  # Lsum
                     score = _rouge_lsum_score(pred_lsum, tgt_lsum)
                 result_inner[rouge_key] = score
             list_results.append(result_inner)
+            pair_idx += 1
 
         if accumulate == "best":
             key_curr = rouge_keys_values[0]

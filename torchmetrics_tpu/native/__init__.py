@@ -83,7 +83,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # temp path and rename over: the old (mapped) library survives a
         # failed rebuild, in-place linker writes over the mapping are avoided,
         # and the fresh inode sidesteps dlopen's by-identity caching.
-        if not all(hasattr(lib, sym) for sym in ("tm_levenshtein", "tm_lcs", "tm_pesq")):
+        if not all(hasattr(lib, sym) for sym in ("tm_levenshtein", "tm_lcs", "tm_pesq", "tm_ngram_hits_batch")):
             tmp_path = f"{lib_path}.{os.getpid()}.rebuild"  # pid-unique: concurrent rebuilds must not interleave
             try:
                 _compile(tmp_path)
@@ -115,6 +115,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tm_lcs_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
             ctypes.POINTER(ctypes.c_int64)
         ] * 2 + [ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.tm_ngram_hits_batch.restype = None
+        lib.tm_ngram_hits_batch.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 2 + [
+            ctypes.POINTER(ctypes.c_int64)
+        ] * 2 + [ctypes.c_int64, ctypes.c_int64] + [ctypes.POINTER(ctypes.c_int64)] * 3
         lib.tm_pesq.restype = ctypes.c_double
         lib.tm_pesq.argtypes = [
             ctypes.POINTER(ctypes.c_double),
@@ -262,6 +266,59 @@ def batch_lcs(pairs: Sequence[Tuple[Sequence, Sequence]]) -> np.ndarray:
         out.ctypes.data_as(p),
     )
     return out
+
+
+def _py_ngram_hits(a: Sequence, b: Sequence, n: int) -> Tuple[int, int, int]:
+    from collections import Counter
+
+    ca = Counter(tuple(a[i : i + n]) for i in range(len(a) - n + 1))
+    cb = Counter(tuple(b[i : i + n]) for i in range(len(b) - n + 1))
+    hits = sum(min(ca[g], cb[g]) for g in ca if g in cb)
+    return hits, sum(ca.values()), sum(cb.values())
+
+
+def batch_ngram_hits_multi(
+    pairs: Sequence[Tuple[Sequence, Sequence]], ns: Sequence[int]
+) -> dict:
+    """Clipped n-gram overlap for a batch of token-sequence pairs, for several
+    n values at once — the ROUGE-N hot op. The pairs are id-mapped and
+    flattened ONCE; one kernel crossing per n. Returns
+    {n: (hits, a_ngram_counts, b_ngram_counts)}, one entry per pair each."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_ngram_hits_batch"):
+        out = {}
+        for n in ns:
+            res = [_py_ngram_hits(a, b, n) for a, b in pairs]
+            cols = list(zip(*res)) if res else ([], [], [])
+            out[n] = tuple(np.asarray(c, dtype=np.int64) for c in cols)
+        return out
+    a_flat, a_off, b_flat, b_off = _flatten_pairs(pairs)
+    p = ctypes.POINTER(ctypes.c_int64)
+    out = {}
+    for n in ns:
+        hits = np.zeros(len(pairs), dtype=np.int64)
+        a_cnt = np.zeros(len(pairs), dtype=np.int64)
+        b_cnt = np.zeros(len(pairs), dtype=np.int64)
+        lib.tm_ngram_hits_batch(
+            a_flat.ctypes.data_as(p),
+            a_off.ctypes.data_as(p),
+            b_flat.ctypes.data_as(p),
+            b_off.ctypes.data_as(p),
+            len(pairs),
+            n,
+            hits.ctypes.data_as(p),
+            a_cnt.ctypes.data_as(p),
+            b_cnt.ctypes.data_as(p),
+        )
+        out[n] = (hits, a_cnt, b_cnt)
+    return out
+
+
+def batch_ngram_hits(
+    pairs: Sequence[Tuple[Sequence, Sequence]], n: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Single-n convenience wrapper over :func:`batch_ngram_hits_multi`."""
+    return batch_ngram_hits_multi(pairs, [n])[n]
 
 
 def pesq_batch(ref: np.ndarray, deg: np.ndarray, fs: int, wideband: bool) -> Optional[np.ndarray]:
