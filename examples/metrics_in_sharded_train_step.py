@@ -29,20 +29,38 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
 
 
 def main() -> None:
     num_classes, batch, dim = 5, 64, 16
     mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
-    acc = MulticlassAccuracy(num_classes=num_classes, sync_axis="data")
-    f1 = MulticlassF1Score(num_classes=num_classes, sync_axis="data")
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=num_classes),
+            "f1": MulticlassF1Score(num_classes=num_classes),
+            "precision": MulticlassPrecision(num_classes=num_classes),
+            "recall": MulticlassRecall(num_classes=num_classes),
+        }
+    )
 
     rng = np.random.RandomState(0)
     w = jnp.asarray(rng.randn(dim, num_classes).astype(np.float32) * 0.1)
     x = jnp.asarray(rng.randn(batch, dim).astype(np.float32))
     y = jnp.asarray(rng.randint(0, num_classes, size=(batch,)))
+
+    # one eager probe before tracing: f1/precision/recall merge into a single
+    # compute group, so the compiled step runs TWO updates (and two psum sets)
+    # for the four metrics — the reference's compute-group saving, in-trace
+    coll.resolve_compute_groups(x @ w, y)
+    print("compute groups:", dict(coll.compute_groups))
 
     @jax.jit
     def train_step(w, x, y):
@@ -56,31 +74,30 @@ def main() -> None:
             grads = jax.lax.pmean(grads, "data")
             w = w - 0.1 * grads
             logits = x @ w
-            # fresh per-batch metric states, psum-synced inside the trace; the
-            # host folds them into the run state with the declared-reduction
+            # fresh per-batch collection states, psum-synced inside the trace;
+            # the host folds them into the run state with the declared-reduction
             # merge. (Syncing a state that is carried across steps would re-psum
             # already-global totals — never do that.)
-            acc_b = acc.functional_sync(acc.functional_update(acc.init_state(), logits, y), "data")
-            f1_b = f1.functional_sync(f1.functional_update(f1.init_state(), logits, y), "data")
-            return w, loss, acc_b, f1_b
+            states_b = coll.functional_update(coll.functional_init(), logits, y)
+            states_b = coll.functional_sync(states_b, "data")
+            return w, loss, states_b
 
         return shard_map(
             step,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P()),
             check_vma=False,
         )(w, x, y)
 
-    acc_state = f1_state = None
+    run_states = None
     for step_idx in range(3):
-        w, loss, acc_b, f1_b = train_step(w, x, y)
-        acc_state = acc_b if acc_state is None else acc.merge_states(acc_state, acc_b)
-        f1_state = f1_b if f1_state is None else f1.merge_states(f1_state, f1_b)
+        w, loss, states_b = train_step(w, x, y)
+        run_states = states_b if run_states is None else coll.merge_states(run_states, states_b)
         print(f"step {step_idx}: loss={float(loss):.4f}")
 
-    print("accuracy:", float(acc.functional_compute(acc_state)))
-    print("f1:      ", float(f1.functional_compute(f1_state)))
+    for name, value in coll.functional_compute(run_states).items():
+        print(f"{name}: {float(value):.4f}")
 
 
 if __name__ == "__main__":

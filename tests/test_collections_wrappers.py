@@ -304,3 +304,16 @@ class TestFunctionalCollection:
         states = mc.functional_update(states, jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
         res = mc.functional_compute(states)
         assert set(res) == {"val_MulticlassAccuracy", "val_MulticlassPrecision", "val_MulticlassRecall"}
+
+    def test_collection_merge_states(self):
+        mc = self._make()
+        mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        a = mc.functional_update(mc.functional_init(), jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        b = mc.functional_update(mc.functional_init(), jnp.asarray(PREDS[1]), jnp.asarray(TARGET[1]))
+        merged = mc.merge_states(a, b)
+        res = mc.functional_compute(merged)
+        flat_p, flat_t = PREDS[:2].reshape(-1), TARGET[:2].reshape(-1)
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(flat_t, flat_p)) < 1e-6
+        assert (
+            abs(float(res["MulticlassRecall"]) - sk_recall(flat_t, flat_p, average="macro", zero_division=0)) < 1e-6
+        )

@@ -398,6 +398,16 @@ class MetricCollection:
                 result[name] = self._modules[name].functional_compute(st)
         return self._flatten_results(result)
 
+    def merge_states(
+        self,
+        a: Dict[str, Dict[str, Any]],
+        b: Dict[str, Dict[str, Any]],
+        counts: Optional[Tuple[int, int]] = None,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Merge two collection state pytrees per each leader's declared
+        reductions (the collection analogue of :meth:`Metric.merge_states`)."""
+        return {leader: self._modules[leader].merge_states(a[leader], b[leader], counts=counts) for leader in a}
+
     def functional_forward(
         self, states: Dict[str, Dict[str, Any]], *args: Any, update_count: Optional[int] = None, **kwargs: Any
     ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, Any]]:
