@@ -317,3 +317,185 @@ class TestFunctionalCollection:
         assert (
             abs(float(res["MulticlassRecall"]) - sk_recall(flat_t, flat_p, average="macro", zero_division=0)) < 1e-6
         )
+
+
+class TestFunctionalBootstrap:
+    """Vmapped bootstrap path: one traced update for all replicates."""
+
+    def test_explicit_indices_match_manual_copies(self):
+        import jax
+        from copy import deepcopy
+
+        base = BinaryAccuracy()
+        boot = BootStrapper(base, num_bootstraps=3, raw=True, sampling_strategy="multinomial")
+        rng2 = np.random.RandomState(3)
+        preds = jnp.asarray(rng2.rand(16).astype(np.float32))
+        target = jnp.asarray(rng2.randint(0, 2, 16))
+        idx = jnp.asarray(rng2.randint(0, 16, (3, 16)))
+
+        state = boot.functional_init()
+        state = boot.functional_update(state, preds, target, indices=idx)
+        out = boot.functional_compute(state)
+
+        manual = []
+        for b in range(3):
+            m = deepcopy(base)
+            m.update(preds[np.asarray(idx[b])], target[np.asarray(idx[b])])
+            manual.append(float(m.compute()))
+        np.testing.assert_allclose(np.asarray(out["raw"]), manual, atol=1e-6)
+        np.testing.assert_allclose(float(out["mean"]), np.mean(manual), atol=1e-6)
+        np.testing.assert_allclose(float(out["std"]), np.std(manual, ddof=1), atol=1e-5)
+
+    def test_jit_end_to_end_with_key(self):
+        import jax
+
+        boot = BootStrapper(
+            MeanMetric(), num_bootstraps=8, quantile=0.5, sampling_strategy="multinomial"
+        )
+        state0 = boot.functional_init()
+
+        @jax.jit
+        def step(state, vals, key):
+            return boot.functional_update(state, vals, key=key)
+
+        vals = jnp.asarray(np.arange(32, dtype=np.float32))
+        state = step(state0, vals, jax.random.PRNGKey(0))
+        state = step(state, vals + 1.0, jax.random.PRNGKey(1))
+        out = boot.functional_compute(state)
+        # resampled means of values centered near 16 stay in a tight band
+        assert 10.0 < float(out["mean"]) < 22.0
+        assert float(out["std"]) >= 0.0
+        assert out["quantile"].shape == ()
+
+    def test_poisson_strategy_rejected_and_key_required(self):
+        boot = BootStrapper(MeanMetric(), num_bootstraps=2)  # poisson default
+        state = boot.functional_init()
+        vals = jnp.asarray([1.0, 2.0])
+        with pytest.raises(ValueError, match="multinomial"):
+            import jax
+
+            boot.functional_update(state, vals, key=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="key"):
+            boot.functional_update(state, vals)
+        with pytest.raises(ValueError, match="shape"):
+            boot.functional_update(state, vals, indices=jnp.asarray([0, 1]))
+
+
+class TestFunctionalWrapperPaths:
+    """MinMax and Multioutput pure paths inside jitted steps."""
+
+    def test_minmax_functional_matches_oo(self):
+        import jax
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mm = MinMaxMetric(MeanSquaredError())
+        state = mm.functional_init()
+        rng2 = np.random.RandomState(4)
+        batches = [(jnp.asarray(rng2.rand(8).astype(np.float32)), jnp.asarray(rng2.rand(8).astype(np.float32))) for _ in range(3)]
+
+        fwd = jax.jit(mm.functional_forward)
+        raws = []
+        for p, t in batches:
+            state, out = fwd(state, p, t)
+            raws.append(float(out["raw"]))
+        res = mm.functional_compute(state)
+        # min/max fold every batch value; raw is the accumulated value
+        assert float(res["min"]) <= min(raws) + 1e-6
+        assert float(res["max"]) >= max(raws) - 1e-6
+        all_p = jnp.concatenate([p for p, _ in batches])
+        all_t = jnp.concatenate([t for _, t in batches])
+        expected = float(np.mean((np.asarray(all_p) - np.asarray(all_t)) ** 2))
+        np.testing.assert_allclose(float(res["raw"]), expected, rtol=1e-5)
+
+    def test_multioutput_functional_matches_oo(self):
+        import jax
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        rng2 = np.random.RandomState(5)
+        preds = rng2.rand(16, 3).astype(np.float32)
+        target = rng2.rand(16, 3).astype(np.float32)
+
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False)
+        state = mo.functional_init()
+        step = jax.jit(mo.functional_update)
+        state = step(state, jnp.asarray(preds[:8]), jnp.asarray(target[:8]))
+        state = step(state, jnp.asarray(preds[8:]), jnp.asarray(target[8:]))
+        got = np.asarray(mo.functional_compute(state))
+
+        oo = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+        oo.update(jnp.asarray(preds), jnp.asarray(target))
+        np.testing.assert_allclose(got, np.asarray(oo.compute()), rtol=1e-5)
+
+    def test_multioutput_functional_guards(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)  # remove_nans default True
+        with pytest.raises(ValueError, match="remove_nans=False"):
+            mo.functional_update(mo.functional_init(), jnp.ones((4, 2)), jnp.ones((4, 2)))
+        mo2 = MultioutputWrapper(MeanSquaredError(), num_outputs=4, remove_nans=False)
+        with pytest.raises(ValueError, match="Expected 4 outputs"):
+            mo2.functional_update(mo2.functional_init(), jnp.ones((4, 2)), jnp.ones((4, 2)))
+
+    def test_running_functional_matches_oo(self):
+        import jax
+
+        run = Running(SumMetric(), window=2)
+        state = run.functional_init()
+        step = jax.jit(run.functional_update)
+        vals = [1.0, 2.0, 3.0]
+        for v in vals:
+            state = step(state, jnp.asarray(v))
+        assert float(run.functional_compute(state)) == 5.0  # last two only
+
+        # partial fill and empty window
+        run2 = Running(SumMetric(), window=4)
+        s2 = run2.functional_init()
+        assert float(run2.functional_compute(s2)) == 0.0
+        s2 = run2.functional_update(s2, jnp.asarray(7.0))
+        assert float(run2.functional_compute(s2)) == 7.0
+
+        # mean-metric fold matches the OO window fold across a longer run
+        oo = Running(MeanMetric(), window=3)
+        fn = Running(MeanMetric(), window=3)
+        sf = fn.functional_init()
+        rng2 = np.random.RandomState(6)
+        for _ in range(5):
+            batch = jnp.asarray(rng2.rand(4).astype(np.float32))
+            oo.update(batch)
+            sf = fn.functional_update(sf, batch)
+        np.testing.assert_allclose(float(fn.functional_compute(sf)), float(oo.compute()), rtol=1e-6)
+
+    def test_running_functional_forward_and_cat_guard(self):
+        from torchmetrics_tpu import CatMetric
+
+        run = Running(SumMetric(), window=2)
+        state = run.functional_init()
+        state, batch_val = run.functional_forward(state, jnp.asarray(4.0))
+        assert float(batch_val) == 4.0
+        with pytest.raises(ValueError, match="sum/mean/max/min"):
+            Running(CatMetric(), window=2).functional_init()
+
+    def test_minmax_functional_update_absorbs_batch(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mm = MinMaxMetric(MeanSquaredError())
+        state = mm.functional_init()
+        p = jnp.asarray([1.0, 2.0]); t = jnp.asarray([1.0, 4.0])
+        state = mm.functional_update(state, p, t)
+        res = mm.functional_compute(state)
+        np.testing.assert_allclose(float(res["raw"]), 2.0, rtol=1e-6)
+        # eager base metric state untouched by the pure path
+        assert mm._base_metric._update_count == 0
+
+    def test_running_rejects_cat_reduction_tensor_state(self):
+        from torchmetrics_tpu.retrieval import RetrievalRecall
+
+        with pytest.raises(ValueError, match="cat"):
+            Running(RetrievalRecall(capacity=8), window=2).functional_init()
+
+    def test_multioutput_squeeze_guard(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False, squeeze_outputs=False)
+        with pytest.raises(ValueError, match="squeeze_outputs"):
+            mo.functional_update(mo.functional_init(), jnp.ones((4, 2)), jnp.ones((4, 2)))

@@ -4,6 +4,16 @@ from typing import Any
 from torchmetrics_tpu.metric import Metric
 
 
+def _stacked_init(base: Metric, n: int) -> Any:
+    """``n`` copies of the base default state stacked along a new leading axis —
+    the vmap-ready state layout shared by the wrappers' functional paths."""
+    import jax
+    import jax.numpy as jnp
+
+    states = [base.init_state() for _ in range(n)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
 class WrapperMetric(Metric):
     """Abstract base for wrappers; the wrapper itself never syncs (children do)."""
 

@@ -122,3 +122,77 @@ class BootStrapper(WrapperMetric):
         for m in self.metrics:
             m.reset()
         super().reset()
+
+    # ------------------------------------------------------ pure/functional API
+    #
+    # TPU-idiomatic bootstrap (SURVEY.md §7 step 5): instead of n deep copies
+    # fed by a host-side Python loop, the resample axis becomes a vmap axis —
+    # state leaves carry a leading ``num_bootstraps`` dimension and ONE vmapped
+    # update/compute serves every replicate inside a jitted step. Resampling
+    # must be static-shape under jit, so the functional path draws multinomial
+    # (with-replacement, size-n) index matrices; the poisson strategy's
+    # variable-length ``np.repeat`` resamples exist only on the eager OO path.
+
+    def functional_init(self) -> Dict[str, Any]:
+        """Fresh default state with a leading ``num_bootstraps`` axis per leaf."""
+        from torchmetrics_tpu.wrappers.abstract import _stacked_init
+
+        return _stacked_init(self.metrics[0], self.num_bootstraps)
+
+    def functional_update(
+        self, state: Dict[str, Any], *args: Any, key: Any = None, indices: Any = None, **kwargs: Any
+    ) -> Dict[str, Any]:
+        """Pure vmapped update: ``(stacked_state, batch) -> stacked_state'``.
+
+        Pass a ``jax.random`` ``key`` (multinomial strategy only — the static-
+        shape resample) or an explicit ``indices`` array of shape
+        ``(num_bootstraps, batch)`` selecting each replicate's resample.
+        """
+        import jax
+
+        base = self.metrics[0]
+        sizes = [a.shape[0] for a in args if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0]
+        sizes += [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0]
+        if not sizes:
+            raise ValueError("None of the input contained any tensor, so no sampling could be done")
+        size = sizes[0]
+        if indices is None:
+            if key is None:
+                raise ValueError("functional_update needs either a `key` or an explicit `indices` array")
+            if self.sampling_strategy != "multinomial":
+                raise ValueError(
+                    "The functional bootstrap path requires sampling_strategy='multinomial': poisson"
+                    " resamples have data-dependent length and cannot be traced with static shapes."
+                )
+            indices = jax.random.randint(key, (self.num_bootstraps, size), 0, size)
+        indices = jnp.asarray(indices)
+        if indices.ndim != 2 or indices.shape[0] != self.num_bootstraps:
+            raise ValueError(
+                f"Expected `indices` of shape (num_bootstraps={self.num_bootstraps}, n) but got {indices.shape}"
+            )
+
+        def _one(st: Dict[str, Any], idx: Array) -> Dict[str, Any]:
+            new_args = [a[idx] if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0 else a for a in args]
+            new_kwargs = {
+                k: v[idx] if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0 else v for k, v in kwargs.items()
+            }
+            return base.functional_update(st, *new_args, **new_kwargs)
+
+        return jax.vmap(_one)(state, indices)
+
+    def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        """Mean/std/quantile/raw across the vmapped replicate axis."""
+        import jax
+
+        base = self.metrics[0]
+        vals = jax.vmap(base.functional_compute)(state)
+        output_dict: Dict[str, Array] = {}
+        if self.mean:
+            output_dict["mean"] = jax.tree_util.tree_map(lambda v: v.mean(0), vals)
+        if self.std:
+            output_dict["std"] = jax.tree_util.tree_map(lambda v: v.std(0, ddof=1), vals)
+        if self.quantile is not None:
+            output_dict["quantile"] = jax.tree_util.tree_map(lambda v: jnp.quantile(v, self.quantile, axis=0), vals)
+        if self.raw:
+            output_dict["raw"] = vals
+        return output_dict

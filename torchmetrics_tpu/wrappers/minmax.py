@@ -77,3 +77,50 @@ class MinMaxMetric(WrapperMetric):
     def reset(self) -> None:
         super().reset()
         self._base_metric.reset()
+
+    # ------------------------------------------------------ pure/functional API
+
+    def functional_init(self) -> Dict[str, Any]:
+        """Fresh wrapper state: base metric state + running extrema."""
+        return {
+            "base": self._base_metric.init_state(),
+            "min_val": jnp.asarray(jnp.inf),
+            "max_val": jnp.asarray(-jnp.inf),
+        }
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure update: absorb the batch into the base state.
+
+        Mirrors the OO ``update`` — extrema move only on forward/compute
+        (they track *computed* values, reference minmax.py:66-79).
+        """
+        base_batch = self._base_metric.functional_update(self._base_metric.init_state(), *args, **kwargs)
+        return {
+            "base": self._base_metric.merge_states(state["base"], base_batch),
+            "min_val": state["min_val"],
+            "max_val": state["max_val"],
+        }
+
+    def functional_forward(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> tuple:
+        """Pure forward: ``(state, batch) -> (state', {'raw','min','max'})``.
+
+        The batch value is the base metric on the batch alone; extrema fold the
+        batch value in; the base state keeps the global accumulation.
+        """
+        base_batch = self._base_metric.functional_update(self._base_metric.init_state(), *args, **kwargs)
+        batch_val = jnp.asarray(self._base_metric.functional_compute(base_batch))
+        new_state = {
+            "base": self._base_metric.merge_states(state["base"], base_batch),
+            "min_val": jnp.minimum(state["min_val"], batch_val.astype(jnp.float32)),
+            "max_val": jnp.maximum(state["max_val"], batch_val.astype(jnp.float32)),
+        }
+        return new_state, {"raw": batch_val, "max": new_state["max_val"], "min": new_state["min_val"]}
+
+    def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        """Accumulated base value with extrema folded over it (jit-safe)."""
+        val = jnp.asarray(self._base_metric.functional_compute(state["base"]))
+        return {
+            "raw": val,
+            "max": jnp.maximum(state["max_val"], val.astype(jnp.float32)),
+            "min": jnp.minimum(state["min_val"], val.astype(jnp.float32)),
+        }

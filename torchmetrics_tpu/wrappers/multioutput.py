@@ -108,3 +108,65 @@ class MultioutputWrapper(WrapperMetric):
         for metric in self.metrics:
             metric.reset()
         super().reset()
+
+    # ------------------------------------------------------ pure/functional API
+    #
+    # The output axis becomes a vmap axis: state leaves carry a leading
+    # ``num_outputs`` dimension and one vmapped update/compute serves every
+    # output — no per-output Python loop inside the traced step. NaN-row
+    # removal is data-dependent shape, so it stays on the eager OO path;
+    # construct with ``remove_nans=False`` to use the functional API.
+
+    def functional_init(self) -> Any:
+        """Fresh default state with a leading ``num_outputs`` axis per leaf."""
+        from torchmetrics_tpu.wrappers.abstract import _stacked_init
+
+        return _stacked_init(self.metrics[0], len(self.metrics))
+
+    def _vmap_payload(self, args: Tuple, kwargs: dict) -> Tuple[Any, Any]:
+        def prep(x: Any) -> Any:
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) > 0:
+                moved = jnp.moveaxis(jnp.asarray(x), self.output_dim, 0)
+                if moved.shape[0] != len(self.metrics):
+                    raise ValueError(
+                        f"Expected {len(self.metrics)} outputs along dim {self.output_dim}"
+                        f" but got {moved.shape[0]}"
+                    )
+                return moved
+            return x
+
+        payload = (tuple(prep(a) for a in args), {k: prep(v) for k, v in kwargs.items()})
+        import jax
+
+        axes = jax.tree_util.tree_map(
+            lambda x: 0 if hasattr(x, "shape") and getattr(x, "ndim", 0) > 0 else None, payload
+        )
+        return payload, axes
+
+    def functional_update(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        """Pure vmapped update over the output axis: ``(stacked_state, batch) -> stacked_state'``."""
+        if self.remove_nans:
+            raise ValueError(
+                "The functional path requires remove_nans=False: NaN-row removal changes shapes"
+                " per output and cannot be traced. Construct MultioutputWrapper(..., remove_nans=False)."
+            )
+        if not self.squeeze_outputs:
+            raise ValueError(
+                "The functional path requires squeeze_outputs=True: vmapping over the output"
+                " axis always removes it, so a kept size-1 axis cannot be honored."
+            )
+        import jax
+
+        base = self.metrics[0]
+        payload, axes = self._vmap_payload(args, kwargs)
+
+        def _one(st: Any, p: Tuple) -> Any:
+            return base.functional_update(st, *p[0], **p[1])
+
+        return jax.vmap(_one, in_axes=(0, axes))(state, payload)
+
+    def functional_compute(self, state: Any) -> Array:
+        """Stacked per-output values, matching :meth:`compute`'s layout."""
+        import jax
+
+        return jax.vmap(self.metrics[0].functional_compute)(state)

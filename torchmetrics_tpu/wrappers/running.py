@@ -74,3 +74,80 @@ class Running(WrapperMetric):
         super().reset()
         self._window_states = []
         self.base_metric.reset()
+
+    # ------------------------------------------------------ pure/functional API
+    #
+    # The window becomes a static leading axis: state leaves are
+    # ``(window, ...)`` rings, an update shifts the newest batch state in (and
+    # the oldest out), and compute folds the filled slots oldest-to-newest with
+    # the base merge protocol under a validity mask — all trace-safe, so a
+    # running metric lives inside a jitted train step. Tensor states only
+    # (list/"cat" states have per-slot dynamic shapes).
+
+    def functional_init(self) -> Any:
+        """Fresh ring state: ``window``-stacked default states + fill count."""
+        import jax
+        import jax.numpy as jnp
+
+        base = self.base_metric
+        bad = [
+            name
+            for name, fx in base._reductions.items()
+            if isinstance(base._defaults.get(name), list) or fx not in ("sum", "mean", "max", "min")
+        ]
+        if bad:
+            raise ValueError(
+                "The functional Running path supports tensor states with sum/mean/max/min"
+                f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
+                " merges change leaf shapes and cannot form a static ring buffer."
+            )
+        states = [base.init_state() for _ in range(self.window)]
+        return {
+            "slots": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states),
+            "count": jnp.asarray(0, jnp.int32),
+        }
+
+    def functional_update(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        """Pure update: shift the batch state into the newest ring slot."""
+        new_state, _ = self._functional_step(state, *args, **kwargs)
+        return new_state
+
+    def functional_forward(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        """Pure forward: ``(state, batch) -> (state', batch_value)``."""
+        return self._functional_step(state, *args, compute_batch=True, **kwargs)
+
+    def _functional_step(self, state: Any, *args: Any, compute_batch: bool = False, **kwargs: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        base = self.base_metric
+        batch_state = base.functional_update(base.init_state(), *args, **kwargs)
+        slots = jax.tree_util.tree_map(
+            lambda s, b: jnp.concatenate([s[1:], b[None]], axis=0), state["slots"], batch_state
+        )
+        new_state = {"slots": slots, "count": state["count"] + 1}
+        batch_val = base.functional_compute(batch_state) if compute_batch else None
+        return new_state, batch_val
+
+    def functional_compute(self, state: Any) -> Any:
+        """Fold filled ring slots oldest-to-newest via the base merge protocol."""
+        import jax
+        import jax.numpy as jnp
+
+        base = self.base_metric
+        slots, count = state["slots"], state["count"]
+        n_valid = jnp.minimum(count, self.window)
+        # slot i holds the (window - i)-th most recent update; valid slots are
+        # the contiguous tail i >= window - n_valid
+        acc = jax.tree_util.tree_map(lambda s: s[0], slots)
+        started = 0 >= self.window - n_valid
+        for i in range(1, self.window):
+            slot_i = jax.tree_util.tree_map(lambda s: s[i], slots)
+            valid_i = i >= self.window - n_valid
+            merged = base.merge_states(acc, slot_i)
+            take_merged = started & valid_i
+            acc = jax.tree_util.tree_map(
+                lambda m, s, a: jnp.where(take_merged, m, jnp.where(valid_i, s, a)), merged, slot_i, acc
+            )
+            started = started | valid_i
+        return base.functional_compute(acc)
