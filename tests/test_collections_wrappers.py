@@ -499,3 +499,156 @@ class TestFunctionalWrapperPaths:
         mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False, squeeze_outputs=False)
         with pytest.raises(ValueError, match="squeeze_outputs"):
             mo.functional_update(mo.functional_init(), jnp.ones((4, 2)), jnp.ones((4, 2)))
+
+    def test_classwise_functional(self):
+        import jax
+        from torchmetrics_tpu.classification import MulticlassAccuracy as MCA
+
+        cw = ClasswiseWrapper(MCA(num_classes=3, average=None), labels=["a", "b", "c"])
+        state = cw.functional_init()
+        preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.4, 0.3]])
+        target = jnp.asarray([0, 1, 2, 0])
+        state = jax.jit(cw.functional_update)(state, preds, target)
+        res = cw.functional_compute(state)
+        assert set(res) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+        assert float(res["multiclassaccuracy_a"]) == 0.5
+
+    def test_multitask_functional(self):
+        import jax
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mt = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        states = mt.functional_init()
+        preds = jnp.asarray([0.2, 0.8, 0.3, 0.6]); target = jnp.asarray([0, 1, 1, 0])
+        step = jax.jit(mt.functional_update)
+        states = step(states, {"cls": preds, "reg": preds}, {"cls": target, "reg": target.astype(jnp.float32)})
+        res = mt.functional_compute(states)
+        assert abs(float(res["cls"]) - 0.5) < 1e-6
+        assert abs(float(res["reg"]) - 0.2325) < 1e-4
+        with pytest.raises(ValueError, match="same keys"):
+            mt.functional_update(states, {"cls": preds}, {"cls": target})
+
+    def test_wrapper_functional_sync_on_mesh(self):
+        """BootStrapper/Multioutput/Running/MinMax functional_sync produce
+        globally-correct values inside a shard_map step."""
+        import jax
+        from functools import partial
+        from jax.sharding import Mesh, PartitionSpec as P
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mesh = Mesh(np.array(__import__("jax").devices()[:8]), ("data",))
+        rng2 = np.random.RandomState(8)
+        preds = jnp.asarray(rng2.rand(64).astype(np.float32))
+        target = jnp.asarray(rng2.rand(64).astype(np.float32))
+        mo_preds = jnp.asarray(rng2.rand(64, 2).astype(np.float32))
+        mo_target = jnp.asarray(rng2.rand(64, 2).astype(np.float32))
+        idx = jnp.asarray(rng2.randint(0, 8, (4, 8)))  # per-shard resample
+
+        boot = BootStrapper(MeanMetric(), num_bootstraps=4, raw=True, sampling_strategy="multinomial")
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+        run = Running(MeanSquaredError(), window=2)
+        mm = MinMaxMetric(MeanSquaredError())
+        b0, m0, r0, x0 = boot.functional_init(), mo.functional_init(), run.functional_init(), mm.functional_init()
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data"), P("data"), P("data")), out_specs=P(), check_vma=False)
+        def step(p, t, mp, mt_):
+            bs = boot.functional_sync(boot.functional_update(b0, p, indices=idx), "data")
+            ms = mo.functional_sync(mo.functional_update(m0, mp, mt_), "data")
+            rs = run.functional_sync(run.functional_update(r0, p, t), "data")
+            xs = mm.functional_sync(mm.functional_forward(x0, p, t)[0], "data")
+            return (
+                boot.functional_compute(bs)["mean"],
+                mo.functional_compute(ms),
+                run.functional_compute(rs),
+                mm.functional_compute(xs),
+            )
+
+        boot_mean, mo_vals, run_val, mm_vals = step(preds, target, mo_preds, mo_target)
+        # multioutput + running + minmax raw all equal the full-batch MSE
+        expected_mo = ((np.asarray(mo_preds) - np.asarray(mo_target)) ** 2).mean(0)
+        np.testing.assert_allclose(np.asarray(mo_vals), expected_mo, rtol=1e-5)
+        expected_mse = float(np.mean((np.asarray(preds) - np.asarray(target)) ** 2))
+        np.testing.assert_allclose(float(run_val), expected_mse, rtol=1e-5)
+        np.testing.assert_allclose(float(mm_vals["raw"]), expected_mse, rtol=1e-5)
+        assert np.isfinite(float(boot_mean))
+
+    def test_running_mean_uniform_window_weighting(self):
+        """A 'mean'-reduced custom state must average uniformly over the window."""
+        from torchmetrics_tpu.metric import Metric as BaseMetric
+        import jax.numpy as jnp2
+
+        class MeanState(BaseMetric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp2.asarray(0.0), dist_reduce_fx="mean")
+
+            def update(self, v):
+                self.x = jnp2.asarray(v, dtype=jnp2.float32)
+
+            def compute(self):
+                return self.x
+
+        run = Running(MeanState(), window=3)
+        s = run.functional_init()
+        oo = Running(MeanState(), window=3)
+        for v in (1.0, 2.0, 3.0):
+            s = run.functional_update(s, v)
+            oo.update(jnp.asarray(v))
+        np.testing.assert_allclose(float(run.functional_compute(s)), 2.0, rtol=1e-6)
+        np.testing.assert_allclose(float(oo.compute()), 2.0, rtol=1e-6)
+
+    def test_minmax_functional_guards_full_state_update(self):
+        from torchmetrics_tpu.metric import Metric as BaseMetric
+
+        class FullState(BaseMetric):
+            full_state_update = True
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, v):
+                self.x = self.x + v
+
+            def compute(self):
+                return self.x
+
+        with pytest.raises(ValueError, match="full_state_update=False"):
+            MinMaxMetric(FullState()).functional_init()
+
+    def test_minmax_first_batch_replaces_default_for_mean_states(self):
+        from torchmetrics_tpu.metric import Metric as BaseMetric
+        import jax.numpy as jnp2
+
+        class MeanState(BaseMetric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp2.asarray(0.0), dist_reduce_fx="mean")
+
+            def update(self, v):
+                self.x = jnp2.asarray(v, dtype=jnp2.float32)
+
+            def compute(self):
+                return self.x
+
+        mm = MinMaxMetric(MeanState())
+        s = mm.functional_init()
+        s = mm.functional_update(s, 3.0)
+        assert float(mm.functional_compute(s)["raw"]) == 3.0  # not diluted to 1.5
+        s = mm.functional_update(s, 1.0)
+        assert abs(float(mm.functional_compute(s)["raw"]) - 2.0) < 1e-6
+
+    def test_stacked_init_rejects_cat_states(self):
+        from torchmetrics_tpu import CatMetric
+
+        with pytest.raises(ValueError, match="list"):
+            BootStrapper(CatMetric(), num_bootstraps=2, sampling_strategy="multinomial").functional_init()
+        with pytest.raises(ValueError, match="list"):
+            MultioutputWrapper(CatMetric(), num_outputs=2, remove_nans=False).functional_init()
+        with pytest.raises(ValueError, match="sum/mean/max/min"):
+            MinMaxMetric(CatMetric()).functional_init()

@@ -442,6 +442,11 @@ class Metric:
         """A fresh default state pytree (the pure analogue of ``reset``)."""
         return {k: (list(v) if isinstance(v, list) else jnp.asarray(v)) for k, v in copy.deepcopy(self._defaults).items()}
 
+    def functional_init(self) -> Dict[str, Any]:
+        """Alias of :meth:`init_state` — the uniform functional-protocol name
+        shared with ``MetricCollection`` and the wrapper family."""
+        return self.init_state()
+
     def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``(state, batch) -> state'``. jit/vmap/shard_map-safe.
 

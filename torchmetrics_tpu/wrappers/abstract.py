@@ -10,6 +10,13 @@ def _stacked_init(base: Metric, n: int) -> Any:
     import jax
     import jax.numpy as jnp
 
+    bad = [name for name, default in base._defaults.items() if isinstance(default, list)]
+    if bad:
+        raise ValueError(
+            f"{type(base).__name__} holds list ('cat') state(s) {bad} whose per-update"
+            " dynamic shapes cannot be stacked into a static replicate axis; the functional"
+            " wrapper paths require tensor states (e.g. capacity-buffered variants)."
+        )
     states = [base.init_state() for _ in range(n)]
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
 

@@ -151,11 +151,6 @@ class BootStrapper(WrapperMetric):
         import jax
 
         base = self.metrics[0]
-        sizes = [a.shape[0] for a in args if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0]
-        sizes += [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0]
-        if not sizes:
-            raise ValueError("None of the input contained any tensor, so no sampling could be done")
-        size = sizes[0]
         if indices is None:
             if key is None:
                 raise ValueError("functional_update needs either a `key` or an explicit `indices` array")
@@ -164,6 +159,11 @@ class BootStrapper(WrapperMetric):
                     "The functional bootstrap path requires sampling_strategy='multinomial': poisson"
                     " resamples have data-dependent length and cannot be traced with static shapes."
                 )
+            sizes = [a.shape[0] for a in args if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0]
+            sizes += [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0]
+            if not sizes:
+                raise ValueError("None of the input contained any tensor, so no sampling could be done")
+            size = sizes[0]
             indices = jax.random.randint(key, (self.num_bootstraps, size), 0, size)
         indices = jnp.asarray(indices)
         if indices.ndim != 2 or indices.shape[0] != self.num_bootstraps:
@@ -179,6 +179,13 @@ class BootStrapper(WrapperMetric):
             return base.functional_update(st, *new_args, **new_kwargs)
 
         return jax.vmap(_one)(state, indices)
+
+    def functional_sync(self, state: Dict[str, Any], axis_name: Any = None) -> Dict[str, Any]:
+        """Per-replicate declared-collective sync, vmapped over the resample axis."""
+        import jax
+
+        base = self.metrics[0]
+        return jax.vmap(lambda st: base.functional_sync(st, axis_name))(state)
 
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Mean/std/quantile/raw across the vmapped replicate axis."""

@@ -60,3 +60,18 @@ class ClasswiseWrapper(WrapperMetric):
 
     def reset(self) -> None:
         self.metric.reset()
+
+    # ------------------------------------------------------ pure/functional API
+    # state IS the base metric's state; only the compute output is relabeled
+
+    def functional_init(self) -> Dict[str, Any]:
+        return self.metric.init_state()
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.metric.functional_update(state, *args, **kwargs)
+
+    def functional_sync(self, state: Dict[str, Any], axis_name: Any = None) -> Dict[str, Any]:
+        return self.metric.functional_sync(state, axis_name)
+
+    def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
+        return self._convert(self.metric.functional_compute(state))

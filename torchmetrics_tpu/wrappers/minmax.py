@@ -79,26 +79,66 @@ class MinMaxMetric(WrapperMetric):
         self._base_metric.reset()
 
     # ------------------------------------------------------ pure/functional API
+    #
+    # Extrema are data, not side effects, on this path: they move when a value
+    # is *produced into the state* — i.e. on ``functional_forward`` (batch
+    # values). ``functional_compute`` is a pure read: it folds the current
+    # accumulated value into the reported extrema but cannot persist that fold
+    # (call ``functional_forward``, or carry the returned state, if you need
+    # compute-time values tracked like the OO ``compute`` does via ``_track``).
 
     def functional_init(self) -> Dict[str, Any]:
-        """Fresh wrapper state: base metric state + running extrema."""
+        """Fresh wrapper state: base metric state + running extrema + count."""
+        if self._base_metric.full_state_update is not False:
+            raise ValueError(
+                "The functional MinMaxMetric path requires a base metric with"
+                " full_state_update=False: its update is decomposed into fresh-batch-state"
+                f" + merge, but {type(self._base_metric).__name__}.full_state_update is"
+                f" {self._base_metric.full_state_update}."
+            )
+        bad = [
+            name
+            for name, fx in self._base_metric._reductions.items()
+            if isinstance(self._base_metric._defaults.get(name), list) or fx not in ("sum", "mean", "max", "min")
+        ]
+        if bad:
+            raise ValueError(
+                "The functional MinMaxMetric path supports tensor states with sum/mean/max/min"
+                f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
+                " merges change leaf shapes and cannot be carried through a traced step."
+            )
         return {
             "base": self._base_metric.init_state(),
             "min_val": jnp.asarray(jnp.inf),
             "max_val": jnp.asarray(-jnp.inf),
+            "count": jnp.asarray(0, jnp.int32),
         }
 
+    def _absorb(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> tuple:
+        import jax
+
+        base_batch = self._base_metric.functional_update(self._base_metric.init_state(), *args, **kwargs)
+        merged = self._base_metric.merge_states(
+            state["base"], base_batch, counts=(jnp.maximum(state["count"], 1), 1)
+        )
+        # the very first batch must REPLACE the default state, not average with
+        # it — a phantom (1,1)-weighted default would dilute "mean" states
+        is_first = state["count"] == 0
+        merged = jax.tree_util.tree_map(lambda b, m: jnp.where(is_first, b, m), base_batch, merged)
+        return base_batch, merged
+
     def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Pure update: absorb the batch into the base state.
+        """Pure update: absorb the batch into the base state (count-weighted).
 
         Mirrors the OO ``update`` — extrema move only on forward/compute
         (they track *computed* values, reference minmax.py:66-79).
         """
-        base_batch = self._base_metric.functional_update(self._base_metric.init_state(), *args, **kwargs)
+        _, merged = self._absorb(state, *args, **kwargs)
         return {
-            "base": self._base_metric.merge_states(state["base"], base_batch),
+            "base": merged,
             "min_val": state["min_val"],
             "max_val": state["max_val"],
+            "count": state["count"] + 1,
         }
 
     def functional_forward(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> tuple:
@@ -107,17 +147,39 @@ class MinMaxMetric(WrapperMetric):
         The batch value is the base metric on the batch alone; extrema fold the
         batch value in; the base state keeps the global accumulation.
         """
-        base_batch = self._base_metric.functional_update(self._base_metric.init_state(), *args, **kwargs)
+        base_batch, merged = self._absorb(state, *args, **kwargs)
         batch_val = jnp.asarray(self._base_metric.functional_compute(base_batch))
         new_state = {
-            "base": self._base_metric.merge_states(state["base"], base_batch),
+            "base": merged,
             "min_val": jnp.minimum(state["min_val"], batch_val.astype(jnp.float32)),
             "max_val": jnp.maximum(state["max_val"], batch_val.astype(jnp.float32)),
+            "count": state["count"] + 1,
         }
         return new_state, {"raw": batch_val, "max": new_state["max_val"], "min": new_state["min_val"]}
 
+    def functional_sync(self, state: Dict[str, Any], axis_name: Any = None) -> Dict[str, Any]:
+        """Declared-collective sync: base state by its own reductions, extrema
+        by min/max (matching the OO states' dist_reduce_fx, minmax.py:38-39)."""
+        from torchmetrics_tpu.parallel.sync import sync_states
+
+        axis = axis_name or self.sync_axis
+        extrema = sync_states(
+            {"min_val": state["min_val"], "max_val": state["max_val"], "count": state["count"]},
+            {"min_val": "min", "max_val": "max", "count": "sum"},
+            axis,
+        )
+        return {
+            "base": self._base_metric.functional_sync(state["base"], axis),
+            "min_val": extrema["min_val"],
+            "max_val": extrema["max_val"],
+            # summed: after sync the base state holds global totals, so future
+            # count-weighted merges must weigh it by the global update count
+            "count": extrema["count"],
+        }
+
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
-        """Accumulated base value with extrema folded over it (jit-safe)."""
+        """Accumulated base value with extrema folded over it — a pure read:
+        the fold is reported but NOT persisted (see the class-path note above)."""
         val = jnp.asarray(self._base_metric.functional_compute(state["base"]))
         return {
             "raw": val,

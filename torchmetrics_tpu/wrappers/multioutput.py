@@ -165,6 +165,13 @@ class MultioutputWrapper(WrapperMetric):
 
         return jax.vmap(_one, in_axes=(0, axes))(state, payload)
 
+    def functional_sync(self, state: Any, axis_name: Any = None) -> Any:
+        """Per-output declared-collective sync, vmapped over the output axis."""
+        import jax
+
+        base = self.metrics[0]
+        return jax.vmap(lambda st: base.functional_sync(st, axis_name))(state)
+
     def functional_compute(self, state: Any) -> Array:
         """Stacked per-output values, matching :meth:`compute`'s layout."""
         import jax

@@ -75,6 +75,29 @@ class MultitaskWrapper(WrapperMetric):
             metric.reset()
         super().reset()
 
+    # ------------------------------------------------------ pure/functional API
+    # states are a dict keyed by task; each task delegates to its metric's (or
+    # collection's) own pure core
+
+    def functional_init(self) -> Dict[str, Any]:
+        return {task: m.functional_init() for task, m in self.task_metrics.items()}
+
+    def functional_update(
+        self, states: Dict[str, Any], task_preds: Dict[str, Any], task_targets: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        self._check_all_tasks_present(task_preds)
+        self._check_all_tasks_present(task_targets)
+        return {
+            task: m.functional_update(states[task], task_preds[task], task_targets[task])
+            for task, m in self.task_metrics.items()
+        }
+
+    def functional_sync(self, states: Dict[str, Any], axis_name: Any = None) -> Dict[str, Any]:
+        return {task: m.functional_sync(states[task], axis_name) for task, m in self.task_metrics.items()}
+
+    def functional_compute(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        return {task: m.functional_compute(states[task]) for task, m in self.task_metrics.items()}
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
         import copy
 

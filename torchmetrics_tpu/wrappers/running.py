@@ -62,12 +62,16 @@ class Running(WrapperMetric):
         return batch_val
 
     def compute(self) -> Any:
-        """Fold window states with the base metric's merge protocol."""
+        """Fold window states with the base metric's merge protocol.
+
+        Count-weighted (``counts=(k, 1)``): each snapshot holds one update, so
+        "mean"-reduced states average uniformly over the window.
+        """
         if not self._window_states:
             return self.base_metric.functional_compute(self.base_metric.init_state())
         acc = self._window_states[0]
-        for st in self._window_states[1:]:
-            acc = self.base_metric.merge_states(acc, st)
+        for k, st in enumerate(self._window_states[1:], start=1):
+            acc = self.base_metric.merge_states(acc, st, counts=(k, 1))
         return self.base_metric.functional_compute(acc)
 
     def reset(self) -> None:
@@ -101,9 +105,10 @@ class Running(WrapperMetric):
                 f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
                 " merges change leaf shapes and cannot form a static ring buffer."
             )
-        states = [base.init_state() for _ in range(self.window)]
+        from torchmetrics_tpu.wrappers.abstract import _stacked_init
+
         return {
-            "slots": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states),
+            "slots": _stacked_init(base, self.window),
             "count": jnp.asarray(0, jnp.int32),
         }
 
@@ -129,8 +134,21 @@ class Running(WrapperMetric):
         batch_val = base.functional_compute(batch_state) if compute_batch else None
         return new_state, batch_val
 
+    def functional_sync(self, state: Any, axis_name: Any = None) -> Any:
+        """Per-slot declared-collective sync, vmapped over the window axis."""
+        import jax
+
+        base = self.base_metric
+        slots = jax.vmap(lambda st: base.functional_sync(st, axis_name))(state["slots"])
+        return {"slots": slots, "count": state["count"]}
+
     def functional_compute(self, state: Any) -> Any:
-        """Fold filled ring slots oldest-to-newest via the base merge protocol."""
+        """Fold filled ring slots oldest-to-newest via the base merge protocol.
+
+        The fold is count-weighted (``counts=(k, 1)``): each slot holds exactly
+        one update, so "mean"-reduced states come out uniformly weighted over
+        the window rather than exponentially decayed.
+        """
         import jax
         import jax.numpy as jnp
 
@@ -141,13 +159,15 @@ class Running(WrapperMetric):
         # the contiguous tail i >= window - n_valid
         acc = jax.tree_util.tree_map(lambda s: s[0], slots)
         started = 0 >= self.window - n_valid
+        n_acc = started.astype(jnp.int32)
         for i in range(1, self.window):
             slot_i = jax.tree_util.tree_map(lambda s: s[i], slots)
             valid_i = i >= self.window - n_valid
-            merged = base.merge_states(acc, slot_i)
+            merged = base.merge_states(acc, slot_i, counts=(jnp.maximum(n_acc, 1), 1))
             take_merged = started & valid_i
             acc = jax.tree_util.tree_map(
                 lambda m, s, a: jnp.where(take_merged, m, jnp.where(valid_i, s, a)), merged, slot_i, acc
             )
             started = started | valid_i
+            n_acc = n_acc + valid_i.astype(jnp.int32)
         return base.functional_compute(acc)
