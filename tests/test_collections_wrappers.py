@@ -652,3 +652,29 @@ class TestFunctionalWrapperPaths:
             MultioutputWrapper(CatMetric(), num_outputs=2, remove_nans=False).functional_init()
         with pytest.raises(ValueError, match="sum/mean/max/min"):
             MinMaxMetric(CatMetric()).functional_init()
+
+    def test_wrapper_functional_sync_uses_sync_axis_default(self):
+        import jax
+        from functools import partial
+        from jax.sharding import Mesh, PartitionSpec as P
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        run = Running(MeanSquaredError(), window=2, sync_axis="data")
+        r0 = run.functional_init()
+        p = jnp.asarray(np.random.RandomState(9).rand(64).astype(np.float32))
+        t = jnp.asarray(np.random.RandomState(10).rand(64).astype(np.float32))
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        def step(p_, t_):
+            rs = run.functional_sync(run.functional_update(r0, p_, t_))  # no explicit axis
+            return run.functional_compute(rs)
+
+        expected = float(np.mean((np.asarray(p) - np.asarray(t)) ** 2))
+        np.testing.assert_allclose(float(step(p, t)), expected, rtol=1e-5)
+
+    def test_bootstrap_scalar_input_raises_even_with_indices(self):
+        boot = BootStrapper(MeanMetric(), num_bootstraps=2, sampling_strategy="multinomial")
+        with pytest.raises(ValueError, match="tensor"):
+            boot.functional_update(boot.functional_init(), 1.0, indices=jnp.zeros((2, 4), jnp.int32))

@@ -360,6 +360,20 @@ class MetricCollection:
         states compare equal — the same probe the OO ``update`` path performs on
         its first call (reference collections.py:228-262), made explicit so it
         can happen host-side before ``jit`` tracing. Idempotent.
+
+        Example:
+            >>> import jax, jax.numpy as jnp
+            >>> from torchmetrics_tpu import MetricCollection
+            >>> from torchmetrics_tpu.classification import MulticlassF1Score, MulticlassRecall
+            >>> coll = MetricCollection([MulticlassF1Score(num_classes=3), MulticlassRecall(num_classes=3)])
+            >>> preds, target = jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 2, 2, 1])
+            >>> groups = coll.resolve_compute_groups(preds, target)
+            >>> sorted(len(g) for g in groups.values())  # f1/recall share one stat-scores state
+            [2]
+            >>> states = coll.functional_init()
+            >>> states = jax.jit(coll.functional_update)(states, preds, target)
+            >>> {k: round(float(v), 4) for k, v in sorted(coll.functional_compute(states).items())}
+            {'MulticlassF1Score': 0.7778, 'MulticlassRecall': 0.8333}
         """
         if self._enable_compute_groups and not self._groups_checked:
             trial = {

@@ -79,8 +79,27 @@ def sync_value(value: Any, reduction: Reduction, axis_name: Union[str, Sequence[
 def sync_states(
     states: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: Union[str, Sequence[str]]
 ) -> Dict[str, Any]:
-    """Apply :func:`sync_value` to every state field. Pure; safe under jit."""
-    return {name: sync_value(value, reductions.get(name), axis_name) for name, value in states.items()}
+    """Apply the declared collectives to every state field. Pure; safe under jit.
+
+    Fields sharing a ``sum/mean/max/min`` reduction ride ONE fused collective
+    (``lax.psum`` & co. accept pytrees), so a metric with K scalar counters
+    costs one rendezvous, not K — the stat-scores tp/fp/tn/fn quartet syncs as
+    a single fused psum. Lists and ``cat``/callable/None reductions keep the
+    per-field :func:`sync_value` path.
+    """
+    fused_ops = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}
+    grouped: Dict[str, Dict[str, Any]] = {fx: {} for fx in fused_ops}
+    out: Dict[str, Any] = {}
+    for name, value in states.items():
+        fx = reductions.get(name)
+        if fx in fused_ops and not isinstance(value, (list, tuple)):
+            grouped[fx][name] = value
+        else:
+            out[name] = sync_value(value, fx, axis_name)
+    for fx, vals in grouped.items():
+        if vals:
+            out.update(fused_ops[fx](vals, axis_name))
+    return out
 
 
 def host_sync_value(value: Any, reduction: Reduction) -> Any:

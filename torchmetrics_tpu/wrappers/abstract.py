@@ -4,6 +4,22 @@ from typing import Any
 from torchmetrics_tpu.metric import Metric
 
 
+def _require_mergeable_tensor_states(base: Metric, path_name: str) -> None:
+    """Reject base metrics whose states cannot be carried through a traced
+    merge fold: list states and 'cat'/custom reductions change leaf shapes."""
+    bad = [
+        name
+        for name, fx in base._reductions.items()
+        if isinstance(base._defaults.get(name), list) or fx not in ("sum", "mean", "max", "min")
+    ]
+    if bad:
+        raise ValueError(
+            f"The functional {path_name} path supports tensor states with sum/mean/max/min"
+            f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
+            " merges change leaf shapes and cannot be carried through a traced step."
+        )
+
+
 def _stacked_init(base: Metric, n: int) -> Any:
     """``n`` copies of the base default state stacked along a new leading axis —
     the vmap-ready state layout shared by the wrappers' functional paths."""

@@ -147,10 +147,25 @@ class BootStrapper(WrapperMetric):
         Pass a ``jax.random`` ``key`` (multinomial strategy only — the static-
         shape resample) or an explicit ``indices`` array of shape
         ``(num_bootstraps, batch)`` selecting each replicate's resample.
+
+        Example:
+            >>> import jax, jax.numpy as jnp
+            >>> from torchmetrics_tpu import BootStrapper, MeanMetric
+            >>> boot = BootStrapper(MeanMetric(), num_bootstraps=4, sampling_strategy="multinomial")
+            >>> state = boot.functional_init()
+            >>> state = jax.jit(boot.functional_update)(
+            ...     state, jnp.asarray([1.0, 2.0, 3.0, 4.0]), key=jax.random.PRNGKey(0))
+            >>> out = boot.functional_compute(state)
+            >>> sorted(out) == ['mean', 'std'] and bool(out['std'] >= 0)
+            True
         """
         import jax
 
         base = self.metrics[0]
+        sizes = [a.shape[0] for a in args if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0]
+        sizes += [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0]
+        if not sizes:
+            raise ValueError("None of the input contained any tensor, so no sampling could be done")
         if indices is None:
             if key is None:
                 raise ValueError("functional_update needs either a `key` or an explicit `indices` array")
@@ -159,10 +174,6 @@ class BootStrapper(WrapperMetric):
                     "The functional bootstrap path requires sampling_strategy='multinomial': poisson"
                     " resamples have data-dependent length and cannot be traced with static shapes."
                 )
-            sizes = [a.shape[0] for a in args if hasattr(a, "shape") and getattr(a, "ndim", 0) > 0]
-            sizes += [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and getattr(v, "ndim", 0) > 0]
-            if not sizes:
-                raise ValueError("None of the input contained any tensor, so no sampling could be done")
             size = sizes[0]
             indices = jax.random.randint(key, (self.num_bootstraps, size), 0, size)
         indices = jnp.asarray(indices)
@@ -185,7 +196,8 @@ class BootStrapper(WrapperMetric):
         import jax
 
         base = self.metrics[0]
-        return jax.vmap(lambda st: base.functional_sync(st, axis_name))(state)
+        axis = axis_name or self.sync_axis
+        return jax.vmap(lambda st: base.functional_sync(st, axis))(state)
 
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Mean/std/quantile/raw across the vmapped replicate axis."""

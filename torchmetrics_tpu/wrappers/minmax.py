@@ -96,17 +96,9 @@ class MinMaxMetric(WrapperMetric):
                 f" + merge, but {type(self._base_metric).__name__}.full_state_update is"
                 f" {self._base_metric.full_state_update}."
             )
-        bad = [
-            name
-            for name, fx in self._base_metric._reductions.items()
-            if isinstance(self._base_metric._defaults.get(name), list) or fx not in ("sum", "mean", "max", "min")
-        ]
-        if bad:
-            raise ValueError(
-                "The functional MinMaxMetric path supports tensor states with sum/mean/max/min"
-                f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
-                " merges change leaf shapes and cannot be carried through a traced step."
-            )
+        from torchmetrics_tpu.wrappers.abstract import _require_mergeable_tensor_states
+
+        _require_mergeable_tensor_states(self._base_metric, "MinMaxMetric")
         return {
             "base": self._base_metric.init_state(),
             "min_val": jnp.asarray(jnp.inf),

@@ -170,7 +170,8 @@ class MultioutputWrapper(WrapperMetric):
         import jax
 
         base = self.metrics[0]
-        return jax.vmap(lambda st: base.functional_sync(st, axis_name))(state)
+        axis = axis_name or self.sync_axis
+        return jax.vmap(lambda st: base.functional_sync(st, axis))(state)
 
     def functional_compute(self, state: Any) -> Array:
         """Stacked per-output values, matching :meth:`compute`'s layout."""

@@ -90,23 +90,12 @@ class Running(WrapperMetric):
 
     def functional_init(self) -> Any:
         """Fresh ring state: ``window``-stacked default states + fill count."""
-        import jax
         import jax.numpy as jnp
 
-        base = self.base_metric
-        bad = [
-            name
-            for name, fx in base._reductions.items()
-            if isinstance(base._defaults.get(name), list) or fx not in ("sum", "mean", "max", "min")
-        ]
-        if bad:
-            raise ValueError(
-                "The functional Running path supports tensor states with sum/mean/max/min"
-                f" reductions only; state(s) {bad} use list or 'cat'/custom reductions whose"
-                " merges change leaf shapes and cannot form a static ring buffer."
-            )
-        from torchmetrics_tpu.wrappers.abstract import _stacked_init
+        from torchmetrics_tpu.wrappers.abstract import _require_mergeable_tensor_states, _stacked_init
 
+        base = self.base_metric
+        _require_mergeable_tensor_states(base, "Running")
         return {
             "slots": _stacked_init(base, self.window),
             "count": jnp.asarray(0, jnp.int32),
@@ -139,7 +128,8 @@ class Running(WrapperMetric):
         import jax
 
         base = self.base_metric
-        slots = jax.vmap(lambda st: base.functional_sync(st, axis_name))(state["slots"])
+        axis = axis_name or self.sync_axis
+        slots = jax.vmap(lambda st: base.functional_sync(st, axis))(state["slots"])
         return {"slots": slots, "count": state["count"]}
 
     def functional_compute(self, state: Any) -> Any:
