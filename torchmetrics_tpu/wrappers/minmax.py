@@ -64,8 +64,7 @@ class MinMaxMetric(WrapperMetric):
         return {"raw": jnp.asarray(batch_raw), "max": self.max_val, "min": self.min_val}
 
     def _track(self, val: Array) -> None:
-        if not (hasattr(val, "size") and val.size == 1):
-            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        val = self._check_scalar(val)
         self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
         self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
 
@@ -140,11 +139,12 @@ class MinMaxMetric(WrapperMetric):
         batch value in; the base state keeps the global accumulation.
         """
         base_batch, merged = self._absorb(state, *args, **kwargs)
-        batch_val = jnp.asarray(self._base_metric.functional_compute(base_batch))
+        batch_val = self._check_scalar(self._base_metric.functional_compute(base_batch))
+        new_min, new_max = self._fold_extrema(state, batch_val)
         new_state = {
             "base": merged,
-            "min_val": jnp.minimum(state["min_val"], batch_val.astype(jnp.float32)),
-            "max_val": jnp.maximum(state["max_val"], batch_val.astype(jnp.float32)),
+            "min_val": new_min,
+            "max_val": new_max,
             "count": state["count"] + 1,
         }
         return new_state, {"raw": batch_val, "max": new_state["max_val"], "min": new_state["min_val"]}
@@ -172,9 +172,22 @@ class MinMaxMetric(WrapperMetric):
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Accumulated base value with extrema folded over it — a pure read:
         the fold is reported but NOT persisted (see the class-path note above)."""
-        val = jnp.asarray(self._base_metric.functional_compute(state["base"]))
-        return {
-            "raw": val,
-            "max": jnp.maximum(state["max_val"], val.astype(jnp.float32)),
-            "min": jnp.minimum(state["min_val"], val.astype(jnp.float32)),
-        }
+        val = self._check_scalar(self._base_metric.functional_compute(state["base"]))
+        new_min, new_max = self._fold_extrema(state, val)
+        return {"raw": val, "max": new_max, "min": new_min}
+
+    @staticmethod
+    def _fold_extrema(state: Dict[str, Any], val: Array) -> tuple:
+        """Strict-comparison fold like the OO ``_track`` — a NaN value leaves
+        the extrema untouched (``jnp.minimum/maximum`` would propagate it)."""
+        v = val.astype(jnp.float32)
+        new_min = jnp.where(state["min_val"] > v, v, state["min_val"])
+        new_max = jnp.where(state["max_val"] < v, v, state["max_val"])
+        return new_min, new_max
+
+    @staticmethod
+    def _check_scalar(raw: Any) -> Array:
+        """Same scalar contract as the OO ``_track`` (shape is static in-trace)."""
+        if not (isinstance(raw, (float, int)) or (hasattr(raw, "size") and raw.size == 1)):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {raw}.")
+        return jnp.asarray(raw)
