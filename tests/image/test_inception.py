@@ -233,6 +233,7 @@ class TestWeightConverter:
             params_from_torch_fidelity_state_dict(sd)
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestGoldenActivations:
     """Fixed-seed params + fixed input -> committed features: pins the
     architecture (a changed resize matrix, pool quirk or BN epsilon fails).

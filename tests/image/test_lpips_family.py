@@ -313,6 +313,7 @@ class TestPerceptualPathLength:
         np.testing.assert_allclose(ours, ref, atol=1e-5)
 
 
+@pytest.mark.slow  # builds/runs full flax nets; run with --runslow
 class TestGoldenActivations:
     """Fixed-seed params + fixed inputs -> committed LPIPS scores, pinning the
     flax backbones against silent drift (regenerate after intentional

@@ -276,6 +276,52 @@ class TestFunctionalCollection:
             abs(float(res["MulticlassRecall"]) - sk_recall(flat_t, flat_p, average="macro", zero_division=0)) < 1e-6
         )
 
+    def test_functional_sync_fuses_collectives_across_groups(self):
+        """Sum-reduced states across BOTH compute groups ride one psum per dtype
+        (fields are ravelled+concatenated, reduced once, split back)."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from functools import partial
+
+        mc = self._make()
+        mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        states0 = mc.functional_init()
+        assert len(states0) == 2  # two groups -> would be >=2 psums unfused
+        n_fields = sum(len(st) for st in states0.values())
+        sum_dtypes = {
+            jnp.asarray(v).dtype
+            for leader, st in states0.items()
+            for f, v in st.items()
+            if mc._modules[leader]._reductions.get(f) == "sum"
+        }
+        assert n_fields > len(sum_dtypes)  # fusion must actually merge something
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        def dist_step(p, t):
+            st = mc.functional_update(states0, p, t)
+            st = mc.functional_sync(st, "data")
+            return mc.functional_compute(st)
+
+        def count_psums(jaxpr):
+            n = 0
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name.startswith("psum"):
+                    n += 1
+                for v in eqn.params.values():
+                    for sub in v if isinstance(v, (list, tuple)) else [v]:
+                        if hasattr(sub, "eqns"):
+                            n += count_psums(sub)
+                        elif hasattr(sub, "jaxpr"):
+                            n += count_psums(sub.jaxpr)
+            return n
+
+        closed = jax.make_jaxpr(dist_step)(jnp.asarray(PREDS.reshape(-1)), jnp.asarray(TARGET.reshape(-1)))
+        assert count_psums(closed.jaxpr) == len(sum_dtypes)
+        # and the fused path still produces the globally-correct values
+        res = dist_step(jnp.asarray(PREDS.reshape(-1)), jnp.asarray(TARGET.reshape(-1)))
+        assert abs(float(res["MulticlassAccuracy"]) - sk_accuracy(TARGET.reshape(-1), PREDS.reshape(-1))) < 1e-6
+
     def test_functional_forward_batch_values(self):
         mc = self._make()
         mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
@@ -304,6 +350,104 @@ class TestFunctionalCollection:
         states = mc.functional_update(states, jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
         res = mc.functional_compute(states)
         assert set(res) == {"val_MulticlassAccuracy", "val_MulticlassPrecision", "val_MulticlassRecall"}
+
+    def test_wrapper_member_functional_paths(self):
+        """A wrapper with its own functional_init/sync override inside a
+        collection must keep its protocol: init builds the INNER state (not the
+        wrapper's empty default dict), sync keeps the override's semantics, and
+        functional_forward merges via the wrapper's merge_states delegation."""
+        import jax
+        from functools import partial
+        from jax.sharding import Mesh, PartitionSpec as P
+        from sklearn.metrics import precision_score
+
+        from torchmetrics_tpu.wrappers import ClasswiseWrapper
+
+        coll = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+                "cw": ClasswiseWrapper(MulticlassPrecision(num_classes=NUM_CLASSES, average=None)),
+            }
+        )
+        preds, target = jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0])
+        coll.resolve_compute_groups(preds, target)
+        states = coll.functional_init()
+        assert all(st for st in states.values())  # no empty wrapper state dicts
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        flat_p, flat_t = jnp.asarray(PREDS.reshape(-1)), jnp.asarray(TARGET.reshape(-1))
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        def step(p, t):
+            st = coll.functional_update(coll.functional_init(), p, t)
+            st = coll.functional_sync(st, "data")
+            return coll.functional_compute(st)
+
+        res = step(flat_p, flat_t)
+        want = precision_score(TARGET.reshape(-1), PREDS.reshape(-1), average=None, zero_division=0)
+        got = np.array([float(res[f"multiclassprecision_{i}"]) for i in range(NUM_CLASSES)])
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        assert abs(float(res["acc"]) - sk_accuracy(TARGET.reshape(-1), PREDS.reshape(-1))) < 1e-6
+
+        # functional_forward path exercises the wrapper's merge_states delegation
+        st2, batch_vals = coll.functional_forward(coll.functional_init(), preds, target)
+        want0 = precision_score(TARGET[0], PREDS[0], average=None, zero_division=0)
+        got0 = np.array([float(batch_vals[f"multiclassprecision_{i}"]) for i in range(NUM_CLASSES)])
+        np.testing.assert_allclose(got0, want0, atol=1e-6)
+
+    def test_forward_override_leaders_in_collection(self):
+        """Leaders with their own functional_forward semantics (MinMax extrema
+        fold, Running window shift) must run them inside the collection's
+        functional_forward, and merging a count-0 MinMax state must not dilute
+        mean-reduced base states."""
+        from torchmetrics_tpu import MeanMetric
+        from torchmetrics_tpu.wrappers import MinMaxMetric, Running
+
+        coll = MetricCollection({"mm": MinMaxMetric(MeanMetric())})
+        st = coll.functional_init()
+        st, _ = coll.functional_forward(st, jnp.asarray([1.0, 3.0]))
+        st, _ = coll.functional_forward(st, jnp.asarray([5.0, 7.0]))
+        out = coll.functional_compute(st)
+        assert abs(float(out["raw"]) - 4.0) < 1e-6
+        assert abs(float(out["min"]) - 2.0) < 1e-6  # per-batch folds: 2 then 6
+        assert abs(float(out["max"]) - 6.0) < 1e-6
+
+        collr = MetricCollection({"run": Running(MeanMetric(), window=2)})
+        str_ = collr.functional_init()
+        for x in ([1.0], [100.0], [2.0], [4.0]):
+            str_, _ = collr.functional_forward(str_, jnp.asarray(x))
+        assert abs(float(collr.functional_compute(str_)["run"]) - 3.0) < 1e-6  # last-2 window
+
+        mm = MinMaxMetric(MeanMetric())
+        fresh = mm.functional_init()
+        one, _ = mm.functional_forward(mm.functional_init(), jnp.asarray([4.0]))
+        assert abs(float(mm.functional_compute(mm.merge_states(fresh, one))["raw"]) - 4.0) < 1e-6
+        assert abs(float(mm.functional_compute(mm.merge_states(one, fresh))["raw"]) - 4.0) < 1e-6
+
+    def test_minmax_merge_and_0d_carry(self):
+        """MinMaxMetric.merge_states folds two streams; a base metric whose
+        compute returns shape (1,) must not grow the 0-d extrema states."""
+        from torchmetrics_tpu import MeanMetric
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        mm = MinMaxMetric(MeanMetric())
+        a, b = mm.functional_init(), mm.functional_init()
+        a, _ = mm.functional_forward(a, jnp.asarray([1.0, 3.0]))
+        b, _ = mm.functional_forward(b, jnp.asarray([5.0, 7.0]))
+        out = mm.functional_compute(mm.merge_states(a, b))
+        assert abs(float(out["raw"]) - 4.0) < 1e-6
+        assert abs(float(out["min"]) - 2.0) < 1e-6  # per-stream folds: 2 and 6
+        assert abs(float(out["max"]) - 6.0) < 1e-6
+
+        class OneDim(MeanMetric):
+            def functional_compute(self, state):
+                return super().functional_compute(state).reshape(1)
+
+        mm1 = MinMaxMetric(OneDim())
+        st = mm1.functional_init()
+        st, _ = mm1.functional_forward(st, jnp.asarray([1.0, 2.0]))
+        assert st["min_val"].shape == () and st["max_val"].shape == ()
 
     def test_collection_merge_states(self):
         mc = self._make()

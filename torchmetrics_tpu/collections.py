@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.parallel.sync import sync_states
 from torchmetrics_tpu.utils.data import _flatten_dict
 
 _PREFIX_SUFFIX_ERROR = "Expected input `{}` to be a string, but got {}"
@@ -292,7 +293,7 @@ class MetricCollection:
                 if len(cg) > 1 and all(
                     m.full_state_update is False and not m.dist_sync_on_step for _, m in members
                 ):
-                    batch_state = m0.functional_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
+                    batch_state = m0.functional_update(m0.functional_init(), *args, **m0._filter_kwargs(**kwargs))
                     global_state = m0._copy_state_dict()
                     m0._state = {k: (list(v) if isinstance(v, list) else v) for k, v in batch_state.items()}
                     m0._update_count += 1
@@ -377,7 +378,7 @@ class MetricCollection:
         """
         if self._enable_compute_groups and not self._groups_checked:
             trial = {
-                name: m.functional_update(m.init_state(), *args, **m._filter_kwargs(**kwargs))
+                name: m.functional_update(m.functional_init(), *args, **m._filter_kwargs(**kwargs))
                 for name, m in self._modules.items()
             }
             self._merge_compute_groups(trial_states=trial)
@@ -386,7 +387,7 @@ class MetricCollection:
 
     def functional_init(self) -> Dict[str, Dict[str, Any]]:
         """Fresh default states, one pytree per compute-group leader."""
-        return {cg[0]: self._modules[cg[0]].init_state() for cg in self._groups.values()}
+        return {cg[0]: self._modules[cg[0]].functional_init() for cg in self._groups.values()}
 
     def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
         """Pure update: one leader ``functional_update`` per compute group."""
@@ -399,8 +400,40 @@ class MetricCollection:
     def functional_sync(
         self, states: Dict[str, Dict[str, Any]], axis_name: Optional[Union[str, Sequence[str]]] = None
     ) -> Dict[str, Dict[str, Any]]:
-        """Pure in-trace sync: one set of collectives per compute group."""
-        return {leader: self._modules[leader].functional_sync(st, axis_name) for leader, st in states.items()}
+        """Pure in-trace sync with cross-group collective fusion.
+
+        Same-reduction fields are fused across ALL compute groups sharing a sync
+        axis, so a whole collection of sum-reduced metrics costs ONE ``lax.psum``
+        rendezvous per step rather than one per group (``sync_states`` already
+        fuses within a metric; this lifts the fusion to the collection level).
+        Leaders with a custom ``dist_sync_fn`` keep their own path.
+        """
+        out: Dict[str, Dict[str, Any]] = {}
+        # leaders fusable together must resolve to the same mesh axis
+        by_axis: Dict[Any, List[str]] = {}
+        for leader, st in states.items():
+            m = self._modules[leader]
+            # only fuse plain Metric sync paths: a custom dist_sync_fn or a
+            # subclass/wrapper functional_sync override (BootStrapper, Running,
+            # ClasswiseWrapper, ...) must keep its own semantics
+            if m.dist_sync_fn is not None or type(m).functional_sync is not Metric.functional_sync:
+                out[leader] = m.functional_sync(st, axis_name)
+                continue
+            axis = axis_name or m.sync_axis
+            key = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            by_axis.setdefault(key, []).append(leader)
+        for axis_key, leaders in by_axis.items():
+            axis = list(axis_key) if isinstance(axis_key, tuple) else axis_key
+            flat = {f"{leader}\x00{field}": v for leader in leaders for field, v in states[leader].items()}
+            reds = {
+                f"{leader}\x00{field}": self._modules[leader]._reductions.get(field)
+                for leader in leaders
+                for field in states[leader]
+            }
+            synced = sync_states(flat, reds, axis)
+            for leader in leaders:
+                out[leader] = {field: synced[f"{leader}\x00{field}"] for field in states[leader]}
+        return out
 
     def functional_compute(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         """Pure compute: every member reads its group leader's state; results are
@@ -439,7 +472,17 @@ class MetricCollection:
         counts = (update_count, 1) if update_count is not None else None
         for cg in self._groups.values():
             m0 = self._modules[cg[0]]
-            batch_state = m0.functional_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
+            if type(m0).functional_forward is not Metric.functional_forward:
+                # a leader with its own forward semantics (MinMaxMetric's extrema
+                # fold, Running's window shift) must run them; wrapper trial
+                # states never structurally match plain metrics, so such a
+                # leader is always alone in its group. No update_count: these
+                # wrappers carry their own counts in-state.
+                new_states[cg[0]], result[cg[0]] = m0.functional_forward(
+                    states[cg[0]], *args, **m0._filter_kwargs(**kwargs)
+                )
+                continue
+            batch_state = m0.functional_update(m0.functional_init(), *args, **m0._filter_kwargs(**kwargs))
             new_states[cg[0]] = m0.merge_states(states[cg[0]], batch_state, counts=counts)
             for name in cg:
                 result[name] = self._modules[name].functional_compute(batch_state)

@@ -483,7 +483,7 @@ class Metric:
         count-weighted like the stateful path (reference metric.py:399-431);
         without it both sides weigh equally.
         """
-        batch_state = self.functional_update(self.init_state(), *args, **kwargs)
+        batch_state = self.functional_update(self.functional_init(), *args, **kwargs)
         batch_value = self.functional_compute(batch_state)
         counts = (update_count, 1) if update_count is not None else None
         return self.merge_states(state, batch_state, counts=counts), batch_value

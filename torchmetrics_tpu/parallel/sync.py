@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array, lax
 
 Reduction = Union[str, Callable, None]
@@ -81,24 +82,36 @@ def sync_states(
 ) -> Dict[str, Any]:
     """Apply the declared collectives to every state field. Pure; safe under jit.
 
-    Fields sharing a ``sum/mean/max/min`` reduction ride ONE fused collective
-    (``lax.psum`` & co. accept pytrees), so a metric with K scalar counters
-    costs one rendezvous, not K — the stat-scores tp/fp/tn/fn quartet syncs as
-    a single fused psum. Lists and ``cat``/callable/None reductions keep the
-    per-field :func:`sync_value` path.
+    Fields sharing a ``sum/mean/max/min`` reduction (and dtype) are ravelled
+    into ONE flat vector and reduced by a single collective, then split back —
+    a metric with K scalar counters costs one rendezvous, not K (``lax.psum``
+    on a pytree binds one primitive PER LEAF, so leaf-level fusion must be done
+    by hand; the concat/split is pure data movement XLA fuses away). The
+    stat-scores tp/fp/tn/fn quartet syncs as a single psum of a 4-vector.
+    Lists and ``cat``/callable/None reductions keep the per-field
+    :func:`sync_value` path.
     """
     fused_ops = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}
-    grouped: Dict[str, Dict[str, Any]] = {fx: {} for fx in fused_ops}
+    grouped: Dict[Any, List[Any]] = {}
     out: Dict[str, Any] = {}
     for name, value in states.items():
         fx = reductions.get(name)
         if fx in fused_ops and not isinstance(value, (list, tuple)):
-            grouped[fx][name] = value
-        else:
-            out[name] = sync_value(value, fx, axis_name)
-    for fx, vals in grouped.items():
-        if vals:
-            out.update(fused_ops[fx](vals, axis_name))
+            arr = jnp.asarray(value)
+            if arr.dtype != jnp.bool_:
+                grouped.setdefault((fx, arr.dtype), []).append((name, arr))
+                continue
+        out[name] = sync_value(value, fx, axis_name)
+    for (fx, _), items in grouped.items():
+        if len(items) == 1:
+            name, arr = items[0]
+            out[name] = fused_ops[fx](arr, axis_name)
+            continue
+        flat = jnp.concatenate([arr.ravel() for _, arr in items])
+        reduced = fused_ops[fx](flat, axis_name)
+        offsets = np.cumsum([arr.size for _, arr in items])[:-1]
+        for (name, arr), part in zip(items, jnp.split(reduced, offsets)):
+            out[name] = part.reshape(arr.shape)
     return out
 
 

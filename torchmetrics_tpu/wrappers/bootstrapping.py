@@ -199,6 +199,11 @@ class BootStrapper(WrapperMetric):
         axis = axis_name or self.sync_axis
         return jax.vmap(lambda st: base.functional_sync(st, axis))(state)
 
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any], counts: Any = None) -> Dict[str, Any]:
+        """Replicate-wise merge: sum/mean/max/min folds are elementwise, so the
+        base metric's merge applies directly to the stacked leaves."""
+        return self.metrics[0].merge_states(a, b, counts=counts)
+
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Mean/std/quantile/raw across the vmapped replicate axis."""
         import jax

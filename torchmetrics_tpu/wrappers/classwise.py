@@ -73,5 +73,8 @@ class ClasswiseWrapper(WrapperMetric):
     def functional_sync(self, state: Dict[str, Any], axis_name: Any = None) -> Dict[str, Any]:
         return self.metric.functional_sync(state, axis_name)
 
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any], counts: Any = None) -> Dict[str, Any]:
+        return self.metric.merge_states(a, b, counts=counts)
+
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         return self._convert(self.metric.functional_compute(state))

@@ -176,6 +176,29 @@ class MinMaxMetric(WrapperMetric):
         new_min, new_max = self._fold_extrema(state, val)
         return {"raw": val, "max": new_max, "min": new_min}
 
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any], counts: Any = None) -> Dict[str, Any]:
+        """Merge two wrapper states: base by its own reductions (count-weighted
+        by each side's own update count), extrema by NaN-ignoring min/max.
+
+        A side that saw no updates contributes nothing — its default base state
+        must REPLACE rather than dilute "mean" reductions (same guard as
+        :meth:`_absorb`'s first-batch case).
+        """
+        import jax
+
+        na, nb = a["count"], b["count"]
+        base = self._base_metric.merge_states(
+            a["base"], b["base"], counts=(jnp.maximum(na, 1), jnp.maximum(nb, 1))
+        )
+        base = jax.tree_util.tree_map(lambda bb, mm: jnp.where(na == 0, bb, mm), b["base"], base)
+        base = jax.tree_util.tree_map(lambda aa, mm: jnp.where(nb == 0, aa, mm), a["base"], base)
+        return {
+            "base": base,
+            "min_val": jnp.fmin(a["min_val"], b["min_val"]),
+            "max_val": jnp.fmax(a["max_val"], b["max_val"]),
+            "count": na + nb,
+        }
+
     @staticmethod
     def _fold_extrema(state: Dict[str, Any], val: Array) -> tuple:
         """Strict-comparison fold like the OO ``_track`` — a NaN value leaves
@@ -190,4 +213,6 @@ class MinMaxMetric(WrapperMetric):
         """Same scalar contract as the OO ``_track`` (shape is static in-trace)."""
         if not (isinstance(raw, (float, int)) or (hasattr(raw, "size") and raw.size == 1)):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {raw}.")
-        return jnp.asarray(raw)
+        # a size-1 but non-0-d value (shape (1,)) would broadcast the () extrema
+        # states up to (1,), changing the carry structure under jit/scan
+        return jnp.asarray(raw).reshape(())

@@ -173,6 +173,11 @@ class MultioutputWrapper(WrapperMetric):
         axis = axis_name or self.sync_axis
         return jax.vmap(lambda st: base.functional_sync(st, axis))(state)
 
+    def merge_states(self, a: Any, b: Any, counts: Any = None) -> Any:
+        """Output-wise merge: sum/mean/max/min folds are elementwise, so the
+        base metric's merge applies directly to the stacked leaves."""
+        return self.metrics[0].merge_states(a, b, counts=counts)
+
     def functional_compute(self, state: Any) -> Array:
         """Stacked per-output values, matching :meth:`compute`'s layout."""
         import jax

@@ -98,6 +98,9 @@ class MultitaskWrapper(WrapperMetric):
     def functional_compute(self, states: Dict[str, Any]) -> Dict[str, Any]:
         return {task: m.functional_compute(states[task]) for task, m in self.task_metrics.items()}
 
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any], counts: Any = None) -> Dict[str, Any]:
+        return {task: m.merge_states(a[task], b[task], counts=counts) for task, m in self.task_metrics.items()}
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
         import copy
 

@@ -132,6 +132,13 @@ class Running(WrapperMetric):
         slots = jax.vmap(lambda st: base.functional_sync(st, axis))(state["slots"])
         return {"slots": slots, "count": state["count"]}
 
+    def merge_states(self, a: Any, b: Any, counts: Any = None) -> Any:
+        raise NotImplementedError(
+            "Running state is a sliding-window ring of per-update states; merging two rings"
+            " has no defined order. Advance the window with functional_update/functional_forward"
+            " instead."
+        )
+
     def functional_compute(self, state: Any) -> Any:
         """Fold filled ring slots oldest-to-newest via the base merge protocol.
 
