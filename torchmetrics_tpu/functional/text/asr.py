@@ -15,17 +15,20 @@ from torchmetrics_tpu.functional.text.helper import _batch_distances, _validate_
 
 
 # ------------------------------------------------------------------------- WER
-def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
-    """Summed word-level edit distance + total reference words (reference wer.py:23-48)."""
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[float, float]:
+    """Summed word-level edit distance + total reference words (reference wer.py:23-48).
+
+    Returns host floats: the counts fold into device state (or the final ratio)
+    with zero per-call host->device transfers — a scalar put per update would
+    dominate the whole text pipeline on a TPU tunnel.
+    """
     preds, target = _validate_text_inputs(preds, target)
     pairs, dists = _batch_distances(preds, target)
-    errors = int(dists.sum())
-    total = sum(len(t) for _, t in pairs)
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return float(dists.sum()), float(sum(len(t) for _, t in pairs))
 
 
-def _wer_compute(errors: Array, total: Array) -> Array:
-    return errors / total
+def _wer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
+    return jnp.asarray(errors / total, dtype=jnp.float32)
 
 
 def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -41,17 +44,16 @@ def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 
 
 # ------------------------------------------------------------------------- CER
-def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
-    """Char-level edit distance + total reference chars (reference cer.py:22-48)."""
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[float, float]:
+    """Char-level edit distance + total reference chars (reference cer.py:22-48);
+    host floats like :func:`_wer_update`."""
     preds, target = _validate_text_inputs(preds, target)
     pairs, dists = _batch_distances(preds, target, char_level=True)
-    errors = int(dists.sum())
-    total = sum(len(t) for _, t in pairs)
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return float(dists.sum()), float(sum(len(t) for _, t in pairs))
 
 
-def _cer_compute(errors: Array, total: Array) -> Array:
-    return errors / total
+def _cer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
+    return jnp.asarray(errors / total, dtype=jnp.float32)
 
 
 def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -71,17 +73,16 @@ def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 
 
 # ------------------------------------------------------------------------- MER
-def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
-    """Edit distance + max(len) totals (reference mer.py:23-50)."""
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[float, float]:
+    """Edit distance + max(len) totals (reference mer.py:23-50); host floats
+    like :func:`_wer_update`."""
     preds, target = _validate_text_inputs(preds, target)
     pairs, dists = _batch_distances(preds, target)
-    errors = int(dists.sum())
-    total = sum(max(len(p_), len(t_)) for p_, t_ in pairs)
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return float(dists.sum()), float(sum(max(len(p_), len(t_)) for p_, t_ in pairs))
 
 
-def _mer_compute(errors: Array, total: Array) -> Array:
-    return errors / total
+def _mer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
+    return jnp.asarray(errors / total, dtype=jnp.float32)
 
 
 def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -103,7 +104,7 @@ def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]
 # --------------------------------------------------------------------- WIL/WIP
 def _word_info_update(
     preds: Union[str, List[str]], target: Union[str, List[str]]
-) -> Tuple[Array, Array, Array]:
+) -> Tuple[float, float, float]:
     """Negated hit count + per-side word totals.
 
     Reference wil.py:22-54 / wip.py:22-54: accumulates ``edit - max_len`` (the
@@ -116,19 +117,19 @@ def _word_info_update(
     target_total = float(sum(len(t_) for _, t_ in pairs))
     preds_total = float(sum(len(p_) for p_, _ in pairs))
     total = float(sum(max(len(p_), len(t_)) for p_, t_ in pairs))
-    return (
-        jnp.asarray(errors - total, dtype=jnp.float32),
-        jnp.asarray(target_total, dtype=jnp.float32),
-        jnp.asarray(preds_total, dtype=jnp.float32),
-    )
+    return errors - total, target_total, preds_total
 
 
-def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
-    return 1 - ((errors / target_total) * (errors / preds_total))
+def _wil_compute(
+    errors: Union[Array, float], target_total: Union[Array, float], preds_total: Union[Array, float]
+) -> Array:
+    return jnp.asarray(1 - ((errors / target_total) * (errors / preds_total)), dtype=jnp.float32)
 
 
-def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
-    return (errors / target_total) * (errors / preds_total)
+def _wip_compute(
+    errors: Union[Array, float], target_total: Union[Array, float], preds_total: Union[Array, float]
+) -> Array:
+    return jnp.asarray((errors / target_total) * (errors / preds_total), dtype=jnp.float32)
 
 
 def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:

@@ -148,14 +148,33 @@ def native_available() -> bool:
 
 
 def _tokens_to_ids(*sequences: Sequence) -> List[np.ndarray]:
-    """Map arbitrary hashable tokens to a shared int-id space."""
-    vocab: dict = {}
+    """Map arbitrary hashable tokens to a shared int-id space.
+
+    Vectorized via ``np.unique(return_inverse=True)`` (C-speed sort-based
+    labelling; the id ASSIGNMENT differs from insertion order but the kernels
+    only test ids for equality). Mixed/unorderable token types fall back to a
+    Python dict walk.
+    """
+    lens = [len(s) for s in sequences]
+    flat: List = [t for s in sequences for t in s]
+    if not flat:
+        return [np.zeros(0, dtype=np.int64) for _ in sequences]
+    t0 = type(flat[0])
+    try:
+        if any(type(tok) is not t0 for tok in flat):
+            raise TypeError  # mixed types: np.asarray would coerce (e.g. 1 -> "1")
+        arr = np.asarray(flat)
+        if arr.ndim != 1:  # e.g. equal-length tuple tokens coerced to 2-D
+            raise TypeError
+        inv = np.unique(arr, return_inverse=True)[1].astype(np.int64, copy=False)
+    except (TypeError, ValueError):
+        vocab: dict = {}
+        inv = np.fromiter((vocab.setdefault(tok, len(vocab)) for tok in flat), dtype=np.int64, count=len(flat))
     out = []
-    for seq in sequences:
-        ids = np.empty(len(seq), dtype=np.int64)
-        for i, tok in enumerate(seq):
-            ids[i] = vocab.setdefault(tok, len(vocab))
-        out.append(ids)
+    start = 0
+    for n in lens:
+        out.append(inv[start : start + n])
+        start += n
     return out
 
 
