@@ -449,6 +449,39 @@ class TestFunctionalCollection:
         st, _ = mm1.functional_forward(st, jnp.asarray([1.0, 2.0]))
         assert st["min_val"].shape == () and st["max_val"].shape == ()
 
+    def test_state_roundtrip_across_group_topologies(self):
+        """state() saved after auto-grouping loads into a fresh (ungrouped)
+        collection; wrapper load_state invalidates the compute cache and does
+        not re-arm the compute-before-update warning."""
+        import warnings
+
+        from torchmetrics_tpu import MeanMetric
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        p, t = jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0])
+        c1 = self._make()
+        c1.update(p, t)
+        saved = c1.state()
+        assert len(saved) < len(c1.keys())  # groups merged -> leader-keyed
+        c2 = self._make()
+        c2.load_state(saved)  # fresh collection still has singleton groups
+        r1, r2 = c1.compute(), c2.compute()
+        assert all(abs(float(r1[k]) - float(r2[k])) < 1e-6 for k in r1)
+
+        mm = MinMaxMetric(MeanMetric())
+        mm.update(jnp.asarray([1.0]))
+        mm.compute()  # populate the cache
+        src = MinMaxMetric(MeanMetric())
+        src.update(jnp.asarray([9.0]))
+        mm.load_state(src.state())
+        assert abs(float(mm.compute()["raw"]) - 9.0) < 1e-6  # not the stale 1.0
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fresh = MinMaxMetric(MeanMetric())
+            fresh.load_state(src.state())
+            fresh.compute()
+            assert not any("before" in str(x.message) for x in w)
+
     def test_collection_merge_states(self):
         mc = self._make()
         mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))

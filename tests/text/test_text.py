@@ -286,6 +286,18 @@ class TestASR:
         ref_metric.update(PREDS_ASR, TARGET_ASR)
         _close(metric.compute(), ref_metric.compute())
 
+    def test_empty_reference_ieee_semantics(self):
+        """Zero-length references divide like the reference's tensor math
+        (0/0 -> nan, x/0 -> inf) instead of raising ZeroDivisionError."""
+        import math
+
+        assert math.isnan(float(F.word_error_rate([""], [""])))
+        assert math.isinf(float(F.word_error_rate(["abc def"], [""])))
+        assert math.isnan(float(F.char_error_rate([""], [""])))
+        assert math.isnan(float(F.match_error_rate([""], [""])))
+        float(F.word_information_lost([""], [""]))
+        float(F.word_information_preserved([""], [""]))
+
 
 class TestSQuAD:
     PREDS = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]

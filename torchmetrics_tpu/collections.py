@@ -445,6 +445,43 @@ class MetricCollection:
                 result[name] = self._modules[name].functional_compute(st)
         return self._flatten_results(result)
 
+    def state(self) -> Dict[str, Dict[str, Any]]:
+        """Live states in the functional layout: one pytree per group leader
+        (followers share the leader's state, reference collections.py:289-308)."""
+        return {cg[0]: self._modules[cg[0]].state() for cg in self._groups.values()}
+
+    def load_state(self, states: Dict[str, Dict[str, Any]]) -> None:
+        """Install leader-keyed state pytrees into every member of each group.
+
+        The saved keys reflect the SOURCE collection's resolved groups, which
+        may be coarser than this collection's (e.g. saved after auto-grouping,
+        loaded into a fresh collection still holding singleton groups). A
+        target leader missing from ``states`` falls back to the unique saved
+        state whose field names/shapes/dtypes match its own defaults; genuine
+        ambiguity raises."""
+
+        def _sig_of_state(st: Dict[str, Any]) -> tuple:
+            return tuple(
+                sorted((k, getattr(v, "shape", None), str(getattr(v, "dtype", ""))) for k, v in st.items())
+            )
+
+        for cg in self._groups.values():
+            if cg[0] in states:
+                st = states[cg[0]]
+            else:
+                want = _sig_of_state(self._modules[cg[0]].functional_init())
+                cands = [k for k, v in states.items() if _sig_of_state(v) == want]
+                if len(cands) != 1:
+                    raise KeyError(
+                        f"state missing group leader {cg[0]!r} and"
+                        f" {'no' if not cands else 'multiple'} saved states match its layout"
+                        f" (candidates: {cands}); save and load with the same compute-group"
+                        " resolution to disambiguate"
+                    )
+                st = states[cands[0]]
+            for name in cg:
+                self._modules[name].load_state(st)
+
     def merge_states(
         self,
         a: Dict[str, Dict[str, Any]],

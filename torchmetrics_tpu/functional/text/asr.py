@@ -6,12 +6,23 @@ States are jnp scalars so the modular classes psum-sync them over the mesh.
 """
 from __future__ import annotations
 
+import math
 from typing import List, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array
 
 from torchmetrics_tpu.functional.text.helper import _batch_distances, _validate_text_inputs
+
+
+def _host_div(num: Union[Array, float], den: Union[Array, float]) -> Union[Array, float]:
+    """Division with IEEE zero semantics on host floats (0/0 -> nan, x/0 -> inf),
+    matching the jnp behavior the modular (array-state) path gets for free."""
+    if isinstance(num, (int, float)) and isinstance(den, (int, float)):
+        if den == 0.0:
+            return float("nan") if num == 0.0 else math.copysign(math.inf, num)
+        return num / den
+    return num / den
 
 
 # ------------------------------------------------------------------------- WER
@@ -28,7 +39,7 @@ def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
 
 
 def _wer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
-    return jnp.asarray(errors / total, dtype=jnp.float32)
+    return jnp.asarray(_host_div(errors, total), dtype=jnp.float32)
 
 
 def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -53,7 +64,7 @@ def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
 
 
 def _cer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
-    return jnp.asarray(errors / total, dtype=jnp.float32)
+    return jnp.asarray(_host_div(errors, total), dtype=jnp.float32)
 
 
 def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -82,7 +93,7 @@ def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
 
 
 def _mer_compute(errors: Union[Array, float], total: Union[Array, float]) -> Array:
-    return jnp.asarray(errors / total, dtype=jnp.float32)
+    return jnp.asarray(_host_div(errors, total), dtype=jnp.float32)
 
 
 def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
@@ -123,13 +134,13 @@ def _word_info_update(
 def _wil_compute(
     errors: Union[Array, float], target_total: Union[Array, float], preds_total: Union[Array, float]
 ) -> Array:
-    return jnp.asarray(1 - ((errors / target_total) * (errors / preds_total)), dtype=jnp.float32)
+    return jnp.asarray(1 - (_host_div(errors, target_total) * _host_div(errors, preds_total)), dtype=jnp.float32)
 
 
 def _wip_compute(
     errors: Union[Array, float], target_total: Union[Array, float], preds_total: Union[Array, float]
 ) -> Array:
-    return jnp.asarray((errors / target_total) * (errors / preds_total), dtype=jnp.float32)
+    return jnp.asarray(_host_div(errors, target_total) * _host_div(errors, preds_total), dtype=jnp.float32)
 
 
 def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:

@@ -544,6 +544,9 @@ class Metric:
             v = state[k]
             self._state[k] = list(v) if isinstance(v, (list, tuple)) else v
         self._computed = None
+        # a restored state counts as updated: compute() must not warn on the
+        # checkpoint-resume flow
+        self._update_count = max(self._update_count, 1)
 
     # ------------------------------------------------------------- lifecycle
     def reset(self) -> None:

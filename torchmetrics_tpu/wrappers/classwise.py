@@ -61,6 +61,14 @@ class ClasswiseWrapper(WrapperMetric):
     def reset(self) -> None:
         self.metric.reset()
 
+    def state(self) -> Dict[str, Any]:
+        return self.metric.state()
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        self.metric.load_state(state)
+        self._computed = None
+        self._update_count = max(self._update_count, 1)
+
     # ------------------------------------------------------ pure/functional API
     # state IS the base metric's state; only the compute output is relabeled
 

@@ -77,6 +77,24 @@ class MinMaxMetric(WrapperMetric):
         super().reset()
         self._base_metric.reset()
 
+    def state(self) -> Dict[str, Any]:
+        """Live state in the FUNCTIONAL layout (base state nested + extrema +
+        count), so ``state()``/``merge_states``/``functional_compute``/
+        ``load_state`` interoperate across the dual API."""
+        return {
+            "base": self._base_metric.state(),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "count": jnp.asarray(self._update_count, jnp.int32),
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        self._base_metric.load_state(state["base"])
+        self.min_val = state["min_val"]
+        self.max_val = state["max_val"]
+        self._update_count = int(state["count"])
+        self._computed = None
+
     # ------------------------------------------------------ pure/functional API
     #
     # Extrema are data, not side effects, on this path: they move when a value

@@ -101,6 +101,15 @@ class MultitaskWrapper(WrapperMetric):
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any], counts: Any = None) -> Dict[str, Any]:
         return {task: m.merge_states(a[task], b[task], counts=counts) for task, m in self.task_metrics.items()}
 
+    def state(self) -> Dict[str, Any]:
+        return {task: m.state() for task, m in self.task_metrics.items()}
+
+    def load_state(self, states: Dict[str, Any]) -> None:
+        for task, m in self.task_metrics.items():
+            m.load_state(states[task])
+        self._computed = None
+        self._update_count = max(self._update_count, 1)
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
         import copy
 
