@@ -482,6 +482,37 @@ class TestFunctionalCollection:
             fresh.compute()
             assert not any("before" in str(x.message) for x in w)
 
+    def test_running_state_cross_window_and_list_base(self):
+        """Running.load_state honors the SOURCE ring's window (newest slots
+        survive a resize, pads never load); list-state bases round-trip via
+        the snapshot layout."""
+        from torchmetrics_tpu import SumMetric
+        from torchmetrics_tpu.regression import SpearmanCorrCoef
+        from torchmetrics_tpu.wrappers import Running
+
+        src = Running(SumMetric(), window=5)
+        src.update(jnp.asarray(10.0))
+        src.update(jnp.asarray(20.0))
+        for target_window, want in ((3, 30.0), (7, 30.0)):
+            t = Running(SumMetric(), window=target_window)
+            t.load_state(src.state())
+            assert float(t.compute()) == want
+        src2 = Running(SumMetric(), window=3)
+        for v in (1.0, 2.0, 4.0):
+            src2.update(jnp.asarray(v))
+        t1 = Running(SumMetric(), window=1)
+        t1.load_state(src2.state())
+        assert float(t1.compute()) == 4.0  # only the newest update
+
+        r = Running(SpearmanCorrCoef(), window=3)  # list-state base
+        p, t_ = jnp.asarray(rng.randn(16)), jnp.asarray(rng.randn(16))
+        r.update(p, t_)
+        st = r.state()
+        assert "snapshots" in st
+        r2 = Running(SpearmanCorrCoef(), window=3)
+        r2.load_state(st)
+        assert abs(float(r2.compute()) - float(r.compute())) < 1e-6
+
     def test_collection_merge_states(self):
         mc = self._make()
         mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))

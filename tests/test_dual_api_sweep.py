@@ -179,3 +179,22 @@ def test_merge_states_matches_sequential_updates(module_name, cls_name, ctor, se
     # compare computed VALUES, not raw states: dist_reduce_fx=None metrics
     # (e.g. Pearson) stack per-side moments and fold them at compute time
     _tree_allclose(merged_value, sequential_value)
+
+
+@pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CASES)
+def test_state_load_state_roundtrip(module_name, cls_name, ctor, setup, upd):
+    """state() -> load_state() into a FRESH instance reproduces compute() for
+    every buildable metric class — the checkpoint/restore contract of the pure
+    API (complements the OO state_dict/orbax tests)."""
+    ns, upd = _build(module_name, cls_name, ctor, setup, upd)
+    m = ns["m"]
+    rounds = (upd,) if isinstance(upd, str) else upd
+    for r in rounds:
+        exec(f"m.update({r})", ns)
+    expected = m.compute()
+
+    ns2, _ = _build(module_name, cls_name, ctor, setup, upd)
+    m2 = ns2["m"]
+    m2.load_state(m.state())
+    restored = m2.compute()
+    _tree_allclose(expected, restored)

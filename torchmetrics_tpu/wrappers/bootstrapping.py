@@ -204,6 +204,21 @@ class BootStrapper(WrapperMetric):
         base metric's merge applies directly to the stacked leaves."""
         return self.metrics[0].merge_states(a, b, counts=counts)
 
+    def state(self) -> Dict[str, Any]:
+        """Live per-replicate states stacked into the functional layout."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[m.state() for m in self.metrics])
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        for i, m in enumerate(self.metrics):
+            m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state))
+        self._computed = None
+        self._update_count = max(self._update_count, 1)
+
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Mean/std/quantile/raw across the vmapped replicate axis."""
         import jax

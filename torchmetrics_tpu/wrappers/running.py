@@ -79,6 +79,44 @@ class Running(WrapperMetric):
         self._window_states = []
         self.base_metric.reset()
 
+    def state(self) -> Any:
+        """Live window in the FUNCTIONAL ring layout: ``(window, ...)`` slots
+        (default-padded at the front, newest last) + total update count.
+
+        List/"cat"-state bases cannot stack into a static ring (per-slot list
+        lengths differ); their window is exported as a ``snapshots`` list of
+        per-update state dicts instead."""
+        import jax
+        import jax.numpy as jnp
+
+        base = self.base_metric
+        count = jnp.asarray(self._update_count, jnp.int32)
+        if any(isinstance(d, list) for d in base._defaults.values()):
+            return {"snapshots": [dict(s) for s in self._window_states], "count": count}
+        pad = [base.init_state() for _ in range(self.window - len(self._window_states))]
+        seq = pad + list(self._window_states)
+        slots = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *seq)
+        return {"slots": slots, "count": count}
+
+    def load_state(self, state: Any) -> None:
+        import jax
+
+        count = int(state["count"])
+        if "snapshots" in state:
+            keep = min(self.window, len(state["snapshots"]))
+            self._window_states = [dict(s) for s in state["snapshots"][-keep:]] if keep else []
+        else:
+            slots = state["slots"]
+            # index relative to the SOURCE ring's window (its leading dim):
+            # real data sits newest-last there, front slots are default pads
+            src_window = jax.tree_util.tree_leaves(slots)[0].shape[0]
+            n = min(count, src_window, self.window)
+            self._window_states = [
+                jax.tree_util.tree_map(lambda x, i=i: x[i], slots) for i in range(src_window - n, src_window)
+            ]
+        self._update_count = count
+        self._computed = None
+
     # ------------------------------------------------------ pure/functional API
     #
     # The window becomes a static leading axis: state leaves are
