@@ -1,0 +1,106 @@
+"""Metrics under XLA auto-SPMD: plain ``jit`` + ``NamedSharding`` inputs.
+
+The shard_map tests drive the EXPLICIT collective path (per-shard update +
+declared-reduction sync). This module pins the other TPU-native mode from
+SURVEY §2.17: metric updates traced under plain ``jax.jit`` over globally
+sharded inputs, where the SPMD partitioner inserts the cross-device
+reductions itself — no ``functional_sync`` call, no shard_map. This is how
+metrics compose with a pjit training step whose activations already carry
+shardings.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from sklearn.metrics import accuracy_score, f1_score, mean_squared_error
+
+from torchmetrics_tpu import MeanMetric, MeanSquaredError, MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+
+N, C = 64, 5
+rng = np.random.RandomState(11)
+PREDS = rng.randint(0, C, N)
+TARGET = rng.randint(0, C, N)
+
+
+def _shard(mesh, x, spec):
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+
+class TestAutoSPMD:
+    def test_metric_update_compute_under_jit(self, mesh):
+        """Sharded inputs, replicated state: value equals the global oracle."""
+        m = MulticlassAccuracy(num_classes=C, average="micro", validate_args=False)
+        p = _shard(mesh, PREDS, P("batch"))
+        t = _shard(mesh, TARGET, P("batch"))
+
+        step = jax.jit(m.functional_update)
+        state = step(m.functional_init(), p, t)
+        val = jax.jit(m.functional_compute)(state)
+        assert abs(float(val) - accuracy_score(TARGET, PREDS)) < 1e-6
+        # the accumulated state is fully replicated — no shard-local residue
+        for leaf in jax.tree_util.tree_leaves(state):
+            assert leaf.sharding.is_fully_replicated
+
+    def test_multi_step_accumulation(self, mesh):
+        m = MeanSquaredError()
+        x = rng.randn(4, N).astype(np.float32)
+        y = rng.randn(4, N).astype(np.float32)
+        step = jax.jit(m.functional_update)
+        state = m.functional_init()
+        for i in range(4):
+            state = step(state, _shard(mesh, x[i], P("batch")), _shard(mesh, y[i], P("batch")))
+        val = float(jax.jit(m.functional_compute)(state))
+        assert abs(val - mean_squared_error(y.reshape(-1), x.reshape(-1))) < 1e-5
+
+    def test_collection_under_jit(self, mesh):
+        coll = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+                "f1": MulticlassF1Score(num_classes=C, validate_args=False),
+                "confmat": MulticlassConfusionMatrix(num_classes=C, validate_args=False),
+            }
+        )
+        p = _shard(mesh, PREDS, P("batch"))
+        t = _shard(mesh, TARGET, P("batch"))
+        coll.resolve_compute_groups(jnp.asarray(PREDS[:8]), jnp.asarray(TARGET[:8]))
+        states = jax.jit(coll.functional_update)(coll.functional_init(), p, t)
+        res = coll.functional_compute(states)
+        assert abs(float(res["acc"]) - accuracy_score(TARGET, PREDS)) < 1e-6
+        assert abs(float(res["f1"]) - f1_score(TARGET, PREDS, average="macro")) < 1e-6
+        assert int(np.asarray(res["confmat"]).sum()) == N
+
+    def test_2d_sharded_inputs(self, mesh2d):
+        """(batch, seq) values sharded over BOTH mesh axes — the long-context
+        layout — reduce to the correct global mean under plain jit."""
+        m = MeanMetric()
+        vals = rng.rand(16, 8).astype(np.float32)
+        v = _shard(mesh2d, vals, P("data", "seq"))
+        state = jax.jit(m.functional_update)(m.functional_init(), v)
+        out = float(jax.jit(m.functional_compute)(state))
+        assert abs(out - float(vals.mean())) < 1e-6
+
+    def test_forward_under_jit(self, mesh):
+        """functional_forward (state', batch value) traces under jit with
+        sharded inputs too."""
+        m = MulticlassAccuracy(num_classes=C, average="micro", validate_args=False)
+        p = _shard(mesh, PREDS, P("batch"))
+        t = _shard(mesh, TARGET, P("batch"))
+        fwd = jax.jit(m.functional_forward)
+        state, batch_val = fwd(m.functional_init(), p, t)
+        assert abs(float(batch_val) - accuracy_score(TARGET, PREDS)) < 1e-6
+        val = float(jax.jit(m.functional_compute)(state))
+        assert abs(val - accuracy_score(TARGET, PREDS)) < 1e-6
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devices, ("data", "seq"))

@@ -513,6 +513,30 @@ class TestFunctionalCollection:
         r2.load_state(st)
         assert abs(float(r2.compute()) - float(r.compute())) < 1e-6
 
+    def test_bootstrapper_state_snapshots_and_mismatch(self):
+        """Poisson/list-state bootstraps export a snapshot layout; loading a
+        state with the wrong replicate count raises instead of silently
+        clamping (jax eager indexing clamps out-of-bounds)."""
+        from torchmetrics_tpu import MeanMetric
+        from torchmetrics_tpu.regression import SpearmanCorrCoef
+        from torchmetrics_tpu.wrappers import BootStrapper
+
+        p, t_ = jnp.asarray(rng.randn(16)), jnp.asarray(rng.randn(16))
+        b = BootStrapper(SpearmanCorrCoef(), num_bootstraps=4)  # default poisson
+        b.update(p, t_)
+        st = b.state()
+        assert "replicates" in st
+        b2 = BootStrapper(SpearmanCorrCoef(), num_bootstraps=4)
+        b2.load_state(st)
+        o1, o2 = b.compute(), b2.compute()
+        assert all(abs(float(o1[k]) - float(o2[k])) < 1e-6 for k in o1)
+
+        b8 = BootStrapper(MeanMetric(), num_bootstraps=8, sampling_strategy="multinomial")
+        b8.update(jnp.asarray([1.0, 2.0]))
+        b10 = BootStrapper(MeanMetric(), num_bootstraps=10, sampling_strategy="multinomial")
+        with pytest.raises(ValueError, match="8"):
+            b10.load_state(b8.state())
+
     def test_collection_merge_states(self):
         mc = self._make()
         mc.resolve_compute_groups(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))

@@ -20,6 +20,43 @@ def _require_mergeable_tensor_states(base: Metric, path_name: str) -> None:
         )
 
 
+def _stacked_state(metrics: Any) -> Any:
+    """Children's live states in the functional stacked ``(n, ...)`` layout,
+    falling back to a per-child ``replicates`` snapshot list when list/"cat"
+    states make stacking impossible (poisson bootstrap resamples, cat states
+    of differing lengths)."""
+    import jax
+    import jax.numpy as jnp
+
+    states = [m.state() for m in metrics]
+    if any(isinstance(d, list) for d in metrics[0]._defaults.values()):
+        return {"replicates": states}
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def _load_stacked_state(metrics: Any, state: Any) -> None:
+    """Inverse of :func:`_stacked_state`, validating the replicate count —
+    jax's eager indexing CLAMPS out-of-bounds, which would silently duplicate
+    the last replicate on a count mismatch."""
+    import jax
+
+    if isinstance(state, dict) and "replicates" in state:
+        reps = state["replicates"]
+        if len(reps) != len(metrics):
+            raise ValueError(f"state holds {len(reps)} replicate states but this wrapper has {len(metrics)}")
+        for m, st in zip(metrics, reps):
+            m.load_state(st)
+        return
+    leaves = jax.tree_util.tree_leaves(state)
+    if leaves and leaves[0].shape[0] != len(metrics):
+        raise ValueError(
+            f"state leading dimension {leaves[0].shape[0]} does not match this wrapper's"
+            f" {len(metrics)} child metrics"
+        )
+    for i, m in enumerate(metrics):
+        m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state))
+
+
 def _stacked_init(base: Metric, n: int) -> Any:
     """``n`` copies of the base default state stacked along a new leading axis —
     the vmap-ready state layout shared by the wrappers' functional paths."""

@@ -205,17 +205,16 @@ class BootStrapper(WrapperMetric):
         return self.metrics[0].merge_states(a, b, counts=counts)
 
     def state(self) -> Dict[str, Any]:
-        """Live per-replicate states stacked into the functional layout."""
-        import jax
-        import jax.numpy as jnp
+        """Live per-replicate states in the functional stacked layout (or a
+        ``replicates`` snapshot list for list-state bases / poisson resamples)."""
+        from torchmetrics_tpu.wrappers.abstract import _stacked_state
 
-        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[m.state() for m in self.metrics])
+        return _stacked_state(self.metrics)
 
     def load_state(self, state: Dict[str, Any]) -> None:
-        import jax
+        from torchmetrics_tpu.wrappers.abstract import _load_stacked_state
 
-        for i, m in enumerate(self.metrics):
-            m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state))
+        _load_stacked_state(self.metrics, state)
         self._computed = None
         self._update_count = max(self._update_count, 1)
 

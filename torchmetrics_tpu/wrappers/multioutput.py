@@ -179,17 +179,16 @@ class MultioutputWrapper(WrapperMetric):
         return self.metrics[0].merge_states(a, b, counts=counts)
 
     def state(self) -> Any:
-        """Live per-output states stacked into the functional layout."""
-        import jax
-        import jax.numpy as jnp
+        """Live per-output states in the functional stacked layout (or a
+        ``replicates`` snapshot list for list-state bases)."""
+        from torchmetrics_tpu.wrappers.abstract import _stacked_state
 
-        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[m.state() for m in self.metrics])
+        return _stacked_state(self.metrics)
 
     def load_state(self, state: Any) -> None:
-        import jax
+        from torchmetrics_tpu.wrappers.abstract import _load_stacked_state
 
-        for i, m in enumerate(self.metrics):
-            m.load_state(jax.tree_util.tree_map(lambda x: x[i], state))
+        _load_stacked_state(self.metrics, state)
         self._computed = None
         self._update_count = max(self._update_count, 1)
 
