@@ -18,7 +18,6 @@ from torchmetrics_tpu.functional.classification.auroc import (
 )
 from torchmetrics_tpu.functional.classification.roc import _multiclass_roc_compute, _multilabel_roc_compute
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 

@@ -6,7 +6,7 @@ State layout per mode:
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np

@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from jax import Array
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
 from torchmetrics_tpu.classification.precision_recall_curve import (

@@ -12,7 +12,7 @@ copy-on-access dance of the reference (:515-549) is unnecessary by construction.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np

@@ -12,7 +12,6 @@ from jax import Array
 
 from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     Thresholds,
-    _adjust_threshold_arg,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_format,
     _binary_precision_recall_curve_tensor_validation,
@@ -31,7 +30,7 @@ from torchmetrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
-from torchmetrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
+from torchmetrics_tpu.utils.compute import _safe_divide
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 

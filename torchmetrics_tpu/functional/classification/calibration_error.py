@@ -14,7 +14,6 @@ from jax import Array
 from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_format,
     _binary_confusion_matrix_tensor_validation,
-    _multiclass_confusion_matrix_format,
     _multiclass_confusion_matrix_tensor_validation,
 )
 from torchmetrics_tpu.utils.compute import _safe_divide

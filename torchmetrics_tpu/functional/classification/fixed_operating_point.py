@@ -21,7 +21,7 @@ objective is 0 — the returned threshold is the 1e6 sentinel.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple, Union
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ ROC / AUROC / AveragePrecision reuse this state and post-process.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp

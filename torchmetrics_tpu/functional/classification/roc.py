@@ -1,7 +1,7 @@
 """ROC curve (reference functional/classification/roc.py), built on the PR-curve state."""
 from __future__ import annotations
 
-from typing import List, Optional, Tuple, Union
+from typing import Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np

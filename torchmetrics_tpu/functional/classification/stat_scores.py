@@ -26,7 +26,7 @@ from jax import Array
 
 from torchmetrics_tpu.utils.checks import _is_float_dtype, _check_same_shape, _is_concrete
 from torchmetrics_tpu.utils.compute import _safe_divide
-from torchmetrics_tpu.utils.data import _bincount, select_topk
+from torchmetrics_tpu.utils.data import select_topk
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 

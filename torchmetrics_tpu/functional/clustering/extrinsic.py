@@ -8,7 +8,7 @@ grid evaluation.
 """
 from __future__ import annotations
 
-from typing import Tuple, Union
+from typing import Tuple
 
 import jax.numpy as jnp
 from jax import Array

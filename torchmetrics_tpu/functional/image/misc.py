@@ -5,7 +5,7 @@ Reference: functional/image/{tv,uqi,sam,ergas,rmse_sw,rase,scc}.py.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple, Union
+from typing import Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 from jax import Array

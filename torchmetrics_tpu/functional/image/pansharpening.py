@@ -4,7 +4,7 @@ Reference: functional/image/{d_lambda,d_s,qnr}.py — built on per-band UQI.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -1,7 +1,7 @@
 """Explained variance (reference functional/regression/explained_variance.py)."""
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array

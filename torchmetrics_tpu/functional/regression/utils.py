@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from jax import Array
 
-from torchmetrics_tpu.utils.checks import _check_same_shape
 
 
 def _check_data_shape_to_num_outputs(

@@ -7,7 +7,7 @@ States are jnp scalars so the modular classes psum-sync them over the mesh.
 from __future__ import annotations
 
 import math
-from typing import List, Sequence, Tuple, Union
+from typing import List, Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array

@@ -14,7 +14,7 @@ weights (zero-egress: no downloads are attempted).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np

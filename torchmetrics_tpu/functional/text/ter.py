@@ -14,7 +14,6 @@ scalars (total edits, total reference length).
 from __future__ import annotations
 
 import re
-from functools import lru_cache
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp

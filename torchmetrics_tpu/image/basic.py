@@ -18,7 +18,6 @@ from torchmetrics_tpu.functional.image.misc import (
     _total_variation_update,
     error_relative_global_dimensionless_synthesis,
     relative_average_spectral_error,
-    root_mean_squared_error_using_sliding_window,
     spatial_correlation_coefficient,
     spectral_angle_mapper,
     universal_image_quality_index,
@@ -29,19 +28,16 @@ from torchmetrics_tpu.functional.image.pansharpening import (
     spectral_distortion_index,
 )
 from torchmetrics_tpu.functional.image.psnr import (
-    peak_signal_noise_ratio,
-    peak_signal_noise_ratio_with_blocked_effect,
     _compute_bef,
     _psnr_compute,
     _psnr_update,
 )
 from torchmetrics_tpu.functional.image.ssim import (
     multiscale_structural_similarity_index_measure,
-    structural_similarity_index_measure,
     _ssim_check_inputs,
     _ssim_update,
 )
-from torchmetrics_tpu.functional.image.vif import _vif_per_channel, visual_information_fidelity
+from torchmetrics_tpu.functional.image.vif import _vif_per_channel
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utils.data import dim_zero_cat
 

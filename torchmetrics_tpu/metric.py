@@ -41,16 +41,10 @@ from torchmetrics_tpu.parallel.sync import (
     host_sync_value,
     in_named_axis_context,
     sync_states,
-    sync_value,
 )
 from torchmetrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
-    dim_zero_cat,
-    dim_zero_max,
-    dim_zero_mean,
-    dim_zero_min,
-    dim_zero_sum,
 )
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.utils.prints import rank_zero_warn

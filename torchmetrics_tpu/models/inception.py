@@ -21,7 +21,7 @@ takes; random init gives architecture-correct shapes for testing.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import flax.linen as nn
 import jax

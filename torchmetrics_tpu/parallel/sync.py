@@ -16,7 +16,7 @@ the PartitionSpec-aware generalisation of the reference's ``dist_reduce_fx``.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Sequence, Union
 
 import jax
 import jax.numpy as jnp

@@ -26,7 +26,6 @@ from torchmetrics_tpu.functional.regression.pearson import (
 from torchmetrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_update
 from torchmetrics_tpu.functional.regression.rank_based import (
     _concordance_corrcoef_compute,
-    _kendall_tau_update,
     _spearman_corrcoef_compute,
 )
 from torchmetrics_tpu.metric import Metric

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from torchmetrics_tpu.functional.text.bert import bert_score
-from torchmetrics_tpu.functional.text.infolm import _ALLOWED_INFORMATION_MEASURE, _InformationMeasure, infolm
+from torchmetrics_tpu.functional.text.infolm import _InformationMeasure, infolm
 from torchmetrics_tpu.metric import Metric
 
 

@@ -48,10 +48,10 @@ def _load_stacked_state(metrics: Any, state: Any) -> None:
             m.load_state(st)
         return
     leaves = jax.tree_util.tree_leaves(state)
-    if leaves and leaves[0].shape[0] != len(metrics):
+    if leaves and leaves[0].shape[:1] != (len(metrics),):
         raise ValueError(
-            f"state leading dimension {leaves[0].shape[0]} does not match this wrapper's"
-            f" {len(metrics)} child metrics"
+            f"state leading dimension {leaves[0].shape[:1] or 'scalar'} does not match this"
+            f" wrapper's {len(metrics)} child metrics"
         )
     for i, m in enumerate(metrics):
         m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state))

@@ -7,7 +7,7 @@ weights). compute → mean/std/quantile/raw over the copies.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, Optional, Sequence, Union
+from typing import Any, Dict, Optional, Union
 
 import jax.numpy as jnp
 import numpy as np

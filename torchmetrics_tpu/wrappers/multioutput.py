@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np

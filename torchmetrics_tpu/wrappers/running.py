@@ -6,7 +6,7 @@ Requires ``full_state_update=False`` on the base metric.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.wrappers.abstract import WrapperMetric

@@ -6,7 +6,7 @@ clones the base per step; ``best_metric``/``compute_all`` across steps.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
