@@ -513,6 +513,39 @@ class TestFunctionalCollection:
         r2.load_state(st)
         assert abs(float(r2.compute()) - float(r.compute())) < 1e-6
 
+    def test_tracker_state_roundtrip(self):
+        """MetricTracker joins the state()/load_state contract: per-step states
+        restore into a fresh tracker with identical compute_all/best_metric."""
+        from torchmetrics_tpu.classification import BinaryAccuracy
+        from torchmetrics_tpu.wrappers import MetricTracker
+
+        t_ = jnp.asarray([0, 1, 1, 0])
+        # three DISTINCT per-step accuracies (1.0, 0.25, 0.5) so a restore
+        # that duplicates, drops or reorders steps cannot pass
+        step_preds = [
+            jnp.asarray([0.2, 0.8, 0.7, 0.1]),
+            jnp.asarray([0.8, 0.2, 0.3, 0.9]),
+            jnp.asarray([0.2, 0.8, 0.3, 0.6]),
+        ]
+        tr = MetricTracker(BinaryAccuracy())
+        for p in step_preds:
+            tr.increment()
+            tr.update(p, t_)
+        all_vals = np.asarray(tr.compute_all())
+        assert len(set(all_vals.round(4).tolist())) == 3  # genuinely distinct
+        tr2 = MetricTracker(BinaryAccuracy())
+        tr2.load_state(tr.state())
+        assert tr2.n_steps == 3
+        np.testing.assert_allclose(np.asarray(tr2.compute_all()), all_vals)
+        assert tr.best_metric(return_step=True) == tr2.best_metric(return_step=True)
+        # a bad step state raises cleanly and leaves the target untouched
+        bad = tr.state()
+        bad["steps"][1] = {"wrong_field": jnp.asarray(0.0)}
+        before = np.asarray(tr2.compute_all())
+        with pytest.raises(KeyError):
+            tr2.load_state(bad)
+        np.testing.assert_allclose(np.asarray(tr2.compute_all()), before)
+
     def test_bootstrapper_state_snapshots_and_mismatch(self):
         """Poisson/list-state bootstraps export a snapshot layout; loading a
         state with the wrong replicate count raises instead of silently

@@ -104,6 +104,23 @@ class MetricTracker:
         for metric in self._steps:
             metric.reset()
 
+    def state(self) -> Dict[str, Any]:
+        """Per-step states, completing the state()/load_state contract the rest
+        of the wrapper family shares (each step is the base metric's layout)."""
+        return {"steps": [m.state() for m in self._steps]}
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        # build the new steps fully before swapping them in: a bad step state
+        # must raise cleanly, not leave a half-loaded tracker behind
+        new_steps: List[Union[Metric, MetricCollection]] = []
+        for st in state["steps"]:
+            m = deepcopy(self._base_metric)
+            m.reset()
+            m.load_state(st)
+            new_steps.append(m)
+        self._steps = new_steps
+        self._increment_called = bool(self._steps)
+
     def _best(self, values: Array, maximize: bool) -> Tuple[float, int]:
         idx = int(jnp.argmax(values)) if maximize else int(jnp.argmin(values))
         return float(values[idx]), idx
