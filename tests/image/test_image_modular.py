@@ -164,6 +164,25 @@ class TestGenerativeMetrics:
         ours = float(_compute_fid(jnp.asarray(mu1), jnp.asarray(s1), jnp.asarray(mu2), jnp.asarray(s2)))
         assert abs(ours - ref_fid) / abs(ref_fid) < 1e-3
 
+    def test_fid_rank_deficient_covariance(self):
+        """Fewer samples than features (the quick-eval regime) must produce a
+        finite FID matching scipy's exact sqrtm — the Newton-Schulz iteration
+        this replaced returned NaN here."""
+        from scipy import linalg
+
+        from torchmetrics_tpu.image.fid import _compute_fid
+
+        rng2 = np.random.RandomState(7)
+        n, f = 24, 96  # rank(cov) = 23 << 96
+        f1 = rng2.randn(n, f)
+        f2 = rng2.randn(n, f) * 1.1 + 0.3
+        mu1, mu2 = f1.mean(0), f2.mean(0)
+        s1, s2 = np.cov(f1, rowvar=False), np.cov(f2, rowvar=False)
+        ref_fid = ((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * linalg.sqrtm(s1 @ s2).real)
+        ours = float(_compute_fid(jnp.asarray(mu1), jnp.asarray(s1), jnp.asarray(mu2), jnp.asarray(s2)))
+        assert np.isfinite(ours)
+        assert abs(ours - ref_fid) / abs(ref_fid) < 5e-3
+
     def test_fid_reset_real_features(self):
         fid = I.FrechetInceptionDistance(feature_extractor=self._features, num_features=16, reset_real_features=False)
         real = rng.rand(32, 3, 8, 8).astype(np.float32)

@@ -3,8 +3,9 @@
 States are *streaming second-moment sums* (feature sum, outer-product sum,
 sample count — all ``dist_reduce_fx="sum"``, reference fid.py:347-353) so the
 metric psum-syncs across a mesh in O(F²). compute = mean/cov from sums + the
-Fréchet distance with a Newton–Schulz matrix square root (pure JAX; replaces the
-reference's scipy.linalg.sqrtm — SURVEY §2.16).
+Fréchet distance via a symmetric-eigh trace identity (pure JAX, TPU-supported,
+robust to rank-deficient covariances; replaces the reference's
+eigvals/scipy.linalg.sqrtm — SURVEY §2.16).
 
 The feature network is pluggable exactly like the reference's user
 feature-extractor escape hatch (fid.py: ``feature`` accepts a Module). Pretrained
@@ -21,28 +22,22 @@ from jax import Array
 from torchmetrics_tpu.metric import Metric
 
 
-def _newton_schulz_sqrtm(mat: Array, num_iters: int = 50, eps: float = 1e-12) -> Array:
-    """Matrix square root via Newton–Schulz iteration (TPU-friendly matmuls)."""
-    dim = mat.shape[0]
-    norm = jnp.linalg.norm(mat)
-    y = mat / (norm + eps)
-    z = jnp.eye(dim, dtype=mat.dtype)
-    identity = jnp.eye(dim, dtype=mat.dtype)
-    for _ in range(num_iters):
-        t = 0.5 * (3.0 * identity - z @ y)
-        y = y @ t
-        z = t @ z
-    return y * jnp.sqrt(norm + eps)
-
-
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
-    """Fréchet distance between two gaussians (reference fid.py:159-180)."""
+    """Fréchet distance between two gaussians (reference fid.py:159-180).
+
+    The reference computes ``sum(sqrt(eigvals(sigma1 @ sigma2)))``; general
+    (non-symmetric) eigendecomposition does not exist on TPU, so we use the
+    symmetric identity ``Tr sqrt(S1 S2) = Tr sqrt(S1^1/2 S2 S1^1/2)`` — two
+    ``eigh`` calls, TPU-supported and robust to the rank-deficient covariances
+    a small sample count produces (where a Newton–Schulz sqrtm iteration, the
+    previous implementation, returned NaN).
+    """
     diff = mu1 - mu2
-    # trace of sqrtm(sigma1 @ sigma2): stabilised with a small diagonal jitter
-    dim = sigma1.shape[0]
-    offset = jnp.eye(dim, dtype=sigma1.dtype) * 1e-6
-    covmean = _newton_schulz_sqrtm((sigma1 + offset) @ (sigma2 + offset))
-    tr_covmean = jnp.trace(covmean)
+    e1, v1 = jnp.linalg.eigh(sigma1)
+    s1h = (v1 * jnp.sqrt(jnp.clip(e1, 0.0, None))) @ v1.T  # sigma1^(1/2), PSD-projected
+    inner = s1h @ sigma2 @ s1h
+    inner = 0.5 * (inner + inner.T)  # re-symmetrize float rounding
+    tr_covmean = jnp.sqrt(jnp.clip(jnp.linalg.eigvalsh(inner), 0.0, None)).sum()
     return (diff @ diff) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 

@@ -65,7 +65,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         >>> mifid.update(real, real=True)
         >>> mifid.update(fake, real=False)
         >>> round(float(mifid.compute()), 4)
-        0.0032
+        0.0033
     """
 
     is_differentiable = False
