@@ -321,7 +321,7 @@ def bench_config3():
     def fid_update_pair():
         fid.update(fr, real=True)
         fid.update(ff, real=False)
-        jax.block_until_ready(fid.real_features_cov_sum)  # async dispatch must not leak out of the timer
+        jax.block_until_ready(fid.fake_features_cov_sum)  # last write: async dispatch must not leak out of the timer
 
     fid_update = _time_host(fid_update_pair, steps=10)
     jax.block_until_ready(fid.compute())  # warm the eigh compile before timing

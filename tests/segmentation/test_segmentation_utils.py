@@ -100,10 +100,33 @@ def test_surface_distance():
     np.testing.assert_allclose(np.sort(got), np.sort(want), atol=1e-5)
 
 
+def test_surface_area_table_and_3d_mask_edges():
+    """3-D spacing path: marching-cubes surface-area table and neighbour codes
+    match the reference for several anisotropic spacings."""
+    from torchmetrics.functional.segmentation.utils import table_surface_area as ref_table
+    from torchmetrics_tpu.functional.segmentation.utils import table_surface_area
+
+    for sp in [(1, 1, 1), (2, 2, 2), (1, 2, 3)]:
+        ours_t, ours_k = table_surface_area(sp)
+        want_t, want_k = ref_table(sp)
+        np.testing.assert_allclose(np.asarray(ours_t), want_t.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ours_k).reshape(-1), want_k.numpy().reshape(-1))
+
+    rng = np.random.RandomState(3)
+    p = rng.rand(10, 11, 12) > 0.6
+    t = rng.rand(10, 11, 12) > 0.6
+    got = mask_edges(p, t, crop=True, spacing=(1, 2, 3))
+    want = ref_mask_edges(torch.from_numpy(p), torch.from_numpy(t), crop=True, spacing=(1, 2, 3))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g).astype(np.float32), w.numpy().squeeze().astype(np.float32), rtol=1e-5
+        )
+
+
 def test_validation():
     with pytest.raises(ValueError, match="binarized"):
         binary_erosion(MASK * 3)
     with pytest.raises(ValueError, match="rank 2"):
         distance_transform(MASK2D[0])
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="match the mask rank"):
         mask_edges(MASK2D.astype(bool), MASK2D.astype(bool), spacing=(1, 1, 1))

@@ -1,0 +1,49 @@
+"""Extract the marching-cubes surface-normal lookup table into a package fixture.
+
+The 256-entry neighbour-code -> surface-normal table is public lookup data from
+deepmind/surface-distance (Apache-2.0), embedded by the reference at
+functional/segmentation/utils.py:452 (itself citing the DeepMind repo). This
+script parses that literal out of the reference source with ``ast`` (no code is
+copied — the output is a binary data fixture) and writes
+``torchmetrics_tpu/functional/segmentation/_surface_normals.npz`` with a
+``normals`` array of shape (256, 4, 3).
+
+Run offline once: ``python tools/gen_surface_tables.py``.
+"""
+import ast
+import pathlib
+
+import numpy as np
+
+REF = pathlib.Path("/root/reference/src/torchmetrics/functional/segmentation/utils.py")
+OUT = pathlib.Path(__file__).resolve().parent.parent / "torchmetrics_tpu" / "functional" / "segmentation" / "_surface_normals.npz"
+
+
+def main() -> None:
+    tree = ast.parse(REF.read_text())
+    fn = next(
+        n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef) and n.name == "table_surface_area"
+    )
+    rows = None
+    for node in ast.walk(fn):
+        # the big literal is the first argument of torch.tensor([...]) assigned to `table`
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "table" for t in node.targets
+        ):
+            call = node.value
+            if isinstance(call, ast.Call) and call.args:
+                lst = call.args[0]
+                # substitute the `zeros` name ([0.,0.,0.]) before literal_eval
+                src = ast.unparse(lst).replace("zeros", "[0.0, 0.0, 0.0]")
+                rows = ast.literal_eval(src)
+                break
+    assert rows is not None, "table literal not found"
+    normals = np.asarray(rows, dtype=np.float32)
+    assert normals.shape == (256, 4, 3), normals.shape
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(OUT, normals=normals)
+    print(f"wrote {OUT} {normals.shape} ({OUT.stat().st_size} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,8 +9,10 @@ Binary morphology and distance machinery for boundary metrics. TPU notes:
   the reference's pytorch engine (O(N^2) worst-case memory, fine for the mask
   sizes boundary metrics see); the scipy engine is the memory-lean host
   fallback.
-- 3-D ``spacing`` (surface-area neighbour tables) is not implemented yet; the
-  2-D contour-length table is formula-driven from the pixel spacing.
+- ``spacing`` tables: the 2-D contour-length table is formula-driven from the
+  pixel spacing; the 3-D surface-area table scales the marching-cubes normal
+  lookup (``_surface_normals.npz``, public deepmind/surface-distance data) by
+  the per-face voxel areas.
 """
 from __future__ import annotations
 
@@ -163,14 +165,46 @@ def table_contour_length(spacing: Tuple[int, int]) -> Tuple[Array, Array]:
     return jnp.asarray(table), kernel
 
 
+@lru_cache
+def _surface_normals() -> np.ndarray:
+    """The 256-code marching-cubes surface-normal lookup, shape (256, 4, 3).
+
+    Public lookup data from deepmind/surface-distance (Apache-2.0), the same
+    table the reference embeds at functional/segmentation/utils.py:452; stored
+    here as a binary fixture (tools/gen_surface_tables.py documents the
+    extraction)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_surface_normals.npz")
+    return np.load(path)["normals"]
+
+
+@lru_cache
+def table_surface_area(spacing: Tuple[int, int, int]) -> Tuple[Array, Array]:
+    """Neighbour-code -> surface area table for 3-D masks (reference utils.py:452-532).
+
+    Each 2x2x2 neighbourhood encodes to an 8-bit code via the
+    [[[128,64],[32,16]],[[8,4],[2,1]]] kernel; a code's area is the sum of the
+    norms of its marching-cubes surface normals scaled by the per-face voxel
+    areas (s1*s2, s0*s2, s0*s1)."""
+    if not isinstance(spacing, tuple) or len(spacing) != 3:
+        raise ValueError("The spacing must be a tuple of length 3.")
+    normals = _surface_normals()  # (256, 4, 3)
+    face = np.asarray(
+        [spacing[1] * spacing[2], spacing[0] * spacing[2], spacing[0] * spacing[1]], dtype=np.float32
+    )
+    table = np.linalg.norm(normals * face, axis=-1).sum(-1)
+    kernel = jnp.asarray([[[128, 64], [32, 16]], [[8, 4], [2, 1]]], dtype=jnp.float32)
+    return jnp.asarray(table), kernel
+
+
 def get_neighbour_tables(spacing: Union[Tuple[int, int], Tuple[int, int, int]]) -> Tuple[Array, Array]:
-    """Dispatch to the contour-length (2-D) table; 3-D surface areas are a known gap."""
+    """Dispatch to the contour-length (2-D) or surface-area (3-D) table
+    (reference utils.py:387-405)."""
     if isinstance(spacing, tuple) and len(spacing) == 2:
         return table_contour_length(spacing)
     if isinstance(spacing, tuple) and len(spacing) == 3:
-        raise NotImplementedError(
-            "3-D surface-area neighbour tables are not implemented yet; use spacing=None (erosion-based edges)."
-        )
+        return table_surface_area(spacing)
     raise ValueError("The spacing must be a tuple of length 2 or 3.")
 
 
@@ -185,16 +219,30 @@ def _neighbour_codes_2d(mask: Array, kernel: Array) -> Array:
     ).astype(jnp.int32)
 
 
+def _neighbour_codes_3d(mask: Array, kernel: Array) -> Array:
+    """Valid-mode 2x2x2 correlation producing the neighbour code per position."""
+    m = mask.astype(jnp.float32)
+    out = jnp.zeros(tuple(s - 1 for s in m.shape), dtype=jnp.float32)
+    for i in range(2):
+        for j in range(2):
+            for k in range(2):
+                sl = (slice(i, m.shape[0] - 1 + i), slice(j, m.shape[1] - 1 + j), slice(k, m.shape[2] - 1 + k))
+                out = out + m[sl] * kernel[i, j, k]
+    return out.astype(jnp.int32)
+
+
 def mask_edges(
     preds: Array,
     target: Array,
     crop: bool = True,
     spacing: Optional[Tuple[int, ...]] = None,
 ):
-    """Edges (and, with spacing, per-position contour areas) of two binary masks.
+    """Edges (and, with spacing, per-position contour/surface areas) of two
+    binary masks.
 
     Reference utils.py:278-333. Without spacing: edge = mask XOR eroded(mask).
-    With 2-D spacing: neighbour-code table lookup.
+    With spacing: neighbour-code table lookup (marching squares in 2-D,
+    marching-cubes surface areas in 3-D).
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -219,9 +267,12 @@ def mask_edges(
         be_target = binary_erosion(target[None, None]).squeeze((0, 1)).astype(bool) ^ target
         return be_pred, be_target
 
+    if len(spacing) != preds.ndim:
+        raise ValueError(f"`spacing` length {len(spacing)} must match the mask rank {preds.ndim}.")
     table, kernel = get_neighbour_tables(spacing)
-    code_preds = _neighbour_codes_2d(preds, kernel)
-    code_target = _neighbour_codes_2d(target, kernel)
+    codes = _neighbour_codes_3d if len(spacing) == 3 else _neighbour_codes_2d
+    code_preds = codes(preds, kernel)
+    code_target = codes(target, kernel)
     all_ones = table.shape[0] - 1
     edges_preds = (code_preds != 0) & (code_preds != all_ones)
     edges_target = (code_target != 0) & (code_target != all_ones)
