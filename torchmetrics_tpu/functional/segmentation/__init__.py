@@ -7,6 +7,7 @@ from torchmetrics_tpu.functional.segmentation.utils import (  # noqa: F401
     mask_edges,
     surface_distance,
     table_contour_length,
+    table_surface_area,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "mask_edges",
     "surface_distance",
     "table_contour_length",
+    "table_surface_area",
 ]
