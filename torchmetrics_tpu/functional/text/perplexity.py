@@ -1,9 +1,11 @@
 """Perplexity — the fully device-native text metric.
 
-Reference: functional/text/perplexity.py:65-126. TPU design: pure jnp with
-`log_softmax` + `take_along_axis` (numerically better than the reference's
-softmax→index→log and a single fused XLA kernel); `ignore_index` handled by a
-mask so shapes stay static under jit. The two outputs are psum-able scalars.
+Reference: functional/text/perplexity.py:65-126. TPU design: the gathered-logit
+identity ``-log p[t] = logsumexp(logits) - logits[t]`` — one reduction over the
+(N, V) logits without materializing a full log-prob array (numerically better
+than the reference's softmax→index→log, and HBM-bandwidth-shaped);
+`ignore_index` handled by a mask so shapes stay static under jit. The two
+outputs are psum-able scalars.
 """
 from __future__ import annotations
 
@@ -37,9 +39,15 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
 
 
 def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
-    """Σ(-log p[target]) and token count (reference perplexity.py:66-111), jit-safe."""
+    """Σ(-log p[target]) and token count (reference perplexity.py:66-111), jit-safe.
+
+    ``-log p[t] = logsumexp(logits) - logits[t]``: the gathered-logit identity
+    reads the (N, V) logits for one reduction and never materializes the full
+    (N, V) log-prob array a ``log_softmax`` + gather would write and re-read —
+    the HBM-bandwidth-shaped formulation of the same math.
+    """
     _check_shape_and_type_consistency(preds, target)
-    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    logits = preds.reshape(-1, preds.shape[-1]).astype(jnp.float32)
     target_flat = target.reshape(-1)
 
     if ignore_index is not None:
@@ -48,7 +56,9 @@ def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] 
     else:
         mask = jnp.ones_like(target_flat, dtype=bool)
 
-    token_log_probs = jnp.take_along_axis(log_probs, target_flat[:, None], axis=1).squeeze(1)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    token_logits = jnp.take_along_axis(logits, target_flat[:, None], axis=1).squeeze(1)
+    token_log_probs = token_logits - lse
     total_log_probs = -jnp.sum(token_log_probs * mask)
     count = jnp.sum(mask)
     return total_log_probs, count
