@@ -94,3 +94,16 @@ def test_top_level_namespace_parity():
     ref = _ref_all("__init__.py")
     missing = [n for n in ref if not hasattr(tm, n)]
     assert missing == [], f"top-level namespace missing: {missing}"
+
+
+def test_utilities_namespace_parity():
+    """The reference's torchmetrics.utilities.__all__ surface exists on
+    torchmetrics_tpu.utils (our spelling of the same namespace)."""
+    from torchmetrics_tpu import utils
+
+    ref = _ref_all("utilities/__init__.py")
+    assert ref, "reference utilities __all__ not found"
+    missing = [n for n in ref if not hasattr(utils, n)]
+    assert missing == [], f"utils namespace missing: {missing}"
+    not_exported = [n for n in ref if n not in utils.__all__]
+    assert not_exported == [], f"utils.__all__ misses reference names: {not_exported}"

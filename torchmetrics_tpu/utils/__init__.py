@@ -15,3 +15,22 @@ from torchmetrics_tpu.utils.data import (  # noqa: F401
     to_onehot,
 )
 from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401
+
+# tensor reductions the reference exports from torchmetrics.utilities
+# (utilities/__init__.py: class_reduce, reduce); implemented with the sync
+# machinery they serve
+from torchmetrics_tpu.parallel.sync import class_reduce, reduce  # noqa: F401, E402
+
+__all__ = [
+    "check_forward_full_state_property",
+    "class_reduce",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "reduce",
+]
