@@ -159,9 +159,8 @@ def _tokens_to_ids(*sequences: Sequence) -> List[np.ndarray]:
     flat: List = [t for s in sequences for t in s]
     if not flat:
         return [np.zeros(0, dtype=np.int64) for _ in sequences]
-    t0 = type(flat[0])
     try:
-        if any(type(tok) is not t0 for tok in flat):
+        if len(set(map(type, flat))) > 1:
             raise TypeError  # mixed types: np.asarray would coerce (e.g. 1 -> "1")
         arr = np.asarray(flat)
         if arr.ndim != 1:  # e.g. equal-length tuple tokens coerced to 2-D
