@@ -122,3 +122,62 @@ class TestTwoAxisSync:
         np.testing.assert_allclose(
             float(jax.jit(step)(jnp.asarray(preds), jnp.asarray(target))), float(full.compute()), rtol=1e-6
         )
+
+
+class TestFusedSyncConsistency:
+    """The concat-fused sync_states must be indistinguishable from per-field
+    sync_value across randomized state layouts (mixed reductions, dtypes,
+    shapes, 0-d scalars, lists) on 1-axis and 2-axis meshes."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fused_equals_per_field(self, seed):
+        from torchmetrics_tpu.parallel.sync import sync_states, sync_value
+
+        rng = np.random.RandomState(seed)
+        reductions = ["sum", "mean", "max", "min", "cat", None]
+        dtypes = [np.float32, np.int32, np.float16]
+        n_fields = rng.randint(2, 8)
+        layout = {}
+        for i in range(n_fields):
+            fx = reductions[rng.randint(len(reductions))]
+            dt = dtypes[rng.randint(len(dtypes))]
+            shape = () if rng.rand() < 0.3 else tuple(rng.randint(1, 4, rng.randint(1, 3)))
+            layout[f"f{i}"] = (fx, dt, shape)
+        # one list ('growing') state per layout half the time
+        if rng.rand() < 0.5:
+            layout["lst"] = ("cat", np.float32, "list")
+
+        two_axis = seed % 2 == 1
+        if two_axis:
+            mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("a", "b"))
+            axis = ("a", "b")
+        else:
+            mesh = Mesh(np.array(jax.devices()[:8]), ("a",))
+            axis = "a"
+
+        def make_states():
+            states, reds = {}, {}
+            for name, (fx, dt, shape) in layout.items():
+                reds[name] = fx
+                if shape == "list":
+                    states[name] = [jnp.asarray(rng.rand(3).astype(dt))]
+                else:
+                    v = (rng.rand(*shape) * 10).astype(dt) if shape else dt(rng.rand() * 10)
+                    states[name] = jnp.asarray(v)
+            return states, reds
+
+        states, reds = make_states()
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(), out_specs=(P(), P()), check_vma=False)
+        def both():
+            fused = sync_states(states, reds, axis)
+            naive = {k: sync_value(v, reds.get(k), axis) for k, v in states.items()}
+            return fused, naive
+
+        fused, naive = both()
+        flat_f = jax.tree_util.tree_leaves(fused)
+        flat_n = jax.tree_util.tree_leaves(naive)
+        assert len(flat_f) == len(flat_n)
+        for a, b in zip(flat_f, flat_n):
+            assert a.dtype == b.dtype, (a.dtype, b.dtype)
+            np.testing.assert_allclose(np.asarray(a, dtype=np.float64), np.asarray(b, dtype=np.float64), rtol=1e-3)
