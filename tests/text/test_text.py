@@ -506,6 +506,12 @@ class TestTextSync:
         per_shard = [metric.init_state() for _ in range(8)]
         for i in range(8):
             per_shard[i] = metric.functional_update(per_shard[i], [preds[i]], [target[i]])
+        # _wer_update returns host floats (asr.py contract) but the class state
+        # they fold into must stay a psum-able device Array with a pinned dtype
+        # — no asarray coercion here, or a host-float regression would hide
+        for s in per_shard:
+            assert isinstance(s["errors"], jax.Array) and s["errors"].dtype == jnp.float32
+            assert isinstance(s["total"], jax.Array) and s["total"].dtype == jnp.float32
         errors = jnp.stack([s["errors"] for s in per_shard])
         totals = jnp.stack([s["total"] for s in per_shard])
 

@@ -20,6 +20,7 @@ import numpy as np
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.parallel.sync import sync_states
 from torchmetrics_tpu.utils.data import _flatten_dict
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 _PREFIX_SUFFIX_ERROR = "Expected input `{}` to be a string, but got {}"
 
@@ -450,7 +451,7 @@ class MetricCollection:
         (followers share the leader's state, reference collections.py:289-308)."""
         return {cg[0]: self._modules[cg[0]].state() for cg in self._groups.values()}
 
-    def load_state(self, states: Dict[str, Dict[str, Any]]) -> None:
+    def load_state(self, states: Dict[str, Dict[str, Any]], update_count: Optional[int] = None) -> None:
         """Install leader-keyed state pytrees into every member of each group.
 
         The saved keys reflect the SOURCE collection's resolved groups, which
@@ -479,8 +480,22 @@ class MetricCollection:
                         " resolution to disambiguate"
                     )
                 st = states[cands[0]]
+                # the match is structural only (field names/shapes/dtypes) — a
+                # state saved from a different collection whose single entry
+                # happens to share the layout would load silently. The expected
+                # fallback case is a same-collection topology change (saved
+                # after auto-grouping, loaded into singleton groups): there the
+                # matched key names a member of THIS collection. An unknown key
+                # means the states came from somewhere else — make that visible.
+                if cands[0] not in self._modules:
+                    rank_zero_warn(
+                        f"load_state: group leader {cg[0]!r} not in saved states; matched saved"
+                        f" state {cands[0]!r} (not a member of this collection) by field-layout"
+                        " signature only. Verify the states were saved from an equivalent"
+                        " collection."
+                    )
             for name in cg:
-                self._modules[name].load_state(st)
+                self._modules[name].load_state(st, update_count=update_count)
 
     def merge_states(
         self,

@@ -530,17 +530,32 @@ class Metric:
                 out[attr] = jnp.stack([jnp.atleast_1d(va), jnp.atleast_1d(vb)])
         return out
 
-    def load_state(self, state: Dict[str, Any]) -> None:
-        """Install a state pytree as the live state (inverse of :meth:`state`)."""
+    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
+        """Install a state pytree as the live state (inverse of :meth:`state`).
+
+        ``update_count`` restores the number of updates the state represents;
+        without it the count is set to exactly 1 (a restored state counts as
+        updated so ``compute()`` does not warn, and a stale pre-load count on the
+        target instance is never kept). Metrics whose states declare a ``"mean"``
+        reduction (none in-tree — MeanMetric carries an explicit weight state)
+        need the true count for count-weighted ``forward`` merges after resume.
+        """
         for k in self._defaults:
             if k not in state:
                 raise KeyError(f"state missing field {k!r}")
             v = state[k]
             self._state[k] = list(v) if isinstance(v, (list, tuple)) else v
         self._computed = None
-        # a restored state counts as updated: compute() must not warn on the
-        # checkpoint-resume flow
-        self._update_count = max(self._update_count, 1)
+        self._update_count = self._restored_count(update_count)
+
+    @staticmethod
+    def _restored_count(update_count: Optional[int], fallback: int = 1) -> int:
+        """The single restore policy for ``load_state``'s update count: the
+        explicit value when given, else ``fallback`` (default exactly 1 — a
+        restored state counts as updated, and a stale pre-load count on the
+        target instance is never kept). Wrappers whose exported state carries
+        its own count (MinMax, Running) pass that count as ``fallback``."""
+        return int(update_count) if update_count is not None else int(fallback)
 
     # ------------------------------------------------------------- lifecycle
     def reset(self) -> None:

@@ -34,10 +34,11 @@ def _stacked_state(metrics: Any) -> Any:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
 
 
-def _load_stacked_state(metrics: Any, state: Any) -> None:
+def _load_stacked_state(metrics: Any, state: Any, update_count: Any = None) -> None:
     """Inverse of :func:`_stacked_state`, validating the replicate count —
     jax's eager indexing CLAMPS out-of-bounds, which would silently duplicate
-    the last replicate on a count mismatch."""
+    the last replicate on a count mismatch. ``update_count`` is forwarded to
+    every child so wrapper and children agree after a restore."""
     import jax
 
     if isinstance(state, dict) and "replicates" in state:
@@ -45,7 +46,7 @@ def _load_stacked_state(metrics: Any, state: Any) -> None:
         if len(reps) != len(metrics):
             raise ValueError(f"state holds {len(reps)} replicate states but this wrapper has {len(metrics)}")
         for m, st in zip(metrics, reps):
-            m.load_state(st)
+            m.load_state(st, update_count=update_count)
         return
     leaves = jax.tree_util.tree_leaves(state)
     if leaves and leaves[0].shape[:1] != (len(metrics),):
@@ -54,7 +55,7 @@ def _load_stacked_state(metrics: Any, state: Any) -> None:
             f" wrapper's {len(metrics)} child metrics"
         )
     for i, m in enumerate(metrics):
-        m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state))
+        m.load_state(jax.tree_util.tree_map(lambda x, i=i: x[i], state), update_count=update_count)
 
 
 def _stacked_init(base: Metric, n: int) -> Any:

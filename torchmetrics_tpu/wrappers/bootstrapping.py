@@ -211,12 +211,12 @@ class BootStrapper(WrapperMetric):
 
         return _stacked_state(self.metrics)
 
-    def load_state(self, state: Dict[str, Any]) -> None:
+    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
         from torchmetrics_tpu.wrappers.abstract import _load_stacked_state
 
-        _load_stacked_state(self.metrics, state)
+        _load_stacked_state(self.metrics, state, update_count=update_count)
         self._computed = None
-        self._update_count = max(self._update_count, 1)
+        self._update_count = self._restored_count(update_count)
 
     def functional_compute(self, state: Dict[str, Any]) -> Dict[str, Array]:
         """Mean/std/quantile/raw across the vmapped replicate axis."""

@@ -64,10 +64,10 @@ class ClasswiseWrapper(WrapperMetric):
     def state(self) -> Dict[str, Any]:
         return self.metric.state()
 
-    def load_state(self, state: Dict[str, Any]) -> None:
-        self.metric.load_state(state)
+    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
+        self.metric.load_state(state, update_count=update_count)
         self._computed = None
-        self._update_count = max(self._update_count, 1)
+        self._update_count = self._restored_count(update_count)
 
     # ------------------------------------------------------ pure/functional API
     # state IS the base metric's state; only the compute output is relabeled

@@ -1,7 +1,7 @@
 """MinMaxMetric (reference wrappers/minmax.py:29): track running min/max of compute."""
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 from jax import Array
@@ -88,11 +88,14 @@ class MinMaxMetric(WrapperMetric):
             "count": jnp.asarray(self._update_count, jnp.int32),
         }
 
-    def load_state(self, state: Dict[str, Any]) -> None:
-        self._base_metric.load_state(state["base"])
+    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
+        # the exported state carries the true count; an explicit update_count
+        # (the base-class signature) overrides the bookkeeping counter
+        count = self._restored_count(update_count, fallback=int(state["count"]))
+        self._base_metric.load_state(state["base"], update_count=count)
         self.min_val = state["min_val"]
         self.max_val = state["max_val"]
-        self._update_count = int(state["count"])
+        self._update_count = count
         self._computed = None
 
     # ------------------------------------------------------ pure/functional API

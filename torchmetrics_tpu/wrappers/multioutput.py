@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -185,12 +185,12 @@ class MultioutputWrapper(WrapperMetric):
 
         return _stacked_state(self.metrics)
 
-    def load_state(self, state: Any) -> None:
+    def load_state(self, state: Any, update_count: Optional[int] = None) -> None:
         from torchmetrics_tpu.wrappers.abstract import _load_stacked_state
 
-        _load_stacked_state(self.metrics, state)
+        _load_stacked_state(self.metrics, state, update_count=update_count)
         self._computed = None
-        self._update_count = max(self._update_count, 1)
+        self._update_count = self._restored_count(update_count)
 
     def functional_compute(self, state: Any) -> Array:
         """Stacked per-output values, matching :meth:`compute`'s layout."""

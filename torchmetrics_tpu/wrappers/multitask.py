@@ -104,11 +104,11 @@ class MultitaskWrapper(WrapperMetric):
     def state(self) -> Dict[str, Any]:
         return {task: m.state() for task, m in self.task_metrics.items()}
 
-    def load_state(self, states: Dict[str, Any]) -> None:
+    def load_state(self, states: Dict[str, Any], update_count: Optional[int] = None) -> None:
         for task, m in self.task_metrics.items():
-            m.load_state(states[task])
+            m.load_state(states[task], update_count=update_count)
         self._computed = None
-        self._update_count = max(self._update_count, 1)
+        self._update_count = self._restored_count(update_count)
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
         import copy

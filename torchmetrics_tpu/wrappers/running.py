@@ -6,7 +6,7 @@ Requires ``full_state_update=False`` on the base metric.
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.wrappers.abstract import WrapperMetric
@@ -98,9 +98,13 @@ class Running(WrapperMetric):
         slots = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *seq)
         return {"slots": slots, "count": count}
 
-    def load_state(self, state: Any) -> None:
+    def load_state(self, state: Any, update_count: Optional[int] = None) -> None:
         import jax
 
+        # the ring state's own count is authoritative for slot restoration —
+        # an explicit update_count must never resurrect default-pad slots as
+        # real window states (or drop real ones); it only overrides the
+        # bookkeeping counter below
         count = int(state["count"])
         if "snapshots" in state:
             keep = min(self.window, len(state["snapshots"]))
@@ -114,7 +118,7 @@ class Running(WrapperMetric):
             self._window_states = [
                 jax.tree_util.tree_map(lambda x, i=i: x[i], slots) for i in range(src_window - n, src_window)
             ]
-        self._update_count = count
+        self._update_count = self._restored_count(update_count, fallback=count)
         self._computed = None
 
     # ------------------------------------------------------ pure/functional API

@@ -6,7 +6,7 @@ clones the base per step; ``best_metric``/``compute_all`` across steps.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, List, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -109,7 +109,11 @@ class MetricTracker:
         of the wrapper family shares (each step is the base metric's layout)."""
         return {"steps": [m.state() for m in self._steps]}
 
-    def load_state(self, state: Dict[str, Any]) -> None:
+    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
+        # update_count is accepted for base-signature uniformity only — each
+        # step is its own lifecycle (MinMax/Running step states carry their own
+        # counts), so a single forwarded value would clobber per-step counts
+        del update_count
         # build the new steps fully before swapping them in: a bad step state
         # must raise cleanly, not leave a half-loaded tracker behind
         new_steps: List[Union[Metric, MetricCollection]] = []
