@@ -18,6 +18,15 @@ import jax  # noqa: E402
 # backend reliably; the config update does.
 jax.config.update("jax_platforms", "cpu")
 
+# The suite is compile-dominated (hundreds of distinct jit signatures); the
+# persistent compilation cache drops warm reruns several-fold. Zero thresholds:
+# XLA:CPU compiles are individually fast (<1 s) so the defaults would cache
+# nothing. Safe on 1 core; keys include jax version + XLA flags.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_compilation_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
