@@ -251,11 +251,14 @@ def _code_hash(name: str, fn) -> str:
                 ["git", "rev-parse", f"HEAD:{path}"],
                 capture_output=True, text=True, timeout=10, cwd=repo,
             ).stdout.strip()
-            dirty = subprocess.run(
-                ["git", "status", "--porcelain", "--", path],
+            # hash the actual uncommitted content, not a boolean: two different
+            # dirty states of the same HEAD must not share a cache entry
+            diff = subprocess.run(
+                ["git", "diff", "HEAD", "--", path],
                 capture_output=True, text=True, timeout=10, cwd=repo,
-            ).stdout.strip()
-            parts.append(f"{path}={tree}{'+dirty' if dirty else ''}")
+            ).stdout
+            dirty = f"+{hashlib.sha256(diff.encode()).hexdigest()[:12]}" if diff else ""
+            parts.append(f"{path}={tree}{dirty}")
         except Exception:
             parts.append(f"{path}=unknown")
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
@@ -939,20 +942,24 @@ def _run_config(fn):
     return result
 
 
+# the accelerator-workload configs, shared with tools/capture_tpu_bench.py so
+# a config added here is automatically part of the TPU capture set
+DEVICE_CONFIGS = (
+    ("1_accuracy_update", bench_config1),
+    ("3_ssim_psnr", bench_config3),
+    ("4_detection_map", bench_config4),
+    ("5_text_ppl_wer", bench_config5),
+    ("6_binned_curve_pallas", bench_config6),
+)
+
+
 def main() -> None:
     backend = _ensure_backend()
     on_accel = not backend.startswith("cpu")
     cache = _load_cache()
     configs = {}
     provenance = {"live": [], "cache": [], "cpu_only": []}
-    device_configs = (
-        ("1_accuracy_update", bench_config1),
-        ("3_ssim_psnr", bench_config3),
-        ("4_detection_map", bench_config4),
-        ("5_text_ppl_wer", bench_config5),
-        ("6_binned_curve_pallas", bench_config6),
-    )
-    for name, fn in device_configs:
+    for name, fn in DEVICE_CONFIGS:
         ch = _code_hash(name, fn)
         if not on_accel:
             # tunnel down this window: reuse the committed TPU capture for the
@@ -972,8 +979,10 @@ def main() -> None:
         configs[name] = result
         # only accelerator captures are worth persisting: nothing ever reads a
         # "cpu" family back, and churning the committed cache on every degraded
-        # run would bury the TPU provenance in noise
-        if "error" not in result and on_accel:
+        # run would bury the TPU provenance in noise. A stall-poisoned
+        # measurement (timing never converged even after retry) must not
+        # become durable TPU evidence either.
+        if "error" not in result and on_accel and not result.get("timing_unstable"):
             _store_cache(cache, name, "tpu", ch, result)
         provenance["live" if on_accel else "cpu_only"].append(name)
     for name in ("2_collection_mesh_sync", "sync_latency"):

@@ -208,6 +208,57 @@ class TestPESQ:
         val = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(clean), jnp.asarray(clean), fs, "wb"))
         assert val > 4.4
 
+    # ---- P.862-mandated invariance properties: independent behavioural
+    # validation using NO fitted ground truth (the anchor conformance above is
+    # a calibration-convergence check — its constants were solved against the
+    # same two scores it asserts; see native/pesq.cpp header and
+    # tools/calibrate_pesq.py --transfer for the measured cross-mode holdout).
+
+    @pytest.mark.parametrize(("fs", "mode"), [(8000, "nb"), (16000, "wb")])
+    def test_level_offset_invariance(self, fs, mode):
+        """P.862 level alignment: scaling either signal by +-10 dB must not
+        change the score (align_level normalizes to 1e7 active band power)."""
+        clean = _speech_like(2 * fs, fs, seed=20)
+        deg = clean + 0.05 * np.random.RandomState(21).randn(len(clean))
+        base = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(clean), fs, mode))
+        for db in (-10.0, -6.0, 6.0, 10.0):
+            g = 10 ** (db / 20)
+            scaled_deg = float(
+                FA.perceptual_evaluation_speech_quality(jnp.asarray(deg * g), jnp.asarray(clean), fs, mode)
+            )
+            scaled_both = float(
+                FA.perceptual_evaluation_speech_quality(jnp.asarray(deg * g), jnp.asarray(clean * g), fs, mode)
+            )
+            np.testing.assert_allclose(scaled_deg, base, atol=1e-6)
+            np.testing.assert_allclose(scaled_both, base, atol=1e-6)
+
+    @pytest.mark.parametrize(("fs", "mode"), [(8000, "nb"), (16000, "wb")])
+    def test_constant_delay_invariance(self, fs, mode):
+        """P.862 time alignment: a constant delay up to well inside the
+        envelope-correlation window must leave the score within 0.1 MOS.
+
+        Uses a bursty (speech-like-envelope) noise carrier so the 4 ms energy
+        envelope has a unique correlation peak — the regime the P.862 aligner
+        is specified for. Regression guard for the mean-removal fix in
+        estimate_delay (an unnormalized correlation of positive log-energies
+        always peaked at lag 0, silently disabling delay compensation)."""
+        r = np.random.RandomState(3)
+        n = 2 * fs
+        carrier = r.randn(n)
+        env = np.repeat(r.rand(25) > 0.4, n // 25 + 1)[:n].astype(float)
+        k = int(0.02 * fs)
+        env = np.convolve(env, np.ones(k) / k, mode="same") + 0.05
+        sig = carrier * env
+        deg = sig + r.randn(n) * np.sqrt(np.mean(sig**2)) * 10 ** (-20 / 20)
+        base = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(sig), fs, mode))
+        for delay_ms in (4, 8, 16, 32):
+            d = int(fs * delay_ms / 1000)
+            delayed = np.concatenate([np.zeros(d), deg])[:n]
+            val = float(
+                FA.perceptual_evaluation_speech_quality(jnp.asarray(delayed), jnp.asarray(sig), fs, mode)
+            )
+            assert abs(val - base) < 0.1, f"{delay_ms}ms delay moved MOS {base:.3f} -> {val:.3f}"
+
     def test_validation(self):
         with pytest.raises(ValueError, match="fs"):
             FA.perceptual_evaluation_speech_quality(jnp.zeros(8000), jnp.zeros(8000), 44100, "nb")

@@ -16,10 +16,22 @@ fixed (at the 0.1 the pre-calibration defaults used) and the overall scale is
 solved by bisection. Run after any change to the perceptual model, then bake
 the printed values into the TM_PESQ_K* defaults in pesq.cpp.
 
-Usage: python tools/calibrate_pesq.py
+Cross-mode transfer (the held-out experiment this calibration CANNOT pass):
+``--transfer`` solves ONE shared constant from a single mode's anchor and
+scores the other mode's anchor held-out. The measured transfer errors (also
+recorded in native/pesq.cpp's header) are -0.72 MOS (nb-fitted, wb held out)
+and +2.23 MOS (wb-fitted, nb held out):
+the ITU standard's per-mode hand-tuned band tables are load-bearing — the
+uniform-bark approximation plus one shared scale does not reproduce ITU's
+cross-mode behaviour, which is WHY the per-mode constants exist. The
+conformance test at the anchors therefore demonstrates calibration
+convergence; independent behavioural validation comes from the P.862-mandated
+invariance property tests (level offset, constant delay, identity ceiling,
+noise monotonicity) which use no fitted ground truth.
 """
 from __future__ import annotations
 
+import argparse
 import ctypes
 import os
 import subprocess
@@ -43,14 +55,25 @@ def anchor_signals() -> tuple[np.ndarray, np.ndarray]:
     return target, preds
 
 
-def main() -> None:
+def _load_kernel():
     lib_path = os.path.join(tempfile.mkdtemp(prefix="pesq_cal_"), "libpesq_cal.so")
     subprocess.run(["g++", "-O3", "-shared", "-fPIC", SRC, "-o", lib_path], check=True)
     lib = ctypes.CDLL(lib_path)
     lib.tm_pesq.restype = ctypes.c_double
     lib.tm_pesq.argtypes = [ctypes.POINTER(ctypes.c_double)] * 2 + [ctypes.c_int64] * 2 + [ctypes.c_int32]
     lib.tm_pesq_set_calibration.argtypes = [ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+    return lib
 
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--transfer", action="store_true",
+        help="held-out experiment: shared constant from one anchor, other anchor predicted",
+    )
+    args = parser.parse_args()
+
+    lib = _load_kernel()
     ref, deg = anchor_signals()
     pd = ctypes.POINTER(ctypes.c_double)
 
@@ -58,6 +81,18 @@ def main() -> None:
         fs, wb, _ = ANCHORS[mode]
         lib.tm_pesq_set_calibration(wb, ksym, ASYM_RATIO * ksym)
         return lib.tm_pesq(ref.ctypes.data_as(pd), deg.ctypes.data_as(pd), len(ref), fs, wb)
+
+    if args.transfer:
+        for fit_mode, held_mode in (("nb", "wb"), ("wb", "nb")):
+            target_fit = ANCHORS[fit_mode][2]
+            k = brentq(lambda kk: mos(fit_mode, kk) - target_fit, 1e-4, 50.0, xtol=1e-10)
+            predicted = mos(held_mode, k)
+            target_held = ANCHORS[held_mode][2]
+            print(
+                f"shared k from {fit_mode} anchor = {k:.6f}: held-out {held_mode}"
+                f" predicted {predicted:.4f} vs ITU {target_held} (err {predicted - target_held:+.4f})"
+            )
+        return
 
     for mode, (fs, wb, target_mos) in ANCHORS.items():
         ksym = brentq(lambda k: mos(mode, k) - target_mos, 1e-4, 50.0, xtol=1e-10)

@@ -42,16 +42,9 @@ def main() -> int:
     if jax.default_backend() == "cpu":
         print("in-process backend demoted to cpu — aborting capture")
         return 1
-    device_configs = (
-        ("1_accuracy_update", bench.bench_config1),
-        ("3_ssim_psnr", bench.bench_config3),
-        ("4_detection_map", bench.bench_config4),
-        ("5_text_ppl_wer", bench.bench_config5),
-        ("6_binned_curve_pallas", bench.bench_config6),
-    )
     cache = bench._load_cache()
     failures = 0
-    for name, fn in device_configs:
+    for name, fn in bench.DEVICE_CONFIGS:
         t1 = time.time()
         result = bench._run_config(fn)
         took = time.time() - t1
@@ -59,9 +52,13 @@ def main() -> int:
             print(f"{name}: ERROR {result['error']} ({took:.0f}s)")
             failures += 1
             continue
+        if result.get("timing_unstable"):
+            print(f"{name}: timing never converged (stall window?) — NOT cached ({took:.0f}s)")
+            failures += 1
+            continue
         bench._store_cache(cache, name, "tpu", bench._code_hash(name, fn), result)
         print(f"{name}: value={result.get('value')} vs_baseline={result.get('vs_baseline')} ({took:.0f}s)")
-    print(f"done: {len(device_configs) - failures}/{len(device_configs)} captured to {bench.CACHE_PATH}")
+    print(f"done: {len(bench.DEVICE_CONFIGS) - failures}/{len(bench.DEVICE_CONFIGS)} captured to {bench.CACHE_PATH}")
     return 0 if failures == 0 else 2
 
 

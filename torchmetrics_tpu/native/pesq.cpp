@@ -17,9 +17,23 @@
 // (z = 6*asinh(f/600)) rather than the standard's hand-tuned tables. The
 // tables' normalisation is absorbed into per-mode disturbance-scale
 // constants solved against ITU-wheel-computed anchor scores
-// (tools/calibrate_pesq.py; conformance test tests/audio/test_dsp.py), so
-// absolute MOS-LQO values are pinned to the ITU scale at those anchors and
-// rankings are pinned by the property tests.
+// (tools/calibrate_pesq.py; conformance test tests/audio/test_dsp.py).
+//
+// Validation posture (be precise about what is demonstrated where):
+// - The anchor conformance test demonstrates CALIBRATION CONVERGENCE: one
+//   free scalar per mode is solved against one ITU score per mode, so
+//   matching the anchors is not independent evidence of accuracy elsewhere.
+// - Independent behavioural validation comes from the P.862-mandated
+//   invariance properties, which use no fitted ground truth: exact level-
+//   offset invariance (align_level), constant-delay invariance up to the
+//   envelope alignment window, identity ceiling, noise monotonicity
+//   (tests/audio/test_dsp.py::TestPESQ).
+// - Cross-mode transfer was measured as the held-out experiment
+//   (tools/calibrate_pesq.py --transfer): one shared constant fitted on the
+//   nb anchor predicts the wb anchor at -0.72 MOS (and +2.23 the reverse) —
+//   the ITU standard's per-mode hand-tuned band tables are load-bearing,
+//   which is why the per-mode constants exist and cannot be validated
+//   held-out with only one ITU score per mode available offline.
 //
 // Build: g++ -O3 -shared -fPIC pesq.cpp -o libtm_native.so
 // ABI: plain C, driven through ctypes.
@@ -35,16 +49,16 @@
 // Values solved by tools/calibrate_pesq.py against the ITU-wheel anchor
 // scores (see the calibration comment in pesq_raw below).
 #ifndef TM_PESQ_KSYM_NB
-#define TM_PESQ_KSYM_NB 1.154065961
+#define TM_PESQ_KSYM_NB 1.019230292
 #endif
 #ifndef TM_PESQ_KASYM_NB
-#define TM_PESQ_KASYM_NB 0.115406596
+#define TM_PESQ_KASYM_NB 0.101923029
 #endif
 #ifndef TM_PESQ_KSYM_WB
-#define TM_PESQ_KSYM_WB 0.079861207
+#define TM_PESQ_KSYM_WB 0.089766662
 #endif
 #ifndef TM_PESQ_KASYM_WB
-#define TM_PESQ_KASYM_WB 0.007986121
+#define TM_PESQ_KASYM_WB 0.008976666
 #endif
 
 namespace {
@@ -155,15 +169,30 @@ int64_t estimate_delay(const std::vector<double>& ref, const std::vector<double>
         for (size_t j = 0; j < hop; ++j) s += deg[i * hop + j] * deg[i * hop + j];
         ed[i] = std::log1p(s);
     }
+    // mean-removed, overlap-normalized correlation: raw log-energies are
+    // mean-dominated and all-positive, so an unnormalized sum peaks at lag 0
+    // purely because that lag has the longest overlap — which silently
+    // disabled delay compensation for every delayed input
+    double mr = 0.0, md = 0.0;
+    for (double v : er) mr += v;
+    for (double v : ed) md += v;
+    mr /= static_cast<double>(nr);
+    md /= static_cast<double>(nd);
     const int64_t max_lag = static_cast<int64_t>(std::min(nr, nd) / 2);
     double best = -1e300;
     int64_t best_lag = 0;
     for (int64_t lag = -max_lag; lag <= max_lag; ++lag) {
         double c = 0;
+        int64_t cnt = 0;
         for (size_t i = 0; i < nr; ++i) {
             const int64_t j = static_cast<int64_t>(i) + lag;
-            if (j >= 0 && j < static_cast<int64_t>(nd)) c += er[i] * ed[j];
+            if (j >= 0 && j < static_cast<int64_t>(nd)) {
+                c += (er[i] - mr) * (ed[j] - md);
+                ++cnt;
+            }
         }
+        if (cnt < 4) continue;
+        c /= static_cast<double>(cnt);
         if (c > best) {
             best = c;
             best_lag = lag;
