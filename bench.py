@@ -252,11 +252,26 @@ def _code_hash(name: str, fn) -> str:
                 capture_output=True, text=True, timeout=10, cwd=repo,
             ).stdout.strip()
             # hash the actual uncommitted content, not a boolean: two different
-            # dirty states of the same HEAD must not share a cache entry
+            # dirty states of the same HEAD must not share a cache entry.
+            # `git diff HEAD` covers tracked modifications; untracked files in
+            # the dep tree (`??` in status) are hashed by content separately —
+            # a new module can change dispatch without touching tracked files
             diff = subprocess.run(
                 ["git", "diff", "HEAD", "--", path],
                 capture_output=True, text=True, timeout=10, cwd=repo,
             ).stdout
+            status = subprocess.run(
+                ["git", "status", "--porcelain", "--", path],
+                capture_output=True, text=True, timeout=10, cwd=repo,
+            ).stdout
+            for line in status.splitlines():
+                if line.startswith("??"):
+                    fpath = os.path.join(repo, line[3:].strip())
+                    try:
+                        with open(fpath, "rb") as fh:
+                            diff += f"??{line[3:]}:{hashlib.sha256(fh.read()).hexdigest()}"
+                    except OSError:
+                        diff += f"??{line[3:]}:unreadable"
             dirty = f"+{hashlib.sha256(diff.encode()).hexdigest()[:12]}" if diff else ""
             parts.append(f"{path}={tree}{dirty}")
         except Exception:

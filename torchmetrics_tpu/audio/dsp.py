@@ -34,7 +34,7 @@ class PerceptualEvaluationSpeechQuality(Metric):
         >>> m = PerceptualEvaluationSpeechQuality(fs=8000, mode='nb')
         >>> m.update(preds, target)
         >>> round(float(m.compute()), 4)
-        4.3889
+        4.4069
     """
 
     sum_pesq: Array

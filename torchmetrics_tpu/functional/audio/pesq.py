@@ -39,7 +39,7 @@ def perceptual_evaluation_speech_quality(
         >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 555 * t)
         >>> result = perceptual_evaluation_speech_quality(preds, target, fs=8000, mode='nb')
         >>> round(float(result), 4)
-        4.3889
+        4.4069
     """
     if fs not in (8000, 16000):
         raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")

@@ -23,7 +23,7 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits, _softmax_if_logits
-from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.checks import _check_same_shape, _is_concrete
 from torchmetrics_tpu.utils.compute import _safe_divide, interp
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
@@ -59,12 +59,36 @@ def _binary_precision_recall_curve_arg_validation(
         raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
 
 
+def _check_binary_target_values(target: Array, ignore_index: Optional[int]) -> None:
+    """Target values must be {0, 1} (+ ignore_index) — reference :150-160.
+
+    Data-dependent host check: reads concrete values, so it is skipped
+    automatically under jit (same contract as stat_scores validation)."""
+    if not _is_concrete(target):
+        return
+    unique_values = np.unique(np.asarray(target))
+    check = (unique_values != 0) & (unique_values != 1)
+    if ignore_index is not None:
+        check &= unique_values != ignore_index
+    if check.any():
+        raise ValueError(
+            f"Detected the following values in `target`: {unique_values.tolist()} but expected only"
+            f" the following values {[0, 1] if ignore_index is None else [ignore_index, 0, 1]}."
+        )
+
+
 def _binary_precision_recall_curve_tensor_validation(
     preds: Array, target: Array, ignore_index: Optional[int] = None
 ) -> None:
     _check_same_shape(preds, target)
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `target` to be an int tensor with ground truth labels,"
+            f" but got dtype {jnp.asarray(target).dtype}"
+        )
     if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
         raise ValueError(f"Expected argument `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+    _check_binary_target_values(target, ignore_index)
 
 
 def _binary_precision_recall_curve_format(
@@ -201,6 +225,20 @@ def _multiclass_precision_recall_curve_tensor_validation(
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_classes={num_classes}`")
     if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
         raise ValueError("Expected argument `preds` to be a float tensor with probabilities/logits")
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int tensor with ground truth labels")
+    # class labels must be < num_classes (+ ignore_index) — reference :414-428;
+    # value check reads concrete data, skipped under jit
+    if _is_concrete(target):
+        unique_values = np.unique(np.asarray(target))
+        bad = (unique_values < 0) | (unique_values >= num_classes)
+        if ignore_index is not None:
+            bad &= unique_values != ignore_index
+        if bad.any():
+            raise ValueError(
+                f"Detected values in `target` outside [0, {num_classes - 1}]: "
+                f"{unique_values[bad].tolist()}"
+            )
 
 
 def _multiclass_precision_recall_curve_format(
@@ -367,6 +405,9 @@ def _multilabel_precision_recall_curve_tensor_validation(
         raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_labels={num_labels}`")
     if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
         raise ValueError("Expected argument `preds` to be a float tensor with probabilities/logits")
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int tensor with ground truth labels")
+    _check_binary_target_values(target, ignore_index)
 
 
 def _multilabel_precision_recall_curve_format(
