@@ -1,0 +1,135 @@
+"""End-to-end FID/LPIPS on REAL pretrained weights — gated on the bundle.
+
+The converters (models/inception.py:params_from_torch_fidelity_state_dict,
+models/lpips.py:params_from_torch_state_dict) are structurally pinned by the
+golden-activation tests (tests/image/test_inception.py, test_lpips_family.py)
+but those use random weights. This module proves them on the real
+checkpoints the reference auto-downloads (reference image/fid.py:30-44).
+
+Why gated: this build environment has ZERO EGRESS — the checkpoints cannot be
+fetched here. On a machine with network access run
+
+    python tools/fetch_model_weights.py --out tests/fixtures_real/weights
+
+(hash-pinned URLs, conversion to flat-npz trees) and copy the directory in;
+every test below then activates automatically.
+
+Value pinning is two-level:
+  1. Self-consistency properties that need no external oracle: FID of a set
+     against itself is ~0; FID grows monotonically with added noise; LPIPS of
+     identical images is ~0 and grows with distortion.
+  2. A committed pin file (tests/fixtures_real/goldens_real_weights.json): on
+     first run with the bundle present the computed values are written and the
+     test instructs to commit them; later runs assert equality within 1e-3 —
+     pinning the converted-weights pipeline bit-for-bit across refactors.
+"""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_HERE = os.path.dirname(__file__)
+_WEIGHTS_DIR = os.path.join(_HERE, "..", "fixtures_real", "weights")
+_PINS = os.path.join(_HERE, "..", "fixtures_real", "goldens_real_weights.json")
+
+needs_bundle = pytest.mark.skipif(
+    not os.path.exists(os.path.join(_WEIGHTS_DIR, "inception_params.npz")),
+    reason=(
+        "real-weights bundle absent: this environment has zero egress, so the"
+        " checkpoints the reference auto-downloads cannot be fetched here. Run"
+        " `python tools/fetch_model_weights.py` on a networked machine and copy"
+        " tests/fixtures_real/weights/ in to activate this end-to-end proof."
+    ),
+)
+
+
+def _images():
+    data = np.load(os.path.join(_HERE, "..", "fixtures_real", "images.npz"))
+    # NHWC uint8 -> NCHW float batches, tiled into patches for a sample set
+    out = []
+    for name in data.files:
+        img = data[name].astype(np.float32)
+        for y in range(0, 192, 64):
+            for x in range(0, 256, 64):
+                out.append(np.transpose(img[y : y + 64, x : x + 64], (2, 0, 1)))
+    return np.stack(out)  # (24, 3, 64, 64) in [0, 255]
+
+
+def _check_pin(key: str, value: float) -> None:
+    pins = {}
+    if os.path.exists(_PINS):
+        with open(_PINS) as f:
+            pins = json.load(f)
+    if key in pins:
+        # rtol-dominated: FID values are O(10-100) and cross-backend float32
+        # accumulation differences scale with the value; atol alone would make
+        # a pin recorded on CPU fail on TPU
+        np.testing.assert_allclose(value, pins[key], rtol=1e-3, atol=1e-3)
+        return
+    pins[key] = value
+    with open(_PINS, "w") as f:
+        json.dump(pins, f, indent=1, sort_keys=True)
+    pytest.skip(f"pin {key}={value:.6f} recorded on first real-weights run — commit {_PINS}")
+
+
+@needs_bundle
+def test_fid_real_weights_properties():
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+    from torchmetrics_tpu.models.inception import inception_feature_extractor
+    from torchmetrics_tpu.models.serialization import load_npz_tree
+
+    params = load_npz_tree(os.path.join(_WEIGHTS_DIR, "inception_params.npz"))
+    extractor = inception_feature_extractor(params, feature_dim=2048)
+    imgs = _images()
+    rng = np.random.RandomState(0)
+    noisy = np.clip(imgs + rng.randn(*imgs.shape) * 25, 0, 255)
+    very_noisy = np.clip(imgs + rng.randn(*imgs.shape) * 80, 0, 255)
+
+    def fid(a, b):
+        m = FrechetInceptionDistance(feature_extractor=extractor, num_features=2048)
+        m.update(jnp.asarray(a), real=True)
+        m.update(jnp.asarray(b), real=False)
+        return float(m.compute())
+
+    self_fid = fid(imgs, imgs)
+    assert abs(self_fid) < 1e-2, self_fid
+    fid_noisy, fid_very = fid(imgs, noisy), fid(imgs, very_noisy)
+    assert 0 < fid_noisy < fid_very
+    _check_pin("fid_2048_real_vs_noise25", fid_noisy)
+
+
+@needs_bundle
+def test_lpips_real_weights_properties():
+    from torchmetrics_tpu.functional.image import learned_perceptual_image_patch_similarity
+    from torchmetrics_tpu.models.lpips import lpips_network
+    from torchmetrics_tpu.models.serialization import load_npz_tree
+
+    params = load_npz_tree(os.path.join(_WEIGHTS_DIR, "lpips_alex_params.npz"))
+    net = lpips_network("alex", params=params)
+    imgs = _images()[:8] / 127.5 - 1.0  # LPIPS [-1, 1] domain
+    rng = np.random.RandomState(1)
+    noisy = np.clip(imgs + rng.randn(*imgs.shape) * 0.2, -1, 1)
+
+    same = float(learned_perceptual_image_patch_similarity(jnp.asarray(imgs), jnp.asarray(imgs), net=net))
+    diff = float(learned_perceptual_image_patch_similarity(jnp.asarray(imgs), jnp.asarray(noisy), net=net))
+    assert abs(same) < 1e-5 and diff > 0.01
+    _check_pin("lpips_alex_real_vs_noise02", diff)
+
+
+def test_serialization_roundtrip(tmp_path):
+    """The flat-npz tree codec the bundle uses — runs everywhere (no bundle)."""
+    from torchmetrics_tpu.models.serialization import flatten_tree, load_npz_tree, unflatten_tree
+
+    tree = {"a": {"b": np.ones((2, 3)), "c": {"d": np.arange(4)}}, "e": np.float32(2.0)}
+    flat = flatten_tree(tree)
+    assert set(flat) == {"a/b", "a/c/d", "e"}
+    back = unflatten_tree(flat)
+    np.testing.assert_array_equal(back["a"]["c"]["d"], np.arange(4))
+    path = tmp_path / "t.npz"
+    np.savez(path, **flat)
+    loaded = load_npz_tree(str(path))
+    np.testing.assert_array_equal(loaded["a"]["b"], np.ones((2, 3)))

@@ -1,0 +1,136 @@
+"""Fetch + convert the pretrained weights behind FID/KID/IS/MiFID and LPIPS.
+
+The reference auto-downloads these at first use (reference image/fid.py:30-44
+via torch-fidelity; image/lpip.py via the lpips package). This environment has
+zero egress, so acquisition is a separate, documented, hash-pinned step to run
+on a machine with network access:
+
+    python tools/fetch_model_weights.py --out tests/fixtures_real/weights
+
+then copy the output directory here. The gated test
+tests/image/test_real_weights.py activates automatically once the bundle
+exists and proves the converters (models/inception.py:params_from_torch_fidelity_state_dict,
+models/lpips.py:params_from_torch_state_dict) on real checkpoints.
+
+Sources (hash-pinned; the first two embed the hash prefix in the filename,
+upstream's own integrity convention):
+
+  inception  https://github.com/toshas/torch-fidelity/releases/download/v0.2.0/weights-inception-2015-12-05-6726825d.pth
+             (torch-fidelity's FeatureExtractorInceptionV3 checkpoint — the
+             exact network the reference wraps, reference image/fid.py:30-44)
+  alexnet    https://download.pytorch.org/models/alexnet-owt-7be5be79.pth
+  lpips_alex https://github.com/richzhang/PerceptualSimilarity/raw/master/lpips/weights/v0.1/alex.pth
+             (LPIPS linear heads; no upstream hash — pinned below on first
+             fetch: the recorded sha256 must match on every later fetch)
+
+Integrity: each file's sha256 is checked against PINS; a missing pin is
+recorded into the output manifest on first fetch (trust-on-first-use) and
+enforced afterwards.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SOURCES = {
+    "inception": {
+        "url": "https://github.com/toshas/torch-fidelity/releases/download/v0.2.0/"
+               "weights-inception-2015-12-05-6726825d.pth",
+        # filename-embedded prefix: upstream names the file by its hash prefix
+        "sha256_prefix": "6726825d",
+    },
+    "alexnet": {
+        "url": "https://download.pytorch.org/models/alexnet-owt-7be5be79.pth",
+        "sha256_prefix": "7be5be79",
+    },
+    "lpips_alex": {
+        "url": "https://github.com/richzhang/PerceptualSimilarity/raw/master/lpips/weights/v0.1/alex.pth",
+        "sha256_prefix": None,  # recorded on first fetch into the manifest
+    },
+}
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="tests/fixtures_real/weights")
+    args = parser.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    manifest_path = os.path.join(args.out, "manifest.json")
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+
+    import numpy as np
+    import torch
+
+    raw = {}
+    for name, spec in SOURCES.items():
+        dest = os.path.join(args.out, f"{name}.pth")
+        if not os.path.exists(dest):
+            print(f"fetching {name} from {spec['url']}")
+            # download to a temp name and replace on success: an interrupted
+            # download must not leave a partial file that permanently fails
+            # the hash check
+            part = dest + ".part"
+            urllib.request.urlretrieve(spec["url"], part)
+            os.replace(part, dest)
+        digest = _sha256(dest)
+        if spec["sha256_prefix"] and not digest.startswith(spec["sha256_prefix"]):
+            raise RuntimeError(f"{name}: sha256 {digest} does not start with pinned {spec['sha256_prefix']}")
+        pinned = manifest.get(name, {}).get("sha256")
+        if pinned and pinned != digest:
+            raise RuntimeError(f"{name}: sha256 {digest} != recorded {pinned}")
+        manifest[name] = {"url": spec["url"], "sha256": digest}
+        raw[name] = {
+            k: np.asarray(v.detach().cpu().numpy()) if hasattr(v, "detach") else v
+            for k, v in torch.load(dest, map_location="cpu", weights_only=False).items()
+        }
+        print(f"{name}: ok ({digest[:16]}…)")
+
+    # convert to our flax trees and save one npz bundle per net
+    from torchmetrics_tpu.models.inception import params_from_torch_fidelity_state_dict
+    from torchmetrics_tpu.models.lpips import params_from_torch_state_dict
+    from torchmetrics_tpu.models.serialization import flatten_tree
+
+    inception_params = params_from_torch_fidelity_state_dict(raw["inception"])
+    # LPIPS alex: backbone convs from torchvision alexnet (keys
+    # ``features.{i}.*``) remapped into the lpips package's slice layout
+    # (``net.slice{K}.{i}.*`` — slices keep the original Sequential indices as
+    # submodule names), plus the lin heads from the richzhang alex.pth
+    from torchmetrics_tpu.models.lpips import _TORCH_CONV_INDEX
+
+    lpips_sd = {}
+    for _ours, (slc, idx) in _TORCH_CONV_INDEX["alex"].items():
+        for leaf in ("weight", "bias"):
+            lpips_sd[f"net.{slc}.{idx}.{leaf}"] = raw["alexnet"][f"features.{idx}.{leaf}"]
+    lpips_sd.update(raw["lpips_alex"])
+    lpips_params = params_from_torch_state_dict(lpips_sd, net_type="alex")
+
+    for fname, tree in (("inception_params.npz", inception_params), ("lpips_alex_params.npz", lpips_params)):
+        flat = flatten_tree(tree)
+        np.savez(os.path.join(args.out, fname), **flat)
+        print(f"wrote {fname} ({len(flat)} arrays)")
+
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"manifest: {manifest_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
