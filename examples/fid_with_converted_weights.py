@@ -2,7 +2,13 @@
 counterpart of the reference's auto-download FID path, image/fid.py:30-44).
 
 Zero-egress environments can't fetch the torch-fidelity checkpoint, so the
-weights flow is explicit:
+weights flow is explicit. The one-command path is
+
+    python tools/fetch_model_weights.py --out tests/fixtures_real/weights
+
+on a networked machine (hash-pinned download + conversion + flat-npz bundle;
+the gated tests in tests/image/test_real_weights.py then activate). The
+manual equivalent:
 
 1. OFFLINE (any machine with internet + torch-fidelity)::
 

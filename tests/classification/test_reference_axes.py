@@ -173,6 +173,9 @@ def test_multiclass_missing_class_case():
 DTYPES = [
     pytest.param(jnp.float16, 1e-2, id="float16"),
     pytest.param(jnp.bfloat16, 1e-1, id="bfloat16"),
+    # without jax_enable_x64 (default here) the float64 row degrades to
+    # float32 — it then duplicates the baseline rather than testing double;
+    # on an x64-enabled run it exercises the reference's torch.double row
     pytest.param(jnp.float64, 1e-6, id="float64"),
 ]
 

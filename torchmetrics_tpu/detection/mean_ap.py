@@ -13,6 +13,15 @@ Redesign for XLA:
 - The variable-length 101-point PR interpolation runs on host numpy (cheap,
   O(total_dets log) per class) — the device does the O(E*T*D*G) work.
 
+Host/device placement: where the jitted matcher executes follows jax's
+default device. At small scales (tens of images x ~12 dets, the typical eval
+batch and bench config 4) the workload is dispatch-latency-bound and pinning
+to host CPU wins (``with jax.default_device(jax.devices("cpu")[0])``); as
+E*T*Dmax*Gmax grows, the batched IoU + scan matcher amortizes dispatch and
+the accelerator wins. The crossover is measured by bench config 4's
+``value_on_device``/``device_vs_host_ratio`` rows on real hardware; both
+placements produce identical results, so callers choose by scale alone.
+
 Divergence from the legacy spec: ``iscrowd`` ground truths are supported —
 crowd ground truths never count toward recall, and detections overlapping a
 crowd above the IoU threshold are ignored rather than counted as false

@@ -14,13 +14,13 @@ from jax import Array
 
 from torchmetrics_tpu.utils.checks import _check_same_shape
 from torchmetrics_tpu.utils.compute import _safe_divide, _safe_xlogy
+from torchmetrics_tpu.functional.regression.utils import _at_least_float32
 
 
 # ------------------------------------------------------------------------ MAE
 def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    preds = preds.astype(jnp.float32) if not jnp.issubdtype(preds.dtype, jnp.floating) else preds
-    target = target.astype(jnp.float32) if not jnp.issubdtype(target.dtype, jnp.floating) else target
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     return jnp.abs(preds - target).sum(), preds.size
 
 
@@ -48,6 +48,7 @@ def mean_absolute_error(preds: Array, target: Array) -> Array:
 # ------------------------------------------------------------------------ MSE
 def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     if num_outputs == 1:
         preds = preds.reshape(-1)
         target = target.reshape(-1)
