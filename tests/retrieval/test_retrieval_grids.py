@@ -47,14 +47,15 @@ CLASSES = [
 ]
 
 
-def _run_pair(cls_name, kwargs):
+def _run_pair(cls_name, kwargs, target=None):
     import torchmetrics.retrieval as RRM
 
+    target = TARGET if target is None else target
     ours = getattr(ORM, cls_name)(**kwargs)
     theirs = getattr(RRM, cls_name)(**kwargs)
-    ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(INDEXES))
+    ours.update(jnp.asarray(PREDS), jnp.asarray(target), indexes=jnp.asarray(INDEXES))
     theirs.update(
-        torch.from_numpy(PREDS), torch.from_numpy(TARGET), indexes=torch.from_numpy(INDEXES)
+        torch.from_numpy(PREDS), torch.from_numpy(target), indexes=torch.from_numpy(INDEXES)
     )
     return np.asarray(ours.compute(), dtype=np.float64), theirs.compute().numpy().astype(np.float64)
 
@@ -73,20 +74,10 @@ def test_empty_target_action_grid(cls_name, extra, empty_target_action):
 def test_ignore_index_grid(cls_name, extra):
     target = TARGET.copy()
     target[rng.rand(N_DOCS) < 0.1] = -1
-    import torchmetrics.retrieval as RRM
 
     kwargs = {"ignore_index": -1, "empty_target_action": "skip", **extra}
-    ours = getattr(ORM, cls_name)(**kwargs)
-    theirs = getattr(RRM, cls_name)(**kwargs)
-    ours.update(jnp.asarray(PREDS), jnp.asarray(target), indexes=jnp.asarray(INDEXES))
-    theirs.update(
-        torch.from_numpy(PREDS), torch.from_numpy(target), indexes=torch.from_numpy(INDEXES)
-    )
-    np.testing.assert_allclose(
-        np.asarray(ours.compute(), dtype=np.float64),
-        theirs.compute().numpy().astype(np.float64),
-        atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} ignore_index",
-    )
+    a, b = _run_pair(cls_name, kwargs, target=target)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} ignore_index")
 
 
 @pytest.mark.parametrize("cls_name", ["RetrievalPrecision", "RetrievalRecall", "RetrievalNormalizedDCG"])
@@ -96,3 +87,41 @@ def test_top_k_grid(cls_name, top_k):
     kwargs["empty_target_action"] = "neg"
     a, b = _run_pair(cls_name, kwargs)
     np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} top_k={top_k}")
+
+
+def _np_custom_aggregate(values, dim=None):
+    """A deliberately asymmetric custom aggregation (q75), exercised on both
+    sides — mirrors the reference's _custom_aggregate_fn axis
+    (reference tests/unittests/retrieval/test_map.py:57)."""
+    import torch as _t
+
+    if isinstance(values, _t.Tensor):
+        return _t.quantile(values, 0.75)
+    return jnp.quantile(values, 0.75)
+
+
+@pytest.mark.parametrize("cls_name,extra", [("RetrievalMAP", {}), ("RetrievalPrecision", {"top_k": 3})])
+@pytest.mark.parametrize("aggregation", ["mean", "median", "max", "min", _np_custom_aggregate])
+def test_aggregation_grid(cls_name, extra, aggregation):
+    """Reference axis: per-query values fold with mean/median/max/min or a
+    user callable (reference retrieval/base.py:28-44)."""
+    kwargs = {"aggregation": aggregation, "empty_target_action": "neg", **extra}
+    a, b = _run_pair(cls_name, kwargs)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"{cls_name} agg={aggregation}")
+
+
+@pytest.mark.parametrize("empty_target_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("top_k", [None, 1, 4, 10])
+def test_joint_axes_grid(empty_target_action, ignore_index, top_k):
+    """The reference's full class-test cross product (test_map.py:53-58) on
+    one representative metric: every axis combination, not just marginals."""
+
+    target = TARGET.copy()
+    if ignore_index is not None:
+        target[np.random.RandomState(3).rand(N_DOCS) < 0.1] = ignore_index
+    kwargs = {"empty_target_action": empty_target_action, "ignore_index": ignore_index}
+    if top_k is not None:
+        kwargs["top_k"] = top_k
+    a, b = _run_pair("RetrievalPrecision", kwargs, target=target)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4, err_msg=f"joint {kwargs}")

@@ -84,13 +84,13 @@ def test_aggregation_dtype(dtype, rtol):
         np.testing.assert_allclose(out, expect, rtol=max(rtol, 2e-2))
 
 
-@pytest.mark.parametrize(("dtype", "rtol"), DTYPES)
-def test_stat_scores_state_dtype_pinned(dtype, rtol):
+@pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16], ids=["float16", "bfloat16"])
+def test_stat_scores_state_dtype_pinned(dtype):
     """bf16/f16 inputs must leave integer count states integer-typed."""
     from torchmetrics_tpu.classification import BinaryStatScores
 
     m = BinaryStatScores()
     m.update(jnp.asarray(rng.rand(32).astype(np.float32), dtype=dtype), jnp.asarray(rng.randint(0, 2, 32)))
     for field, v in m.state().items():
-        assert not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) or field not in ("tp", "fp", "tn", "fn"), (
-            field, jnp.asarray(v).dtype)
+        if field in ("tp", "fp", "tn", "fn"):
+            assert not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating), (field, jnp.asarray(v).dtype)

@@ -78,6 +78,7 @@ def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_ou
 # ----------------------------------------------------------------------- MSLE
 def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     sum_squared_log_error = ((jnp.log1p(preds) - jnp.log1p(target)) ** 2).sum()
     return sum_squared_log_error, preds.size
 
@@ -95,7 +96,7 @@ def mean_squared_log_error(preds: Array, target: Array) -> Array:
         0.128
     """
 
-    s, n = _mean_squared_log_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
+    s, n = _mean_squared_log_error_update(jnp.asarray(preds), jnp.asarray(target))
     return s / n
 
 
@@ -104,6 +105,7 @@ def _mean_absolute_percentage_error_update(
     preds: Array, target: Array, epsilon: float = 1.17e-06
 ) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
     return abs_per_error.sum(), preds.size
 
@@ -121,7 +123,7 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
         0.3274
     """
 
-    s, n = _mean_absolute_percentage_error_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
+    s, n = _mean_absolute_percentage_error_update(jnp.asarray(preds), jnp.asarray(target))
     return s / n
 
 
@@ -130,6 +132,7 @@ def _symmetric_mean_absolute_percentage_error_update(
     preds: Array, target: Array, epsilon: float = 1.17e-06
 ) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
     return 2 * abs_per_error.sum(), preds.size
 
@@ -148,7 +151,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
     """
 
     s, n = _symmetric_mean_absolute_percentage_error_update(
-        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        jnp.asarray(preds), jnp.asarray(target)
     )
     return s / n
 
@@ -156,6 +159,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
 # ---------------------------------------------------------------------- WMAPE
 def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     return jnp.abs(preds - target).sum(), jnp.abs(target).sum()
 
 
@@ -173,7 +177,7 @@ def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Arra
     """
 
     s, t = _weighted_mean_absolute_percentage_error_update(
-        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        jnp.asarray(preds), jnp.asarray(target)
     )
     return s / jnp.clip(t, min=1.17e-06)
 
@@ -203,9 +207,10 @@ def relative_squared_error(preds: Array, target: Array, squared: bool = True) ->
         0.0514
     """
 
-    preds = jnp.asarray(preds, dtype=jnp.float32)
-    target = jnp.asarray(target, dtype=jnp.float32)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     sum_squared_obs = (target * target).sum(0)
     sum_obs = target.sum(0)
     sum_squared_error = ((target - preds) ** 2).sum(0)
@@ -215,6 +220,7 @@ def relative_squared_error(preds: Array, target: Array, squared: bool = True) ->
 # -------------------------------------------------------------------- LogCosh
 def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     if num_outputs == 1:
         preds = preds.reshape(-1)
         target = target.reshape(-1)
@@ -237,8 +243,8 @@ def log_cosh_error(preds: Array, target: Array) -> Array:
         0.1685
     """
 
-    preds = jnp.asarray(preds, dtype=jnp.float32)
-    target = jnp.asarray(target, dtype=jnp.float32)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
     s, n = _log_cosh_error_update(preds, target, num_outputs)
     return (s / n).squeeze()
@@ -247,6 +253,7 @@ def log_cosh_error(preds: Array, target: Array) -> Array:
 # ------------------------------------------------------------------ Minkowski
 def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     if not (isinstance(p, (float, int)) and p >= 1):
         raise ValueError(f"Argument ``p`` expected to be a float larger than 1, but got {p}")
     return (jnp.abs(preds - target) ** p).sum()
@@ -265,13 +272,14 @@ def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
         1.0772
     """
 
-    s = _minkowski_distance_update(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), p)
+    s = _minkowski_distance_update(jnp.asarray(preds), jnp.asarray(target), p)
     return s ** (1.0 / p)
 
 
 # ------------------------------------------------------------------- Tweedie
 def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     if power < 0:
         deviance_score = 2 * (
             jnp.power(jnp.clip(target, min=0), 2 - power) / ((1 - power) * (2 - power))
@@ -309,7 +317,7 @@ def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> A
     """
 
     s, n = _tweedie_deviance_score_update(
-        jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), power
+        jnp.asarray(preds), jnp.asarray(target), power
     )
     return s / n
 
@@ -319,6 +327,7 @@ def _critical_success_index_update(
     preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
 ) -> Tuple[Array, Array, Array]:
     _check_same_shape(preds, target)
+    preds, target = _at_least_float32(preds), _at_least_float32(target)
     if keep_sequence_dim is None:
         sum_dims = None
     else:

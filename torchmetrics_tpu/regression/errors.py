@@ -131,7 +131,7 @@ class MeanSquaredLogError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         s, n = _mean_squared_log_error_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+            jnp.asarray(preds), jnp.asarray(target)
         )
         self.sum_squared_log_error = self.sum_squared_log_error + s
         self.total = self.total + n
@@ -166,7 +166,7 @@ class MeanAbsolutePercentageError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         s, n = _mean_absolute_percentage_error_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+            jnp.asarray(preds), jnp.asarray(target)
         )
         self.sum_abs_per_error = self.sum_abs_per_error + s
         self.total = self.total + n
@@ -193,7 +193,7 @@ class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
 
     def update(self, preds: Array, target: Array) -> None:
         s, n = _symmetric_mean_absolute_percentage_error_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+            jnp.asarray(preds), jnp.asarray(target)
         )
         self.sum_abs_per_error = self.sum_abs_per_error + s
         self.total = self.total + n
@@ -225,7 +225,7 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         s, t = _weighted_mean_absolute_percentage_error_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+            jnp.asarray(preds), jnp.asarray(target)
         )
         self.sum_abs_error = self.sum_abs_error + s
         self.sum_scale = self.sum_scale + t
@@ -263,8 +263,8 @@ class RelativeSquaredError(Metric):
         self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        preds = jnp.asarray(preds, dtype=jnp.float32)
-        target = jnp.asarray(target, dtype=jnp.float32)
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
         self.sum_squared_obs = self.sum_squared_obs + (target * target).sum(0)
         self.sum_obs = self.sum_obs + target.sum(0)
         self.sum_squared_error = self.sum_squared_error + ((target - preds) ** 2).sum(0)
@@ -305,7 +305,7 @@ class LogCoshError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         s, n = _log_cosh_error_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.num_outputs
+            jnp.asarray(preds), jnp.asarray(target), self.num_outputs
         )
         self.sum_log_cosh_error = self.sum_log_cosh_error + s
         self.total = self.total + n
@@ -342,7 +342,7 @@ class MinkowskiDistance(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.p
+            jnp.asarray(preds), jnp.asarray(target), self.p
         )
 
     def compute(self) -> Array:
@@ -378,7 +378,7 @@ class TweedieDevianceScore(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         s, n = _tweedie_deviance_score_update(
-            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.power
+            jnp.asarray(preds), jnp.asarray(target), self.power
         )
         self.sum_deviance_score = self.sum_deviance_score + s
         self.num_observations = self.num_observations + n
