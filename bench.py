@@ -712,8 +712,14 @@ def bench_config5():
     logits = jnp.asarray(rng.randn(8, 128, 2000).astype(np.float32))
     target = jnp.asarray(rng.randint(0, 2000, (8, 128)))
 
-    jit_ppl = jax.jit(lambda p, t: ours_ppl(p, t))
-    per_step_ppl = _time_jax(jit_ppl, logits, target, steps=30)
+    if jax.default_backend() == "cpu":
+        # eager dispatch takes the vectorized-numpy host fallback (XLA:CPU
+        # lowers the vocab logsumexp to scalar libm exp; see
+        # functional/text/perplexity.py) — the path real CPU usage gets
+        per_step_ppl = _time_host(lambda: jax.block_until_ready(ours_ppl(logits, target)), steps=30)
+    else:
+        jit_ppl = jax.jit(lambda p, t: ours_ppl(p, t))
+        per_step_ppl = _time_jax(jit_ppl, logits, target, steps=30)
 
     words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
     preds_txt = [" ".join(rng.choice(words, 12)) for _ in range(256)]

@@ -84,6 +84,21 @@ def test_aggregation_dtype(dtype, rtol):
         np.testing.assert_allclose(out, expect, rtol=max(rtol, 2e-2))
 
 
+@pytest.mark.parametrize(("dtype", "rtol"), DTYPES)
+@pytest.mark.parametrize(
+    "name", ["signal_noise_ratio", "scale_invariant_signal_noise_ratio", "scale_invariant_signal_distortion_ratio"]
+)
+def test_audio_snr_dtype(name, dtype, rtol):
+    import torchmetrics_tpu.functional.audio as A
+
+    t = np.arange(4000, dtype=np.float32) / 8000
+    clean = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+    noisy = clean + rng.randn(4000).astype(np.float32) * 0.05
+    # dB-scale outputs: rounding in the signal/noise power ratio amplifies
+    # through the log; bf16 needs a wider relative tolerance
+    _run(getattr(A, name), dtype, max(rtol, 5e-2), noisy, clean)
+
+
 @pytest.mark.parametrize("dtype", [jnp.float16, jnp.bfloat16], ids=["float16", "bfloat16"])
 def test_stat_scores_state_dtype_pinned(dtype):
     """bf16/f16 inputs must leave integer count states integer-typed."""

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.compute import _at_least_float32
 
 
 def _symmetric_toeplitz(vector: Array) -> Array:
@@ -131,8 +132,9 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         >>> round(float(result), 4)
         20.0
     """
-    preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
+    # dB outputs keep the f32 dtype contract; f16 sums of squares overflow
+    preds = _at_least_float32(preds)
+    target = _at_least_float32(target)
     _check_same_shape(preds, target)
     eps = jnp.finfo(preds.dtype).eps
 

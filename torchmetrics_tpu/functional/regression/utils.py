@@ -1,22 +1,9 @@
 """Shared regression helpers (reference functional/regression/utils.py)."""
 from __future__ import annotations
 
-import jax.numpy as jnp
 from jax import Array
 
-
-def _at_least_float32(x: Array) -> Array:
-    """Upcast integer and sub-32-bit float inputs to float32 for accumulation.
-
-    Keeps the metric-output/state dtype contract at float32 for bf16/f16 eval
-    pipelines (docs/IMPLEMENTING.md dtype rule): a single XLA reduce already
-    accumulates sub-32-bit sums in f32 internally, but the REDUCED value would
-    round back to the input dtype (ULP(800)=4 in bf16) and the class path's
-    ``state + batch_sum`` adds would then compound that rounding every update.
-    float64 passes through."""
-    if not jnp.issubdtype(x.dtype, jnp.floating) or jnp.finfo(x.dtype).bits < 32:
-        return x.astype(jnp.float32)
-    return x
+from torchmetrics_tpu.utils.compute import _at_least_float32  # noqa: F401  (canonical home: utils.compute)
 
 
 

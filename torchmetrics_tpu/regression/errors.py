@@ -24,7 +24,7 @@ from torchmetrics_tpu.functional.regression.basic import (
     _weighted_mean_absolute_percentage_error_update,
 )
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utils.compute import _safe_divide
+from torchmetrics_tpu.utils.compute import _at_least_float32, _safe_divide
 
 
 class MeanAbsoluteError(Metric):
@@ -263,8 +263,9 @@ class RelativeSquaredError(Metric):
         self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        preds = jnp.asarray(preds)
-        target = jnp.asarray(target)
+        # sums of squares overflow f16 (max ~65k) before reaching the f32 state
+        preds = _at_least_float32(preds)
+        target = _at_least_float32(target)
         self.sum_squared_obs = self.sum_squared_obs + (target * target).sum(0)
         self.sum_obs = self.sum_obs + target.sum(0)
         self.sum_squared_error = self.sum_squared_error + ((target - preds) ** 2).sum(0)

@@ -12,6 +12,22 @@ import jax.numpy as jnp
 from jax import Array
 
 
+def _at_least_float32(x: Array) -> Array:
+    """Upcast integer and sub-32-bit float inputs to float32 for accumulation.
+
+    Keeps the metric-output/state dtype contract at float32 for bf16/f16 eval
+    pipelines (docs/IMPLEMENTING.md dtype rule): a single XLA reduce already
+    accumulates sub-32-bit sums in f32 internally, but the REDUCED value would
+    round back to the input dtype — and sums of squares overflow f16 outright
+    (max ~65k). float64 passes through for x64-enabled runs."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return x  # complex inputs (C-SI-SNR spectra) pass through untouched
+    if not jnp.issubdtype(x.dtype, jnp.floating) or jnp.finfo(x.dtype).bits < 32:
+        return x.astype(jnp.float32)
+    return x
+
+
 def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
     """Elementwise num/denom returning ``zero_division`` where denom == 0.
 
