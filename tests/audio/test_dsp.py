@@ -232,6 +232,41 @@ class TestPESQ:
             np.testing.assert_allclose(scaled_deg, base, atol=1e-6)
             np.testing.assert_allclose(scaled_both, base, atol=1e-6)
 
+    def test_real_speech_when_available(self):
+        """Held-out ground truth on REAL speech — gated on the reference's S3
+        wav pack (reference tests/unittests/audio/__init__.py:8-9, fetched by
+        its Makefile:43-46; zero egress here). If audio_speech.wav +
+        audio_speech_bab_0dB.wav are ever placed in tests/fixtures_real/,
+        this activates: the ITU wheel's committed scores for that pair are
+        wb 1.0832 / nb 1.6072 (reference test_pesq.py:127-136) — genuinely
+        held-out values our calibration never saw. Asserted loosely (the
+        kernel's per-mode constants were solved on synthetic anchors; the
+        measured cross-mode transfer error is ~0.7 MOS, see
+        tools/calibrate_pesq.py --transfer) plus strict ranking sanity."""
+        import os
+
+        fdir = os.path.join(os.path.dirname(__file__), "..", "fixtures_real")
+        ref_wav = os.path.join(fdir, "audio_speech.wav")
+        deg_wav = os.path.join(fdir, "audio_speech_bab_0dB.wav")
+        if not (os.path.exists(ref_wav) and os.path.exists(deg_wav)):
+            pytest.skip(
+                "real speech pack absent (zero-egress environment): place the"
+                " reference suite's audio_speech.wav/audio_speech_bab_0dB.wav in"
+                " tests/fixtures_real/ to activate this held-out check"
+            )
+        from scipy.io import wavfile
+
+        rate, ref = wavfile.read(ref_wav)
+        rate2, deg = wavfile.read(deg_wav)
+        assert rate == rate2
+        wb = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(ref), rate, "wb"))
+        nb = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(deg), jnp.asarray(ref), rate, "nb"))
+        clean_wb = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(ref), jnp.asarray(ref), rate, "wb"))
+        # ranking is calibration-independent; values within the documented band
+        assert wb < clean_wb and nb < clean_wb
+        np.testing.assert_allclose(wb, 1.0832337141036987, atol=0.75)
+        np.testing.assert_allclose(nb, 1.6072081327438354, atol=0.75)
+
     @pytest.mark.parametrize(("fs", "mode"), [(8000, "nb"), (16000, "wb")])
     def test_constant_delay_invariance(self, fs, mode):
         """P.862 time alignment: a constant delay up to well inside the
