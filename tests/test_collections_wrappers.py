@@ -513,6 +513,32 @@ class TestFunctionalCollection:
         r2.load_state(st)
         assert abs(float(r2.compute()) - float(r.compute())) < 1e-6
 
+    def test_running_count_override_roundtrip(self):
+        """An explicit update_count override must not desync the exported ring:
+        the fill travels separately, so a later state()/load_state cycle keeps
+        exactly the real slots (neither drops them nor resurrects pads)."""
+        from torchmetrics_tpu import SumMetric
+        from torchmetrics_tpu.wrappers import Running
+
+        src = Running(SumMetric(), window=3)
+        for v in (1.0, 2.0, 3.0):
+            src.update(jnp.asarray(v))
+        low = Running(SumMetric(), window=3)
+        low.load_state(src.state(), update_count=1)   # bookkeeping shrunk
+        assert float(low.compute()) == 6.0
+        again = Running(SumMetric(), window=3)
+        again.load_state(low.state())                 # export after override
+        assert float(again.compute()) == 6.0          # real slots survive
+
+        part = Running(SumMetric(), window=5)
+        part.update(jnp.asarray(2.0))
+        part.update(jnp.asarray(3.0))
+        high = Running(SumMetric(), window=5)
+        high.load_state(part.state(), update_count=10)  # bookkeeping inflated
+        cycle = Running(SumMetric(), window=5)
+        cycle.load_state(high.state())
+        assert float(cycle.compute()) == 5.0            # pads not resurrected
+
     def test_tracker_state_roundtrip(self):
         """MetricTracker joins the state()/load_state contract: per-step states
         restore into a fresh tracker with identical compute_all/best_metric."""
