@@ -90,39 +90,37 @@ class Running(WrapperMetric):
         import jax.numpy as jnp
 
         base = self.base_metric
-        count = jnp.asarray(self._update_count, jnp.int32)
-        # fill (number of REAL slots) travels separately from the lifetime
-        # count: load_state(..., update_count=) may override the bookkeeping
-        # counter, and a later export must still restore exactly the real
-        # slots — deriving fill from count would desynchronize the two
-        fill = jnp.asarray(len(self._window_states), jnp.int32)
+        # the functional layout's count is the ring VALIDITY counter (slot i is
+        # valid iff i >= window - min(count, window)), so the export carries the
+        # actual number of real slots — NOT self._update_count, which
+        # load_state(..., update_count=) may override independently; exporting
+        # the bookkeeping counter would desynchronize every later restore and
+        # functional_compute on this state
+        count = jnp.asarray(len(self._window_states), jnp.int32)
         if any(isinstance(d, list) for d in base._defaults.values()):
             return {"snapshots": [dict(s) for s in self._window_states], "count": count}
         pad = [base.init_state() for _ in range(self.window - len(self._window_states))]
         seq = pad + list(self._window_states)
         slots = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *seq)
-        return {"slots": slots, "count": count, "fill": fill}
+        return {"slots": slots, "count": count}
 
     def load_state(self, state: Any, update_count: Optional[int] = None) -> None:
         import jax
 
-        # the ring state's own fill is authoritative for slot restoration —
-        # an explicit update_count must never resurrect default-pad slots as
-        # real window states (or drop real ones); it only overrides the
-        # bookkeeping counter below. Older exports without "fill" fall back
-        # to the count (the two were equal before the counter became
-        # overridable).
+        # the ring state's count (= number of valid slots, see state()) is
+        # authoritative for slot restoration — an explicit update_count must
+        # never resurrect default-pad slots as real window states (or drop
+        # real ones); it only overrides the bookkeeping counter below
         count = int(state["count"])
         if "snapshots" in state:
             keep = min(self.window, len(state["snapshots"]))
             self._window_states = [dict(s) for s in state["snapshots"][-keep:]] if keep else []
         else:
             slots = state["slots"]
-            fill = int(state.get("fill", count))
             # index relative to the SOURCE ring's window (its leading dim):
             # real data sits newest-last there, front slots are default pads
             src_window = jax.tree_util.tree_leaves(slots)[0].shape[0]
-            n = min(fill, src_window, self.window)
+            n = min(count, src_window, self.window)
             self._window_states = [
                 jax.tree_util.tree_map(lambda x, i=i: x[i], slots) for i in range(src_window - n, src_window)
             ]
