@@ -264,6 +264,37 @@ class TestMeanAveragePrecision:
                 float(got[k]), float(want[k]), atol=1e-5, err_msg=f"{k} {box_format} {iou_thresholds}"
             )
 
+    @pytest.mark.parametrize("rec_thresholds", [None, [0.0, 0.25, 0.5, 0.75, 1.0]])
+    @pytest.mark.parametrize("max_detection_thresholds", [None, [2, 5, 8]])
+    def test_parity_rec_and_maxdet_grid(self, rec_thresholds, max_detection_thresholds):
+        """Legacy-oracle grid over the remaining reference axes:
+        rec_thresholds (PR interpolation grid) x max_detection_thresholds."""
+        preds, target = self._inputs(n_img=4)
+        ours = tm.MeanAveragePrecision(
+            rec_thresholds=rec_thresholds, max_detection_thresholds=max_detection_thresholds
+        )
+        ref = self._legacy_oracle()
+        if rec_thresholds is not None:
+            ref.rec_thresholds = list(rec_thresholds)
+        if max_detection_thresholds is not None:
+            ref.max_detection_thresholds = sorted(max_detection_thresholds)
+        ours.update(preds, target)
+        ref.update(
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
+            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+        )
+        got, want = ours.compute(), ref.compute()
+        mds = sorted(max_detection_thresholds or [1, 10, 100])
+        keys = ["map", "map_50", "map_75"] + [f"mar_{d}" for d in mds]
+        for k in keys:
+            # every expected key must exist on BOTH sides — a naming mismatch
+            # must fail loudly, not silently skip the axis under test
+            assert k in got and k in want, f"missing key {k}: got={sorted(got)}, want={sorted(want.keys())}"
+            np.testing.assert_allclose(
+                float(got[k]), float(want[k]), atol=1e-5,
+                err_msg=f"{k} rec={rec_thresholds} maxdet={max_detection_thresholds}",
+            )
+
     def test_empty_preds(self):
         preds = [{"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros(0, np.float32), "labels": np.zeros(0, np.int64)}]
         target = [{"boxes": _rand_boxes(3), "labels": np.asarray([0, 1, 1])}]

@@ -515,8 +515,11 @@ class TestFunctionalCollection:
 
     def test_running_count_override_roundtrip(self):
         """An explicit update_count override must not desync the exported ring:
-        the fill travels separately, so a later state()/load_state cycle keeps
-        exactly the real slots (neither drops them nor resurrects pads)."""
+        the exported count keeps the lifetime value while it is consistent
+        with the real slots and falls back to the fill when an override broke
+        that invariant, so a later state()/load_state cycle keeps exactly the
+        real slots (neither drops them nor resurrects pads) and the functional
+        ops read the same export correctly."""
         from torchmetrics_tpu import SumMetric
         from torchmetrics_tpu.wrappers import Running
 
@@ -526,6 +529,7 @@ class TestFunctionalCollection:
         low = Running(SumMetric(), window=3)
         low.load_state(src.state(), update_count=1)   # bookkeeping shrunk
         assert float(low.compute()) == 6.0
+        assert float(low.functional_compute(low.state())) == 6.0  # same export, functional path
         again = Running(SumMetric(), window=3)
         again.load_state(low.state())                 # export after override
         assert float(again.compute()) == 6.0          # real slots survive
@@ -538,6 +542,15 @@ class TestFunctionalCollection:
         cycle = Running(SumMetric(), window=5)
         cycle.load_state(high.state())
         assert float(cycle.compute()) == 5.0            # pads not resurrected
+
+        # the lifetime count survives restore while consistent with the ring
+        lifetime = Running(SumMetric(), window=2)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            lifetime.update(jnp.asarray(v))
+        restored = Running(SumMetric(), window=2)
+        restored.load_state(lifetime.state())
+        assert restored.update_count == 5
+        assert float(restored.compute()) == 9.0
 
     def test_tracker_state_roundtrip(self):
         """MetricTracker joins the state()/load_state contract: per-step states

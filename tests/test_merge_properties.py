@@ -71,6 +71,18 @@ def test_merge_associative_and_order_invariant(name, data):
         metric = case[1]()
         classes = case[2]
         preds, target = _batches(data.draw, 3, 8, classes)
+        if classes > 2:
+            # multiclass metrics take CLASS LABELS here — float probabilities
+            # in (0,1) would int-cast to all-zeros and make the law degenerate
+            preds = np.asarray(
+                data.draw(
+                    st.lists(
+                        st.lists(st.integers(0, classes - 1), min_size=8, max_size=8),
+                        min_size=3, max_size=3,
+                    )
+                ),
+                np.int32,
+            )
         states = [metric.functional_update(metric.functional_init(), jnp.asarray(p), jnp.asarray(t))
                   for p, t in zip(preds, target)]
     else:

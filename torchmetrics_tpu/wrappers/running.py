@@ -48,7 +48,6 @@ class Running(WrapperMetric):
         if len(self._window_states) > self.window:
             self._window_states.pop(0)
         self._computed = None
-        self._update_count += 1
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Batch value + window accumulation."""
@@ -90,13 +89,17 @@ class Running(WrapperMetric):
         import jax.numpy as jnp
 
         base = self.base_metric
-        # the functional layout's count is the ring VALIDITY counter (slot i is
-        # valid iff i >= window - min(count, window)), so the export carries the
-        # actual number of real slots — NOT self._update_count, which
-        # load_state(..., update_count=) may override independently; exporting
-        # the bookkeeping counter would desynchronize every later restore and
-        # functional_compute on this state
-        count = jnp.asarray(len(self._window_states), jnp.int32)
+        # the functional layout's count doubles as the ring VALIDITY counter
+        # (slot i is valid iff i >= window - min(count, window)). The lifetime
+        # _update_count satisfies that invariant in normal operation and is
+        # exported so restore preserves it — but load_state(..., update_count=)
+        # may override the bookkeeping to a value inconsistent with the ring;
+        # exporting THAT would make every later restore/functional_compute
+        # drop real slots or resurrect default pads, so fall back to the
+        # actual fill whenever the invariant is broken
+        fill = len(self._window_states)
+        lifetime = self._update_count
+        count = jnp.asarray(lifetime if min(lifetime, self.window) == fill else fill, jnp.int32)
         if any(isinstance(d, list) for d in base._defaults.values()):
             return {"snapshots": [dict(s) for s in self._window_states], "count": count}
         pad = [base.init_state() for _ in range(self.window - len(self._window_states))]
