@@ -77,19 +77,17 @@ def _stable_min(run_block, repeats, max_extra=5):
     it; until then keep measuring (bounded), sleeping briefly so a stall burst
     does not cover every block. Non-convergence is recorded in
     ``_TIMING_UNSTABLE`` — the retry policy keys on that, not on win/loss."""
-    times = [run_block() for _ in range(repeats)]
-    stable = False
-    for _ in range(max_extra):
+    def converged() -> bool:
         srt = sorted(times)
-        if len(srt) >= 2 and srt[1] <= 1.3 * srt[0]:
-            stable = True
+        return len(srt) >= 2 and srt[1] <= 1.3 * srt[0]
+
+    times = [run_block() for _ in range(repeats)]
+    for _ in range(max_extra):
+        if converged():
             break
         time.sleep(0.5)
         times.append(run_block())
-    else:
-        srt = sorted(times)
-        stable = len(srt) >= 2 and srt[1] <= 1.3 * srt[0]
-    if not stable:
+    if not converged():
         _TIMING_UNSTABLE.append(True)
     return min(times)
 
@@ -695,9 +693,7 @@ def bench_config4():
     # from the host-pinned row when an accelerator is present; the crossover
     # (host wins at this 16x12 scale, device wins as D*G*T grows) is documented
     # in detection/mean_ap.py.
-    import jax as _jax
-
-    if _jax.default_backend() != "cpu":
+    if jax.default_backend() != "cpu":
         def ours_device_once():
             m = MeanAveragePrecision()
             for det, scores, dlab, gt, glab in data:

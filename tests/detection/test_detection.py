@@ -198,6 +198,11 @@ class TestMeanAveragePrecision:
             target.append({"boxes": gt, "labels": gt_labels})
         return preds, target
 
+    @staticmethod
+    def _to_torch(batch):
+        """numpy detection dicts -> the torch layout the legacy oracle takes."""
+        return [{k: torch.from_numpy(np.asarray(v)) for k, v in item.items()} for item in batch]
+
     def _legacy_oracle(self, class_metrics=False):
         import torchmetrics.detection._mean_ap as legacy
 
@@ -214,8 +219,7 @@ class TestMeanAveragePrecision:
         ours.update(preds[:half], target[:half])
         ours.update(preds[half:], target[half:])
         ref.update(
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+            self._to_torch(preds), self._to_torch(target),
         )
         got = ours.compute()
         want = ref.compute()
@@ -255,8 +259,7 @@ class TestMeanAveragePrecision:
             ref.iou_thresholds = list(iou_thresholds)
         ours.update(preds, target)
         ref.update(
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+            self._to_torch(preds), self._to_torch(target),
         )
         got, want = ours.compute(), ref.compute()
         for k in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100"):
@@ -280,8 +283,7 @@ class TestMeanAveragePrecision:
             ref.max_detection_thresholds = sorted(max_detection_thresholds)
         ours.update(preds, target)
         ref.update(
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
-            [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target],
+            self._to_torch(preds), self._to_torch(target),
         )
         got, want = ours.compute(), ref.compute()
         mds = sorted(max_detection_thresholds or [1, 10, 100])

@@ -28,20 +28,23 @@ jax.config.update("jax_platforms", "cpu")
 # FP state at import and hypothesis refuses to emit floats under it; integer
 # draws mapped onto the needed ranges sidestep the check entirely.
 def _batches(draw, n_batches, size, classes):
-    preds_int = draw(
-        st.lists(
-            st.lists(st.integers(1, 99), min_size=size, max_size=size),
-            min_size=n_batches, max_size=n_batches,
+    """Random (preds, target) batch stacks. Binary metrics take probability
+    preds; multiclass metrics here take CLASS-LABEL preds (float probabilities
+    would int-cast to all-zeros and make the laws degenerate)."""
+
+    def grid(strategy):
+        return draw(
+            st.lists(
+                st.lists(strategy, min_size=size, max_size=size),
+                min_size=n_batches, max_size=n_batches,
+            )
         )
-    )
-    preds = [[v / 100.0 for v in row] for row in preds_int]
-    target = draw(
-        st.lists(
-            st.lists(st.integers(0, classes - 1), min_size=size, max_size=size),
-            min_size=n_batches, max_size=n_batches,
-        )
-    )
-    return np.asarray(preds, np.float32), np.asarray(target, np.int32)
+
+    target = np.asarray(grid(st.integers(0, classes - 1)), np.int32)
+    if classes > 2:
+        return np.asarray(grid(st.integers(0, classes - 1)), np.int32), target
+    preds = np.asarray(grid(st.integers(1, 99)), np.float32) / 100.0
+    return preds, target
 
 
 
@@ -71,18 +74,6 @@ def test_merge_associative_and_order_invariant(name, data):
         metric = case[1]()
         classes = case[2]
         preds, target = _batches(data.draw, 3, 8, classes)
-        if classes > 2:
-            # multiclass metrics take CLASS LABELS here — float probabilities
-            # in (0,1) would int-cast to all-zeros and make the law degenerate
-            preds = np.asarray(
-                data.draw(
-                    st.lists(
-                        st.lists(st.integers(0, classes - 1), min_size=8, max_size=8),
-                        min_size=3, max_size=3,
-                    )
-                ),
-                np.int32,
-            )
         states = [metric.functional_update(metric.functional_init(), jnp.asarray(p), jnp.asarray(t))
                   for p, t in zip(preds, target)]
     else:
