@@ -6,7 +6,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper, _single_value_plot
 from torchmetrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -55,6 +55,8 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         return _binary_auroc_compute(self._curve_state(), self.thresholds, self.max_fpr)
+
+    plot = _single_value_plot
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
@@ -105,6 +107,8 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
         else:
             weights = None
         return _reduce_auroc(fpr, tpr, self.average, weights)
+
+    plot = _single_value_plot
 
 
 class MultilabelAUROC(MultilabelPrecisionRecallCurve):
@@ -169,6 +173,8 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
             fpr, tpr, _ = _multilabel_roc_compute(self.confmat, self.num_labels, self.thresholds)
             weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
         return _reduce_auroc(fpr, tpr, self.average, weights)
+
+    plot = _single_value_plot
 
 
 class AUROC(_ClassificationTaskWrapper):

@@ -6,7 +6,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper, _single_value_plot
 from torchmetrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -45,6 +45,8 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         return _binary_average_precision_compute(self._curve_state(), self.thresholds)
+
+    plot = _single_value_plot
 
 
 class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
@@ -95,6 +97,8 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
         else:
             weights = None
         return _reduce_average_precision(precision, recall, self.average, weights)
+
+    plot = _single_value_plot
 
 
 class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
@@ -162,6 +166,8 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
             )
             weights = (self.confmat[0, :, 1, 0] + self.confmat[0, :, 1, 1]).astype(jnp.float32)
         return _reduce_average_precision(precision, recall, self.average, weights)
+
+    plot = _single_value_plot
 
 
 class AveragePrecision(_ClassificationTaskWrapper):

@@ -15,3 +15,11 @@ class _ClassificationTaskWrapper(Metric):
 
     def compute(self) -> None:
         raise NotImplementedError(f"{self.__class__.__name__} metric does not have a `compute` method.")
+
+
+def _single_value_plot(self, val=None, ax=None):
+    """Single/multi-value plot for scalar-output subclasses of the curve or
+    confusion-matrix families: their inherited curve/heatmap plot does not
+    apply to a scalar compute() (the reference overrides these the same way,
+    e.g. reference classification/auroc.py:126)."""
+    return self._plot(val, ax)

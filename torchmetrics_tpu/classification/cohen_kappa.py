@@ -5,7 +5,7 @@ from typing import Any, Optional
 
 from jax import Array
 
-from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper, _single_value_plot
 from torchmetrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
 from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce
 from torchmetrics_tpu.metric import Metric
@@ -48,6 +48,8 @@ class BinaryCohenKappa(BinaryConfusionMatrix):
     def compute(self) -> Array:
         return _cohen_kappa_reduce(self.confmat, self.weights)
 
+    plot = _single_value_plot
+
 
 class MulticlassCohenKappa(MulticlassConfusionMatrix):
     """Multiclass Cohen Kappa (modular interface, accumulating across updates).
@@ -84,6 +86,8 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
     def compute(self) -> Array:
         return _cohen_kappa_reduce(self.confmat, self.weights)
+
+    plot = _single_value_plot
 
 
 class CohenKappa(_ClassificationTaskWrapper):

@@ -57,6 +57,13 @@ class _BinaryFixedBase(BinaryPrecisionRecallCurve):
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
         return _binary_fixed_compute(self._curve_state(), self.thresholds, self.min_constraint, self._family)
 
+    def plot(self, val=None, ax=None):
+        """Plot the metric VALUE only: compute() returns (value, threshold)
+        and the threshold is an operating point, not a result (reference
+        classification/recall_fixed_precision.py:174 plots compute()[0])."""
+        val = val if val is not None else self.compute()[0]
+        return self._plot(val, ax)
+
 
 class _MulticlassFixedBase(MulticlassPrecisionRecallCurve):
     is_differentiable = False
@@ -98,6 +105,13 @@ class _MulticlassFixedBase(MulticlassPrecisionRecallCurve):
         return _multidim_fixed_compute(
             state, self.num_classes, self.thresholds, self.min_constraint, self._family, curves
         )
+
+    def plot(self, val=None, ax=None):
+        """Plot the metric VALUE only: compute() returns (value, threshold)
+        and the threshold is an operating point, not a result (reference
+        classification/recall_fixed_precision.py:174 plots compute()[0])."""
+        val = val if val is not None else self.compute()[0]
+        return self._plot(val, ax)
 
 
 class _MultilabelFixedBase(MultilabelPrecisionRecallCurve):
@@ -142,6 +156,13 @@ class _MultilabelFixedBase(MultilabelPrecisionRecallCurve):
         return _multidim_fixed_compute(
             state, self.num_labels, self.thresholds, self.min_constraint, self._family, curves
         )
+
+    def plot(self, val=None, ax=None):
+        """Plot the metric VALUE only: compute() returns (value, threshold)
+        and the threshold is an operating point, not a result (reference
+        classification/recall_fixed_precision.py:174 plots compute()[0])."""
+        val = val if val is not None else self.compute()[0]
+        return self._plot(val, ax)
 
 
 class BinaryRecallAtFixedPrecision(_BinaryFixedBase):

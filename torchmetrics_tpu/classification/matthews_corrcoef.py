@@ -5,7 +5,7 @@ from typing import Any, Optional
 
 from jax import Array
 
-from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper, _single_value_plot
 from torchmetrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -48,6 +48,8 @@ class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
     def compute(self) -> Array:
         return _matthews_corrcoef_reduce(self.confmat)
 
+    plot = _single_value_plot
+
 
 class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
     """Multiclass Matthews Corr Coef (modular interface, accumulating across updates).
@@ -80,6 +82,8 @@ class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
 
     def compute(self) -> Array:
         return _matthews_corrcoef_reduce(self.confmat)
+
+    plot = _single_value_plot
 
 
 class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
@@ -114,6 +118,8 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
 
     def compute(self) -> Array:
         return _matthews_corrcoef_reduce(self.confmat)
+
+    plot = _single_value_plot
 
 
 class MatthewsCorrCoef(_ClassificationTaskWrapper):

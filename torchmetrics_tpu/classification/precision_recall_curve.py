@@ -224,6 +224,18 @@ class MulticlassPrecisionRecallCurve(Metric):
             self._curve_state(), self.num_classes, self.thresholds, self.average
         )
 
+    def plot(self, curve=None, score=None, ax=None):
+        """Per-class PR curves: binned states plot (C, T) rows, exact states
+        plot ragged per-class lists (reference classification/precision_recall_curve.py
+        plot contract); ``score`` labels each class when given per-class."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=type(self).__name__,
+        )
+
 
 class MultilabelPrecisionRecallCurve(Metric):
     """Multilabel Precision Recall Curve (modular interface, accumulating across updates).
@@ -301,6 +313,18 @@ class MultilabelPrecisionRecallCurve(Metric):
                 self._curve_state(), self.num_labels, None, self.ignore_index, self._valid_state()
             )
         return _multilabel_precision_recall_curve_compute(self._curve_state(), self.num_labels, self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Per-class PR curves: binned states plot (C, T) rows, exact states
+        plot ragged per-class lists (reference classification/precision_recall_curve.py
+        plot contract); ``score`` labels each class when given per-class."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=type(self).__name__,
+        )
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):

@@ -62,6 +62,16 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
     def compute(self):
         return _multiclass_roc_compute(self._curve_state(), self.num_classes, self.thresholds, self.average)
 
+    def plot(self, curve=None, score=None, ax=None):
+        """Per-class ROC curves (see MulticlassPrecisionRecallCurve.plot)."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[0], curve[1], curve[2]), score=score, ax=ax,
+            label_names=("FPR", "TPR"), name=type(self).__name__,
+        )
+
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
     """Multilabel ROC (modular interface, accumulating across updates).
@@ -81,6 +91,16 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
         if self.thresholds is None:
             return _multilabel_roc_compute(self._curve_state(), self.num_labels, None, self._valid_state())
         return _multilabel_roc_compute(self._curve_state(), self.num_labels, self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Per-class ROC curves (see MulticlassPrecisionRecallCurve.plot)."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[0], curve[1], curve[2]), score=score, ax=ax,
+            label_names=("FPR", "TPR"), name=type(self).__name__,
+        )
 
 
 class ROC(_ClassificationTaskWrapper):

@@ -162,24 +162,48 @@ def plot_curve(
     legend_name: Optional[str] = None,
     name: Optional[str] = None,
 ) -> _PLOT_OUT_TYPE:
-    """Plot an (x, y, thresholds) curve like ROC / PR (reference plot.py:270)."""
+    """Plot an (x, y, thresholds) curve like ROC / PR (reference plot.py:270).
+
+    ``score=True`` computes the trapezoid area under each plotted polyline for
+    the legend (reference plot.py's score semantics); any other non-None score
+    is used as the label value directly. Curves may be single 1-D arrays,
+    (C, T) per-class stacks, or — the exact-mode multiclass/multilabel layout —
+    per-class LISTS of 1-D arrays with different lengths."""
     _error_on_missing_matplotlib()
-    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+
+    # normalize every input layout to a list of (x, y) polylines
+    if isinstance(curve[0], (list, tuple)) or isinstance(curve[1], (list, tuple)):
+        polylines = [(np.asarray(xc), np.asarray(yc)) for xc, yc in zip(curve[0], curve[1])]
+        per_class = True
+    else:
+        x, y = np.asarray(curve[0]), np.asarray(curve[1])
+        per_class = y.ndim > 1
+        if per_class:
+            polylines = [(x[c] if x.ndim > 1 else x, y[c]) for c in range(y.shape[0])]
+        else:
+            polylines = [(x, y)]
+
+    def _trapz(xv, yv):
+        xv, yv = np.asarray(xv, np.float64), np.asarray(yv, np.float64)
+        order = np.argsort(xv, kind="stable")
+        integrate = np.trapezoid if hasattr(np, "trapezoid") else np.trapz  # numpy<2 compat
+        return float(integrate(yv[order], xv[order]))
+
+    if score is True:
+        areas = [_trapz(xc, yc) for xc, yc in polylines]
+        score = np.asarray(areas) if per_class else areas[0]
+
     fig, ax = (plt.subplots() if ax is None else (ax.get_figure(), ax))
-    if y.ndim > 1:
-        for c in range(y.shape[0]):
+    for c, (xc, yc) in enumerate(polylines):
+        if per_class:
             lbl = f"{legend_name or 'Class'} {c}"
             if score is not None and np.asarray(score).ndim:
                 lbl += f" (score={float(np.asarray(score)[c]):.3f})"
-            ax.plot(x[c] if x.ndim > 1 else x, y[c], label=lbl)
+        else:
+            lbl = f"score={float(np.asarray(score)):.3f}" if score is not None else None
+        ax.plot(xc, yc, label=lbl)
+    if per_class or (polylines and score is not None):
         ax.legend()
-    else:
-        lbl = None
-        if score is not None:
-            lbl = f"score={float(np.asarray(score)):.3f}"
-        ax.plot(x, y, label=lbl)
-        if lbl:
-            ax.legend()
     if label_names:
         ax.set_xlabel(label_names[0])
         ax.set_ylabel(label_names[1])
