@@ -62,6 +62,18 @@ BATCH = 1024
 WARMUP = 10
 STEPS = 200
 
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    """shard_map across jax versions: jax.shard_map(check_vma=...) on new
+    releases, jax.experimental.shard_map(check_rep=...) on <=0.4."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
 # last _stable_min verdicts, reset per config run: True when any block series
 # never converged (two fastest blocks >30% apart after all extensions) — the
 # outcome-independent stall signal driving the symmetric retry policy
@@ -232,6 +244,15 @@ _CONFIG_DEPS = {
         "torchmetrics_tpu/functional/classification",
         "torchmetrics_tpu/classification",
         "torchmetrics_tpu/ops",
+        "torchmetrics_tpu/utils",
+    ],
+    "7_eager_executor": [
+        "torchmetrics_tpu/metric.py",
+        "torchmetrics_tpu/collections.py",
+        "torchmetrics_tpu/ops",
+        "torchmetrics_tpu/functional/classification",
+        "torchmetrics_tpu/classification",
+        "torchmetrics_tpu/utils",
     ],
 }
 
@@ -248,6 +269,16 @@ def _code_hash(name: str, fn) -> str:
         src = repr(fn)
     repo = os.path.dirname(os.path.abspath(__file__))
     parts = [src, consts]
+    # toolchain identity: a jax/jaxlib bump must invalidate cached TPU rows
+    # (ADVICE r5 #2). Safe to import here: _code_hash only runs after
+    # _ensure_backend's subprocess probe has settled the platform env.
+    try:
+        import jax as _jax
+        import jaxlib as _jaxlib
+
+        parts.append(f"jax={_jax.__version__},jaxlib={getattr(_jaxlib, '__version__', '?')}")
+    except Exception:
+        parts.append("jax=unknown")
     for path in _CONFIG_DEPS.get(name, []):
         try:
             tree = subprocess.run(
@@ -435,14 +466,12 @@ def bench_config2():
         jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH)), NamedSharding(mesh, P("data"))
     )
 
-    from functools import partial
-
-    @jax.jit
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
-    def step(lg, tg):
+    def _synced_body(lg, tg):
         st = coll.functional_update(states0, lg, tg)
         st = coll.functional_sync(st, "data")
         return coll.functional_compute(st)
+
+    step = jax.jit(_shard_map(_synced_body, mesh, (P("data"), P("data")), P()))
 
     # block after every call: concurrently enqueued runs of a multi-collective
     # module interleave their rendezvous across runs on a starved host and
@@ -452,6 +481,22 @@ def bench_config2():
 
     per_step = _time_host(blocking_step, steps=30, warmup=3)
     ours = 1.0 / per_step
+
+    # executor-fused synced row (ISSUE 1): same update+sync+compute work, but
+    # the whole collection's collectives fold into one psum per
+    # (reduction, dtype) and computed values are PACKED into one replicated
+    # buffer per dtype, so the step pays O(dtypes) output dispatch, not
+    # O(metrics) — the per-output buffer creation across 8 virtual devices is
+    # a measurable share of the synced-row gap
+    from torchmetrics_tpu.ops.executor import make_synced_collection_step
+
+    fused_body, _unpack = make_synced_collection_step(coll, axis_name="data", pack_values=True)
+    fused_step = jax.jit(
+        _shard_map(lambda lg, tg: fused_body(states0, lg, tg)[1], mesh, (P("data"), P("data")), P())
+    )
+    ours_fused = 1.0 / _time_host(
+        lambda: jax.block_until_ready(fused_step(logits, target)), steps=30, warmup=3
+    )
 
     # same-work row: BOTH sides single-device, unsynced, update+compute — the
     # headline row above carries sync work the reference baseline cannot do
@@ -507,6 +552,11 @@ def bench_config2():
         # symmetric comparison: no collectives on either side
         "value_same_work_unsynced": round(ours_unsynced, 2),
         "vs_baseline_same_work": round(ours_unsynced / ref_val, 3) if ref_val else None,
+        # executor-fused synced step (packed values, fused collectives) and the
+        # synced-vs-unsynced gaps the ISSUE-1 acceptance tracks
+        "value_fused_executor": round(ours_fused, 2),
+        "gap_synced_vs_unsynced": round(ours_unsynced / ours, 2),
+        "gap_fused_vs_unsynced": round(ours_unsynced / ours_fused, 2),
     }
 
 
@@ -811,6 +861,117 @@ def bench_config6():
     }
 
 
+# ----------------------------------------------------------- config 7
+def bench_config7():
+    """Eager stateful API through the donated-state executor vs op-by-op.
+
+    The ISSUE-1 tentpole row: the SAME update stream driven through
+    ``Metric.update()`` / ``MetricCollection.update()`` with the executor on
+    vs off (``executor=False`` restores the pre-executor op-by-op eager path
+    exactly), plus the fused eager ``forward``. No torch reference — the
+    baseline here is our own pre-executor dispatch path.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+    from torchmetrics_tpu.ops.executor import executor_stats
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH))
+
+    def _block(obj):
+        states = (
+            [v for m in obj.values() for v in m._state.values()]
+            if isinstance(obj, MetricCollection)
+            else list(obj._state.values())
+        )
+        jax.block_until_ready(states)
+
+    def run_update(obj, steps):
+        for _ in range(WARMUP):
+            obj.update(logits, target)
+        _block(obj)
+
+        def block():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                obj.update(logits, target)
+            _block(obj)
+            return (time.perf_counter() - t0) / steps
+
+        return 1.0 / _stable_min(block, repeats=3)
+
+    def run_forward(obj, steps):
+        obj.update(logits, target)  # resolve groups / warm caches
+        for _ in range(3):
+            obj(logits, target)
+        _block(obj)
+
+        def block():
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(steps):
+                out = obj(logits, target)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / steps
+
+        return 1.0 / _stable_min(block, repeats=3)
+
+    def make_collection(executor):
+        coll = MetricCollection(
+            {
+                "confmat": MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "precision": MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+                "recall": MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            },
+            executor=executor,
+        )
+        if executor is False:  # the true pre-executor baseline: members eager too
+            for m in coll.values():
+                m._executor_enabled = False
+        return coll
+
+    single_ex = run_update(MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False), steps=200)
+    single_op = run_update(
+        MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False), steps=100
+    )
+    coll_ex_obj = make_collection(None)
+    coll_ex = run_update(coll_ex_obj, steps=100)
+    coll_op = run_update(make_collection(False), steps=40)
+    fwd_ex = run_forward(make_collection(None), steps=60)
+    fwd_op = run_forward(make_collection(False), steps=20)
+
+    stats = executor_stats(coll_ex_obj)
+    return {
+        "value": round(coll_ex, 2),
+        "unit": "steps/s (5-metric collection eager update via donated-state executor, batch=1024, C=10)",
+        "vs_baseline": None,  # baseline is our own op-by-op path, reported below
+        "single_executor": round(single_ex, 2),
+        "single_op_by_op": round(single_op, 2),
+        "single_speedup": round(single_ex / single_op, 2),
+        "collection_op_by_op": round(coll_op, 2),
+        "collection_speedup": round(coll_ex / coll_op, 2),
+        "forward_executor": round(fwd_ex, 2),
+        "forward_op_by_op": round(fwd_op, 2),
+        "forward_speedup": round(fwd_ex / fwd_op, 2),
+        "executor_stats": {
+            k: stats[k] for k in ("compiles", "cache_hits", "donated_calls", "copied_calls")
+        },
+    }
+
+
 # ----------------------------------------------------------- sync latency
 def bench_sync_latency():
     """psum / all_gather latency vs state size on the 8-device mesh (µs/step)."""
@@ -840,15 +1001,10 @@ def bench_sync_latency():
     for label, n in (("4KB", 1024), ("1MB", 262144), ("4MB", 1048576)):
         x = jax.device_put(jnp.zeros((8, n // 8), dtype=jnp.float32), NamedSharding(mesh, P("data")))
 
-        @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
-        def psum_step(v):
-            return jax.lax.psum(v, "data")
-
-        @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
-        def gather_step(v):
-            return jax.lax.all_gather(v, "data", axis=0, tiled=True)
+        psum_step = jax.jit(_shard_map(lambda v: jax.lax.psum(v, "data"), mesh, P("data"), P()))
+        gather_step = jax.jit(
+            _shard_map(lambda v: jax.lax.all_gather(v, "data", axis=0, tiled=True), mesh, P("data"), P())
+        )
 
         out[label] = {
             "psum_us": round(_time_jax(psum_step, x, steps=30) * 1e6, 1),
@@ -981,6 +1137,7 @@ DEVICE_CONFIGS = (
     ("4_detection_map", bench_config4),
     ("5_text_ppl_wer", bench_config5),
     ("6_binned_curve_pallas", bench_config6),
+    ("7_eager_executor", bench_config7),
 )
 
 
@@ -1033,6 +1190,10 @@ def main() -> None:
         "vs_baseline": primary.get("vs_baseline"),
         "backend": backend if on_accel else ("tpu (from result cache)" if not degraded else backend),
         "backend_degraded": degraded,
+        # ADVICE r5 #3: a cache-replayed summary must not read as a live TPU
+        # run — False whenever no accelerator was reachable THIS invocation,
+        # even if every device row was served from the committed TPU cache
+        "measured_live": on_accel,
         "tpu_provenance": provenance,
         "backend_probe": _PROBE_LOG,
         "configs": configs,

@@ -204,7 +204,11 @@ def _tree_allclose(a, b):
     lb = jax.tree_util.tree_leaves(b)
     assert len(la) == len(lb)
     for x, y in zip(la, lb):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6)
+        # rtol sits just above float32 fusion-reassociation noise: the eager
+        # stateful path now executes COMPILED (ops/executor.py), so modular vs
+        # functional comparisons legitimately differ by XLA reduction-order
+        # rounding — dB-scaled metrics (SDR) amplify it to ~2e-5 relative
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=3e-5, atol=1e-6)
 
 
 @pytest.mark.parametrize("module_name,cls_name,ctor,setup,upd", CASES)

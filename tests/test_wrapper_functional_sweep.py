@@ -31,7 +31,11 @@ def _tree_allclose(a, b):
     lb = jax.tree_util.tree_leaves(b)
     assert len(la) == len(lb)
     for x, y in zip(la, lb):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6)
+        # rtol just above float32 fusion-reassociation noise: the OO side runs
+        # COMPILED through the executor (ops/executor.py), so functional-eager
+        # vs modular-compiled comparisons carry XLA reduction-order rounding
+        # that dB-scaled metrics (SDR) amplify to ~2e-5 relative
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=3e-5, atol=1e-6)
 
 
 def _eligible_or_skip(metric, cls_name):

@@ -12,20 +12,21 @@ tests/image/test_real_weights.py activates automatically once the bundle
 exists and proves the converters (models/inception.py:params_from_torch_fidelity_state_dict,
 models/lpips.py:params_from_torch_state_dict) on real checkpoints.
 
-Sources (hash-pinned; the first two embed the hash prefix in the filename,
-upstream's own integrity convention):
+Integrity policy (no trust-on-first-use):
 
-  inception  https://github.com/toshas/torch-fidelity/releases/download/v0.2.0/weights-inception-2015-12-05-6726825d.pth
-             (torch-fidelity's FeatureExtractorInceptionV3 checkpoint — the
-             exact network the reference wraps, reference image/fid.py:30-44)
-  alexnet    https://download.pytorch.org/models/alexnet-owt-7be5be79.pth
-  lpips_alex https://github.com/richzhang/PerceptualSimilarity/raw/master/lpips/weights/v0.1/alex.pth
-             (LPIPS linear heads; no upstream hash — pinned below on first
-             fetch: the recorded sha256 must match on every later fetch)
-
-Integrity: each file's sha256 is checked against PINS; a missing pin is
-recorded into the output manifest on first fetch (trust-on-first-use) and
-enforced afterwards.
+- Every source pins an immutable URL — release-asset or commit-sha'd raw path,
+  never a mutable branch — and, where known, a full ``sha256`` in ``SOURCES``.
+  A fetched file failing its pin aborts.
+- ``lpips_alex`` has no upstream-published hash. Its entry therefore ships
+  with ``commit``/``sha256`` set to ``None`` and the script REFUSES to fetch
+  it until the operator either fills the pins in ``SOURCES`` or passes
+  ``--trust-first-fetch``, which downloads once, prints the full sha256 and
+  the exact ``SOURCES`` lines to commit, and records them in the manifest —
+  the trust decision is an explicit, logged operator action, not a silent
+  default.
+- Checkpoints load with ``torch.load(weights_only=True)``; only a source
+  explicitly marked ``allow_legacy_pickle`` (none today) may fall back to the
+  arbitrary-code pickle path, and only after its hash pin has passed.
 """
 from __future__ import annotations
 
@@ -38,20 +39,34 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Commit sha of richzhang/PerceptualSimilarity that the lpips_alex raw URL is
+# pinned to. None = not yet pinned: fill this (plus the sha256 below) from a
+# trusted networked machine, or run once with --trust-first-fetch to capture
+# both values for committing.
+LPIPS_COMMIT: "str | None" = None
+
 SOURCES = {
     "inception": {
         "url": "https://github.com/toshas/torch-fidelity/releases/download/v0.2.0/"
                "weights-inception-2015-12-05-6726825d.pth",
         # filename-embedded prefix: upstream names the file by its hash prefix
         "sha256_prefix": "6726825d",
+        "sha256": None,  # full pin recorded to the manifest on first verified fetch
     },
     "alexnet": {
         "url": "https://download.pytorch.org/models/alexnet-owt-7be5be79.pth",
         "sha256_prefix": "7be5be79",
+        "sha256": None,
     },
     "lpips_alex": {
-        "url": "https://github.com/richzhang/PerceptualSimilarity/raw/master/lpips/weights/v0.1/alex.pth",
-        "sha256_prefix": None,  # recorded on first fetch into the manifest
+        # LPIPS linear heads. Mutable-branch URLs (raw/master) are forbidden:
+        # the path below is templated on LPIPS_COMMIT and refuses to resolve
+        # until that pin is set (or --trust-first-fetch is passed, which
+        # fetches from the commit-less fallback ONCE and prints the pins).
+        "url_template": "https://github.com/richzhang/PerceptualSimilarity/raw/{commit}/lpips/weights/v0.1/alex.pth",
+        "unpinned_fallback_url": "https://github.com/richzhang/PerceptualSimilarity/raw/master/lpips/weights/v0.1/alex.pth",
+        "sha256_prefix": None,
+        "sha256": None,  # REQUIRED before normal fetches; see LPIPS_COMMIT
     },
 }
 
@@ -64,9 +79,70 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
+def _resolve_url(name: str, spec: dict, trust_first_fetch: bool) -> str:
+    if "url" in spec:
+        return spec["url"]
+    if LPIPS_COMMIT:
+        return spec["url_template"].format(commit=LPIPS_COMMIT)
+    if trust_first_fetch:
+        return spec["unpinned_fallback_url"]
+    raise SystemExit(
+        f"{name}: refusing to fetch — no commit/sha256 pin. Either set LPIPS_COMMIT and"
+        f" SOURCES['{name}']['sha256'] in this file (from a trusted machine), or run once"
+        " with --trust-first-fetch to capture the pins to commit."
+    )
+
+
+def _check_integrity(name: str, spec: dict, digest: str, manifest: dict, trust_first_fetch: bool) -> None:
+    prefix = spec.get("sha256_prefix")
+    if prefix and not digest.startswith(prefix):
+        raise RuntimeError(f"{name}: sha256 {digest} does not start with pinned {prefix}")
+    pinned = spec.get("sha256")
+    if pinned:
+        if digest != pinned:
+            raise RuntimeError(f"{name}: sha256 {digest} != SOURCES pin {pinned}")
+        return
+    recorded = manifest.get(name, {}).get("sha256")
+    if recorded and recorded != digest:
+        raise RuntimeError(f"{name}: sha256 {digest} != previously recorded {recorded}")
+    if not prefix and not recorded and not trust_first_fetch:
+        raise SystemExit(
+            f"{name}: no sha256 pin in SOURCES and no recorded manifest hash; re-run with"
+            " --trust-first-fetch to make the first-trust decision explicitly."
+        )
+    if not pinned:
+        print(
+            f"{name}: unpinned source fetched under --trust-first-fetch; commit this pin:\n"
+            f"    SOURCES[{name!r}]['sha256'] = {digest!r}"
+        )
+
+
+def _load_checkpoint(name: str, spec: dict, dest: str):
+    """weights_only load; the arbitrary-code pickle path needs an explicit
+    per-source opt-in AND a passed hash pin."""
+    import torch
+
+    try:
+        return torch.load(dest, map_location="cpu", weights_only=True)
+    except Exception as err:
+        if not spec.get("allow_legacy_pickle"):
+            raise RuntimeError(
+                f"{name}: torch.load(weights_only=True) failed ({err}). This source is not"
+                " marked allow_legacy_pickle, and unpickling arbitrary code from a download"
+                " is refused. Verify the file, or mark the source explicitly after review."
+            ) from err
+        print(f"{name}: weights_only load failed; falling back to legacy pickle (opted in)")
+        return torch.load(dest, map_location="cpu", weights_only=False)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="tests/fixtures_real/weights")
+    parser.add_argument(
+        "--trust-first-fetch",
+        action="store_true",
+        help="allow ONE fetch of sources that have no sha256 pin yet, printing the pins to commit",
+    )
     args = parser.parse_args()
     os.makedirs(args.out, exist_ok=True)
     manifest_path = os.path.join(args.out, "manifest.json")
@@ -76,29 +152,25 @@ def main() -> int:
             manifest = json.load(f)
 
     import numpy as np
-    import torch
 
     raw = {}
     for name, spec in SOURCES.items():
+        url = _resolve_url(name, spec, args.trust_first_fetch)
         dest = os.path.join(args.out, f"{name}.pth")
         if not os.path.exists(dest):
-            print(f"fetching {name} from {spec['url']}")
+            print(f"fetching {name} from {url}")
             # download to a temp name and replace on success: an interrupted
             # download must not leave a partial file that permanently fails
             # the hash check
             part = dest + ".part"
-            urllib.request.urlretrieve(spec["url"], part)
+            urllib.request.urlretrieve(url, part)
             os.replace(part, dest)
         digest = _sha256(dest)
-        if spec["sha256_prefix"] and not digest.startswith(spec["sha256_prefix"]):
-            raise RuntimeError(f"{name}: sha256 {digest} does not start with pinned {spec['sha256_prefix']}")
-        pinned = manifest.get(name, {}).get("sha256")
-        if pinned and pinned != digest:
-            raise RuntimeError(f"{name}: sha256 {digest} != recorded {pinned}")
-        manifest[name] = {"url": spec["url"], "sha256": digest}
+        _check_integrity(name, spec, digest, manifest, args.trust_first_fetch)
+        manifest[name] = {"url": url, "sha256": digest}
         raw[name] = {
             k: np.asarray(v.detach().cpu().numpy()) if hasattr(v, "detach") else v
-            for k, v in torch.load(dest, map_location="cpu", weights_only=False).items()
+            for k, v in _load_checkpoint(name, spec, dest).items()
         }
         print(f"{name}: ok ({digest[:16]}…)")
 

@@ -93,6 +93,12 @@ class BaseAggregator(Metric):
         """Value that is a no-op for this aggregator's reduction."""
         return 0.0
 
+    def _executor_traceable(self) -> bool:
+        """The "error"/"warn" nan strategies need concrete values — tracing the
+        update would silently skip the raise/warning, so those instances keep
+        the eager path (ops/executor.py consults this hook)."""
+        return self.nan_strategy not in ("error", "warn")
+
     def update(self, value: Union[float, Array]) -> None:
         raise NotImplementedError
 

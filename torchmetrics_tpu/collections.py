@@ -42,6 +42,11 @@ class MetricCollection:
         prefix / postfix: added to each output key.
         compute_groups: True (auto-detect), False (disable), or explicit list of
             lists of metric names.
+        executor: route eager ``update``/``forward`` through ONE fused,
+            donated-state compiled call covering every compute group
+            (ops/executor.py). ``None`` (default) follows the
+            ``TORCHMETRICS_TPU_EXECUTOR`` env flag; ``False`` restores the
+            per-metric eager loop (members may still use their own executors).
 
     Example:
         >>> import jax.numpy as jnp
@@ -62,14 +67,41 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        executor: Optional[bool] = None,
     ) -> None:
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
         self._groups_checked = False
         self._state_is_copy = False
+        if executor is not None and not isinstance(executor, bool):
+            raise ValueError(f"Expected keyword argument `executor` to be a `bool` but got {executor}")
+        self._executor_enabled = executor
+        self._executor_obj: Optional[Any] = None
         self._modules: Dict[str, Metric] = {}
         self.add_metrics(metrics, *additional_metrics)
+
+    def _get_executor(self):
+        """The lazily-built fused collection executor, or None when disabled."""
+        if self._executor_enabled is False:
+            return None
+        from torchmetrics_tpu.ops import executor as _executor_mod
+
+        if self._executor_enabled is None and not _executor_mod.executor_enabled_default():
+            return None
+        if self._executor_obj is None:
+            self._executor_obj = _executor_mod.CollectionExecutor(self)
+        return self._executor_obj
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state["_executor_obj"] = None  # compiled executables are process-local
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.__dict__.setdefault("_executor_obj", None)
+        self.__dict__.setdefault("_executor_enabled", None)
 
     # --------------------------------------------------------------- plumbing
     @staticmethod
@@ -187,8 +219,17 @@ class MetricCollection:
 
     # ------------------------------------------------------------- metric API
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each metric once per compute group (reference :200-226)."""
+        """Update each metric once per compute group (reference :200-226).
+
+        Once groups are resolved, the fused executor runs EVERY group's update
+        as one compiled, donated-state call; when it cannot (disabled, an
+        untraceable leader, exotic inputs), the per-group loop below runs and
+        each leader still benefits from its own per-metric executor."""
         if self._groups_checked:
+            ex = self._get_executor()
+            if ex is not None and ex.run_update(args, kwargs):
+                self._compute_groups_create_state_ref()
+                return
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
                 m0.update(*args, **m0._filter_kwargs(**kwargs))
@@ -268,6 +309,11 @@ class MetricCollection:
         """Point follower states at the leader's arrays (reference :289-308)."""
         for cg in self._groups.values():
             m0 = self._modules[cg[0]]
+            if len(cg) > 1:
+                # the group's arrays are intentionally aliased: the per-metric
+                # executor must never donate them (the collection's fused
+                # executor manages donation for the group as a whole)
+                m0.__dict__["_state_shared"] = True
             for name in cg[1:]:
                 follower = self._modules[name]
                 for state in m0._defaults:
@@ -275,6 +321,7 @@ class MetricCollection:
                     follower._state[state] = list(val) if isinstance(val, list) else val
                 follower._update_count = m0._update_count
                 follower._computed = None
+                follower.__dict__["_state_shared"] = True
         self._state_is_copy = copy
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -288,6 +335,13 @@ class MetricCollection:
         """
         res: Dict[str, Any] = {}
         if self._groups_checked and self._enable_compute_groups:
+            ex = self._get_executor()
+            if ex is not None:
+                fused = ex.run_forward(args, kwargs)
+                if fused is not None:
+                    self._compute_groups_create_state_ref()
+                    out, _ = _flatten_dict({self._set_name(k): v for k, v in fused.items()})
+                    return out
             for cg in self._groups.values():
                 members = [(n, self._modules[n]) for n in cg]
                 m0 = members[0][1]
@@ -463,7 +517,11 @@ class MetricCollection:
 
         def _sig_of_state(st: Dict[str, Any]) -> tuple:
             return tuple(
-                sorted((k, getattr(v, "shape", None), str(getattr(v, "dtype", ""))) for k, v in st.items())
+                sorted(
+                    (k, getattr(v, "shape", None), str(getattr(v, "dtype", "")))
+                    for k, v in st.items()
+                    if k != Metric._STATE_COUNT_KEY  # reserved count key is not a state field
+                )
             )
 
         for cg in self._groups.values():

@@ -75,6 +75,10 @@ class Metric:
             - ``distributed_available_fn``: override the world check.
             - ``sync_on_compute``: sync state automatically in ``compute`` (default True).
             - ``compute_with_cache``: cache the result of ``compute`` (default True).
+            - ``executor``: route eager ``update``/``forward`` through the
+              donated-state jitted executor (ops/executor.py). ``None`` (default)
+              follows the ``TORCHMETRICS_TPU_EXECUTOR`` env flag (on unless set
+              to ``0``); ``False`` restores the op-by-op eager path exactly.
 
     Example:
         >>> import jax.numpy as jnp
@@ -125,6 +129,9 @@ class Metric:
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         if not isinstance(self.compute_with_cache, bool):
             raise ValueError(f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}")
+        self._executor_enabled = kwargs.pop("executor", None)
+        if self._executor_enabled is not None and not isinstance(self._executor_enabled, bool):
+            raise ValueError(f"Expected keyword argument `executor` to be a `bool` but got {self._executor_enabled}")
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -142,6 +149,15 @@ class Metric:
 
         self._cache: Optional[Dict[str, Any]] = None
         self._is_synced = False
+
+        # donated-state executor bookkeeping (ops/executor.py): built lazily;
+        # _state_escaped means some state array may be referenced outside this
+        # metric (so the executor copies before donating), _state_shared means
+        # the arrays are aliased by a MetricCollection compute group (the
+        # collection's fused executor manages donation for the whole group).
+        self._executor_obj: Optional[Any] = None
+        self._state_escaped = True
+        self._state_shared = False
 
     # ------------------------------------------------------------------ states
     def add_state(
@@ -175,8 +191,12 @@ class Metric:
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
-        state = self.__dict__.get("_state")
+        d = self.__dict__
+        state = d.get("_state")
         if state is not None and name in state:
+            # the returned array may now be referenced outside the metric: the
+            # executor must not donate it until it produces fresh state again
+            d["_state_escaped"] = True
             return state[name]
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
@@ -186,12 +206,14 @@ class Metric:
         state = self.__dict__.get("_state")
         if state is not None and name in state:
             state[name] = value
+            self.__dict__["_state_escaped"] = True
             return
         object.__setattr__(self, name, value)
 
     @property
     def metric_state(self) -> Dict[str, Any]:
         """Current (live) state values (reference metric.py:190-193)."""
+        self.__dict__["_state_escaped"] = True
         return {attr: self._state[attr] for attr in self._defaults}
 
     @property
@@ -220,11 +242,44 @@ class Metric:
         return jnp.float32
 
     # ------------------------------------------------------------- update path
+    def _get_executor(self):
+        """The lazily-built donated-state executor for this instance, or None
+        when disabled (``executor=False`` ctor arg or the
+        ``TORCHMETRICS_TPU_EXECUTOR`` env flag)."""
+        enabled = self.__dict__.get("_executor_enabled")
+        if enabled is False:
+            return None
+        from torchmetrics_tpu.ops import executor as _executor_mod
+
+        if enabled is None and not _executor_mod.executor_enabled_default():
+            return None
+        ex = self.__dict__.get("_executor_obj")
+        if ex is None:
+            cls = type(self)
+            ex = _executor_mod.MetricExecutor(
+                self,
+                plain_functional=(
+                    cls.functional_update is Metric.functional_update
+                    and cls.functional_compute is Metric.functional_compute
+                ),
+                plain_forward=(
+                    cls.functional_forward is Metric.functional_forward
+                    and cls.merge_states is Metric.merge_states
+                ),
+            )
+            object.__setattr__(self, "_executor_obj", ex)
+        return ex
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
+            ex = self._get_executor()
+            if ex is not None:
+                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                    if ex.run_update(args, kwargs):
+                        return
             try:
                 # per-metric profiler scope (SURVEY §5: the TPU analogue of the
                 # reference's torch._C._log_api_usage_once telemetry)
@@ -280,7 +335,16 @@ class Metric:
 
     # ----------------------------------------------------------- forward paths
     def forward(self, *args: Any, **kwargs: Any) -> Any:
-        """Accumulate into global state AND return the batch value (metric.py:281-312)."""
+        """Accumulate into global state AND return the batch value (metric.py:281-312).
+
+        When the executor is enabled, the whole forward — batch-state update,
+        batch-value compute, and the global-state merge — runs as ONE compiled
+        computation with the accumulated state donated (ops/executor.py)."""
+        ex = self._get_executor()
+        if ex is not None:
+            handled, batch_val = ex.run_forward(args, kwargs)
+            if handled:
+                return batch_val
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             return self._forward_full_state_update(*args, **kwargs)
         return self._forward_reduce_state_update(*args, **kwargs)
@@ -423,14 +487,27 @@ class Metric:
     # ------------------------------------------------------- pure / functional
     def _copy_state_dict(self) -> Dict[str, Any]:
         """Shallow-copy live state; jnp arrays are immutable so no deepcopy needed."""
+        self.__dict__["_state_escaped"] = True  # handing out aliases: no donation until re-owned
         out: Dict[str, Any] = {}
         for k, v in self._state.items():
             out[k] = list(v) if isinstance(v, list) else v
         return out
 
+    #: reserved state key carrying the update count through state()/load_state
+    _STATE_COUNT_KEY = "_update_count"
+
     def state(self) -> Dict[str, Any]:
-        """The live state as a pytree (entry point of the pure API)."""
-        return self._copy_state_dict()
+        """The live state as a pytree (entry point of the pure API).
+
+        The export carries the update count under the reserved key
+        ``"_update_count"`` (a plain int leaf) so :meth:`load_state`
+        round-trips it without the caller passing it explicitly; the
+        functional entry points strip the key on input, and
+        :meth:`merge_states` drops it (it iterates declared states only).
+        """
+        out = self._copy_state_dict()
+        out[self._STATE_COUNT_KEY] = int(self._update_count)
+        return out
 
     def init_state(self) -> Dict[str, Any]:
         """A fresh default state pytree (the pure analogue of ``reset``)."""
@@ -450,7 +527,11 @@ class Metric:
         """
         saved = self._state
         try:
-            object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
+            object.__setattr__(
+                self,
+                "_state",
+                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k != self._STATE_COUNT_KEY},
+            )
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
                 self._update_fn(*args, **kwargs)
             return self._copy_state_dict()
@@ -461,7 +542,11 @@ class Metric:
         """Pure compute: ``state -> value``. jit-safe."""
         saved = self._state
         try:
-            object.__setattr__(self, "_state", {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()})
+            object.__setattr__(
+                self,
+                "_state",
+                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k != self._STATE_COUNT_KEY},
+            )
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
                 return _squeeze_if_scalar(self._compute_fn())
         finally:
@@ -533,18 +618,24 @@ class Metric:
     def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
         """Install a state pytree as the live state (inverse of :meth:`state`).
 
-        ``update_count`` restores the number of updates the state represents;
-        without it the count is set to exactly 1 (a restored state counts as
-        updated so ``compute()`` does not warn, and a stale pre-load count on the
-        target instance is never kept). Metrics whose states declare a ``"mean"``
-        reduction (none in-tree — MeanMetric carries an explicit weight state)
-        need the true count for count-weighted ``forward`` merges after resume.
+        ``update_count`` restores the number of updates the state represents.
+        When omitted, a count carried by the state itself (the reserved
+        ``"_update_count"`` key every :meth:`state` export includes) is used,
+        so ``m2.load_state(m1.state())`` round-trips the count without the
+        caller passing it; with neither, the count falls back to exactly 1 (a
+        restored state counts as updated so ``compute()`` does not warn, and a
+        stale pre-load count on the target instance is never kept). The count
+        weights ``"mean"``-reduced merges in ``forward`` after a resume.
         """
+        carried = state.get(self._STATE_COUNT_KEY)
+        if update_count is None and carried is not None:
+            update_count = int(np.asarray(carried))
         for k in self._defaults:
             if k not in state:
                 raise KeyError(f"state missing field {k!r}")
             v = state[k]
             self._state[k] = list(v) if isinstance(v, (list, tuple)) else v
+        self.__dict__["_state_escaped"] = True  # installed arrays have external aliases
         self._computed = None
         self._update_count = self._restored_count(update_count)
 
@@ -567,6 +658,9 @@ class Metric:
                 self._state[attr] = []
             else:
                 self._state[attr] = jnp.asarray(default)
+        # fresh states alias _defaults (jnp.asarray is a no-op on jnp arrays):
+        # the executor must copy before its next donation
+        self.__dict__["_state_escaped"] = True
         self._cache = None
         self._is_synced = False
 
@@ -604,6 +698,7 @@ class Metric:
                     self._state[key] = jnp.asarray(value)
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
+        self.__dict__["_state_escaped"] = True
         self._computed = None
 
     def to(self, device) -> "Metric":
@@ -617,6 +712,7 @@ class Metric:
             k: ([jax.device_put(el, device) for el in v] if isinstance(v, list) else jax.device_put(v, device))
             for k, v in self._defaults.items()
         }
+        self.__dict__["_state_escaped"] = True
         return self
 
     def set_dtype(self, dst_type) -> "Metric":
@@ -631,6 +727,7 @@ class Metric:
         self._defaults = {
             k: ([_cast(el) for el in v] if isinstance(v, list) else _cast(v)) for k, v in self._defaults.items()
         }
+        self.__dict__["_state_escaped"] = True
         self._dtype_convert = False
         return self
 
@@ -681,6 +778,10 @@ class Metric:
         state.pop("_update_fn", None)
         state.pop("_compute_fn", None)
         state.pop("_update_signature", None)
+        # compiled executables are process-local; a restored copy owns nothing
+        state["_executor_obj"] = None
+        state["_state_escaped"] = True
+        state["_state_shared"] = False
         # jnp arrays pickle fine via numpy
         state["_state"] = {
             k: ([np.asarray(el) for el in v] if isinstance(v, list) else np.asarray(v)) for k, v in state["_state"].items()
@@ -693,6 +794,10 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self.__dict__.setdefault("_executor_obj", None)
+        self.__dict__.setdefault("_executor_enabled", None)
+        self.__dict__.setdefault("_state_escaped", True)
+        self.__dict__.setdefault("_state_shared", False)
         self._state = {
             k: ([jnp.asarray(el) for el in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
         }

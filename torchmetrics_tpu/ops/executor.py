@@ -1,0 +1,963 @@
+"""Donated-state jitted executor for the eager stateful API (L2/L4).
+
+The pure functional path (``functional_update`` inside a user's jitted train
+step) has always enjoyed fused XLA execution; the stateful shell
+(``Metric.update()``, ``forward()``, ``MetricCollection`` in a plain eval loop)
+dispatched op-by-op from Python. This module closes that gap: every eager
+``update``/``forward`` call looks up (or builds) a compiled function
+
+    state' = f(state, *batch)
+
+keyed by ``(call kind, input pytree structure, shape bucket, dtypes)`` with the
+state pytree **donated** (``donate_argnums=0``), so large accumulators
+(capacity-buffered curves, confusion matrices, feature buffers) are updated in
+place instead of copied every step.
+
+Shape bucketing
+    Ragged batches (the last batch of an epoch) are padded up a small geometric
+    ladder of power-of-two buckets so they reuse the warm executable instead of
+    triggering a recompile. Padding rows are copies of the batch's first row;
+    inside the trace the padded contribution is subtracted exactly for
+    ``"sum"``-reduced states (duplicated real rows are no-ops for ``max``/
+    ``min`` states). The correction assumes the update is per-sample additive,
+    which the executor *verifies empirically*: the first padded call for a
+    metric also runs the eager op-by-op oracle and compares; on any mismatch
+    bucketing is disabled for that instance (exact-shape compilation remains).
+
+Donation ownership
+    Donating a buffer invalidates every other reference to it, so the executor
+    only donates arrays it itself produced and that have not escaped to user
+    code since. ``Metric`` tracks two flags:
+
+    - ``_state_escaped`` — some state array may be referenced outside the
+      metric (a ``state()`` export, an attribute read, a fresh ``reset`` whose
+      arrays alias ``_defaults``). The next executor call copies the state
+      once, then re-owns the result.
+    - ``_state_shared`` — the arrays are aliased *by design* inside a
+      ``MetricCollection`` compute group. The single-metric executor never
+      donates shared state; the collection's fused executor manages the group
+      as a whole.
+
+    The first call on a fresh cache key also copies, so a compile-time failure
+    can never consume live state.
+
+Escape hatch
+    ``Metric(..., executor=False)`` / ``MetricCollection(..., executor=False)``
+    or the environment variable ``TORCHMETRICS_TPU_EXECUTOR=0`` restore the
+    previous eager op-by-op path exactly; any error while tracing a metric's
+    update falls back to the eager path permanently for that instance (the
+    reason is recorded in :func:`executor_stats`).
+
+Synced path
+    :func:`make_synced_collection_step` builds the fused
+    ``update -> sync -> compute`` step used under ``shard_map``: the
+    collection-level leaf fusion in ``parallel/sync.py`` coalesces the whole
+    collection's collectives into one ``psum`` per (reduction, dtype) per step,
+    and computed values are packed into one replicated buffer per dtype so an
+    N-metric collection pays O(dtypes), not O(N), per-output dispatch cost.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# CPU (and some other) backends do not implement buffer donation; jax warns on
+# every dispatch. Donation is still semantically correct there (silently
+# ignored), so silence exactly that message.
+warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+
+ENV_FLAG = "TORCHMETRICS_TPU_EXECUTOR"
+
+#: reserved key carried by ``Metric.state()`` exports (see metric.py)
+STATE_COUNT_KEY = "_update_count"
+
+_BUCKET_FLOOR = 8
+_FUSABLE_REDUCTIONS = ("sum", "max", "min")
+_PY_SCALARS = (bool, int, float, complex, np.generic)
+
+
+def executor_enabled_default() -> bool:
+    """Global default from the environment (``TORCHMETRICS_TPU_EXECUTOR``)."""
+    return os.environ.get(ENV_FLAG, "1").strip().lower() not in ("0", "false", "off", "no")
+
+
+def bucket_size(n: int) -> int:
+    """Next rung of the geometric bucket ladder: powers of two, floor 8.
+
+    >>> [bucket_size(n) for n in (1, 8, 9, 100, 1024)]
+    [8, 8, 16, 128, 1024]
+    """
+    n = int(n)
+    if n <= _BUCKET_FLOOR:
+        return _BUCKET_FLOOR
+    return 1 << (n - 1).bit_length()
+
+
+def _trace_clean() -> bool:
+    try:
+        return bool(jax.core.trace_state_clean())
+    except Exception:
+        return True
+
+
+def _is_concrete_array(x: Any) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, jax.core.Tracer)
+
+
+def _classify_leaves(leaves: Sequence[Any]):
+    """Per-leaf signature, or None when any leaf cannot cross a jit boundary.
+
+    Python ``bool`` leaves key on their VALUE: they stay static (closed over
+    per executable) so flag arguments like FID's ``update(imgs, real=True)``
+    keep driving Python control flow instead of becoming tracers.
+    """
+    sig: List[Any] = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.core.Tracer):
+            return None
+        if type(leaf) is bool:
+            sig.append(("static_bool", leaf))
+        elif _is_concrete_array(leaf):
+            arr = leaf if hasattr(leaf, "dtype") else np.asarray(leaf)
+            sig.append((tuple(arr.shape), str(arr.dtype)))
+        elif isinstance(leaf, _PY_SCALARS):
+            sig.append(("py", type(leaf).__name__))
+        else:
+            return None
+    return tuple(sig)
+
+
+def _split_static_bools(leaves: Sequence[Any]):
+    """(dynamic leaves, ((index, value), ...)) — bools are closed over, not traced."""
+    dyn: List[Any] = []
+    spec: List[Tuple[int, bool]] = []
+    for i, leaf in enumerate(leaves):
+        if type(leaf) is bool:
+            spec.append((i, leaf))
+        else:
+            dyn.append(leaf)
+    return dyn, tuple(spec)
+
+
+def _merge_static_bools(dyn: Sequence[Any], spec: Tuple[Tuple[int, bool], ...], total: int) -> List[Any]:
+    fixed = dict(spec)
+    it = iter(dyn)
+    return [fixed[i] if i in fixed else next(it) for i in range(total)]
+
+
+def _common_batch_dim(leaves: Sequence[Any]) -> Optional[int]:
+    """The shared leading dim of every >=1-d array leaf, if one exists."""
+    dims = set()
+    for leaf in leaves:
+        if _is_concrete_array(leaf) and getattr(leaf, "ndim", 0) >= 1:
+            dims.add(int(leaf.shape[0]))
+    if len(dims) != 1:
+        return None
+    return dims.pop()
+
+
+def _pad_leaves(leaves: Sequence[Any], batched: Sequence[bool], pad_to: int) -> List[Any]:
+    """Pad each batched leaf's leading dim to ``pad_to`` with copies of row 0."""
+    out: List[Any] = []
+    for leaf, is_batched in zip(leaves, batched):
+        if not is_batched:
+            out.append(leaf)
+            continue
+        arr = jnp.asarray(leaf)
+        n = arr.shape[0]
+        if n == pad_to:
+            out.append(arr)
+        else:
+            fill = jnp.broadcast_to(arr[:1], (pad_to - n,) + arr.shape[1:])
+            out.append(jnp.concatenate([arr, fill], axis=0))
+    return out
+
+
+def _row0_leaves(leaves: Sequence[Any], batched: Sequence[bool]) -> List[Any]:
+    return [leaf[:1] if is_batched else leaf for leaf, is_batched in zip(leaves, batched)]
+
+
+def _tree_copy(state: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: jnp.array(v, copy=True) for k, v in state.items()}
+
+
+def _states_close(a: Dict[str, Any], b: Dict[str, Any], fields) -> bool:
+    for k in fields:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        if x.shape != y.shape:
+            return False
+        if np.issubdtype(x.dtype, np.floating):
+            if not np.allclose(x, y, rtol=1e-4, atol=1e-6, equal_nan=True):
+                return False
+        elif not np.array_equal(x, y):
+            return False
+    return True
+
+
+def _values_close(a: Any, b: Any) -> bool:
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb or len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or not np.allclose(x, y, rtol=1e-4, atol=1e-6, equal_nan=True):
+            return False
+    return True
+
+
+def _subtract_pad_contribution(
+    metric: Any,
+    updated: Dict[str, Any],
+    defaults: Dict[str, Any],
+    init_const: Dict[str, Any],
+    row0_args: tuple,
+    row0_kwargs: dict,
+    extra: Any,
+) -> Dict[str, Any]:
+    """Remove the padding rows' contribution from an updated state pytree.
+
+    ``extra`` (traced scalar) is the number of padded rows, each a copy of the
+    batch's first row. For per-sample-additive ``"sum"`` states the padding
+    adds exactly ``extra * (update(init, row0) - default)``; duplicated real
+    rows can never change a ``max``/``min`` state. Validity is probed
+    empirically on the first padded call (see module docstring).
+    """
+    d1 = metric.functional_update(init_const, *row0_args, **row0_kwargs)
+    out: Dict[str, Any] = {}
+    for field in metric._defaults:
+        if metric._reductions.get(field) == "sum":
+            contrib = d1[field] - defaults[field]
+            out[field] = updated[field] - contrib * extra.astype(jnp.asarray(contrib).dtype)
+        else:
+            out[field] = updated[field]
+    return out
+
+
+def _new_stats() -> Dict[str, Any]:
+    return {
+        "calls": 0,          # executor actually ran the computation
+        "compiles": 0,       # distinct cache keys built (one XLA compile each)
+        "cache_hits": 0,     # calls served by a warm executable
+        "padded_calls": 0,   # calls that padded a ragged batch up the ladder
+        "donated_calls": 0,  # calls that donated the live state buffers
+        "copied_calls": 0,   # calls that copied first (escaped/shared/fresh key)
+        "probes": 0,         # eager oracle runs validating padded execution
+        "skipped_calls": 0,  # per-call ineligibility (tracers, odd inputs)
+    }
+
+
+class _ExecutorBase:
+    """Shared cache/stats/flag plumbing for metric- and collection-executors."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[Any, Callable] = {}
+        self.stats = _new_stats()
+        self.disabled_reason: Optional[str] = None
+        self._static_reason_cached: Any = ()  # sentinel: not yet computed
+        self._pad_validated = False
+        self._bucketing_ok = True
+
+    def _get_fn(self, key: Any, builder: Callable[[], Callable]) -> Tuple[Callable, bool]:
+        fn = self._cache.get(key)
+        if fn is not None:
+            self.stats["cache_hits"] += 1
+            return fn, False
+        fn = jax.jit(builder(), donate_argnums=0)
+        self._cache[key] = fn
+        self.stats["compiles"] += 1
+        return fn, True
+
+    def stats_dict(self) -> Dict[str, Any]:
+        out = dict(self.stats)
+        out["disabled_reason"] = self.disabled_reason
+        out["bucketing_enabled"] = self._bucketing_ok
+        out["cached_executables"] = len(self._cache)
+        return out
+
+
+class MetricExecutor(_ExecutorBase):
+    """Per-``Metric`` executor: compiled update/forward with donated state."""
+
+    def __init__(self, metric: Any, plain_functional: bool, plain_forward: bool) -> None:
+        super().__init__()
+        self._metric = metric
+        self._plain_functional = plain_functional
+        self._plain_forward = plain_forward
+
+    # ------------------------------------------------------------ eligibility
+    def _static_reason(self) -> Optional[str]:
+        if self._static_reason_cached != ():
+            return self._static_reason_cached
+        m = self._metric
+        reason = None
+        if not self._plain_functional:
+            reason = "functional_update/functional_compute overridden"
+        elif getattr(m, "executor_compatible", True) is False:
+            reason = "metric declares executor_compatible=False"
+        elif not m._defaults:
+            reason = "no registered states"
+        elif any(isinstance(v, list) for v in m._defaults.values()):
+            reason = "list states change pytree structure every update"
+        elif m.compute_on_cpu:
+            reason = "compute_on_cpu moves states host-side after update"
+        elif getattr(m, "validate_args", None) is True:
+            reason = "validate_args=True needs concrete input checks"
+        else:
+            hook = getattr(m, "_executor_traceable", None)
+            if callable(hook) and not hook():
+                reason = "metric declares itself untraceable"
+        self._static_reason_cached = reason
+        return reason
+
+    def usable(self) -> bool:
+        return self.disabled_reason is None and self._static_reason() is None
+
+    def stats_dict(self) -> Dict[str, Any]:
+        out = super().stats_dict()
+        if out["disabled_reason"] is None:
+            out["disabled_reason"] = self._static_reason()
+        return out
+
+    def bucketable(self) -> bool:
+        if not self._bucketing_ok:
+            return False
+        m = self._metric
+        for field, fx in m._reductions.items():
+            if fx not in _FUSABLE_REDUCTIONS:
+                return False
+            if fx == "sum" and jnp.asarray(m._defaults[field]).dtype == jnp.bool_:
+                return False
+        return True
+
+    # --------------------------------------------------------------- builders
+    def _consts(self):
+        m = self._metric
+        defaults = {k: jnp.asarray(v) for k, v in m._defaults.items()}
+        return defaults
+
+    def _build_update(self, treedef, batched, bucket, padded, bool_spec, n_leaves):
+        m = self._metric
+        defaults = self._consts()
+
+        if not padded:
+            def raw(state, *dyn):
+                leaves = _merge_static_bools(dyn, bool_spec, n_leaves)
+                args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+                return m.functional_update(state, *args, **kwargs)
+            return raw
+
+        def raw(state, n_valid, *dyn):
+            leaves = _merge_static_bools(dyn, bool_spec, n_leaves)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            g = m.functional_update(state, *args, **kwargs)
+            r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
+            extra = jnp.asarray(bucket, jnp.int32) - n_valid
+            return _subtract_pad_contribution(m, g, defaults, defaults, r_args, r_kwargs, extra)
+
+        return raw
+
+    def _build_forward(self, treedef, batched, bucket, padded, variant, bool_spec, n_leaves):
+        m = self._metric
+        defaults = self._consts()
+        one = jnp.asarray(1, jnp.int32)
+
+        def batch_state(leaves):
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            return m.functional_update(defaults, *args, **kwargs), (args, kwargs)
+
+        def raw(state, count, *rest):
+            if padded:
+                n_valid, dyn = rest[0], rest[1:]
+                extra = jnp.asarray(bucket, jnp.int32) - n_valid
+            else:
+                dyn = rest
+                extra = None
+            leaves = _merge_static_bools(dyn, bool_spec, n_leaves)
+            bs, (args, kwargs) = batch_state(leaves)
+            if extra is not None:
+                r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
+                bs = _subtract_pad_contribution(m, bs, defaults, defaults, r_args, r_kwargs, extra)
+            value = m.functional_compute(bs)
+            if variant == "reduce":
+                new_state = m.merge_states(state, bs, counts=(count, one))
+            else:
+                new_state = m.functional_update(state, *args, **kwargs)
+                if extra is not None:
+                    r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
+                    new_state = _subtract_pad_contribution(
+                        m, new_state, defaults, defaults, r_args, r_kwargs, extra
+                    )
+            return new_state, value
+
+        return raw
+
+    # ----------------------------------------------------------------- shared
+    def _prepare(self, args, kwargs):
+        """Classify inputs; returns (treedef, leaves, sig, batched, bucket, n) or None.
+
+        ``(args, kwargs)`` flatten as one pytree: dict keys live in the treedef
+        (jax sorts them), so keyword order never splits the executable cache.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = _classify_leaves(leaves)
+        if sig is None:
+            return None
+        n = _common_batch_dim(leaves)
+        bucket = None
+        padded = False
+        if n is not None and n > 0 and self.bucketable():
+            bucket = bucket_size(n)
+            padded = bucket != n
+        if padded:
+            batched = tuple(
+                _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
+                for l in leaves
+            )
+            call_leaves = _pad_leaves(leaves, batched, bucket)
+            sig = _classify_leaves(call_leaves)
+        else:
+            batched = None
+            call_leaves = list(leaves)
+        dyn_leaves, bool_spec = _split_static_bools(call_leaves)
+        return treedef, dyn_leaves, sig, batched, bucket, n, padded, bool_spec, len(call_leaves)
+
+    # ------------------------------------------------------------------ entry
+    def run_update(self, args: tuple, kwargs: dict) -> bool:
+        """Execute ``update`` through the compiled path; False -> caller falls
+        back to the eager body (never partially applied)."""
+        if not self.usable():
+            return False
+        if not _trace_clean():
+            self.stats["skipped_calls"] += 1
+            return False
+        try:
+            return self._run_update(args, kwargs)
+        except Exception as err:  # sticky: a metric that cannot trace stays eager
+            self.disabled_reason = f"{type(err).__name__}: {err}"
+            return False
+
+    def _run_update(self, args, kwargs) -> bool:
+        prep = self._prepare(args, kwargs)
+        if prep is None:
+            self.stats["skipped_calls"] += 1
+            return False
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        m = self._metric
+
+        key = ("u", treedef, sig, batched, bucket if padded else None)
+        fn, fresh = self._get_fn(
+            key, lambda: self._build_update(treedef, batched, bucket, padded, bool_spec, n_leaves)
+        )
+
+        state = {k: m._state[k] for k in m._defaults}
+        need_copy = fresh or m._state_escaped or m._state_shared
+        state_in = _tree_copy(state) if need_copy else state
+
+        do_probe = padded and not self._pad_validated
+        oracle = m.functional_update(state, *args, **kwargs) if do_probe else None
+
+        if padded:
+            new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
+            self.stats["padded_calls"] += 1
+        else:
+            new_state = fn(state_in, *call_leaves)
+
+        if do_probe:
+            self.stats["probes"] += 1
+            if _states_close(new_state, oracle, m._defaults):
+                self._pad_validated = True
+            else:
+                # bucketing is numerically unsafe for this metric: discard the
+                # padded result (the live state was untouched — probe calls
+                # always run on a copy) and re-dispatch through the
+                # exact-shape compiled path, so every call stays consistently
+                # compiled rather than one call carrying eager-flavoured
+                # rounding
+                self._bucketing_ok = False
+                return self._run_update(args, kwargs)
+
+        self.stats["calls"] += 1
+        self.stats["copied_calls" if need_copy else "donated_calls"] += 1
+        object.__setattr__(m, "_state", dict(new_state))
+        m.__dict__["_state_escaped"] = False
+        return True
+
+    def run_forward(self, args: tuple, kwargs: dict) -> Tuple[bool, Any]:
+        """Execute ``forward`` as one fused ``(state, batch) -> (state', value)``
+        computation. Returns ``(handled, batch_value)``."""
+        m = self._metric
+        if not self.usable() or not self._plain_forward or m.dist_sync_on_step:
+            return False, None
+        if not _trace_clean():
+            self.stats["skipped_calls"] += 1
+            return False, None
+        try:
+            return self._run_forward(args, kwargs)
+        except Exception as err:
+            self.disabled_reason = f"{type(err).__name__}: {err}"
+            return False, None
+
+    def _forward_oracle(self, variant, state, args, kwargs, count):
+        m = self._metric
+        bs = m.functional_update(m.functional_init(), *args, **kwargs)
+        value = m.functional_compute(bs)
+        if variant == "reduce":
+            new_state = m.merge_states(state, bs, counts=(count, 1))
+        else:
+            new_state = m.functional_update(state, *args, **kwargs)
+        return new_state, value
+
+    def _run_forward(self, args, kwargs):
+        prep = self._prepare(args, kwargs)
+        if prep is None:
+            self.stats["skipped_calls"] += 1
+            return False, None
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        m = self._metric
+        variant = "reduce" if m.full_state_update is False else "full"
+
+        key = ("f", variant, treedef, sig, batched, bucket if padded else None)
+        fn, fresh = self._get_fn(
+            key,
+            lambda: self._build_forward(treedef, batched, bucket, padded, variant, bool_spec, n_leaves),
+        )
+
+        state = {k: m._state[k] for k in m._defaults}
+        count = int(m._update_count)
+        need_copy = fresh or m._state_escaped or m._state_shared
+        state_in = _tree_copy(state) if need_copy else state
+
+        do_probe = padded and not self._pad_validated
+        oracle = self._forward_oracle(variant, state, args, kwargs, count) if do_probe else None
+
+        count_arr = jnp.asarray(count, jnp.int32)
+        if padded:
+            new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
+            self.stats["padded_calls"] += 1
+        else:
+            new_state, value = fn(state_in, count_arr, *call_leaves)
+
+        if do_probe:
+            self.stats["probes"] += 1
+            if _states_close(new_state, oracle[0], m._defaults) and _values_close(value, oracle[1]):
+                self._pad_validated = True
+            else:
+                # see _run_update: discard and re-dispatch unpadded
+                self._bucketing_ok = False
+                return self._run_forward(args, kwargs)
+
+        self.stats["calls"] += 1
+        self.stats["copied_calls" if need_copy else "donated_calls"] += 1
+        object.__setattr__(m, "_state", dict(new_state))
+        m.__dict__["_state_escaped"] = False
+        m._update_count += 1
+        m._computed = None
+        m._to_sync = m.sync_on_compute
+        m._should_unsync = True
+        return True, value
+
+
+class CollectionExecutor(_ExecutorBase):
+    """Fused executor for a ``MetricCollection``: one compiled call updates (or
+    forwards) EVERY compute group, with the combined leader-state pytree
+    donated. Engages only when every group leader is executor-eligible;
+    otherwise the collection falls back to the per-metric loop (where each
+    leader still uses its own :class:`MetricExecutor`)."""
+
+    def __init__(self, collection: Any) -> None:
+        super().__init__()
+        self._coll = collection
+
+    # ------------------------------------------------------------ eligibility
+    def _leaders(self):
+        coll = self._coll
+        return [(cg[0], coll._modules[cg[0]], cg) for cg in coll._groups.values()]
+
+    def _leader_executors(self):
+        out = []
+        for name, m, cg in self._leaders():
+            ex = m._get_executor()
+            if ex is None or not ex.usable():
+                return None
+            if any(getattr(mm, "_executor_enabled", None) is False for mm in (self._coll._modules[x] for x in cg)):
+                return None
+            out.append((name, m, cg, ex))
+        return out
+
+    def bucketable(self, leader_execs) -> bool:
+        return self._bucketing_ok and all(ex.bucketable() for _, _, _, ex in leader_execs)
+
+    def _kwarg_names(self, m, kwargs) -> Tuple[str, ...]:
+        return tuple(sorted(m._filter_kwargs(**kwargs)))
+
+    # --------------------------------------------------------------- builders
+    def _build_update(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves):
+        coll = self._coll
+
+        def raw(states, *rest):
+            if padded:
+                n_valid, dyn = rest[0], rest[1:]
+                extra = jnp.asarray(bucket, jnp.int32) - n_valid
+            else:
+                dyn, extra = rest, None
+            leaves = _merge_static_bools(dyn, bool_spec, n_leaves)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            if extra is not None:
+                r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
+            out = {}
+            for leader, kw_names, defaults in leader_specs:
+                m = coll._modules[leader]
+                fkw = {k: kwargs[k] for k in kw_names}
+                g = m.functional_update(states[leader], *args, **fkw)
+                if extra is not None:
+                    rkw = {k: r_kwargs[k] for k in kw_names}
+                    g = _subtract_pad_contribution(m, g, defaults, defaults, r_args, rkw, extra)
+                out[leader] = g
+            return out
+
+        return raw
+
+    def _build_forward(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves):
+        coll = self._coll
+        one = jnp.asarray(1, jnp.int32)
+
+        def raw(states, counts, *rest):
+            if padded:
+                n_valid, dyn = rest[0], rest[1:]
+                extra = jnp.asarray(bucket, jnp.int32) - n_valid
+            else:
+                dyn, extra = rest, None
+            leaves = _merge_static_bools(dyn, bool_spec, n_leaves)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            if extra is not None:
+                r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
+            new_states, values = {}, {}
+            for leader, members, kw_names, defaults in leader_specs:
+                m = coll._modules[leader]
+                fkw = {k: kwargs[k] for k in kw_names}
+                bs = m.functional_update(defaults, *args, **fkw)
+                if extra is not None:
+                    rkw = {k: r_kwargs[k] for k in kw_names}
+                    bs = _subtract_pad_contribution(m, bs, defaults, defaults, r_args, rkw, extra)
+                new_states[leader] = m.merge_states(states[leader], bs, counts=(counts[leader], one))
+                for name in members:
+                    values[name] = coll._modules[name].functional_compute(bs)
+            return new_states, values
+
+        return raw
+
+    # ----------------------------------------------------------------- shared
+    def _prepare(self, args, kwargs, leader_execs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, tuple(sorted(kwargs.items()))))
+        sig = _classify_leaves(leaves)
+        if sig is None:
+            return None
+        n = _common_batch_dim(leaves)
+        bucket, padded = None, False
+        if n is not None and n > 0 and self.bucketable(leader_execs):
+            bucket = bucket_size(n)
+            padded = bucket != n
+        if padded:
+            batched = tuple(
+                _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
+                for l in leaves
+            )
+            call_leaves = _pad_leaves(leaves, batched, bucket)
+            sig = _classify_leaves(call_leaves)
+        else:
+            batched = None
+            call_leaves = list(leaves)
+        dyn_leaves, bool_spec = _split_static_bools(call_leaves)
+        return treedef, dyn_leaves, sig, batched, bucket, n, padded, bool_spec, len(call_leaves)
+
+    def _group_need_copy(self, cg, fresh) -> bool:
+        mods = self._coll._modules
+        return fresh or any(mods[name]._state_escaped for name in cg)
+
+    def _install(self, leader, new_state, cg, bump_count: bool) -> None:
+        mods = self._coll._modules
+        m0 = mods[leader]
+        object.__setattr__(m0, "_state", dict(new_state))
+        if bump_count:
+            m0._update_count += 1
+        m0._computed = None
+        for name in cg:
+            mm = mods[name]
+            mm.__dict__["_state_escaped"] = False
+            mm.__dict__["_state_shared"] = True
+
+    # ------------------------------------------------------------------ entry
+    def run_update(self, args: tuple, kwargs: dict) -> bool:
+        if self.disabled_reason is not None:
+            return False
+        if not _trace_clean():
+            self.stats["skipped_calls"] += 1
+            return False
+        leader_execs = self._leader_executors()
+        if leader_execs is None:
+            return False
+        try:
+            return self._run_update(args, kwargs, leader_execs)
+        except Exception as err:
+            self.disabled_reason = f"{type(err).__name__}: {err}"
+            return False
+
+    def _run_update(self, args, kwargs, leader_execs) -> bool:
+        prep = self._prepare(args, kwargs, leader_execs)
+        if prep is None:
+            self.stats["skipped_calls"] += 1
+            return False
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        coll = self._coll
+
+        kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
+        key = ("u", treedef, sig, batched, bucket if padded else None, kw_map)
+
+        def builder():
+            specs = [
+                (name, dict(kw_map)[name], {k: jnp.asarray(v) for k, v in m._defaults.items()})
+                for name, m, _ in self._leaders()
+            ]
+            return self._build_update(treedef, batched, bucket, padded, specs, bool_spec, n_leaves)
+
+        fn, fresh = self._get_fn(key, builder)
+
+        states, copied = {}, False
+        for name, m, cg, _ in leader_execs:
+            st = {k: m._state[k] for k in m._defaults}
+            if self._group_need_copy(cg, fresh):
+                st = _tree_copy(st)
+                copied = True
+            states[name] = st
+
+        do_probe = padded and not self._pad_validated
+        oracle = None
+        if do_probe:
+            oracle = {
+                name: m.functional_update({k: m._state[k] for k in m._defaults}, *args, **m._filter_kwargs(**kwargs))
+                for name, m, _, _ in leader_execs
+            }
+
+        if padded:
+            new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
+            self.stats["padded_calls"] += 1
+        else:
+            new_states = fn(states, *call_leaves)
+
+        if do_probe:
+            self.stats["probes"] += 1
+            ok = all(
+                _states_close(new_states[name], oracle[name], m._defaults)
+                for name, m, _, _ in leader_execs
+            )
+            if ok:
+                self._pad_validated = True
+            else:
+                # see MetricExecutor._run_update: discard and re-dispatch unpadded
+                self._bucketing_ok = False
+                return self._run_update(args, kwargs, leader_execs)
+
+        self.stats["calls"] += 1
+        self.stats["copied_calls" if copied else "donated_calls"] += 1
+        for name, _, cg, _ in leader_execs:
+            self._install(name, new_states[name], cg, bump_count=True)
+        return True
+
+    def run_forward(self, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
+        """Fused forward for the WHOLE collection, or None to fall back.
+
+        Only engages when every group qualifies for the reduce-merge forward
+        (all members ``full_state_update=False``, no ``dist_sync_on_step``)."""
+        if self.disabled_reason is not None:
+            return None
+        if not _trace_clean():
+            self.stats["skipped_calls"] += 1
+            return None
+        leader_execs = self._leader_executors()
+        if leader_execs is None:
+            return None
+        from torchmetrics_tpu.metric import Metric  # deferred: avoids import cycle
+
+        coll = self._coll
+        for name, m0, cg, ex in leader_execs:
+            if not ex._plain_forward:
+                return None
+            for member in cg:
+                mm = coll._modules[member]
+                if mm.full_state_update is not False or mm.dist_sync_on_step:
+                    return None
+                # every member's compute traces inside the fused call
+                if type(mm).functional_compute is not Metric.functional_compute:
+                    return None
+        try:
+            return self._run_forward(args, kwargs, leader_execs)
+        except Exception as err:
+            self.disabled_reason = f"{type(err).__name__}: {err}"
+            return None
+
+    def _run_forward(self, args, kwargs, leader_execs):
+        prep = self._prepare(args, kwargs, leader_execs)
+        if prep is None:
+            self.stats["skipped_calls"] += 1
+            return None
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        coll = self._coll
+
+        kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
+        key = ("f", treedef, sig, batched, bucket if padded else None, kw_map)
+
+        def builder():
+            specs = [
+                (
+                    name,
+                    tuple(cg),
+                    dict(kw_map)[name],
+                    {k: jnp.asarray(v) for k, v in m._defaults.items()},
+                )
+                for name, m, cg in self._leaders()
+            ]
+            return self._build_forward(treedef, batched, bucket, padded, specs, bool_spec, n_leaves)
+
+        fn, fresh = self._get_fn(key, builder)
+
+        states, copied = {}, False
+        counts = {}
+        for name, m, cg, _ in leader_execs:
+            st = {k: m._state[k] for k in m._defaults}
+            if self._group_need_copy(cg, fresh):
+                st = _tree_copy(st)
+                copied = True
+            states[name] = st
+            counts[name] = jnp.asarray(int(m._update_count), jnp.int32)
+
+        do_probe = padded and not self._pad_validated
+        oracle = None
+        if do_probe:
+            oracle_states, oracle_values = {}, {}
+            for name, m, cg, _ in leader_execs:
+                bs = m.functional_update(m.functional_init(), *args, **m._filter_kwargs(**kwargs))
+                oracle_states[name] = m.merge_states(
+                    {k: m._state[k] for k in m._defaults}, bs, counts=(int(m._update_count), 1)
+                )
+                for member in cg:
+                    oracle_values[member] = coll._modules[member].functional_compute(bs)
+            oracle = (oracle_states, oracle_values)
+
+        if padded:
+            new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
+            self.stats["padded_calls"] += 1
+        else:
+            new_states, values = fn(states, counts, *call_leaves)
+
+        if do_probe:
+            self.stats["probes"] += 1
+            ok = all(
+                _states_close(new_states[name], oracle[0][name], m._defaults)
+                for name, m, _, _ in leader_execs
+            ) and _values_close(values, oracle[1])
+            if ok:
+                self._pad_validated = True
+            else:
+                # see MetricExecutor._run_update: discard and re-dispatch unpadded
+                self._bucketing_ok = False
+                return self._run_forward(args, kwargs, leader_execs)
+
+        self.stats["calls"] += 1
+        self.stats["copied_calls" if copied else "donated_calls"] += 1
+        for name, _, cg, _ in leader_execs:
+            self._install(name, new_states[name], cg, bump_count=True)
+        return dict(values)
+
+
+# ---------------------------------------------------------------------------
+# synced-path fusion: update -> sync -> compute as ONE computation
+# ---------------------------------------------------------------------------
+
+def make_value_packer(example_values: Any):
+    """Build (pack, unpack) for a fixed values pytree.
+
+    ``pack`` (trace-safe) concatenates all leaves of a values pytree into one
+    flat vector per dtype — an N-metric collection then materialises O(dtypes)
+    replicated output buffers per step instead of O(N). ``unpack`` (host-side)
+    restores the original pytree from the packed dict.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(example_values)
+    specs = [(tuple(l.shape), jnp.dtype(l.dtype)) for l in leaves]
+    order: Dict[str, List[int]] = {}
+    for i, (_, dt) in enumerate(specs):
+        order.setdefault(str(dt), []).append(i)
+
+    def pack(tree):
+        lv = jax.tree_util.tree_leaves(tree)
+        return {
+            dt: jnp.concatenate([jnp.ravel(lv[i]) for i in idxs])
+            for dt, idxs in order.items()
+        }
+
+    def unpack(packed):
+        out: List[Any] = [None] * len(specs)
+        for dt, idxs in order.items():
+            flat = np.asarray(packed[dt])
+            off = 0
+            for i in idxs:
+                shape, _ = specs[i]
+                size = int(np.prod(shape)) if shape else 1
+                out[i] = flat[off:off + size].reshape(shape)
+                off += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return pack, unpack
+
+
+def make_synced_collection_step(collection: Any, axis_name: str = "batch", pack_values: bool = True):
+    """Fused ``(states, *batch) -> (states', packed_values)`` synced step.
+
+    Meant to be wrapped in the caller's ``shard_map``/``jit`` over a mesh
+    binding ``axis_name``. One computation runs every compute group's update,
+    folds the whole collection's sync collectives into one ``psum`` per
+    (reduction, dtype) (via ``MetricCollection.functional_sync``'s cross-group
+    leaf fusion), computes every metric from the synced state, and packs the
+    computed leaves per dtype. Returns ``(step, unpack)`` where ``unpack``
+    (host-side) restores the values dict from the packed output; it is built
+    lazily on the first call's structure when ``pack_values`` is True.
+    """
+    box: Dict[str, Any] = {}
+
+    def step(states, *args, **kwargs):
+        st = collection.functional_update(states, *args, **kwargs)
+        synced = collection.functional_sync(st, axis_name)
+        values = collection.functional_compute(synced)
+        if pack_values:
+            if "pack" not in box:
+                box["pack"], box["unpack"] = make_value_packer(values)
+            values = box["pack"](values)
+        return st, values
+
+    def unpack(packed):
+        if not pack_values:
+            return packed
+        return box["unpack"](packed)
+
+    return step, unpack
+
+
+def executor_stats(obj: Any) -> Dict[str, Any]:
+    """Executor instrumentation for a ``Metric`` or ``MetricCollection``.
+
+    Returns zeroed stats when the executor has not engaged yet (or is
+    disabled); see the keys in this module's ``_new_stats``.
+    """
+    ex = getattr(obj, "_executor_obj", None)
+    if ex is None:
+        out = _new_stats()
+        out["disabled_reason"] = None
+        out["bucketing_enabled"] = True
+        out["cached_executables"] = 0
+        return out
+    return ex.stats_dict()

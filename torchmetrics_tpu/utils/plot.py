@@ -200,7 +200,13 @@ def plot_curve(
             if score is not None and np.asarray(score).ndim:
                 lbl += f" (score={float(np.asarray(score)[c]):.3f})"
         else:
-            lbl = f"score={float(np.asarray(score)):.3f}" if score is not None else None
+            if score is not None:
+                s = np.asarray(score)
+                # a per-class score array can ride along with a 1-D (e.g.
+                # micro-averaged) curve: label with its mean instead of raising
+                lbl = f"score={float(s) if s.size == 1 else float(s.mean()):.3f}"
+            else:
+                lbl = None
         ax.plot(xc, yc, label=lbl)
     if per_class or (polylines and score is not None):
         ax.legend()

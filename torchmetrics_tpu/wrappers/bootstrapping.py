@@ -47,6 +47,9 @@ class BootStrapper(WrapperMetric):
     """
 
     full_state_update: Optional[bool] = True
+    # eager updates draw fresh host-side RandomState resamples per call; a
+    # traced executor replay would freeze one sample pattern forever
+    executor_compatible: bool = False
 
     def __init__(
         self,

@@ -82,7 +82,11 @@ class MinMaxMetric(WrapperMetric):
         count), so ``state()``/``merge_states``/``functional_compute``/
         ``load_state`` interoperate across the dual API."""
         return {
-            "base": self._base_metric.state(),
+            # field-only export (no reserved "_update_count" key): the nested
+            # base state must stay tree-compatible with functional_init's
+            # layout and with merge_states outputs; the wrapper carries the
+            # authoritative count itself
+            "base": self._base_metric._copy_state_dict(),
             "min_val": self.min_val,
             "max_val": self.max_val,
             "count": jnp.asarray(self._update_count, jnp.int32),
