@@ -1,0 +1,648 @@
+"""Failure-containment acceptance battery (ISSUE 2).
+
+For every injected fault — NaN batch under a strict policy, a raise mid-update
+(after state mutation), a dispatch failure after donation, a hung/broken
+multi-host sync, a corrupted restore pytree — the metric's observable state
+after the failure must equal its state before the failing call, on both the
+eager and executor paths. Plus the satellites: resume-mid-epoch under the
+executor (both cross-path directions), the ``functional_sync`` reserved-count
+regression, and the recorded executor fallback reasons.
+"""
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric
+from torchmetrics_tpu.aggregation import MaxMetric
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops.executor import executor_stats
+from torchmetrics_tpu.testing import faults
+from torchmetrics_tpu.utils.exceptions import (
+    StateCorruptionError,
+    SyncTimeoutError,
+    TorchMetricsUserWarning,
+)
+
+NUM_CLASSES = 5
+
+
+def _mc_batch(n, seed):
+    r = np.random.RandomState(seed)
+    return (
+        jnp.asarray(r.randn(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(r.randint(0, NUM_CLASSES, n)),
+    )
+
+
+def _observable(metric):
+    """Host copy of everything the containment contract covers. Forced
+    ``np.array`` copies: on CPU a zero-copy device view would be silently
+    rewritten by an in-place donating dispatch — the very hazard under test."""
+    return (
+        {
+            k: ([np.array(x) for x in v] if isinstance(v, list) else np.array(v))
+            for k, v in ((kk, metric._state[kk]) for kk in metric._defaults)
+        },
+        metric.update_count,
+    )
+
+
+def _assert_observable_equal(before, after):
+    state_b, count_b = before
+    state_a, count_a = after
+    assert count_b == count_a, f"update_count changed across a failed call: {count_b} -> {count_a}"
+    assert set(state_b) == set(state_a)
+    for k in state_b:
+        b, a = state_b[k], state_a[k]
+        if isinstance(b, list):
+            assert len(b) == len(a)
+            for x, y in zip(b, a):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(a), err_msg=f"state field {k!r}")
+
+
+class _TwoPhase(Metric):
+    """Two states mutated sequentially — the canonical half-applied hazard."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("first", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("second", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.first = self.first + x.sum()
+        self.second = self.second + (x * 2).sum()
+
+    def compute(self):
+        return self.first + self.second
+
+
+# ---------------------------------------------------------------------------
+# transactional update / forward (eager + executor)
+# ---------------------------------------------------------------------------
+
+
+class TestTransactionalUpdate:
+    @pytest.mark.parametrize("use_executor", [True, False], ids=["executor", "eager"])
+    @pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric])
+    @pytest.mark.parametrize("call", ["update", "forward"])
+    def test_nan_batch_strict_policy_rolls_back(self, cls, call, use_executor):
+        """nan_strategy='error' raising on a poisoned batch must leave the
+        accumulated state exactly as it was — the epoch survives the batch
+        (with the executor flag in both positions; 'error' instances
+        self-declare untraceable, so both land on the contained eager body)."""
+        m = cls(nan_strategy="error", executor=use_executor)
+        m.update(jnp.asarray([1.0, 2.0, 3.0]))
+        expected = float(m.compute())
+        before = _observable(m)
+        (bad,) = faults.poison_batch(jnp.asarray([4.0, 5.0]), frac=0.5, seed=3)
+        with pytest.raises(RuntimeError, match="nan"):
+            getattr(m, call)(bad)
+        _assert_observable_equal(before, _observable(m))
+        m._computed = None
+        assert float(m.compute()) == expected
+        m.update(jnp.asarray([4.0]))  # still usable after the contained failure
+
+    @pytest.mark.parametrize("use_executor", [True, False], ids=["executor", "eager"])
+    def test_mid_update_raise_after_mutation(self, use_executor):
+        """An exception raised AFTER the update body mutated state (the
+        half-applied transition) rolls everything back, on both paths."""
+        m = _TwoPhase(executor=use_executor)
+        m.update(jnp.asarray([1.0, 2.0]))
+        before = _observable(m)
+        with faults.raise_in_update(m, after_mutation=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(jnp.asarray([10.0]))
+        _assert_observable_equal(before, _observable(m))
+        # the metric keeps working once the fault clears
+        m.update(jnp.asarray([3.0]))
+        ctrl = _TwoPhase(executor=False)
+        ctrl.update(jnp.asarray([1.0, 2.0]))
+        ctrl.update(jnp.asarray([3.0]))
+        np.testing.assert_allclose(float(m.compute()), float(ctrl.compute()), rtol=1e-6)
+
+    def test_mid_update_raise_records_fallback_reason(self):
+        """With the executor on, a body that cannot trace (it raises) gets the
+        sticky eager fallback WITH the reason recorded and surfaced."""
+        m = _TwoPhase(executor=True)
+        with faults.raise_in_update(m, after_mutation=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(jnp.asarray([1.0]))
+        status = m.executor_status
+        assert status["enabled"] is True
+        assert status["fallback_reason"] is not None
+        assert "FaultInjected" in status["fallback_reason"]
+
+    @pytest.mark.parametrize("use_executor", [True, False], ids=["executor", "eager"])
+    def test_compute_raise_leaves_state_intact(self, use_executor):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=use_executor)
+        m.update(*_mc_batch(16, 0))
+        before = _observable(m)
+        with faults.raise_in_compute(m):
+            with pytest.raises(faults.FaultInjected):
+                m.compute()
+        _assert_observable_equal(before, _observable(m))
+        assert 0.0 <= float(m.compute()) <= 1.0
+
+
+class TestForwardContainment:
+    def test_full_state_forward_failure_keeps_cached_global_state(self):
+        """THE regression this PR exists for: _forward_full_state_update used
+        to lose the accumulated global state when the batch-value compute
+        raised after the mid-call reset."""
+        m = MaxMetric(nan_strategy="ignore", executor=False)  # full_state_update=True
+        m.update(jnp.asarray([5.0, 1.0]))
+        before = _observable(m)
+        with faults.raise_in_compute(m):
+            with pytest.raises(faults.FaultInjected):
+                m.forward(jnp.asarray([3.0]))
+        _assert_observable_equal(before, _observable(m))
+        # and the metric still folds correctly afterwards
+        m.forward(jnp.asarray([7.0]))
+        assert float(m.compute()) == 7.0
+
+    def test_full_state_forward_failure_in_second_update(self):
+        m = MaxMetric(nan_strategy="error", executor=False)
+        m.update(jnp.asarray([5.0, 1.0]))
+        before = _observable(m)
+        (bad,) = faults.poison_batch(jnp.asarray([2.0, 3.0]), frac=0.5, seed=7)
+        with pytest.raises(RuntimeError, match="nan"):
+            m.forward(bad)
+        _assert_observable_equal(before, _observable(m))
+
+    @pytest.mark.parametrize("use_executor", [True, False], ids=["executor", "eager"])
+    def test_reduce_forward_failure_restores_global_state(self, use_executor):
+        m = BinaryAccuracy(validate_args=False, executor=use_executor)
+        r = np.random.RandomState(0)
+        m.update(jnp.asarray(r.rand(8).astype(np.float32)), jnp.asarray(r.randint(0, 2, 8)))
+        before = _observable(m)
+        with faults.raise_in_compute(m):
+            with pytest.raises(faults.FaultInjected):
+                m.forward(jnp.asarray(r.rand(4).astype(np.float32)), jnp.asarray(r.randint(0, 2, 4)))
+        _assert_observable_equal(before, _observable(m))
+
+    def test_collection_grouped_forward_failure_restores_leader(self):
+        coll = MetricCollection(
+            [MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+             MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False)],
+            executor=False,
+        )
+        coll.update(*_mc_batch(16, 0))  # resolves the shared stat-scores group
+        assert any(len(g) > 1 for g in coll.compute_groups.values())
+        leader = coll._modules[next(iter(coll.compute_groups.values()))[0]]
+        before = _observable(leader)
+        with faults.raise_in_compute(leader):
+            with pytest.raises(faults.FaultInjected):
+                coll.forward(*_mc_batch(8, 1))
+        _assert_observable_equal(before, _observable(leader))
+
+
+# ---------------------------------------------------------------------------
+# executor dispatch failure after donation
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchContainment:
+    def _warm(self, m, batches=3):
+        for i in range(batches):
+            m.update(*_mc_batch(32, i))
+        stats = executor_stats(m)
+        assert stats["donated_calls"] >= 1, f"executor never donated: {stats}"
+        return m
+
+    def test_update_dispatch_failure_restores_donated_state(self):
+        """A warm executable failing at dispatch — donated buffers consumed —
+        restores the pre-call state from the host-side recovery reference,
+        propagates the error, and does NOT disable the executor."""
+        m = self._warm(MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True))
+        before = _observable(m)
+        with faults.fail_dispatch(consume=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(*_mc_batch(32, 50))
+        _assert_observable_equal(before, _observable(m))
+        stats = executor_stats(m)
+        assert stats["dispatch_failures"] == 1
+        assert stats["recovery_restores"] == 1
+        assert stats["disabled_reason"] is None, "a transient dispatch failure must not disable the executor"
+        # the compiled path keeps working after the fault clears
+        m.update(*_mc_batch(32, 51))
+        assert executor_stats(m)["calls"] > stats["calls"]
+
+    def test_forward_dispatch_failure_restores_donated_state(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True)
+        for i in range(3):
+            m.forward(*_mc_batch(32, i))
+        assert executor_stats(m)["donated_calls"] >= 1
+        before = _observable(m)
+        with faults.fail_dispatch(consume=True):
+            with pytest.raises(faults.FaultInjected):
+                m.forward(*_mc_batch(32, 60))
+        _assert_observable_equal(before, _observable(m))
+        assert executor_stats(m)["disabled_reason"] is None
+
+    def test_collection_fused_dispatch_failure_restores_all_groups(self):
+        coll = MetricCollection(
+            [MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+             MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False)],
+            executor=True,
+        )
+        for i in range(3):
+            coll.update(*_mc_batch(32, i))
+        assert executor_stats(coll)["donated_calls"] >= 1
+        befores = {name: _observable(m) for name, m in coll._modules.items()}
+        with faults.fail_dispatch(consume=True):
+            with pytest.raises(faults.FaultInjected):
+                coll.update(*_mc_batch(32, 70))
+        for name, m in coll._modules.items():
+            _assert_observable_equal(befores[name], _observable(m))
+        assert executor_stats(coll)["disabled_reason"] is None
+        coll.update(*_mc_batch(32, 71))  # fused path still alive
+
+    def test_dispatch_failure_matches_eager_control_after_recovery(self):
+        """End to end: fail one dispatch mid-stream, keep going — the final
+        value must equal an eager control that never saw the fault."""
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True)
+        ctrl = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        for i in range(3):
+            b = _mc_batch(32, i)
+            m.update(*b)
+            ctrl.update(*b)
+        with faults.fail_dispatch(consume=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(*_mc_batch(32, 99))
+        for i in range(3, 6):
+            b = _mc_batch(32, i)
+            m.update(*b)
+            ctrl.update(*b)
+        np.testing.assert_allclose(float(m.compute()), float(ctrl.compute()), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bounded multi-host sync
+# ---------------------------------------------------------------------------
+
+
+def _dist_metric(**kwargs):
+    """A SumMetric that believes it runs multi-host, so compute() takes the
+    process_allgather path (which the fault harness can hang/break)."""
+    return SumMetric(nan_strategy="ignore", executor=False, distributed_available_fn=lambda: True, **kwargs)
+
+
+class TestBoundedSync:
+    def test_sync_timeout_raises_with_state_intact(self):
+        m = _dist_metric(sync_timeout=0.2, on_sync_failure="raise")
+        m.update(jnp.asarray([1.0, 2.0]))
+        before = _observable(m)
+        with faults.hang_sync(seconds=5.0):
+            with pytest.raises(SyncTimeoutError):
+                m.compute()
+        _assert_observable_equal(before, _observable(m))
+        assert m._is_synced is False and m._cache is None  # no half-synced residue
+        assert float(m.compute()) == 3.0  # sane once the collective heals
+
+    def test_sync_timeout_degrades_to_local(self):
+        m = _dist_metric(sync_timeout=0.2, on_sync_failure="local")
+        m.update(jnp.asarray([1.0, 2.0]))
+        with faults.hang_sync(seconds=5.0):
+            with pytest.warns(TorchMetricsUserWarning, match="local-only"):
+                value = m.compute()
+        assert float(value) == 3.0  # local data still served
+        assert m.last_sync_ok is False
+        # a later healthy sync clears the flag
+        m._computed = None
+        assert float(m.compute()) == 3.0
+        assert m.last_sync_ok is True
+
+    def test_broken_sync_degrades_to_local(self):
+        m = _dist_metric(on_sync_failure="local")
+        m.update(jnp.asarray([4.0]))
+        with faults.break_sync():
+            with pytest.warns(TorchMetricsUserWarning, match="local-only"):
+                assert float(m.compute()) == 4.0
+        assert m.last_sync_ok is False
+
+    def test_broken_sync_raise_policy_propagates(self):
+        m = _dist_metric(on_sync_failure="raise")
+        m.update(jnp.asarray([4.0]))
+        before = _observable(m)
+        with faults.break_sync():
+            with pytest.raises(faults.FaultInjected):
+                m.compute()
+        _assert_observable_equal(before, _observable(m))
+
+    def test_sync_timeout_kwarg_validation(self):
+        with pytest.raises(ValueError, match="sync_timeout"):
+            SumMetric(nan_strategy="ignore", sync_timeout=-1)
+        with pytest.raises(ValueError, match="on_sync_failure"):
+            SumMetric(nan_strategy="ignore", on_sync_failure="retry")
+
+
+# ---------------------------------------------------------------------------
+# validated restore
+# ---------------------------------------------------------------------------
+
+
+class TestValidatedRestore:
+    def _src(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        m.update(*_mc_batch(16, 0))
+        return m
+
+    @pytest.mark.parametrize("mode", ["shape", "dtype", "structure"])
+    def test_strict_rejects_corruption_target_untouched(self, mode):
+        src = self._src()
+        dst = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        dst.update(*_mc_batch(8, 1))
+        before = _observable(dst)
+        bad = faults.corrupt_state(src.state(), mode=mode)
+        with pytest.raises(StateCorruptionError):
+            dst.load_state(bad, validate="strict")
+        _assert_observable_equal(before, _observable(dst))
+
+    def test_check_finite_rejects_nan_state(self):
+        src = MeanMetric(nan_strategy="ignore", executor=False)
+        src.update(jnp.asarray([1.0, 2.0]))
+        bad = faults.corrupt_state(src.state(), mode="nan")
+        dst = MeanMetric(nan_strategy="ignore", executor=False)
+        with pytest.raises(StateCorruptionError, match="non-finite"):
+            dst.load_state(bad, check_finite=True)
+        # without the finite check the same pytree installs (shapes/dtypes ok)
+        dst.load_state(bad)
+
+    def test_strict_is_default_and_structural(self):
+        src = self._src()
+        dst = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        with pytest.raises(StateCorruptionError):
+            dst.load_state(faults.corrupt_state(src.state(), mode="structure"))
+        # StateCorruptionError is still a KeyError for legacy callers
+        with pytest.raises(KeyError):
+            dst.load_state(faults.corrupt_state(src.state(), mode="structure"))
+
+    def test_validate_off_installs_identically_zero_dispatch(self):
+        """validate='off' must add zero device dispatches: the exported arrays
+        are installed as-is (same objects), nothing new is created."""
+        src = self._src()
+        st = src.state()
+        dst = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        dst.load_state(st, validate="off")
+        for k in src._defaults:
+            assert dst._state[k] is st[k]
+        assert float(dst.compute()) == float(src.compute())
+
+    def test_strict_happy_path_installs_identically(self):
+        """strict validation is metadata-only: the round-trip still installs
+        the exact same array objects (no casts, no dispatches)."""
+        src = self._src()
+        st = src.state()
+        dst = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        dst.load_state(st, validate="strict")
+        for k in src._defaults:
+            assert dst._state[k] is st[k]
+
+    def test_cast_mode_converts_dtype(self):
+        src = self._src()
+        st = src.state()
+        field = next(iter(src._defaults))
+        drifted = dict(st)
+        drifted[field] = jnp.asarray(st[field]).astype(jnp.float32)
+        dst = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        with pytest.raises(StateCorruptionError, match="dtype"):
+            dst.load_state(drifted, validate="strict")
+        dst.load_state(drifted, validate="cast")
+        assert str(jnp.asarray(dst._state[field]).dtype) == str(jnp.asarray(st[field]).dtype)
+        assert float(dst.compute()) == float(src.compute())
+
+    def test_state_spec_shape_and_serialisable(self):
+        import json
+
+        m = self._src()
+        spec = m.state_spec()
+        assert spec["spec_version"] == 1 and spec["class"] == "MulticlassAccuracy"
+        for fs in spec["fields"].values():
+            assert fs["kind"] == "array" and fs["reduction"] == "sum" and fs["shape_invariant"]
+        json.dumps(spec)  # persistable next to the checkpoint
+
+    def test_collection_load_state_validates(self):
+        coll = MetricCollection(
+            [MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)], executor=False
+        )
+        coll.update(*_mc_batch(16, 0))
+        states = coll.state()
+        leader = next(iter(states))
+        bad = dict(states)
+        bad[leader] = faults.corrupt_state(states[leader], mode="dtype")
+        coll2 = MetricCollection(
+            [MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)], executor=False
+        )
+        with pytest.raises(StateCorruptionError):
+            coll2.load_state(bad)
+        coll2.load_state(bad, validate="cast")
+        assert coll2.state_spec().keys() == states.keys()
+
+
+# ---------------------------------------------------------------------------
+# resume mid-epoch under the executor (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestResumeUnderExecutor:
+    @pytest.mark.parametrize(
+        "src_executor,dst_executor",
+        [(True, True), (False, True), (True, False)],
+        ids=["executor-to-executor", "eager-to-executor", "executor-to-eager"],
+    )
+    def test_forward_resume_matches_uninterrupted(self, src_executor, dst_executor):
+        """state() -> load_state() -> continued forward under the executor is
+        indistinguishable from never suspending — including states produced by
+        the other path (satellite: only the eager path was covered)."""
+        straight = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=dst_executor)
+        suspended = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=src_executor)
+        batches = [_mc_batch(32, i) for i in range(6)]
+        for b in batches[:3]:
+            np.testing.assert_allclose(
+                np.asarray(straight.forward(*b)), np.asarray(suspended.forward(*b)), rtol=1e-5
+            )
+        resumed = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=dst_executor)
+        resumed.load_state(suspended.state())
+        assert resumed.update_count == suspended.update_count
+        for b in batches[3:]:
+            np.testing.assert_allclose(
+                np.asarray(straight.forward(*b)), np.asarray(resumed.forward(*b)), rtol=1e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(straight.compute()), np.asarray(resumed.compute()), rtol=1e-6
+        )
+
+    def test_update_resume_under_executor_with_donation(self):
+        """The restored state must survive the executor's donation machinery:
+        after load_state the first compiled call copies (the arrays are
+        externally aliased), then donation streaks resume."""
+        straight = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True)
+        part = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True)
+        batches = [_mc_batch(32, 10 + i) for i in range(6)]
+        for b in batches[:3]:
+            straight.update(*b)
+            part.update(*b)
+        st = part.state()
+        resumed = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=True)
+        resumed.load_state(st)
+        for b in batches[3:]:
+            straight.update(*b)
+            resumed.update(*b)
+        np.testing.assert_allclose(float(straight.compute()), float(resumed.compute()), rtol=1e-6)
+        # the checkpointed pytree is still intact (not consumed by donation)
+        for k, v in st.items():
+            np.asarray(v)  # a donated-away buffer would raise on access
+
+
+# ---------------------------------------------------------------------------
+# functional_sync reserved count key (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def _smap():
+    try:
+        from jax.experimental.shard_map import shard_map
+
+        return partial(shard_map, check_rep=False)
+    except ImportError:  # newer jax spells it jax.shard_map / check_vma
+        return partial(jax.shard_map, check_vma=False)
+
+
+class TestFunctionalSyncCountKey:
+    def test_state_export_syncs_with_summed_count(self, mesh):
+        """functional_sync on a state() export (which carries the reserved
+        '_update_count' int leaf) must strip the count from the collectives
+        and re-attach it summed across ranks — it used to be all-gathered
+        into a stacked per-rank array (or crash under jit)."""
+        from jax.sharding import PartitionSpec as P
+
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        for i in range(3):
+            m.update(*_mc_batch(16, i))
+        st = jax.tree_util.tree_map(jnp.asarray, m.state())
+        assert "_update_count" in st
+
+        fn = _smap()(
+            lambda s: m.functional_sync(s, "batch"),
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+        )
+        synced = jax.jit(fn)(st)
+        world = mesh.devices.size
+        assert int(synced["_update_count"]) == 3 * world
+        assert np.asarray(synced["_update_count"]).ndim == 0  # scalar, not stacked
+        for k in m._defaults:
+            np.testing.assert_allclose(
+                np.asarray(synced[k]), world * np.asarray(st[k]), rtol=1e-6
+            )
+
+    def test_collection_functional_sync_strips_count(self, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        coll = MetricCollection(
+            [MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+             MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False)],
+            executor=False,
+        )
+        for i in range(2):
+            coll.update(*_mc_batch(16, i))
+        states = jax.tree_util.tree_map(jnp.asarray, coll.state())
+        leader = next(iter(states))
+        assert "_update_count" in states[leader]
+
+        fn = _smap()(
+            lambda s: coll.functional_sync(s, "batch"),
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+        )
+        synced = jax.jit(fn)(states)
+        world = mesh.devices.size
+        assert int(synced[leader]["_update_count"]) == 2 * world
+        for k, v in states[leader].items():
+            if k == "_update_count":
+                continue
+            np.testing.assert_allclose(np.asarray(synced[leader][k]), world * np.asarray(v), rtol=1e-6)
+
+    def test_eager_roundtrip_after_synced_state_load(self, mesh):
+        """The synced export (summed count included) loads back into a fresh
+        metric with the count reflecting the world-wide update total."""
+        from jax.sharding import PartitionSpec as P
+
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        m.update(*_mc_batch(16, 0))
+        st = jax.tree_util.tree_map(jnp.asarray, m.state())
+        fn = _smap()(lambda s: m.functional_sync(s, "batch"), mesh=mesh, in_specs=(P(),), out_specs=P())
+        synced = jax.jit(fn)(st)
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        m2.load_state(synced)
+        assert m2.update_count == mesh.devices.size
+
+
+# ---------------------------------------------------------------------------
+# executor fallback diagnosis (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorStatus:
+    def test_static_ineligibility_is_surfaced(self):
+        from torchmetrics_tpu import CatMetric
+
+        m = CatMetric(nan_strategy="ignore")  # list state -> statically ineligible
+        m.update(jnp.asarray([1.0, 2.0]))
+        status = m.executor_status
+        assert status["enabled"] is True and status["engaged"] is False
+        assert "list states" in status["fallback_reason"]
+
+    def test_disabled_instance_reports_clean(self):
+        m = SumMetric(nan_strategy="ignore", executor=False)
+        m.update(jnp.asarray([1.0]))
+        status = m.executor_status
+        assert status["enabled"] is False
+        assert status["fallback_reason"] is None
+
+    def test_sticky_trace_fallback_logs_once_at_debug(self, caplog):
+        class Untraceable(Metric):
+            full_state_update = False
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                if float(x.sum()) > -1e30:  # host branch on traced value
+                    self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        m = Untraceable(executor=True)
+        with caplog.at_level(logging.DEBUG, logger="torchmetrics_tpu"):
+            m.update(jnp.asarray([1.0]))
+            m.update(jnp.asarray([2.0]))
+        assert float(m.compute()) == 3.0
+        msgs = [r.message for r in caplog.records if "executor disabled" in r.message]
+        assert len(msgs) == 1, msgs  # once, not per call
+        assert "Untraceable" in msgs[0]
+        assert m.executor_status["fallback_reason"] is not None
+
+    def test_collection_status_includes_members(self):
+        coll = MetricCollection([SumMetric(nan_strategy="ignore")], executor=False)
+        status = coll.executor_status
+        assert status["enabled"] is False
+        assert "SumMetric" in status["members"]

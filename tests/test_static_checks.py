@@ -1,0 +1,35 @@
+"""Static hygiene gates (ISSUE 2 satellite): no silent broad exception
+handlers may enter torchmetrics_tpu/ — every ``except Exception`` either
+re-raises or records a reason (tools/lint_exceptions.py)."""
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_linter():
+    path = REPO / "tools" / "lint_exceptions.py"
+    spec = importlib.util.spec_from_file_location("lint_exceptions", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("lint_exceptions", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_silent_broad_excepts():
+    linter = _load_linter()
+    violations, stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    msg = "\n".join(f"{v.path}:{v.line}: {v.snippet}" for v in violations)
+    assert not violations, f"silent broad except handlers (re-raise or record a reason):\n{msg}"
+    assert not stale, f"stale lint allowlist entries (handlers gone — remove them): {stale}"
+
+
+def test_allowlist_is_exercised():
+    """The allowlist stays honest: each entry still names a real silent
+    handler, so an obsolete entry cannot quietly shield future code."""
+    linter = _load_linter()
+    pkg = REPO / "torchmetrics_tpu"
+    for rel, why in linter.ALLOWLIST.items():
+        found = linter.lint_file(pkg / rel, rel)
+        assert found, f"allowlist entry {rel!r} ({why}) matches no handler — remove it"

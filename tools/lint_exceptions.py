@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Static pass: no silent broad exception handlers in torchmetrics_tpu/.
+
+The failure-containment work (ISSUE 2) turned every ``except Exception`` in
+the executor into either a re-raise or a *recorded* fallback reason; this
+lint keeps it that way. A broad handler (``except:``, ``except Exception``,
+``except BaseException``, or a tuple containing one of those) must do at
+least one of:
+
+- re-raise (any ``raise`` statement anywhere in the handler body), or
+- record a reason: call one of the recognised recorders
+  (``self._disable(...)``, ``rank_zero_warn/info/debug``, a ``log.*`` /
+  ``warnings.warn`` call) or assign to a reason attribute
+  (``disabled_reason`` / ``fallback_reason`` / ``_last_sync_ok``).
+
+A small allowlist covers the legitimate guard sites whose silence is the
+point (optional-dependency import guards and the pre-init backend probe).
+Run directly (``python tools/lint_exceptions.py``) for a report, or through
+``tests/test_static_checks.py`` where it gates the suite.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+#: files whose broad-but-silent handlers are deliberate; keys are paths
+#: relative to the package root, values say why (shown when the entry goes
+#: stale so the next person knows what it used to cover)
+ALLOWLIST = {
+    "utils/plot.py": "optional matplotlib import guard",
+    "utils/prints.py": "jax backend probe before distributed init (treat as rank 0)",
+}
+
+#: a call to any of these counts as recording the reason
+RECORDER_NAMES = {
+    "_disable",
+    "rank_zero_warn",
+    "rank_zero_info",
+    "rank_zero_debug",
+    "warn",
+    "warning",
+    "info",
+    "debug",
+    "error",
+    "exception",
+}
+
+#: an assignment to any of these counts as recording the reason
+REASON_ATTRS = {"disabled_reason", "fallback_reason", "_last_sync_ok"}
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    snippet: str
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    node = handler.type
+    if node is None:  # bare except
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _BROAD_NAMES
+    if isinstance(node, ast.Tuple):
+        return any(
+            (isinstance(el, ast.Name) and el.id in _BROAD_NAMES)
+            or (isinstance(el, ast.Attribute) and el.attr in _BROAD_NAMES)
+            for el in node.elts
+        )
+    return False
+
+
+def _records_reason(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+            if name in RECORDER_NAMES:
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                name = tgt.id if isinstance(tgt, ast.Name) else tgt.attr if isinstance(tgt, ast.Attribute) else None
+                if name in REASON_ATTRS:
+                    return True
+                # self.__dict__["_last_sync_ok"] = ... style
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and tgt.slice.value in REASON_ATTRS
+                ):
+                    return True
+    return False
+
+
+def lint_file(path: Path, rel: str) -> List[Violation]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [Violation(rel, err.lineno or 0, f"syntax error: {err.msg}")]
+    lines = source.splitlines()
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) and not _records_reason(node):
+            snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+            out.append(Violation(rel, node.lineno, snippet))
+    return out
+
+
+def collect_violations(pkg_root: Path):
+    """(violations, stale_allowlist): broad-silent handlers outside the
+    allowlist, and allowlist entries that no longer match any handler."""
+    violations: List[Violation] = []
+    used = set()
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = path.relative_to(pkg_root).as_posix()
+        found = lint_file(path, rel)
+        if not found:
+            continue
+        if rel in ALLOWLIST:
+            used.add(rel)
+            continue
+        violations.extend(found)
+    stale = sorted(set(ALLOWLIST) - used)
+    return violations, stale
+
+
+def main() -> int:
+    pkg_root = Path(__file__).resolve().parent.parent / "torchmetrics_tpu"
+    violations, stale = collect_violations(pkg_root)
+    for v in violations:
+        print(f"{v.path}:{v.line}: silent broad except (re-raise or record a reason): {v.snippet}")
+    for rel in stale:
+        print(f"allowlist entry {rel!r} ({ALLOWLIST[rel]}) matches no handler anymore — remove it")
+    if violations or stale:
+        return 1
+    print(f"lint_exceptions: clean ({pkg_root})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

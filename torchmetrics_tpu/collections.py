@@ -348,14 +348,26 @@ class MetricCollection:
                 if len(cg) > 1 and all(
                     m.full_state_update is False and not m.dist_sync_on_step for _, m in members
                 ):
-                    batch_state = m0.functional_update(m0.functional_init(), *args, **m0._filter_kwargs(**kwargs))
+                    # transactional like Metric._forward_reduce_state_update: a
+                    # raise from the batch update, merge, or any member's
+                    # compute restores the leader's pre-call state and count
                     global_state = m0._copy_state_dict()
-                    m0._state = {k: (list(v) if isinstance(v, list) else v) for k, v in batch_state.items()}
-                    m0._update_count += 1
-                    m0._reduce_states(global_state)
-                    m0._computed = None
-                    for name, m in members:
-                        res[name] = m.functional_compute(batch_state)
+                    pre_count, pre_computed = m0._update_count, m0._computed
+                    try:
+                        batch_state = m0.functional_update(m0.functional_init(), *args, **m0._filter_kwargs(**kwargs))
+                        m0._state = {k: (list(v) if isinstance(v, list) else v) for k, v in batch_state.items()}
+                        m0._update_count += 1
+                        m0._reduce_states(global_state)
+                        m0._computed = None
+                        for name, m in members:
+                            res[name] = m.functional_compute(batch_state)
+                    except BaseException:
+                        m0._rollback(
+                            {k: (list(v) if isinstance(v, list) else v) for k, v in global_state.items()},
+                            pre_count,
+                            pre_computed,
+                        )
+                        raise
                 else:
                     for name, m in members:
                         res[name] = m(*args, **m._filter_kwargs(**kwargs))
@@ -462,7 +474,14 @@ class MetricCollection:
         rendezvous per step rather than one per group (``sync_states`` already
         fuses within a metric; this lifts the fusion to the collection level).
         Leaders with a custom ``dist_sync_fn`` keep their own path.
+
+        Like :meth:`Metric.functional_sync`, the reserved ``"_update_count"``
+        key carried by :meth:`state` exports is stripped from the collectives
+        and re-attached summed across ranks.
         """
+        import jax
+
+        count_key = Metric._STATE_COUNT_KEY
         out: Dict[str, Dict[str, Any]] = {}
         # leaders fusable together must resolve to the same mesh axis
         by_axis: Dict[Any, List[str]] = {}
@@ -479,15 +498,25 @@ class MetricCollection:
             by_axis.setdefault(key, []).append(leader)
         for axis_key, leaders in by_axis.items():
             axis = list(axis_key) if isinstance(axis_key, tuple) else axis_key
-            flat = {f"{leader}\x00{field}": v for leader in leaders for field, v in states[leader].items()}
+            flat = {
+                f"{leader}\x00{field}": v
+                for leader in leaders
+                for field, v in states[leader].items()
+                if field != count_key
+            }
             reds = {
                 f"{leader}\x00{field}": self._modules[leader]._reductions.get(field)
                 for leader in leaders
                 for field in states[leader]
+                if field != count_key
             }
             synced = sync_states(flat, reds, axis)
             for leader in leaders:
-                out[leader] = {field: synced[f"{leader}\x00{field}"] for field in states[leader]}
+                out[leader] = {
+                    field: synced[f"{leader}\x00{field}"] for field in states[leader] if field != count_key
+                }
+                if count_key in states[leader]:
+                    out[leader][count_key] = jax.lax.psum(jnp.asarray(states[leader][count_key]), axis)
         return out
 
     def functional_compute(self, states: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
@@ -505,7 +534,35 @@ class MetricCollection:
         (followers share the leader's state, reference collections.py:289-308)."""
         return {cg[0]: self._modules[cg[0]].state() for cg in self._groups.values()}
 
-    def load_state(self, states: Dict[str, Dict[str, Any]], update_count: Optional[int] = None) -> None:
+    def state_spec(self) -> Dict[str, Any]:
+        """Per-group-leader :meth:`Metric.state_spec`, exported alongside
+        :meth:`state` so checkpointing layers can verify a restore target."""
+        return {cg[0]: self._modules[cg[0]].state_spec() for cg in self._groups.values()}
+
+    @property
+    def executor_status(self) -> Dict[str, Any]:
+        """Fused-executor diagnosis for the collection plus per-member status
+        (see :attr:`Metric.executor_status`)."""
+        from torchmetrics_tpu.ops.executor import executor_enabled_default, executor_stats
+
+        enabled = self._executor_enabled
+        enabled = executor_enabled_default() if enabled is None else enabled
+        stats = executor_stats(self)
+        return {
+            "enabled": enabled,
+            "engaged": stats["calls"] > 0,
+            "fallback_reason": None if enabled is False else stats.get("fallback_reason"),
+            "stats": stats,
+            "members": {name: m.executor_status for name, m in self._modules.items()},
+        }
+
+    def load_state(
+        self,
+        states: Dict[str, Dict[str, Any]],
+        update_count: Optional[int] = None,
+        validate: str = "strict",
+        check_finite: bool = False,
+    ) -> None:
         """Install leader-keyed state pytrees into every member of each group.
 
         The saved keys reflect the SOURCE collection's resolved groups, which
@@ -553,7 +610,13 @@ class MetricCollection:
                         " collection."
                     )
             for name in cg:
-                self._modules[name].load_state(st, update_count=update_count)
+                member = self._modules[name]
+                if type(member).load_state is Metric.load_state:
+                    member.load_state(st, update_count=update_count, validate=validate, check_finite=check_finite)
+                else:
+                    # wrappers override load_state with their own layouts (and
+                    # signatures); they validate structurally themselves
+                    member.load_state(st, update_count=update_count)
 
     def merge_states(
         self,

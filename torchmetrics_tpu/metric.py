@@ -38,6 +38,7 @@ from jax import Array
 
 from torchmetrics_tpu.parallel.sync import (
     Reduction,
+    default_sync_timeout,
     host_sync_value,
     in_named_axis_context,
     sync_states,
@@ -46,7 +47,11 @@ from torchmetrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
 )
-from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.exceptions import (
+    StateCorruptionError,
+    TorchMetricsUserError,
+    TorchMetricsUserWarning,
+)
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -79,6 +84,13 @@ class Metric:
               donated-state jitted executor (ops/executor.py). ``None`` (default)
               follows the ``TORCHMETRICS_TPU_EXECUTOR`` env flag (on unless set
               to ``0``); ``False`` restores the op-by-op eager path exactly.
+            - ``sync_timeout``: bound (seconds) on the multi-host
+              ``process_allgather`` sync path; ``None`` (default) follows the
+              ``TORCHMETRICS_TPU_SYNC_TIMEOUT`` env var (unbounded when unset).
+            - ``on_sync_failure``: what a failed/timed-out host sync does:
+              ``"raise"`` (default) propagates the error with local state
+              intact; ``"local"`` degrades to local-only state with a
+              rank-zero warning, flagged via :attr:`last_sync_ok`.
 
     Example:
         >>> import jax.numpy as jnp
@@ -132,6 +144,15 @@ class Metric:
         self._executor_enabled = kwargs.pop("executor", None)
         if self._executor_enabled is not None and not isinstance(self._executor_enabled, bool):
             raise ValueError(f"Expected keyword argument `executor` to be a `bool` but got {self._executor_enabled}")
+        self.sync_timeout = kwargs.pop("sync_timeout", None)
+        if self.sync_timeout is None:
+            self.sync_timeout = default_sync_timeout()
+        elif not isinstance(self.sync_timeout, (int, float)) or isinstance(self.sync_timeout, bool) or self.sync_timeout <= 0:
+            raise ValueError(f"Expected keyword argument `sync_timeout` to be a positive number of seconds but got {self.sync_timeout}")
+        self.on_sync_failure = kwargs.pop("on_sync_failure", "raise")
+        if self.on_sync_failure not in ("raise", "local"):
+            raise ValueError(f"Expected keyword argument `on_sync_failure` to be 'raise' or 'local' but got {self.on_sync_failure}")
+        self._last_sync_ok = True
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -217,6 +238,30 @@ class Metric:
         return {attr: self._state[attr] for attr in self._defaults}
 
     @property
+    def executor_status(self) -> Dict[str, Any]:
+        """Why (and whether) this instance runs through the donated-state
+        executor — a metric silently running 20× slower on the eager path is
+        diagnosable from here (ISSUE 2 satellite).
+
+        Returns ``{"enabled": bool, "engaged": bool, "fallback_reason":
+        Optional[str], "stats": {...}}``: ``enabled`` reflects the resolved
+        configuration (ctor arg / env flag), ``engaged`` whether any call has
+        actually executed compiled, and ``fallback_reason`` the recorded cause
+        when the executor stepped aside (also logged once at debug level).
+        """
+        from torchmetrics_tpu.ops.executor import executor_enabled_default, executor_stats
+
+        enabled = self.__dict__.get("_executor_enabled")
+        enabled = executor_enabled_default() if enabled is None else enabled
+        stats = executor_stats(self)
+        return {
+            "enabled": enabled,
+            "engaged": stats["calls"] > 0,
+            "fallback_reason": None if enabled is False else stats.get("fallback_reason"),
+            "stats": stats,
+        }
+
+    @property
     def update_called(self) -> bool:
         return self._update_count > 0
 
@@ -270,26 +315,58 @@ class Metric:
             object.__setattr__(self, "_executor_obj", ex)
         return ex
 
+    def _state_snapshot(self) -> Dict[str, Any]:
+        """Shallow pre-call snapshot for transactional rollback: jnp arrays are
+        immutable so references suffice; list states are list-copied. Unlike
+        :meth:`_copy_state_dict` this does NOT mark the state escaped — the
+        snapshot never outlives the call, so donation streaks survive."""
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
+
+    def _rollback(self, state: Dict[str, Any], update_count: int, computed: Any) -> None:
+        """Reinstall a pre-call snapshot after a failed update/forward."""
+        object.__setattr__(self, "_state", state)
+        # the restored arrays may be aliased by whoever observed the failure
+        self.__dict__["_state_escaped"] = True
+        self.__dict__["_update_count"] = update_count
+        self.__dict__["_computed"] = computed
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            # transactional contract (docs/ROBUSTNESS.md): any exception out of
+            # this call leaves (_state, _update_count, _computed) exactly as
+            # they were before it — no half-mutated accumulators
+            pre_count, pre_computed = self._update_count, self._computed
             self._computed = None
             self._update_count += 1
             ex = self._get_executor()
             if ex is not None:
-                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                    if ex.run_update(args, kwargs):
-                        return
+                try:
+                    with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                        if ex.run_update(args, kwargs):
+                            return
+                except BaseException:
+                    # the executor restored _state itself (recovery reference);
+                    # only the wrapper bookkeeping needs unwinding
+                    self._update_count, self._computed = pre_count, pre_computed
+                    raise
+            snapshot = self._state_snapshot()
             try:
                 # per-metric profiler scope (SURVEY §5: the TPU analogue of the
-                # reference's torch._C._log_api_usage_once telemetry)
+                # reference's torch._C._log_api_usage_once telemetry); the body
+                # routes through self._update_fn so the fault-injection harness
+                # (testing/faults.py) can intercept every path uniformly
                 with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                    update(*args, **kwargs)
+                    self._update_fn(*args, **kwargs)
             except TypeError as err:
+                self._rollback(snapshot, pre_count, pre_computed)
                 if "got an unexpected keyword argument" in str(err) or "positional argument" in str(err):
                     raise TypeError(
                         f"Encountered an error while calling `update` of {type(self).__name__}: {err}"
                     ) from err
+                raise
+            except BaseException:
+                self._rollback(snapshot, pre_count, pre_computed)
                 raise
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
@@ -320,7 +397,9 @@ class Metric:
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
-                value = _squeeze_if_scalar(compute(*args, **kwargs))
+                # routed through self._compute_fn (not the closed-over bound
+                # method) so the fault harness can intercept compute too
+                value = _squeeze_if_scalar(self._compute_fn(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
             return value
@@ -350,39 +429,65 @@ class Metric:
         return self._forward_reduce_state_update(*args, **kwargs)
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        """2× update strategy (reference metric.py:314-357)."""
-        self.update(*args, **kwargs)
-        _update_count = self._update_count
-        self._to_sync = self.dist_sync_on_step
-        cache = self._copy_state_dict()
+        """2× update strategy (reference metric.py:314-357), transactional.
+
+        Any exception — first update, the batch-value update, or compute —
+        restores the pre-call accumulated state; previously a raise after the
+        mid-call ``reset`` lost the cached global state for good (ISSUE 2).
+        The snapshot uses :meth:`_copy_state_dict` (marks the state escaped)
+        because the inner ``update`` calls may route through the donating
+        executor, which must not consume the arrays the snapshot references.
+        """
+        pre_state = self._copy_state_dict()
+        pre_count, pre_computed = self._update_count, self._computed
+        try:
+            self.update(*args, **kwargs)
+            _update_count = self._update_count
+            self._to_sync = self.dist_sync_on_step
+            cache = self._copy_state_dict()
+            self._computed = None
+            self.reset()
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+            # restore context
+            self._update_count = _update_count
+            self._state = cache
+        except BaseException:
+            self._rollback(pre_state, pre_count, pre_computed)
+            raise
+        finally:
+            self._to_sync = self.sync_on_compute
+            self._should_unsync = True
         self._computed = None
-        self.reset()
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-        # restore context
-        self._update_count = _update_count
-        self._state = cache
-        self._computed = None
-        self._to_sync = self.sync_on_compute
-        self._should_unsync = True
         return batch_val
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        """1× update + state-merge strategy (reference metric.py:359-397)."""
+        """1× update + state-merge strategy (reference metric.py:359-397),
+        transactional: a raise from the batch update, the batch compute, or
+        the merge restores the pre-call global state and count."""
         global_state = self._copy_state_dict()
         _update_count = self._update_count
+        pre_computed = self._computed
         self.reset()
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
+        try:
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
 
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-
-        self._update_count = _update_count + 1
-        self._reduce_states(global_state)
+            self._update_count = _update_count + 1
+            self._reduce_states(global_state)
+        except BaseException:
+            self._rollback(
+                {k: (list(v) if isinstance(v, list) else v) for k, v in global_state.items()},
+                _update_count,
+                pre_computed,
+            )
+            raise
+        finally:
+            self._to_sync = self.sync_on_compute
+            self._should_unsync = True
         self._computed = None
-        self._to_sync = self.sync_on_compute
-        self._should_unsync = True
         return batch_val
 
     def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
@@ -441,17 +546,53 @@ class Metric:
         distributed_available = distributed_available or self.distributed_available_fn
         if not should_sync or (not in_trace and not distributed_available()):
             return
-        # cache prior to syncing (restored by unsync)
+        # cache prior to syncing (restored by unsync); containment: a failed
+        # sync must leave no half-synced state behind — the synced dict is
+        # built fully before installation, and the cache is cleared on failure
+        # so a later sync/unsync cycle starts clean
         self._cache = self._copy_state_dict()
-
-        dist_sync_fn = dist_sync_fn or self.dist_sync_fn
-        if dist_sync_fn is not None:
-            self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
-        elif in_trace:
-            self._state = sync_states(self._state, self._reductions, axis_name)
-        else:  # multi-host, outside jit
-            self._state = {k: host_sync_value(v, self._reductions.get(k)) for k, v in self._state.items()}
+        try:
+            dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+            if dist_sync_fn is not None:
+                self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
+            elif in_trace:
+                self._state = sync_states(self._state, self._reductions, axis_name)
+            else:  # multi-host, outside jit: bounded with a degradation policy
+                self._host_sync_bounded()
+        except BaseException:
+            self._cache = None
+            raise
         self._is_synced = True
+
+    def _host_sync_bounded(self) -> None:
+        """The ``process_allgather`` path under ``sync_timeout`` /
+        ``on_sync_failure`` (ISSUE 2 tentpole #3): ``"raise"`` propagates with
+        local state intact; ``"local"`` keeps serving local-only values with a
+        rank-zero warning, observable via :attr:`last_sync_ok`."""
+        try:
+            synced = {
+                k: host_sync_value(v, self._reductions.get(k), timeout=self.sync_timeout)
+                for k, v in self._state.items()
+            }
+        except Exception as err:
+            if self.on_sync_failure != "local":
+                raise
+            self.__dict__["_last_sync_ok"] = False
+            rank_zero_warn(
+                f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
+                " degrading to local-only state per on_sync_failure='local'."
+                " Values computed this step cover THIS process's data only.",
+                TorchMetricsUserWarning,
+            )
+            return
+        self._state = synced
+        self.__dict__["_last_sync_ok"] = True
+
+    @property
+    def last_sync_ok(self) -> bool:
+        """False when the most recent multi-host sync degraded to local-only
+        state (``on_sync_failure="local"``); True after any successful sync."""
+        return self.__dict__.get("_last_sync_ok", True)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference metric.py:540-560)."""
@@ -474,15 +615,21 @@ class Metric:
         distributed_available: Optional[Callable] = None,
         axis_name: Optional[Union[str, Sequence[str]]] = None,
     ) -> Generator[None, None, None]:
-        """Sync on entry, restore on exit (reference metric.py:562-597)."""
+        """Sync on entry, restore on exit (reference metric.py:562-597).
+
+        The unsync runs in a ``finally`` so an exception inside the body (a
+        failing ``compute``) cannot strand the metric in the synced state —
+        part of the transaction guarantee (docs/ROBUSTNESS.md)."""
         self.sync(
             dist_sync_fn=dist_sync_fn,
             should_sync=should_sync,
             distributed_available=distributed_available,
             axis_name=axis_name,
         )
-        yield
-        self.unsync(should_unsync=self._is_synced and should_unsync)
+        try:
+            yield
+        finally:
+            self.unsync(should_unsync=self._is_synced and should_unsync)
 
     # ------------------------------------------------------- pure / functional
     def _copy_state_dict(self) -> Dict[str, Any]:
@@ -508,6 +655,135 @@ class Metric:
         out = self._copy_state_dict()
         out[self._STATE_COUNT_KEY] = int(self._update_count)
         return out
+
+    #: reductions under which a state's array shape is invariant across
+    #: updates/merges/syncs — the only fields whose shape `validate="strict"`
+    #: can check exactly ("cat" grows, None stacks, callables are opaque)
+    _SHAPE_INVARIANT_REDUCTIONS = ("sum", "mean", "max", "min")
+
+    def state_spec(self) -> Dict[str, Any]:
+        """Declared layout of this metric's state pytree, exported alongside
+        :meth:`state` so checkpointing layers can persist and later verify a
+        restore target (ISSUE 2 tentpole #2).
+
+        Returns a plain-Python (JSON-serialisable) dict::
+
+            {"spec_version": 1, "class": <type name>,
+             "count_key": "_update_count",
+             "fields": {name: {"kind": "array"|"list", "shape": tuple|None,
+                               "dtype": str|None, "reduction": str|None,
+                               "shape_invariant": bool}}}
+
+        ``shape`` / ``dtype`` describe the default (fresh) state;
+        ``shape_invariant`` tells a validator whether the live shape must
+        still equal it ("sum"/"mean"/"max"/"min" accumulators) or may have
+        legitimately grown ("cat" concatenations, ``None`` stacks, custom
+        reductions).
+        """
+        fields: Dict[str, Any] = {}
+        for name, default in self._defaults.items():
+            fx = self._reductions.get(name)
+            reduction = fx if isinstance(fx, str) else ("custom" if callable(fx) else None)
+            if isinstance(default, list):
+                fields[name] = {
+                    "kind": "list", "shape": None, "dtype": None,
+                    "reduction": reduction, "shape_invariant": False,
+                }
+            else:
+                arr = jnp.asarray(default)
+                fields[name] = {
+                    "kind": "array",
+                    "shape": tuple(int(d) for d in arr.shape),
+                    "dtype": str(arr.dtype),
+                    "reduction": reduction,
+                    "shape_invariant": fx in self._SHAPE_INVARIANT_REDUCTIONS,
+                }
+        return {
+            "spec_version": 1,
+            "class": type(self).__name__,
+            "count_key": self._STATE_COUNT_KEY,
+            "fields": fields,
+        }
+
+    def validate_state(self, state: Dict[str, Any], mode: str = "strict", check_finite: bool = False) -> Dict[str, Any]:
+        """Check a state pytree against this metric's :meth:`state_spec`.
+
+        Returns the (possibly cast) state dict; raises
+        :class:`~torchmetrics_tpu.utils.exceptions.StateCorruptionError` on any
+        mismatch. Modes:
+
+        - ``"strict"``: tree structure (every declared field present, right
+          kind), exact dtype, and exact shape for shape-invariant fields.
+          Metadata-only — zero device dispatches.
+        - ``"cast"``: like strict, but a dtype mismatch casts to the declared
+          dtype instead of raising (shape/structure problems still raise).
+        - ``"off"``: no checks, state returned untouched.
+
+        ``check_finite=True`` additionally scans floating-point array fields
+        for NaN/Inf (one device reduction per float field) — the corrupted
+        checkpoint that parses fine but poisons every later merge.
+        """
+        if mode == "off":
+            return state
+        if mode not in ("strict", "cast"):
+            raise ValueError(f"validate must be 'strict', 'cast' or 'off', got {mode!r}")
+        if not isinstance(state, dict):
+            raise StateCorruptionError(
+                f"{type(self).__name__}: state must be a dict pytree, got {type(state).__name__}"
+            )
+        spec = self.state_spec()["fields"]
+        out: Dict[str, Any] = dict(state)
+        for name, field_spec in spec.items():
+            if name not in state:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: state is missing declared field {name!r}"
+                    f" (has {sorted(k for k in state if k != self._STATE_COUNT_KEY)})"
+                )
+            value = state[name]
+            if field_spec["kind"] == "list":
+                if not isinstance(value, (list, tuple)):
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: field {name!r} is a list state but the restored"
+                        f" value is {type(value).__name__}"
+                    )
+                if check_finite:
+                    for i, el in enumerate(value):
+                        self._check_field_finite(name, el, index=i)
+                continue
+            if isinstance(value, (list, tuple)):
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: field {name!r} is an array state but the restored"
+                    f" value is a {type(value).__name__}"
+                )
+            arr = value if hasattr(value, "shape") and hasattr(value, "dtype") else np.asarray(value)
+            if field_spec["shape_invariant"] and tuple(arr.shape) != field_spec["shape"]:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: field {name!r} has shape {tuple(arr.shape)} but this"
+                    f" metric's state layout requires {field_spec['shape']}"
+                )
+            if str(arr.dtype) != field_spec["dtype"]:
+                if mode == "cast":
+                    out[name] = jnp.asarray(value).astype(field_spec["dtype"])
+                else:
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: field {name!r} has dtype {arr.dtype} but this"
+                        f" metric's state layout requires {field_spec['dtype']}"
+                        " (use validate='cast' to convert)"
+                    )
+            if check_finite:
+                self._check_field_finite(name, out[name])
+        return out
+
+    def _check_field_finite(self, name: str, value: Any, index: Optional[int] = None) -> None:
+        arr = jnp.asarray(value)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            return
+        if not bool(jnp.all(jnp.isfinite(arr))):
+            where = f"{name!r}[{index}]" if index is not None else f"{name!r}"
+            raise StateCorruptionError(
+                f"{type(self).__name__}: field {where} contains non-finite values"
+                " (check_finite=True rejects NaN/Inf accumulators)"
+            )
 
     def init_state(self) -> Dict[str, Any]:
         """A fresh default state pytree (the pure analogue of ``reset``)."""
@@ -572,11 +848,25 @@ class Metric:
 
         Honors ``dist_sync_fn`` (e.g. ``parallel.quantized_sync``) like the OO
         :meth:`sync` path does.
+
+        The reserved ``"_update_count"`` key a :meth:`state` export carries is
+        NOT a declared state: it is stripped before the collectives (applying
+        a per-field reduction to the plain count leaf would stack it per rank,
+        or crash under jit) and re-attached afterwards summed across ranks —
+        the count of a synced state is the number of updates merged into it
+        world-wide.
         """
         axis = axis_name or self.sync_axis
+        count = state.get(self._STATE_COUNT_KEY)
+        if count is not None:
+            state = {k: v for k, v in state.items() if k != self._STATE_COUNT_KEY}
         if self.dist_sync_fn is not None:
-            return {k: self.dist_sync_fn(v, self._reductions.get(k), axis) for k, v in state.items()}
-        return sync_states(state, self._reductions, axis)
+            out = {k: self.dist_sync_fn(v, self._reductions.get(k), axis) for k, v in state.items()}
+        else:
+            out = sync_states(state, self._reductions, axis)
+        if count is not None:
+            out[self._STATE_COUNT_KEY] = jax.lax.psum(jnp.asarray(count), axis)
+        return out
 
     def merge_states(
         self, a: Dict[str, Any], b: Dict[str, Any], counts: Optional[Tuple[int, int]] = None
@@ -615,7 +905,13 @@ class Metric:
                 out[attr] = jnp.stack([jnp.atleast_1d(va), jnp.atleast_1d(vb)])
         return out
 
-    def load_state(self, state: Dict[str, Any], update_count: Optional[int] = None) -> None:
+    def load_state(
+        self,
+        state: Dict[str, Any],
+        update_count: Optional[int] = None,
+        validate: str = "strict",
+        check_finite: bool = False,
+    ) -> None:
         """Install a state pytree as the live state (inverse of :meth:`state`).
 
         ``update_count`` restores the number of updates the state represents.
@@ -626,15 +922,29 @@ class Metric:
         restored state counts as updated so ``compute()`` does not warn, and a
         stale pre-load count on the target instance is never kept). The count
         weights ``"mean"``-reduced merges in ``forward`` after a resume.
+
+        ``validate`` guards against corrupted resume checkpoints (ISSUE 2):
+        ``"strict"`` (default) rejects structural/shape/dtype mismatches with
+        :class:`StateCorruptionError` before anything is installed — the
+        checks read metadata only, so the happy path adds zero device
+        dispatches; ``"cast"`` converts dtype mismatches instead of raising;
+        ``"off"`` restores the unchecked fast path. ``check_finite=True``
+        additionally rejects NaN/Inf float accumulators (adds one reduction
+        per float field). Validation is all-or-nothing: on any failure the
+        live state is untouched.
         """
+        state = self.validate_state(state, mode=validate, check_finite=check_finite)
         carried = state.get(self._STATE_COUNT_KEY)
         if update_count is None and carried is not None:
             update_count = int(np.asarray(carried))
+        # stage fully, then install — a raise mid-loop must not half-load
+        staged: Dict[str, Any] = {}
         for k in self._defaults:
             if k not in state:
-                raise KeyError(f"state missing field {k!r}")
+                raise StateCorruptionError(f"state missing field {k!r}")
             v = state[k]
-            self._state[k] = list(v) if isinstance(v, (list, tuple)) else v
+            staged[k] = list(v) if isinstance(v, (list, tuple)) else v
+        self._state.update(staged)
         self.__dict__["_state_escaped"] = True  # installed arrays have external aliases
         self._computed = None
         self._update_count = self._restored_count(update_count)
@@ -798,6 +1108,9 @@ class Metric:
         self.__dict__.setdefault("_executor_enabled", None)
         self.__dict__.setdefault("_state_escaped", True)
         self.__dict__.setdefault("_state_shared", False)
+        self.__dict__.setdefault("sync_timeout", None)
+        self.__dict__.setdefault("on_sync_failure", "raise")
+        self.__dict__.setdefault("_last_sync_ok", True)
         self._state = {
             k: ([jnp.asarray(el) for el in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
         }

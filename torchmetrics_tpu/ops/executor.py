@@ -66,12 +66,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utils.prints import rank_zero_debug
+
 # CPU (and some other) backends do not implement buffer donation; jax warns on
 # every dispatch. Donation is still semantically correct there (silently
 # ignored), so silence exactly that message.
 warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
 
 ENV_FLAG = "TORCHMETRICS_TPU_EXECUTOR"
+#: set to "0" to drop the per-call host-side recovery snapshot taken before a
+#: donating dispatch (docs/EXECUTOR.md "Failure semantics") — faster steady
+#: state, but a failed dispatch then resets the metric instead of restoring it
+RECOVERY_ENV_FLAG = "TORCHMETRICS_TPU_EXECUTOR_RECOVERY"
 
 #: reserved key carried by ``Metric.state()`` exports (see metric.py)
 STATE_COUNT_KEY = "_update_count"
@@ -86,6 +92,29 @@ def executor_enabled_default() -> bool:
     return os.environ.get(ENV_FLAG, "1").strip().lower() not in ("0", "false", "off", "no")
 
 
+def recovery_enabled_default() -> bool:
+    """Whether donating calls keep a host-side recovery snapshot
+    (``TORCHMETRICS_TPU_EXECUTOR_RECOVERY``, on by default)."""
+    return os.environ.get(RECOVERY_ENV_FLAG, "1").strip().lower() not in ("0", "false", "off", "no")
+
+
+class _DispatchFailure(Exception):
+    """Internal: a WARM executable failed at dispatch time.
+
+    By then the inputs may already have been donated, so the executor has
+    restored the live state (from the host-side recovery snapshot) before
+    raising this; the outer entry point unwraps and propagates ``original`` to
+    the caller instead of falling back to the eager body — the eager body
+    would silently re-run the batch and turn an error into a double-count
+    hazard, and a transient runtime failure must not permanently disable the
+    compiled path the way a trace failure does.
+    """
+
+    def __init__(self, original: BaseException) -> None:
+        super().__init__(str(original))
+        self.original = original
+
+
 def bucket_size(n: int) -> int:
     """Next rung of the geometric bucket ladder: powers of two, floor 8.
 
@@ -98,10 +127,22 @@ def bucket_size(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+_trace_probe_logged = False
+
+
 def _trace_clean() -> bool:
+    global _trace_probe_logged
     try:
         return bool(jax.core.trace_state_clean())
-    except Exception:
+    except Exception as err:
+        # jax moved/renamed this probe across versions; assume an untraced
+        # context but say so once instead of silently guessing forever
+        if not _trace_probe_logged:
+            _trace_probe_logged = True
+            rank_zero_debug(
+                f"torchmetrics_tpu executor: jax.core.trace_state_clean unavailable"
+                f" ({type(err).__name__}: {err}); assuming untraced context"
+            )
         return True
 
 
@@ -249,6 +290,8 @@ def _new_stats() -> Dict[str, Any]:
         "copied_calls": 0,   # calls that copied first (escaped/shared/fresh key)
         "probes": 0,         # eager oracle runs validating padded execution
         "skipped_calls": 0,  # per-call ineligibility (tracers, odd inputs)
+        "dispatch_failures": 0,   # warm-executable failures propagated to the caller
+        "recovery_restores": 0,   # donated states reinstalled from the host snapshot
     }
 
 
@@ -262,6 +305,53 @@ class _ExecutorBase:
         self._static_reason_cached: Any = ()  # sentinel: not yet computed
         self._pad_validated = False
         self._bucketing_ok = True
+        self._keep_recovery = recovery_enabled_default()
+
+    def _owner_name(self) -> str:
+        return type(self).__name__
+
+    def _disable(self, reason: str) -> None:
+        """Permanently fall back to the eager path, RECORDING why (ISSUE 2
+        satellite: a metric silently running 20× slower must be diagnosable).
+        The reason surfaces via ``Metric.executor_status`` /
+        :func:`executor_stats` and is logged once at debug level."""
+        if self.disabled_reason is None:
+            rank_zero_debug(
+                f"torchmetrics_tpu executor disabled for {self._owner_name()}: {reason}"
+                " (eager fallback; see Metric.executor_status)"
+            )
+        self.disabled_reason = reason
+
+    def _snapshot(self, state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Host-side recovery reference taken right before a donating call: if
+        the dispatch dies after the runtime took the buffers, this is the only
+        surviving copy of the accumulated state. ``None`` when recovery is
+        disabled via the env flag.
+
+        ``np.array`` (copying) rather than ``jax.device_get``: on CPU backends
+        device_get can return a zero-copy VIEW of the device buffer, which an
+        in-place donating dispatch then overwrites — silently corrupting the
+        very snapshot that exists to survive it."""
+        if not self._keep_recovery:
+            return None
+        return {k: np.array(v) for k, v in state.items()}
+
+    def _restore(self, metric: Any, recovery: Optional[Dict[str, Any]]) -> None:
+        """Reinstall a recovery snapshot (or defaults when recovery is off)
+        into ``metric`` after a donated dispatch failed."""
+        if recovery is not None:
+            restored = {k: jnp.asarray(v) for k, v in recovery.items()}
+            self.stats["recovery_restores"] += 1
+        else:
+            restored = {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+            rank_zero_debug(
+                f"torchmetrics_tpu executor: dispatch failed after donation with"
+                f" {RECOVERY_ENV_FLAG}=0 — state of {type(metric).__name__} reset to defaults"
+            )
+        new_state = dict(metric._state)
+        new_state.update(restored)
+        object.__setattr__(metric, "_state", new_state)
+        metric.__dict__["_state_escaped"] = True
 
     def _get_fn(self, key: Any, builder: Callable[[], Callable]) -> Tuple[Callable, bool]:
         fn = self._cache.get(key)
@@ -276,6 +366,7 @@ class _ExecutorBase:
     def stats_dict(self) -> Dict[str, Any]:
         out = dict(self.stats)
         out["disabled_reason"] = self.disabled_reason
+        out["fallback_reason"] = self.disabled_reason
         out["bucketing_enabled"] = self._bucketing_ok
         out["cached_executables"] = len(self._cache)
         return out
@@ -289,6 +380,9 @@ class MetricExecutor(_ExecutorBase):
         self._metric = metric
         self._plain_functional = plain_functional
         self._plain_forward = plain_forward
+
+    def _owner_name(self) -> str:
+        return type(self._metric).__name__
 
     # ------------------------------------------------------------ eligibility
     def _static_reason(self) -> Optional[str]:
@@ -322,6 +416,7 @@ class MetricExecutor(_ExecutorBase):
         out = super().stats_dict()
         if out["disabled_reason"] is None:
             out["disabled_reason"] = self._static_reason()
+        out["fallback_reason"] = out["disabled_reason"]
         return out
 
     def bucketable(self) -> bool:
@@ -430,7 +525,14 @@ class MetricExecutor(_ExecutorBase):
     # ------------------------------------------------------------------ entry
     def run_update(self, args: tuple, kwargs: dict) -> bool:
         """Execute ``update`` through the compiled path; False -> caller falls
-        back to the eager body (never partially applied)."""
+        back to the eager body (never partially applied).
+
+        Failure containment (docs/EXECUTOR.md "Failure semantics"): a FRESH
+        key's failure is a trace/compile problem — inputs were copies, so the
+        sticky eager fallback is safe. A WARM executable's failure is a
+        runtime/dispatch problem after the inputs may have been donated: the
+        live state has been restored from the recovery snapshot and the
+        original error propagates (no silent eager re-run of the batch)."""
         if not self.usable():
             return False
         if not _trace_clean():
@@ -438,8 +540,10 @@ class MetricExecutor(_ExecutorBase):
             return False
         try:
             return self._run_update(args, kwargs)
+        except _DispatchFailure as df:
+            raise df.original
         except Exception as err:  # sticky: a metric that cannot trace stays eager
-            self.disabled_reason = f"{type(err).__name__}: {err}"
+            self._disable(f"{type(err).__name__}: {err}")
             return False
 
     def _run_update(self, args, kwargs) -> bool:
@@ -458,15 +562,25 @@ class MetricExecutor(_ExecutorBase):
         state = {k: m._state[k] for k in m._defaults}
         need_copy = fresh or m._state_escaped or m._state_shared
         state_in = _tree_copy(state) if need_copy else state
+        # donation in play -> keep a host-side recovery reference (ISSUE 2)
+        recovery = None if need_copy else self._snapshot(state)
 
         do_probe = padded and not self._pad_validated
         oracle = m.functional_update(state, *args, **kwargs) if do_probe else None
 
-        if padded:
-            new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
-            self.stats["padded_calls"] += 1
-        else:
-            new_state = fn(state_in, *call_leaves)
+        try:
+            if padded:
+                new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
+                self.stats["padded_calls"] += 1
+            else:
+                new_state = fn(state_in, *call_leaves)
+        except Exception as err:
+            if fresh:
+                raise  # trace/compile failure: live state was never at risk
+            if not need_copy:
+                self._restore(m, recovery)
+            self.stats["dispatch_failures"] += 1
+            raise _DispatchFailure(err)
 
         if do_probe:
             self.stats["probes"] += 1
@@ -499,8 +613,10 @@ class MetricExecutor(_ExecutorBase):
             return False, None
         try:
             return self._run_forward(args, kwargs)
+        except _DispatchFailure as df:
+            raise df.original
         except Exception as err:
-            self.disabled_reason = f"{type(err).__name__}: {err}"
+            self._disable(f"{type(err).__name__}: {err}")
             return False, None
 
     def _forward_oracle(self, variant, state, args, kwargs, count):
@@ -532,16 +648,25 @@ class MetricExecutor(_ExecutorBase):
         count = int(m._update_count)
         need_copy = fresh or m._state_escaped or m._state_shared
         state_in = _tree_copy(state) if need_copy else state
+        recovery = None if need_copy else self._snapshot(state)
 
         do_probe = padded and not self._pad_validated
         oracle = self._forward_oracle(variant, state, args, kwargs, count) if do_probe else None
 
         count_arr = jnp.asarray(count, jnp.int32)
-        if padded:
-            new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
-            self.stats["padded_calls"] += 1
-        else:
-            new_state, value = fn(state_in, count_arr, *call_leaves)
+        try:
+            if padded:
+                new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
+                self.stats["padded_calls"] += 1
+            else:
+                new_state, value = fn(state_in, count_arr, *call_leaves)
+        except Exception as err:
+            if fresh:
+                raise  # trace/compile failure: live state was never at risk
+            if not need_copy:
+                self._restore(m, recovery)
+            self.stats["dispatch_failures"] += 1
+            raise _DispatchFailure(err)
 
         if do_probe:
             self.stats["probes"] += 1
@@ -573,6 +698,21 @@ class CollectionExecutor(_ExecutorBase):
     def __init__(self, collection: Any) -> None:
         super().__init__()
         self._coll = collection
+
+    def _owner_name(self) -> str:
+        return f"MetricCollection[{', '.join(self._coll._modules)}]"
+
+    def _restore_groups(self, donated) -> None:
+        """Reinstall recovery snapshots for every donated group after a failed
+        fused dispatch, re-pointing followers at the leader's restored arrays."""
+        mods = self._coll._modules
+        for name, m, cg, recovery in donated:
+            self._restore(m, recovery)
+            for member in cg[1:]:
+                follower = mods[member]
+                for field in m._defaults:
+                    follower._state[field] = m._state[field]
+                follower.__dict__["_state_escaped"] = True
 
     # ------------------------------------------------------------ eligibility
     def _leaders(self):
@@ -704,8 +844,10 @@ class CollectionExecutor(_ExecutorBase):
             return False
         try:
             return self._run_update(args, kwargs, leader_execs)
+        except _DispatchFailure as df:
+            raise df.original
         except Exception as err:
-            self.disabled_reason = f"{type(err).__name__}: {err}"
+            self._disable(f"{type(err).__name__}: {err}")
             return False
 
     def _run_update(self, args, kwargs, leader_execs) -> bool:
@@ -729,11 +871,14 @@ class CollectionExecutor(_ExecutorBase):
         fn, fresh = self._get_fn(key, builder)
 
         states, copied = {}, False
+        donated = []  # groups whose live buffers go into the donated call
         for name, m, cg, _ in leader_execs:
             st = {k: m._state[k] for k in m._defaults}
             if self._group_need_copy(cg, fresh):
                 st = _tree_copy(st)
                 copied = True
+            else:
+                donated.append((name, m, cg, self._snapshot(st)))
             states[name] = st
 
         do_probe = padded and not self._pad_validated
@@ -744,11 +889,18 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
-        if padded:
-            new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
-            self.stats["padded_calls"] += 1
-        else:
-            new_states = fn(states, *call_leaves)
+        try:
+            if padded:
+                new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
+                self.stats["padded_calls"] += 1
+            else:
+                new_states = fn(states, *call_leaves)
+        except Exception as err:
+            if fresh:
+                raise  # trace/compile failure: every group's input was a copy
+            self._restore_groups(donated)
+            self.stats["dispatch_failures"] += 1
+            raise _DispatchFailure(err)
 
         if do_probe:
             self.stats["probes"] += 1
@@ -797,8 +949,10 @@ class CollectionExecutor(_ExecutorBase):
                     return None
         try:
             return self._run_forward(args, kwargs, leader_execs)
+        except _DispatchFailure as df:
+            raise df.original
         except Exception as err:
-            self.disabled_reason = f"{type(err).__name__}: {err}"
+            self._disable(f"{type(err).__name__}: {err}")
             return None
 
     def _run_forward(self, args, kwargs, leader_execs):
@@ -827,12 +981,15 @@ class CollectionExecutor(_ExecutorBase):
         fn, fresh = self._get_fn(key, builder)
 
         states, copied = {}, False
+        donated = []  # groups whose live buffers go into the donated call
         counts = {}
         for name, m, cg, _ in leader_execs:
             st = {k: m._state[k] for k in m._defaults}
             if self._group_need_copy(cg, fresh):
                 st = _tree_copy(st)
                 copied = True
+            else:
+                donated.append((name, m, cg, self._snapshot(st)))
             states[name] = st
             counts[name] = jnp.asarray(int(m._update_count), jnp.int32)
 
@@ -849,11 +1006,18 @@ class CollectionExecutor(_ExecutorBase):
                     oracle_values[member] = coll._modules[member].functional_compute(bs)
             oracle = (oracle_states, oracle_values)
 
-        if padded:
-            new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
-            self.stats["padded_calls"] += 1
-        else:
-            new_states, values = fn(states, counts, *call_leaves)
+        try:
+            if padded:
+                new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
+                self.stats["padded_calls"] += 1
+            else:
+                new_states, values = fn(states, counts, *call_leaves)
+        except Exception as err:
+            if fresh:
+                raise  # trace/compile failure: every group's input was a copy
+            self._restore_groups(donated)
+            self.stats["dispatch_failures"] += 1
+            raise _DispatchFailure(err)
 
         if do_probe:
             self.stats["probes"] += 1
@@ -957,6 +1121,7 @@ def executor_stats(obj: Any) -> Dict[str, Any]:
     if ex is None:
         out = _new_stats()
         out["disabled_reason"] = None
+        out["fallback_reason"] = None
         out["bucketing_enabled"] = True
         out["cached_executables"] = 0
         return out

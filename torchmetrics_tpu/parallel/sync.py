@@ -16,7 +16,8 @@ the PartitionSpec-aware generalisation of the reference's ``dist_reduce_fx``.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence, Union
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,60 @@ from jax import Array, lax
 Reduction = Union[str, Callable, None]
 
 _VALID_REDUCTIONS = ("sum", "mean", "max", "min", "cat")
+
+#: env var holding the fleet-wide default host-sync bound (seconds, float)
+SYNC_TIMEOUT_ENV = "TORCHMETRICS_TPU_SYNC_TIMEOUT"
+
+
+def default_sync_timeout() -> Optional[float]:
+    """The environment-configured host-sync timeout, or None (unbounded)."""
+    raw = os.environ.get(SYNC_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(f"{SYNC_TIMEOUT_ENV} must be a number of seconds, got {raw!r}")
+    return value if value > 0 else None
+
+
+def _process_allgather(value: Any) -> Any:
+    """The raw DCN collective — a module-level seam so the fault-injection
+    harness (testing/faults.py) can hang or break it without a real cluster."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(value)
+
+
+def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
+    """``process_allgather`` bounded by ``timeout`` seconds.
+
+    A hung collective (the classic multi-host failure mode: one process died
+    mid-epoch and the rest block forever inside the rendezvous) surfaces as
+    :class:`SyncTimeoutError` instead of a silent hang. The abandoned gather
+    thread cannot be cancelled — it parks until the runtime gives up — so a
+    timeout should be treated as this process's cue to checkpoint local state
+    and exit, not to retry in a loop.
+    """
+    if timeout is None:
+        return _process_allgather(value)
+    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    # deferred: utils/__init__ itself imports from this module (reduce/class_reduce)
+    from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+    pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tm_tpu_sync")
+    try:
+        fut = pool.submit(_process_allgather, value)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutTimeout:
+            raise SyncTimeoutError(
+                f"multi-host state sync (process_allgather) did not complete within {timeout}s"
+            ) from None
+    finally:
+        pool.shutdown(wait=False)
 
 
 def in_named_axis_context(axis_name: Union[str, Sequence[str]]) -> bool:
@@ -115,20 +170,21 @@ def sync_states(
     return out
 
 
-def host_sync_value(value: Any, reduction: Reduction) -> Any:
+def host_sync_value(value: Any, reduction: Reduction, timeout: Optional[float] = None) -> Any:
     """Multi-host (DCN) sync outside jit via process_allgather, then local reduce.
 
     Only invoked when ``jax.process_count() > 1``; single-host states are already
-    replicated so host sync is a no-op at the caller.
+    replicated so host sync is a no-op at the caller. ``timeout`` (seconds)
+    bounds the collective — see :func:`_gather_with_timeout`; the degradation
+    policy on timeout belongs to the caller (``Metric.sync``'s
+    ``on_sync_failure``).
     """
-    from jax.experimental import multihost_utils
-
     is_list = isinstance(value, (list, tuple))
     if is_list:
         if len(value) == 0:
             return value
         value = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0)
-    gathered = multihost_utils.process_allgather(value)  # (world, *shape)
+    gathered = _gather_with_timeout(value, timeout)  # (world, *shape)
     if reduction == "sum":
         out = gathered.sum(0)
     elif reduction == "mean":

@@ -1,0 +1,28 @@
+"""Testing utilities — the fault-injection harness (ISSUE 2).
+
+``torchmetrics_tpu.testing.faults`` provides the chaos primitives the
+failure-containment suite (tests/test_fault_containment.py) is built on; they
+are public so downstream training stacks can chaos-test their own metric
+pipelines the same way.
+"""
+from torchmetrics_tpu.testing.faults import (  # noqa: F401
+    FaultInjected,
+    break_sync,
+    corrupt_state,
+    fail_dispatch,
+    hang_sync,
+    poison_batch,
+    raise_in_compute,
+    raise_in_update,
+)
+
+__all__ = [
+    "FaultInjected",
+    "break_sync",
+    "corrupt_state",
+    "fail_dispatch",
+    "hang_sync",
+    "poison_batch",
+    "raise_in_compute",
+    "raise_in_update",
+]

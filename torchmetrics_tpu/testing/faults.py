@@ -1,0 +1,247 @@
+"""Fault-injection harness for chaos-testing metric pipelines (ISSUE 2).
+
+Production TPU failures are rarely clean exceptions: a bad batch poisons an
+accumulator, a dispatch dies after the runtime took ownership of donated
+buffers, a multi-host collective hangs because one process fell over, a resume
+checkpoint comes back truncated. Each primitive here injects exactly one of
+those faults, deterministically, on a single host — so the containment
+guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
+
+- :func:`poison_batch` — NaN/Inf-corrupt input arrays.
+- :func:`raise_in_update` / :func:`raise_in_compute` — raise at a chosen point
+  inside the metric body, optionally *after* state mutation (the half-mutated
+  accumulator case).
+- :func:`fail_dispatch` — make every executor dispatch raise, optionally after
+  the compiled call consumed its donated inputs.
+- :func:`hang_sync` / :func:`break_sync` — stall or break the multi-host
+  ``process_allgather`` seam (drives ``sync_timeout`` / ``on_sync_failure``).
+- :func:`corrupt_state` — damage a state pytree (shape/dtype/structure/NaN)
+  the way a torn checkpoint would (drives ``load_state(validate=...)``).
+
+All context managers restore the patched seam on exit, including when the
+body raises. They are process-local and NOT thread-safe (they patch module
+and class attributes) — use from a single test thread.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised by the injection primitives — distinct from
+    anything the framework raises itself, so tests can assert the *injected*
+    fault (and nothing else) escaped."""
+
+
+# --------------------------------------------------------------------- inputs
+
+def poison_batch(*arrays: Any, mode: str = "nan", frac: float = 0.25, seed: int = 0) -> Tuple[Any, ...]:
+    """Corrupt a fraction of every floating-point array's entries with NaN
+    (``mode="nan"``) or +/-Inf (``mode="inf"``). Integer arrays (labels) pass
+    through untouched. Deterministic in ``seed``.
+
+    >>> import jax.numpy as jnp
+    >>> (x,) = poison_batch(jnp.zeros(8), frac=0.5, seed=1)
+    >>> int(jnp.isnan(x).sum()) == 4
+    True
+    """
+    if mode not in ("nan", "inf"):
+        raise ValueError(f"mode must be 'nan' or 'inf', got {mode!r}")
+    rng = np.random.RandomState(seed)
+    out = []
+    for arr in arrays:
+        a = np.array(arr)
+        if not np.issubdtype(a.dtype, np.floating):
+            out.append(arr)
+            continue
+        flat = a.reshape(-1)
+        k = max(1, int(round(frac * flat.size)))
+        idx = rng.choice(flat.size, size=min(k, flat.size), replace=False)
+        if mode == "nan":
+            flat[idx] = np.nan
+        else:
+            flat[idx] = np.where(rng.rand(len(idx)) < 0.5, np.inf, -np.inf)
+        out.append(jnp.asarray(flat.reshape(a.shape)))
+    return tuple(out)
+
+
+# --------------------------------------------------------------- metric body
+
+@contextmanager
+def raise_in_update(
+    metric: Any, exc: Optional[BaseException] = None, after_mutation: bool = True
+) -> Generator[None, None, None]:
+    """Make ``metric``'s update body raise.
+
+    With ``after_mutation=True`` (default) the REAL update body runs first —
+    the live state is already mutated when the exception fires, which is
+    exactly the half-applied-transition case the transactional wrapper must
+    roll back. ``after_mutation=False`` raises before touching anything.
+
+    The patch targets ``metric._update_fn``, the seam every path shares
+    (eager body, ``functional_update``, executor builders). Note for
+    executor-enabled metrics: an executable compiled BEFORE entering this
+    context has the original body baked in — inject on a cold instance (so
+    the fault traces in) or use :func:`fail_dispatch` for warm ones.
+    """
+    orig = metric._update_fn
+    error = exc if exc is not None else FaultInjected("injected update failure")
+
+    def failing(*args: Any, **kwargs: Any) -> None:
+        if after_mutation:
+            orig(*args, **kwargs)
+        raise error
+
+    object.__setattr__(metric, "_update_fn", failing)
+    try:
+        yield
+    finally:
+        object.__setattr__(metric, "_update_fn", orig)
+
+
+@contextmanager
+def raise_in_compute(metric: Any, exc: Optional[BaseException] = None) -> Generator[None, None, None]:
+    """Make ``metric``'s compute body raise (patches ``metric._compute_fn``,
+    shared by the eager wrapper and ``functional_compute``)."""
+    orig = metric._compute_fn
+    error = exc if exc is not None else FaultInjected("injected compute failure")
+
+    def failing(*args: Any, **kwargs: Any) -> Any:
+        raise error
+
+    object.__setattr__(metric, "_compute_fn", failing)
+    try:
+        yield
+    finally:
+        object.__setattr__(metric, "_compute_fn", orig)
+
+
+# ----------------------------------------------------------------- executor
+
+@contextmanager
+def fail_dispatch(
+    exc: Optional[BaseException] = None, consume: bool = True
+) -> Generator[None, None, None]:
+    """Make every donated-state executor dispatch raise.
+
+    With ``consume=True`` (default) the real compiled function is invoked
+    first — donated input buffers are genuinely consumed before the failure,
+    the worst case the executor's host-side recovery reference exists for.
+    Patches ``_ExecutorBase._get_fn`` class-wide; affects all metrics until
+    exit.
+    """
+    from torchmetrics_tpu.ops import executor as executor_mod
+
+    orig = executor_mod._ExecutorBase._get_fn
+    error = exc if exc is not None else FaultInjected("injected dispatch failure")
+
+    def patched(self: Any, key: Any, builder: Any):
+        fn, fresh = orig(self, key, builder)
+
+        def failing(*args: Any, **kwargs: Any) -> Any:
+            if consume:
+                fn(*args, **kwargs)
+            raise error
+
+        return failing, fresh
+
+    executor_mod._ExecutorBase._get_fn = patched
+    try:
+        yield
+    finally:
+        executor_mod._ExecutorBase._get_fn = orig
+
+
+# --------------------------------------------------------------------- sync
+
+@contextmanager
+def hang_sync(seconds: float = 30.0) -> Generator[None, None, None]:
+    """Stall the multi-host ``process_allgather`` seam by ``seconds`` before
+    letting it proceed — a metric with ``sync_timeout < seconds`` sees a
+    :class:`~torchmetrics_tpu.utils.exceptions.SyncTimeoutError`; one without
+    a bound blocks, exactly like a real dead-peer rendezvous."""
+    from torchmetrics_tpu.parallel import sync as sync_mod
+
+    orig = sync_mod._process_allgather
+
+    def hanging(value: Any) -> Any:
+        time.sleep(seconds)
+        return orig(value)
+
+    sync_mod._process_allgather = hanging
+    try:
+        yield
+    finally:
+        sync_mod._process_allgather = orig
+
+
+@contextmanager
+def break_sync(exc: Optional[BaseException] = None) -> Generator[None, None, None]:
+    """Make the multi-host ``process_allgather`` seam raise immediately (a
+    collective aborted by the runtime rather than hung)."""
+    from torchmetrics_tpu.parallel import sync as sync_mod
+
+    orig = sync_mod._process_allgather
+    error = exc if exc is not None else FaultInjected("injected sync failure")
+
+    def failing(value: Any) -> Any:
+        raise error
+
+    sync_mod._process_allgather = failing
+    try:
+        yield
+    finally:
+        sync_mod._process_allgather = orig
+
+
+# -------------------------------------------------------------- checkpoints
+
+def corrupt_state(
+    state: Dict[str, Any], mode: str = "nan", field: Optional[str] = None, seed: int = 0
+) -> Dict[str, Any]:
+    """A damaged copy of a state pytree, the way a torn/bit-flipped resume
+    checkpoint presents. The input is never modified.
+
+    Modes (``field`` picks the victim; default: first eligible array field):
+
+    - ``"shape"``   — the field's array gains a bogus leading dim.
+    - ``"dtype"``   — the field's array is cast float<->int.
+    - ``"structure"`` — the field's key is deleted outright.
+    - ``"nan"``     — a random entry of a float field becomes NaN.
+    """
+    if mode not in ("shape", "dtype", "structure", "nan"):
+        raise ValueError(f"mode must be one of shape/dtype/structure/nan, got {mode!r}")
+    out = {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}
+    candidates = [
+        k for k, v in state.items()
+        if not isinstance(v, (list, tuple)) and hasattr(v, "dtype") and k != "_update_count"
+    ]
+    if mode == "nan":
+        candidates = [k for k in candidates if np.issubdtype(np.asarray(state[k]).dtype, np.floating)]
+    if field is not None:
+        if field not in state:
+            raise KeyError(f"field {field!r} not in state")
+        candidates = [field]
+    if not candidates:
+        raise ValueError(f"state has no array field eligible for mode {mode!r}")
+    victim = candidates[0]
+    value = jnp.asarray(state[victim])
+    if mode == "shape":
+        out[victim] = jnp.stack([value, value])
+    elif mode == "dtype":
+        if jnp.issubdtype(value.dtype, jnp.floating):
+            out[victim] = value.astype(jnp.int32)
+        else:
+            out[victim] = value.astype(jnp.float32)
+    elif mode == "structure":
+        del out[victim]
+    else:  # nan
+        flat = np.array(value).reshape(-1)
+        flat[np.random.RandomState(seed).randint(0, flat.size)] = np.nan
+        out[victim] = jnp.asarray(flat.reshape(value.shape))
+    return out

@@ -1,6 +1,8 @@
 """Framework exceptions.
 
-Mirrors the reference's exception surface (torchmetrics/utilities/exceptions.py).
+Mirrors the reference's exception surface (torchmetrics/utilities/exceptions.py)
+plus the failure-containment additions (ISSUE 2): corrupted-restore and
+bounded-sync errors.
 """
 
 
@@ -10,3 +12,27 @@ class TorchMetricsUserError(Exception):
 
 class TorchMetricsUserWarning(UserWarning):
     """Warning raised on questionable usage of the metric API."""
+
+
+class StateCorruptionError(TorchMetricsUserError, KeyError):
+    """A state pytree failed validation on restore.
+
+    Raised by ``Metric.load_state(..., validate="strict"|"cast")`` when the
+    incoming pytree's structure, shapes, dtypes, or (optionally) finiteness do
+    not match the metric's :meth:`~torchmetrics_tpu.Metric.state_spec`. Also a
+    ``KeyError`` so pre-existing callers catching the old missing-field error
+    keep working.
+    """
+
+    def __str__(self) -> str:  # KeyError.__str__ repr-quotes the message
+        return Exception.__str__(self)
+
+
+class SyncTimeoutError(TorchMetricsUserError, TimeoutError):
+    """A bounded multi-host sync did not complete within ``sync_timeout``.
+
+    Raised by the ``process_allgather`` path when a collective exceeds the
+    configured timeout and the metric's ``on_sync_failure`` policy is
+    ``"raise"`` (under ``"local"`` the metric degrades to local-only state
+    instead, flagged via ``Metric.last_sync_ok``).
+    """
