@@ -19,6 +19,7 @@ from torchmetrics_tpu.classification import (
     BinaryPrecisionRecallCurve,
     BinaryROC,
 )
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 
 rng = np.random.RandomState(12)
 PREDS = rng.rand(512).astype(np.float32)
@@ -57,7 +58,7 @@ class TestCapacityBuffers:
 
         @jax.jit
         @partial(
-            jax.shard_map, mesh=mesh, in_specs=(P("batch"), P("batch")), out_specs=P(), check_vma=False
+            shard_map_compat, mesh=mesh, in_specs=(P("batch"), P("batch")), out_specs=P(), check_vma=False
         )
         def step(p, t):
             st = m.functional_update(state0, p, t)
@@ -159,7 +160,7 @@ class TestRetrievalCapacityBuffers:
         state0 = m.init_state()
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("batch"),) * 3, out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("batch"),) * 3, out_specs=P(), check_vma=False)
         def step(p, t, idx):
             st = m.functional_update(state0, p, t, indexes=idx)
             return m.functional_sync(st, "batch")

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from torchmetrics_tpu.parallel.sync import shard_map_compat
+
 NUM_DEVICES = 8
 
 
@@ -208,12 +210,12 @@ class MetricTester:
             # declared dynamic-shape compute: sync in-trace, compute on host —
             # the same split the OO path uses
             synced = jax.jit(
-                jax.shard_map(sync_only, mesh=mesh, in_specs=P("batch"), out_specs=P(), check_vma=False)
+                shard_map_compat(sync_only, mesh=mesh, in_specs=P("batch"), out_specs=P(), check_vma=False)
             )(stacked)
             result = metric.functional_compute(_rewrap(synced))
         else:
             result = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     sync_and_compute,
                     mesh=mesh,
                     in_specs=P("batch"),

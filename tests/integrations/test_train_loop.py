@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 sys.path.insert(0, "/root/repo/tests")
 
 import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 
 NUM_DEVICES = 8
 NUM_CLASSES = 4
@@ -62,7 +63,7 @@ class TestTrainLoopIntegration:
         model, params, opt, opt_state, mesh, acc, f1, loss_m = self._setup()
 
         @partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P(), P(), P("data"), P("data")),
             out_specs=(P(), P(), P(), P(), P(), P()),

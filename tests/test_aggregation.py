@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 
 
 @pytest.mark.parametrize(
@@ -82,5 +83,5 @@ def test_mean_metric_ddp_semantics(mesh):
         return m.functional_compute(st)
 
     data = jnp.arange(24.0).reshape(8, 3)
-    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    out = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
     assert abs(float(out) - float(data.mean())) < 1e-6

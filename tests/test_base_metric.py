@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from torchmetrics_tpu import CompositionalMetric, Metric, MeanMetric, SumMetric
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 
 
@@ -261,7 +262,7 @@ def test_sync_shard_map(mesh):
         return m.functional_compute(st)
 
     data = jnp.arange(8.0).reshape(8, 1)
-    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    out = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
     assert float(out) == float(data.sum())
 
 
@@ -275,5 +276,5 @@ def test_oo_sync_inside_trace(mesh):
         return m.compute()
 
     data = jnp.arange(8.0).reshape(8, 1)
-    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
+    out = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=P("batch"), out_specs=P()))(data)
     assert float(out) == float(data.sum())

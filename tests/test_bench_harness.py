@@ -172,3 +172,35 @@ def test_all_cached_reports_tpu_backend(cache_path, monkeypatch):
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert out["backend_degraded"] is False
     assert out["backend"] == "tpu (from result cache)"
+
+
+def test_apply_baselines_fills_null_ratios_only(tmp_path, monkeypatch):
+    """vs_baseline null -> filled from BASELINE.json bench_baselines with the
+    source labelled; a live torch ratio is never overwritten (ISSUE 3
+    satellite: the perf trajectory is tracked run-over-run)."""
+    baselines = {
+        "2_collection_mesh_sync": {"value": 2000.0, "value_same_work_unsynced": 6000.0},
+    }
+    r = bench._apply_baselines(
+        "2_collection_mesh_sync",
+        {"value": 2100.0, "vs_baseline": None, "value_same_work_unsynced": 3000.0, "vs_baseline_same_work": None},
+        baselines,
+    )
+    assert r["vs_baseline"] == 1.05
+    assert r["vs_baseline_same_work"] == 0.5
+    assert r["baseline_source"] == "BASELINE.json bench_baselines"
+    # live ratio wins: nothing touched, no source label
+    r2 = bench._apply_baselines("2_collection_mesh_sync", {"value": 2100.0, "vs_baseline": 3.3}, baselines)
+    assert r2["vs_baseline"] == 3.3 and "baseline_source" not in r2
+    # unknown config / missing baseline: untouched
+    r3 = bench._apply_baselines("nope", {"value": 1.0, "vs_baseline": None}, baselines)
+    assert r3["vs_baseline"] is None
+
+
+def test_committed_baselines_cover_every_config():
+    """BASELINE.json's bench_baselines block stays in lockstep with the
+    configs bench.py actually runs."""
+    baselines = bench._load_baselines()
+    names = [n for n, _ in bench.DEVICE_CONFIGS] + ["2_collection_mesh_sync"]
+    for name in names:
+        assert baselines.get(name, {}).get("value"), f"no committed baseline for {name}"

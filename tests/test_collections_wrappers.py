@@ -26,6 +26,7 @@ from torchmetrics_tpu.classification import (
     MulticlassPrecision,
     MulticlassRecall,
 )
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 
 NUM_CLASSES = 5
 rng = np.random.RandomState(31)
@@ -263,7 +264,7 @@ class TestFunctionalCollection:
         mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         def dist_step(p, t):
             st = mc.functional_update(states0, p, t)
             st = mc.functional_sync(st, "data")
@@ -297,7 +298,7 @@ class TestFunctionalCollection:
         assert n_fields > len(sum_dtypes)  # fusion must actually merge something
         mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         def dist_step(p, t):
             st = mc.functional_update(states0, p, t)
             st = mc.functional_sync(st, "data")
@@ -378,7 +379,7 @@ class TestFunctionalCollection:
         flat_p, flat_t = jnp.asarray(PREDS.reshape(-1)), jnp.asarray(TARGET.reshape(-1))
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         def step(p, t):
             st = coll.functional_update(coll.functional_init(), p, t)
             st = coll.functional_sync(st, "data")
@@ -855,7 +856,7 @@ class TestFunctionalWrapperPaths:
         b0, m0, r0, x0 = boot.functional_init(), mo.functional_init(), run.functional_init(), mm.functional_init()
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data"), P("data"), P("data")), out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("data"), P("data"), P("data"), P("data")), out_specs=P(), check_vma=False)
         def step(p, t, mp, mt_):
             bs = boot.functional_sync(boot.functional_update(b0, p, indices=idx), "data")
             ms = mo.functional_sync(mo.functional_update(m0, mp, mt_), "data")
@@ -970,7 +971,7 @@ class TestFunctionalWrapperPaths:
         t = jnp.asarray(np.random.RandomState(10).rand(64).astype(np.float32))
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)
         def step(p_, t_):
             rs = run.functional_sync(run.functional_update(r0, p_, t_))  # no explicit axis
             return run.functional_compute(rs)

@@ -1,0 +1,552 @@
+"""Deferred-reduction exactness + lifecycle suite (ISSUE 3).
+
+State sharded per-device along the mesh data axis, updates purely local (zero
+collectives per step), every declared ``dist_reduce_fx`` applied exactly once
+at the read point — must produce bit-for-bit (allclose) the same results as
+the per-step-synced path for every reduction family, survive a mid-epoch
+sharded ``state()``/``load_state`` round-trip, and keep the transactional
+flags (PR 2) consistent under injected faults.
+
+Runs on the 8-fake-device CPU mesh from conftest.py.
+"""
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu import Metric, MetricCollection  # noqa: E402
+from torchmetrics_tpu.classification import (  # noqa: E402
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.ops.executor import (  # noqa: E402
+    make_deferred_collection_step,
+    make_synced_collection_step,
+)
+from torchmetrics_tpu.parallel.sync import (  # noqa: E402
+    reshard_local_state,
+    shard_map_compat,
+    unshard_local_state,
+)
+from torchmetrics_tpu.testing import faults  # noqa: E402
+from torchmetrics_tpu.utils.exceptions import StateCorruptionError  # noqa: E402
+
+NUM_DEVICES = 8
+NUM_CLASSES = 10
+BATCH = 64
+STEPS = 3
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("batch",))
+
+
+def _put(mesh, arr, spec=P("batch")):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _put_state(mesh, states, spec_tree):
+    return jax.device_put(
+        states, jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp), spec_tree)
+    )
+
+
+# ------------------------------------------------------- one metric per family
+class _SumLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+class _MeanRed(Metric):
+    """A state genuinely declared dist_reduce_fx='mean' (pmean at the read point)."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def update(self, x):
+        self.avg = self.avg + x.mean()
+
+    def compute(self):
+        return self.avg
+
+
+class _MaxLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("m", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.m = jnp.maximum(self.m, x.max())
+
+    def compute(self):
+        return self.m
+
+
+class _MinLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("m", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+
+    def update(self, x):
+        self.m = jnp.minimum(self.m, x.min())
+
+    def compute(self):
+        return self.m
+
+
+class _CatSum(Metric):
+    """Fixed-dtype growing 'cat' array state; compute is order-invariant so the
+    device-major vs step-major concat order difference cannot hide errors."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("vals", jnp.zeros((0,), jnp.float32), dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals = jnp.concatenate([self.vals, x.reshape(-1)])
+
+    def compute(self):
+        return self.vals.sum()
+
+
+def _epoch_batches(seed=0, steps=STEPS, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(batch).astype(np.float32)) for _ in range(steps)]
+
+
+def _run_deferred_metric(metric, batches, mesh):
+    """Carry sharded state with zero per-step collectives; reduce+compute once."""
+    spec = metric.sharded_state_spec("batch")
+
+    def local(st, x):
+        return reshard_local_state(metric.functional_update(unshard_local_state(st), x))
+
+    step = jax.jit(shard_map_compat(local, mesh, (spec, P("batch")), spec))
+
+    def read(st):
+        return metric.functional_compute(metric.reduce_sharded_state(st, "batch"))
+
+    st = _put_state(mesh, metric.init_sharded_state(NUM_DEVICES), spec)
+    for x in batches:
+        st = step(st, _put(mesh, x))
+    value = jax.jit(shard_map_compat(read, mesh, (spec,), P()))(st)
+    return st, value
+
+
+def _run_step_synced_metric(metric, batches, mesh):
+    """Per-step-synced comparator: the SAME local carry, but every step pays the
+    sync and computes from the synced state (torchmetrics forward semantics).
+    The last step's value is the epoch value."""
+    spec = metric.sharded_state_spec("batch")
+
+    def body(st, x):
+        st2 = metric.functional_update(unshard_local_state(st), x)
+        synced = metric.functional_sync(st2, "batch")
+        return reshard_local_state(st2), metric.functional_compute(synced)
+
+    step = jax.jit(shard_map_compat(body, mesh, (spec, P("batch")), (spec, P())))
+    st = _put_state(mesh, metric.init_sharded_state(NUM_DEVICES), spec)
+    value = None
+    for x in batches:
+        st, value = step(st, _put(mesh, x))
+    return st, value
+
+
+FAMILIES = [
+    ("sum", _SumLike),
+    ("mean", _MeanRed),
+    ("max", _MaxLike),
+    ("min", _MinLike),
+    ("cat", _CatSum),
+]
+
+
+class TestDeferredExactness:
+    """Deferred compute() == per-step-synced compute() for every family."""
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f for f, _ in FAMILIES])
+    def test_metric_family(self, family, cls):
+        mesh = _mesh()
+        batches = _epoch_batches()
+        _, deferred = _run_deferred_metric(cls(), batches, mesh)
+        _, synced = _run_step_synced_metric(cls(), batches, mesh)
+        np.testing.assert_allclose(np.asarray(deferred), np.asarray(synced), rtol=1e-6)
+
+    @pytest.mark.parametrize(
+        "family,cls", [(f, c) for f, c in FAMILIES if f != "mean"], ids=[f for f, _ in FAMILIES if f != "mean"]
+    )
+    def test_matches_eager_single_device(self, family, cls):
+        """For reductions where per-device grouping is associative-exact, the
+        deferred value equals the plain eager full-batch accumulation."""
+        mesh = _mesh()
+        batches = _epoch_batches()
+        _, deferred = _run_deferred_metric(cls(), batches, mesh)
+        eager = cls(executor=False)
+        for x in batches:
+            eager.update(x)
+        np.testing.assert_allclose(np.asarray(deferred), float(eager.compute()), rtol=1e-5)
+
+    def test_mean_metric_sum_pair_matches_eager(self):
+        """MeanMetric (sum/weight pair) is exact under deferral — the canonical
+        'mean via two sums' pattern."""
+        mesh = _mesh()
+        batches = _epoch_batches()
+        m = tm.MeanMetric()
+        _, deferred = _run_deferred_metric(m, batches, mesh)
+        eager = tm.MeanMetric()
+        for x in batches:
+            eager.update(x)
+        np.testing.assert_allclose(np.asarray(deferred), float(eager.compute()), rtol=1e-6)
+
+
+def _collection(**kw):
+    return MetricCollection(
+        {
+            "confmat": MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+            "precision": MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+            "recall": MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False),
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+        },
+        **kw,
+    )
+
+
+def _cls_batches(seed=0, steps=STEPS, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.randn(batch, NUM_CLASSES).astype(np.float32)),
+            jnp.asarray(rng.randint(0, NUM_CLASSES, batch)),
+        )
+        for _ in range(steps)
+    ]
+
+
+class TestDeferredCollection:
+    """MetricCollection groups: deferred == per-step-synced == eager."""
+
+    def _resolved(self, **kw):
+        coll = _collection(**kw)
+        probe = _cls_batches(seed=99, steps=1, batch=8)[0]
+        coll.resolve_compute_groups(*probe)
+        return coll
+
+    def _eager_values(self, batches):
+        coll = _collection()
+        for lg, tg in batches:
+            coll.update(lg, tg)
+        return coll.compute()
+
+    def test_local_step_matches_synced_and_eager(self):
+        mesh = _mesh()
+        coll = self._resolved(reduce="deferred")
+        batches = _cls_batches()
+        deferred = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        st = deferred.init_states()
+        for lg, tg in batches:
+            st = deferred.local_step(st, _put(mesh, lg), _put(mesh, tg))
+        vals = deferred.reduce(st)
+
+        # per-step-synced comparator: same sharded carry, sync+compute per step
+        spec = coll.sharded_state_spec("batch")
+        step_body, unpack = make_synced_collection_step(coll, axis_name="batch")
+
+        def body(st, lg, tg):
+            local = unshard_local_state(st)
+            st2, packed = step_body(local, lg, tg)
+            return reshard_local_state(st2), packed
+
+        step = jax.jit(shard_map_compat(body, mesh, (spec, P("batch"), P("batch")), (spec, P())))
+        st2 = _put_state(mesh, coll.init_sharded_states(NUM_DEVICES), spec)
+        packed = None
+        for lg, tg in batches:
+            st2, packed = step(st2, _put(mesh, lg), _put(mesh, tg))
+        synced_vals = unpack(packed)
+
+        eager_vals = self._eager_values(batches)
+        for k in eager_vals:
+            np.testing.assert_allclose(
+                np.asarray(vals[k]), np.asarray(synced_vals[k]), rtol=1e-6, err_msg=k
+            )
+            np.testing.assert_allclose(
+                np.asarray(vals[k]), np.asarray(eager_vals[k]), rtol=1e-5, err_msg=k
+            )
+
+    def test_local_epoch_scan_matches_eager(self):
+        """The one-dispatch epoch chunk (lax.scan) — the eval-loop shape —
+        produces the same values as per-step dispatch and eager."""
+        mesh = _mesh()
+        coll = self._resolved(reduce="deferred")
+        batches = _cls_batches(seed=3)
+        deferred = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        lg_e = _put(mesh, jnp.stack([lg for lg, _ in batches]), P(None, "batch"))
+        tg_e = _put(mesh, jnp.stack([tg for _, tg in batches]), P(None, "batch"))
+        st = deferred.local_epoch(deferred.init_states(), lg_e, tg_e)
+        vals = deferred.reduce(st)
+        eager_vals = self._eager_values(batches)
+        for k in eager_vals:
+            np.testing.assert_allclose(
+                np.asarray(vals[k]), np.asarray(eager_vals[k]), rtol=1e-5, err_msg=k
+            )
+
+    def test_make_synced_collection_step_reduce_param(self):
+        """reduce='deferred' on make_synced_collection_step returns the raw
+        (local_step, reduce_step, unpack) bodies; reduce='step' keeps the
+        2-tuple; anything else raises."""
+        coll = self._resolved()
+        assert len(make_synced_collection_step(coll, axis_name="batch")) == 2
+        assert len(make_synced_collection_step(coll, axis_name="batch", reduce="deferred")) == 3
+        with pytest.raises(ValueError, match="reduce"):
+            make_synced_collection_step(coll, axis_name="batch", reduce="bogus")
+
+
+class TestShardedRoundTrip:
+    """Mid-epoch state()/load_state of a sharded state."""
+
+    def _accumulate(self, mesh, metric, batches):
+        return _run_deferred_metric(metric, batches, mesh)
+
+    def test_load_state_sharded_folds_on_compute(self):
+        mesh = _mesh()
+        batches = _epoch_batches(seed=1)
+        m = _SumLike()
+        st, deferred_val = self._accumulate(mesh, m, batches)
+        stacked = {k: np.asarray(v) for k, v in st.items()}
+
+        m2 = _SumLike()
+        m2.load_state(stacked, sharded=True)
+        assert m2.deferred_pending
+        assert m2._pending_shards == NUM_DEVICES
+        np.testing.assert_allclose(float(m2.compute()), np.asarray(deferred_val), rtol=1e-6)
+        assert m2._pending_shards is None  # folded
+        assert m2.executor_status["last_reduce_us"] is not None
+
+    def test_state_export_roundtrips_sharded_marker(self):
+        mesh = _mesh()
+        m = _SumLike()
+        st, _ = self._accumulate(mesh, m, _epoch_batches(seed=2))
+        m2 = _SumLike()
+        m2.load_state({k: np.asarray(v) for k, v in st.items()}, sharded=True)
+        export = m2.state()
+        assert export[Metric._STATE_SHARDS_KEY] == NUM_DEVICES
+        m3 = _SumLike()
+        m3.load_state(export)  # auto-detects the sharded layout
+        assert m3._pending_shards == NUM_DEVICES
+        np.testing.assert_allclose(float(m3.compute()), float(m2.compute()), rtol=1e-6)
+
+    def test_resume_mid_epoch_equals_uninterrupted(self):
+        mesh = _mesh()
+        all_batches = _epoch_batches(seed=4, steps=4)
+        m = _SumLike()
+        spec = m.sharded_state_spec("batch")
+
+        def local(st, x):
+            return reshard_local_state(m.functional_update(unshard_local_state(st), x))
+
+        step = jax.jit(shard_map_compat(local, mesh, (spec, P("batch")), spec))
+
+        def read(st):
+            return m.functional_compute(m.reduce_sharded_state(st, "batch"))
+
+        reader = jax.jit(shard_map_compat(read, mesh, (spec,), P()))
+
+        # first half, checkpoint through load_state, second half
+        st = _put_state(mesh, m.init_sharded_state(NUM_DEVICES), spec)
+        for x in all_batches[:2]:
+            st = step(st, _put(mesh, x))
+        ckpt = {k: np.asarray(v) for k, v in st.items()}
+        m2 = _SumLike()
+        m2.load_state(ckpt, sharded=True)
+        resumed = {
+            k: v for k, v in m2.state().items() if k not in Metric._RESERVED_STATE_KEYS
+        }
+        st2 = _put_state(mesh, {k: jnp.asarray(v) for k, v in resumed.items()}, spec)
+        for x in all_batches[2:]:
+            st2 = step(st2, _put(mesh, x))
+        resumed_val = reader(st2)
+
+        st_full = _put_state(mesh, m.init_sharded_state(NUM_DEVICES), spec)
+        for x in all_batches:
+            st_full = step(st_full, _put(mesh, x))
+        full_val = reader(st_full)
+        np.testing.assert_allclose(np.asarray(resumed_val), np.asarray(full_val), rtol=1e-6)
+
+    def test_sharded_validation_rejects_wrong_trailing_shape(self):
+        m = tm.MeanMetric()
+        good = {
+            k: np.zeros((NUM_DEVICES,) + np.asarray(v).shape, dtype=np.asarray(v).dtype)
+            for k, v in m.init_state().items()
+        }
+        m.load_state(good, sharded=True)  # sanity: stacked layout accepted
+        bad = dict(good)
+        bad["mean_value"] = np.zeros((NUM_DEVICES, 3), np.float32)  # scalar state grew a bogus dim
+        m2 = tm.MeanMetric()
+        with pytest.raises(StateCorruptionError, match="stacked layout"):
+            m2.load_state(bad, sharded=True)
+
+    def test_sharded_validation_rejects_list_states(self):
+        m = tm.CatMetric()  # list state
+        with pytest.raises(StateCorruptionError, match="list state"):
+            m.load_state({"value": [jnp.zeros(3)]}, sharded=True)
+
+    def test_collection_sharded_load(self):
+        mesh = _mesh()
+        coll = _collection()
+        probe = _cls_batches(seed=99, steps=1, batch=8)[0]
+        coll.resolve_compute_groups(*probe)
+        batches = _cls_batches(seed=5)
+        deferred = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        st = deferred.init_states()
+        for lg, tg in batches:
+            st = deferred.local_step(st, _put(mesh, lg), _put(mesh, tg))
+        vals = deferred.reduce(st)
+
+        coll2 = _collection()
+        coll2.resolve_compute_groups(*probe)
+        stacked = {ldr: {k: np.asarray(v) for k, v in fields.items()} for ldr, fields in st.items()}
+        coll2.load_state(stacked, sharded=True)
+        out = coll2.compute()
+        for k in vals:
+            np.testing.assert_allclose(np.asarray(out[k]), np.asarray(vals[k]), rtol=1e-6, err_msg=k)
+
+
+class TestDeferredPolicyOO:
+    """The reduce= knob on the stateful shell + fault interplay (PR 2)."""
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="reduce"):
+            _SumLike(reduce="bogus")
+        with pytest.raises(ValueError, match="deferred"):
+            _SumLike(reduce="deferred", dist_sync_on_step=True)
+        with pytest.raises(ValueError, match="reduce"):
+            _collection(reduce="bogus")
+
+    def test_env_default(self, monkeypatch):
+        from torchmetrics_tpu.parallel.sync import REDUCE_POLICY_ENV
+
+        monkeypatch.setenv(REDUCE_POLICY_ENV, "deferred")
+        assert _SumLike().reduce_policy == "deferred"
+        monkeypatch.setenv(REDUCE_POLICY_ENV, "bogus")
+        with pytest.raises(ValueError, match="TORCHMETRICS_TPU_REDUCE"):
+            _SumLike()
+        monkeypatch.delenv(REDUCE_POLICY_ENV)
+        assert _SumLike().reduce_policy == "step"
+
+    def test_collection_propagates_policy(self):
+        coll = _collection(reduce="deferred")
+        assert all(m.reduce_policy == "deferred" for m in coll.values())
+        assert coll.executor_status["deferred_pending"] is False
+
+    def test_deferred_pending_lifecycle(self):
+        m = _SumLike(reduce="deferred")
+        assert not m.deferred_pending
+        m.update(jnp.ones(4))
+        assert m.deferred_pending
+        status = m.executor_status
+        assert status["deferred_pending"] is True
+        assert "last_reduce_us" in status
+        m.reset()
+        assert not m.deferred_pending
+
+    def test_rollback_restores_deferred_flag(self):
+        """A failed update on a deferred metric leaves state AND the pending
+        flag exactly as they were (fault-containment interplay)."""
+        m = _SumLike(reduce="deferred", executor=False)
+        m.update(jnp.ones(4))
+        before_state = {k: np.asarray(v) for k, v in m.state().items()}
+        assert m.deferred_pending
+        with faults.raise_in_update(m):
+            with pytest.raises(faults.FaultInjected):
+                m.update(jnp.ones(4))
+        assert m.deferred_pending  # flag unchanged
+        after_state = {k: np.asarray(v) for k, v in m.state().items()}
+        for k in before_state:
+            np.testing.assert_array_equal(before_state[k], after_state[k])
+
+    def test_failed_update_after_sharded_load_keeps_fold_consistent(self):
+        """update() on a sharded restore folds first; if the update body then
+        fails, the rollback target is the folded state — flags and values stay
+        consistent (no half-sharded limbo)."""
+        mesh = _mesh()
+        m = _SumLike(executor=False)
+        st, deferred_val = _run_deferred_metric(m, _epoch_batches(seed=6), mesh)
+        m2 = _SumLike(executor=False)
+        m2.load_state({k: np.asarray(v) for k, v in st.items()}, sharded=True)
+        with faults.raise_in_update(m2):
+            with pytest.raises(faults.FaultInjected):
+                m2.update(jnp.ones(4))
+        assert m2._pending_shards is None  # fold committed, update rolled back
+        np.testing.assert_allclose(float(m2.compute()), np.asarray(deferred_val), rtol=1e-6)
+
+    def test_unsync_restores_pending_flag(self):
+        """sync() marks state reduced; unsync() restores the pending flag with
+        the local state (sync_context interplay, docs/SHARDING.md)."""
+        m = _SumLike(reduce="deferred", distributed_available_fn=lambda: True, executor=False)
+        m.update(jnp.ones(4))
+        assert m.deferred_pending
+        m.sync(dist_sync_fn=lambda v, red, axis: v)  # identity "collective"
+        assert not m.deferred_pending
+        m.unsync()
+        assert m.deferred_pending
+
+
+class TestGatherPool:
+    """_gather_with_timeout reuses one module-level worker pool (ISSUE 3
+    satellite): successful gathers share a pool; a timeout retires it."""
+
+    def test_pool_reused_across_successful_gathers(self):
+        from torchmetrics_tpu.parallel import sync as sync_mod
+
+        sync_mod._gather_pool = None
+        orig = sync_mod._process_allgather
+        sync_mod._process_allgather = lambda v: v
+        try:
+            sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)
+            pool1 = sync_mod._gather_pool
+            sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)
+            assert sync_mod._gather_pool is pool1  # same worker, no churn
+        finally:
+            sync_mod._process_allgather = orig
+
+    def test_timeout_retires_parked_pool(self):
+        from torchmetrics_tpu.parallel import sync as sync_mod
+        from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+        sync_mod._gather_pool = None
+        with faults.hang_sync(seconds=1.5):
+            with pytest.raises(SyncTimeoutError):
+                sync_mod._gather_with_timeout(jnp.ones(2), timeout=0.1)
+            # the parked pool was retired: the next bounded gather gets a fresh
+            # worker instead of queueing behind the abandoned one
+            assert sync_mod._gather_pool is None
+            with pytest.raises(SyncTimeoutError):
+                sync_mod._gather_with_timeout(jnp.ones(2), timeout=0.1)

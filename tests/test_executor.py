@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 from torchmetrics_tpu import MeanMetric, MetricCollection
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 from torchmetrics_tpu.aggregation import MaxMetric, SumMetric
 from torchmetrics_tpu.classification import (
     BinaryAccuracy,
@@ -358,12 +359,7 @@ def test_update_inside_jit_falls_through_to_trace():
 def test_synced_step_single_collective_and_parity():
     """The fused synced step folds the whole collection's sync into ONE
     all-reduce per (reduction, dtype) and packs values per dtype."""
-    try:
-        from jax.experimental.shard_map import shard_map
-
-        smap = partial(shard_map, check_rep=False)
-    except ImportError:  # newer jax spells it jax.shard_map / check_vma
-        smap = partial(jax.shard_map, check_vma=False)
+    smap = partial(shard_map_compat, check_vma=False)  # version-portable
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devices = jax.devices("cpu")[:8]

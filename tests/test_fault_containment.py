@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 
 from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 from torchmetrics_tpu.aggregation import MaxMetric
 from torchmetrics_tpu.classification import (
     BinaryAccuracy,
@@ -514,12 +515,7 @@ class TestResumeUnderExecutor:
 
 
 def _smap():
-    try:
-        from jax.experimental.shard_map import shard_map
-
-        return partial(shard_map, check_rep=False)
-    except ImportError:  # newer jax spells it jax.shard_map / check_vma
-        return partial(jax.shard_map, check_vma=False)
+    return partial(shard_map_compat, check_vma=False)  # version-portable
 
 
 class TestFunctionalSyncCountKey:

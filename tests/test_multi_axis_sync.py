@@ -17,6 +17,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 sys.path.insert(0, "/root/repo/tests")
 
 import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu.parallel.sync import shard_map_compat  # noqa: E402
 
 NUM_DEVICES = 8
 
@@ -39,7 +40,7 @@ class TestTwoAxisSync:
         mesh = _mesh_2d()
 
         @partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P("data", "seq"), P("data", "seq")),
             out_specs=P(),
@@ -64,7 +65,7 @@ class TestTwoAxisSync:
         mesh = _mesh_2d()
 
         @partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data", "seq"), out_specs=P(), check_vma=False
+            shard_map_compat, mesh=mesh, in_specs=P("data", "seq"), out_specs=P(), check_vma=False
         )
         def step(v):
             st = metric.functional_update(state0, v)
@@ -82,7 +83,7 @@ class TestTwoAxisSync:
         state0 = metric.init_state()
 
         @partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data", "seq"), out_specs=P(), check_vma=False
+            shard_map_compat, mesh=mesh, in_specs=P("data", "seq"), out_specs=P(), check_vma=False
         )
         def step(v):
             st = metric.functional_update(state0, v)
@@ -106,7 +107,7 @@ class TestTwoAxisSync:
         mesh = _mesh_2d()
 
         @partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P("data", "seq"), P("data", "seq")),
             out_specs=P(),
@@ -168,7 +169,7 @@ class TestFusedSyncConsistency:
 
         states, reds = make_states()
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=(), out_specs=(P(), P()), check_vma=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=(), out_specs=(P(), P()), check_vma=False)
         def both():
             fused = sync_states(states, reds, axis)
             naive = {k: sync_value(v, reds.get(k), axis) for k, v in states.items()}

@@ -1,6 +1,8 @@
-"""Static hygiene gates (ISSUE 2 satellite): no silent broad exception
-handlers may enter torchmetrics_tpu/ — every ``except Exception`` either
-re-raises or records a reason (tools/lint_exceptions.py)."""
+"""Static hygiene gates: no silent broad exception handlers in
+torchmetrics_tpu/ (ISSUE 2, tools/lint_exceptions.py), and no per-step
+collectives inside update-stage functional code (ISSUE 3,
+tools/lint_collectives.py — reductions belong to parallel/sync.py, applied
+per the declared ``dist_reduce_fx`` at the sync/read point)."""
 import importlib.util
 import sys
 from pathlib import Path
@@ -8,13 +10,17 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _load_linter():
-    path = REPO / "tools" / "lint_exceptions.py"
-    spec = importlib.util.spec_from_file_location("lint_exceptions", path)
+def _load_tool(name: str):
+    path = REPO / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules.setdefault("lint_exceptions", mod)
+    sys.modules.setdefault(name, mod)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_linter():
+    return _load_tool("lint_exceptions")
 
 
 def test_no_silent_broad_excepts():
@@ -33,3 +39,30 @@ def test_allowlist_is_exercised():
     for rel, why in linter.ALLOWLIST.items():
         found = linter.lint_file(pkg / rel, rel)
         assert found, f"allowlist entry {rel!r} ({why}) matches no handler — remove it"
+
+
+def test_no_collectives_in_update_stage():
+    """functional/ update-stage code must accumulate locally: a hidden
+    lax.psum/all_gather would re-introduce a per-step rendezvous and break
+    the deferred-reduction contract (zero collectives until the read point)."""
+    linter = _load_tool("lint_collectives")
+    violations, stale = linter.collect_violations(REPO / "torchmetrics_tpu" / "functional")
+    msg = "\n".join(f"{v.path}:{v.line} in {v.func}: {v.snippet}" for v in violations)
+    assert not violations, f"collectives inside update-stage functions (move to parallel/sync.py):\n{msg}"
+    assert not stale, f"stale lint allowlist entries (calls gone — remove them): {stale}"
+
+
+def test_collectives_linter_catches_violations(tmp_path):
+    """The linter actually fires: a synthetic update-stage function calling
+    lax.psum must be flagged (guards against the rule rotting into a no-op)."""
+    linter = _load_tool("lint_collectives")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from jax import lax\n"
+        "def _foo_update(x):\n"
+        "    return lax.psum(x, 'batch')\n"
+        "def _foo_compute(x):\n"
+        "    return lax.psum(x, 'batch')  # compute-stage: allowed\n"
+    )
+    found = linter.lint_file(bad, "bad.py")
+    assert len(found) == 1 and found[0].func == "_foo_update"

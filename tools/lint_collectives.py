@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Static pass: no per-step collectives inside update-stage functional code.
+
+The deferred-reduction work (ISSUE 3) makes the declared ``dist_reduce_fx`` the
+ONLY place cross-device communication is allowed to come from: update-stage
+functions accumulate locally, and ``parallel/sync.py`` applies the reductions
+(fused) at the sync/read point. A ``lax.psum`` hidden inside a
+``_*_update`` helper would silently re-introduce a per-step rendezvous — and
+break the local-accumulation contract ``shard_map``'d deferred loops rely on.
+
+Rule: inside any function of ``torchmetrics_tpu/functional/`` whose name marks
+it as update-stage (``*_update`` / ``_update_*``), calls to the collective
+primitives (``psum``, ``pmean``, ``pmax``, ``pmin``, ``all_gather``,
+``all_to_all``, ``ppermute``, ``pshuffle``, ``axis_index``) are forbidden —
+whether spelled ``lax.psum(...)``, ``jax.lax.psum(...)`` or imported bare.
+Per-step collectives belong only in ``parallel/sync.py``.
+
+Run directly (``python tools/lint_collectives.py``) for a report, or through
+``tests/test_static_checks.py`` where it gates the suite.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+#: collective primitives that imply a cross-device rendezvous (axis_index is
+#: included: update-stage code keying on the device index is a smell — local
+#: accumulation must be rank-agnostic so the deferred fold stays exact)
+COLLECTIVE_NAMES = {
+    "psum",
+    "psum_scatter",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "axis_index",
+}
+
+#: functions whose collective use is deliberate; keys are
+#: "<path relative to functional/>::<function name>", values say why
+ALLOWLIST: dict = {}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    func: str
+    snippet: str
+
+
+def _is_update_stage(name: str) -> bool:
+    return name.endswith("_update") or name.startswith("_update_") or name == "update"
+
+
+def _called_collective(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in COLLECTIVE_NAMES:
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in COLLECTIVE_NAMES:
+        return fn.id
+    return None
+
+
+def lint_file(path: Path, rel: str) -> List[Violation]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [Violation(rel, err.lineno or 0, "<module>", f"syntax error: {err.msg}")]
+    lines = source.splitlines()
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_update_stage(node.name):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _called_collective(sub)
+                if name is not None:
+                    snippet = lines[sub.lineno - 1].strip() if sub.lineno <= len(lines) else ""
+                    out.append(Violation(rel, sub.lineno, node.name, snippet))
+    return out
+
+
+def collect_violations(functional_root: Path):
+    """(violations, stale_allowlist): collectives inside update-stage functions
+    outside the allowlist, and allowlist entries matching nothing anymore."""
+    violations: List[Violation] = []
+    used = set()
+    for path in sorted(functional_root.rglob("*.py")):
+        rel = path.relative_to(functional_root).as_posix()
+        for v in lint_file(path, rel):
+            key = f"{v.path}::{v.func}"
+            if key in ALLOWLIST:
+                used.add(key)
+                continue
+            violations.append(v)
+    stale = sorted(set(ALLOWLIST) - used)
+    return violations, stale
+
+
+def main() -> int:
+    functional_root = Path(__file__).resolve().parent.parent / "torchmetrics_tpu" / "functional"
+    violations, stale = collect_violations(functional_root)
+    for v in violations:
+        print(
+            f"{v.path}:{v.line}: collective in update-stage function {v.func!r}"
+            f" (per-step collectives belong only in parallel/sync.py): {v.snippet}"
+        )
+    for key in stale:
+        print(f"allowlist entry {key!r} ({ALLOWLIST[key]}) matches no call anymore — remove it")
+    if violations or stale:
+        return 1
+    print(f"lint_collectives: clean ({functional_root})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.parallel.sync import sync_states
+from torchmetrics_tpu.parallel.sync import (
+    REDUCE_POLICIES,
+    init_sharded_states,
+    local_accumulate_spec,
+    sync_states,
+    unshard_local_state,
+)
 from torchmetrics_tpu.utils.data import _flatten_dict
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -47,6 +53,12 @@ class MetricCollection:
             (ops/executor.py). ``None`` (default) follows the
             ``TORCHMETRICS_TPU_EXECUTOR`` env flag; ``False`` restores the
             per-metric eager loop (members may still use their own executors).
+        reduce: reduction policy applied to EVERY member: ``"step"`` keeps
+            per-step collective semantics, ``"deferred"`` accumulates locally
+            and applies each declared ``dist_reduce_fx`` exactly once at
+            ``compute()``/``sync()`` time (docs/SHARDING.md). ``None``
+            (default) leaves each member's own policy (which follows the
+            ``TORCHMETRICS_TPU_REDUCE`` env var).
 
     Example:
         >>> import jax.numpy as jnp
@@ -68,6 +80,7 @@ class MetricCollection:
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
         executor: Optional[bool] = None,
+        reduce: Optional[str] = None,
     ) -> None:
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
@@ -78,6 +91,9 @@ class MetricCollection:
             raise ValueError(f"Expected keyword argument `executor` to be a `bool` but got {executor}")
         self._executor_enabled = executor
         self._executor_obj: Optional[Any] = None
+        if reduce is not None and reduce not in REDUCE_POLICIES:
+            raise ValueError(f"Expected keyword argument `reduce` to be one of {REDUCE_POLICIES} but got {reduce}")
+        self.reduce_policy = reduce
         self._modules: Dict[str, Metric] = {}
         self.add_metrics(metrics, *additional_metrics)
 
@@ -102,6 +118,7 @@ class MetricCollection:
         self.__dict__.update(state)
         self.__dict__.setdefault("_executor_obj", None)
         self.__dict__.setdefault("_executor_enabled", None)
+        self.__dict__.setdefault("reduce_policy", None)
 
     # --------------------------------------------------------------- plumbing
     @staticmethod
@@ -160,6 +177,14 @@ class MetricCollection:
                         self._modules[k] = v
         else:
             raise ValueError("Unknown input to MetricCollection.")
+        if self.reduce_policy is not None:
+            for name, m in self._modules.items():
+                if self.reduce_policy == "deferred" and m.dist_sync_on_step:
+                    raise ValueError(
+                        f"Member {name!r} has dist_sync_on_step=True, which conflicts with the"
+                        " collection's reduce='deferred' policy (a per-step sync IS the step policy)"
+                    )
+                m.reduce_policy = self.reduce_policy
         self._groups_checked = False
         if self._enable_compute_groups:
             self._init_compute_groups()
@@ -322,6 +347,10 @@ class MetricCollection:
                 follower._update_count = m0._update_count
                 follower._computed = None
                 follower.__dict__["_state_shared"] = True
+                # followers read the leader's arrays: their deferred-reduction
+                # flags must describe the same (shared) state
+                follower.__dict__["_reduced"] = m0.__dict__.get("_reduced", True)
+                follower.__dict__["_pending_shards"] = m0.__dict__.get("_pending_shards")
         self._state_is_copy = copy
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -358,6 +387,7 @@ class MetricCollection:
                         m0._state = {k: (list(v) if isinstance(v, list) else v) for k, v in batch_state.items()}
                         m0._update_count += 1
                         m0._reduce_states(global_state)
+                        m0._mark_unreduced()
                         m0._computed = None
                         for name, m in members:
                             res[name] = m.functional_compute(batch_state)
@@ -456,6 +486,32 @@ class MetricCollection:
         """Fresh default states, one pytree per compute-group leader."""
         return {cg[0]: self._modules[cg[0]].functional_init() for cg in self._groups.values()}
 
+    # ------------------------------------------------- sharded (deferred) API
+    def init_sharded_states(self, num_shards: int) -> Dict[str, Dict[str, Any]]:
+        """Fresh states in the sharded layout (leading shard axis on every
+        field, one pytree per group leader) — the carry of a deferred-reduction
+        epoch loop (docs/SHARDING.md)."""
+        return init_sharded_states(self.functional_init(), num_shards)
+
+    def sharded_state_spec(self, axis_name: str = "batch") -> Dict[str, Any]:
+        """PartitionSpec pytree partitioning every field's leading shard axis
+        along ``axis_name`` — the ``shard_map`` in/out spec of the collection's
+        local-accumulation step."""
+        return local_accumulate_spec(self.functional_init(), axis_name)
+
+    def reduce_sharded_states(
+        self, states: Dict[str, Dict[str, Any]], axis_name: Optional[Union[str, Sequence[str]]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """The deferred-reduction read point for the whole collection, inside a
+        ``shard_map`` body: drop the local shard axis and run
+        :meth:`functional_sync` once — the cross-group leaf fusion folds every
+        sum-family field of EVERY compute group into one collective rendezvous
+        per (reduction, dtype), instead of one per field per step."""
+        import jax
+
+        with jax.named_scope("tm_tpu.reduce"):
+            return self.functional_sync(unshard_local_state(states), axis_name)
+
     def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
         """Pure update: one leader ``functional_update`` per compute group."""
         out: Dict[str, Dict[str, Any]] = {}
@@ -552,6 +608,7 @@ class MetricCollection:
             "enabled": enabled,
             "engaged": stats["calls"] > 0,
             "fallback_reason": None if enabled is False else stats.get("fallback_reason"),
+            "deferred_pending": any(m.deferred_pending for m in self._modules.values()),
             "stats": stats,
             "members": {name: m.executor_status for name, m in self._modules.items()},
         }
@@ -562,6 +619,7 @@ class MetricCollection:
         update_count: Optional[int] = None,
         validate: str = "strict",
         check_finite: bool = False,
+        sharded: Optional[bool] = None,
     ) -> None:
         """Install leader-keyed state pytrees into every member of each group.
 
@@ -577,7 +635,7 @@ class MetricCollection:
                 sorted(
                     (k, getattr(v, "shape", None), str(getattr(v, "dtype", "")))
                     for k, v in st.items()
-                    if k != Metric._STATE_COUNT_KEY  # reserved count key is not a state field
+                    if k not in Metric._RESERVED_STATE_KEYS  # count/shard markers are not state fields
                 )
             )
 
@@ -612,7 +670,9 @@ class MetricCollection:
             for name in cg:
                 member = self._modules[name]
                 if type(member).load_state is Metric.load_state:
-                    member.load_state(st, update_count=update_count, validate=validate, check_finite=check_finite)
+                    member.load_state(
+                        st, update_count=update_count, validate=validate, check_finite=check_finite, sharded=sharded
+                    )
                 else:
                     # wrappers override load_state with their own layouts (and
                     # signatures); they validate structurally themselves

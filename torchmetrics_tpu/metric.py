@@ -28,6 +28,7 @@ from __future__ import annotations
 import copy
 import functools
 import inspect
+import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
@@ -37,10 +38,15 @@ import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.parallel.sync import (
+    REDUCE_POLICIES,
     Reduction,
+    default_reduce_policy,
     default_sync_timeout,
+    fold_sharded_states,
     host_sync_value,
     in_named_axis_context,
+    init_sharded_states,
+    local_accumulate_spec,
     sync_states,
 )
 from torchmetrics_tpu.utils.data import (
@@ -91,6 +97,13 @@ class Metric:
               ``"raise"`` (default) propagates the error with local state
               intact; ``"local"`` degrades to local-only state with a
               rank-zero warning, flagged via :attr:`last_sync_ok`.
+            - ``reduce``: when the declared ``dist_reduce_fx`` runs:
+              ``"step"`` keeps per-step collective semantics
+              (``dist_sync_on_step`` forwards sync every batch); ``"deferred"``
+              accumulates locally and applies each reduction exactly once, at
+              ``compute()``/``sync()`` time (docs/SHARDING.md). ``None``
+              (default) follows the ``TORCHMETRICS_TPU_REDUCE`` env var
+              (``"step"`` when unset).
 
     Example:
         >>> import jax.numpy as jnp
@@ -153,6 +166,22 @@ class Metric:
         if self.on_sync_failure not in ("raise", "local"):
             raise ValueError(f"Expected keyword argument `on_sync_failure` to be 'raise' or 'local' but got {self.on_sync_failure}")
         self._last_sync_ok = True
+        self.reduce_policy = kwargs.pop("reduce", None)
+        if self.reduce_policy is None:
+            self.reduce_policy = default_reduce_policy()
+        elif self.reduce_policy not in REDUCE_POLICIES:
+            raise ValueError(f"Expected keyword argument `reduce` to be one of {REDUCE_POLICIES} but got {self.reduce_policy}")
+        if self.reduce_policy == "deferred" and self.dist_sync_on_step:
+            raise ValueError(
+                "`reduce='deferred'` defers every collective to compute()/sync() and cannot"
+                " be combined with `dist_sync_on_step=True` (a per-step sync IS the step policy)"
+            )
+        # deferred-reduction bookkeeping: _reduced is False while locally
+        # accumulated state has a pending reduction; _pending_shards is the
+        # shard count of an installed (stacked) sharded state awaiting a fold
+        self._reduced = True
+        self._pending_shards: Optional[int] = None
+        self._last_reduce_us: Optional[float] = None
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -258,8 +287,22 @@ class Metric:
             "enabled": enabled,
             "engaged": stats["calls"] > 0,
             "fallback_reason": None if enabled is False else stats.get("fallback_reason"),
+            # deferred-reduction observability (ISSUE 3): is a reduction still
+            # pending, and how long did the last reduce/sync take on this host
+            "deferred_pending": self.deferred_pending,
+            "last_reduce_us": self.__dict__.get("_last_reduce_us"),
             "stats": stats,
         }
+
+    @property
+    def deferred_pending(self) -> bool:
+        """True while locally-accumulated state still awaits its deferred
+        reduction — either the ``reduce="deferred"`` policy has unreduced
+        updates, or a sharded state was installed (``load_state(...,
+        sharded=True)``) and the fold has not run yet."""
+        if self.__dict__.get("_pending_shards") is not None:
+            return True
+        return self.__dict__.get("reduce_policy") == "deferred" and not self.__dict__.get("_reduced", True)
 
     @property
     def update_called(self) -> bool:
@@ -322,21 +365,68 @@ class Metric:
         snapshot never outlives the call, so donation streaks survive."""
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
 
-    def _rollback(self, state: Dict[str, Any], update_count: int, computed: Any) -> None:
-        """Reinstall a pre-call snapshot after a failed update/forward."""
+    def _rollback(
+        self,
+        state: Dict[str, Any],
+        update_count: int,
+        computed: Any,
+        reduced: Optional[bool] = None,
+        pending_shards: Any = "_keep",
+    ) -> None:
+        """Reinstall a pre-call snapshot after a failed update/forward.
+
+        ``reduced``/``pending_shards`` restore the deferred-reduction flags
+        captured alongside the snapshot, so a failed call on a sharded or
+        locally-accumulated state cannot leave the flags claiming the opposite
+        of what the restored arrays hold; omitted (the default) leaves them
+        untouched for callers that never moved them."""
         object.__setattr__(self, "_state", state)
         # the restored arrays may be aliased by whoever observed the failure
         self.__dict__["_state_escaped"] = True
         self.__dict__["_update_count"] = update_count
         self.__dict__["_computed"] = computed
+        if reduced is not None:
+            self.__dict__["_reduced"] = reduced
+        if pending_shards != "_keep":
+            self.__dict__["_pending_shards"] = pending_shards
+
+    def _fold_pending(self) -> None:
+        """Collapse an installed sharded state (``load_state(..., sharded=True)``)
+        into the reduced layout — the on-demand re-reduce that keeps the OO
+        surface (update/compute/sync) correct after a sharded restore."""
+        shards = self.__dict__.get("_pending_shards")
+        if shards is None:
+            return
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+            folded = fold_sharded_states(
+                {k: jnp.asarray(self._state[k]) for k in self._defaults}, self._reductions
+            )
+        new_state = dict(self._state)
+        new_state.update({k: jnp.asarray(v) for k, v in folded.items()})
+        object.__setattr__(self, "_state", new_state)
+        self.__dict__["_state_escaped"] = True
+        self.__dict__["_pending_shards"] = None
+        self.__dict__["_last_reduce_us"] = round((time.perf_counter() - t0) * 1e6, 1)
+
+    def _mark_unreduced(self) -> None:
+        """Record that state now holds locally-accumulated (unreduced) values;
+        a no-op outside the deferred policy."""
+        if self.__dict__.get("reduce_policy") == "deferred":
+            self.__dict__["_reduced"] = False
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             # transactional contract (docs/ROBUSTNESS.md): any exception out of
             # this call leaves (_state, _update_count, _computed) exactly as
-            # they were before it — no half-mutated accumulators
+            # they were before it — no half-mutated accumulators. A sharded
+            # restore folds first (re-reduce on demand) so the update operates
+            # on reduced-layout arrays; the committed fold is itself a valid
+            # pre-call state, so the rollback target is the folded snapshot.
+            self._fold_pending()
             pre_count, pre_computed = self._update_count, self._computed
+            pre_reduced = self.__dict__.get("_reduced", True)
             self._computed = None
             self._update_count += 1
             ex = self._get_executor()
@@ -344,11 +434,13 @@ class Metric:
                 try:
                     with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
                         if ex.run_update(args, kwargs):
+                            self._mark_unreduced()
                             return
                 except BaseException:
                     # the executor restored _state itself (recovery reference);
                     # only the wrapper bookkeeping needs unwinding
                     self._update_count, self._computed = pre_count, pre_computed
+                    self.__dict__["_reduced"] = pre_reduced
                     raise
             snapshot = self._state_snapshot()
             try:
@@ -359,15 +451,16 @@ class Metric:
                 with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
                     self._update_fn(*args, **kwargs)
             except TypeError as err:
-                self._rollback(snapshot, pre_count, pre_computed)
+                self._rollback(snapshot, pre_count, pre_computed, reduced=pre_reduced)
                 if "got an unexpected keyword argument" in str(err) or "positional argument" in str(err):
                     raise TypeError(
                         f"Encountered an error while calling `update` of {type(self).__name__}: {err}"
                     ) from err
                 raise
             except BaseException:
-                self._rollback(snapshot, pre_count, pre_computed)
+                self._rollback(snapshot, pre_count, pre_computed, reduced=pre_reduced)
                 raise
+            self._mark_unreduced()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -392,6 +485,7 @@ class Metric:
                 )
             if self._computed is not None:
                 return self._computed
+            self._fold_pending()  # sharded restore: re-reduce before sync/compute
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
@@ -419,10 +513,12 @@ class Metric:
         When the executor is enabled, the whole forward — batch-state update,
         batch-value compute, and the global-state merge — runs as ONE compiled
         computation with the accumulated state donated (ops/executor.py)."""
+        self._fold_pending()  # sharded restore: re-reduce before merging batches
         ex = self._get_executor()
         if ex is not None:
             handled, batch_val = ex.run_forward(args, kwargs)
             if handled:
+                self._mark_unreduced()
                 return batch_val
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             return self._forward_full_state_update(*args, **kwargs)
@@ -440,6 +536,7 @@ class Metric:
         """
         pre_state = self._copy_state_dict()
         pre_count, pre_computed = self._update_count, self._computed
+        pre_reduced = self.__dict__.get("_reduced", True)
         try:
             self.update(*args, **kwargs)
             _update_count = self._update_count
@@ -452,8 +549,9 @@ class Metric:
             # restore context
             self._update_count = _update_count
             self._state = cache
+            self._mark_unreduced()  # the restored cache holds local accumulation
         except BaseException:
-            self._rollback(pre_state, pre_count, pre_computed)
+            self._rollback(pre_state, pre_count, pre_computed, reduced=pre_reduced)
             raise
         finally:
             self._to_sync = self.sync_on_compute
@@ -468,6 +566,7 @@ class Metric:
         global_state = self._copy_state_dict()
         _update_count = self._update_count
         pre_computed = self._computed
+        pre_reduced = self.__dict__.get("_reduced", True)
         self.reset()
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
@@ -477,11 +576,13 @@ class Metric:
 
             self._update_count = _update_count + 1
             self._reduce_states(global_state)
+            self._mark_unreduced()  # merged state holds local accumulation again
         except BaseException:
             self._rollback(
                 {k: (list(v) if isinstance(v, list) else v) for k, v in global_state.items()},
                 _update_count,
                 pre_computed,
+                reduced=pre_reduced,
             )
             raise
         finally:
@@ -540,6 +641,7 @@ class Metric:
         """
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
+        self._fold_pending()  # sharded restore: collapse shards before collectives
         axis_name = axis_name if axis_name is not None else self.sync_axis
         # str or sequence of axis names (multi-axis data×sequence sync)
         in_trace = axis_name is not None and in_named_axis_context(axis_name)
@@ -551,18 +653,26 @@ class Metric:
         # built fully before installation, and the cache is cleared on failure
         # so a later sync/unsync cycle starts clean
         self._cache = self._copy_state_dict()
+        t0 = time.perf_counter()
         try:
-            dist_sync_fn = dist_sync_fn or self.dist_sync_fn
-            if dist_sync_fn is not None:
-                self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
-            elif in_trace:
-                self._state = sync_states(self._state, self._reductions, axis_name)
-            else:  # multi-host, outside jit: bounded with a degradation policy
-                self._host_sync_bounded()
+            with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+                dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+                if dist_sync_fn is not None:
+                    self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
+                elif in_trace:
+                    self._state = sync_states(self._state, self._reductions, axis_name)
+                else:  # multi-host, outside jit: bounded with a degradation policy
+                    self._host_sync_bounded()
         except BaseException:
             self._cache = None
             raise
         self._is_synced = True
+        # state now holds globally-reduced values; unsync restores the flag
+        # along with the local state
+        self.__dict__["_reduced_pre_sync"] = self.__dict__.get("_reduced", True)
+        self.__dict__["_reduced"] = True
+        if not in_trace:  # tracer timings are meaningless; record host syncs only
+            self.__dict__["_last_reduce_us"] = round((time.perf_counter() - t0) * 1e6, 1)
 
     def _host_sync_bounded(self) -> None:
         """The ``process_allgather`` path under ``sync_timeout`` /
@@ -605,6 +715,8 @@ class Metric:
         self._state = self._cache
         self._cache = None
         self._is_synced = False
+        # local (pre-sync) state is back: its reduction is pending again
+        self.__dict__["_reduced"] = self.__dict__.pop("_reduced_pre_sync", True)
 
     @contextmanager
     def sync_context(
@@ -643,6 +755,11 @@ class Metric:
     #: reserved state key carrying the update count through state()/load_state
     _STATE_COUNT_KEY = "_update_count"
 
+    #: reserved state key marking a sharded export (value = shard count); set by
+    #: state() while a sharded restore is pending so the export round-trips
+    #: through load_state without the caller re-passing ``sharded=True``
+    _STATE_SHARDS_KEY = "_sharded_shards"
+
     def state(self) -> Dict[str, Any]:
         """The live state as a pytree (entry point of the pure API).
 
@@ -651,10 +768,19 @@ class Metric:
         round-trips it without the caller passing it explicitly; the
         functional entry points strip the key on input, and
         :meth:`merge_states` drops it (it iterates declared states only).
+        While a sharded restore is pending (``load_state(..., sharded=True)``
+        with no fold yet), the export also carries the shard count under
+        ``"_sharded_shards"`` so the stacked layout round-trips losslessly.
         """
         out = self._copy_state_dict()
         out[self._STATE_COUNT_KEY] = int(self._update_count)
+        shards = self.__dict__.get("_pending_shards")
+        if shards is not None:
+            out[self._STATE_SHARDS_KEY] = int(shards)
         return out
+
+    #: reserved (non-state) keys a state() export may carry
+    _RESERVED_STATE_KEYS = (_STATE_COUNT_KEY, _STATE_SHARDS_KEY)
 
     #: reductions under which a state's array shape is invariant across
     #: updates/merges/syncs — the only fields whose shape `validate="strict"`
@@ -705,7 +831,13 @@ class Metric:
             "fields": fields,
         }
 
-    def validate_state(self, state: Dict[str, Any], mode: str = "strict", check_finite: bool = False) -> Dict[str, Any]:
+    def validate_state(
+        self,
+        state: Dict[str, Any],
+        mode: str = "strict",
+        check_finite: bool = False,
+        sharded: bool = False,
+    ) -> Dict[str, Any]:
         """Check a state pytree against this metric's :meth:`state_spec`.
 
         Returns the (possibly cast) state dict; raises
@@ -722,6 +854,11 @@ class Metric:
         ``check_finite=True`` additionally scans floating-point array fields
         for NaN/Inf (one device reduction per float field) — the corrupted
         checkpoint that parses fine but poisons every later merge.
+
+        ``sharded=True`` validates the stacked per-device layout instead
+        (docs/SHARDING.md): every array field carries a leading shard axis, so
+        shape-invariant fields must match ``(N, *declared_shape)`` with the
+        SAME ``N`` across all fields.
         """
         if mode == "off":
             return state
@@ -733,14 +870,20 @@ class Metric:
             )
         spec = self.state_spec()["fields"]
         out: Dict[str, Any] = dict(state)
+        shard_counts: Dict[str, int] = {}
         for name, field_spec in spec.items():
             if name not in state:
                 raise StateCorruptionError(
                     f"{type(self).__name__}: state is missing declared field {name!r}"
-                    f" (has {sorted(k for k in state if k != self._STATE_COUNT_KEY)})"
+                    f" (has {sorted(k for k in state if k not in self._RESERVED_STATE_KEYS)})"
                 )
             value = state[name]
             if field_spec["kind"] == "list":
+                if sharded:
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: field {name!r} is a list state; list states"
+                        " cannot carry a shard axis (sharded=True)"
+                    )
                 if not isinstance(value, (list, tuple)):
                     raise StateCorruptionError(
                         f"{type(self).__name__}: field {name!r} is a list state but the restored"
@@ -756,7 +899,16 @@ class Metric:
                     f" value is a {type(value).__name__}"
                 )
             arr = value if hasattr(value, "shape") and hasattr(value, "dtype") else np.asarray(value)
-            if field_spec["shape_invariant"] and tuple(arr.shape) != field_spec["shape"]:
+            if sharded:
+                if arr.ndim < 1 or (
+                    field_spec["shape_invariant"] and tuple(arr.shape[1:]) != field_spec["shape"]
+                ):
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: sharded field {name!r} has shape {tuple(arr.shape)}"
+                        f" but the stacked layout requires (num_shards, *{field_spec['shape']})"
+                    )
+                shard_counts[name] = int(arr.shape[0])
+            elif field_spec["shape_invariant"] and tuple(arr.shape) != field_spec["shape"]:
                 raise StateCorruptionError(
                     f"{type(self).__name__}: field {name!r} has shape {tuple(arr.shape)} but this"
                     f" metric's state layout requires {field_spec['shape']}"
@@ -772,6 +924,10 @@ class Metric:
                     )
             if check_finite:
                 self._check_field_finite(name, out[name])
+        if sharded and len(set(shard_counts.values())) > 1:
+            raise StateCorruptionError(
+                f"{type(self).__name__}: sharded fields disagree on the shard count: {shard_counts}"
+            )
         return out
 
     def _check_field_finite(self, name: str, value: Any, index: Optional[int] = None) -> None:
@@ -794,6 +950,41 @@ class Metric:
         shared with ``MetricCollection`` and the wrapper family."""
         return self.init_state()
 
+    # ------------------------------------------------- sharded (deferred) API
+    def init_sharded_state(self, num_shards: int) -> Dict[str, Any]:
+        """A fresh state pytree in the sharded layout: every field gains a
+        leading shard axis of size ``num_shards`` (docs/SHARDING.md). Feed it
+        through ``shard_map`` with :meth:`sharded_state_spec` as the state
+        in/out spec and accumulate locally with :meth:`functional_update`
+        (unshard/reshard around the call, or use the executor's
+        ``make_deferred_collection_step`` which does it for you)."""
+        if any(isinstance(v, list) for v in self._defaults.values()):
+            raise TorchMetricsUserError(
+                f"{type(self).__name__} holds list states, which cannot carry a shard axis;"
+                " deferred sharded accumulation needs fixed-shape states"
+            )
+        return init_sharded_states(self.init_state(), num_shards)
+
+    def sharded_state_spec(self, axis_name: Optional[str] = None) -> Dict[str, Any]:
+        """PartitionSpec pytree partitioning every state field's leading shard
+        axis along ``axis_name`` (default :attr:`sync_axis`) — the
+        ``shard_map`` in/out spec of the local-accumulation step."""
+        axis = axis_name or self.sync_axis
+        return local_accumulate_spec(self.init_state(), axis)
+
+    def reduce_sharded_state(
+        self, state: Dict[str, Any], axis_name: Optional[Union[str, Sequence[str]]] = None
+    ) -> Dict[str, Any]:
+        """The deferred-reduction read point for this metric, inside a
+        ``shard_map`` body: drop the local shard axis and apply every declared
+        ``dist_reduce_fx`` exactly once (one fused rendezvous for all
+        sum-family fields via ``sync_states``). Honors ``dist_sync_fn`` and
+        the reserved count key like :meth:`functional_sync`."""
+        from torchmetrics_tpu.parallel.sync import unshard_local_state
+
+        with jax.named_scope("tm_tpu.reduce"):
+            return self.functional_sync(unshard_local_state(state), axis_name)
+
     def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``(state, batch) -> state'``. jit/vmap/shard_map-safe.
 
@@ -806,9 +997,11 @@ class Metric:
             object.__setattr__(
                 self,
                 "_state",
-                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k != self._STATE_COUNT_KEY},
+                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k not in self._RESERVED_STATE_KEYS},
             )
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"), jax.named_scope(
+                f"tm_tpu.update/{type(self).__name__}"
+            ):
                 self._update_fn(*args, **kwargs)
             return self._copy_state_dict()
         finally:
@@ -821,7 +1014,7 @@ class Metric:
             object.__setattr__(
                 self,
                 "_state",
-                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k != self._STATE_COUNT_KEY},
+                {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k not in self._RESERVED_STATE_KEYS},
             )
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
                 return _squeeze_if_scalar(self._compute_fn())
@@ -911,6 +1104,7 @@ class Metric:
         update_count: Optional[int] = None,
         validate: str = "strict",
         check_finite: bool = False,
+        sharded: Optional[bool] = None,
     ) -> None:
         """Install a state pytree as the live state (inverse of :meth:`state`).
 
@@ -932,8 +1126,19 @@ class Metric:
         additionally rejects NaN/Inf float accumulators (adds one reduction
         per float field). Validation is all-or-nothing: on any failure the
         live state is untouched.
+
+        ``sharded=True`` installs a *sharded* state — the stacked per-device
+        layout a deferred-reduction epoch loop carries (docs/SHARDING.md):
+        each array field has a leading shard axis. The stack is kept as-is
+        and folded per the declared reductions on demand (the next
+        ``update``/``compute``/``sync``), so a mid-epoch checkpoint can be
+        pushed straight back onto the mesh without losing per-shard locality.
+        ``None`` (default) auto-detects via the reserved ``"_sharded_shards"``
+        key a sharded :meth:`state` export carries.
         """
-        state = self.validate_state(state, mode=validate, check_finite=check_finite)
+        if sharded is None:
+            sharded = isinstance(state, dict) and state.get(self._STATE_SHARDS_KEY) is not None
+        state = self.validate_state(state, mode=validate, check_finite=check_finite, sharded=sharded)
         carried = state.get(self._STATE_COUNT_KEY)
         if update_count is None and carried is not None:
             update_count = int(np.asarray(carried))
@@ -944,10 +1149,23 @@ class Metric:
                 raise StateCorruptionError(f"state missing field {k!r}")
             v = state[k]
             staged[k] = list(v) if isinstance(v, (list, tuple)) else v
+        num_shards: Optional[int] = None
+        if sharded:
+            for v in staged.values():
+                if not isinstance(v, list) and getattr(jnp.asarray(v), "ndim", 0) >= 1:
+                    num_shards = int(jnp.asarray(v).shape[0])
+                    break
+            if num_shards is None:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: sharded=True but no array field carries a shard axis"
+                )
         self._state.update(staged)
         self.__dict__["_state_escaped"] = True  # installed arrays have external aliases
         self._computed = None
         self._update_count = self._restored_count(update_count)
+        self.__dict__["_pending_shards"] = num_shards
+        if sharded:
+            self.__dict__["_reduced"] = False
 
     @staticmethod
     def _restored_count(update_count: Optional[int], fallback: int = 1) -> int:
@@ -973,6 +1191,8 @@ class Metric:
         self.__dict__["_state_escaped"] = True
         self._cache = None
         self._is_synced = False
+        self.__dict__["_reduced"] = True  # nothing accumulated, nothing pending
+        self.__dict__["_pending_shards"] = None
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference metric.py:696-698)."""
@@ -1111,6 +1331,10 @@ class Metric:
         self.__dict__.setdefault("sync_timeout", None)
         self.__dict__.setdefault("on_sync_failure", "raise")
         self.__dict__.setdefault("_last_sync_ok", True)
+        self.__dict__.setdefault("reduce_policy", default_reduce_policy())
+        self.__dict__.setdefault("_reduced", True)
+        self.__dict__.setdefault("_pending_shards", None)
+        self.__dict__.setdefault("_last_reduce_us", None)
         self._state = {
             k: ([jnp.asarray(el) for el in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
         }

@@ -569,11 +569,15 @@ class MetricExecutor(_ExecutorBase):
         oracle = m.functional_update(state, *args, **kwargs) if do_probe else None
 
         try:
-            if padded:
-                new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
-                self.stats["padded_calls"] += 1
-            else:
-                new_state = fn(state_in, *call_leaves)
+            # profiler span naming the metric so wall time attributes to it
+            # (ISSUE 3 observability; the traced body carries matching
+            # jax.named_scope annotations via functional_update)
+            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+                if padded:
+                    new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
+                    self.stats["padded_calls"] += 1
+                else:
+                    new_state = fn(state_in, *call_leaves)
         except Exception as err:
             if fresh:
                 raise  # trace/compile failure: live state was never at risk
@@ -655,11 +659,12 @@ class MetricExecutor(_ExecutorBase):
 
         count_arr = jnp.asarray(count, jnp.int32)
         try:
-            if padded:
-                new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
-                self.stats["padded_calls"] += 1
-            else:
-                new_state, value = fn(state_in, count_arr, *call_leaves)
+            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+                if padded:
+                    new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
+                    self.stats["padded_calls"] += 1
+                else:
+                    new_state, value = fn(state_in, count_arr, *call_leaves)
         except Exception as err:
             if fresh:
                 raise  # trace/compile failure: live state was never at risk
@@ -826,6 +831,7 @@ class CollectionExecutor(_ExecutorBase):
         object.__setattr__(m0, "_state", dict(new_state))
         if bump_count:
             m0._update_count += 1
+            m0._mark_unreduced()  # fresh local accumulation under reduce="deferred"
         m0._computed = None
         for name in cg:
             mm = mods[name]
@@ -890,11 +896,12 @@ class CollectionExecutor(_ExecutorBase):
             }
 
         try:
-            if padded:
-                new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
-                self.stats["padded_calls"] += 1
-            else:
-                new_states = fn(states, *call_leaves)
+            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+                if padded:
+                    new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
+                    self.stats["padded_calls"] += 1
+                else:
+                    new_states = fn(states, *call_leaves)
         except Exception as err:
             if fresh:
                 raise  # trace/compile failure: every group's input was a copy
@@ -1007,11 +1014,12 @@ class CollectionExecutor(_ExecutorBase):
             oracle = (oracle_states, oracle_values)
 
         try:
-            if padded:
-                new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
-                self.stats["padded_calls"] += 1
-            else:
-                new_states, values = fn(states, counts, *call_leaves)
+            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+                if padded:
+                    new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
+                    self.stats["padded_calls"] += 1
+                else:
+                    new_states, values = fn(states, counts, *call_leaves)
         except Exception as err:
             if fresh:
                 raise  # trace/compile failure: every group's input was a copy
@@ -1079,7 +1087,9 @@ def make_value_packer(example_values: Any):
     return pack, unpack
 
 
-def make_synced_collection_step(collection: Any, axis_name: str = "batch", pack_values: bool = True):
+def make_synced_collection_step(
+    collection: Any, axis_name: str = "batch", pack_values: bool = True, reduce: str = "step"
+):
     """Fused ``(states, *batch) -> (states', packed_values)`` synced step.
 
     Meant to be wrapped in the caller's ``shard_map``/``jit`` over a mesh
@@ -1090,7 +1100,20 @@ def make_synced_collection_step(collection: Any, axis_name: str = "batch", pack_
     computed leaves per dtype. Returns ``(step, unpack)`` where ``unpack``
     (host-side) restores the values dict from the packed output; it is built
     lazily on the first call's structure when ``pack_values`` is True.
+
+    With ``reduce="deferred"`` the per-step collectives disappear entirely and
+    the return becomes ``(local_step, reduce_step, unpack)``: ``local_step``
+    accumulates into *sharded* state (leading shard axis, spec
+    ``collection.sharded_state_spec(axis_name)``) with ZERO collectives, and
+    ``reduce_step(states) -> packed_values`` applies every declared
+    ``dist_reduce_fx`` exactly once — the read point of the deferred policy
+    (docs/SHARDING.md). :func:`make_deferred_collection_step` wraps the pair
+    in ``shard_map``/``jit`` (donation intact) for you.
     """
+    if reduce == "deferred":
+        return _make_deferred_bodies(collection, axis_name, pack_values)
+    if reduce != "step":
+        raise ValueError(f"reduce must be 'step' or 'deferred', got {reduce!r}")
     box: Dict[str, Any] = {}
 
     def step(states, *args, **kwargs):
@@ -1109,6 +1132,175 @@ def make_synced_collection_step(collection: Any, axis_name: str = "batch", pack_
         return box["unpack"](packed)
 
     return step, unpack
+
+
+def _make_deferred_bodies(collection: Any, axis_name: str, pack_values: bool):
+    """(local_step, reduce_step, unpack) raw bodies for the deferred policy;
+    both are meant to run inside the caller's ``shard_map`` with the state
+    spec from ``collection.sharded_state_spec(axis_name)``."""
+    from torchmetrics_tpu.parallel.sync import reshard_local_state, unshard_local_state
+
+    box: Dict[str, Any] = {}
+
+    def local_step(states, *args, **kwargs):
+        # purely local accumulation: unshard -> update -> reshard, no collectives
+        with jax.named_scope("tm_tpu.update"):
+            local = collection.functional_update(unshard_local_state(states), *args, **kwargs)
+        return reshard_local_state(local)
+
+    def reduce_step(states):
+        # the single deferred rendezvous: one fused collective per
+        # (reduction, dtype) for the whole collection, then compute
+        synced = collection.reduce_sharded_states(states, axis_name)
+        values = collection.functional_compute(synced)
+        if pack_values:
+            if "pack" not in box:
+                box["pack"], box["unpack"] = make_value_packer(values)
+            values = box["pack"](values)
+        return values
+
+    def unpack(packed):
+        if not pack_values:
+            return packed
+        return box["unpack"](packed)
+
+    return local_step, reduce_step, unpack
+
+
+class DeferredCollectionStep:
+    """Compiled deferred-reduction drivers for one collection on one mesh
+    (built by :func:`make_deferred_collection_step`; see docs/SHARDING.md).
+
+    State lives *sharded per-device* along the mesh data axis; the hot loop
+    pays zero collectives, and every declared ``dist_reduce_fx`` runs exactly
+    once at the read point:
+
+    - :meth:`init_states` — fresh sharded states placed on the mesh.
+    - :meth:`local_step` — ``(states, *batch) -> states'``: ONE compiled
+      dispatch of purely local accumulation, state pytree **donated**.
+    - :meth:`local_epoch` — ``(states, *stacked) -> states'``: a whole chunk
+      of steps (leading axis = steps) folded into ONE dispatch via
+      ``lax.scan``. Because no step carries a rendezvous, devices run the
+      entire chunk decoupled — this is the MapReduce shape (DrJAX) that makes
+      epoch-style eval loops run at unsynced speed.
+    - :meth:`reduce` — ``states -> values``: the separately cached read-point
+      executable; one fused collective per (reduction, dtype) for the whole
+      collection, then every metric's compute.
+    """
+
+    def __init__(self, collection: Any, mesh: Any, axis_name: str, pack_values: bool, batch_specs: Any, donate: bool) -> None:
+        self._coll = collection
+        self._mesh = mesh
+        self._axis = axis_name
+        self._batch_specs = batch_specs
+        self._donate = donate
+        self._local_body, self._reduce_body, self._unpack = _make_deferred_bodies(
+            collection, axis_name, pack_values
+        )
+        self._state_spec = collection.sharded_state_spec(axis_name)
+        self._compiled: Dict[Any, Callable] = {}
+
+    def _b_specs(self, batch):
+        from jax.sharding import PartitionSpec as P
+
+        if self._batch_specs is not None:
+            return tuple(self._batch_specs)
+        return tuple(P(self._axis) for _ in batch)
+
+    def _epoch_specs(self, batch):
+        # stacked chunk: leading axis is steps (unsharded), batch dim next
+        from jax.sharding import PartitionSpec as P
+
+        if self._batch_specs is not None:
+            return tuple(P(None, *sp) for sp in self._batch_specs)
+        return tuple(P(None, self._axis) for _ in batch)
+
+    def init_states(self):
+        from jax.sharding import NamedSharding
+
+        states = self._coll.init_sharded_states(len(self._mesh.devices.flatten()))
+        shardings = jax.tree_util.tree_map(lambda sp: NamedSharding(self._mesh, sp), self._state_spec)
+        return jax.device_put(states, shardings)
+
+    def _get(self, key, builder):
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = builder()
+            self._compiled[key] = fn
+        return fn
+
+    def local_step(self, states, *batch):
+        from torchmetrics_tpu.parallel.sync import shard_map_compat
+
+        def build():
+            mapped = shard_map_compat(
+                self._local_body, self._mesh, (self._state_spec,) + self._b_specs(batch), self._state_spec
+            )
+            return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
+
+        fn = self._get(("local", len(batch)), build)
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{type(self._coll).__name__}"):
+            return fn(states, *batch)
+
+    def local_epoch(self, states, *stacked):
+        from torchmetrics_tpu.parallel.sync import shard_map_compat, reshard_local_state, unshard_local_state
+
+        def build():
+            def epoch_body(st, *chunk):
+                local = unshard_local_state(st)
+
+                def one(carry, batch):
+                    return self._coll.functional_update(carry, *batch), None
+
+                with jax.named_scope("tm_tpu.update"):
+                    out, _ = jax.lax.scan(one, local, tuple(chunk))
+                return reshard_local_state(out)
+
+            mapped = shard_map_compat(
+                epoch_body, self._mesh, (self._state_spec,) + self._epoch_specs(stacked), self._state_spec
+            )
+            return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
+
+        fn = self._get(("epoch", len(stacked)), build)
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{type(self._coll).__name__}"):
+            return fn(states, *stacked)
+
+    def reduce(self, states):
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import shard_map_compat
+
+        def build():
+            # values are replicated after the fused collectives; out_specs=P()
+            return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
+
+        fn = self._get("reduce", build)
+        with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+            return self._unpack(fn(states))
+
+
+def make_deferred_collection_step(
+    collection: Any,
+    mesh: Any,
+    axis_name: str = "batch",
+    pack_values: bool = True,
+    batch_specs: Any = None,
+    donate: bool = True,
+) -> DeferredCollectionStep:
+    """Compile the deferred-reduction epoch loop for ``collection`` on ``mesh``.
+
+    Returns a :class:`DeferredCollectionStep` whose ``local_step`` (one batch
+    per dispatch) and ``local_epoch`` (a stacked chunk of steps per dispatch,
+    scanned) accumulate into sharded state with ZERO per-step collectives and
+    the state pytree donated; ``reduce`` applies every declared
+    ``dist_reduce_fx`` exactly once (one fused rendezvous per
+    (reduction, dtype) for the whole collection) — call it at
+    compute()/epoch end.
+
+    ``batch_specs`` gives the PartitionSpec(s) of the per-batch arguments
+    (default: every argument sharded along ``axis_name`` on its leading dim).
+    """
+    return DeferredCollectionStep(collection, mesh, axis_name, pack_values, batch_specs, donate)
 
 
 def executor_stats(obj: Any) -> Dict[str, Any]:

@@ -31,6 +31,26 @@ _VALID_REDUCTIONS = ("sum", "mean", "max", "min", "cat")
 #: env var holding the fleet-wide default host-sync bound (seconds, float)
 SYNC_TIMEOUT_ENV = "TORCHMETRICS_TPU_SYNC_TIMEOUT"
 
+#: env var holding the fleet-wide default reduction policy ("step" | "deferred")
+REDUCE_POLICY_ENV = "TORCHMETRICS_TPU_REDUCE"
+
+REDUCE_POLICIES = ("step", "deferred")
+
+
+def default_reduce_policy() -> str:
+    """The environment-configured reduction policy (``TORCHMETRICS_TPU_REDUCE``).
+
+    ``"step"`` (default) keeps the per-step collective semantics; ``"deferred"``
+    accumulates locally and applies each state's declared ``dist_reduce_fx``
+    exactly once, at ``compute()``/``sync()`` time (docs/SHARDING.md).
+    """
+    raw = os.environ.get(REDUCE_POLICY_ENV, "").strip().lower()
+    if not raw:
+        return "step"
+    if raw not in REDUCE_POLICIES:
+        raise ValueError(f"{REDUCE_POLICY_ENV} must be one of {REDUCE_POLICIES}, got {raw!r}")
+    return raw
+
 
 def default_sync_timeout() -> Optional[float]:
     """The environment-configured host-sync timeout, or None (unbounded)."""
@@ -52,6 +72,13 @@ def _process_allgather(value: Any) -> Any:
     return multihost_utils.process_allgather(value)
 
 
+#: the shared single-worker pool for bounded gathers — one thread serves every
+#: successful sync instead of a fresh ThreadPoolExecutor per call; retired (and
+#: lazily replaced) only when a timeout leaves its worker parked on an
+#: abandoned gather, so repeated timeouts never accumulate live pools
+_gather_pool: Optional[Any] = None
+
+
 def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
     """``process_allgather`` bounded by ``timeout`` seconds.
 
@@ -64,23 +91,28 @@ def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
     """
     if timeout is None:
         return _process_allgather(value)
+    global _gather_pool
     from concurrent.futures import ThreadPoolExecutor
     from concurrent.futures import TimeoutError as _FutTimeout
 
     # deferred: utils/__init__ itself imports from this module (reduce/class_reduce)
     from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 
-    pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tm_tpu_sync")
+    pool = _gather_pool
+    if pool is None:
+        pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tm_tpu_sync")
+        _gather_pool = pool
+    fut = pool.submit(_process_allgather, value)
     try:
-        fut = pool.submit(_process_allgather, value)
-        try:
-            return fut.result(timeout=timeout)
-        except _FutTimeout:
-            raise SyncTimeoutError(
-                f"multi-host state sync (process_allgather) did not complete within {timeout}s"
-            ) from None
-    finally:
+        return fut.result(timeout=timeout)
+    except _FutTimeout:
+        # the worker is now parked on the abandoned gather: retire this pool so
+        # the next sync starts with a free worker instead of queueing behind it
+        _gather_pool = None
         pool.shutdown(wait=False)
+        raise SyncTimeoutError(
+            f"multi-host state sync (process_allgather) did not complete within {timeout}s"
+        ) from None
 
 
 def in_named_axis_context(axis_name: Union[str, Sequence[str]]) -> bool:
@@ -170,6 +202,31 @@ def sync_states(
     return out
 
 
+def reduce_stacked(gathered: Any, reduction: Reduction) -> Any:
+    """Collapse the leading rank/shard axis of a stacked value per the declared
+    reduction — the shared read-point fold behind :func:`host_sync_value` (the
+    post-allgather reduce) and :func:`fold_sharded_states` (the out-of-mesh
+    deferred reduce).
+
+    ``gathered`` is reduced as-is (np OR jnp): single-process
+    ``process_allgather`` returns scalars 0-d, which numpy's legacy
+    out-of-bounds-axis tolerance reduces as a no-op — coercing to jnp here
+    would turn that path into a ValueError."""
+    if reduction == "sum":
+        return gathered.sum(0)
+    if reduction == "mean":
+        return gathered.mean(0)
+    if reduction == "max":
+        return gathered.max(0)
+    if reduction == "min":
+        return gathered.min(0)
+    if reduction == "cat":
+        return gathered.reshape((-1,) + gathered.shape[2:])
+    if callable(reduction):
+        return reduction(gathered)
+    return gathered
+
+
 def host_sync_value(value: Any, reduction: Reduction, timeout: Optional[float] = None) -> Any:
     """Multi-host (DCN) sync outside jit via process_allgather, then local reduce.
 
@@ -185,21 +242,100 @@ def host_sync_value(value: Any, reduction: Reduction, timeout: Optional[float] =
             return value
         value = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0)
     gathered = _gather_with_timeout(value, timeout)  # (world, *shape)
-    if reduction == "sum":
-        out = gathered.sum(0)
-    elif reduction == "mean":
-        out = gathered.mean(0)
-    elif reduction == "max":
-        out = gathered.max(0)
-    elif reduction == "min":
-        out = gathered.min(0)
-    elif reduction == "cat":
-        out = gathered.reshape((-1,) + gathered.shape[2:])
-    elif callable(reduction):
-        out = reduction(gathered)
-    else:
-        out = gathered
+    out = reduce_stacked(gathered, reduction)
     return [out] if is_list else out
+
+
+# ---------------------------------------------------------------------------
+# Deferred reduction: sharded per-device state, reduced once at the read point
+# ---------------------------------------------------------------------------
+#
+# The per-step-synced path pays one (fused) collective rendezvous every batch.
+# Under the deferred policy, state instead lives SHARDED along the mesh data
+# axis: every leaf carries a leading shard axis (size 1 inside a shard_map
+# body, ``num_shards`` in the global stacked view), updates are purely local
+# (zero collectives), and the declared ``dist_reduce_fx`` is applied exactly
+# once — at compute()/sync() — via the same grouped-psum fusion sync_states
+# already performs. See docs/SHARDING.md.
+
+
+def shard_map_compat(
+    body: Callable, mesh: Any, in_specs: Any, out_specs: Any, check_vma: bool = False
+) -> Callable:
+    """``shard_map`` across jax versions: ``jax.shard_map(check_vma=...)`` on
+    new releases, ``jax.experimental.shard_map(check_rep=...)`` on <=0.4.
+    ``check_vma`` keeps the new-API spelling (metric sync bodies generally
+    need it off: all_gather outputs are replicated but not statically
+    provable)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma)
+
+
+def local_accumulate_spec(states: Any, axis_name: str = "batch") -> Any:
+    """PartitionSpec pytree for sharded metric state under ``shard_map``.
+
+    Every array leaf is partitioned along ``axis_name`` on its leading shard
+    axis — the in/out spec of the local-accumulation step. Use with states
+    produced by :func:`init_sharded_states` (or carried out of a previous
+    local step).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(axis_name), states)
+
+
+def init_sharded_states(init: Any, num_shards: int) -> Any:
+    """Stack a fresh (replicated) state pytree into the sharded layout: each
+    leaf gains a leading shard axis of size ``num_shards``, every shard holding
+    the default value (the identity element of its declared reduction)."""
+    return jax.tree_util.tree_map(
+        lambda v: jnp.broadcast_to(jnp.asarray(v)[None], (num_shards,) + jnp.asarray(v).shape), init
+    )
+
+
+def unshard_local_state(state: Any) -> Any:
+    """Drop the leading shard axis inside a ``shard_map`` body (local size 1),
+    yielding the plain per-device state ``functional_update`` expects."""
+    return jax.tree_util.tree_map(lambda v: jnp.squeeze(jnp.asarray(v), axis=0), state)
+
+
+def reshard_local_state(state: Any) -> Any:
+    """Re-add the leading shard axis after a local update so the result maps
+    back through the ``local_accumulate_spec`` out-spec."""
+    return jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], state)
+
+
+def reduce_sharded_states(
+    states: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: Union[str, Sequence[str]]
+) -> Dict[str, Any]:
+    """The deferred-reduction read point: apply every declared ``dist_reduce_fx``
+    exactly once over locally-accumulated shards.
+
+    Meant to run inside a ``shard_map`` body whose state in-spec is
+    :func:`local_accumulate_spec`: each field arrives with its local shard axis
+    (size 1), is unsharded, and the whole dict goes through
+    :func:`sync_states` — so all sum-family fields of a metric (or, via
+    ``MetricCollection.functional_sync``, a whole collection) still share ONE
+    fused collective rendezvous. Returns replicated (reduced) states without
+    the shard axis.
+    """
+    with jax.named_scope("tm_tpu.reduce"):
+        return sync_states(unshard_local_state(states), reductions, axis_name)
+
+
+def fold_sharded_states(states: Dict[str, Any], reductions: Dict[str, Reduction]) -> Dict[str, Any]:
+    """Out-of-mesh fold of a host-fetched sharded state (global stacked view,
+    leading axis = num_shards): collapse the shard axis per declared reduction.
+
+    This is what ``Metric.load_state(..., sharded=True)`` uses to re-reduce on
+    demand — the same arithmetic :func:`reduce_sharded_states` performs with
+    collectives, run on the gathered stack instead.
+    """
+    with jax.named_scope("tm_tpu.reduce"):
+        return {k: reduce_stacked(v, reductions.get(k)) for k, v in states.items()}
 
 
 # ---------------------------------------------------------------------------
