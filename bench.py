@@ -584,6 +584,38 @@ def bench_config2():
     ours_deferred = 1.0 / (per_epoch_step + per_reduce / EPOCH_STEPS)
     ours_deferred_dispatch = 1.0 / (per_dispatch_step + per_reduce / EPOCH_STEPS)
 
+    # autosave-overhead row (ISSUE 4): one durable snapshot of the sharded
+    # epoch state per epoch (io/checkpoint.py Autosaver architecture). The
+    # HOT LOOP pays only the forced host-side copy of the state — manifest
+    # building, sha256 hashing, and the atomic fsync'd write all run on the
+    # Autosaver's background worker, overlapped with the next chunk's compute
+    # — so the overhead row amortizes the copy, and the full synchronous
+    # pipeline cost is reported separately (autosave_sync_us) for the
+    # preemption-flush / background-saturation budget. Acceptance:
+    # autosave_overhead_pct < 5.
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from torchmetrics_tpu.io import save_state as _save_state
+    from torchmetrics_tpu.io.checkpoint import host_copy_tree as _host_copy
+
+    ckpt_dir = _tempfile.mkdtemp(prefix="tm_tpu_bench_ckpt_")
+    try:
+        st_save = deferred.local_step(deferred.init_states(), logits, target)
+        per_copy = _time_host(lambda: _host_copy(st_save), steps=10, warmup=1)
+        _save_state(coll, ckpt_dir, states=st_save, keep=2, sharded=True)  # warm path
+        per_save = _time_host(
+            lambda: _save_state(coll, ckpt_dir, states=st_save, keep=2, sharded=True),
+            steps=10,
+            warmup=1,
+        )
+    finally:
+        _shutil.rmtree(ckpt_dir, ignore_errors=True)
+    ours_deferred_autosave = 1.0 / (per_epoch_step + (per_reduce + per_copy) / EPOCH_STEPS)
+    autosave_overhead_pct = 100.0 * (per_copy / EPOCH_STEPS) / (
+        per_epoch_step + per_reduce / EPOCH_STEPS
+    )
+
     # same-work row: BOTH sides single-device, unsynced, update+compute — the
     # headline row above carries sync work the reference baseline cannot do
     # single-host, so this row is the symmetric comparison (VERDICT r4 weak #7)
@@ -656,6 +688,15 @@ def bench_config2():
         "deferred_reduce_us": round(per_reduce * 1e6, 1),
         "gap_deferred_vs_unsynced": round(ours_unsynced / ours_deferred, 2),
         "gap_deferred_dispatch_vs_unsynced": round(ours_unsynced / ours_deferred_dispatch, 2),
+        # durable-checkpoint rows (ISSUE 4 acceptance: autosave_overhead_pct
+        # < 5): one rotating-store snapshot of the sharded epoch state per
+        # 30-step epoch; the hot loop pays only the host copy
+        # (autosave_copy_us), the fsync'd atomic write runs on the background
+        # worker (full synchronous pipeline = autosave_sync_us)
+        "value_deferred_autosave": round(ours_deferred_autosave, 2),
+        "autosave_copy_us": round(per_copy * 1e6, 1),
+        "autosave_sync_us": round(per_save * 1e6, 1),
+        "autosave_overhead_pct": round(autosave_overhead_pct, 2),
     }
 
 

@@ -1,0 +1,616 @@
+"""Durability & preemption-resilience chaos suite (ISSUE 4).
+
+The acceptance property: for every state family (sum/mean/max/min/cat) and
+both ``reduce="step"|"deferred"``, a run preempted at an arbitrary update and
+restored from the last autosave computes EXACTLY what an uninterrupted run
+over the same prefix of batches computes; torn/corrupt snapshots are detected
+(typed error) and skipped in favor of the previous valid one, never silently
+installed. Plus: retry-then-succeed sync, warm-dispatch retry, the stall
+watchdog, the gather-worker leak regression, and the per-shard check_finite
+regression.
+
+Runs on the 8-fake-device CPU mesh from conftest.py.
+"""
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import Metric, MetricCollection
+from torchmetrics_tpu.io import (
+    Autosaver,
+    RetryPolicy,
+    backoff_delays,
+    call_with_retries,
+    install_preemption_handler,
+    load_manifest,
+    restore_state,
+    save_state,
+    stall_watchdog,
+)
+from torchmetrics_tpu.io import retry as retry_mod
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step
+from torchmetrics_tpu.testing import faults
+from torchmetrics_tpu.utils.exceptions import (
+    CheckpointCorruptionError,
+    DispatchStallError,
+    StateCorruptionError,
+    SyncTimeoutError,
+)
+
+NUM_DEVICES = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("batch",))
+
+
+# ------------------------------------------------------------- state families
+
+class _SumLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+#: (name, constructor) — the real aggregation metrics cover each declared
+#: reduction family including the list-growing "cat" state; _SumLike covers
+#: the executor-eligible path (the aggregators self-declare untraceable)
+FAMILIES = [
+    ("sum", tm.SumMetric),
+    ("mean", tm.MeanMetric),
+    ("max", tm.MaxMetric),
+    ("min", tm.MinMetric),
+    ("cat", tm.CatMetric),
+    ("sum_executor", _SumLike),
+]
+
+
+def _batches(n, seed=0):
+    r = np.random.RandomState(seed)
+    return [jnp.asarray(r.randn(16).astype(np.float32)) for _ in range(n)]
+
+
+def _values_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# atomic snapshot store
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicSnapshotStore:
+    def test_single_file_roundtrip(self, tmp_path):
+        m = _SumLike()
+        for b in _batches(4):
+            m.update(b)
+        path = str(tmp_path / "snap.ckpt")
+        assert save_state(m, path) == path
+        m2 = _SumLike()
+        info = restore_state(path, m2)
+        _values_equal(m2.compute(), m.compute())
+        assert m2.update_count == 4
+        assert info["path"] == path and info["fallbacks_skipped"] == 0
+
+    def test_manifest_contents(self, tmp_path):
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        man = load_manifest(path)
+        assert man["kind"] == "metric" and man["class"] == "_SumLike"
+        assert man["update_count"] == 1
+        assert man["spec"]["fields"]["total"]["reduction"] == "sum"
+        assert man["mesh"]["device_count"] == jax.device_count()
+        assert len(man["leaves"]) == 1 and man["leaves"][0]["sha256"]
+
+    def test_list_state_roundtrip(self, tmp_path):
+        m = tm.CatMetric()
+        m.update(jnp.asarray([1.0, 2.0]))
+        m.update(jnp.asarray([3.0]))
+        path = str(tmp_path / "cat.ckpt")
+        save_state(m, path)
+        m2 = tm.CatMetric()
+        restore_state(path, m2)
+        _values_equal(m2.compute(), m.compute())
+
+    def test_collection_roundtrip_with_compute_groups(self, tmp_path):
+        from torchmetrics_tpu.classification import MulticlassF1Score, MulticlassRecall
+
+        coll = MetricCollection([MulticlassF1Score(num_classes=3), MulticlassRecall(num_classes=3)])
+        r = np.random.RandomState(0)
+        for _ in range(3):
+            coll.update(jnp.asarray(r.randint(0, 3, 16)), jnp.asarray(r.randint(0, 3, 16)))
+        expected = coll.compute()
+        path = str(tmp_path / "coll.ckpt")
+        save_state(coll, path)
+        coll2 = MetricCollection([MulticlassF1Score(num_classes=3), MulticlassRecall(num_classes=3)])
+        restore_state(path, coll2)
+        got = coll2.compute()
+        assert set(got) == set(expected)
+        for k in expected:
+            _values_equal(got[k], expected[k])
+
+    def test_wrong_class_rejected(self, tmp_path):
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        with pytest.raises(StateCorruptionError):
+            restore_state(path, tm.MaxMetric())
+
+    @pytest.mark.parametrize("mode", ["truncate", "zero", "flip"])
+    def test_torn_write_detected(self, tmp_path, mode):
+        """Every torn-write signature raises the typed error and leaves the
+        restore target untouched — damage is never silently installed."""
+        m = _SumLike()
+        for b in _batches(3):
+            m.update(b)
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        faults.torn_write(path, mode=mode)
+        m2 = _SumLike()
+        m2.update(jnp.asarray([7.0]))
+        before = float(m2.compute())
+        with pytest.raises(CheckpointCorruptionError):
+            restore_state(path, m2)
+        assert float(m2.compute()) == before  # untouched
+
+    def test_rotating_store_falls_back_past_damage(self, tmp_path):
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        checkpoints = []
+        for i, b in enumerate(_batches(3, seed=1)):
+            m.update(b)
+            save_state(m, store, keep=3)
+            checkpoints.append(float(m.compute()))
+        snaps = sorted(os.listdir(store))
+        assert len(snaps) == 3
+        faults.torn_write(os.path.join(store, snaps[-1]))  # newest damaged
+        m2 = _SumLike()
+        warned = []
+        info = restore_state(store, m2, on_fallback=lambda p, e: warned.append((p, e)))
+        assert info["fallbacks_skipped"] == 1 and len(warned) == 1
+        assert isinstance(warned[0][1], CheckpointCorruptionError)
+        _values_equal(m2.compute(), checkpoints[1])  # newest VALID, not newest
+
+    def test_rotating_store_all_damaged_raises(self, tmp_path):
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        save_state(m, store, keep=2)
+        m.update(jnp.ones(4))
+        save_state(m, store, keep=2)
+        for name in os.listdir(store):
+            faults.torn_write(os.path.join(store, name))
+        with pytest.raises(CheckpointCorruptionError, match="all 2 damaged"):
+            restore_state(store, _SumLike())
+
+    def test_rotation_prunes_to_keep(self, tmp_path):
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        for b in _batches(5):
+            m.update(b)
+            save_state(m, store, keep=2)
+        assert len(os.listdir(store)) == 2
+
+    def test_no_temp_litter_after_save(self, tmp_path):
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        store = str(tmp_path / "store")
+        save_state(m, store, keep=2)
+        assert all(not n.startswith(".") for n in os.listdir(store))
+
+    def test_sharded_stacked_roundtrip(self, tmp_path):
+        """A stacked sharded (deferred) state survives the disk round-trip and
+        folds to the same value on restore."""
+        m = _SumLike(executor=False)
+        stacked = {"total": jnp.asarray(np.arange(NUM_DEVICES, dtype=np.float32))}
+        path = str(tmp_path / "sharded.ckpt")
+        save_state(m, path, states=stacked, sharded=True)
+        m2 = _SumLike(executor=False)
+        restore_state(path, m2)
+        assert m2.deferred_pending
+        _values_equal(m2.compute(), np.float32(np.arange(NUM_DEVICES, dtype=np.float32).sum()))
+
+
+# ---------------------------------------------------------------------------
+# kill & restore: the acceptance property
+# ---------------------------------------------------------------------------
+
+
+class TestKillRestore:
+    @pytest.mark.parametrize("reduce", ["step", "deferred"])
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f[0] for f in FAMILIES])
+    def test_preempt_and_restore_equals_uninterrupted_prefix(self, tmp_path, family, cls, reduce):
+        """Preempted at update 5 with autosaves every 2: the restored metric's
+        compute() must EXACTLY equal an uninterrupted run over the first
+        `restored.update_count` batches — no drift, no double count."""
+        store = str(tmp_path / "store")
+        batches = _batches(7, seed=3)
+        m = cls(reduce=reduce)
+        saver = Autosaver(m, store, every_n_updates=2, background=False).attach()
+        with pytest.raises(faults.PreemptionInjected):
+            with faults.preempt_after(m, 5):
+                for b in batches:
+                    m.update(b)
+        assert saver.stats["saves"] >= 1
+
+        m2 = cls(reduce=reduce)
+        restore_state(store, m2)
+        prefix = m2.update_count
+        assert 1 <= prefix <= 5
+        reference = cls(reduce=reduce)
+        for b in batches[:prefix]:
+            reference.update(b)
+        _values_equal(m2.compute(), reference.compute())
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f[0] for f in FAMILIES])
+    def test_resume_after_restore_matches_full_run(self, tmp_path, family, cls):
+        """Restore then replay the remaining batches: the total must equal an
+        uninterrupted full run — the checkpoint is a true resume point."""
+        store = str(tmp_path / "store")
+        batches = _batches(6, seed=4)
+        m = cls()
+        saver = Autosaver(m, store, every_n_updates=3, background=False, reuse_recovery=False).attach()
+        for b in batches[:3]:
+            m.update(b)
+        assert saver.stats["saves"] == 1
+
+        m2 = cls()
+        restore_state(store, m2)
+        assert m2.update_count == 3
+        for b in batches[3:]:
+            m2.update(b)
+        reference = cls()
+        for b in batches:
+            reference.update(b)
+        _values_equal(m2.compute(), reference.compute())
+        assert m2.update_count == len(batches)
+
+    def test_preemption_handler_flushes_final_snapshot(self, tmp_path):
+        """SIGTERM mid-epoch: the installed handler flushes the CURRENT state
+        synchronously, then chains to the previous handler."""
+        store = str(tmp_path / "store")
+        batches = _batches(5, seed=5)
+        chained = []
+        previous = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+        try:
+            m = tm.MeanMetric()
+            saver = Autosaver(m, store, every_n_updates=1000)  # cadence never fires
+            handle = install_preemption_handler(saver, signums=(signal.SIGTERM,))
+            try:
+                for b in batches:
+                    m.update(b)
+                os.kill(os.getpid(), signal.SIGTERM)
+                deadline = time.time() + 5
+                while not chained and time.time() < deadline:
+                    time.sleep(0.01)
+                assert chained == [signal.SIGTERM]
+                assert handle.flushes == 1
+            finally:
+                handle.uninstall()
+            m2 = tm.MeanMetric()
+            restore_state(store, m2)
+            assert m2.update_count == 5
+            _values_equal(m2.compute(), m.compute())
+        finally:
+            signal.signal(signal.SIGTERM, previous)
+
+    def test_deferred_epoch_loop_mid_epoch_checkpoint(self, tmp_path):
+        """The sharded external-state loop (DeferredCollectionStep): kill after
+        k local steps, restore the stacked layout from disk, fold — equal to
+        the uninterrupted k-step reduce."""
+        mesh = _mesh()
+        coll = MetricCollection({"s": _SumLike(executor=False)}, compute_groups=False)
+        step = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        r = np.random.RandomState(6)
+        xs = [jnp.asarray(r.randn(NUM_DEVICES * 4).astype(np.float32)) for _ in range(4)]
+        st = step.init_states()
+        for x in xs[:3]:
+            st = step.local_step(st, x)
+        stacked_total = np.array(st["s"]["total"])  # host copy before anything donates
+        expected_mesh = step.reduce(st)["s"]
+        path = str(tmp_path / "epoch.ckpt")
+        save_state(coll, path, states=st, sharded=True)
+
+        coll2 = MetricCollection({"s": _SumLike(executor=False)}, compute_groups=False)
+        restore_state(path, coll2)
+        got = coll2.compute()["s"]
+        # exact vs the host-side fold of the SAME shards (the restore read path)
+        _values_equal(got, jnp.asarray(stacked_total).sum(axis=0))
+        # and consistent with the in-mesh fused reduce up to reduction-order rounding
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected_mesh), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# autosaver mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestAutosaver:
+    def test_background_write_and_flush(self, tmp_path):
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        saver = Autosaver(m, store, every_n_updates=2, background=True).attach()
+        for b in _batches(4, seed=7):
+            m.update(b)
+        saver.flush()
+        assert saver.stats["saves"] >= 1 and saver.stats["save_errors"] == 0
+        m2 = _SumLike()
+        restore_state(store, m2)
+        assert m2.update_count >= 1
+        saver.detach()
+        ticks_before = saver._updates_since_save
+        m.update(jnp.ones(4))  # detached: no further cadence ticks
+        assert saver._updates_since_save == ticks_before
+
+    def test_time_cadence(self, tmp_path):
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        saver = Autosaver(m, store, every_s=0.05, background=False).attach()
+        m.update(jnp.ones(4))
+        first = saver.stats["saves"]
+        time.sleep(0.08)
+        m.update(jnp.ones(4))
+        assert saver.stats["saves"] == first + 1
+
+    def test_recovery_snapshot_reuse_is_one_update_behind(self, tmp_path):
+        """An executor-eligible metric's autosave reuses the donating call's
+        host-side recovery snapshot: free (no extra device fetch) and exactly
+        one committed update behind the live state."""
+        store = str(tmp_path / "store")
+        m = _SumLike()
+        for b in _batches(3, seed=8):
+            m.update(b)  # warm the executor into donation
+        assert m.executor_status["stats"]["donated_calls"] >= 1
+        saver = Autosaver(m, store, every_n_updates=2, background=False).attach()
+        extra = _batches(2, seed=9)
+        m.update(extra[0])
+        m.update(extra[1])  # trigger
+        assert saver.stats["saves"] == 1
+        assert saver.stats["reused_recovery_snapshots"] == 1
+        m2 = _SumLike()
+        restore_state(store, m2)
+        assert m2.update_count == m.update_count - 1
+
+    def test_observer_not_fired_mid_forward(self, tmp_path):
+        """forward() runs internal updates whose transient states are NOT valid
+        checkpoints; the observer must fire exactly once per forward, post-commit."""
+        seen = []
+        m = tm.SumMetric()
+        m.add_update_observer(lambda obj: seen.append(float(np.asarray(obj._state["sum_value"]))))
+        m(jnp.asarray([1.0, 2.0]))
+        m(jnp.asarray([4.0]))
+        assert seen == [3.0, 7.0]  # accumulated state, once per forward
+
+    def test_autosave_failure_does_not_kill_the_step(self, tmp_path, monkeypatch):
+        m = _SumLike()
+        bad_dir = str(tmp_path / "file-not-dir")
+        with open(bad_dir, "w") as fh:
+            fh.write("occupied")  # directory creation will fail
+        saver = Autosaver(m, bad_dir, every_n_updates=1, background=False).attach()
+        with pytest.warns(UserWarning, match="autosave failed"):
+            m.update(jnp.ones(4))  # the update itself must survive
+        assert m.update_count == 1
+        assert saver.stats["save_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# transient-failure policy: sync retry, dispatch retry, watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestSyncRetry:
+    def test_flaky_sync_recovers_within_budget(self):
+        m = tm.MeanMetric(on_sync_failure="retry", sync_retries=3, distributed_available_fn=lambda: True)
+        m.update(jnp.asarray([2.0, 4.0]))
+        with faults.flaky_sync(fail_n=2) as counters:
+            m.sync()
+            m.unsync()
+        assert counters["failures"] == 2 and counters["attempts"] > 2
+        assert m.last_sync_ok
+
+    def test_retry_budget_exhausted_raises_with_state_intact(self):
+        m = tm.MeanMetric(on_sync_failure="retry", sync_retries=1, distributed_available_fn=lambda: True)
+        m.update(jnp.asarray([2.0, 4.0]))
+        before = float(np.asarray(m._state["mean_value"]))
+        with faults.flaky_sync(fail_n=100):
+            with pytest.raises(faults.FaultInjected):
+                m.sync()
+        assert float(np.asarray(m._state["mean_value"])) == before
+        assert not m._is_synced
+
+    def test_env_var_drives_default_retries(self, monkeypatch):
+        monkeypatch.setenv(retry_mod.SYNC_RETRIES_ENV, "7")
+        assert retry_mod.default_sync_retries() == 7
+        monkeypatch.setenv(retry_mod.SYNC_RETRIES_ENV, "bogus")
+        with pytest.raises(ValueError):
+            retry_mod.default_sync_retries()
+
+    def test_backoff_schedule_deterministic_without_jitter(self):
+        delays = list(backoff_delays(RetryPolicy(max_retries=4, base_delay=0.1, multiplier=2.0, jitter=0.0)))
+        assert delays == [0.1, 0.2, 0.4, 0.8]
+        capped = list(backoff_delays(RetryPolicy(max_retries=5, base_delay=1.0, max_delay=2.0, jitter=0.0)))
+        assert max(capped) == 2.0
+
+    def test_call_with_retries_gives_up_after_budget(self):
+        calls = {"n": 0}
+
+        def always_fails():
+            calls["n"] += 1
+            raise RuntimeError("nope")
+
+        policy = RetryPolicy(max_retries=2, base_delay=0.0, jitter=0.0)
+        with pytest.raises(RuntimeError):
+            call_with_retries(always_fails, policy, sleep=lambda _: None)
+        assert calls["n"] == 3  # initial + 2 retries
+
+
+class TestDispatchRetryAndWatchdog:
+    def test_warm_dispatch_retry_recovers(self, monkeypatch):
+        monkeypatch.setenv(retry_mod.DISPATCH_RETRIES_ENV, "2")
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        m.update(jnp.ones(4))  # warm + donated
+        with faults.fail_dispatch(fail_n=1):
+            m.update(jnp.ones(4))  # fails once after donation, retried on a copy
+        stats = m.executor_status["stats"]
+        assert stats["dispatch_failures"] == 1
+        assert stats["dispatch_retries"] == 1
+        assert stats["recovery_restores"] == 1
+        _values_equal(m.compute(), np.float32(12.0))  # no double count
+
+    def test_without_retries_warm_failure_propagates(self, monkeypatch):
+        monkeypatch.delenv(retry_mod.DISPATCH_RETRIES_ENV, raising=False)
+        m = _SumLike()
+        m.update(jnp.ones(4))
+        m.update(jnp.ones(4))
+        with faults.fail_dispatch(fail_n=1):
+            with pytest.raises(faults.FaultInjected):
+                m.update(jnp.ones(4))
+        _values_equal(m.compute(), np.float32(8.0))  # restored, not reset
+
+    def test_watchdog_fires_on_hang_sync(self):
+        """The chaos scenario from the ISSUE: a hung rendezvous under the
+        watchdog surfaces as DispatchStallError in ~deadline seconds instead
+        of blocking forever."""
+        m = tm.MeanMetric(distributed_available_fn=lambda: True)
+        m.update(jnp.asarray([1.0, 3.0]))
+        before = float(np.asarray(m._state["mean_value"]))
+        t0 = time.monotonic()
+        with faults.hang_sync(seconds=20.0):
+            with pytest.raises(DispatchStallError):
+                with stall_watchdog(0.4, what="host sync"):
+                    m.sync()
+        assert time.monotonic() - t0 < 5.0
+        assert float(np.asarray(m._state["mean_value"])) == before
+
+    def test_watchdog_noop_when_disabled_or_fast(self):
+        with stall_watchdog(None):
+            pass
+        with stall_watchdog(5.0, what="fast call"):
+            x = 1 + 1
+        assert x == 2
+
+    def test_stall_error_not_retried(self):
+        calls = {"n": 0}
+
+        def stalls():
+            calls["n"] += 1
+            raise DispatchStallError("wedged")
+
+        with pytest.raises(DispatchStallError):
+            call_with_retries(stalls, RetryPolicy(max_retries=5, base_delay=0.0), sleep=lambda _: None)
+        assert calls["n"] == 1  # never re-run: it would park another deadline
+
+    def test_stall_error_carries_breadcrumbs(self):
+        err = DispatchStallError("wedged", executor_status={"calls": 3})
+        assert err.executor_status == {"calls": 3}
+        assert isinstance(err, TimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# gather-worker leak regression (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestGatherWorkerLeak:
+    def _sync_threads(self):
+        return [t for t in threading.enumerate() if t.name == "tm_tpu_sync" and t.is_alive()]
+
+    def test_parked_workers_are_daemon_and_self_retire(self):
+        """Repeated timeouts against a hung peer: every abandoned worker is a
+        daemon (cannot wedge interpreter exit — the old pool's non-daemon
+        workers could) and exits once its parked gather clears."""
+        from torchmetrics_tpu.parallel import sync as sync_mod
+
+        sync_mod._gather_pool = None
+        baseline = len(self._sync_threads())
+        with faults.hang_sync(seconds=0.8):
+            for _ in range(3):
+                with pytest.raises(SyncTimeoutError):
+                    sync_mod._gather_with_timeout(jnp.ones(2), timeout=0.05)
+        parked = self._sync_threads()
+        assert len(parked) - baseline <= 3
+        assert all(t.daemon for t in parked)
+        deadline = time.time() + 10
+        while len(self._sync_threads()) > baseline and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(self._sync_threads()) == baseline  # deterministic reaping
+
+    def test_recovered_worker_is_reused_not_leaked(self):
+        """After the hang clears, successful gathers share ONE worker again —
+        no per-degradation churn."""
+        from torchmetrics_tpu.parallel import sync as sync_mod
+
+        sync_mod._gather_pool = None
+        sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)
+        worker = sync_mod._gather_pool
+        for _ in range(3):
+            sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)
+        assert sync_mod._gather_pool is worker
+        assert len(self._sync_threads()) >= 1
+
+    def test_worker_delivers_seam_errors(self):
+        from torchmetrics_tpu.parallel import sync as sync_mod
+
+        sync_mod._gather_pool = None
+        with faults.break_sync():
+            with pytest.raises(faults.FaultInjected):
+                sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)
+        # the worker survives a job failure and serves the next call
+        assert np.asarray(sync_mod._gather_with_timeout(jnp.ones(2), timeout=5.0)).shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# check_finite on sharded/deferred states (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckFiniteSharded:
+    def _stacked_with_nan(self, shard=3):
+        arr = np.zeros(NUM_DEVICES, dtype=np.float32)
+        arr[shard] = np.nan
+        return {"total": jnp.asarray(arr), "_update_count": 2, "_sharded_shards": NUM_DEVICES}
+
+    def test_validate_off_still_honors_check_finite(self):
+        """check_finite is an explicit request: validate='off' used to skip it
+        silently, installing the poisoned checkpoint."""
+        m = _SumLike(executor=False)
+        with pytest.raises(StateCorruptionError, match="non-finite"):
+            m.load_state(self._stacked_with_nan(), validate="off", check_finite=True)
+
+    def test_strict_sharded_names_the_poisoned_shard(self):
+        m = _SumLike(executor=False)
+        with pytest.raises(StateCorruptionError, match=r"shard\(s\) \[3\]"):
+            m.load_state(self._stacked_with_nan(shard=3), check_finite=True)
+
+    def test_clean_sharded_state_passes(self):
+        m = _SumLike(executor=False)
+        m.load_state(
+            {"total": jnp.ones(NUM_DEVICES), "_update_count": 1, "_sharded_shards": NUM_DEVICES},
+            check_finite=True,
+        )
+        _values_equal(m.compute(), np.float32(NUM_DEVICES))
+
+    def test_validate_off_without_check_finite_installs_unchecked(self):
+        m = _SumLike(executor=False)
+        m.load_state(self._stacked_with_nan(), validate="off", check_finite=False)
+        assert m.deferred_pending  # installed (explicitly unchecked fast path)

@@ -347,8 +347,11 @@ class TestBoundedSync:
     def test_sync_timeout_kwarg_validation(self):
         with pytest.raises(ValueError, match="sync_timeout"):
             SumMetric(nan_strategy="ignore", sync_timeout=-1)
+        # "retry" joined the valid policies in ISSUE 4 (docs/DURABILITY.md)
         with pytest.raises(ValueError, match="on_sync_failure"):
-            SumMetric(nan_strategy="ignore", on_sync_failure="retry")
+            SumMetric(nan_strategy="ignore", on_sync_failure="give_up")
+        with pytest.raises(ValueError, match="sync_retries"):
+            SumMetric(nan_strategy="ignore", sync_retries=-2)
 
 
 # ---------------------------------------------------------------------------

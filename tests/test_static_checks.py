@@ -1,8 +1,11 @@
 """Static hygiene gates: no silent broad exception handlers in
-torchmetrics_tpu/ (ISSUE 2, tools/lint_exceptions.py), and no per-step
+torchmetrics_tpu/ (ISSUE 2, tools/lint_exceptions.py), no per-step
 collectives inside update-stage functional code (ISSUE 3,
 tools/lint_collectives.py — reductions belong to parallel/sync.py, applied
-per the declared ``dist_reduce_fx`` at the sync/read point)."""
+per the declared ``dist_reduce_fx`` at the sync/read point), and no
+non-atomic binary writes of state payloads outside io/checkpoint.py
+(ISSUE 4, tools/lint_atomic_io.py — the torn-write window the atomic
+snapshot store exists to close)."""
 import importlib.util
 import sys
 from pathlib import Path
@@ -50,6 +53,35 @@ def test_no_collectives_in_update_stage():
     msg = "\n".join(f"{v.path}:{v.line} in {v.func}: {v.snippet}" for v in violations)
     assert not violations, f"collectives inside update-stage functions (move to parallel/sync.py):\n{msg}"
     assert not stale, f"stale lint allowlist entries (calls gone — remove them): {stale}"
+
+
+def test_no_nonatomic_state_writes():
+    """All binary payload writes route through io/checkpoint.py's atomic
+    write-to-temp → fsync → rename path; a stray open(..., "wb") elsewhere
+    would reintroduce the torn-write window (docs/DURABILITY.md)."""
+    linter = _load_tool("lint_atomic_io")
+    violations, stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    msg = "\n".join(f"{v.path}:{v.line} in {v.func}: {v.snippet}" for v in violations)
+    assert not violations, f"non-atomic state writes (route through io/checkpoint.py):\n{msg}"
+    assert not stale, f"stale lint allowlist entries (writes gone — remove them): {stale}"
+
+
+def test_atomic_io_linter_catches_violations(tmp_path):
+    """The linter actually fires: a synthetic module writing binary state
+    bytes with open(..., "wb") and np.savez(path) must be flagged."""
+    linter = _load_tool("lint_atomic_io")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def _save(path, state):\n"
+        "    with open(path, 'wb') as fh:\n"
+        "        fh.write(state)\n"
+        "    np.savez(path, **state)\n"
+        "def _read(path):\n"
+        "    return open(path, 'rb').read()  # reads are fine\n"
+    )
+    found = linter.lint_file(bad, "bad.py")
+    assert len(found) == 2 and all(v.func == "_save" for v in found)
 
 
 def test_collectives_linter_catches_violations(tmp_path):

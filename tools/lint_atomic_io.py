@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Static pass: no non-atomic binary writes of state payloads in the package.
+
+The durability work (ISSUE 4) makes ``torchmetrics_tpu/io/checkpoint.py`` the
+ONLY place allowed to put metric-state bytes on disk, because it is the only
+place that performs the full atomic dance (write-to-temp → fsync → atomic
+rename → directory fsync). A stray ``open(path, "wb")`` / ``np.savez(path)``
+anywhere else would reintroduce the torn-write window the snapshot store
+exists to close: a preemption mid-write leaves a file that *parses* as a
+truncated payload and silently poisons the next restore.
+
+Rule: inside ``torchmetrics_tpu/`` (excluding ``io/checkpoint.py``), these
+calls are forbidden unless allowlisted with a reason:
+
+- ``open(..., mode)`` where the mode string writes binary ("wb", "xb", "ab",
+  "wb+", ...) — spelled ``open``, ``io.open`` or ``os.fdopen``;
+- ``np.save`` / ``np.savez`` / ``np.savez_compressed`` / ``jnp.save`` with a
+  non-buffer first argument (writing straight to a path);
+- ``pickle.dump`` (stateful payloads must go through the manifest format);
+- ``Path.write_bytes``.
+
+Run directly (``python tools/lint_atomic_io.py``) for a report, or through
+``tests/test_static_checks.py`` where it gates the suite.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+#: the one module allowed to write payload bytes (paths relative to the
+#: package root, posix separators)
+EXEMPT_FILES = {"io/checkpoint.py"}
+
+#: deliberate exceptions; keys are "<path relative to torchmetrics_tpu/>::<line-function>"
+#: (function name of the enclosing def, or "<module>"), values say why
+ALLOWLIST = {
+    "testing/faults.py::torn_write": (
+        "fault injection: deliberately NON-atomic damage to an existing snapshot"
+        " file — simulating exactly the failure the rule prevents"
+    ),
+}
+
+_SAVERS = {"save", "savez", "savez_compressed"}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    func: str
+    snippet: str
+
+
+def _writes_binary(mode: str) -> bool:
+    return ("b" in mode) and any(c in mode for c in "wxa+")
+
+
+def _call_violation(node: ast.Call) -> bool:
+    fn = node.func
+    name = None
+    attr_owner = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+        if isinstance(fn.value, ast.Name):
+            attr_owner = fn.value.id
+
+    if name in ("open", "fdopen"):
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) and isinstance(node.args[1].value, str):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        return mode is not None and _writes_binary(mode)
+    if name in _SAVERS and attr_owner in ("np", "numpy", "jnp"):
+        # writing into an in-memory buffer is fine; a Constant str/pathish
+        # first arg (or any Name that is not an io buffer) is treated as a
+        # path write — conservative, allowlist the false positives
+        if node.args and isinstance(node.args[0], ast.Call):
+            return False  # e.g. np.savez(BytesIO(), ...) / opened handle factory
+        return bool(node.args)
+    if name == "dump" and attr_owner == "pickle":
+        return True
+    if name == "write_bytes":
+        return True
+    return False
+
+
+def lint_file(path: Path, rel: str) -> List[Violation]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [Violation(rel, err.lineno or 0, "<module>", f"syntax error: {err.msg}")]
+    lines = source.splitlines()
+    # map every call to its innermost enclosing function name
+    out: List[Violation] = []
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            if isinstance(child, ast.Call) and _call_violation(child):
+                snippet = lines[child.lineno - 1].strip() if child.lineno <= len(lines) else ""
+                out.append(Violation(rel, child.lineno, func, snippet))
+            visit(child, child_func)
+
+    visit(tree, "<module>")
+    return out
+
+
+def collect_violations(package_root: Path):
+    """(violations, stale_allowlist): binary payload writes outside
+    io/checkpoint.py not covered by the allowlist, plus allowlist entries that
+    no longer match anything."""
+    violations: List[Violation] = []
+    used = set()
+    for path in sorted(package_root.rglob("*.py")):
+        rel = path.relative_to(package_root).as_posix()
+        if rel in EXEMPT_FILES:
+            continue
+        for v in lint_file(path, rel):
+            key = f"{v.path}::{v.func}"
+            if key in ALLOWLIST:
+                used.add(key)
+                continue
+            violations.append(v)
+    stale = sorted(set(ALLOWLIST) - used)
+    return violations, stale
+
+
+def main() -> int:
+    package_root = Path(__file__).resolve().parent.parent / "torchmetrics_tpu"
+    violations, stale = collect_violations(package_root)
+    for v in violations:
+        print(
+            f"{v.path}:{v.line}: non-atomic binary write in {v.func!r}"
+            f" (state payloads must go through io/checkpoint.py's atomic store): {v.snippet}"
+        )
+    for key in stale:
+        print(f"allowlist entry {key!r} ({ALLOWLIST[key]}) matches no call anymore — remove it")
+    if violations or stale:
+        return 1
+    print(f"lint_atomic_io: clean ({package_root})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

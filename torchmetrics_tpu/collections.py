@@ -112,6 +112,8 @@ class MetricCollection:
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         state["_executor_obj"] = None  # compiled executables are process-local
+        # observers are process-local callbacks (autosavers, fault hooks)
+        state.pop("_update_observers", None)
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -242,6 +244,34 @@ class MetricCollection:
         name = base if self.prefix is None else self.prefix + base
         return name if self.postfix is None else name + self.postfix
 
+    # ------------------------------------------------------ update observers
+    @property
+    def update_count(self) -> int:
+        """Updates committed into the collection: the max member count (group
+        leaders advance in lockstep, so this is the shared step count)."""
+        return max((m.update_count for m in self._modules.values()), default=0)
+
+    def add_update_observer(self, callback: Any) -> Any:
+        """Register ``callback(collection)`` to fire once after every committed
+        collection-level ``update``/``forward`` — both the fused-executor path
+        (where member ``update`` never runs) and the per-group loop. The
+        autosave trigger point (io/checkpoint.py). Returns a detach function."""
+        observers = self.__dict__.setdefault("_update_observers", [])
+        observers.append(callback)
+
+        def detach() -> None:
+            obs = self.__dict__.get("_update_observers")
+            if obs is not None and callback in obs:
+                obs.remove(callback)
+
+        return detach
+
+    def _notify_update(self) -> None:
+        observers = self.__dict__.get("_update_observers")
+        if observers:
+            for callback in tuple(observers):
+                callback(self)
+
     # ------------------------------------------------------------- metric API
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update each metric once per compute group (reference :200-226).
@@ -254,6 +284,7 @@ class MetricCollection:
             ex = self._get_executor()
             if ex is not None and ex.run_update(args, kwargs):
                 self._compute_groups_create_state_ref()
+                self._notify_update()
                 return
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
@@ -266,6 +297,7 @@ class MetricCollection:
                 self._merge_compute_groups()
                 self._compute_groups_create_state_ref()
                 self._groups_checked = True
+        self._notify_update()
 
     def _merge_compute_groups(self, trial_states: Optional[Dict[str, Dict[str, Any]]] = None) -> None:
         """Union groups whose states compare equal (reference :228-262), O(n²).
@@ -370,6 +402,7 @@ class MetricCollection:
                 if fused is not None:
                     self._compute_groups_create_state_ref()
                     out, _ = _flatten_dict({self._set_name(k): v for k, v in fused.items()})
+                    self._notify_update()
                     return out
             for cg in self._groups.values():
                 members = [(n, self._modules[n]) for n in cg]
@@ -409,6 +442,7 @@ class MetricCollection:
                 self._compute_groups_create_state_ref()
                 self._groups_checked = True
         res, _ = _flatten_dict({self._set_name(k): v for k, v in res.items()})
+        self._notify_update()
         return res
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:

@@ -1,0 +1,40 @@
+"""Durability subsystem: atomic snapshot store, autosave, retry/backoff.
+
+``io.checkpoint`` persists metric state safely (atomic writes, per-leaf
+hashes, rotating fallback, preemption flush); ``io.retry`` turns transient
+failures into backed-off re-attempts and silent stalls into typed errors.
+See docs/DURABILITY.md.
+"""
+from torchmetrics_tpu.io.checkpoint import (  # noqa: F401
+    Autosaver,
+    PreemptionHandle,
+    install_preemption_handler,
+    load_manifest,
+    restore_state,
+    save_state,
+)
+from torchmetrics_tpu.io.retry import (  # noqa: F401
+    RetryPolicy,
+    backoff_delays,
+    call_with_retries,
+    default_dispatch_deadline,
+    default_dispatch_retries,
+    default_sync_retries,
+    stall_watchdog,
+)
+
+__all__ = [
+    "Autosaver",
+    "PreemptionHandle",
+    "RetryPolicy",
+    "backoff_delays",
+    "call_with_retries",
+    "default_dispatch_deadline",
+    "default_dispatch_retries",
+    "default_sync_retries",
+    "install_preemption_handler",
+    "load_manifest",
+    "restore_state",
+    "save_state",
+    "stall_watchdog",
+]

@@ -1,0 +1,750 @@
+"""Durable metric-state snapshots: atomic writes, validated restores, autosave.
+
+PR 2 contained in-process failures (transactional rollback, validated
+``load_state``) and PR 3 made state shardable and resumable — but a SIGTERM,
+host crash, or torn write still lost the whole epoch of accumulated metric
+state because nothing ever reached disk safely. This module closes the loop
+from "contained" to "survivable" (pjit-era training runs assume exactly this:
+accumulated state durably checkpointed and restartable, arXiv:2204.06514):
+
+- :func:`save_state` / :func:`restore_state` — a single-file snapshot format
+  (versioned manifest + npz payload, per-leaf sha256) written via
+  write-to-temp → fsync → atomic rename, so a crash at ANY byte leaves either
+  the previous snapshot or none — never a half-written one that parses.
+- Rotating stores — ``save_state(..., keep=N)`` keeps the N newest snapshots
+  in a directory; ``restore_state`` walks them newest-first and *skips* torn
+  or corrupt files (typed :class:`CheckpointCorruptionError`) in favor of the
+  newest valid one, never silently installing damage.
+- :class:`Autosaver` — cadence-driven snapshots off the hot path: the
+  host-side copy reuses the executor's forced-copy recovery snapshot when one
+  is fresh (zero extra device sync), and serialization + disk I/O run on a
+  background thread.
+- :func:`install_preemption_handler` — a SIGTERM/SIGINT hook that flushes one
+  final synchronous snapshot before the process dies.
+
+Restores route through the existing ``load_state(validate="strict")`` path,
+so every structural/shape/dtype/finiteness guarantee of docs/ROBUSTNESS.md
+applies to disk restores too, including stacked sharded (deferred) layouts.
+
+This file is the ONLY place in the package allowed to write state payloads to
+disk (enforced by ``tools/lint_atomic_io.py``): one implementation of the
+atomic dance means no second, subtly-torn one.
+"""
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.utils.exceptions import (
+    CheckpointCorruptionError,
+    StateCorruptionError,
+    TorchMetricsUserError,
+)
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
+
+#: file magic: 10 bytes, includes the container version
+_MAGIC = b"TMTPUCKv1\n"
+
+#: manifest schema version (bump on incompatible manifest changes)
+MANIFEST_VERSION = 1
+
+#: rotating-store snapshot filename pattern
+_SNAP_RE = re.compile(r"^snapshot-(\d{8})\.ckpt$")
+
+#: default rotation depth for rotating stores and the Autosaver
+DEFAULT_KEEP = 3
+
+#: reserved per-metric export keys (mirrors Metric._RESERVED_STATE_KEYS without
+#: importing metric.py at module import time)
+_COUNT_KEY = "_update_count"
+_SHARDS_KEY = "_sharded_shards"
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def host_copy_tree(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Host-side (numpy) deep copy of a state export — the same forced-copy
+    discipline as the executor's recovery snapshot (``np.array``, never a
+    zero-copy device view a donating dispatch could overwrite). Reserved int
+    leaves and list states keep their structure.
+
+    >>> snap = host_copy_tree({"total": np.ones(2), "_update_count": 3})
+    >>> snap["_update_count"], snap["total"].shape
+    (3, (2,))
+    """
+    out: Dict[str, Any] = {}
+    for k, v in state.items():
+        if isinstance(v, dict):
+            out[k] = host_copy_tree(v)
+        elif isinstance(v, (list, tuple)):
+            out[k] = [np.array(el) for el in v]
+        elif isinstance(v, (int, float)) and not hasattr(v, "shape"):
+            out[k] = v
+        else:
+            out[k] = np.array(v)
+    return out
+
+
+# ---------------------------------------------------------------- flattening
+
+def _flatten_export(state: Dict[str, Any]) -> Tuple[List[Tuple[Dict[str, Any], np.ndarray]], Dict[str, Any]]:
+    """Split a (metric or collection) state export into array leaves + scalars.
+
+    Returns ``(leaves, scalars)``: each leaf is ``(path_descriptor, array)``
+    where the descriptor pinpoints the leaf (``leader`` for collections,
+    ``field``, ``index`` for list-state elements); ``scalars`` mirrors the
+    export's nesting with only the reserved int leaves (counts, shard marks).
+    """
+    leaves: List[Tuple[Dict[str, Any], np.ndarray]] = []
+    scalars: Dict[str, Any] = {}
+
+    def visit(sub: Dict[str, Any], leader: Optional[str]) -> None:
+        dst = scalars.setdefault(leader, {}) if leader is not None else scalars
+        for field, value in sub.items():
+            if isinstance(value, dict):
+                if leader is not None:
+                    raise TorchMetricsUserError(
+                        f"state export nests deeper than collection->metric at {field!r}"
+                    )
+                visit(value, field)
+            elif field in (_COUNT_KEY, _SHARDS_KEY):
+                dst[field] = int(np.asarray(value))
+            elif isinstance(value, (list, tuple)):
+                dst.setdefault("_list_fields", {})[field] = len(value)
+                for i, el in enumerate(value):
+                    leaves.append(({"leader": leader, "field": field, "index": i}, np.asarray(el)))
+            else:
+                leaves.append(({"leader": leader, "field": field, "index": None}, np.asarray(value)))
+
+    visit(state, None)
+    return leaves, scalars
+
+
+def _unflatten_export(
+    leaves: List[Tuple[Dict[str, Any], np.ndarray]], scalars: Dict[str, Any], nested: bool
+) -> Dict[str, Any]:
+    """Inverse of :func:`_flatten_export` (list elements arrive in saved order)."""
+
+    def bucket(leader: Optional[str]) -> Dict[str, Any]:
+        if not nested:
+            return state
+        return state.setdefault(leader, {})
+
+    state: Dict[str, Any] = {}
+    for desc, arr in leaves:
+        dst = bucket(desc["leader"])
+        if desc["index"] is None:
+            dst[desc["field"]] = arr
+        else:
+            dst.setdefault(desc["field"], []).append(arr)
+
+    def attach(dst: Dict[str, Any], info: Dict[str, Any]) -> None:
+        for field, n in (info.get("_list_fields") or {}).items():
+            got = dst.setdefault(field, [])
+            if len(got) != n:
+                raise CheckpointCorruptionError(
+                    f"list state {field!r} expected {n} elements, payload holds {len(got)}"
+                )
+        for key in (_COUNT_KEY, _SHARDS_KEY):
+            if key in info:
+                dst[key] = int(info[key])
+
+    if nested:
+        for leader, info in scalars.items():
+            attach(state.setdefault(leader, {}), info or {})
+    else:
+        attach(state, scalars)
+    return state
+
+
+# ------------------------------------------------------------------- writing
+
+def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]) -> bytes:
+    """Serialize one snapshot: magic + manifest JSON + npz payload."""
+    import jax
+
+    from torchmetrics_tpu import __version__
+
+    nested = any(isinstance(v, dict) for v in state.values())
+    leaves, scalars = _flatten_export(state)
+
+    payload_buf = _io.BytesIO()
+    arrays = {f"leaf_{i:05d}": arr for i, (_, arr) in enumerate(leaves)}
+    np.savez(payload_buf, **arrays)
+    payload = payload_buf.getvalue()
+
+    leaf_manifest = [
+        {
+            "key": f"leaf_{i:05d}",
+            "leader": desc["leader"],
+            "field": desc["field"],
+            "index": desc["index"],
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "sha256": _sha256(np.ascontiguousarray(arr).tobytes()),
+        }
+        for i, (desc, arr) in enumerate(leaves)
+    ]
+
+    try:
+        spec = obj.state_spec()
+    except Exception as err:  # objects without a spec (exotic wrappers) still snapshot
+        rank_zero_debug(f"torchmetrics_tpu checkpoint: no state_spec for {type(obj).__name__} ({err})")
+        spec = None
+    manifest = {
+        "manifest_version": MANIFEST_VERSION,
+        "library_version": __version__,
+        "jax_version": jax.__version__,
+        "created_unix": time.time(),
+        "kind": "collection" if nested else "metric",
+        "class": type(obj).__name__,
+        "spec": spec,
+        "update_count": update_count,
+        "reduce_policy": getattr(obj, "reduce_policy", None),
+        "mesh": {
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+            "process_index": jax.process_index(),
+        },
+        "scalars": scalars,
+        "leaves": leaf_manifest,
+        "payload_len": len(payload),
+        "payload_sha256": _sha256(payload),
+    }
+    manifest_bytes = json.dumps(manifest, sort_keys=True).encode("utf-8")
+    header = _MAGIC + len(manifest_bytes).to_bytes(8, "little")
+    return header + manifest_bytes + payload
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """write-to-temp → flush → fsync → atomic rename (+ best-effort dir fsync).
+
+    A crash at any byte leaves either the complete previous file or a stray
+    ``.tmp.*`` sibling ``os.replace`` never promoted — the reader can never
+    observe a prefix of ``data`` under the final name.
+    """
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # best-effort temp cleanup; the failure below is the story
+        raise
+    try:  # the rename itself must be durable, not just the bytes
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        rank_zero_debug(f"torchmetrics_tpu checkpoint: directory fsync unavailable for {directory}")
+
+
+def _list_snapshots(directory: str) -> List[Tuple[int, str]]:
+    """Rotating-store snapshots as (sequence, path), oldest first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _SNAP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def _resolve_update_count(obj: Any, state: Dict[str, Any]) -> Optional[int]:
+    if _COUNT_KEY in state:
+        return int(np.asarray(state[_COUNT_KEY]))
+    counts = [int(np.asarray(v[_COUNT_KEY])) for v in state.values() if isinstance(v, dict) and _COUNT_KEY in v]
+    if counts:
+        return max(counts)
+    count = getattr(obj, "update_count", None)
+    return int(count) if count is not None else None
+
+
+def save_state(
+    obj: Any,
+    path: str,
+    keep: Optional[int] = None,
+    states: Optional[Dict[str, Any]] = None,
+    sharded: bool = False,
+) -> str:
+    """Write a durable snapshot of ``obj``'s metric state; returns the path written.
+
+    ``obj`` is a ``Metric`` or ``MetricCollection`` (anything with ``state()``
+    / ``state_spec()`` / ``load_state()``). Two addressing modes:
+
+    - ``path`` names a FILE (default): one snapshot, atomically replaced.
+    - ``keep=N`` (or ``path`` names an existing directory): a rotating store —
+      snapshots are written as ``snapshot-<seq>.ckpt`` inside ``path`` and
+      only the N newest are retained. :func:`restore_state` on the directory
+      walks them newest-first, skipping torn/corrupt files.
+
+    ``states`` overrides the live state with an external pytree — the
+    deferred-reduction epoch loop (``DeferredCollectionStep``) carries its
+    accumulated state *outside* the collection, so mid-epoch checkpoints pass
+    it here; ``sharded=True`` marks each (leader's) export with the stacked
+    shard count so a restore re-installs the per-device layout losslessly
+    (``load_state`` auto-detects via the reserved key).
+
+    The write path is crash-atomic (write-to-temp → fsync → rename): a
+    preemption mid-save can cost at most the *newest* snapshot, never an old
+    valid one.
+    """
+    if states is None:
+        export = obj.state()
+    else:
+        export = {k: (dict(v) if isinstance(v, dict) else v) for k, v in states.items()}
+        if sharded:
+            def mark(sub: Dict[str, Any]) -> Dict[str, Any]:
+                shards = None
+                for v in sub.values():
+                    arr = np.asarray(v)
+                    if arr.ndim >= 1:
+                        shards = int(arr.shape[0])
+                        break
+                if shards is None:
+                    raise TorchMetricsUserError("sharded=True but no array leaf carries a shard axis")
+                sub = dict(sub)
+                sub[_SHARDS_KEY] = shards
+                return sub
+
+            if any(isinstance(v, dict) for v in export.values()):
+                export = {leader: mark(sub) for leader, sub in export.items()}
+            else:
+                export = mark(export)
+    export = host_copy_tree(export)
+    data = _snapshot_bytes(obj, export, _resolve_update_count(obj, export))
+
+    is_dir_store = keep is not None or os.path.isdir(path)
+    if not is_dir_store:
+        _atomic_write(path, data)
+        return path
+
+    keep = DEFAULT_KEEP if keep is None else int(keep)
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    os.makedirs(path, exist_ok=True)
+    existing = _list_snapshots(path)
+    seq = (existing[-1][0] + 1) if existing else 0
+    target = os.path.join(path, f"snapshot-{seq:08d}.ckpt")
+    _atomic_write(target, data)
+    for _, old in _list_snapshots(path)[:-keep]:
+        try:
+            os.unlink(old)
+        except OSError:
+            rank_zero_debug(f"torchmetrics_tpu checkpoint: could not prune {old}")
+    return target
+
+
+# ------------------------------------------------------------------- reading
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    """Parse and integrity-check just the manifest of a snapshot file
+    (inspection without touching the payload arrays)."""
+    manifest, _ = _read_file(path, want_payload=False)
+    return manifest
+
+
+def _read_file(path: str, want_payload: bool = True) -> Tuple[Dict[str, Any], Optional[bytes]]:
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as err:
+        raise CheckpointCorruptionError(f"cannot read snapshot {path}: {err}") from err
+    if len(blob) < len(_MAGIC) + 8 or not blob.startswith(_MAGIC):
+        raise CheckpointCorruptionError(
+            f"{path} is not a torchmetrics_tpu snapshot (bad magic/truncated header)"
+        )
+    mlen = int.from_bytes(blob[len(_MAGIC):len(_MAGIC) + 8], "little")
+    m_start = len(_MAGIC) + 8
+    if mlen <= 0 or m_start + mlen > len(blob):
+        raise CheckpointCorruptionError(f"{path}: manifest length {mlen} exceeds file size (torn write)")
+    try:
+        manifest = json.loads(blob[m_start:m_start + mlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as err:
+        raise CheckpointCorruptionError(f"{path}: manifest is not valid JSON ({err})") from err
+    version = manifest.get("manifest_version")
+    if not isinstance(version, int) or version > MANIFEST_VERSION:
+        raise CheckpointCorruptionError(
+            f"{path}: manifest_version {version!r} unsupported (this build reads <= {MANIFEST_VERSION})"
+        )
+    payload = blob[m_start + mlen:]
+    if len(payload) != manifest.get("payload_len"):
+        raise CheckpointCorruptionError(
+            f"{path}: payload is {len(payload)} bytes, manifest promises"
+            f" {manifest.get('payload_len')} (torn write)"
+        )
+    if _sha256(payload) != manifest.get("payload_sha256"):
+        raise CheckpointCorruptionError(f"{path}: payload sha256 mismatch (corrupt/torn write)")
+    return manifest, (payload if want_payload else None)
+
+
+def _decode_state(path: str, manifest: Dict[str, Any], payload: bytes) -> Dict[str, Any]:
+    try:
+        archive = np.load(_io.BytesIO(payload), allow_pickle=False)
+    except Exception as err:
+        raise CheckpointCorruptionError(f"{path}: payload archive unreadable ({err})") from err
+    leaves: List[Tuple[Dict[str, Any], np.ndarray]] = []
+    for entry in manifest.get("leaves", []):
+        key = entry["key"]
+        if key not in archive.files:
+            raise CheckpointCorruptionError(f"{path}: payload missing leaf {key} ({entry['field']!r})")
+        arr = archive[key]
+        if list(arr.shape) != entry["shape"] or str(arr.dtype) != entry["dtype"]:
+            raise CheckpointCorruptionError(
+                f"{path}: leaf {entry['field']!r} is {arr.dtype}{tuple(arr.shape)},"
+                f" manifest promises {entry['dtype']}{tuple(entry['shape'])}"
+            )
+        if _sha256(np.ascontiguousarray(arr).tobytes()) != entry["sha256"]:
+            raise CheckpointCorruptionError(
+                f"{path}: leaf {entry['field']!r} sha256 mismatch (bit rot / corrupt write)"
+            )
+        leaves.append(({"leader": entry["leader"], "field": entry["field"], "index": entry["index"]}, arr))
+    return _unflatten_export(leaves, manifest.get("scalars") or {}, manifest.get("kind") == "collection")
+
+
+def _restore_file(
+    path: str, obj: Any, validate: str, check_finite: bool
+) -> Dict[str, Any]:
+    manifest, payload = _read_file(path)
+    if validate != "off" and manifest.get("class") not in (None, type(obj).__name__):
+        raise StateCorruptionError(
+            f"{path} holds state for {manifest.get('class')!r}, not {type(obj).__name__!r}"
+            " (use validate='off' to force)"
+        )
+    state = _decode_state(path, manifest, payload)
+    # wrappers with their own state layouts override load_state without the
+    # validate/check_finite kwargs (they validate structurally themselves) —
+    # forward only what the target's signature accepts
+    import inspect
+
+    params = inspect.signature(obj.load_state).parameters
+    kwargs: Dict[str, Any] = {}
+    if "validate" in params:
+        kwargs["validate"] = validate
+    if "check_finite" in params:
+        kwargs["check_finite"] = check_finite
+    obj.load_state(state, **kwargs)
+    return manifest
+
+
+def restore_state(
+    path: str,
+    obj: Any,
+    validate: str = "strict",
+    check_finite: bool = False,
+    on_fallback: Optional[Callable[[str, Exception], None]] = None,
+) -> Dict[str, Any]:
+    """Restore ``obj``'s state from a snapshot file or rotating store.
+
+    Single file: integrity checks (magic, manifest, payload + per-leaf
+    sha256 — the torn-write detectors) raise
+    :class:`CheckpointCorruptionError`; the decoded pytree then routes through
+    ``obj.load_state(validate=..., check_finite=...)`` so disk restores get
+    the full docs/ROBUSTNESS.md validation, including stacked sharded
+    (deferred) layouts via the reserved shard-count key.
+
+    Rotating store (``path`` is a directory): snapshots are tried NEWEST
+    first; a torn/corrupt/invalid snapshot is skipped (``on_fallback(path,
+    error)`` observes each skip, default a rank-zero warning) and the next
+    older one is tried — a damaged file is never silently installed. Raises
+    :class:`CheckpointCorruptionError` when no snapshot is restorable.
+
+    Returns the restored snapshot's manifest, with ``"path"`` and
+    ``"fallbacks_skipped"`` attached.
+    """
+    if not os.path.isdir(path):
+        manifest = _restore_file(path, obj, validate, check_finite)
+        manifest["path"] = path
+        manifest["fallbacks_skipped"] = 0
+        return manifest
+
+    snaps = _list_snapshots(path)
+    if not snaps:
+        raise CheckpointCorruptionError(f"no snapshots found in rotating store {path}")
+    skipped = 0
+    errors: List[str] = []
+    for _, snap in reversed(snaps):
+        try:
+            manifest = _restore_file(snap, obj, validate, check_finite)
+        except (CheckpointCorruptionError, StateCorruptionError) as err:
+            skipped += 1
+            errors.append(f"{os.path.basename(snap)}: {type(err).__name__}: {err}")
+            if on_fallback is not None:
+                on_fallback(snap, err)
+            else:
+                rank_zero_warn(
+                    f"torchmetrics_tpu checkpoint: skipping damaged snapshot {snap}"
+                    f" ({type(err).__name__}: {err}); falling back to the previous one"
+                )
+            continue
+        manifest["path"] = snap
+        manifest["fallbacks_skipped"] = skipped
+        return manifest
+    raise CheckpointCorruptionError(
+        f"no valid snapshot in rotating store {path}; all {len(snaps)} damaged:\n  " + "\n  ".join(errors)
+    )
+
+
+# ------------------------------------------------------------------ autosave
+
+class Autosaver:
+    """Cadence-driven durable snapshots of a live metric/collection.
+
+    Attach to any ``Metric`` or ``MetricCollection``; after every committed
+    top-level ``update``/``forward`` the cadence is checked and, when due, a
+    snapshot lands in the rotating store at ``directory``::
+
+        saver = Autosaver(metric, "/ckpt/acc", every_n_updates=100).attach()
+        ...  # training loop: saves trigger off committed updates
+        saver.flush(); saver.detach()
+
+    Cost model (the hot path must not feel the disk):
+
+    - The host-side copy *reuses the executor's forced-copy recovery
+      snapshot* when one is fresh enough (every donating call takes one
+      anyway — ops/executor.py), so triggering a save usually costs zero
+      extra device synchronisation; only eager/escaped states pay one
+      device→host fetch.
+    - Serialization, hashing, and the fsync'd write run on a single
+      background worker thread. If a save is still in flight when the next
+      one triggers, the new one is SKIPPED (counted in ``stats`` — cadence
+      too fast for the disk) rather than queued without bound.
+
+    ``every_n_updates`` / ``every_s`` may be combined; whichever fires first
+    wins and both clocks reset on a save. For loops that carry state outside
+    the object (deferred epoch loops), call :meth:`step` with the external
+    ``states`` pytree instead of attaching.
+    """
+
+    def __init__(
+        self,
+        obj: Any,
+        directory: str,
+        every_n_updates: Optional[int] = None,
+        every_s: Optional[float] = None,
+        keep: int = DEFAULT_KEEP,
+        background: bool = True,
+        reuse_recovery: bool = True,
+    ) -> None:
+        if every_n_updates is None and every_s is None:
+            raise ValueError("Autosaver needs a cadence: every_n_updates and/or every_s")
+        if every_n_updates is not None and every_n_updates < 1:
+            raise ValueError(f"every_n_updates must be >= 1, got {every_n_updates}")
+        if every_s is not None and every_s <= 0:
+            raise ValueError(f"every_s must be > 0, got {every_s}")
+        self.obj = obj
+        self.directory = directory
+        self.every_n_updates = every_n_updates
+        self.every_s = every_s
+        self.keep = keep
+        self.background = background
+        self.reuse_recovery = reuse_recovery
+        self.stats: Dict[str, Any] = {
+            "saves": 0,
+            "skipped_inflight": 0,
+            "reused_recovery_snapshots": 0,
+            "save_errors": 0,
+            "last_path": None,
+            "last_error": None,
+            "last_save_unix": None,
+        }
+        self._updates_since_save = 0
+        self._last_save_t = time.monotonic()
+        self._inflight: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._detach_fns: List[Callable[[], None]] = []
+
+    # ------------------------------------------------------------ observation
+    def attach(self) -> "Autosaver":
+        """Observe committed updates on the target (idempotent)."""
+        if not self._detach_fns:
+            self._detach_fns.append(self.obj.add_update_observer(self._on_update))
+        return self
+
+    def detach(self) -> None:
+        for fn in self._detach_fns:
+            fn()
+        self._detach_fns.clear()
+
+    def _on_update(self, _obj: Any) -> None:
+        self._updates_since_save += 1
+        self.maybe_save()
+
+    def step(self, states: Optional[Dict[str, Any]] = None, sharded: bool = False) -> Optional[str]:
+        """Manual cadence tick for loops not routed through update/forward
+        (deferred epoch loops carrying external ``states``). Returns the path
+        written when a save triggered, else None."""
+        self._updates_since_save += 1
+        return self.maybe_save(states=states, sharded=sharded)
+
+    # ----------------------------------------------------------------- saving
+    def _due(self) -> bool:
+        if self.every_n_updates is not None and self._updates_since_save >= self.every_n_updates:
+            return True
+        if self.every_s is not None and (time.monotonic() - self._last_save_t) >= self.every_s:
+            return True
+        return False
+
+    def maybe_save(self, states: Optional[Dict[str, Any]] = None, sharded: bool = False) -> Optional[str]:
+        if not self._due():
+            return None
+        return self.save_now(states=states, sharded=sharded)
+
+    def _host_snapshot(self) -> Tuple[Dict[str, Any], Optional[int]]:
+        """(host-copied export, update_count) — reusing the executor's recovery
+        snapshot when it describes the current state history."""
+        if self.reuse_recovery:
+            from torchmetrics_tpu.ops.executor import latest_recovery_snapshot
+
+            reusable = latest_recovery_snapshot(self.obj)
+            if reusable is not None:
+                count, export = reusable  # already np copies, count keys embedded
+                self.stats["reused_recovery_snapshots"] += 1
+                return export, int(count)
+        export = host_copy_tree(self.obj.state())
+        return export, _resolve_update_count(self.obj, export)
+
+    def save_now(self, states: Optional[Dict[str, Any]] = None, sharded: bool = False) -> Optional[str]:
+        """Trigger a save immediately: host copy on the calling thread, write
+        on the worker (or inline when ``background=False``). Returns the
+        (eventual) snapshot path, or None when skipped for an in-flight write."""
+        with self._lock:
+            if self._inflight is not None and self._inflight.is_alive():
+                self.stats["skipped_inflight"] += 1
+                return None
+            if states is not None:
+                export = host_copy_tree(states)
+                count = _resolve_update_count(self.obj, export)
+                payload_states: Optional[Dict[str, Any]] = export
+            else:
+                export, count = self._host_snapshot()
+                payload_states = export
+            self._updates_since_save = 0
+            self._last_save_t = time.monotonic()
+
+            def write() -> None:
+                try:
+                    written = save_state(
+                        self.obj, self.directory, keep=self.keep, states=payload_states, sharded=sharded
+                    )
+                    self.stats["saves"] += 1
+                    self.stats["last_path"] = written
+                    self.stats["last_save_unix"] = time.time()
+                except Exception as err:
+                    # an autosave failure must not kill the training step; it
+                    # is recorded (and visible in stats) instead
+                    self.stats["save_errors"] += 1
+                    self.stats["last_error"] = f"{type(err).__name__}: {err}"
+                    rank_zero_warn(f"torchmetrics_tpu autosave failed: {type(err).__name__}: {err}")
+
+            if not self.background:
+                write()
+                return self.stats["last_path"]
+            worker = threading.Thread(target=write, name="tm_tpu_autosave", daemon=True)
+            self._inflight = worker
+            worker.start()
+        # background mode: the concrete snapshot path lands in stats["last_path"]
+        # once the worker commits; the store directory is the stable address
+        return self.directory
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until any in-flight background write completes."""
+        worker = self._inflight
+        if worker is not None and worker.is_alive():
+            worker.join(timeout)
+
+    def final_save(self) -> Optional[str]:
+        """Synchronous last-gasp snapshot (the preemption-handler path): waits
+        for any in-flight write, then saves the CURRENT live state inline —
+        no recovery-snapshot reuse, no background thread."""
+        self.flush()
+        reuse, background = self.reuse_recovery, self.background
+        self.reuse_recovery = False
+        self.background = False
+        try:
+            return self.save_now()
+        finally:
+            self.reuse_recovery, self.background = reuse, background
+
+
+# -------------------------------------------------------------- preemption
+
+class PreemptionHandle:
+    """Installed signal hooks; ``uninstall()`` restores the previous handlers."""
+
+    def __init__(self, saver: Autosaver, signums: Tuple[int, ...]) -> None:
+        import signal as _signal
+
+        self._saver = saver
+        self._previous: Dict[int, Any] = {}
+        self.flushes = 0
+        for signum in signums:
+            self._previous[signum] = _signal.getsignal(signum)
+            _signal.signal(signum, self._handle)
+
+    def _handle(self, signum: int, frame: Any) -> None:
+        import signal as _signal
+
+        self.flushes += 1
+        try:
+            self._saver.final_save()
+        except Exception as err:  # the chained handler must still run on a failed flush
+            rank_zero_warn(f"torchmetrics_tpu preemption flush failed: {type(err).__name__}: {err}")
+        previous = self._previous.get(signum)
+        if callable(previous):
+            previous(signum, frame)
+        elif signum == _signal.SIGINT:
+            raise KeyboardInterrupt
+        elif previous is _signal.SIG_DFL:
+            # re-deliver with the default disposition so exit codes stay honest
+            _signal.signal(signum, _signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def uninstall(self) -> None:
+        import signal as _signal
+
+        for signum, previous in self._previous.items():
+            _signal.signal(signum, previous)
+        self._previous.clear()
+
+
+def install_preemption_handler(
+    saver: Autosaver, signums: Optional[Tuple[int, ...]] = None
+) -> PreemptionHandle:
+    """Flush one final snapshot when the process is told to die.
+
+    Registers handlers for SIGTERM and SIGINT (override via ``signums``) that
+    run ``saver.final_save()`` — synchronous, current live state — then chain
+    to the previously-installed handler (or re-deliver the default
+    disposition), so a preempted pod loses at most the batches since the last
+    committed update, not the epoch. Must be called from the main thread
+    (CPython restriction on ``signal.signal``); returns a handle whose
+    ``uninstall()`` restores the previous handlers.
+    """
+    import signal as _signal
+
+    if signums is None:
+        signums = (_signal.SIGTERM, _signal.SIGINT)
+    return PreemptionHandle(saver, tuple(signums))

@@ -1,0 +1,269 @@
+"""Transient-failure policy: capped exponential backoff and stall watchdog.
+
+TPU fleets fail in two time signatures. *Transient* failures (a DCN collective
+aborted by a peer restart, a runtime dispatch rejected during a driver hiccup)
+succeed on a re-attempt seconds later — the right response is capped
+exponential backoff with jitter, not an epoch-losing crash. *Stalls* (a
+rendezvous whose peer died, a wedged donating dispatch) never return at all —
+the right response is a deadline that converts the silent hang into a typed
+:class:`~torchmetrics_tpu.utils.exceptions.DispatchStallError` the caller can
+checkpoint-and-exit on (docs/DURABILITY.md).
+
+This module provides both primitives and the env-var plumbing that wires them
+into the two seams that need them:
+
+- ``Metric(on_sync_failure="retry")`` / ``TORCHMETRICS_TPU_SYNC_RETRIES`` —
+  the multi-host ``process_allgather`` path (``parallel/sync.py``).
+- ``TORCHMETRICS_TPU_DISPATCH_RETRIES`` — the executor's warm-dispatch
+  recovery path (``ops/executor.py``): state is restored from the host-side
+  recovery snapshot, then the dispatch re-runs on a fresh copy.
+- ``TORCHMETRICS_TPU_DISPATCH_DEADLINE`` — seconds before a donating compiled
+  call is declared stalled (off when unset).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Generator, Iterator, Optional, Tuple, Type, Union
+
+from torchmetrics_tpu.utils.exceptions import DispatchStallError
+from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+#: env var: how many times a failed multi-host sync re-attempts under
+#: ``on_sync_failure="retry"`` (int >= 0; default 3 when the policy is chosen
+#: without an explicit count)
+SYNC_RETRIES_ENV = "TORCHMETRICS_TPU_SYNC_RETRIES"
+
+#: env var: how many times a failed WARM executor dispatch re-attempts (on a
+#: fresh state copy, after the recovery restore) before propagating; 0
+#: (default) keeps the restore-and-raise semantics of docs/EXECUTOR.md
+DISPATCH_RETRIES_ENV = "TORCHMETRICS_TPU_DISPATCH_RETRIES"
+
+#: env var: seconds before a donating compiled dispatch is declared stalled
+#: (DispatchStallError); unset/0 disables the watchdog
+DISPATCH_DEADLINE_ENV = "TORCHMETRICS_TPU_DISPATCH_DEADLINE"
+
+#: default sync retry count when ``on_sync_failure="retry"`` is selected but
+#: the env var is unset
+DEFAULT_SYNC_RETRIES = 3
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer retry count, got {raw!r}")
+    if value < 0:
+        raise ValueError(f"{name} must be >= 0, got {value}")
+    return value
+
+
+def default_sync_retries() -> int:
+    """Retry count for ``on_sync_failure="retry"`` (``TORCHMETRICS_TPU_SYNC_RETRIES``)."""
+    return _env_int(SYNC_RETRIES_ENV, DEFAULT_SYNC_RETRIES)
+
+
+def default_dispatch_retries() -> int:
+    """Warm-dispatch retry count (``TORCHMETRICS_TPU_DISPATCH_RETRIES``, default 0)."""
+    return _env_int(DISPATCH_RETRIES_ENV, 0)
+
+
+def default_dispatch_deadline() -> Optional[float]:
+    """Watchdog deadline in seconds (``TORCHMETRICS_TPU_DISPATCH_DEADLINE``), or None."""
+    raw = os.environ.get(DISPATCH_DEADLINE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(f"{DISPATCH_DEADLINE_ENV} must be a number of seconds, got {raw!r}")
+    return value if value > 0 else None
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with jitter.
+
+    ``delay(k) = min(max_delay, base_delay * multiplier**k) * (1 + U(-jitter, jitter))``
+    for attempt k in [0, max_retries). ``jitter=0`` makes the schedule exactly
+    deterministic (tests); the default de-synchronises a fleet retrying the
+    same dead rendezvous so the recovered peer is not hit by a thundering herd.
+    """
+
+    max_retries: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0 <= self.jitter < 1:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+
+def backoff_delays(policy: RetryPolicy, seed: Optional[int] = None) -> Iterator[float]:
+    """The policy's delay schedule, one value per retry attempt.
+
+    >>> [round(d, 3) for d in backoff_delays(RetryPolicy(max_retries=4, jitter=0.0))]
+    [0.05, 0.1, 0.2, 0.4]
+    """
+    import random
+
+    rng = random.Random(seed)
+    for k in range(policy.max_retries):
+        delay = min(policy.max_delay, policy.base_delay * policy.multiplier**k)
+        if policy.jitter:
+            delay *= 1.0 + rng.uniform(-policy.jitter, policy.jitter)
+        yield delay
+
+
+def call_with_retries(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    retry_on: Union[Type[BaseException], Tuple[Type[BaseException], ...]] = Exception,
+    no_retry_on: Tuple[Type[BaseException], ...] = (DispatchStallError,),
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    what: str = "call",
+) -> Any:
+    """Run ``fn`` with up to ``policy.max_retries`` backed-off re-attempts.
+
+    ``no_retry_on`` exceptions propagate immediately even when they match
+    ``retry_on`` — a :class:`DispatchStallError` by default: re-running a call
+    that just hung for its whole deadline would park the loop for another one.
+    ``on_retry(attempt, error, delay)`` fires before each sleep (observability
+    seam; the executor counts these into its stats).
+    """
+    delays = backoff_delays(policy)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except no_retry_on:
+            raise
+        except retry_on as err:
+            delay = next(delays, None)
+            if delay is None:
+                raise  # budget exhausted: propagate the final failure
+            attempt += 1
+            if on_retry is not None:
+                on_retry(attempt, err, delay)
+            else:
+                rank_zero_debug(
+                    f"torchmetrics_tpu retry: {what} failed ({type(err).__name__}: {err});"
+                    f" attempt {attempt}/{policy.max_retries} in {delay:.3f}s"
+                )
+            sleep(delay)
+
+
+# --------------------------------------------------------------------- watchdog
+
+@contextmanager
+def stall_watchdog(
+    deadline: Optional[float],
+    what: str = "compiled dispatch",
+    status: Optional[Callable[[], Any]] = None,
+) -> Generator[None, None, None]:
+    """Bound a blocking call: raise :class:`DispatchStallError` at ``deadline``
+    seconds instead of hanging the loop forever.
+
+    A wedged donating dispatch (or a rendezvous whose peer died) blocks inside
+    the runtime where no Python timeout can reach, so the watchdog thread
+    delivers a real SIGINT to the main thread (``signal.pthread_kill`` — an OS
+    signal actually wakes a blocked syscall, unlike ``interrupt_main``'s
+    flag-only path, which is the fallback) and the context manager converts
+    the resulting ``KeyboardInterrupt`` into the typed error, attaching
+    ``status()`` breadcrumbs (e.g. ``executor_status``) so the operator sees
+    *which* call wedged and in what state. A custom SIGINT handler installed
+    by the application (including :func:`install_preemption_handler`) runs
+    first — a preemption flush before the stall error is the intended
+    interplay.
+
+    Only the MAIN thread can receive the interrupt: on any other thread the
+    watchdog is a no-op (logged once at debug level). ``deadline`` None/<=0
+    disables the guard entirely. The stalled call itself cannot be cancelled —
+    treat a stall as this process's cue to checkpoint local state and exit
+    (docs/DURABILITY.md), not to retry.
+    """
+    if deadline is None or deadline <= 0:
+        yield
+        return
+    if threading.current_thread() is not threading.main_thread():
+        rank_zero_debug(
+            f"torchmetrics_tpu stall_watchdog: not on the main thread; cannot deliver"
+            f" the interrupt — {what} runs unguarded"
+        )
+        yield
+        return
+    main_ident = threading.main_thread().ident
+    done = threading.Event()
+    fired = threading.Event()
+
+    def deliver() -> None:
+        import signal as _signal
+
+        try:
+            # a real OS signal: wakes the main thread even inside a blocked
+            # syscall (time.sleep, lock waits, runtime rendezvous polls)
+            _signal.pthread_kill(main_ident, _signal.SIGINT)
+            return
+        except (AttributeError, ProcessLookupError, OSError):
+            pass
+        import _thread
+
+        _thread.interrupt_main()  # flag-only fallback: fires at the next bytecode
+
+    def watch() -> None:
+        if not done.wait(deadline) and not done.is_set():
+            fired.set()
+            deliver()
+
+    watcher = threading.Thread(target=watch, name="tm_tpu_watchdog", daemon=True)
+    watcher.start()
+    try:
+        yield
+    except KeyboardInterrupt:
+        done.set()
+        if fired.is_set():
+            breadcrumbs = None
+            if status is not None:
+                try:
+                    breadcrumbs = status()
+                except Exception as err:  # breadcrumbs must never mask the stall itself
+                    rank_zero_debug(f"torchmetrics_tpu stall_watchdog: status() failed ({err})")
+                    breadcrumbs = None
+            raise DispatchStallError(
+                f"{what} did not complete within {deadline}s (stalled runtime call;"
+                " checkpoint local state and restart this process)"
+                + (f"; executor_status={breadcrumbs}" if breadcrumbs is not None else ""),
+                executor_status=breadcrumbs,
+            ) from None
+        raise
+    else:
+        done.set()
+        if fired.is_set():
+            # the call returned inside the race window after the watchdog fired:
+            # absorb the in-flight interrupt so it cannot detonate at an
+            # arbitrary later bytecode boundary
+            t_end = time.monotonic() + 0.2
+            try:
+                while time.monotonic() < t_end:
+                    time.sleep(0.005)
+                rank_zero_debug(
+                    f"torchmetrics_tpu stall_watchdog: {what} completed at the deadline;"
+                    " pending interrupt not observed within the absorption window"
+                )
+            except KeyboardInterrupt:
+                pass
+    finally:
+        done.set()

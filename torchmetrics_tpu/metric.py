@@ -96,7 +96,15 @@ class Metric:
             - ``on_sync_failure``: what a failed/timed-out host sync does:
               ``"raise"`` (default) propagates the error with local state
               intact; ``"local"`` degrades to local-only state with a
-              rank-zero warning, flagged via :attr:`last_sync_ok`.
+              rank-zero warning, flagged via :attr:`last_sync_ok`;
+              ``"retry"`` re-attempts the gather with capped exponential
+              backoff (``sync_retries`` / ``TORCHMETRICS_TPU_SYNC_RETRIES``
+              attempts, io/retry.py) and propagates only when the budget is
+              exhausted.
+            - ``sync_retries``: how many backed-off re-attempts
+              ``on_sync_failure="retry"`` makes before giving up; ``None``
+              (default) follows ``TORCHMETRICS_TPU_SYNC_RETRIES`` (3 when
+              unset).
             - ``reduce``: when the declared ``dist_reduce_fx`` runs:
               ``"step"`` keeps per-step collective semantics
               (``dist_sync_on_step`` forwards sync every batch); ``"deferred"``
@@ -163,8 +171,15 @@ class Metric:
         elif not isinstance(self.sync_timeout, (int, float)) or isinstance(self.sync_timeout, bool) or self.sync_timeout <= 0:
             raise ValueError(f"Expected keyword argument `sync_timeout` to be a positive number of seconds but got {self.sync_timeout}")
         self.on_sync_failure = kwargs.pop("on_sync_failure", "raise")
-        if self.on_sync_failure not in ("raise", "local"):
-            raise ValueError(f"Expected keyword argument `on_sync_failure` to be 'raise' or 'local' but got {self.on_sync_failure}")
+        if self.on_sync_failure not in ("raise", "local", "retry"):
+            raise ValueError(
+                f"Expected keyword argument `on_sync_failure` to be 'raise', 'local' or 'retry' but got {self.on_sync_failure}"
+            )
+        self.sync_retries = kwargs.pop("sync_retries", None)
+        if self.sync_retries is not None and (
+            not isinstance(self.sync_retries, int) or isinstance(self.sync_retries, bool) or self.sync_retries < 0
+        ):
+            raise ValueError(f"Expected keyword argument `sync_retries` to be a non-negative int but got {self.sync_retries}")
         self._last_sync_ok = True
         self.reduce_policy = kwargs.pop("reduce", None)
         if self.reduce_policy is None:
@@ -431,17 +446,22 @@ class Metric:
             self._update_count += 1
             ex = self._get_executor()
             if ex is not None:
+                handled = False
                 try:
                     with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                        if ex.run_update(args, kwargs):
-                            self._mark_unreduced()
-                            return
+                        handled = ex.run_update(args, kwargs)
                 except BaseException:
                     # the executor restored _state itself (recovery reference);
                     # only the wrapper bookkeeping needs unwinding
                     self._update_count, self._computed = pre_count, pre_computed
                     self.__dict__["_reduced"] = pre_reduced
                     raise
+                if handled:
+                    self._mark_unreduced()
+                    # post-commit: an observer raising here (e.g. a simulated
+                    # preemption) must NOT unwind the committed update
+                    self._notify_update()
+                    return
             snapshot = self._state_snapshot()
             try:
                 # per-metric profiler scope (SURVEY §5: the TPU analogue of the
@@ -463,6 +483,7 @@ class Metric:
             self._mark_unreduced()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+            self._notify_update()
 
         return wrapped_func
 
@@ -506,6 +527,34 @@ class Metric:
     def compute(self) -> Any:  # overridden by subclass; rebound in __init__
         raise NotImplementedError
 
+    # ------------------------------------------------------ update observers
+    def add_update_observer(self, callback: Callable[["Metric"], None]) -> Callable[[], None]:
+        """Register ``callback(metric)`` to fire after every COMMITTED
+        top-level ``update``/``forward`` — the autosave trigger point
+        (io/checkpoint.py). Mid-``forward`` internal updates (where the live
+        state transiently holds batch-only values) never notify, so an
+        observer always sees a consistent accumulated state. Returns a
+        zero-argument detach function."""
+        observers = self.__dict__.setdefault("_update_observers", [])
+        observers.append(callback)
+
+        def detach() -> None:
+            obs = self.__dict__.get("_update_observers")
+            if obs is not None and callback in obs:
+                obs.remove(callback)
+
+        return detach
+
+    def _notify_update(self) -> None:
+        """Fire update observers — only at top level (not inside forward's
+        internal update pair, whose intermediate states are not checkpoints)."""
+        if self.__dict__.get("_forward_depth", 0):
+            return
+        observers = self.__dict__.get("_update_observers")
+        if observers:
+            for callback in tuple(observers):
+                callback(self)
+
     # ----------------------------------------------------------- forward paths
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate into global state AND return the batch value (metric.py:281-312).
@@ -513,6 +562,18 @@ class Metric:
         When the executor is enabled, the whole forward — batch-state update,
         batch-value compute, and the global-state merge — runs as ONE compiled
         computation with the accumulated state donated (ops/executor.py)."""
+        # the internal update pair must not fire update observers (their
+        # intermediate states are batch-only, not valid checkpoints); the
+        # single post-commit notification below covers the whole forward
+        self.__dict__["_forward_depth"] = self.__dict__.get("_forward_depth", 0) + 1
+        try:
+            batch_val = self._forward_impl(*args, **kwargs)
+        finally:
+            self.__dict__["_forward_depth"] -= 1
+        self._notify_update()
+        return batch_val
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Any:
         self._fold_pending()  # sharded restore: re-reduce before merging batches
         ex = self._get_executor()
         if ex is not None:
@@ -678,12 +739,29 @@ class Metric:
         """The ``process_allgather`` path under ``sync_timeout`` /
         ``on_sync_failure`` (ISSUE 2 tentpole #3): ``"raise"`` propagates with
         local state intact; ``"local"`` keeps serving local-only values with a
-        rank-zero warning, observable via :attr:`last_sync_ok`."""
-        try:
-            synced = {
+        rank-zero warning, observable via :attr:`last_sync_ok`; ``"retry"``
+        re-attempts the whole gather with capped exponential backoff
+        (io/retry.py) before propagating — the transient-abort case (a peer
+        restarting mid-rendezvous) recovers without losing the epoch."""
+
+        def gather_all() -> Dict[str, Any]:
+            return {
                 k: host_sync_value(v, self._reductions.get(k), timeout=self.sync_timeout)
                 for k, v in self._state.items()
             }
+
+        try:
+            if self.on_sync_failure == "retry":
+                from torchmetrics_tpu.io.retry import RetryPolicy, call_with_retries, default_sync_retries
+
+                retries = self.sync_retries if self.sync_retries is not None else default_sync_retries()
+                synced = call_with_retries(
+                    gather_all,
+                    RetryPolicy(max_retries=retries),
+                    what=f"multi-host sync of {type(self).__name__}",
+                )
+            else:
+                synced = gather_all()
         except Exception as err:
             if self.on_sync_failure != "local":
                 raise
@@ -861,6 +939,18 @@ class Metric:
         SAME ``N`` across all fields.
         """
         if mode == "off":
+            # check_finite is an explicit request and must still run — it used
+            # to be silently skipped here, letting a NaN-poisoned checkpoint
+            # through whenever structural validation was disabled
+            if check_finite:
+                for name, value in state.items():
+                    if name in self._RESERVED_STATE_KEYS:
+                        continue
+                    if isinstance(value, (list, tuple)):
+                        for i, el in enumerate(value):
+                            self._check_field_finite(name, el, index=i)
+                    else:
+                        self._check_field_finite(name, value, per_shard=sharded)
             return state
         if mode not in ("strict", "cast"):
             raise ValueError(f"validate must be 'strict', 'cast' or 'off', got {mode!r}")
@@ -923,16 +1013,30 @@ class Metric:
                         " (use validate='cast' to convert)"
                     )
             if check_finite:
-                self._check_field_finite(name, out[name])
+                self._check_field_finite(name, out[name], per_shard=sharded)
         if sharded and len(set(shard_counts.values())) > 1:
             raise StateCorruptionError(
                 f"{type(self).__name__}: sharded fields disagree on the shard count: {shard_counts}"
             )
         return out
 
-    def _check_field_finite(self, name: str, value: Any, index: Optional[int] = None) -> None:
+    def _check_field_finite(
+        self, name: str, value: Any, index: Optional[int] = None, per_shard: bool = False
+    ) -> None:
         arr = jnp.asarray(value)
         if not jnp.issubdtype(arr.dtype, jnp.floating):
+            return
+        if per_shard and arr.ndim >= 1:
+            # stacked sharded (deferred) layout: scan every shard and NAME the
+            # poisoned ones — a single per-device NaN would otherwise fold into
+            # every reduced value at the next re-reduce
+            shard_ok = jnp.all(jnp.isfinite(arr).reshape(arr.shape[0], -1), axis=1)
+            if not bool(jnp.all(shard_ok)):
+                bad = [int(i) for i in np.flatnonzero(~np.asarray(shard_ok))]
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: sharded field {name!r} contains non-finite values"
+                    f" in shard(s) {bad} (check_finite=True rejects NaN/Inf accumulators)"
+                )
             return
         if not bool(jnp.all(jnp.isfinite(arr))):
             where = f"{name!r}[{index}]" if index is not None else f"{name!r}"
@@ -1305,6 +1409,10 @@ class Metric:
         # drop the wrapped bound methods; re-created in __setstate__ (metric.py:700-719)
         state.pop("update", None)
         state.pop("compute", None)
+        # observers are process-local callbacks (autosavers, fault hooks): a
+        # pickled/cloned copy must not inherit another instance's triggers
+        state.pop("_update_observers", None)
+        state.pop("_forward_depth", None)
         state.pop("_update_fn", None)
         state.pop("_compute_fn", None)
         state.pop("_update_signature", None)
@@ -1330,6 +1438,7 @@ class Metric:
         self.__dict__.setdefault("_state_shared", False)
         self.__dict__.setdefault("sync_timeout", None)
         self.__dict__.setdefault("on_sync_failure", "raise")
+        self.__dict__.setdefault("sync_retries", None)
         self.__dict__.setdefault("_last_sync_ok", True)
         self.__dict__.setdefault("reduce_policy", default_reduce_policy())
         self.__dict__.setdefault("_reduced", True)

@@ -59,6 +59,7 @@ Synced path
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -66,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utils.exceptions import DispatchStallError
 from torchmetrics_tpu.utils.prints import rank_zero_debug
 
 # CPU (and some other) backends do not implement buffer donation; jax warns on
@@ -292,6 +294,7 @@ def _new_stats() -> Dict[str, Any]:
         "skipped_calls": 0,  # per-call ineligibility (tracers, odd inputs)
         "dispatch_failures": 0,   # warm-executable failures propagated to the caller
         "recovery_restores": 0,   # donated states reinstalled from the host snapshot
+        "dispatch_retries": 0,    # warm failures re-attempted after the restore (io/retry.py)
     }
 
 
@@ -306,6 +309,12 @@ class _ExecutorBase:
         self._pad_validated = False
         self._bucketing_ok = True
         self._keep_recovery = recovery_enabled_default()
+        # most recent committed donating call's host-side recovery snapshot,
+        # kept so the Autosaver (io/checkpoint.py) can serialize it instead of
+        # fetching the live state again — zero extra device sync per autosave.
+        # MetricExecutor: (described_update_count, {field: np}); Collection:
+        # {leader: (count, {field: np})}. None when the last call copied.
+        self._last_recovery: Any = None
 
     def _owner_name(self) -> str:
         return type(self).__name__
@@ -352,6 +361,67 @@ class _ExecutorBase:
         new_state.update(restored)
         object.__setattr__(metric, "_state", new_state)
         metric.__dict__["_state_escaped"] = True
+
+    def _guarded_dispatch(
+        self,
+        primary: Callable[[], Any],
+        retry_call: Callable[[], Any],
+        fresh: bool,
+        restore: Callable[[], None],
+    ) -> Any:
+        """Run a compiled dispatch under the stall watchdog with transient-
+        failure retries (io/retry.py; docs/DURABILITY.md).
+
+        ``primary`` may donate live buffers; ``retry_call`` must build its own
+        input copies (it runs only after ``restore`` reinstalled the recovery
+        snapshot, so the live state is valid again and retries can never
+        double-donate). A fresh key's failure propagates raw (trace/compile
+        problem — the sticky eager fallback upstream is correct); a warm
+        failure exhausting its retry budget raises :class:`_DispatchFailure`
+        wrapping the final error. A :class:`DispatchStallError` is never
+        retried: re-running a call that just hung for its whole deadline would
+        park the loop for another one.
+        """
+        from torchmetrics_tpu.io.retry import (
+            RetryPolicy,
+            backoff_delays,
+            default_dispatch_deadline,
+            default_dispatch_retries,
+            stall_watchdog,
+        )
+
+        deadline = default_dispatch_deadline()
+
+        def once(call: Callable[[], Any]) -> Any:
+            with stall_watchdog(
+                deadline, what=f"donated dispatch for {self._owner_name()}", status=self.stats_dict
+            ):
+                return call()
+
+        try:
+            return once(primary)
+        except Exception as err:
+            if fresh:
+                raise  # trace/compile failure: live state was never at risk
+            restore()
+            self.stats["dispatch_failures"] += 1
+            retries = default_dispatch_retries()
+            if retries and not isinstance(err, DispatchStallError):
+                for delay in backoff_delays(RetryPolicy(max_retries=retries)):
+                    time.sleep(delay)
+                    self.stats["dispatch_retries"] += 1
+                    try:
+                        return once(retry_call)
+                    except DispatchStallError as stalled:
+                        err = stalled
+                        break
+                    except Exception as again:
+                        rank_zero_debug(
+                            f"torchmetrics_tpu executor: retry dispatch for {self._owner_name()}"
+                            f" failed again ({type(again).__name__}: {again})"
+                        )
+                        err = again
+            raise _DispatchFailure(err)
 
     def _get_fn(self, key: Any, builder: Callable[[], Callable]) -> Tuple[Callable, bool]:
         fn = self._cache.get(key)
@@ -542,6 +612,8 @@ class MetricExecutor(_ExecutorBase):
             return self._run_update(args, kwargs)
         except _DispatchFailure as df:
             raise df.original
+        except DispatchStallError:
+            raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:  # sticky: a metric that cannot trace stays eager
             self._disable(f"{type(err).__name__}: {err}")
             return False
@@ -568,23 +640,23 @@ class MetricExecutor(_ExecutorBase):
         do_probe = padded and not self._pad_validated
         oracle = m.functional_update(state, *args, **kwargs) if do_probe else None
 
-        try:
-            # profiler span naming the metric so wall time attributes to it
-            # (ISSUE 3 observability; the traced body carries matching
-            # jax.named_scope annotations via functional_update)
-            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
-                if padded:
-                    new_state = fn(state_in, jnp.asarray(n, jnp.int32), *call_leaves)
-                    self.stats["padded_calls"] += 1
-                else:
-                    new_state = fn(state_in, *call_leaves)
-        except Exception as err:
-            if fresh:
-                raise  # trace/compile failure: live state was never at risk
-            if not need_copy:
-                self._restore(m, recovery)
-            self.stats["dispatch_failures"] += 1
-            raise _DispatchFailure(err)
+        def call_fn(state_arg):
+            if padded:
+                return fn(state_arg, jnp.asarray(n, jnp.int32), *call_leaves)
+            return fn(state_arg, *call_leaves)
+
+        # profiler span naming the metric so wall time attributes to it
+        # (ISSUE 3 observability; the traced body carries matching
+        # jax.named_scope annotations via functional_update)
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+            new_state = self._guarded_dispatch(
+                lambda: call_fn(state_in),
+                lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
+                fresh,
+                lambda: self._restore(m, recovery) if not need_copy else None,
+            )
+        if padded:
+            self.stats["padded_calls"] += 1
 
         if do_probe:
             self.stats["probes"] += 1
@@ -604,6 +676,10 @@ class MetricExecutor(_ExecutorBase):
         self.stats["copied_calls" if need_copy else "donated_calls"] += 1
         object.__setattr__(m, "_state", dict(new_state))
         m.__dict__["_state_escaped"] = False
+        # the wrapper bumped _update_count before this call, so the pre-call
+        # recovery snapshot describes exactly count-1 committed updates — the
+        # Autosaver reuses it as a free (already host-side) checkpoint source
+        self._last_recovery = None if recovery is None else (int(m._update_count) - 1, recovery)
         return True
 
     def run_forward(self, args: tuple, kwargs: dict) -> Tuple[bool, Any]:
@@ -619,6 +695,8 @@ class MetricExecutor(_ExecutorBase):
             return self._run_forward(args, kwargs)
         except _DispatchFailure as df:
             raise df.original
+        except DispatchStallError:
+            raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
             self._disable(f"{type(err).__name__}: {err}")
             return False, None
@@ -658,20 +736,21 @@ class MetricExecutor(_ExecutorBase):
         oracle = self._forward_oracle(variant, state, args, kwargs, count) if do_probe else None
 
         count_arr = jnp.asarray(count, jnp.int32)
-        try:
-            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
-                if padded:
-                    new_state, value = fn(state_in, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
-                    self.stats["padded_calls"] += 1
-                else:
-                    new_state, value = fn(state_in, count_arr, *call_leaves)
-        except Exception as err:
-            if fresh:
-                raise  # trace/compile failure: live state was never at risk
-            if not need_copy:
-                self._restore(m, recovery)
-            self.stats["dispatch_failures"] += 1
-            raise _DispatchFailure(err)
+
+        def call_fn(state_arg):
+            if padded:
+                return fn(state_arg, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
+            return fn(state_arg, count_arr, *call_leaves)
+
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+            new_state, value = self._guarded_dispatch(
+                lambda: call_fn(state_in),
+                lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
+                fresh,
+                lambda: self._restore(m, recovery) if not need_copy else None,
+            )
+        if padded:
+            self.stats["padded_calls"] += 1
 
         if do_probe:
             self.stats["probes"] += 1
@@ -690,6 +769,8 @@ class MetricExecutor(_ExecutorBase):
         m._computed = None
         m._to_sync = m.sync_on_compute
         m._should_unsync = True
+        # snapshot taken pre-bump: it describes count-1 committed updates
+        self._last_recovery = None if recovery is None else (int(m._update_count) - 1, recovery)
         return True, value
 
 
@@ -706,6 +787,19 @@ class CollectionExecutor(_ExecutorBase):
 
     def _owner_name(self) -> str:
         return f"MetricCollection[{', '.join(self._coll._modules)}]"
+
+    def _cache_collection_recovery(self, donated, leader_execs) -> None:
+        """Keep the step's per-group recovery snapshots for Autosaver reuse —
+        only when EVERY group donated (and so has one); a partial set cannot
+        describe a consistent collection-wide checkpoint."""
+        if len(donated) == len(leader_execs) and all(snap is not None for *_, snap in donated):
+            # _install already bumped each leader: snapshots describe count-1
+            self._last_recovery = {
+                name: (int(self._coll._modules[name]._update_count) - 1, snap)
+                for name, _, _, snap in donated
+            }
+        else:
+            self._last_recovery = None
 
     def _restore_groups(self, donated) -> None:
         """Reinstall recovery snapshots for every donated group after a failed
@@ -852,6 +946,8 @@ class CollectionExecutor(_ExecutorBase):
             return self._run_update(args, kwargs, leader_execs)
         except _DispatchFailure as df:
             raise df.original
+        except DispatchStallError:
+            raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
             self._disable(f"{type(err).__name__}: {err}")
             return False
@@ -895,19 +991,26 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
-        try:
-            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
-                if padded:
-                    new_states = fn(states, jnp.asarray(n, jnp.int32), *call_leaves)
-                    self.stats["padded_calls"] += 1
-                else:
-                    new_states = fn(states, *call_leaves)
-        except Exception as err:
-            if fresh:
-                raise  # trace/compile failure: every group's input was a copy
-            self._restore_groups(donated)
-            self.stats["dispatch_failures"] += 1
-            raise _DispatchFailure(err)
+        def call_fn(states_arg):
+            if padded:
+                return fn(states_arg, jnp.asarray(n, jnp.int32), *call_leaves)
+            return fn(states_arg, *call_leaves)
+
+        def copied_states():
+            return {
+                name: _tree_copy({k: m._state[k] for k in m._defaults})
+                for name, m, _, _ in leader_execs
+            }
+
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+            new_states = self._guarded_dispatch(
+                lambda: call_fn(states),
+                lambda: call_fn(copied_states()),
+                fresh,
+                lambda: self._restore_groups(donated),
+            )
+        if padded:
+            self.stats["padded_calls"] += 1
 
         if do_probe:
             self.stats["probes"] += 1
@@ -926,6 +1029,7 @@ class CollectionExecutor(_ExecutorBase):
         self.stats["copied_calls" if copied else "donated_calls"] += 1
         for name, _, cg, _ in leader_execs:
             self._install(name, new_states[name], cg, bump_count=True)
+        self._cache_collection_recovery(donated, leader_execs)
         return True
 
     def run_forward(self, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
@@ -958,6 +1062,8 @@ class CollectionExecutor(_ExecutorBase):
             return self._run_forward(args, kwargs, leader_execs)
         except _DispatchFailure as df:
             raise df.original
+        except DispatchStallError:
+            raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
             self._disable(f"{type(err).__name__}: {err}")
             return None
@@ -1013,19 +1119,26 @@ class CollectionExecutor(_ExecutorBase):
                     oracle_values[member] = coll._modules[member].functional_compute(bs)
             oracle = (oracle_states, oracle_values)
 
-        try:
-            with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
-                if padded:
-                    new_states, values = fn(states, counts, jnp.asarray(n, jnp.int32), *call_leaves)
-                    self.stats["padded_calls"] += 1
-                else:
-                    new_states, values = fn(states, counts, *call_leaves)
-        except Exception as err:
-            if fresh:
-                raise  # trace/compile failure: every group's input was a copy
-            self._restore_groups(donated)
-            self.stats["dispatch_failures"] += 1
-            raise _DispatchFailure(err)
+        def call_fn(states_arg):
+            if padded:
+                return fn(states_arg, counts, jnp.asarray(n, jnp.int32), *call_leaves)
+            return fn(states_arg, counts, *call_leaves)
+
+        def copied_states():
+            return {
+                name: _tree_copy({k: m._state[k] for k in m._defaults})
+                for name, m, _, _ in leader_execs
+            }
+
+        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+            new_states, values = self._guarded_dispatch(
+                lambda: call_fn(states),
+                lambda: call_fn(copied_states()),
+                fresh,
+                lambda: self._restore_groups(donated),
+            )
+        if padded:
+            self.stats["padded_calls"] += 1
 
         if do_probe:
             self.stats["probes"] += 1
@@ -1044,6 +1157,7 @@ class CollectionExecutor(_ExecutorBase):
         self.stats["copied_calls" if copied else "donated_calls"] += 1
         for name, _, cg, _ in leader_execs:
             self._install(name, new_states[name], cg, bump_count=True)
+        self._cache_collection_recovery(donated, leader_execs)
         return dict(values)
 
 
@@ -1301,6 +1415,44 @@ def make_deferred_collection_step(
     (default: every argument sharded along ``axis_name`` on its leading dim).
     """
     return DeferredCollectionStep(collection, mesh, axis_name, pack_values, batch_specs, donate)
+
+
+def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """The most recent donating dispatch's host-side recovery snapshot, shaped
+    like a ``state()`` export — the Autosaver's free checkpoint source
+    (io/checkpoint.py: the forced copy already exists; serializing it costs
+    zero extra device sync).
+
+    Returns ``(update_count, export)`` where the export carries the reserved
+    ``"_update_count"`` key(s) like a real ``state()`` export, or None when no
+    snapshot exists or it is STALE — i.e. not exactly one committed update
+    behind the live state (state escaped, eager fallback engaged, recovery
+    disabled): a stale snapshot would silently checkpoint old history.
+    """
+    ex = getattr(obj, "_executor_obj", None)
+    rec = getattr(ex, "_last_recovery", None)
+    if rec is None:
+        return None
+    if isinstance(ex, CollectionExecutor):
+        coll = ex._coll
+        export: Dict[str, Any] = {}
+        counts = []
+        for leader, (count, snap) in rec.items():
+            if int(coll._modules[leader]._update_count) != count + 1:
+                return None
+            entry = dict(snap)
+            entry[STATE_COUNT_KEY] = int(count)
+            export[leader] = entry
+            counts.append(int(count))
+        if not counts:
+            return None
+        return max(counts), export
+    count, snap = rec
+    if int(ex._metric._update_count) != count + 1:
+        return None
+    export = dict(snap)
+    export[STATE_COUNT_KEY] = int(count)
+    return int(count), export
 
 
 def executor_stats(obj: Any) -> Dict[str, Any]:

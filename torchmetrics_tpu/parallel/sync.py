@@ -17,6 +17,7 @@ the PartitionSpec-aware generalisation of the reference's ``dist_reduce_fx``.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -72,11 +73,69 @@ def _process_allgather(value: Any) -> Any:
     return multihost_utils.process_allgather(value)
 
 
-#: the shared single-worker pool for bounded gathers — one thread serves every
-#: successful sync instead of a fresh ThreadPoolExecutor per call; retired (and
-#: lazily replaced) only when a timeout leaves its worker parked on an
-#: abandoned gather, so repeated timeouts never accumulate live pools
-_gather_pool: Optional[Any] = None
+class _GatherWorker:
+    """One dedicated DAEMON thread serving bounded gathers.
+
+    The previous implementation parked a non-daemon ``ThreadPoolExecutor``
+    worker on every abandoned gather: under repeated ``on_sync_failure="local"``
+    degradation against a dead peer, each timeout leaked one live worker — and
+    because pool threads are non-daemon, a single permanently-hung rendezvous
+    wedged interpreter shutdown at the atexit join. This worker is daemon (a
+    parked gather can never block process exit), and retirement is
+    deterministic: a timed-out worker is marked retired, exits the moment its
+    abandoned gather finally returns (or never runs again if it doesn't), and
+    the module respawns exactly one replacement lazily.
+    """
+
+    def __init__(self) -> None:
+        import queue
+
+        self._jobs: Any = queue.Queue()
+        self._retired = False
+        self._thread = threading.Thread(target=self._loop, name="tm_tpu_sync", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return  # retired while idle
+            fn, value, box, done = job
+            try:
+                box["ok"] = fn(value)
+            except BaseException as err:
+                # not swallowed: _gather_with_timeout re-raises this on the
+                # waiting thread (unless the waiter already timed out and
+                # abandoned the gather, in which case nobody is listening)
+                box["err"] = err
+                from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+                rank_zero_debug(f"tm_tpu gather worker: {type(err).__name__}: {err}")
+            done.set()
+            if self._retired:
+                return  # abandoned mid-gather: the result arrived too late to matter
+
+    def usable(self) -> bool:
+        return not self._retired and self._thread.is_alive()
+
+    def submit(self, fn: Callable, value: Any):
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        self._jobs.put((fn, value, box, done))
+        return box, done
+
+    def retire(self) -> None:
+        """Mark retired; an idle worker exits now, a parked one exits as soon
+        as its abandoned gather clears."""
+        self._retired = True
+        self._jobs.put(None)
+
+
+#: the shared worker for bounded gathers — one daemon thread serves every
+#: successful sync; retired (and lazily replaced) when a timeout leaves it
+#: parked on an abandoned gather, so repeated timeouts never accumulate live
+#: workers and a permanently-hung rendezvous cannot block interpreter exit
+_gather_pool: Optional[_GatherWorker] = None
 
 
 def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
@@ -85,34 +144,35 @@ def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
     A hung collective (the classic multi-host failure mode: one process died
     mid-epoch and the rest block forever inside the rendezvous) surfaces as
     :class:`SyncTimeoutError` instead of a silent hang. The abandoned gather
-    thread cannot be cancelled — it parks until the runtime gives up — so a
-    timeout should be treated as this process's cue to checkpoint local state
-    and exit, not to retry in a loop.
+    thread cannot be cancelled — it parks (daemon, self-retiring) until the
+    runtime gives up. A bounded retry against a *transiently* dead peer is
+    reasonable (``on_sync_failure="retry"``, io/retry.py) — each attempt costs
+    at most one parked worker — but a timeout that repeats is this process's
+    cue to checkpoint local state (io/checkpoint.py) and exit.
     """
     if timeout is None:
         return _process_allgather(value)
     global _gather_pool
-    from concurrent.futures import ThreadPoolExecutor
-    from concurrent.futures import TimeoutError as _FutTimeout
 
     # deferred: utils/__init__ itself imports from this module (reduce/class_reduce)
     from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 
-    pool = _gather_pool
-    if pool is None:
-        pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tm_tpu_sync")
-        _gather_pool = pool
-    fut = pool.submit(_process_allgather, value)
-    try:
-        return fut.result(timeout=timeout)
-    except _FutTimeout:
-        # the worker is now parked on the abandoned gather: retire this pool so
-        # the next sync starts with a free worker instead of queueing behind it
+    worker = _gather_pool
+    if worker is None or not worker.usable():
+        worker = _GatherWorker()
+        _gather_pool = worker
+    box, done = worker.submit(_process_allgather, value)
+    if not done.wait(timeout):
+        # the worker is now parked on the abandoned gather: retire it so the
+        # next sync starts with a free worker instead of queueing behind it
         _gather_pool = None
-        pool.shutdown(wait=False)
+        worker.retire()
         raise SyncTimeoutError(
             f"multi-host state sync (process_allgather) did not complete within {timeout}s"
-        ) from None
+        )
+    if "err" in box:
+        raise box["err"]
+    return box["ok"]
 
 
 def in_named_axis_context(axis_name: Union[str, Sequence[str]]) -> bool:

@@ -15,8 +15,15 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
   the compiled call consumed its donated inputs.
 - :func:`hang_sync` / :func:`break_sync` — stall or break the multi-host
   ``process_allgather`` seam (drives ``sync_timeout`` / ``on_sync_failure``).
+- :func:`flaky_sync` — fail the sync seam exactly k times then succeed
+  (drives ``on_sync_failure="retry"`` backoff, io/retry.py).
 - :func:`corrupt_state` — damage a state pytree (shape/dtype/structure/NaN)
   the way a torn checkpoint would (drives ``load_state(validate=...)``).
+- :func:`torn_write` — truncate/zero/bit-flip a snapshot FILE the way a
+  crash mid-write presents (drives ``restore_state``'s torn-write detection
+  and rotating fallback, io/checkpoint.py).
+- :func:`preempt_after` — raise a simulated preemption after the n-th
+  COMMITTED update (drives autosave + kill/restore chaos tests).
 
 All context managers restore the patched seam on exit, including when the
 body raises. They are process-local and NOT thread-safe (they patch module
@@ -36,6 +43,13 @@ class FaultInjected(RuntimeError):
     """Default exception raised by the injection primitives — distinct from
     anything the framework raises itself, so tests can assert the *injected*
     fault (and nothing else) escaped."""
+
+
+class PreemptionInjected(BaseException):
+    """Raised by :func:`preempt_after` — a BaseException (like the
+    ``SystemExit``/``KeyboardInterrupt`` a real SIGTERM path produces) so
+    ordinary ``except Exception`` recovery code cannot accidentally swallow
+    the simulated kill."""
 
 
 # --------------------------------------------------------------------- inputs
@@ -125,25 +139,32 @@ def raise_in_compute(metric: Any, exc: Optional[BaseException] = None) -> Genera
 
 @contextmanager
 def fail_dispatch(
-    exc: Optional[BaseException] = None, consume: bool = True
+    exc: Optional[BaseException] = None, consume: bool = True, fail_n: Optional[int] = None
 ) -> Generator[None, None, None]:
-    """Make every donated-state executor dispatch raise.
+    """Make donated-state executor dispatches raise.
 
     With ``consume=True`` (default) the real compiled function is invoked
     first — donated input buffers are genuinely consumed before the failure,
     the worst case the executor's host-side recovery reference exists for.
-    Patches ``_ExecutorBase._get_fn`` class-wide; affects all metrics until
-    exit.
+    ``fail_n=k`` fails only the first k dispatches then passes calls through
+    untouched (drives the warm-dispatch retry path, io/retry.py); ``None``
+    (default) fails every dispatch. Patches ``_ExecutorBase._get_fn``
+    class-wide; affects all metrics until exit.
     """
     from torchmetrics_tpu.ops import executor as executor_mod
 
     orig = executor_mod._ExecutorBase._get_fn
     error = exc if exc is not None else FaultInjected("injected dispatch failure")
+    remaining = {"n": fail_n}
 
     def patched(self: Any, key: Any, builder: Any):
         fn, fresh = orig(self, key, builder)
 
         def failing(*args: Any, **kwargs: Any) -> Any:
+            if remaining["n"] is not None and remaining["n"] <= 0:
+                return fn(*args, **kwargs)
+            if remaining["n"] is not None:
+                remaining["n"] -= 1
             if consume:
                 fn(*args, **kwargs)
             raise error
@@ -199,6 +220,35 @@ def break_sync(exc: Optional[BaseException] = None) -> Generator[None, None, Non
         sync_mod._process_allgather = orig
 
 
+@contextmanager
+def flaky_sync(
+    fail_n: int = 1, exc: Optional[BaseException] = None
+) -> Generator[Dict[str, int], None, None]:
+    """Make the multi-host ``process_allgather`` seam fail exactly ``fail_n``
+    times, then succeed — the transient-abort signature (a peer restarting
+    mid-rendezvous) that ``on_sync_failure="retry"`` exists for. Yields a
+    counters dict (``attempts``/``failures``) so tests can assert the retry
+    schedule actually exercised the seam."""
+    from torchmetrics_tpu.parallel import sync as sync_mod
+
+    orig = sync_mod._process_allgather
+    error = exc if exc is not None else FaultInjected("injected transient sync failure")
+    counters = {"attempts": 0, "failures": 0}
+
+    def sometimes_failing(value: Any) -> Any:
+        counters["attempts"] += 1
+        if counters["failures"] < fail_n:
+            counters["failures"] += 1
+            raise error
+        return orig(value)
+
+    sync_mod._process_allgather = sometimes_failing
+    try:
+        yield counters
+    finally:
+        sync_mod._process_allgather = orig
+
+
 # -------------------------------------------------------------- checkpoints
 
 def corrupt_state(
@@ -245,3 +295,80 @@ def corrupt_state(
         flat[np.random.RandomState(seed).randint(0, flat.size)] = np.nan
         out[victim] = jnp.asarray(flat.reshape(value.shape))
     return out
+
+
+def torn_write(path: Any, mode: str = "truncate", frac: float = 0.5, seed: int = 0) -> None:
+    """Damage a snapshot FILE in place, the way real storage failures present.
+
+    Modes:
+
+    - ``"truncate"`` (default) — keep only the first ``frac`` of the bytes: a
+      crash/preemption mid-write (the torn write io/checkpoint.py's atomic
+      rename exists to prevent — this primitive fakes the case where it
+      somehow happened anyway, e.g. a copied/rsynced partial file).
+    - ``"zero"`` — overwrite the last ``1-frac`` of the bytes with zeros, same
+      length: a storage layer that acknowledged before persisting.
+    - ``"flip"`` — flip one random byte's bits: silent media bit rot (caught
+      by the per-leaf sha256, not by length/structure checks).
+
+    Deterministic in ``seed``. The damaged file must be *detected* by
+    ``restore_state`` (typed ``CheckpointCorruptionError``), never installed.
+    """
+    import os
+
+    path = os.fspath(path)
+    if mode not in ("truncate", "zero", "flip"):
+        raise ValueError(f"mode must be truncate/zero/flip, got {mode!r}")
+    if not 0 <= frac < 1:
+        raise ValueError(f"frac must be in [0, 1), got {frac}")
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if not data:
+        raise ValueError(f"{path} is empty; nothing to tear")
+    if mode == "truncate":
+        damaged = data[: max(1, int(len(data) * frac))]
+    elif mode == "zero":
+        cut = max(1, int(len(data) * frac))
+        damaged = data[:cut] + b"\x00" * (len(data) - cut)
+    else:  # flip
+        idx = np.random.RandomState(seed).randint(0, len(data))
+        damaged = data[:idx] + bytes([data[idx] ^ 0xFF]) + data[idx + 1:]
+    # deliberately NON-atomic: the point is to leave the damaged bytes under
+    # the real name, as the failure mode would
+    with open(path, "wb") as fh:
+        fh.write(damaged)
+
+
+@contextmanager
+def preempt_after(
+    metric: Any, n_updates: int, exc: Optional[BaseException] = None
+) -> Generator[None, None, None]:
+    """Simulate a preemption (SIGTERM) arriving after the ``n_updates``-th
+    COMMITTED top-level update/forward on ``metric`` (a ``Metric`` or
+    ``MetricCollection``).
+
+    The raise happens from the post-commit observer seam — state is fully
+    consistent (exactly n updates applied), mirroring a signal delivered
+    between steps. Raises :class:`PreemptionInjected` (a BaseException) so
+    recovery code catching ``Exception`` cannot swallow it. Because the
+    observer fires AFTER any attached Autosaver registered earlier... order
+    note: observers run in attach order, so attach the Autosaver first if the
+    final update should still be autosaved before the kill.
+    """
+    if n_updates < 1:
+        raise ValueError(f"n_updates must be >= 1, got {n_updates}")
+    error = exc if exc is not None else PreemptionInjected(
+        f"injected preemption after update {n_updates}"
+    )
+    seen = {"n": 0}
+
+    def observer(_obj: Any) -> None:
+        seen["n"] += 1
+        if seen["n"] == n_updates:
+            raise error
+
+    detach = metric.add_update_observer(observer)
+    try:
+        yield
+    finally:
+        detach()

@@ -34,5 +34,34 @@ class SyncTimeoutError(TorchMetricsUserError, TimeoutError):
     Raised by the ``process_allgather`` path when a collective exceeds the
     configured timeout and the metric's ``on_sync_failure`` policy is
     ``"raise"`` (under ``"local"`` the metric degrades to local-only state
-    instead, flagged via ``Metric.last_sync_ok``).
+    instead, flagged via ``Metric.last_sync_ok``; under ``"retry"`` the
+    gather is retried with capped exponential backoff first — io/retry.py).
     """
+
+
+class CheckpointCorruptionError(StateCorruptionError):
+    """A durable snapshot file is torn, truncated, or bit-rotted.
+
+    Raised by ``torchmetrics_tpu.io.checkpoint.restore_state`` when the file
+    fails structural parsing (bad magic/manifest), its payload hash does not
+    match the manifest (the torn-write signature: a crash mid-write left a
+    prefix of the file), or a per-leaf sha256 mismatches (bit flip). Distinct
+    from a plain :class:`StateCorruptionError` (a well-formed file whose
+    *contents* fail the metric's spec) so rotating-snapshot fallback can tell
+    "file damaged, try the previous one" from "wrong metric entirely" —
+    though both are skipped when older valid snapshots exist.
+    """
+
+
+class DispatchStallError(TorchMetricsUserError, TimeoutError):
+    """A donating compiled dispatch (or guarded sync) exceeded its deadline.
+
+    Raised by ``torchmetrics_tpu.io.retry.stall_watchdog`` instead of letting
+    the training loop hang forever on a wedged runtime call. Carries
+    ``executor_status`` breadcrumbs (the owning executor's stats at the time
+    of the stall) when the watchdog guarded an executor dispatch.
+    """
+
+    def __init__(self, message: str, executor_status=None) -> None:
+        super().__init__(message)
+        self.executor_status = executor_status
