@@ -12,6 +12,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The compile-ahead layer (ops/compile_cache.py) is exercised by dedicated
+# tests with their own cache dirs; leaving it on globally would schedule a
+# background export job for every one of the suite's hundreds of distinct
+# compiles and write entries to the user cache dir. Tests that need it
+# re-enable via monkeypatch.setenv (the flags are read per call, not cached).
+os.environ.setdefault("TORCHMETRICS_TPU_COMPILE_AHEAD", "0")
+
 import jax  # noqa: E402
 
 # Under the axon TPU plugin the JAX_PLATFORMS env var does not demote the TPU

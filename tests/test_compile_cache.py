@@ -1,0 +1,558 @@
+"""Compile-ahead layer acceptance battery (ops/compile_cache.py, ISSUE 5).
+
+Covers: the persistent executable store (round-trip, validation, size cap),
+executor disk reuse across instances, the warmup API + shape-profile
+manifests, chaos degradation of a poisoned cache (corrupt / stale /
+wrong-computation entries -> warning + fresh compile, never a crash or a
+wrong result), stall-free background compilation (eager-miss swap-in,
+concurrency, rollback/recovery interplay, exactness per state family), and
+the env-flag escape hatches.
+
+The suite-wide conftest sets ``TORCHMETRICS_TPU_COMPILE_AHEAD=0``; every
+test here re-enables the layer explicitly against a tmp cache dir.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export as jax_export
+
+from torchmetrics_tpu import MeanMetric, MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MinMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.ops import compile_cache
+from torchmetrics_tpu.ops.executor import executor_stats
+from torchmetrics_tpu.testing import faults
+
+NUM_CLASSES = 5
+
+
+@pytest.fixture()
+def cache_env(monkeypatch, tmp_path):
+    """Compile-ahead ON against an isolated store; returns the cache dir."""
+    cache_dir = tmp_path / "tm_cache"
+    monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+    monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(cache_dir))
+    return cache_dir
+
+
+def _mc_batch(n, seed=0):
+    r = np.random.RandomState(seed)
+    return (
+        jnp.asarray(r.randn(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(r.randint(0, NUM_CLASSES, n)),
+    )
+
+
+def _entries(cache_dir):
+    store = os.path.join(str(cache_dir), "executables")
+    if not os.path.isdir(store):
+        return []
+    return sorted(p for p in os.listdir(store) if p.endswith(compile_cache.ENTRY_SUFFIX))
+
+
+def _populate(cache_dir, n=32, seed=0):
+    """Run one metric through the executor and wait for its persist job."""
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+    preds, target = _mc_batch(n, seed)
+    m.update(preds, target)
+    assert compile_cache.drain_worker(90)
+    assert _entries(cache_dir), "persist job wrote no entry"
+    return m, float(m.compute())
+
+
+# --------------------------------------------------------------------- store
+
+class TestStore:
+    def test_blob_round_trip(self, cache_env):
+        blobs = [(compile_cache.FORMAT_COMPILED, b"native" * 100), (compile_cache.FORMAT_STABLEHLO, b"hlo" * 50)]
+        path = compile_cache.store_executable("some|key|desc", blobs)
+        assert path is not None and os.path.exists(path)
+        assert compile_cache.load_executable_blob("some|key|desc") == blobs
+
+    def test_key_desc_mismatch_is_a_miss(self, cache_env):
+        compile_cache.store_executable("key-a", (compile_cache.FORMAT_COMPILED, b"blob-a"))
+        assert compile_cache.load_executable_blob("key-b") is None
+
+    @pytest.mark.parametrize("mode", ["truncate", "zero", "flip", "garbage"])
+    def test_corrupt_entry_skipped_with_warning_and_deleted(self, cache_env, mode):
+        compile_cache.store_executable("key", (compile_cache.FORMAT_COMPILED, b"x" * 4096))
+        faults.corrupt_cache_entry(str(cache_env), mode=mode, which="all")
+        with pytest.warns(UserWarning, match="damaged/stale entry"):
+            assert compile_cache.load_executable_blob("key") is None
+        assert not _entries(cache_env), "damaged entry must be deleted"
+
+    def test_stale_toolchain_skipped_with_warning(self, cache_env):
+        compile_cache.store_executable("key", (compile_cache.FORMAT_COMPILED, b"x" * 512))
+        faults.stale_cache_version(str(cache_env))
+        with pytest.warns(UserWarning, match="stale toolchain"):
+            assert compile_cache.load_executable_blob("key") is None
+        assert not _entries(cache_env)
+
+    def test_size_cap_evicts_oldest(self, cache_env, monkeypatch):
+        for i in range(6):
+            compile_cache.store_executable(f"key-{i}", (compile_cache.FORMAT_COMPILED, bytes(2048)))
+            time.sleep(0.01)  # distinct mtimes for deterministic eviction order
+        store = os.path.join(str(cache_env), "executables")
+        assert len(_entries(cache_env)) == 6
+        entry_size = os.path.getsize(os.path.join(store, _entries(cache_env)[0]))
+        removed = compile_cache.prune_store(store, max_bytes=3 * entry_size + 1)
+        assert removed == 3
+        assert compile_cache.load_executable_blob("key-5") is not None  # newest survives
+        assert compile_cache.load_executable_blob("key-0") is None  # oldest evicted
+
+    def test_disabled_layer_stores_nothing(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "0")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path / "c"))
+        assert compile_cache.cache_dir() is None
+        assert compile_cache.store_executable("k", (compile_cache.FORMAT_COMPILED, b"b")) is None
+        assert compile_cache.load_executable_blob("k") is None
+
+
+# ----------------------------------------------------------------- env flags
+
+class TestEnvFlags:
+    def test_compile_ahead_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "0")
+        assert not compile_cache.compile_ahead_enabled()
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        assert compile_cache.compile_ahead_enabled()
+
+    def test_cache_dir_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path / "custom"))
+        assert compile_cache.cache_dir() == str(tmp_path / "custom")
+        monkeypatch.delenv("TORCHMETRICS_TPU_CACHE_DIR")
+        assert compile_cache.cache_dir().endswith(os.path.join(".cache", "torchmetrics_tpu"))
+
+    def test_bg_compile_env_default(self, monkeypatch):
+        monkeypatch.delenv("TORCHMETRICS_TPU_BG_COMPILE", raising=False)
+        assert not compile_cache.background_compile_default()
+        monkeypatch.setenv("TORCHMETRICS_TPU_BG_COMPILE", "1")
+        assert compile_cache.background_compile_default()
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        assert m._get_executor().background_enabled()
+
+    def test_no_disk_io_when_disabled(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "0")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path / "never"))
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(32))
+        compile_cache.drain_worker(30)
+        assert not (tmp_path / "never").exists()
+        assert executor_stats(m)["disk_stores"] == 0
+
+
+# ---------------------------------------------------------------- disk reuse
+
+class TestDiskReuse:
+    def test_sibling_instance_loads_from_disk(self, cache_env):
+        m1, v1 = _populate(cache_env)
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m2.update(*_mc_batch(32))
+        s2 = executor_stats(m2)
+        assert s2["disk_hits"] == 1 and s2["compiles"] == 0
+        assert float(m2.compute()) == v1
+
+    def test_disk_loaded_executable_matches_eager(self, cache_env):
+        _populate(cache_env)
+        m_disk = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m_eager = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        for seed in range(4):
+            batch = _mc_batch(32, seed)
+            m_disk.update(*batch)
+            m_eager.update(*batch)
+        assert executor_stats(m_disk)["disk_hits"] == 1
+        assert np.allclose(np.asarray(m_disk.compute()), np.asarray(m_eager.compute()))
+
+    def test_different_config_is_a_different_key(self, cache_env):
+        _populate(cache_env)
+        m3 = MulticlassAccuracy(num_classes=NUM_CLASSES + 2, validate_args=False)
+        r = np.random.RandomState(0)
+        m3.update(jnp.asarray(r.randn(32, NUM_CLASSES + 2).astype(np.float32)), jnp.asarray(r.randint(0, NUM_CLASSES + 2, 32)))
+        s3 = executor_stats(m3)
+        assert s3["disk_hits"] == 0 and s3["compiles"] == 1
+
+    def test_spec_round_trip(self):
+        spec = compile_cache.spec_of_call("update", _mc_batch(16) + (True, 3), {"w": jnp.ones(16)})
+        args, kwargs = compile_cache.dummy_from_spec(spec)
+        assert args[0].shape == (16, NUM_CLASSES) and args[2] is True and args[3] == 3
+        assert kwargs["w"].shape == (16,)
+        # non-replayable structures are declined, not mangled
+        assert compile_cache.spec_of_call("update", (([jnp.ones(2)],),), {}) is None
+
+
+# -------------------------------------------------------------------- warmup
+
+class TestWarmup:
+    def test_warmup_makes_first_call_warm(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        spec = (jax.ShapeDtypeStruct((32, NUM_CLASSES), jnp.float32), jax.ShapeDtypeStruct((32,), jnp.int32))
+        report = m.warmup(spec, ladder=False)
+        assert report["warmed"] == 1 and not report["skipped"]
+        m.update(*_mc_batch(32))
+        s = executor_stats(m)
+        assert s["cache_hits"] == 1 and s["calls"] == 1 and s["warmup"] == 1
+
+    def test_ladder_covers_ragged_batches(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        report = m.warmup((jax.ShapeDtypeStruct((32, NUM_CLASSES), jnp.float32), jax.ShapeDtypeStruct((32,), jnp.int32)))
+        assert report["warmed"] >= 3  # exact 32 + padded rungs 8/16/32
+        compiles_after_warmup = executor_stats(m)["compiles"]
+        for n in (32, 20, 9, 5):  # full + ragged sizes inside the warmed ladder
+            m.update(*_mc_batch(n, seed=n))
+        s = executor_stats(m)
+        assert s["compiles"] == compiles_after_warmup, "ragged traffic recompiled despite ladder warmup"
+
+    def test_warmup_never_touches_state(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(32))
+        before = float(m.compute())
+        m.warmup((jax.ShapeDtypeStruct((64, NUM_CLASSES), jnp.float32), jax.ShapeDtypeStruct((64,), jnp.int32)))
+        assert float(m.compute()) == before
+        assert m.update_count == 1
+
+    def test_background_warmup_handle(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        handle = m.warmup(
+            (jax.ShapeDtypeStruct((16, NUM_CLASSES), jnp.float32), jax.ShapeDtypeStruct((16,), jnp.int32)),
+            ladder=False,
+            background=True,
+        )
+        report = handle.wait(120)
+        assert handle.done and report["warmed"] == 1
+
+    def test_warmup_with_executor_disabled_reports_skip(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        report = m.warmup(_mc_batch(8))
+        assert report["warmed"] == 0 and report["skipped"] == ["executor disabled"]
+
+    def test_collection_warmup_update_and_forward(self, cache_env):
+        coll = MetricCollection(
+            {
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "precision": MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            }
+        )
+        spec = (jax.ShapeDtypeStruct((64, NUM_CLASSES), jnp.float32), jax.ShapeDtypeStruct((64,), jnp.int32))
+        report = coll.warmup([spec], forward=True, ladder=False)
+        assert report["warmed"] == 2 and not report["skipped"]  # fused update + fused forward
+        batch = _mc_batch(64)
+        coll.update(*batch)
+        out = coll(*batch)
+        s = executor_stats(coll)
+        assert s["cache_hits"] == 2 and s["compiles"] == 2  # warmup compiled, traffic hit
+        ref = MetricCollection(
+            {
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "precision": MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            },
+            executor=False,
+        )
+        ref.update(*batch)
+        ref_out = ref(*batch)
+        for k in ref_out:
+            assert np.allclose(np.asarray(out[k]), np.asarray(ref_out[k]))
+
+    def test_manifest_records_and_replays(self, cache_env, tmp_path):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(32))
+        m.update(*_mc_batch(20, seed=1))  # a ragged bucket the profile must carry
+        manifest = m.shape_profile()
+        assert len(manifest["specs"]) == 2
+        path = str(tmp_path / "profile.json")
+        m.save_shape_profile(path)
+
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        report = m2.warmup_from_manifest(path)
+        assert report["warmed"] >= 1
+        compiles = executor_stats(m2)["compiles"] + executor_stats(m2)["disk_hits"]
+        m2.update(*_mc_batch(32))
+        m2.update(*_mc_batch(20, seed=1))
+        s2 = executor_stats(m2)
+        assert s2["compiles"] + s2["disk_hits"] == compiles, "manifest replay missed a bucket the run used"
+
+    def test_collection_manifest_resolves_groups(self, cache_env, tmp_path):
+        def build():
+            return MetricCollection(
+                {
+                    "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                    "recall": MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False),
+                }
+            )
+
+        coll = build()
+        coll.update(*_mc_batch(16))
+        coll.update(*_mc_batch(16, seed=2))  # second update engages the fused executor
+        path = str(tmp_path / "coll_profile.json")
+        coll.save_shape_profile(path)
+        coll2 = build()
+        report = coll2.warmup_from_manifest(path)
+        assert report["warmed"] >= 1
+        assert coll2._groups_checked  # manifest replay resolved compute groups
+
+
+# --------------------------------------------------------------------- chaos
+
+class TestPoisonedCacheChaos:
+    """Satellite: a poisoned disk cache degrades to a fresh compile with a
+    warning — never a crash, never a wrong result."""
+
+    @pytest.mark.parametrize("mode", ["flip", "truncate", "garbage"])
+    def test_corrupt_entry_degrades_to_fresh_compile(self, cache_env, mode):
+        _, v1 = _populate(cache_env)
+        faults.corrupt_cache_entry(str(cache_env), mode=mode, which="all")
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        with pytest.warns(UserWarning, match="recompiling fresh"):
+            m2.update(*_mc_batch(32))
+        s2 = executor_stats(m2)
+        assert s2["disk_hits"] == 0 and s2["compiles"] == 1
+        assert s2["disabled_reason"] is None  # executor stays engaged
+        assert float(m2.compute()) == v1
+
+    def test_stale_version_degrades_to_fresh_compile(self, cache_env):
+        _, v1 = _populate(cache_env)
+        faults.stale_cache_version(str(cache_env), which="all")
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        with pytest.warns(UserWarning, match="stale toolchain"):
+            m2.update(*_mc_batch(32))
+        s2 = executor_stats(m2)
+        assert s2["disk_hits"] == 0 and s2["compiles"] == 1
+        assert float(m2.compute()) == v1
+
+    def test_wrong_computation_entry_evicted_at_dispatch(self, cache_env):
+        """An entry that deserializes fine but holds a DIFFERENT computation
+        (hash-collision / key-logic-drift stand-in): its dispatch failure
+        evicts the entry and recompiles fresh — no sticky eager fallback."""
+        m1, v1 = _populate(cache_env)
+        # overwrite the real entry's payload with an export of the wrong signature
+        ex = m1._get_executor()
+        key_desc = ex._key_desc(next(iter(ex._cache)))
+        wrong = jax_export.export(jax.jit(lambda x: x + 1))(jax.ShapeDtypeStruct((3,), jnp.float32))
+        compile_cache.store_executable(key_desc, (compile_cache.FORMAT_STABLEHLO, bytes(wrong.serialize())))
+
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        with pytest.warns(UserWarning, match="failed at dispatch"):
+            m2.update(*_mc_batch(32))
+        s2 = executor_stats(m2)
+        assert s2["disk_evictions"] == 1 and s2["compiles"] == 1
+        assert s2["disabled_reason"] is None
+        assert float(m2.compute()) == v1
+        # the poisoned bytes are gone; after the fresh compile's background
+        # persist, whatever lives under that key (if anything) must be the
+        # GOOD computation again — a third instance proves it end to end
+        assert compile_cache.drain_worker(90)
+        current = compile_cache.load_executable_blob(key_desc)
+        assert current is None or all(blob != bytes(wrong.serialize()) for _, blob in current)
+        m3 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m3.update(*_mc_batch(32))
+        assert float(m3.compute()) == v1
+
+    def test_unwritable_store_never_fatal(self, cache_env, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", "/proc/definitely/not/writable")
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_mc_batch(32))  # must not raise
+        compile_cache.drain_worker(60)
+        assert executor_stats(m)["calls"] == 1
+
+
+# ---------------------------------------------------- background compilation
+
+def _swap_in(metric, batch, timeout=90.0):
+    """Wait until the background-compiled executable for ``batch`` swapped in."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if executor_stats(metric)["pending_background"] == 0 and executor_stats(metric)["background_compiles"] > 0:
+            return
+        time.sleep(0.01)
+    raise AssertionError("background compile never swapped in")
+
+
+class TestBackgroundCompile:
+    def test_miss_dispatches_eagerly_then_swaps_in(self, cache_env):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.set_background_compile(True)
+        batch = _mc_batch(32)
+        m.update(*batch)  # cold key: eager body serves the step
+        s = executor_stats(m)
+        assert s["eager_misses"] >= 1 and s["calls"] == 0
+        assert m.update_count == 1  # the step itself committed
+        _swap_in(m, batch)
+        m.update(*batch)
+        s = executor_stats(m)
+        assert s["calls"] == 1 and s["cache_hits"] == 1 and s["background_compiles"] == 1
+
+    @pytest.mark.parametrize(
+        "family,build,batches",
+        [
+            # nan_strategy="ignore": "warn"/"error" need concrete values and
+            # statically opt out of the executor (aggregation._executor_traceable)
+            ("sum", lambda: SumMetric(nan_strategy="ignore"), [jnp.arange(8.0), jnp.arange(8.0) * 2]),
+            ("mean", lambda: MeanMetric(nan_strategy="ignore"), [jnp.arange(8.0), jnp.ones(8)]),
+            ("max", lambda: MaxMetric(nan_strategy="ignore"), [jnp.arange(8.0), -jnp.arange(8.0)]),
+            ("min", lambda: MinMetric(nan_strategy="ignore"), [jnp.arange(8.0), -jnp.arange(8.0)]),
+            ("cat", lambda: CatMetric(), [jnp.arange(4.0), jnp.arange(4.0) + 9]),
+        ],
+    )
+    def test_exactness_per_state_family(self, cache_env, family, build, batches):
+        """The full stream — eager-miss steps, then swapped-in compiled steps
+        — must match the pure eager path bit-for-bit per state family (cat is
+        list-state: statically ineligible, the mode must still be harmless)."""
+        m_bg, m_eager = build(), build()
+        m_eager._executor_enabled = False
+        m_bg.set_background_compile(True)
+        for b in batches:
+            m_bg.update(b)
+            m_eager.update(b)
+        compile_cache.drain_worker(90)
+        for b in batches:  # second pass: warm (or still-eager for cat)
+            m_bg.update(b)
+            m_eager.update(b)
+        assert np.allclose(np.asarray(m_bg.compute()), np.asarray(m_eager.compute()))
+
+    def test_concurrent_updates_during_inflight_compile(self, cache_env):
+        """Updates keep landing (eagerly, exactly once each) while the
+        worker is busy; after the swap-in the tail of the stream runs
+        compiled; the total matches the eager reference."""
+        gate_release = time.monotonic() + 0.7
+        compile_cache.get_worker().submit(lambda: time.sleep(max(0.0, gate_release - time.monotonic())))
+        m_bg = SumMetric(nan_strategy="ignore")
+        m_bg.set_background_compile(True)
+        m_eager = SumMetric(nan_strategy="ignore")
+        m_eager._executor_enabled = False
+        batches = [jnp.full((16,), float(i)) for i in range(30)]
+        for b in batches:
+            m_bg.update(b)
+            m_eager.update(b)
+        s = executor_stats(m_bg)
+        assert s["eager_misses"] >= 1  # at least the stalled-worker window ran eagerly
+        compile_cache.drain_worker(90)
+        m_bg.update(jnp.ones(16))
+        m_eager.update(jnp.ones(16))
+        assert executor_stats(m_bg)["calls"] >= 1  # compiled tail engaged
+        assert float(m_bg.compute()) == float(m_eager.compute())
+        assert m_bg.update_count == m_eager.update_count == len(batches) + 1
+
+    def test_rollback_during_eager_miss_phase(self, cache_env):
+        """A transactional failure while the key is still compiling in the
+        background rolls back exactly like the pre-executor eager path."""
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.set_background_compile(True)
+        batch = _mc_batch(32)
+        m.update(*batch)  # eager miss; compile in flight
+        pre_count = m.update_count
+        pre_value = float(m.compute())
+        with faults.raise_in_update(m, after_mutation=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(*batch)
+        assert m.update_count == pre_count
+        assert float(m.compute()) == pre_value
+        _swap_in(m, batch)
+        m.update(*batch)  # swapped-in executable still serves correctly
+        assert executor_stats(m)["calls"] == 1
+
+    def test_recovery_restore_on_swapped_in_executable(self, cache_env):
+        """The PR-2/4 donation-recovery machinery applies unchanged to a
+        background-compiled executable: a consumed-donation dispatch failure
+        restores the state and propagates, without disabling the executor."""
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        ref = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        m.set_background_compile(True)
+        batch = _mc_batch(32)
+        for _ in range(3):  # eager-miss step, then compiled copy + donation streak
+            m.update(*batch)
+            ref.update(*batch)
+            if executor_stats(m)["calls"] == 0:
+                _swap_in(m, batch)
+        assert executor_stats(m)["donated_calls"] >= 1  # live buffers are in play
+        pre_count = m.update_count
+        with faults.fail_dispatch(consume=True):
+            with pytest.raises(faults.FaultInjected):
+                m.update(*batch)
+        s = executor_stats(m)
+        assert s["dispatch_failures"] == 1 and s["recovery_restores"] >= 1
+        assert s["disabled_reason"] is None
+        assert m.update_count == pre_count
+        assert float(m.compute()) == float(ref.compute())
+
+    def test_collection_background_swap_in(self, cache_env):
+        coll = MetricCollection(
+            {
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            }
+        )
+        batch = _mc_batch(64)
+        coll.update(*batch)  # first update resolves groups (eager by design)
+        coll.set_background_compile(True)
+        coll.update(*batch)  # fused key cold -> eager per-group loop serves it
+        assert executor_stats(coll)["eager_misses"] >= 1
+        compile_cache.drain_worker(90)
+        coll.update(*batch)
+        assert executor_stats(coll)["calls"] >= 1
+        ref = MetricCollection(
+            {
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            },
+            executor=False,
+        )
+        for _ in range(3):
+            ref.update(*batch)
+        out, ref_out = coll.compute(), ref.compute()
+        for k in ref_out:
+            assert np.allclose(np.asarray(out[k]), np.asarray(ref_out[k]))
+
+
+# ----------------------------------------------------------- cross-process
+
+@pytest.mark.slow
+def test_cold_vs_persisted_process(tmp_path):
+    """The whole point: a second process's first call must reuse the first
+    process's executables (disk_hits > 0) and agree on the value."""
+    script = r"""
+import os, sys, time, json
+import jax, jax.numpy as jnp, numpy as np
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.ops import compile_cache
+from torchmetrics_tpu.ops.executor import executor_stats
+m = MulticlassAccuracy(num_classes=5, validate_args=False)
+r = np.random.RandomState(0)
+preds = jnp.asarray(r.randn(32, 5).astype(np.float32)); target = jnp.asarray(r.randint(0, 5, 32))
+t0 = time.perf_counter(); m.update(preds, target)
+jax.block_until_ready(list(m._state.values()))
+dt = time.perf_counter() - t0
+compile_cache.drain_worker(120)
+s = executor_stats(m)
+print(json.dumps({"first_call_s": dt, "disk_hits": s["disk_hits"], "compiles": s["compiles"],
+                  "value": float(m.compute())}))
+"""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TORCHMETRICS_TPU_COMPILE_AHEAD="1",
+        TORCHMETRICS_TPU_CACHE_DIR=str(tmp_path / "xcache"),
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    runs = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", script], capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    cold, persisted = runs
+    assert cold["disk_hits"] == 0 and cold["compiles"] == 1
+    assert persisted["disk_hits"] == 1 and persisted["compiles"] == 0
+    assert persisted["value"] == cold["value"]

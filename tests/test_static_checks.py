@@ -84,6 +84,47 @@ def test_atomic_io_linter_catches_violations(tmp_path):
     assert len(found) == 2 and all(v.func == "_save" for v in found)
 
 
+def test_atomic_io_linter_catches_cache_write_dance(tmp_path):
+    """ISSUE 5 satellite: a module hand-rolling the write/rename dance for a
+    cache entry (its own os.replace, a text-mode manifest write, a
+    Path.write_text) must be flagged — cache-file writes route through
+    io.checkpoint.atomic_write_bytes, the single fsync discipline."""
+    linter = _load_tool("lint_atomic_io")
+    bad = tmp_path / "bad_cache.py"
+    bad.write_text(
+        "import os, shutil\n"
+        "from pathlib import Path\n"
+        "def _store_entry(path, blob):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'wb') as fh:\n"
+        "        fh.write(blob)\n"
+        "    os.replace(tmp, path)\n"
+        "def _store_manifest(path, text):\n"
+        "    Path(path).write_text(text)\n"
+        "def _rotate(old, new):\n"
+        "    os.rename(old, new)\n"
+        "    shutil.move(new, old)\n"
+    )
+    found = linter.lint_file(bad, "bad_cache.py")
+    by_func = {}
+    for v in found:
+        by_func.setdefault(v.func, []).append(v)
+    assert len(by_func.get("_store_entry", [])) == 2  # open(wb) + os.replace
+    assert len(by_func.get("_store_manifest", [])) == 1  # write_text
+    assert len(by_func.get("_rotate", [])) == 2  # os.rename + shutil.move
+
+
+def test_compile_cache_writes_route_through_atomic_helper():
+    """The compile-ahead store itself (ops/compile_cache.py) performs no
+    direct writes: every byte lands via io.checkpoint.atomic_write_bytes."""
+    linter = _load_tool("lint_atomic_io")
+    target = REPO / "torchmetrics_tpu" / "ops" / "compile_cache.py"
+    found = linter.lint_file(target, "ops/compile_cache.py")
+    assert not found, [f"{v.path}:{v.line}: {v.snippet}" for v in found]
+    source = target.read_text()
+    assert "atomic_write_bytes" in source
+
+
 def test_collectives_linter_catches_violations(tmp_path):
     """The linter actually fires: a synthetic update-stage function calling
     lax.psum must be flagged (guards against the rule rotting into a no-op)."""

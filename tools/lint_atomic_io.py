@@ -17,7 +17,13 @@ calls are forbidden unless allowlisted with a reason:
 - ``np.save`` / ``np.savez`` / ``np.savez_compressed`` / ``jnp.save`` with a
   non-buffer first argument (writing straight to a path);
 - ``pickle.dump`` (stateful payloads must go through the manifest format);
-- ``Path.write_bytes``.
+- ``Path.write_bytes`` / ``Path.write_text``;
+- ``os.replace`` / ``os.rename`` / ``shutil.move`` — the atomic-promotion
+  primitive itself. The compile-ahead work (ISSUE 5) made
+  ``io.checkpoint.atomic_write_bytes`` the package-wide durable-write
+  helper (executable cache entries, shape manifests, snapshots all route
+  through it); a module running its own write/rename dance would be a
+  second, independently-buggy implementation of the fsync discipline.
 
 Run directly (``python tools/lint_atomic_io.py``) for a report, or through
 ``tests/test_static_checks.py`` where it gates the suite.
@@ -39,6 +45,19 @@ ALLOWLIST = {
     "testing/faults.py::torn_write": (
         "fault injection: deliberately NON-atomic damage to an existing snapshot"
         " file — simulating exactly the failure the rule prevents"
+    ),
+    "testing/faults.py::corrupt_cache_entry": (
+        "fault injection: deliberately NON-atomic damage to a compile-cache"
+        " entry (drives the poisoned-cache chaos tests)"
+    ),
+    "testing/faults.py::stale_cache_version": (
+        "fault injection: rewrites an entry header with a stale toolchain"
+        " fingerprint, as an old binary would have left it"
+    ),
+    "native/__init__.py::_load": (
+        "ctypes .so rebuild: renames a freshly compiled library over the stale"
+        " one — code artifact, not metric-state/cache payload (dlopen needs a"
+        " real path; the build itself is idempotent and version-checked)"
     ),
 }
 
@@ -84,7 +103,13 @@ def _call_violation(node: ast.Call) -> bool:
         return bool(node.args)
     if name == "dump" and attr_owner == "pickle":
         return True
-    if name == "write_bytes":
+    if name in ("write_bytes", "write_text"):
+        return True
+    # the atomic-promotion primitive: one implementation (io/checkpoint.py),
+    # everything else (compile-cache entries, manifests) calls the helper
+    if name in ("replace", "rename") and attr_owner == "os":
+        return True
+    if name == "move" and attr_owner == "shutil":
         return True
     return False
 

@@ -11,6 +11,7 @@ copy-on-access dance of the reference (:515-549) is unnecessary by construction.
 """
 from __future__ import annotations
 
+import os
 from copy import deepcopy
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -519,6 +520,85 @@ class MetricCollection:
     def functional_init(self) -> Dict[str, Dict[str, Any]]:
         """Fresh default states, one pytree per compute-group leader."""
         return {cg[0]: self._modules[cg[0]].functional_init() for cg in self._groups.values()}
+
+    # -------------------------------------------------- compile-ahead surface
+    def warmup(
+        self,
+        batch_specs: Any,
+        forward: bool = False,
+        ladder: bool = True,
+        background: bool = False,
+    ) -> Any:
+        """Precompile the fused executables ``batch_specs``-shaped traffic
+        will hit (docs/EXECUTOR.md "Compile-ahead & persistent cache").
+
+        Resolves compute groups from the first spec (zero-filled dummies —
+        live state untouched), then warms ONE fused executable per distinct
+        shape/bucket covering every group, exactly what
+        ``update``/``forward`` traffic will dispatch. See
+        :meth:`Metric.warmup` for spec forms, the ladder, and
+        ``background=True`` semantics.
+
+        Example::
+
+            coll.warmup([(jax.ShapeDtypeStruct((1024, 10), jnp.float32),
+                          jax.ShapeDtypeStruct((1024,), jnp.int32))], forward=True)
+        """
+        from torchmetrics_tpu.ops.executor import _normalize_warmup_specs
+
+        specs = _normalize_warmup_specs(batch_specs)
+        if specs and self._enable_compute_groups and not self._groups_checked:
+            args, kwargs = specs[0]
+            self.resolve_compute_groups(*args, **kwargs)
+            self._compute_groups_create_state_ref()
+        ex = self._get_executor()
+        if ex is None:
+            return {"warmed": 0, "already_warm": 0, "skipped": ["executor disabled"], "seconds": 0.0}
+        return ex.warmup(specs, forward=forward, ladder=ladder, background=background)
+
+    def warmup_from_manifest(self, manifest: Any, background: bool = False) -> Any:
+        """Replay a shape-profile manifest (dict from :meth:`shape_profile` or
+        a path written by :meth:`save_shape_profile`): precompiles exactly the
+        fused buckets a previous run recorded."""
+        from torchmetrics_tpu.ops import compile_cache
+
+        if isinstance(manifest, (str, os.PathLike)):
+            manifest = compile_cache.load_shape_manifest(os.fspath(manifest))
+        specs = manifest.get("specs") or []
+        if specs and self._enable_compute_groups and not self._groups_checked:
+            args, kwargs = compile_cache.dummy_from_spec(specs[0])
+            self.resolve_compute_groups(*args, **kwargs)
+            self._compute_groups_create_state_ref()
+        ex = self._get_executor()
+        if ex is None:
+            return {"warmed": 0, "already_warm": 0, "skipped": ["executor disabled"], "seconds": 0.0}
+        return ex.warmup_from_manifest(manifest, background=background)
+
+    def shape_profile(self) -> Dict[str, Any]:
+        """Replayable manifest of the fused call shapes this collection's
+        executor has served (see :meth:`Metric.shape_profile`)."""
+        ex = self._get_executor()
+        if ex is None:
+            from torchmetrics_tpu.ops.compile_cache import PROFILE_VERSION
+
+            return {"profile_version": PROFILE_VERSION, "owner": type(self).__name__, "specs": []}
+        return ex.shape_profile()
+
+    def save_shape_profile(self, path: str) -> str:
+        """Atomically persist :meth:`shape_profile` as JSON at ``path``."""
+        from torchmetrics_tpu.ops.compile_cache import save_shape_manifest
+
+        return save_shape_manifest(path, self.shape_profile())
+
+    def set_background_compile(self, enabled: Optional[bool]) -> None:
+        """Override stall-free background compilation for the fused executor
+        AND every member's (cold keys dispatch eagerly while compiles run on
+        the worker; ``None`` restores the env default)."""
+        ex = self._get_executor()
+        if ex is not None:
+            ex.set_background_compile(enabled)
+        for m in self._modules.values():
+            m.set_background_compile(enabled)
 
     # ------------------------------------------------- sharded (deferred) API
     def init_sharded_states(self, num_shards: int) -> Dict[str, Dict[str, Any]]:

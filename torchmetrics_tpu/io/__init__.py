@@ -8,6 +8,7 @@ See docs/DURABILITY.md.
 from torchmetrics_tpu.io.checkpoint import (  # noqa: F401
     Autosaver,
     PreemptionHandle,
+    atomic_write_bytes,
     install_preemption_handler,
     load_manifest,
     restore_state,
@@ -26,6 +27,7 @@ from torchmetrics_tpu.io.retry import (  # noqa: F401
 __all__ = [
     "Autosaver",
     "PreemptionHandle",
+    "atomic_write_bytes",
     "RetryPolicy",
     "backoff_delays",
     "call_with_retries",

@@ -226,12 +226,18 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
     return header + manifest_bytes + payload
 
 
-def _atomic_write(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str, data: bytes) -> None:
     """write-to-temp → flush → fsync → atomic rename (+ best-effort dir fsync).
 
     A crash at any byte leaves either the complete previous file or a stray
     ``.tmp.*`` sibling ``os.replace`` never promoted — the reader can never
     observe a prefix of ``data`` under the final name.
+
+    This is THE durable-write primitive of the package: every on-disk payload
+    — state snapshots here, compiled-executable cache entries and shape
+    manifests (ops/compile_cache.py) — routes through it, and
+    ``tools/lint_atomic_io.py`` flags any other module performing its own
+    write/rename dance.
     """
     directory = os.path.dirname(os.path.abspath(path)) or "."
     tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp.{os.getpid()}")
@@ -338,7 +344,7 @@ def save_state(
 
     is_dir_store = keep is not None or os.path.isdir(path)
     if not is_dir_store:
-        _atomic_write(path, data)
+        atomic_write_bytes(path, data)
         return path
 
     keep = DEFAULT_KEEP if keep is None else int(keep)
@@ -348,7 +354,7 @@ def save_state(
     existing = _list_snapshots(path)
     seq = (existing[-1][0] + 1) if existing else 0
     target = os.path.join(path, f"snapshot-{seq:08d}.ckpt")
-    _atomic_write(target, data)
+    atomic_write_bytes(target, data)
     for _, old in _list_snapshots(path)[:-keep]:
         try:
             os.unlink(old)

@@ -309,6 +309,69 @@ class Metric:
             "stats": stats,
         }
 
+    # -------------------------------------------------- compile-ahead surface
+    def warmup(
+        self,
+        batch_specs: Any,
+        forward: bool = False,
+        ladder: bool = True,
+        background: bool = False,
+    ) -> Any:
+        """Precompile the executables this metric's traffic will need, ahead
+        of traffic (docs/EXECUTOR.md "Compile-ahead & persistent cache").
+
+        ``batch_specs`` is one example batch or a sequence of them — tuples of
+        arrays or ``jax.ShapeDtypeStruct`` leaves (only shapes/dtypes matter;
+        zero-filled dummies are compiled and discarded, live state is never
+        touched). ``ladder=True`` also warms one padded representative per
+        bucket rung so ragged epoch-final batches land warm. ``forward=True``
+        additionally warms the fused forward executables. With
+        ``background=True`` compilation runs on a daemon thread and a
+        ``WarmupHandle`` (``.wait()`` -> report) is returned; otherwise the
+        report dict ``{"warmed", "already_warm", "skipped", "seconds"}``.
+        Persisted-cache entries (``TORCHMETRICS_TPU_CACHE_DIR``) make warmup
+        across process restarts a deserialization, not a recompile.
+        """
+        ex = self._get_executor()
+        if ex is None:
+            return {"warmed": 0, "already_warm": 0, "skipped": ["executor disabled"], "seconds": 0.0}
+        return ex.warmup(batch_specs, forward=forward, ladder=ladder, background=background)
+
+    def warmup_from_manifest(self, manifest: Any, background: bool = False) -> Any:
+        """Replay a shape-profile manifest (dict from :meth:`shape_profile` or
+        a path written by :meth:`save_shape_profile`): precompiles exactly the
+        call shapes a previous run recorded."""
+        ex = self._get_executor()
+        if ex is None:
+            return {"warmed": 0, "already_warm": 0, "skipped": ["executor disabled"], "seconds": 0.0}
+        return ex.warmup_from_manifest(manifest, background=background)
+
+    def shape_profile(self) -> Dict[str, Any]:
+        """Replayable manifest of the call shapes this metric's executor has
+        served — save it (:meth:`save_shape_profile`) so the next process can
+        ``warmup_from_manifest`` before traffic arrives."""
+        ex = self._get_executor()
+        if ex is None:
+            from torchmetrics_tpu.ops.compile_cache import PROFILE_VERSION
+
+            return {"profile_version": PROFILE_VERSION, "owner": type(self).__name__, "specs": []}
+        return ex.shape_profile()
+
+    def save_shape_profile(self, path: str) -> str:
+        """Atomically persist :meth:`shape_profile` as JSON at ``path``."""
+        from torchmetrics_tpu.ops.compile_cache import save_shape_manifest
+
+        return save_shape_manifest(path, self.shape_profile())
+
+    def set_background_compile(self, enabled: Optional[bool]) -> None:
+        """Per-instance override of stall-free background compilation (cold
+        cache keys dispatch eagerly while the compile runs on a worker; see
+        docs/EXECUTOR.md). ``None`` restores the ``TORCHMETRICS_TPU_BG_COMPILE``
+        env default."""
+        ex = self._get_executor()
+        if ex is not None:
+            ex.set_background_compile(enabled)
+
     @property
     def deferred_pending(self) -> bool:
         """True while locally-accumulated state still awaits its deferred

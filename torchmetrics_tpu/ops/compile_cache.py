@@ -1,0 +1,627 @@
+"""Compile-ahead layer: persistent executable cache + background compilation.
+
+The donated-state executor (ops/executor.py) made the *warm* eager path fast,
+but every fresh process still paid a cold trace + XLA compile per cache key,
+and the first batch landing in a new shape bucket stalled the step loop for
+the whole compile. At production scale — where restarts and preemptions
+(docs/DURABILITY.md) are routine — compile latency IS the tail latency. This
+module closes that gap with three cooperating pieces:
+
+- **An on-disk executable store.** Each executor cache key maps to a stable
+  content hash over ``(code hash, jax/jaxlib/library versions, backend +
+  device kind, abstract input avals, donation + static-argument spec)``.
+  Entries serialize the traced computation via :mod:`jax.export` (a
+  StableHLO module: reloading skips the Python trace of the metric body
+  entirely) and are written with the same write-to-temp → fsync → atomic
+  rename discipline as state snapshots (``io.checkpoint.atomic_write_bytes``
+  — the package's single durable-write primitive). Corrupt, truncated, or
+  version-mismatched entries are *skipped with a warning and deleted*, never
+  fatal: the worst a poisoned cache can do is cost one fresh compile.
+
+- **JAX persistent-compilation-cache wiring.** Where ``jax.export`` cannot
+  serialize a computation (exotic primitives, unexported platforms), the
+  layer still wins by pointing JAX's own persistent compilation cache at
+  ``<cache_dir>/xla`` (only when the user has not configured one), so the
+  XLA compile — the dominant cold cost — is reused across processes even
+  when the trace is not. Both tiers compose: a persisted entry's first
+  dispatch compiles its StableHLO through the same persistent cache, which
+  the store pre-populates at persist time.
+
+- **A bounded background compile worker.** One daemon thread with a bounded
+  queue runs (a) persist jobs — re-trace, export, serialize, atomically
+  store, and pre-warm the persisted form into the XLA cache — and (b)
+  stall-free miss compiles: with background mode enabled, a cold executor
+  key dispatches the step through the eager op-by-op path while the compile
+  runs here, and the warm executable is swapped in atomically for the next
+  call (ops/executor.py). A full queue drops work (counted, retried on a
+  later miss) rather than blocking the step loop.
+
+Environment flags (see docs/EXECUTOR.md "Environment flags"):
+
+- ``TORCHMETRICS_TPU_COMPILE_AHEAD=0`` — escape hatch: disables the whole
+  layer (no disk reads/writes, no background jobs, no XLA-cache wiring).
+- ``TORCHMETRICS_TPU_CACHE_DIR`` — cache location (default
+  ``~/.cache/torchmetrics_tpu``).
+- ``TORCHMETRICS_TPU_BG_COMPILE=1`` — enable stall-free background
+  compilation of cold keys by default (off by default: it changes first-call
+  semantics from "block on compile" to "serve eagerly, swap in later").
+- ``TORCHMETRICS_TPU_CACHE_MAX_BYTES`` — rotating size cap for the
+  executable store (default 512 MiB; oldest entries evicted first).
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
+
+COMPILE_AHEAD_ENV = "TORCHMETRICS_TPU_COMPILE_AHEAD"
+CACHE_DIR_ENV = "TORCHMETRICS_TPU_CACHE_DIR"
+BG_COMPILE_ENV = "TORCHMETRICS_TPU_BG_COMPILE"
+CACHE_MAX_BYTES_ENV = "TORCHMETRICS_TPU_CACHE_MAX_BYTES"
+
+#: executable-entry file magic (8 bytes + newline, includes container version)
+ENTRY_MAGIC = b"TMTPUXC1\n"
+
+#: entry header schema version (bump on incompatible header changes)
+ENTRY_VERSION = 1
+
+#: executable-store entry filename suffix
+ENTRY_SUFFIX = ".tmx"
+
+#: shape-profile manifest schema version
+PROFILE_VERSION = 1
+
+DEFAULT_CACHE_MAX_BYTES = 512 * 1024 * 1024
+
+_FALSEY = ("0", "false", "off", "no")
+
+
+def compile_ahead_enabled() -> bool:
+    """Master switch (``TORCHMETRICS_TPU_COMPILE_AHEAD``, on by default)."""
+    return os.environ.get(COMPILE_AHEAD_ENV, "1").strip().lower() not in _FALSEY
+
+
+def background_compile_default() -> bool:
+    """Whether cold executor keys compile on the background worker by default
+    (``TORCHMETRICS_TPU_BG_COMPILE``, off by default — it changes first-call
+    semantics from "block on compile" to "serve eagerly, swap in later")."""
+    return os.environ.get(BG_COMPILE_ENV, "0").strip().lower() not in _FALSEY
+
+
+def cache_dir() -> Optional[str]:
+    """Resolved executable-cache directory, or None when the layer is off."""
+    if not compile_ahead_enabled():
+        return None
+    configured = os.environ.get(CACHE_DIR_ENV, "").strip()
+    if configured:
+        return os.path.expanduser(configured)
+    return os.path.join(os.path.expanduser("~"), ".cache", "torchmetrics_tpu")
+
+
+def cache_max_bytes() -> int:
+    raw = os.environ.get(CACHE_MAX_BYTES_ENV, "").strip()
+    try:
+        return int(raw) if raw else DEFAULT_CACHE_MAX_BYTES
+    except ValueError:
+        rank_zero_debug(f"torchmetrics_tpu compile cache: bad {CACHE_MAX_BYTES_ENV}={raw!r}; using default")
+        return DEFAULT_CACHE_MAX_BYTES
+
+
+# --------------------------------------------------------------- fingerprints
+
+_SOURCE_HASH_CACHE: Dict[Any, str] = {}
+_sha = lambda data: hashlib.sha256(data).hexdigest()  # noqa: E731
+
+
+def source_hash(obj: Any) -> str:
+    """Cached sha256 of ``inspect.getsource(obj)`` (``"unknown"`` when the
+    source is unavailable — REPL classes, frozen imports)."""
+    cached = _SOURCE_HASH_CACHE.get(obj)
+    if cached is None:
+        try:
+            cached = _sha(inspect.getsource(obj).encode())[:16]
+        except (OSError, TypeError):
+            cached = "unknown"
+        _SOURCE_HASH_CACHE[obj] = cached
+    return cached
+
+
+def toolchain_fingerprint() -> str:
+    """Versions + code identity shared by every entry: a jax/jaxlib/library
+    bump or an edit to the executor/compile-cache machinery must invalidate
+    everything (stale executables silently running old code are the one
+    failure this key exists to prevent)."""
+    cached = _SOURCE_HASH_CACHE.get("__toolchain__")
+    if cached is None:
+        import jax
+        import jaxlib
+
+        from torchmetrics_tpu import __version__
+        from torchmetrics_tpu.ops import executor as executor_mod
+
+        cached = "|".join(
+            (
+                f"tm_tpu={__version__}",
+                f"jax={jax.__version__}",
+                f"jaxlib={getattr(jaxlib, '__version__', '?')}",
+                f"executor={source_hash(executor_mod)}",
+                f"compile_cache={source_hash(inspect.getmodule(toolchain_fingerprint))}",
+            )
+        )
+        _SOURCE_HASH_CACHE["__toolchain__"] = cached
+    return cached
+
+
+def backend_fingerprint() -> str:
+    """``backend/device_kind`` of the default device — executables are
+    machine-code-adjacent, so a different accelerator is a different key."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        return f"{jax.default_backend()}/{dev.device_kind}"
+    except Exception as err:  # backend probing must never break dispatch
+        rank_zero_debug(f"torchmetrics_tpu compile cache: backend probe failed ({err})")
+        return "unknown/unknown"
+
+
+def entry_key(key_desc: str) -> str:
+    """Content hash naming the on-disk entry for a fully-described key."""
+    return _sha(key_desc.encode())[:32]
+
+
+# ------------------------------------------------------- XLA cache fallback
+
+_XLA_CACHE_WIRED = [False]
+
+
+def ensure_xla_cache_configured() -> bool:
+    """Point JAX's persistent compilation cache at ``<cache_dir>/xla`` when
+    the user has not configured one (idempotent, never fatal).
+
+    This is the fallback tier: even computations ``jax.export`` cannot
+    serialize get their XLA compile reused across processes. When we own the
+    directory we also zero the cache thresholds — metric-update computations
+    are individually small and the defaults would cache nothing.
+    """
+    if _XLA_CACHE_WIRED[0]:
+        return True
+    directory = cache_dir()
+    if directory is None:
+        return False
+    import jax
+
+    try:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            _XLA_CACHE_WIRED[0] = True  # user (or test harness) already owns it
+            return True
+        jax.config.update("jax_compilation_cache_dir", os.path.join(directory, "xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        try:
+            # jax memoizes "cache disabled" on the first compile it performs;
+            # a process that already compiled anything (eager ops during group
+            # resolution, imports) would silently ignore the new dir without
+            # this reset
+            from jax._src import compilation_cache as _jax_cc
+
+            _jax_cc.reset_cache()
+        except Exception as err:
+            rank_zero_debug(f"torchmetrics_tpu compile cache: jax cache reset unavailable ({err})")
+        _XLA_CACHE_WIRED[0] = True
+        return True
+    except Exception as err:  # cache wiring is an optimization, never a crash
+        rank_zero_debug(f"torchmetrics_tpu compile cache: could not wire XLA cache ({err})")
+        return False
+
+
+# ------------------------------------------------------------ export round-trip
+
+#: compiled-executable pickle (jax.experimental.serialize_executable): native
+#: code, near-zero reload cost, valid ONLY for the exact toolchain + backend +
+#: device kind the key fingerprints pin down
+FORMAT_COMPILED = "pjit_pickle"
+#: portable StableHLO module (jax.export): reload skips the Python trace but
+#: still pays one (persistent-cache-accelerated) XLA compile
+FORMAT_STABLEHLO = "stablehlo_export"
+
+
+def export_executable(jit_fn: Callable, example_args: Tuple[Any, ...]) -> List[Tuple[str, bytes]]:
+    """Serialize ``jit_fn`` at the avals of ``example_args``; returns the
+    entry's sections as ``[(format, blob), ...]``, best format first.
+
+    Section 1 (when available): the AOT-compiled native executable, pickled
+    (:data:`FORMAT_COMPILED`) — reload is a load, not a compile. The exact
+    jax/jaxlib/backend/device-kind envelope a native executable needs is
+    already part of every entry's key and header, so a mismatched binary can
+    never be looked up, and a tampered one fails the header check. Section 2:
+    the portable ``jax.export`` StableHLO module (:data:`FORMAT_STABLEHLO`) —
+    reload re-compiles (persistent-XLA-cache-accelerated) but survives
+    environments where the native form cannot be reloaded (XLA:CPU sometimes
+    emits executables whose serialized form misses fusion symbols). The
+    loader tries sections in order. Raises when NO section serializes —
+    callers treat that as "this key stays memory-only".
+    """
+    import pickle
+
+    sections: List[Tuple[str, bytes]] = []
+    try:
+        from jax.experimental import serialize_executable as se
+
+        compiled = jit_fn.lower(*example_args).compile()
+        payload, in_tree, out_tree = se.serialize(compiled)
+        sections.append((FORMAT_COMPILED, pickle.dumps((bytes(payload), in_tree, out_tree), protocol=4)))
+    except Exception as err:
+        rank_zero_debug(
+            f"torchmetrics_tpu compile cache: AOT executable serialization unavailable"
+            f" ({type(err).__name__}: {err})"
+        )
+    try:
+        from jax import export as jexport
+
+        sections.append((FORMAT_STABLEHLO, bytes(jexport.export(jit_fn)(*example_args).serialize())))
+    except Exception as err:
+        rank_zero_debug(
+            f"torchmetrics_tpu compile cache: jax.export serialization failed"
+            f" ({type(err).__name__}: {err})"
+        )
+        if not sections:
+            raise
+    return sections
+
+
+def deserialize_executable(blob: bytes, fmt: str = FORMAT_STABLEHLO) -> Callable:
+    """Rebuild a dispatchable callable from a serialized entry.
+
+    :data:`FORMAT_COMPILED` entries load the native executable directly
+    (donation baked in at AOT-compile time; unpickling is safe here in the
+    same sense jax's own persistent cache is — entries live in the user's
+    cache dir, are sha256-checksummed, and are version-pinned by the key).
+    :data:`FORMAT_STABLEHLO` entries wrap the exported module back under
+    ``jax.jit(..., donate_argnums=0)``; their first dispatch compiles the
+    StableHLO (no Python re-trace) and hits the persistent XLA cache when
+    the store pre-warmed it at persist time."""
+    import jax
+
+    if fmt == FORMAT_COMPILED:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = pickle.loads(blob)
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    if fmt != FORMAT_STABLEHLO:
+        raise ValueError(f"unknown cache entry format {fmt!r}")
+    from jax import export as jexport
+
+    exported = jexport.deserialize(bytearray(blob))
+    backend = jax.default_backend()
+    if exported.platforms and backend not in tuple(p.lower() for p in exported.platforms):
+        raise ValueError(f"entry exported for {exported.platforms}, current backend is {backend!r}")
+    return jax.jit(exported.call, donate_argnums=0)
+
+
+# ----------------------------------------------------------------- disk store
+
+def entry_path(key_hash: str, directory: Optional[str] = None) -> Optional[str]:
+    directory = directory if directory is not None else cache_dir()
+    if directory is None:
+        return None
+    return os.path.join(directory, "executables", f"{key_hash}{ENTRY_SUFFIX}")
+
+
+def _entry_bytes(key_desc: str, sections: List[Tuple[str, bytes]]) -> bytes:
+    payload = b"".join(blob for _, blob in sections)
+    header = {
+        "entry_version": ENTRY_VERSION,
+        "sections": [{"format": fmt, "len": len(blob), "sha256": _sha(blob)} for fmt, blob in sections],
+        "toolchain": toolchain_fingerprint(),
+        "backend": backend_fingerprint(),
+        "key_desc_sha256": _sha(key_desc.encode()),
+        "created_unix": time.time(),
+        "payload_len": len(payload),
+        "payload_sha256": _sha(payload),
+    }
+    header_bytes = json.dumps(header, sort_keys=True).encode()
+    return ENTRY_MAGIC + len(header_bytes).to_bytes(8, "little") + header_bytes + payload
+
+
+def store_executable(
+    key_desc: str, sections: Any, directory: Optional[str] = None
+) -> Optional[str]:
+    """Atomically write one entry's sections (``[(format, blob), ...]`` or a
+    single ``(format, blob)`` pair); returns the path written (None when the
+    store is disabled or the write failed — never raises). After a successful
+    write the store is pruned to the rotating size cap."""
+    if sections and isinstance(sections[0], str):
+        sections = [tuple(sections)]
+    if not sections:
+        return None
+    path = entry_path(entry_key(key_desc), directory)
+    if path is None:
+        return None
+    from torchmetrics_tpu.io.checkpoint import atomic_write_bytes
+
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        atomic_write_bytes(path, _entry_bytes(key_desc, list(sections)))
+    except OSError as err:
+        rank_zero_debug(f"torchmetrics_tpu compile cache: store failed for {path} ({err})")
+        return None
+    prune_store(os.path.dirname(path))
+    return path
+
+
+class CacheEntryInvalid(ValueError):
+    """An on-disk entry failed validation (torn, corrupt, stale toolchain or
+    backend). Always *handled* — the loader warns, deletes, and reports a
+    miss; a poisoned cache can never crash a step or change a result."""
+
+
+def _parse_entry(path: str, data: bytes, key_desc: str) -> List[Tuple[str, bytes]]:
+    if len(data) < len(ENTRY_MAGIC) + 8 or not data.startswith(ENTRY_MAGIC):
+        raise CacheEntryInvalid(f"{path}: bad magic / truncated header")
+    hlen = int.from_bytes(data[len(ENTRY_MAGIC):len(ENTRY_MAGIC) + 8], "little")
+    h_start = len(ENTRY_MAGIC) + 8
+    if hlen <= 0 or h_start + hlen > len(data):
+        raise CacheEntryInvalid(f"{path}: header length {hlen} exceeds file size (torn write)")
+    try:
+        header = json.loads(data[h_start:h_start + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as err:
+        raise CacheEntryInvalid(f"{path}: header is not valid JSON ({err})") from err
+    version = header.get("entry_version")
+    if not isinstance(version, int) or version > ENTRY_VERSION:
+        raise CacheEntryInvalid(f"{path}: entry_version {version!r} unsupported (reads <= {ENTRY_VERSION})")
+    if header.get("toolchain") != toolchain_fingerprint():
+        raise CacheEntryInvalid(f"{path}: stale toolchain {header.get('toolchain')!r}")
+    if header.get("backend") != backend_fingerprint():
+        raise CacheEntryInvalid(f"{path}: entry built for backend {header.get('backend')!r}")
+    if header.get("key_desc_sha256") != _sha(key_desc.encode()):
+        raise CacheEntryInvalid(f"{path}: key description mismatch (hash collision or key-logic drift)")
+    payload = data[h_start + hlen:]
+    if len(payload) != header.get("payload_len"):
+        raise CacheEntryInvalid(
+            f"{path}: payload is {len(payload)} bytes, header promises {header.get('payload_len')} (torn write)"
+        )
+    if _sha(payload) != header.get("payload_sha256"):
+        raise CacheEntryInvalid(f"{path}: payload sha256 mismatch (corrupt write / bit rot)")
+    section_meta = header.get("sections")
+    if not isinstance(section_meta, list) or not section_meta:
+        raise CacheEntryInvalid(f"{path}: entry has no sections")
+    sections: List[Tuple[str, bytes]] = []
+    offset = 0
+    for meta in section_meta:
+        fmt, length = meta.get("format"), meta.get("len")
+        if fmt not in (FORMAT_COMPILED, FORMAT_STABLEHLO) or not isinstance(length, int):
+            raise CacheEntryInvalid(f"{path}: malformed section {meta!r}")
+        blob = payload[offset:offset + length]
+        if len(blob) != length or _sha(blob) != meta.get("sha256"):
+            raise CacheEntryInvalid(f"{path}: section {fmt!r} sha256/length mismatch")
+        sections.append((fmt, blob))
+        offset += length
+    if offset != len(payload):
+        raise CacheEntryInvalid(f"{path}: {len(payload) - offset} trailing payload bytes")
+    return sections
+
+
+def load_executable_blob(key_desc: str, directory: Optional[str] = None) -> Optional[List[Tuple[str, bytes]]]:
+    """Validated sections ``[(format, blob), ...]`` for ``key_desc`` (best
+    format first), or None on miss. A damaged or stale entry is WARNED about,
+    deleted, and reported as a miss — degrading to a fresh compile is the
+    contract, crashing is not."""
+    path = entry_path(entry_key(key_desc), directory)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+        return _parse_entry(path, data, key_desc)
+    except CacheEntryInvalid as err:
+        rank_zero_warn(
+            f"torchmetrics_tpu compile cache: skipping damaged/stale entry ({err}); recompiling fresh"
+        )
+        try:
+            os.unlink(path)
+        except OSError:
+            rank_zero_debug(f"torchmetrics_tpu compile cache: could not delete {path}")
+        return None
+    except OSError as err:
+        rank_zero_debug(f"torchmetrics_tpu compile cache: read failed for {path} ({err})")
+        return None
+
+
+def prune_store(directory: str, max_bytes: Optional[int] = None) -> int:
+    """Evict oldest entries (by mtime) until the store fits the size cap;
+    returns the number of entries removed. Never fatal."""
+    max_bytes = cache_max_bytes() if max_bytes is None else max_bytes
+    try:
+        entries = []
+        with os.scandir(directory) as it:
+            for de in it:
+                if de.name.endswith(ENTRY_SUFFIX) and de.is_file():
+                    st = de.stat()
+                    entries.append((st.st_mtime, st.st_size, de.path))
+    except OSError:
+        return 0
+    total = sum(size for _, size, _ in entries)
+    removed = 0
+    for _, size, path in sorted(entries):
+        if total <= max_bytes:
+            break
+        try:
+            os.unlink(path)
+            total -= size
+            removed += 1
+        except OSError:
+            rank_zero_debug(f"torchmetrics_tpu compile cache: could not evict {path}")
+    return removed
+
+
+# ----------------------------------------------------------- background worker
+
+class CompileWorker:
+    """One daemon thread + bounded queue running compile/persist jobs.
+
+    Jobs are plain callables; a job that raises is recorded (``stats``,
+    debug-logged) and never propagates — background compilation is an
+    optimization layered on a correct eager path, so its failures only cost
+    speed. ``submit`` is non-blocking: a full queue DROPS the job (counted)
+    instead of stalling the step loop; the executor re-submits on a later
+    miss. Thread-safe against the donation/recovery machinery by
+    construction: jobs only ever touch builder closures, abstract avals, and
+    fresh dummy arrays — never live metric state.
+    """
+
+    def __init__(self, maxsize: int = 16) -> None:
+        self._q: "queue.Queue[Callable[[], None]]" = queue.Queue(maxsize=maxsize)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.stats = {"submitted": 0, "dropped": 0, "completed": 0, "errors": 0}
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._run, name="tm_tpu_compile_worker", daemon=True)
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                job()
+                self.stats["completed"] += 1
+            except Exception as err:
+                # background work must never crash the process; the eager
+                # path it backs is already correct — record and move on
+                self.stats["errors"] += 1
+                rank_zero_debug(
+                    f"torchmetrics_tpu compile worker: job failed ({type(err).__name__}: {err})"
+                )
+            finally:
+                self._q.task_done()
+
+    def submit(self, job: Callable[[], None]) -> bool:
+        """Enqueue without blocking; False when the bounded queue is full."""
+        try:
+            self._q.put_nowait(job)
+        except queue.Full:
+            self.stats["dropped"] += 1
+            return False
+        self.stats["submitted"] += 1
+        self._ensure_thread()
+        return True
+
+    def pending(self) -> int:
+        return self._q.unfinished_tasks
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every submitted job finished (tests / warmup-wait);
+        True when the queue drained within ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+
+_WORKER: Optional[CompileWorker] = None
+_WORKER_LOCK = threading.Lock()
+
+
+def get_worker() -> CompileWorker:
+    """The process-wide compile worker (created on first use)."""
+    global _WORKER
+    with _WORKER_LOCK:
+        if _WORKER is None:
+            _WORKER = CompileWorker()
+        return _WORKER
+
+
+def drain_worker(timeout: float = 60.0) -> bool:
+    """Wait for all in-flight background compiles/persists (no-op when the
+    worker never started)."""
+    with _WORKER_LOCK:
+        worker = _WORKER
+    return True if worker is None else worker.drain(timeout)
+
+
+# ------------------------------------------------------ shape-profile manifests
+
+def spec_of_call(kind: str, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
+    """JSON-able description of one eager call's input shapes, or None when
+    the call structure cannot be replayed from a manifest (nested pytrees,
+    non-array leaves). Flat tuples of arrays/scalars/bools — essentially
+    every metric update signature — round-trip exactly."""
+    import jax
+
+    def leaf(v: Any) -> Optional[Dict[str, Any]]:
+        if type(v) is bool:
+            return {"bool": v}
+        if isinstance(v, (int, float)) and not isinstance(v, np.generic):
+            return {"scalar": v}
+        if isinstance(v, jax.core.Tracer):
+            return None
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return {"shape": [int(s) for s in v.shape], "dtype": str(v.dtype)}
+        return None
+
+    arg_specs: List[Dict[str, Any]] = []
+    for a in args:
+        s = leaf(a)
+        if s is None:
+            return None
+        arg_specs.append(s)
+    kw_specs: Dict[str, Any] = {}
+    for k, v in kwargs.items():
+        s = leaf(v)
+        if s is None:
+            return None
+        kw_specs[k] = s
+    return {"kind": kind, "args": arg_specs, "kwargs": kw_specs}
+
+
+def dummy_from_spec(spec: Dict[str, Any]) -> Tuple[tuple, dict]:
+    """Zero-filled concrete ``(args, kwargs)`` matching a recorded spec —
+    values are irrelevant for compilation, only avals key executables."""
+    import jax.numpy as jnp
+
+    def leaf(s: Dict[str, Any]) -> Any:
+        if "bool" in s:
+            return bool(s["bool"])
+        if "scalar" in s:
+            return s["scalar"]
+        return jnp.zeros(tuple(s["shape"]), dtype=s["dtype"])
+
+    return tuple(leaf(s) for s in spec.get("args", ())), {k: leaf(s) for k, s in spec.get("kwargs", {}).items()}
+
+
+def save_shape_manifest(path: str, manifest: Dict[str, Any]) -> str:
+    """Atomically persist a shape-profile manifest (JSON) for
+    ``warmup_from_manifest`` replay in a later process."""
+    from torchmetrics_tpu.io.checkpoint import atomic_write_bytes
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    atomic_write_bytes(path, json.dumps(manifest, sort_keys=True, indent=1).encode())
+    return path
+
+
+def load_shape_manifest(path: str) -> Dict[str, Any]:
+    """Parse and structurally validate a shape-profile manifest."""
+    with open(path, "rb") as fh:
+        manifest = json.loads(fh.read().decode())
+    version = manifest.get("profile_version")
+    if not isinstance(version, int) or version > PROFILE_VERSION:
+        raise ValueError(f"{path}: profile_version {version!r} unsupported (reads <= {PROFILE_VERSION})")
+    if not isinstance(manifest.get("specs"), list):
+        raise ValueError(f"{path}: manifest has no 'specs' list")
+    return manifest

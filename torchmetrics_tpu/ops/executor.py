@@ -48,6 +48,20 @@ Escape hatch
     update falls back to the eager path permanently for that instance (the
     reason is recorded in :func:`executor_stats`).
 
+Compile-ahead (ops/compile_cache.py; docs/EXECUTOR.md "Compile-ahead")
+    Cold keys are the tail latency of fresh processes, so the executor layers
+    a cross-process cache over its in-memory one: fresh compiles are exported
+    (``jax.export``) and atomically persisted in the background, a later
+    process's miss loads the serialized computation from disk instead of
+    re-tracing (``disk_hits``), and — with background compilation enabled —
+    a cold key dispatches the step through the eager op-by-op body while the
+    compile runs on the shared worker, the warm executable swapping in
+    atomically for the next call (``eager_misses``/``background_compiles``).
+    :meth:`~_ExecutorBase.warmup` precompiles the bucket ladder ahead of
+    traffic, and every executor records a replayable shape profile
+    (:meth:`~_ExecutorBase.shape_profile`) so ``warmup_from_manifest`` can
+    rebuild exactly the buckets a previous run actually saw.
+
 Synced path
     :func:`make_synced_collection_step` builds the fused
     ``update -> sync -> compute`` step used under ``shard_map``: the
@@ -58,7 +72,9 @@ Synced path
 """
 from __future__ import annotations
 
+import json
 import os
+import threading
 import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -67,8 +83,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.ops import compile_cache
 from torchmetrics_tpu.utils.exceptions import DispatchStallError
-from torchmetrics_tpu.utils.prints import rank_zero_debug
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
 
 # CPU (and some other) backends do not implement buffer donation; jax warns on
 # every dispatch. Donation is still semantically correct there (silently
@@ -115,6 +132,136 @@ class _DispatchFailure(Exception):
     def __init__(self, original: BaseException) -> None:
         super().__init__(str(original))
         self.original = original
+
+
+class _DiskEntryFailure(Exception):
+    """Internal: a disk-loaded executable failed on its FIRST dispatch.
+
+    Persisted entries always dispatch with copied (fresh-key) inputs, so the
+    live state was never at risk — but sticky-disabling the executor (the
+    trace-failure response) would be wrong: without the disk layer this key
+    would have compiled fine. The entry points catch this, evict the entry
+    from memory and disk, and retry the call through a fresh inline compile.
+    """
+
+    def __init__(self, key: Any, key_desc: str, original: BaseException) -> None:
+        super().__init__(str(original))
+        self.key = key
+        self.key_desc = key_desc
+        self.original = original
+
+
+class _PersistSpec:
+    """Everything a background compile/persist job may touch: the key's
+    stable cross-process description, export avals, a factory producing
+    fresh zero-filled dummy arguments, and a builder bound to a DETACHED
+    deep copy of the owner — never the live metric. ``functional_update``
+    swaps ``self._state`` while tracing, so tracing the live object off the
+    main thread would race every concurrent update; jobs trace a clone whose
+    computation is identical (same code, same defaults) but whose state
+    nobody else touches."""
+
+    __slots__ = ("key_desc", "avals", "dummy_args", "make_clone_builder")
+
+    def __init__(
+        self,
+        key_desc: str,
+        avals: Tuple[Any, ...],
+        dummy_args: Callable[[], Tuple[Any, ...]],
+        make_clone_builder: Callable[[], Callable[[], Callable]],
+    ) -> None:
+        self.key_desc = key_desc
+        self.avals = avals
+        self.dummy_args = dummy_args
+        self.make_clone_builder = make_clone_builder
+
+
+def _stable_key_repr(obj: Any) -> str:
+    """Deterministic cross-process rendering of an in-memory cache key
+    (treedefs stringify; primitives repr)."""
+    if isinstance(obj, tuple):
+        return "(" + ",".join(_stable_key_repr(o) for o in obj) + ")"
+    if hasattr(obj, "num_leaves") and type(obj).__name__ == "PyTreeDef":
+        return str(obj)
+    return repr(obj)
+
+
+def _aval_of(x: Any) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(np.shape(x)), jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype)
+
+
+def _zeros_like_spec(shapes_dtypes: Sequence[Tuple[tuple, Any]]) -> List[Any]:
+    return [jnp.zeros(shape, dtype) for shape, dtype in shapes_dtypes]
+
+
+def _concrete_warmup_leaf(leaf: Any) -> Any:
+    """Example leaf -> concrete dummy: ShapeDtypeStructs become zeros, arrays
+    are replaced by zeros of their aval (never dispatch on the user's data),
+    scalars/bools pass through."""
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return jnp.zeros(leaf.shape, leaf.dtype)
+    if _is_concrete_array(leaf):
+        return jnp.zeros(np.shape(leaf), leaf.dtype)
+    return leaf
+
+
+def _normalize_warmup_specs(batch_specs: Any) -> List[Tuple[tuple, dict]]:
+    """Accept one spec or a sequence of specs; each spec is an args tuple
+    (optionally an ``(args_tuple, kwargs_dict)`` pair) of arrays /
+    ``ShapeDtypeStruct`` leaves. Returns concrete ``(args, kwargs)`` dummies.
+    """
+    if isinstance(batch_specs, tuple) and batch_specs and not isinstance(batch_specs[0], (tuple, list)):
+        batch_specs = [batch_specs]  # a single bare args tuple
+    out: List[Tuple[tuple, dict]] = []
+    for spec in batch_specs:
+        if (
+            isinstance(spec, (tuple, list))
+            and len(spec) == 2
+            and isinstance(spec[0], (tuple, list))
+            and isinstance(spec[1], dict)
+        ):
+            args, kwargs = tuple(spec[0]), dict(spec[1])
+        elif isinstance(spec, (tuple, list)):
+            args, kwargs = tuple(spec), {}
+        else:
+            args, kwargs = (spec,), {}
+        out.append(
+            (
+                tuple(_concrete_warmup_leaf(a) for a in args),
+                {k: _concrete_warmup_leaf(v) for k, v in kwargs.items()},
+            )
+        )
+    return out
+
+
+class WarmupHandle:
+    """Handle for a background :meth:`warmup` run: ``wait()`` joins the
+    thread and returns the report dict; ``done`` polls."""
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._report: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def _run(self, body: Callable, jobs: Any, ladder: bool) -> None:
+        try:
+            self._report = body(jobs, ladder)
+        except BaseException as err:  # surfaced on wait(), never lost
+            self._error = err
+            rank_zero_debug(f"torchmetrics_tpu warmup thread failed: {type(err).__name__}: {err}")
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                return None  # still warming; call wait() again
+        if self._error is not None:
+            raise self._error
+        return self._report
 
 
 def bucket_size(n: int) -> int:
@@ -295,6 +442,14 @@ def _new_stats() -> Dict[str, Any]:
         "dispatch_failures": 0,   # warm-executable failures propagated to the caller
         "recovery_restores": 0,   # donated states reinstalled from the host snapshot
         "dispatch_retries": 0,    # warm failures re-attempted after the restore (io/retry.py)
+        # compile-ahead layer (ops/compile_cache.py; docs/EXECUTOR.md)
+        "disk_hits": 0,           # keys served from the persistent executable store
+        "disk_stores": 0,         # fresh compiles exported + persisted to disk
+        "disk_evictions": 0,      # persisted entries that failed at dispatch and were dropped
+        "background_compiles": 0, # cold keys compiled on the worker and swapped in warm
+        "eager_misses": 0,        # calls served eagerly while their compile ran in background
+        "compile_ms_total": 0.0,  # wall-clock spent in cold (trace+compile) dispatches
+        "warmup": 0,              # executables precompiled through the warmup API
     }
 
 
@@ -309,6 +464,14 @@ class _ExecutorBase:
         self._pad_validated = False
         self._bucketing_ok = True
         self._keep_recovery = recovery_enabled_default()
+        # compile-ahead bookkeeping (ops/compile_cache.py): the lock guards
+        # cache/pending mutations shared with the background worker thread
+        self._cache_lock = threading.Lock()
+        self._pending_keys: set = set()
+        self._disk_checked: set = set()
+        self._bg_compile: Optional[bool] = None  # None -> env default
+        self._profile: Dict[str, Dict[str, Any]] = {}  # replayable shape specs
+        self._profile_keys: set = set()  # cache keys already profiled (O(1) warm-path gate)
         # most recent committed donating call's host-side recovery snapshot,
         # kept so the Autosaver (io/checkpoint.py) can serialize it instead of
         # fetching the live state again — zero extra device sync per autosave.
@@ -423,15 +586,372 @@ class _ExecutorBase:
                         err = again
             raise _DispatchFailure(err)
 
-    def _get_fn(self, key: Any, builder: Callable[[], Callable]) -> Tuple[Callable, bool]:
+    # ----------------------------------------------------- compile-ahead layer
+    def background_enabled(self) -> bool:
+        """Whether cold keys compile on the background worker (per-instance
+        override, else the ``TORCHMETRICS_TPU_BG_COMPILE`` env default)."""
+        if self._bg_compile is not None:
+            return self._bg_compile
+        return compile_cache.background_compile_default()
+
+    def set_background_compile(self, enabled: Optional[bool]) -> None:
+        """Override stall-free background compilation for this executor
+        (None restores the env default)."""
+        self._bg_compile = enabled
+
+    def _install_fn(self, key: Any, fn: Callable) -> None:
+        with self._cache_lock:
+            self._cache[key] = fn
+            self._pending_keys.discard(key)
+
+    def _load_from_disk(self, key: Any, persist: _PersistSpec) -> Optional[Callable]:
+        """Deserialize a persisted executable for ``key``, or None on miss.
+
+        The returned callable routes its first-dispatch failure to
+        :class:`_DiskEntryFailure` (evict + fresh recompile, NOT the sticky
+        eager fallback a trace failure earns) and unwraps itself back to the
+        bare jitted call once one dispatch has succeeded."""
+        sections = compile_cache.load_executable_blob(persist.key_desc)
+        if sections is None:
+            return None
+        loaded = None
+        for fmt, blob in sections:  # best format first; fall through on failure
+            try:
+                loaded = compile_cache.deserialize_executable(blob, fmt)
+                break
+            except Exception as err:
+                rank_zero_debug(
+                    f"torchmetrics_tpu compile cache: section {fmt!r} for {self._owner_name()}"
+                    f" failed to deserialize ({type(err).__name__}: {err}); trying next section"
+                )
+        if loaded is None:
+            rank_zero_warn(
+                f"torchmetrics_tpu compile cache: persisted executable for {self._owner_name()}"
+                f" failed to deserialize (no loadable section); recompiling fresh"
+            )
+            self._unlink_entry(persist.key_desc)
+            return None
+        proven = [False]
+
+        def dispatch(*args: Any) -> Any:
+            if proven[0]:
+                return loaded(*args)
+            try:
+                out = loaded(*args)
+            except Exception as err:
+                raise _DiskEntryFailure(key, persist.key_desc, err) from err
+            proven[0] = True
+            self._install_fn(key, loaded)  # drop this wrapper from the hot path
+            return out
+
+        return dispatch
+
+    def _unlink_entry(self, key_desc: str) -> None:
+        path = compile_cache.entry_path(compile_cache.entry_key(key_desc))
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                rank_zero_debug(f"torchmetrics_tpu compile cache: could not delete {path}")
+
+    def _evict_disk_entry(self, failure: _DiskEntryFailure) -> None:
+        """A persisted executable died at dispatch: drop it from memory and
+        disk so the retry compiles fresh (docs/EXECUTOR.md "Compile-ahead")."""
+        with self._cache_lock:
+            self._cache.pop(failure.key, None)
+        self._unlink_entry(failure.key_desc)
+        self.stats["disk_evictions"] += 1
+        rank_zero_warn(
+            f"torchmetrics_tpu compile cache: persisted executable for {self._owner_name()}"
+            f" failed at dispatch ({type(failure.original).__name__}: {failure.original});"
+            " entry evicted, recompiling fresh"
+        )
+
+    def _schedule_background_compile(self, key: Any, persist: _PersistSpec) -> bool:
+        """Compile ``key`` on the shared worker (tracing a detached clone),
+        warm it on zero dummies, and swap it into the cache; the current step
+        proceeds eagerly. A full queue or un-clonable owner skips (False:
+        the caller compiles inline); a failing trace sticky-disables exactly
+        like an inline trace failure would."""
+        with self._cache_lock:
+            if key in self._pending_keys:
+                return True  # already compiling: keep serving eagerly
+            self._pending_keys.add(key)
+        try:
+            clone_builder = persist.make_clone_builder()
+        except Exception as err:
+            rank_zero_debug(
+                f"torchmetrics_tpu executor: {self._owner_name()} is not clonable for background"
+                f" compilation ({type(err).__name__}: {err}); compiling inline"
+            )
+            with self._cache_lock:
+                self._pending_keys.discard(key)
+            return False
+
+        def job() -> None:
+            t0 = time.perf_counter()
+            try:
+                fn = jax.jit(clone_builder(), donate_argnums=0)
+                jax.block_until_ready(fn(*persist.dummy_args()))
+            except Exception as err:
+                with self._cache_lock:
+                    self._pending_keys.discard(key)
+                self._disable(f"background compile failed: {type(err).__name__}: {err}")
+                return
+            self._install_fn(key, fn)
+            self.stats["compiles"] += 1
+            self.stats["background_compiles"] += 1
+            self.stats["compile_ms_total"] += (time.perf_counter() - t0) * 1e3
+            self._persist_body(fn, persist)
+
+        if not compile_cache.get_worker().submit(job):
+            with self._cache_lock:
+                self._pending_keys.discard(key)
+            return False
+        return True
+
+    def _schedule_persist(self, persist: _PersistSpec) -> None:
+        """Persist a freshly inline-compiled key in the background (skipped
+        when an identical entry already exists — e.g. a sibling instance of
+        the same metric config got there first). The worker re-traces a
+        DETACHED clone for export: the live jitted callable's trace would
+        swap live state mid-step (see :class:`_PersistSpec`)."""
+        path = compile_cache.entry_path(compile_cache.entry_key(persist.key_desc))
+        if path is None or os.path.exists(path):
+            return
+        try:
+            clone_builder = persist.make_clone_builder()
+        except Exception as err:
+            rank_zero_debug(
+                f"torchmetrics_tpu executor: {self._owner_name()} is not clonable for background"
+                f" persist ({type(err).__name__}: {err}); key stays memory-only"
+            )
+            return
+        compile_cache.get_worker().submit(
+            lambda: self._persist_body(jax.jit(clone_builder(), donate_argnums=0), persist)
+        )
+
+    def _persist_body(self, fn: Callable, persist: _PersistSpec) -> None:
+        """Worker-side: export the computation at its avals, atomically store
+        it, and pre-warm the persisted form into the XLA persistent cache so
+        the NEXT process's first dispatch is a cache hit, not a compile."""
+        try:
+            sections = compile_cache.export_executable(fn, persist.avals)
+        except Exception as err:
+            # unserializable computation: this key stays memory-only (the XLA
+            # persistent cache still covers its compile); record why once
+            rank_zero_debug(
+                f"torchmetrics_tpu compile cache: export failed for {self._owner_name()}"
+                f" ({type(err).__name__}: {err}); key stays memory-only"
+            )
+            return
+        if compile_cache.store_executable(persist.key_desc, sections) is None:
+            return
+        self.stats["disk_stores"] += 1
+        if sections[0][0] != compile_cache.FORMAT_STABLEHLO:
+            return  # native-executable entries reload without compiling
+        try:
+            # StableHLO-first entries still compile at reload: pre-populate the
+            # XLA persistent cache so the NEXT process's dispatch is a cache hit
+            warm = compile_cache.deserialize_executable(sections[0][1], sections[0][0])
+            jax.block_until_ready(warm(*persist.dummy_args()))
+        except Exception as err:
+            rank_zero_debug(
+                f"torchmetrics_tpu compile cache: could not pre-warm persisted entry"
+                f" ({type(err).__name__}: {err})"
+            )
+
+    def _get_fn(
+        self,
+        key: Any,
+        builder: Callable[[], Callable],
+        persist_factory: Optional[Callable[[], Optional[_PersistSpec]]] = None,
+        allow_background: bool = True,
+    ) -> Tuple[Optional[Callable], bool]:
+        """Resolve ``key`` to a dispatchable callable.
+
+        Resolution order: warm in-memory executable -> persistent disk store
+        (``disk_hits``; first dispatch keeps fresh-key copy semantics) ->
+        background compile (returns ``(None, False)``: the caller serves this
+        step through the eager body while the worker compiles) -> inline
+        ``jax.jit`` build (the pre-compile-ahead behavior), persisted to disk
+        in the background. ``persist_factory`` is only invoked on a miss —
+        warm calls pay zero compile-ahead overhead."""
         fn = self._cache.get(key)
         if fn is not None:
             self.stats["cache_hits"] += 1
             return fn, False
+        persist = None
+        if persist_factory is not None and compile_cache.compile_ahead_enabled():
+            persist = persist_factory()
+        if persist is not None:
+            compile_cache.ensure_xla_cache_configured()
+            if key not in self._disk_checked:
+                self._disk_checked.add(key)
+                loaded = self._load_from_disk(key, persist)
+                if loaded is not None:
+                    self._install_fn(key, loaded)
+                    self.stats["disk_hits"] += 1
+                    return loaded, True  # fresh semantics: first dispatch copies
+            if allow_background and self.background_enabled() and self._schedule_background_compile(key, persist):
+                return None, False
         fn = jax.jit(builder(), donate_argnums=0)
-        self._cache[key] = fn
+        self._install_fn(key, fn)
         self.stats["compiles"] += 1
+        if persist is not None:
+            self._schedule_persist(persist)
         return fn, True
+
+    # -------------------------------------------------- shape-profile manifest
+    def _record_profile(self, key: Any, kind: str, args: tuple, kwargs: dict) -> None:
+        """Remember a replayable description of this call's shapes (bounded;
+        the manifest ``warmup_from_manifest`` replays in a later process).
+        Gated on the cache key so warm calls pay one set lookup, not a
+        spec serialization."""
+        if key in self._profile_keys:
+            return
+        self._profile_keys.add(key)
+        if len(self._profile) >= 64:
+            return
+        spec = compile_cache.spec_of_call(kind, args, kwargs)
+        if spec is None:
+            return
+        self._profile.setdefault(json.dumps(spec, sort_keys=True), spec)
+
+    def shape_profile(self) -> Dict[str, Any]:
+        """Replayable manifest of every (bounded) distinct call shape this
+        executor has seen — feed to ``warmup_from_manifest`` after a restart
+        to precompile exactly the buckets the previous run used."""
+        return {
+            "profile_version": compile_cache.PROFILE_VERSION,
+            "owner": self._owner_name(),
+            "specs": list(self._profile.values()),
+        }
+
+    # ------------------------------------------------------------------ warmup
+    def _warmup_one(self, kind: str, args: tuple, kwargs: dict) -> str:
+        raise NotImplementedError
+
+    def _warmup_bucketable(self) -> bool:
+        raise NotImplementedError
+
+    def _ladder_variants(self, args: tuple, kwargs: dict) -> List[Tuple[tuple, dict]]:
+        """The spec itself plus one padded representative per bucket rung at
+        or below its bucket — precompiling the ladder means the ragged final
+        batches of an epoch land on warm executables too."""
+        out = [(args, kwargs)]
+        spec = compile_cache.spec_of_call("x", args, kwargs)
+        if spec is None or not self._warmup_bucketable():
+            return out
+        dims = {s["shape"][0] for s in list(spec["args"]) + list(spec["kwargs"].values()) if s.get("shape")}
+        if len(dims) != 1:
+            return out
+        n = dims.pop()
+        if n <= 0:
+            return out
+        rung = _BUCKET_FLOOR
+        top = bucket_size(n)
+        while rung <= top:
+            size = max(1, rung - 1)  # pads up to exactly this rung
+            if size != n:
+                resized = json.loads(json.dumps(spec))
+                for leaf in list(resized["args"]) + list(resized["kwargs"].values()):
+                    if leaf.get("shape") and leaf["shape"][0] == n:
+                        leaf["shape"][0] = size
+                out.append(compile_cache.dummy_from_spec(resized))
+            rung <<= 1
+        return out
+
+    def warmup(
+        self,
+        batch_specs: Any,
+        forward: bool = False,
+        ladder: bool = True,
+        background: bool = False,
+    ) -> Any:
+        """Precompile the executables ``batch_specs``-shaped traffic will hit.
+
+        ``batch_specs``: one spec or a sequence of specs, each a tuple of
+        example arrays / ``jax.ShapeDtypeStruct`` leaves (optionally
+        ``(args_tuple, kwargs_dict)``). Values are irrelevant — zero-filled
+        dummies are compiled and discarded; live state is never touched.
+        ``ladder=True`` additionally warms one padded representative per
+        bucket rung. ``background=True`` runs on a daemon thread and returns
+        a :class:`WarmupHandle`; otherwise the report dict is returned.
+        """
+        jobs = [("update", a, k) for a, k in _normalize_warmup_specs(batch_specs)]
+        if forward:
+            jobs += [("forward", a, k) for _, a, k in jobs[: len(jobs)]]
+        return self._launch_warmup(jobs, ladder, background)
+
+    def warmup_from_manifest(self, manifest: Any, background: bool = False) -> Any:
+        """Replay a shape-profile manifest (a dict from :meth:`shape_profile`
+        or a path saved by ``save_shape_profile``): precompiles exactly the
+        call shapes a previous run recorded, no ladder expansion."""
+        if isinstance(manifest, (str, os.PathLike)):
+            manifest = compile_cache.load_shape_manifest(os.fspath(manifest))
+        if not isinstance(manifest.get("specs"), list):
+            raise ValueError("manifest has no 'specs' list")
+        jobs = []
+        for spec in manifest["specs"]:
+            args, kwargs = compile_cache.dummy_from_spec(spec)
+            jobs.append((spec.get("kind", "update"), args, kwargs))
+        return self._launch_warmup(jobs, ladder=False, background=background)
+
+    def _launch_warmup(self, jobs: List[Tuple[str, tuple, dict]], ladder: bool, background: bool) -> Any:
+        if not background:
+            return self._run_warmup(jobs, ladder)
+        handle = WarmupHandle()
+        thread = threading.Thread(
+            target=handle._run, args=(self._run_warmup, jobs, ladder), name="tm_tpu_warmup", daemon=True
+        )
+        handle._thread = thread
+        thread.start()
+        return handle
+
+    def _run_warmup(self, jobs: List[Tuple[str, tuple, dict]], ladder: bool) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        report: Dict[str, Any] = {"warmed": 0, "already_warm": 0, "skipped": []}
+        for kind, args, kwargs in jobs:
+            variants = self._ladder_variants(args, kwargs) if ladder else [(args, kwargs)]
+            for v_args, v_kwargs in variants:
+                try:
+                    outcome = self._warmup_one(kind, v_args, v_kwargs)
+                except Exception as err:  # warmup must never take the loop down
+                    outcome = f"{kind}: {type(err).__name__}: {err}"
+                    rank_zero_debug(f"torchmetrics_tpu warmup: {self._owner_name()}: {outcome}")
+                if outcome == "warmed":
+                    report["warmed"] += 1
+                elif outcome == "already_warm":
+                    report["already_warm"] += 1
+                else:
+                    report["skipped"].append(outcome)
+        report["seconds"] = round(time.perf_counter() - t0, 3)
+        return report
+
+    def _dispatch_warmup(self, key: Any, builder: Callable[[], Callable], persist: _PersistSpec) -> str:
+        """Shared tail of every warmup path: resolve the key inline (disk
+        store consulted, background-miss mode bypassed — warmup IS the
+        background) and prove the executable with one dummy dispatch.
+
+        Tracing goes through a detached clone, not ``builder`` bound to the
+        live object: warmup may run on its own thread while traffic flows,
+        and tracing the live metric would swap its state mid-step."""
+        del builder  # the live-bound builder must not trace off-thread
+        if key in self._cache:
+            return "already_warm"
+        t0 = time.perf_counter()
+        clone_builder = persist.make_clone_builder()
+        fn, _ = self._get_fn(key, clone_builder, lambda: persist, allow_background=False)
+        try:
+            jax.block_until_ready(fn(*persist.dummy_args()))
+        except _DiskEntryFailure as df:
+            self._evict_disk_entry(df)
+            fn, _ = self._get_fn(key, clone_builder, None, allow_background=False)
+            jax.block_until_ready(fn(*persist.dummy_args()))
+        self.stats["warmup"] += 1
+        self.stats["compile_ms_total"] += (time.perf_counter() - t0) * 1e3
+        return "warmed"
 
     def stats_dict(self) -> Dict[str, Any]:
         out = dict(self.stats)
@@ -439,6 +959,9 @@ class _ExecutorBase:
         out["fallback_reason"] = self.disabled_reason
         out["bucketing_enabled"] = self._bucketing_ok
         out["cached_executables"] = len(self._cache)
+        out["background_enabled"] = self.background_enabled()
+        out["pending_background"] = len(self._pending_keys)
+        out["profile_entries"] = len(self._profile)
         return out
 
 
@@ -500,15 +1023,127 @@ class MetricExecutor(_ExecutorBase):
                 return False
         return True
 
-    # --------------------------------------------------------------- builders
-    def _consts(self):
-        m = self._metric
-        defaults = {k: jnp.asarray(v) for k, v in m._defaults.items()}
-        return defaults
+    # ----------------------------------------------------- compile-ahead keys
+    def _owner_desc(self) -> str:
+        """Cross-process identity of this metric's computation: class +
+        defining-module source hash + the registered state spec (shapes carry
+        configuration like ``num_classes``; reductions carry merge semantics)."""
+        import sys
 
-    def _build_update(self, treedef, batched, bucket, padded, bool_spec, n_leaves):
         m = self._metric
-        defaults = self._consts()
+        cls = type(m)
+        mod = sys.modules.get(cls.__module__)
+        fields = ",".join(
+            f"{k}:{jnp.asarray(v).dtype}:{tuple(np.shape(v))}:{m._reductions.get(k)}"
+            for k, v in m._defaults.items()
+        )
+        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}"
+
+    def _key_desc(self, key: Any) -> str:
+        return "|".join(
+            (
+                compile_cache.toolchain_fingerprint(),
+                compile_cache.backend_fingerprint(),
+                self._owner_desc(),
+                _stable_key_repr(key),
+                "donate=0",
+            )
+        )
+
+    def _clone_owner(self):
+        """A fully-detached deep copy of the metric for off-main-thread
+        tracing (``__getstate__`` rebuilds the wrapped methods around the
+        copy, so no closure reaches back to the live instance); its own
+        executor is disabled so a clone can never recurse into this machinery."""
+        import copy
+
+        clone = copy.deepcopy(self._metric)
+        clone.__dict__["_executor_enabled"] = False
+        return clone
+
+    def _persist_spec(
+        self,
+        key: Any,
+        state: Dict[str, Any],
+        call_leaves: Sequence[Any],
+        padded: bool,
+        n: Optional[int],
+        count: bool,
+        clone_factory: Callable[[Any], Callable],
+    ) -> Optional[_PersistSpec]:
+        """Export/warm description of one executable, or None when a leaf
+        cannot be described as a strong-typed aval (python-scalar leaves trace
+        weakly typed; a persisted strong-typed signature would not match).
+        ``clone_factory(clone_metric) -> raw body`` rebuilds the builder over
+        a detached clone for background tracing."""
+        if not all(_is_concrete_array(l) for l in call_leaves):
+            return None
+        state_sd = {k: (tuple(np.shape(v)), jnp.asarray(v).dtype) for k, v in state.items()}
+        leaf_sd = [(tuple(np.shape(l)), l.dtype) for l in call_leaves]
+        n_val = int(n) if padded else None
+        i32 = jnp.int32
+
+        state_avals = {k: jax.ShapeDtypeStruct(s, d) for k, (s, d) in state_sd.items()}
+        scalar_avals = (jax.ShapeDtypeStruct((), i32),) * (int(count) + int(padded))
+        avals = (state_avals,) + scalar_avals + tuple(jax.ShapeDtypeStruct(s, d) for s, d in leaf_sd)
+
+        def dummies() -> Tuple[Any, ...]:
+            st = {k: jnp.zeros(s, d) for k, (s, d) in state_sd.items()}
+            scalars = ()
+            if count:
+                scalars += (jnp.asarray(0, i32),)
+            if n_val is not None:
+                scalars += (jnp.asarray(n_val, i32),)
+            return (st,) + scalars + tuple(_zeros_like_spec(leaf_sd))
+
+        def make_clone_builder() -> Callable[[], Callable]:
+            clone = self._clone_owner()
+            return lambda: clone_factory(clone)
+
+        return _PersistSpec(self._key_desc(key), avals, dummies, make_clone_builder)
+
+    # ------------------------------------------------------------------ warmup
+    def _warmup_bucketable(self) -> bool:
+        return self.bucketable()
+
+    def _warmup_one(self, kind: str, args: tuple, kwargs: dict) -> str:
+        m = self._metric
+        if not self.usable():
+            return f"{kind}: executor unusable ({self.disabled_reason or self._static_reason()})"
+        prep = self._prepare(args, kwargs)
+        if prep is None:
+            return f"{kind}: inputs not executor-eligible"
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        zero_state = {k: jnp.zeros(np.shape(v), jnp.asarray(v).dtype) for k, v in m._defaults.items()}
+        if kind == "update":
+            key = ("u", treedef, sig, batched, bucket if padded else None)
+
+            def build(metric=None):
+                return self._build_update(treedef, batched, bucket, padded, bool_spec, n_leaves, metric=metric)
+
+            persist = self._persist_spec(key, zero_state, call_leaves, padded, n, count=False, clone_factory=build)
+        elif kind == "forward":
+            if not self._plain_forward or m.dist_sync_on_step:
+                return "forward: not fusable (custom forward or dist_sync_on_step)"
+            variant = "reduce" if m.full_state_update is False else "full"
+            key = ("f", variant, treedef, sig, batched, bucket if padded else None)
+
+            def build(metric=None):
+                return self._build_forward(treedef, batched, bucket, padded, variant, bool_spec, n_leaves, metric=metric)
+
+            persist = self._persist_spec(key, zero_state, call_leaves, padded, n, count=True, clone_factory=build)
+        else:
+            return f"{kind}: unknown warmup kind"
+        if persist is None:
+            return f"{kind}: inputs not persistable (python-scalar leaves)"
+        return self._dispatch_warmup(key, build, persist)
+
+    # --------------------------------------------------------------- builders
+    def _build_update(self, treedef, batched, bucket, padded, bool_spec, n_leaves, metric=None):
+        # ``metric`` overrides the traced instance: background jobs pass a
+        # detached clone so tracing never swaps the live metric's state
+        m = metric if metric is not None else self._metric
+        defaults = {k: jnp.asarray(v) for k, v in m._defaults.items()}
 
         if not padded:
             def raw(state, *dyn):
@@ -527,9 +1162,9 @@ class MetricExecutor(_ExecutorBase):
 
         return raw
 
-    def _build_forward(self, treedef, batched, bucket, padded, variant, bool_spec, n_leaves):
-        m = self._metric
-        defaults = self._consts()
+    def _build_forward(self, treedef, batched, bucket, padded, variant, bool_spec, n_leaves, metric=None):
+        m = metric if metric is not None else self._metric
+        defaults = {k: jnp.asarray(v) for k, v in m._defaults.items()}
         one = jnp.asarray(1, jnp.int32)
 
         def batch_state(leaves):
@@ -612,6 +1247,11 @@ class MetricExecutor(_ExecutorBase):
             return self._run_update(args, kwargs)
         except _DispatchFailure as df:
             raise df.original
+        except _DiskEntryFailure as df:
+            # a persisted executable died at dispatch (inputs were copies):
+            # evict it and retry through a fresh inline compile
+            self._evict_disk_entry(df)
+            return self.run_update(args, kwargs)
         except DispatchStallError:
             raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:  # sticky: a metric that cannot trace stays eager
@@ -627,11 +1267,21 @@ class MetricExecutor(_ExecutorBase):
         m = self._metric
 
         key = ("u", treedef, sig, batched, bucket if padded else None)
-        fn, fresh = self._get_fn(
-            key, lambda: self._build_update(treedef, batched, bucket, padded, bool_spec, n_leaves)
-        )
-
+        self._record_profile(key, "update", args, kwargs)
         state = {k: m._state[k] for k in m._defaults}
+
+        def build(metric=None):
+            return self._build_update(treedef, batched, bucket, padded, bool_spec, n_leaves, metric=metric)
+
+        fn, fresh = self._get_fn(
+            key,
+            build,
+            lambda: self._persist_spec(key, state, call_leaves, padded, n, count=False, clone_factory=build),
+        )
+        if fn is None:  # compile in flight on the worker: serve this step eagerly
+            self.stats["eager_misses"] += 1
+            return False
+
         need_copy = fresh or m._state_escaped or m._state_shared
         state_in = _tree_copy(state) if need_copy else state
         # donation in play -> keep a host-side recovery reference (ISSUE 2)
@@ -648,6 +1298,7 @@ class MetricExecutor(_ExecutorBase):
         # profiler span naming the metric so wall time attributes to it
         # (ISSUE 3 observability; the traced body carries matching
         # jax.named_scope annotations via functional_update)
+        t_cold = time.perf_counter() if fresh else None
         with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
             new_state = self._guarded_dispatch(
                 lambda: call_fn(state_in),
@@ -655,6 +1306,8 @@ class MetricExecutor(_ExecutorBase):
                 fresh,
                 lambda: self._restore(m, recovery) if not need_copy else None,
             )
+        if t_cold is not None:
+            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -695,6 +1348,9 @@ class MetricExecutor(_ExecutorBase):
             return self._run_forward(args, kwargs)
         except _DispatchFailure as df:
             raise df.original
+        except _DiskEntryFailure as df:
+            self._evict_disk_entry(df)
+            return self.run_forward(args, kwargs)
         except DispatchStallError:
             raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
@@ -721,12 +1377,21 @@ class MetricExecutor(_ExecutorBase):
         variant = "reduce" if m.full_state_update is False else "full"
 
         key = ("f", variant, treedef, sig, batched, bucket if padded else None)
+        self._record_profile(key, "forward", args, kwargs)
+        state = {k: m._state[k] for k in m._defaults}
+
+        def build(metric=None):
+            return self._build_forward(treedef, batched, bucket, padded, variant, bool_spec, n_leaves, metric=metric)
+
         fn, fresh = self._get_fn(
             key,
-            lambda: self._build_forward(treedef, batched, bucket, padded, variant, bool_spec, n_leaves),
+            build,
+            lambda: self._persist_spec(key, state, call_leaves, padded, n, count=True, clone_factory=build),
         )
+        if fn is None:  # compile in flight on the worker: serve this step eagerly
+            self.stats["eager_misses"] += 1
+            return False, None
 
-        state = {k: m._state[k] for k in m._defaults}
         count = int(m._update_count)
         need_copy = fresh or m._state_escaped or m._state_shared
         state_in = _tree_copy(state) if need_copy else state
@@ -742,6 +1407,7 @@ class MetricExecutor(_ExecutorBase):
                 return fn(state_arg, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
             return fn(state_arg, count_arr, *call_leaves)
 
+        t_cold = time.perf_counter() if fresh else None
         with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
             new_state, value = self._guarded_dispatch(
                 lambda: call_fn(state_in),
@@ -749,6 +1415,8 @@ class MetricExecutor(_ExecutorBase):
                 fresh,
                 lambda: self._restore(m, recovery) if not need_copy else None,
             )
+        if t_cold is not None:
+            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -835,9 +1503,171 @@ class CollectionExecutor(_ExecutorBase):
     def _kwarg_names(self, m, kwargs) -> Tuple[str, ...]:
         return tuple(sorted(m._filter_kwargs(**kwargs)))
 
-    # --------------------------------------------------------------- builders
-    def _build_update(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves):
+    def _forward_unfusable_reason(self, leader_execs) -> Optional[str]:
+        """Why the fused collection forward cannot engage, or None when every
+        group qualifies (reduce-merge forward: all members
+        ``full_state_update=False``, no per-step sync, traceable computes)."""
+        from torchmetrics_tpu.metric import Metric  # deferred: avoids import cycle
+
         coll = self._coll
+        for _name, _m0, cg, ex in leader_execs:
+            if not ex._plain_forward:
+                return "a group leader overrides functional_forward/merge_states"
+            for member in cg:
+                mm = coll._modules[member]
+                if mm.full_state_update is not False or mm.dist_sync_on_step:
+                    return f"member {member!r} needs full_state_update or per-step sync"
+                # every member's compute traces inside the fused call
+                if type(mm).functional_compute is not Metric.functional_compute:
+                    return f"member {member!r} overrides functional_compute"
+        return None
+
+    # ----------------------------------------------------- compile-ahead keys
+    def _owner_desc(self) -> str:
+        """Cross-process identity of the fused computation: every member's
+        class + module source hash, grouped per leader, plus each leader's
+        registered state spec."""
+        import sys
+
+        coll = self._coll
+        parts = []
+        for name, m, cg in self._leaders():
+            members = ",".join(
+                f"{mn}={type(coll._modules[mn]).__qualname__}"
+                f"@{compile_cache.source_hash(sys.modules.get(type(coll._modules[mn]).__module__) or type(coll._modules[mn]))}"
+                for mn in cg
+            )
+            fields = ",".join(
+                f"{k}:{jnp.asarray(v).dtype}:{tuple(np.shape(v))}:{m._reductions.get(k)}"
+                for k, v in m._defaults.items()
+            )
+            parts.append(f"{name}:[{members}]|{fields}")
+        return "Collection{" + ";".join(parts) + "}"
+
+    def _key_desc(self, key: Any) -> str:
+        return "|".join(
+            (
+                compile_cache.toolchain_fingerprint(),
+                compile_cache.backend_fingerprint(),
+                self._owner_desc(),
+                _stable_key_repr(key),
+                "donate=0",
+            )
+        )
+
+    def _clone_owner(self):
+        """A fully-detached deep copy of the collection (every member's
+        ``__getstate__`` rebuilds its wrapped methods around the copy), with
+        all executors disabled, for off-main-thread tracing."""
+        import copy
+
+        clone = copy.deepcopy(self._coll)
+        clone._executor_enabled = False
+        for mm in clone._modules.values():
+            mm.__dict__["_executor_enabled"] = False
+        return clone
+
+    def _persist_spec(
+        self,
+        key: Any,
+        leader_execs,
+        call_leaves: Sequence[Any],
+        padded: bool,
+        n: Optional[int],
+        counts: bool,
+        clone_factory: Callable[[Any], Callable],
+    ) -> Optional[_PersistSpec]:
+        """Collection variant: the donated arg is a dict of per-leader state
+        pytrees; fused forward threads a per-leader count dict before the
+        batch leaves (matching ``call_fn``'s argument order)."""
+        if not all(_is_concrete_array(l) for l in call_leaves):
+            return None
+        states_sd = {
+            name: {k: (tuple(np.shape(v)), jnp.asarray(v).dtype) for k, v in m._defaults.items()}
+            for name, m, _, _ in leader_execs
+        }
+        leaf_sd = [(tuple(np.shape(l)), l.dtype) for l in call_leaves]
+        leader_names = tuple(states_sd)
+        n_val = int(n) if padded else None
+        i32 = jnp.int32
+
+        states_avals = {
+            name: {k: jax.ShapeDtypeStruct(s, d) for k, (s, d) in sub.items()} for name, sub in states_sd.items()
+        }
+        avals: Tuple[Any, ...] = (states_avals,)
+        if counts:
+            avals += ({name: jax.ShapeDtypeStruct((), i32) for name in leader_names},)
+        if padded:
+            avals += (jax.ShapeDtypeStruct((), i32),)
+        avals += tuple(jax.ShapeDtypeStruct(s, d) for s, d in leaf_sd)
+
+        def dummies() -> Tuple[Any, ...]:
+            st = {name: {k: jnp.zeros(s, d) for k, (s, d) in sub.items()} for name, sub in states_sd.items()}
+            out: Tuple[Any, ...] = (st,)
+            if counts:
+                out += ({name: jnp.asarray(0, i32) for name in leader_names},)
+            if n_val is not None:
+                out += (jnp.asarray(n_val, i32),)
+            return out + tuple(_zeros_like_spec(leaf_sd))
+
+        def make_clone_builder() -> Callable[[], Callable]:
+            clone = self._clone_owner()
+            return lambda: clone_factory(clone)
+
+        return _PersistSpec(self._key_desc(key), avals, dummies, make_clone_builder)
+
+    # ------------------------------------------------------------------ warmup
+    def _warmup_bucketable(self) -> bool:
+        leader_execs = self._leader_executors()
+        return leader_execs is not None and self.bucketable(leader_execs)
+
+    def _warmup_one(self, kind: str, args: tuple, kwargs: dict) -> str:
+        if self.disabled_reason is not None:
+            return f"{kind}: executor disabled ({self.disabled_reason})"
+        leader_execs = self._leader_executors()
+        if leader_execs is None:
+            return f"{kind}: a compute-group leader is not executor-eligible"
+        prep = self._prepare(args, kwargs, leader_execs)
+        if prep is None:
+            return f"{kind}: inputs not executor-eligible"
+        treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
+        kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
+        if kind == "update":
+            key = ("u", treedef, sig, batched, bucket if padded else None, kw_map)
+
+            def builder(coll=None):
+                specs = [
+                    (name, dict(kw_map)[name], {k: jnp.asarray(v) for k, v in m._defaults.items()})
+                    for name, m, _ in self._leaders()
+                ]
+                return self._build_update(treedef, batched, bucket, padded, specs, bool_spec, n_leaves, coll=coll)
+
+            persist = self._persist_spec(key, leader_execs, call_leaves, padded, n, counts=False, clone_factory=builder)
+        elif kind == "forward":
+            reason = self._forward_unfusable_reason(leader_execs)
+            if reason is not None:
+                return f"forward: {reason}"
+            key = ("f", treedef, sig, batched, bucket if padded else None, kw_map)
+
+            def builder(coll=None):
+                specs = [
+                    (name, tuple(cg), dict(kw_map)[name], {k: jnp.asarray(v) for k, v in m._defaults.items()})
+                    for name, m, cg in self._leaders()
+                ]
+                return self._build_forward(treedef, batched, bucket, padded, specs, bool_spec, n_leaves, coll=coll)
+
+            persist = self._persist_spec(key, leader_execs, call_leaves, padded, n, counts=True, clone_factory=builder)
+        else:
+            return f"{kind}: unknown warmup kind"
+        if persist is None:
+            return f"{kind}: inputs not persistable (python-scalar leaves)"
+        return self._dispatch_warmup(key, builder, persist)
+
+    # --------------------------------------------------------------- builders
+    def _build_update(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves, coll=None):
+        # ``coll`` overrides the traced instance: background jobs pass a
+        # detached clone so tracing never swaps live member state
+        coll = coll if coll is not None else self._coll
 
         def raw(states, *rest):
             if padded:
@@ -862,8 +1692,8 @@ class CollectionExecutor(_ExecutorBase):
 
         return raw
 
-    def _build_forward(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves):
-        coll = self._coll
+    def _build_forward(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves, coll=None):
+        coll = coll if coll is not None else self._coll
         one = jnp.asarray(1, jnp.int32)
 
         def raw(states, counts, *rest):
@@ -946,6 +1776,9 @@ class CollectionExecutor(_ExecutorBase):
             return self._run_update(args, kwargs, leader_execs)
         except _DispatchFailure as df:
             raise df.original
+        except _DiskEntryFailure as df:
+            self._evict_disk_entry(df)
+            return self.run_update(args, kwargs)
         except DispatchStallError:
             raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
@@ -962,15 +1795,23 @@ class CollectionExecutor(_ExecutorBase):
 
         kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
         key = ("u", treedef, sig, batched, bucket if padded else None, kw_map)
+        self._record_profile(key, "update", args, kwargs)
 
-        def builder():
+        def builder(coll=None):
             specs = [
                 (name, dict(kw_map)[name], {k: jnp.asarray(v) for k, v in m._defaults.items()})
                 for name, m, _ in self._leaders()
             ]
-            return self._build_update(treedef, batched, bucket, padded, specs, bool_spec, n_leaves)
+            return self._build_update(treedef, batched, bucket, padded, specs, bool_spec, n_leaves, coll=coll)
 
-        fn, fresh = self._get_fn(key, builder)
+        fn, fresh = self._get_fn(
+            key,
+            builder,
+            lambda: self._persist_spec(key, leader_execs, call_leaves, padded, n, counts=False, clone_factory=builder),
+        )
+        if fn is None:  # compile in flight on the worker: serve this step eagerly
+            self.stats["eager_misses"] += 1
+            return False
 
         states, copied = {}, False
         donated = []  # groups whose live buffers go into the donated call
@@ -1002,6 +1843,7 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
+        t_cold = time.perf_counter() if fresh else None
         with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
             new_states = self._guarded_dispatch(
                 lambda: call_fn(states),
@@ -1009,6 +1851,8 @@ class CollectionExecutor(_ExecutorBase):
                 fresh,
                 lambda: self._restore_groups(donated),
             )
+        if t_cold is not None:
+            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -1045,23 +1889,15 @@ class CollectionExecutor(_ExecutorBase):
         leader_execs = self._leader_executors()
         if leader_execs is None:
             return None
-        from torchmetrics_tpu.metric import Metric  # deferred: avoids import cycle
-
-        coll = self._coll
-        for name, m0, cg, ex in leader_execs:
-            if not ex._plain_forward:
-                return None
-            for member in cg:
-                mm = coll._modules[member]
-                if mm.full_state_update is not False or mm.dist_sync_on_step:
-                    return None
-                # every member's compute traces inside the fused call
-                if type(mm).functional_compute is not Metric.functional_compute:
-                    return None
+        if self._forward_unfusable_reason(leader_execs) is not None:
+            return None
         try:
             return self._run_forward(args, kwargs, leader_execs)
         except _DispatchFailure as df:
             raise df.original
+        except _DiskEntryFailure as df:
+            self._evict_disk_entry(df)
+            return self.run_forward(args, kwargs)
         except DispatchStallError:
             raise  # a stalled compile/dispatch must surface, never silently disable
         except Exception as err:
@@ -1078,8 +1914,9 @@ class CollectionExecutor(_ExecutorBase):
 
         kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
         key = ("f", treedef, sig, batched, bucket if padded else None, kw_map)
+        self._record_profile(key, "forward", args, kwargs)
 
-        def builder():
+        def builder(coll=None):
             specs = [
                 (
                     name,
@@ -1089,9 +1926,16 @@ class CollectionExecutor(_ExecutorBase):
                 )
                 for name, m, cg in self._leaders()
             ]
-            return self._build_forward(treedef, batched, bucket, padded, specs, bool_spec, n_leaves)
+            return self._build_forward(treedef, batched, bucket, padded, specs, bool_spec, n_leaves, coll=coll)
 
-        fn, fresh = self._get_fn(key, builder)
+        fn, fresh = self._get_fn(
+            key,
+            builder,
+            lambda: self._persist_spec(key, leader_execs, call_leaves, padded, n, counts=True, clone_factory=builder),
+        )
+        if fn is None:  # compile in flight on the worker: serve this step eagerly
+            self.stats["eager_misses"] += 1
+            return None
 
         states, copied = {}, False
         donated = []  # groups whose live buffers go into the donated call
@@ -1130,6 +1974,7 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
+        t_cold = time.perf_counter() if fresh else None
         with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
             new_states, values = self._guarded_dispatch(
                 lambda: call_fn(states),
@@ -1137,6 +1982,8 @@ class CollectionExecutor(_ExecutorBase):
                 fresh,
                 lambda: self._restore_groups(donated),
             )
+        if t_cold is not None:
+            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -1468,5 +2315,8 @@ def executor_stats(obj: Any) -> Dict[str, Any]:
         out["fallback_reason"] = None
         out["bucketing_enabled"] = True
         out["cached_executables"] = 0
+        out["background_enabled"] = compile_cache.background_compile_default()
+        out["pending_background"] = 0
+        out["profile_entries"] = 0
         return out
     return ex.stats_dict()

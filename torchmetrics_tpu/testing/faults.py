@@ -22,6 +22,10 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
 - :func:`torn_write` — truncate/zero/bit-flip a snapshot FILE the way a
   crash mid-write presents (drives ``restore_state``'s torn-write detection
   and rotating fallback, io/checkpoint.py).
+- :func:`corrupt_cache_entry` / :func:`stale_cache_version` — damage or
+  version-stale a persisted-executable cache entry (ops/compile_cache.py):
+  a poisoned disk cache must degrade to a fresh compile with a warning,
+  never crash or change a result.
 - :func:`preempt_after` — raise a simulated preemption after the n-th
   COMMITTED update (drives autosave + kill/restore chaos tests).
 
@@ -157,8 +161,10 @@ def fail_dispatch(
     error = exc if exc is not None else FaultInjected("injected dispatch failure")
     remaining = {"n": fail_n}
 
-    def patched(self: Any, key: Any, builder: Any):
-        fn, fresh = orig(self, key, builder)
+    def patched(self: Any, key: Any, builder: Any, *get_args: Any, **get_kwargs: Any):
+        fn, fresh = orig(self, key, builder, *get_args, **get_kwargs)
+        if fn is None:  # background-compile miss: nothing dispatched to fail
+            return fn, fresh
 
         def failing(*args: Any, **kwargs: Any) -> Any:
             if remaining["n"] is not None and remaining["n"] <= 0:
@@ -337,6 +343,75 @@ def torn_write(path: Any, mode: str = "truncate", frac: float = 0.5, seed: int =
     # the real name, as the failure mode would
     with open(path, "wb") as fh:
         fh.write(damaged)
+
+
+def _cache_entry_paths(cache_dir: Optional[str]) -> list:
+    """Persisted-executable entries under ``cache_dir`` (default: the
+    resolved ``TORCHMETRICS_TPU_CACHE_DIR``), newest first."""
+    import os
+
+    from torchmetrics_tpu.ops import compile_cache
+
+    directory = cache_dir if cache_dir is not None else compile_cache.cache_dir()
+    if directory is None:
+        raise ValueError("no cache directory resolved (compile-ahead disabled?)")
+    store = os.path.join(directory, "executables")
+    try:
+        names = [n for n in os.listdir(store) if n.endswith(compile_cache.ENTRY_SUFFIX)]
+    except FileNotFoundError:
+        raise ValueError(f"no executable store at {store}") from None
+    paths = [os.path.join(store, n) for n in names]
+    return sorted(paths, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
+def corrupt_cache_entry(
+    cache_dir: Optional[str] = None, mode: str = "flip", which: str = "newest", frac: float = 0.5, seed: int = 0
+) -> list:
+    """Damage persisted-executable cache entries in place (ops/compile_cache.py).
+
+    ``mode`` is :func:`torn_write`'s (``truncate``/``zero``/``flip``) plus
+    ``"garbage"`` — replace the whole file with bytes that are not even a
+    valid container. ``which`` picks victims: ``"newest"``, ``"oldest"`` or
+    ``"all"``. Returns the damaged paths. The next executor miss touching a
+    damaged entry must WARN, delete it, and recompile fresh — identical
+    results, no crash (the chaos contract of docs/EXECUTOR.md).
+    """
+    paths = _cache_entry_paths(cache_dir)
+    victims = paths if which == "all" else [paths[0] if which == "newest" else paths[-1]]
+    for path in victims:
+        if mode == "garbage":
+            with open(path, "wb") as fh:
+                fh.write(b"\x00garbage-not-a-cache-entry" * 16)
+        else:
+            torn_write(path, mode=mode, frac=frac, seed=seed)
+    return victims
+
+
+def stale_cache_version(cache_dir: Optional[str] = None, which: str = "newest") -> list:
+    """Rewrite entry headers with a STALE toolchain fingerprint, exactly as a
+    binary from an older jax/library version would have left them (the
+    payload stays intact and checksummed — only the version line lies).
+    ``load_executable_blob`` must refuse such an entry (skip + warn + delete),
+    never deserialize an executable built by different code. Returns paths.
+    """
+    import json
+
+    from torchmetrics_tpu.ops.compile_cache import ENTRY_MAGIC
+
+    paths = _cache_entry_paths(cache_dir)
+    victims = paths if which == "all" else [paths[0] if which == "newest" else paths[-1]]
+    for path in victims:
+        with open(path, "rb") as fh:
+            data = fh.read()
+        hlen = int.from_bytes(data[len(ENTRY_MAGIC):len(ENTRY_MAGIC) + 8], "little")
+        h_start = len(ENTRY_MAGIC) + 8
+        header = json.loads(data[h_start:h_start + hlen].decode())
+        header["toolchain"] = "tm_tpu=0.0.0|jax=0.0.0|jaxlib=0.0.0|executor=stale|compile_cache=stale"
+        new_header = json.dumps(header, sort_keys=True).encode()
+        # deliberately NON-atomic (plain rewrite): simulating a foreign writer
+        with open(path, "wb") as fh:
+            fh.write(ENTRY_MAGIC + len(new_header).to_bytes(8, "little") + new_header + data[h_start + hlen:])
+    return victims
 
 
 @contextmanager
