@@ -624,6 +624,44 @@ def bench_config2():
         per_epoch_step + per_reduce / EPOCH_STEPS
     )
 
+    # telemetry-overhead row (ISSUE 6 acceptance: spans+counters fully ON
+    # must cost <1% steps/s on this config). Both loops re-measure the
+    # deferred path with telemetry forced off, then fully on (span ring
+    # recording included): the epoch-scan loop is the value_deferred headline
+    # shape (one dispatch span per 30-step chunk), the per-dispatch loop is
+    # the worst case (one span per step). Flags restore to env defaults after.
+    from torchmetrics_tpu import obs as _obs
+
+    def _epoch_loop():
+        st = deferred.init_states()
+        t0 = time.perf_counter()
+        st = deferred.local_epoch(st, logits_e, target_e)
+        jax.block_until_ready(st)
+        return (time.perf_counter() - t0) / EPOCH_STEPS
+
+    def _dispatch_loop():
+        st = deferred.local_step(deferred.init_states(), logits, target)
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        for _ in range(EPOCH_STEPS):
+            st = deferred.local_step(st, logits, target)
+        jax.block_until_ready(st)
+        return (time.perf_counter() - t0) / EPOCH_STEPS
+
+    try:
+        _obs.set_telemetry(False)  # also forces tracing off
+        per_epoch_off = _stable_min(_epoch_loop, repeats=3)
+        per_dispatch_off = _stable_min(_dispatch_loop, repeats=3)
+        _obs.set_telemetry(True)
+        _obs.set_tracing(True)  # fully enabled: counters AND span ring
+        per_epoch_on = _stable_min(_epoch_loop, repeats=3)
+        per_dispatch_on = _stable_min(_dispatch_loop, repeats=3)
+    finally:
+        _obs.set_tracing(None)
+        _obs.set_telemetry(None)
+    telemetry_overhead_pct = 100.0 * (per_epoch_on - per_epoch_off) / per_epoch_off
+    telemetry_overhead_dispatch_pct = 100.0 * (per_dispatch_on - per_dispatch_off) / per_dispatch_off
+
     # same-work row: BOTH sides single-device, unsynced, update+compute — the
     # headline row above carries sync work the reference baseline cannot do
     # single-host, so this row is the symmetric comparison (VERDICT r4 weak #7)
@@ -705,6 +743,14 @@ def bench_config2():
         "autosave_copy_us": round(per_copy * 1e6, 1),
         "autosave_sync_us": round(per_save * 1e6, 1),
         "autosave_overhead_pct": round(autosave_overhead_pct, 2),
+        # telemetry-overhead rows (ISSUE 6 acceptance: < 1 on the headline
+        # epoch shape): spans+counters fully on vs fully off, release over
+        # release. The per-dispatch row is the worst case (one host span per
+        # step); negative values are measurement noise around zero.
+        "telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
+        "telemetry_overhead_dispatch_pct": round(telemetry_overhead_dispatch_pct, 2),
+        "telemetry_off_us_per_step": round(per_epoch_off * 1e6, 1),
+        "telemetry_on_us_per_step": round(per_epoch_on * 1e6, 1),
     }
 
 
@@ -1446,6 +1492,28 @@ def _ensure_backend() -> str:
     return backend
 
 
+def _telemetry_probe():
+    """Process-global counters right now (obs/registry.py), or None when the
+    package/telemetry is unavailable — the bench must run regardless."""
+    try:
+        from torchmetrics_tpu import obs
+
+        snap = obs.telemetry_snapshot()
+        return snap["counters"] if snap.get("telemetry_enabled") else None
+    except Exception:
+        return None
+
+
+def _telemetry_delta(before, after):
+    """Counter movement during one config (ISSUE 6 satellite: a bench round
+    records WHAT the runtime did — compiles, disk hits, eager misses — next
+    to how fast it went, so a slow round is attributable from the JSON)."""
+    if before is None or after is None:
+        return None
+    delta = {k: round(v - before.get(k, 0), 3) for k, v in after.items() if v != before.get(k, 0)}
+    return delta or {}
+
+
 def _run_config(fn):
     """Run one config with the symmetric stall-retry policy.
 
@@ -1454,6 +1522,7 @@ def _run_config(fn):
     or it errored — never because the ratio looked bad — and the retry's result
     REPLACES the first (same statistic, not best-of-two)."""
     del _TIMING_UNSTABLE[:]
+    t_before = _telemetry_probe()
     try:
         result = fn()
         # in-process stall flag, or the subbench's own flag across the boundary
@@ -1474,6 +1543,12 @@ def _run_config(fn):
                 # keep the valid first measurement rather than replacing it
                 # with the retry's error; flag why it was not re-measured
                 result = {**result, "timing_unstable": True, "retry_errored": f"{type(e).__name__}: {e}"}
+    # subprocess-backed configs attach their own child-side snapshot; do not
+    # overwrite it with the parent's (empty) counter movement
+    if "telemetry" not in result:
+        delta = _telemetry_delta(t_before, _telemetry_probe())
+        if delta is not None:
+            result["telemetry"] = delta
     return result
 
 
@@ -1567,6 +1642,11 @@ if __name__ == "__main__":
         out = fn()
         if _TIMING_UNSTABLE:  # surface the stall signal across the process boundary
             out["timing_unstable"] = True
+        if "telemetry" not in out:
+            # child-side counters (the whole child's movement — it started at 0)
+            counters = _telemetry_probe()
+            if counters is not None:
+                out["telemetry"] = {k: round(v, 3) for k, v in counters.items() if v}
         print(json.dumps(out))
     else:
         main()

@@ -247,5 +247,7 @@ class TestProfilerScopes:
                     raw = gzip.decompress(raw)
                 blobs.append(raw)
         joined = b"".join(blobs)
-        assert b"MulticlassAccuracy.update" in joined
-        assert b"MulticlassAccuracy.compute" in joined
+        # canonical obs span names (docs/OBSERVABILITY.md): host TraceAnnotation
+        # and device named_scope share the tm_tpu.* constants since ISSUE 6
+        assert b"tm_tpu.update/MulticlassAccuracy" in joined
+        assert b"tm_tpu.compute/MulticlassAccuracy" in joined

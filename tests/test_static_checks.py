@@ -2,11 +2,16 @@
 torchmetrics_tpu/ (ISSUE 2, tools/lint_exceptions.py), no per-step
 collectives inside update-stage functional code (ISSUE 3,
 tools/lint_collectives.py — reductions belong to parallel/sync.py, applied
-per the declared ``dist_reduce_fx`` at the sync/read point), and no
+per the declared ``dist_reduce_fx`` at the sync/read point), no
 non-atomic binary writes of state payloads outside io/checkpoint.py
 (ISSUE 4, tools/lint_atomic_io.py — the torn-write window the atomic
-snapshot store exists to close)."""
+snapshot store exists to close), no blocking host synchronisation in the
+dispatch hot paths (ISSUE 6, tools/lint_blocking_host_sync.py — guards the
+async-read ROADMAP item ahead of time), and the bench regression gate
+(ISSUE 6, tools/check_bench_regression.py — a config drifting below 0.9×
+baseline fails the suite unless BASELINE.json records a reviewed floor)."""
 import importlib.util
+import json
 import sys
 from pathlib import Path
 
@@ -123,6 +128,84 @@ def test_compile_cache_writes_route_through_atomic_helper():
     assert not found, [f"{v.path}:{v.line}: {v.snippet}" for v in found]
     source = target.read_text()
     assert "atomic_write_bytes" in source
+
+
+def test_no_blocking_host_sync_in_hot_paths():
+    """Dispatch-path modules must stay async: a stray block_until_ready /
+    np.asarray / .item() silently serialises the pipeline (the async-read
+    ROADMAP item depends on this invariant; deliberate syncs are allowlisted
+    with reasons — probe oracles, recovery snapshots, checkpoint host-copy)."""
+    linter = _load_tool("lint_blocking_host_sync")
+    violations, stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    msg = "\n".join(f"{v.path}:{v.line} in {v.func}: {v.snippet}" for v in violations)
+    assert not violations, f"blocking host sync in hot paths (use obs.observe_ready):\n{msg}"
+    assert not stale, f"stale lint allowlist entries (calls gone — remove them): {stale}"
+
+
+def test_blocking_sync_linter_catches_violations(tmp_path):
+    """The linter actually fires on all three forbidden forms."""
+    linter = _load_tool("lint_blocking_host_sync")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "def _hot(state, x):\n"
+        "    jax.block_until_ready(state)\n"
+        "    host = np.asarray(x)\n"
+        "    return host.sum().item()\n"
+        "def _fine(x):\n"
+        "    import jax.numpy as jnp\n"
+        "    return jnp.asarray(x)  # stays on device: allowed\n"
+    )
+    found = linter.lint_file(bad, "bad.py")
+    assert len(found) == 3 and all(v.func == "_hot" for v in found)
+
+
+def test_bench_regression_gate_latest_round():
+    """The latest committed BENCH_r*.json passes the 0.9 gate against the
+    current BASELINE.json (known drifts carry reviewed accepted_regressions
+    floors — config 3's 0.885× is visible there, not silent)."""
+    checker = _load_tool("check_bench_regression")
+    bench_path = checker.latest_bench_path(REPO)
+    assert bench_path is not None, "no BENCH_r*.json committed"
+    bench = json.loads(bench_path.read_text())
+    baseline = json.loads((REPO / "BASELINE.json").read_text())
+    violations, _notes = checker.check_bench(bench, baseline)
+    msg = "\n".join(f"{v.config}: {v.detail}" for v in violations)
+    assert not violations, f"bench regression gate failed on {bench_path.name}:\n{msg}"
+
+
+def test_bench_regression_gate_fires_on_synthetic():
+    """A synthetic vs_baseline=0.85 config without an accepted floor must
+    fail; the same config passes once BASELINE.json records a reviewed floor,
+    and fails AGAIN when the drift worsens past that floor."""
+    checker = _load_tool("check_bench_regression")
+    bench = {"configs": {"x_conf": {"value": 85.0, "vs_baseline": 0.85}}}
+    violations, notes = checker.check_bench(bench, {})
+    assert len(violations) == 1 and violations[0].config == "x_conf"
+
+    accepted = {"accepted_regressions": {"x_conf": {"floor": 0.8, "reason": "reviewed"}}}
+    violations, notes = checker.check_bench(bench, accepted)
+    assert not violations and len(notes) == 1
+
+    worse = {"configs": {"x_conf": {"value": 70.0, "vs_baseline": 0.70}}}
+    violations, _ = checker.check_bench(worse, accepted)
+    assert len(violations) == 1 and "worsened" in violations[0].detail
+
+
+def test_bench_regression_gate_recomputes_from_baseline_bump():
+    """Bumping bench_baselines genuinely moves the gate: the recorded
+    vs_baseline may say 0.85, but a re-anchored baseline value that puts
+    value/baseline above the threshold passes without an accepted floor."""
+    checker = _load_tool("check_bench_regression")
+    bench = {"configs": {"x_conf": {"value": 95.0, "vs_baseline": 0.85}}}
+    bumped = {"bench_baselines": {"x_conf": {"value": 100.0}}}
+    violations, _ = checker.check_bench(bench, bumped)
+    assert not violations  # 95/100 = 0.95 >= 0.9
+
+    errored = {"configs": {"x_conf": {"error": "ValueError: boom"}}}
+    violations, _ = checker.check_bench(errored, bumped)
+    assert len(violations) == 1 and "errored" in violations[0].detail
 
 
 def test_collectives_linter_catches_violations(tmp_path):

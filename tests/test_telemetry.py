@@ -1,0 +1,457 @@
+"""Unified runtime telemetry suite (ISSUE 6).
+
+Covers the obs/ package end to end: snapshot counters agreeing with
+``executor_status`` across the eager / fused-collection / deferred /
+background-compile paths, ring-buffer wrap semantics (newest events always
+survive), Chrome-trace export round-tripping as valid trace-event JSON (the
+Perfetto acceptance), span nesting under concurrent background compile +
+autosave, zero-cost-when-off, the duration-key standardization
+(``compile_us_total`` + deprecated ``compile_ms_total`` alias), the
+Prometheus exposition format, breadcrumb routing from the fault paths, and
+the non-blocking ``observe_ready`` device-timing seam.
+
+Runs on the 8-fake-device CPU mesh from conftest.py.
+"""
+import json
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu import MetricCollection, obs  # noqa: E402
+from torchmetrics_tpu.classification import (  # noqa: E402
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+)
+from torchmetrics_tpu.ops import compile_cache  # noqa: E402
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+
+NUM_DEVICES = 8
+NUM_CLASSES = 5
+BATCH = 64
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Fresh telemetry state per test: tracing ON, registry/ring zeroed;
+    env-default flags restored afterwards."""
+    obs.set_telemetry(True)
+    obs.set_tracing(True)
+    obs.reset()
+    obs.reset_ring()
+    yield
+    obs.reset()
+    obs.reset_ring()
+    obs.set_tracing(None)
+    obs.set_telemetry(None)
+
+
+def _batch(n=BATCH, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, n)),
+    )
+
+
+def _span_names():
+    return [e.name for e in obs.peek_events()]
+
+
+# ---------------------------------------------------------------------------
+# counters agree with executor_status across execution paths
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotAgreesWithExecutorStatus:
+    def test_eager_executor_path(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        for seed in range(3):
+            m.update(*_batch(seed=seed))
+        stats = m.executor_status["stats"]
+        per_metric = obs.telemetry_snapshot(m)["counters"]
+        assert per_metric["executor.calls"] == stats["calls"] == 3
+        assert per_metric["executor.compiles"] == stats["compiles"]
+        assert per_metric["executor.cache_hits"] == stats["cache_hits"]
+        # the process-global aggregate covers this executor too
+        global_counters = obs.telemetry_snapshot()["counters"]
+        assert global_counters["executor.calls"] >= stats["calls"]
+
+    def test_fused_collection_path(self):
+        coll = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+                "confmat": MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+            }
+        )
+        for seed in range(2):
+            coll.update(*_batch(seed=seed))
+        # the first update resolves compute groups eagerly; the fused
+        # executor engages from the second call on
+        stats = coll.executor_status["stats"]
+        per_coll = obs.telemetry_snapshot(coll)["counters"]
+        assert stats["calls"] >= 1
+        assert per_coll["executor.calls"] == stats["calls"]
+        assert obs.telemetry_snapshot()["counters"]["executor.calls"] >= stats["calls"]
+        assert any(n.startswith("tm_tpu.dispatch/MetricCollection") for n in _span_names())
+
+    def test_deferred_path_emits_reduce_span(self):
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("batch",))
+        coll = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)}
+        )
+        coll.resolve_compute_groups(*_batch())
+        step = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        logits, target = _batch()
+        logits = jax.device_put(logits, NamedSharding(mesh, P("batch")))
+        target = jax.device_put(target, NamedSharding(mesh, P("batch")))
+        st = step.local_step(step.init_states(), logits, target)
+        step.reduce(st)
+        names = _span_names()
+        assert any(n.startswith("tm_tpu.dispatch/") for n in names)
+        assert obs.SPAN_REDUCE in names
+
+    def test_background_compile_path(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path))
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.set_background_compile(True)
+        m.update(*_batch())  # cold key: served eagerly, compile on the worker
+        assert compile_cache.drain_worker(timeout=60.0)
+        stats = m.executor_status["stats"]
+        per_metric = obs.telemetry_snapshot(m)["counters"]
+        assert per_metric["executor.eager_misses"] == stats["eager_misses"] >= 1
+        assert per_metric["executor.background_compiles"] == stats["background_compiles"]
+        if stats["background_compiles"]:
+            assert any(
+                e.name == obs.SPAN_COMPILE and (e.attrs or {}).get("background")
+                for e in obs.peek_events()
+            )
+
+    def test_aggregate_releases_dropped_executors(self):
+        before = obs.telemetry_snapshot()["counters"].get("executor.instances", 0)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        during = obs.telemetry_snapshot()["counters"]["executor.instances"]
+        assert during >= before + 1
+        del m
+        import gc
+
+        gc.collect()
+        after = obs.telemetry_snapshot()["counters"].get("executor.instances", 0)
+        assert after <= during - 1
+
+
+# ---------------------------------------------------------------------------
+# ring buffer semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRingBuffer:
+    def test_wrap_keeps_newest_events(self):
+        obs.reset_ring(capacity=16)
+        for i in range(50):
+            obs.record_span(f"s{i}", i, i + 1)
+        events = obs.drain_events()
+        assert len(events) == 16
+        assert [e.name for e in events] == [f"s{i}" for i in range(34, 50)]
+        stats = obs.ring_stats()
+        assert stats["recorded_total"] == 50 and stats["dropped_total"] == 34
+
+    def test_drain_clears_and_preserves_order(self):
+        with obs.span("a"):
+            with obs.span("b"):
+                pass
+        events = obs.drain_events()
+        assert [e.name for e in events] == ["b", "a"]  # ordered by span end
+        assert obs.peek_events() == []
+
+    def test_concurrent_recording_loses_nothing_under_capacity(self):
+        obs.reset_ring(capacity=4096)
+
+        def worker(k):
+            for i in range(100):
+                obs.record_span(f"t{k}", i, i + 1)
+
+        threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(obs.drain_events()) == 800
+        assert obs.ring_stats()["dropped_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-off
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryOff:
+    def test_tracing_off_leaves_zero_events(self):
+        obs.set_tracing(False)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        assert obs.peek_events() == []
+        assert obs.ring_stats()["recorded_total"] == 0
+
+    def test_telemetry_off_drops_counters_and_breadcrumbs(self):
+        obs.set_telemetry(False)
+        obs.counter_inc("x.y")
+        obs.gauge_set("g", 1.0)
+        obs.breadcrumb("k", {"a": 1})
+        snap = obs.telemetry_snapshot()
+        assert snap["telemetry_enabled"] is False
+        assert "x.y" not in snap["counters"] and not snap["gauges"]
+        assert obs.dump_diagnostics()["breadcrumbs"] == []
+
+    def test_telemetry_off_disables_tracing_too(self):
+        obs.set_telemetry(False)
+        obs.set_tracing(True)  # must not engage under master-off
+        assert not obs.tracing_enabled()
+        with obs.span("x"):
+            pass
+        assert obs.peek_events() == []
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+class TestExporters:
+    def test_chrome_trace_roundtrips_as_valid_json(self, tmp_path):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        for seed in range(3):
+            m.update(*_batch(seed=seed))
+        path = str(tmp_path / "trace.json")
+        obs.write_chrome_trace(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        events = doc["traceEvents"]
+        assert events, "export produced no events"
+        for ev in events:
+            assert ev["ph"] == "X"
+            assert isinstance(ev["ts"], (int, float)) and isinstance(ev["dur"], (int, float))
+            assert ev["dur"] >= 0
+            assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+            assert isinstance(ev["name"], str) and ev["name"]
+        assert any(ev["name"].startswith("tm_tpu.dispatch/") for ev in events)
+
+    def test_hundred_step_run_shows_all_seam_spans(self, tmp_path):
+        """The acceptance walkthrough: a 100-step run's export carries
+        dispatch/update/reduce/compile/checkpoint spans, loadable as a Chrome
+        trace (Perfetto consumes exactly this schema)."""
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        for step_i in range(100):
+            m.update(*_batch(seed=step_i % 7))
+        m.compute()
+        tm.save_state(m, str(tmp_path / "snap.ckpt"))
+        # the deferred read point contributes the reduce span of a real run
+        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("batch",))
+        coll = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)}
+        )
+        coll.resolve_compute_groups(*_batch())
+        step = make_deferred_collection_step(coll, mesh, axis_name="batch")
+        logits, target = _batch()
+        st = step.local_step(
+            step.init_states(),
+            jax.device_put(logits, NamedSharding(mesh, P("batch"))),
+            jax.device_put(target, NamedSharding(mesh, P("batch"))),
+        )
+        step.reduce(st)
+        doc = obs.chrome_trace(drain=True)
+        names = {ev["name"] for ev in doc["traceEvents"]}
+        for expected in (
+            "tm_tpu.dispatch/MulticlassAccuracy",
+            "tm_tpu.update/MulticlassAccuracy",
+            "tm_tpu.compute/MulticlassAccuracy",
+            obs.SPAN_REDUCE,
+            obs.SPAN_COMPILE,
+            "tm_tpu.checkpoint.save",
+        ):
+            assert expected in names, f"{expected} missing from trace ({sorted(names)[:20]})"
+        # far more warm dispatches than compiles: the trace can attribute them
+        dispatches = [e for e in doc["traceEvents"] if e["name"].startswith("tm_tpu.dispatch/")]
+        compiles = [e for e in doc["traceEvents"] if e["name"] == obs.SPAN_COMPILE]
+        assert len(dispatches) >= 100 and 1 <= len(compiles) < 10
+
+    def test_prometheus_text_format(self):
+        obs.counter_inc("checkpoint.saves", 2)
+        obs.gauge_set("autosave.inflight", 1)
+        text = obs.prometheus_text()
+        assert "# TYPE tm_tpu_checkpoint_saves_total counter" in text
+        assert "tm_tpu_checkpoint_saves_total 2" in text
+        assert "# TYPE tm_tpu_autosave_inflight gauge" in text
+        for line in text.strip().splitlines():
+            assert line.startswith("#") or len(line.split(" ")) == 2
+
+    def test_periodic_exporter_emits_records(self):
+        seen = []
+        exporter = obs.PeriodicExporter(interval_s=0.05, sink=seen.append).start()
+        with obs.span("tick"):
+            pass
+        time.sleep(0.3)
+        exporter.stop()
+        assert exporter.stats["ticks"] >= 2 and exporter.stats["sink_errors"] == 0
+        assert any("tick" in rec.get("spans_by_name", {}) for rec in seen)
+        assert all("telemetry" in rec for rec in seen)
+
+    def test_periodic_exporter_survives_sink_errors(self):
+        def bad_sink(_rec):
+            raise RuntimeError("scraper down")
+
+        exporter = obs.PeriodicExporter(interval_s=0.05, sink=bad_sink).start()
+        time.sleep(0.15)
+        exporter.stop()
+        assert exporter.stats["sink_errors"] >= 1
+        assert exporter.stats["ticks"] >= 1  # the loop survived
+
+
+# ---------------------------------------------------------------------------
+# nesting under concurrency
+# ---------------------------------------------------------------------------
+
+
+def _assert_well_nested(events):
+    """Per thread, any two spans must be disjoint or strictly nested —
+    partial overlap would mean the tracer mis-timed an enter/exit."""
+    by_tid = {}
+    for e in events:
+        by_tid.setdefault(e.tid, []).append(e)
+    for tid, evs in by_tid.items():
+        for i, a in enumerate(evs):
+            for b in evs[i + 1 :]:
+                lo, hi = max(a.t_start_ns, b.t_start_ns), min(a.t_end_ns, b.t_end_ns)
+                if lo < hi:  # they overlap: must be containment
+                    assert (
+                        (a.t_start_ns <= b.t_start_ns and b.t_end_ns <= a.t_end_ns)
+                        or (b.t_start_ns <= a.t_start_ns and a.t_end_ns <= b.t_end_ns)
+                    ), f"partial overlap on tid {tid}: {a.name} vs {b.name}"
+
+
+class TestNesting:
+    def test_spans_nest_under_concurrent_bg_compile_and_autosave(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path / "cache"))
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.set_background_compile(True)
+        saver = tm.Autosaver(m, str(tmp_path / "ckpt"), every_n_updates=2).attach()
+        try:
+            for step_i in range(8):
+                # vary the batch size across bucket rungs: cold keys keep the
+                # background worker compiling while autosaves fire
+                n = 16 + 8 * (step_i % 3)
+                m.update(*_batch(n=n, seed=step_i))
+            saver.flush()
+        finally:
+            saver.detach()
+        assert compile_cache.drain_worker(timeout=60.0)
+        events = obs.drain_events()
+        assert len({e.tid for e in events}) >= 2, "expected spans from worker threads too"
+        _assert_well_nested(events)
+        names = {e.name for e in events}
+        assert any(n.startswith("tm_tpu.dispatch/") or n.startswith("tm_tpu.update/") for n in names)
+        assert obs.SPAN_AUTOSAVE in names and "tm_tpu.checkpoint.save" in names
+
+    def test_update_span_contains_dispatch_span(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        events = obs.drain_events()
+        update = [e for e in events if e.name == "tm_tpu.update/MulticlassAccuracy"]
+        dispatch = [e for e in events if e.name == "tm_tpu.dispatch/MulticlassAccuracy"]
+        assert update and dispatch
+        u, d = update[-1], dispatch[-1]
+        assert u.t_start_ns <= d.t_start_ns and d.t_end_ns <= u.t_end_ns
+
+
+# ---------------------------------------------------------------------------
+# units, breadcrumbs, diagnostics, async observation
+# ---------------------------------------------------------------------------
+
+
+class TestUnitsAndDiagnostics:
+    def test_compile_duration_standardized_on_us_with_alias(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        stats = m.executor_status["stats"]
+        assert stats["compile_us_total"] > 0
+        assert stats["compile_ms_total"] == pytest.approx(stats["compile_us_total"] / 1e3)
+        # every duration-ish stats key carries the _us suffix (alias excepted)
+        for key in stats:
+            if key.endswith(("_ms", "_s", "_seconds")) or "_ms_" in key:
+                assert key == "compile_ms_total", f"non-_us duration key {key!r}"
+
+    def test_executor_status_still_reports_last_reduce_us(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        assert "last_reduce_us" in m.executor_status
+
+    def test_watchdog_stall_routes_breadcrumb(self):
+        from torchmetrics_tpu.io.retry import stall_watchdog
+        from torchmetrics_tpu.utils.exceptions import DispatchStallError
+
+        with pytest.raises(DispatchStallError):
+            with stall_watchdog(0.1, what="test hang", status=lambda: {"calls": 1}):
+                time.sleep(2.0)
+        crumbs = obs.dump_diagnostics()["breadcrumbs"]
+        stalls = [c for c in crumbs if c["kind"] == "dispatch_stall"]
+        assert stalls and stalls[-1]["data"]["what"] == "test hang"
+        assert obs.telemetry_snapshot()["counters"]["watchdog.stalls"] >= 1
+
+    def test_rollback_counts(self):
+        from torchmetrics_tpu.testing import faults
+
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False, executor=False)
+        before = obs.telemetry_snapshot()["counters"].get("rollback.count", 0)
+        with faults.raise_in_update(m):
+            with pytest.raises(faults.FaultInjected):
+                m.update(*_batch())
+        assert obs.telemetry_snapshot()["counters"].get("rollback.count", 0) == before + 1
+
+    def test_dump_diagnostics_shape(self):
+        d = obs.dump_diagnostics()
+        assert set(d) >= {"time_unix", "telemetry", "breadcrumbs", "env", "versions"}
+        assert d["versions"]["jax"] == jax.__version__
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        per = obs.dump_diagnostics(m)
+        assert per["telemetry"]["scope"] == "MulticlassAccuracy"
+
+    def test_observe_ready_records_without_blocking(self):
+        x = jnp.arange(1024.0)
+        y = (x * 2).sum()  # async dispatch in flight
+        out = obs.observe_ready("tm_tpu.device_ready", y, what="test")
+        assert out is y  # the value passes straight through
+        assert obs.flush_ready_observations(timeout=10.0)
+        events = [e for e in obs.drain_events() if e.name == "tm_tpu.device_ready"]
+        assert len(events) == 1 and events[0].attrs == {"what": "test"}
+
+    def test_span_records_error_attr_on_exception(self):
+        with pytest.raises(ValueError):
+            with obs.span("failing"):
+                raise ValueError("boom")
+        events = obs.drain_events()
+        assert events[-1].name == "failing" and events[-1].attrs["error"] == "ValueError"
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            obs.counter_inc("x", -1)
+
+    def test_checkpoint_counters_and_spans(self, tmp_path):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        path = tm.save_state(m, str(tmp_path / "s.ckpt"))
+        m2 = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        tm.restore_state(path, m2)
+        counters = obs.telemetry_snapshot()["counters"]
+        assert counters["checkpoint.saves"] >= 1 and counters["checkpoint.restores"] >= 1
+        names = _span_names()
+        assert "tm_tpu.checkpoint.save" in names and "tm_tpu.checkpoint.restore" in names

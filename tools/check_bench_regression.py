@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Bench regression gate: fail when a config drifts below the baseline floor.
+
+BENCH_r07 carries a silent 0.885× on config 3 that nobody had to look at —
+exactly the failure mode the ROADMAP's gate item names: a perf regression
+that rides along unnoticed because the bench records ratios but nothing
+*enforces* them. This tool is the enforcement:
+
+- For every config in a ``BENCH_r*.json``, the effective ratio is recomputed
+  from ``BASELINE.json``'s ``bench_baselines`` (``value / baseline_value``)
+  when both sides exist — so a deliberate baseline *bump* (re-anchoring after
+  an accepted change) moves the gate — falling back to the recorded
+  ``vs_baseline`` when it cannot be recomputed.
+- A ratio below the threshold (default **0.9**) fails the gate UNLESS
+  ``BASELINE.json`` carries an ``accepted_regressions`` entry for that
+  config: ``{"<config>": {"floor": 0.85, "reason": "..."}}``. The entry is a
+  *visible, reviewed* acknowledgement (the "BASELINE.json bump"); the
+  observed ratio must still clear the entry's ``floor``, so an accepted
+  drift that keeps worsening fails again.
+- A config that recorded an ``"error"`` instead of a value fails outright —
+  a bench that could not measure is not a pass.
+
+Run directly (``python tools/check_bench_regression.py [BENCH.json]``;
+default: the newest ``BENCH_r*.json`` in the repo root) or through
+``tests/test_static_checks.py`` where it gates the suite on the latest
+committed bench round.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: default floor: ROADMAP asks for a gate at vs_baseline < 0.9
+DEFAULT_THRESHOLD = 0.9
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+class Violation(NamedTuple):
+    config: str
+    ratio: Optional[float]
+    threshold: float
+    detail: str
+
+
+def latest_bench_path(root: Path = REPO) -> Optional[Path]:
+    """The newest committed ``BENCH_r<NN>.json`` by round number."""
+    best: Optional[Tuple[int, Path]] = None
+    for p in root.glob("BENCH_r*.json"):
+        m = _BENCH_RE.match(p.name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), p)
+    return best[1] if best else None
+
+
+def effective_ratio(
+    name: str, result: Dict[str, Any], baselines: Dict[str, Any]
+) -> Optional[float]:
+    """value / bench_baselines[name]["value"] when recomputable (a baseline
+    bump then moves the gate), else the recorded ``vs_baseline``."""
+    base = baselines.get(name, {})
+    value = result.get("value")
+    base_value = base.get("value") if isinstance(base, dict) else None
+    if isinstance(value, (int, float)) and isinstance(base_value, (int, float)) and base_value:
+        return float(value) / float(base_value)
+    ratio = result.get("vs_baseline")
+    return float(ratio) if isinstance(ratio, (int, float)) else None
+
+
+def check_bench(
+    bench: Dict[str, Any],
+    baseline: Dict[str, Any],
+    threshold: float = DEFAULT_THRESHOLD,
+) -> Tuple[List[Violation], List[str]]:
+    """(violations, notes). ``notes`` records accepted regressions so a CI log
+    still shows what is being waved through and why."""
+    if "configs" not in bench and isinstance(bench.get("parsed"), dict):
+        bench = bench["parsed"]  # committed BENCH_r*.json wraps the run output
+    configs = bench.get("configs", {})
+    baselines = baseline.get("bench_baselines", {})
+    accepted = baseline.get("accepted_regressions", {})
+    violations: List[Violation] = []
+    notes: List[str] = []
+    for name, result in sorted(configs.items()):
+        if not isinstance(result, dict):
+            continue
+        if "error" in result:
+            violations.append(
+                Violation(name, None, threshold, f"bench config errored: {result['error']}")
+            )
+            continue
+        ratio = effective_ratio(name, result, baselines)
+        if ratio is None or ratio >= threshold:
+            continue
+        entry = accepted.get(name)
+        if isinstance(entry, dict):
+            floor = entry.get("floor")
+            reason = entry.get("reason", "no reason recorded")
+            if isinstance(floor, (int, float)) and ratio >= float(floor):
+                notes.append(
+                    f"{name}: ratio {ratio:.3f} below threshold {threshold} but accepted"
+                    f" (floor {floor}; {reason})"
+                )
+                continue
+            violations.append(
+                Violation(
+                    name,
+                    ratio,
+                    threshold,
+                    f"ratio {ratio:.3f} fell below even the accepted floor"
+                    f" {floor!r} ({reason}) — the drift worsened; re-review",
+                )
+            )
+            continue
+        violations.append(
+            Violation(
+                name,
+                ratio,
+                threshold,
+                f"ratio {ratio:.3f} < {threshold} with no accepted_regressions entry in"
+                " BASELINE.json — fix the regression or record an accepted floor + reason",
+            )
+        )
+    return violations, notes
+
+
+def load_json(path: Path) -> Dict[str, Any]:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "bench",
+        nargs="?",
+        default=None,
+        help="bench result JSON (default: newest BENCH_r*.json in the repo root)",
+    )
+    parser.add_argument("--baseline", default=str(REPO / "BASELINE.json"))
+    parser.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    args = parser.parse_args(argv)
+
+    bench_path = Path(args.bench) if args.bench else latest_bench_path()
+    if bench_path is None or not bench_path.exists():
+        print("check_bench_regression: no BENCH_r*.json found", file=sys.stderr)
+        return 2
+    bench = load_json(bench_path)
+    baseline = load_json(Path(args.baseline)) if Path(args.baseline).exists() else {}
+
+    violations, notes = check_bench(bench, baseline, args.threshold)
+    for note in notes:
+        print(f"note: {note}")
+    for v in violations:
+        print(f"REGRESSION {v.config}: {v.detail}")
+    if violations:
+        return 1
+    print(f"check_bench_regression: clean ({bench_path.name}, threshold {args.threshold})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Static pass: no blocking host synchronisation in library hot paths.
+
+The ROADMAP's fully-async-read item (``compute()`` that never stalls the step
+loop) and the PR 5 stall-free compile discipline both depend on one
+invariant: library code on the dispatch path NEVER forces a device→host
+round-trip. JAX dispatch is asynchronous — a stray ``block_until_ready``,
+``np.asarray(device_array)`` or ``.item()`` inside the hot path silently
+serialises the pipeline, and the cost hides until someone profiles (the
+observability work this rule ships with exists precisely to make it visible;
+``obs.observe_ready`` is the sanctioned way to time device completion, off
+the hot path).
+
+Rule: inside the hot-path modules listed in ``HOT_PATH_FILES``, calls to
+
+- ``jax.block_until_ready`` / ``<x>.block_until_ready()``,
+- ``np.asarray`` / ``np.array`` / ``numpy.asarray`` (forces D2H on a device
+  array; ``jnp.asarray`` is fine — it stays on device),
+- any ``.item()`` method call,
+
+are forbidden unless allowlisted with a reason. The allowlist is the
+documented inventory of every deliberate host sync in the hot-path modules
+(probe oracles, recovery snapshots, warmup, exporters, checkpoint host-copy);
+anything new must either avoid the sync or argue its case in a review.
+
+Run directly (``python tools/lint_blocking_host_sync.py``) for a report, or
+through ``tests/test_static_checks.py`` where it gates the suite.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+#: modules on (or adjacent to) the dispatch path, relative to the package root
+HOT_PATH_FILES = (
+    "metric.py",
+    "collections.py",
+    "ops/executor.py",
+    "ops/compile_cache.py",
+    "parallel/sync.py",
+    "io/checkpoint.py",
+    "io/retry.py",
+    "obs/tracer.py",
+    "obs/registry.py",
+    "obs/export.py",
+)
+
+#: deliberate host syncs; keys are "<path>::<function>", values say why
+ALLOWLIST = {
+    # --- executor: probe oracles, recovery snapshots, warmup (all off the warm path)
+    "ops/executor.py::_states_close": (
+        "pad-probe oracle comparison: runs ONCE per metric on the first padded"
+        " call to validate bucketing, never on the warm path"
+    ),
+    "ops/executor.py::_values_close": (
+        "pad-probe oracle comparison for fused forward: first padded call only"
+    ),
+    "ops/executor.py::_snapshot": (
+        "the recovery snapshot IS a deliberate host copy — the only surviving"
+        " state if a donating dispatch dies (np.array, copying, by design)"
+    ),
+    "ops/executor.py::job": (
+        "background-compile worker: block_until_ready proves the executable on"
+        " the WORKER thread while the step loop serves eagerly"
+    ),
+    "ops/executor.py::_persist_body": (
+        "compile-cache persist worker: pre-warms the stored entry off-thread"
+    ),
+    "ops/executor.py::_dispatch_warmup": (
+        "warmup API: blocking on the dummy dispatch is the point — warmup runs"
+        " ahead of traffic (or on its own thread)"
+    ),
+    "ops/executor.py::_classify_leaves": (
+        "np.asarray on non-array python scalars only (leaves without .dtype);"
+        " device arrays take the hasattr branch and never cross to host"
+    ),
+    "ops/executor.py::unpack": (
+        "host-side value unpacker: runs on values the caller is about to read"
+        " anyway (the read point), not on the update dispatch path"
+    ),
+    # --- metric: read/serialisation surfaces, not the update dispatch path
+    "metric.py::state_dict": (
+        "torch-compat export: serialisation surface, caller asked for host data"
+    ),
+    "metric.py::__hash__": (
+        "module-hash parity helper hashing state bytes: inherently host-side"
+    ),
+    "metric.py::__getstate__": (
+        "pickling: host copies are the contract"
+    ),
+    "metric.py::load_state": (
+        "restore path: update_count arrives as a host scalar by design"
+    ),
+    "metric.py::validate_state": (
+        "validated restore surface: metadata checks on host-provided payloads"
+    ),
+    "metric.py::_check_field_finite": (
+        "validated restore (check_finite): a deliberate read-point validation"
+    ),
+    # --- checkpoint/host-copy: the ISSUE-named allowlist entries
+    "io/checkpoint.py::host_copy_tree": (
+        "checkpoint host-copy: THE sanctioned D2H fetch — serialisation needs"
+        " host bytes; Autosaver overlaps it with compute"
+    ),
+    "io/checkpoint.py::_resolve_update_count": (
+        "snapshot manifest needs the committed count as a host int"
+    ),
+    "io/checkpoint.py::visit": (
+        "manifest/leaf walker in the serialisation worker: operates on an"
+        " already-host-copied export"
+    ),
+    "io/checkpoint.py::mark": (
+        "sharded-export marking reads shard counts from an already-host export"
+    ),
+    # --- obs: the exporters/observer are the sanctioned off-hot-path blockers
+    "obs/tracer.py::_run": (
+        "the ready-observer thread: block_until_ready HERE is the design —"
+        " observe_ready exists so the step loop never blocks"
+    ),
+}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    func: str
+    snippet: str
+
+
+def _is_blocking_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "block_until_ready":
+            return True
+        if fn.attr == "item" and not node.args and not node.keywords:
+            return True
+        if fn.attr in ("asarray", "array") and isinstance(fn.value, ast.Name):
+            return fn.value.id in ("np", "numpy")
+    elif isinstance(fn, ast.Name) and fn.id == "block_until_ready":
+        return True
+    return False
+
+
+def lint_file(path: Path, rel: str) -> List[Violation]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [Violation(rel, err.lineno or 0, "<module>", f"syntax error: {err.msg}")]
+    lines = source.splitlines()
+    out: List[Violation] = []
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            if isinstance(child, ast.Call) and _is_blocking_call(child):
+                snippet = lines[child.lineno - 1].strip() if child.lineno <= len(lines) else ""
+                out.append(Violation(rel, child.lineno, child_func, snippet))
+            visit(child, child_func)
+
+    visit(tree, "<module>")
+    return out
+
+
+def collect_violations(package_root: Path):
+    """(violations, stale_allowlist): blocking host syncs in hot-path modules
+    outside the allowlist, plus allowlist entries matching nothing anymore."""
+    violations: List[Violation] = []
+    used = set()
+    for rel in HOT_PATH_FILES:
+        path = package_root / rel
+        if not path.exists():
+            continue
+        for v in lint_file(path, rel):
+            key = f"{v.path}::{v.func}"
+            if key in ALLOWLIST:
+                used.add(key)
+                continue
+            violations.append(v)
+    stale = sorted(set(ALLOWLIST) - used)
+    return violations, stale
+
+
+def main() -> int:
+    package_root = Path(__file__).resolve().parent.parent / "torchmetrics_tpu"
+    violations, stale = collect_violations(package_root)
+    for v in violations:
+        print(
+            f"{v.path}:{v.line}: blocking host sync in {v.func!r}"
+            f" (hot paths must stay async — time device work via obs.observe_ready): {v.snippet}"
+        )
+    for key in stale:
+        print(f"allowlist entry {key!r} ({ALLOWLIST[key]}) matches no call anymore — remove it")
+    if violations or stale:
+        return 1
+    print(f"lint_blocking_host_sync: clean ({len(HOT_PATH_FILES)} hot-path modules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -621,9 +621,9 @@ class MetricCollection:
         :meth:`functional_sync` once — the cross-group leaf fusion folds every
         sum-family field of EVERY compute group into one collective rendezvous
         per (reduction, dtype), instead of one per field per step."""
-        import jax
+        from torchmetrics_tpu import obs
 
-        with jax.named_scope("tm_tpu.reduce"):
+        with obs.device_span(obs.SPAN_REDUCE):
             return self.functional_sync(unshard_local_state(states), axis_name)
 
     def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:

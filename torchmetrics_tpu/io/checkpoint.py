@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.exceptions import (
     CheckpointCorruptionError,
     StateCorruptionError,
@@ -317,6 +318,18 @@ def save_state(
     preemption mid-save can cost at most the *newest* snapshot, never an old
     valid one.
     """
+    with obs.span(obs.SPAN_CKPT_SAVE, owner=type(obj).__name__):
+        obs.counter_inc("checkpoint.saves")
+        return _save_state_body(obj, path, keep, states, sharded)
+
+
+def _save_state_body(
+    obj: Any,
+    path: str,
+    keep: Optional[int],
+    states: Optional[Dict[str, Any]],
+    sharded: bool,
+) -> str:
     if states is None:
         export = obj.state()
     else:
@@ -480,6 +493,18 @@ def restore_state(
     Returns the restored snapshot's manifest, with ``"path"`` and
     ``"fallbacks_skipped"`` attached.
     """
+    with obs.span(obs.SPAN_CKPT_RESTORE, owner=type(obj).__name__):
+        obs.counter_inc("checkpoint.restores")
+        return _restore_state_body(path, obj, validate, check_finite, on_fallback)
+
+
+def _restore_state_body(
+    path: str,
+    obj: Any,
+    validate: str,
+    check_finite: bool,
+    on_fallback: Optional[Callable[[str, Exception], None]],
+) -> Dict[str, Any]:
     if not os.path.isdir(path):
         manifest = _restore_file(path, obj, validate, check_finite)
         manifest["path"] = path
@@ -497,6 +522,11 @@ def restore_state(
         except (CheckpointCorruptionError, StateCorruptionError) as err:
             skipped += 1
             errors.append(f"{os.path.basename(snap)}: {type(err).__name__}: {err}")
+            obs.counter_inc("checkpoint.restore_fallbacks")
+            obs.breadcrumb(
+                "checkpoint_fallback",
+                {"snapshot": os.path.basename(snap), "error": f"{type(err).__name__}: {err}"},
+            )
             if on_fallback is not None:
                 on_fallback(snap, err)
             else:
@@ -639,16 +669,22 @@ class Autosaver:
         with self._lock:
             if self._inflight is not None and self._inflight.is_alive():
                 self.stats["skipped_inflight"] += 1
+                obs.counter_inc("autosave.skipped_inflight")
                 return None
-            if states is not None:
-                export = host_copy_tree(states)
-                count = _resolve_update_count(self.obj, export)
-                payload_states: Optional[Dict[str, Any]] = export
-            else:
-                export, count = self._host_snapshot()
-                payload_states = export
-            self._updates_since_save = 0
-            self._last_save_t = time.monotonic()
+            # the autosave span covers exactly what the HOT PATH pays: the
+            # host-side copy; serialization + fsync run on the worker, whose
+            # cost shows up as the checkpoint.save span on its own lane
+            with obs.span(obs.SPAN_AUTOSAVE, owner=type(self.obj).__name__):
+                obs.counter_inc("autosave.ticks")
+                if states is not None:
+                    export = host_copy_tree(states)
+                    count = _resolve_update_count(self.obj, export)
+                    payload_states: Optional[Dict[str, Any]] = export
+                else:
+                    export, count = self._host_snapshot()
+                    payload_states = export
+                self._updates_since_save = 0
+                self._last_save_t = time.monotonic()
 
             def write() -> None:
                 try:
@@ -663,6 +699,8 @@ class Autosaver:
                     # is recorded (and visible in stats) instead
                     self.stats["save_errors"] += 1
                     self.stats["last_error"] = f"{type(err).__name__}: {err}"
+                    obs.counter_inc("autosave.save_errors")
+                    obs.breadcrumb("autosave_failed", {"error": f"{type(err).__name__}: {err}"})
                     rank_zero_warn(f"torchmetrics_tpu autosave failed: {type(err).__name__}: {err}")
 
             if not self.background:

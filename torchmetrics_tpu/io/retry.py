@@ -156,6 +156,9 @@ def call_with_retries(
             if delay is None:
                 raise  # budget exhausted: propagate the final failure
             attempt += 1
+            from torchmetrics_tpu import obs  # deferred: io.retry loads before obs in some paths
+
+            obs.counter_inc("retry.attempts")
             if on_retry is not None:
                 on_retry(attempt, err, delay)
             else:
@@ -242,6 +245,16 @@ def stall_watchdog(
                 except Exception as err:  # breadcrumbs must never mask the stall itself
                     rank_zero_debug(f"torchmetrics_tpu stall_watchdog: status() failed ({err})")
                     breadcrumbs = None
+            # route the stall through the diagnostic trail (obs/registry.py):
+            # dump_diagnostics() after the crash shows WHAT stalled and the
+            # executor's counters at that moment, not just the final traceback
+            from torchmetrics_tpu import obs  # deferred: io.retry loads before obs in some paths
+
+            obs.counter_inc("watchdog.stalls")
+            obs.breadcrumb(
+                "dispatch_stall",
+                {"what": what, "deadline_s": deadline, "executor_status": breadcrumbs},
+            )
             raise DispatchStallError(
                 f"{what} did not complete within {deadline}s (stalled runtime call;"
                 " checkpoint local state and restart this process)"

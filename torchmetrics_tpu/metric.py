@@ -49,6 +49,7 @@ from torchmetrics_tpu.parallel.sync import (
     local_accumulate_spec,
     sync_states,
 )
+from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
@@ -458,6 +459,7 @@ class Metric:
         locally-accumulated state cannot leave the flags claiming the opposite
         of what the restored arrays hold; omitted (the default) leaves them
         untouched for callers that never moved them."""
+        obs.counter_inc("rollback.count")
         object.__setattr__(self, "_state", state)
         # the restored arrays may be aliased by whoever observed the failure
         self.__dict__["_state_escaped"] = True
@@ -476,7 +478,7 @@ class Metric:
         if shards is None:
             return
         t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+        with obs.span(obs.SPAN_REDUCE, owner=type(self).__name__, kind="fold_pending"):
             folded = fold_sharded_states(
                 {k: jnp.asarray(self._state[k]) for k in self._defaults}, self._reductions
             )
@@ -511,7 +513,7 @@ class Metric:
             if ex is not None:
                 handled = False
                 try:
-                    with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                    with obs.span(obs.SPAN_UPDATE, suffix=type(self).__name__):
                         handled = ex.run_update(args, kwargs)
                 except BaseException:
                     # the executor restored _state itself (recovery reference);
@@ -531,7 +533,7 @@ class Metric:
                 # reference's torch._C._log_api_usage_once telemetry); the body
                 # routes through self._update_fn so the fault-injection harness
                 # (testing/faults.py) can intercept every path uniformly
-                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                with obs.span(obs.SPAN_UPDATE, suffix=type(self).__name__):
                     self._update_fn(*args, **kwargs)
             except TypeError as err:
                 self._rollback(snapshot, pre_count, pre_computed, reduced=pre_reduced)
@@ -574,7 +576,7 @@ class Metric:
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+            ), obs.span(obs.SPAN_COMPUTE, suffix=type(self).__name__):
                 # routed through self._compute_fn (not the closed-over bound
                 # method) so the fault harness can intercept compute too
                 value = _squeeze_if_scalar(self._compute_fn(*args, **kwargs))
@@ -779,7 +781,7 @@ class Metric:
         self._cache = self._copy_state_dict()
         t0 = time.perf_counter()
         try:
-            with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+            with obs.span(obs.SPAN_REDUCE, owner=type(self).__name__, kind="sync"):
                 dist_sync_fn = dist_sync_fn or self.dist_sync_fn
                 if dist_sync_fn is not None:
                     self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
@@ -829,6 +831,11 @@ class Metric:
             if self.on_sync_failure != "local":
                 raise
             self.__dict__["_last_sync_ok"] = False
+            obs.counter_inc("sync.degraded_local")
+            obs.breadcrumb(
+                "sync_degraded_local",
+                {"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
+            )
             rank_zero_warn(
                 f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
                 " degrading to local-only state per on_sync_failure='local'."
@@ -1149,7 +1156,7 @@ class Metric:
         the reserved count key like :meth:`functional_sync`."""
         from torchmetrics_tpu.parallel.sync import unshard_local_state
 
-        with jax.named_scope("tm_tpu.reduce"):
+        with obs.device_span(obs.SPAN_REDUCE):
             return self.functional_sync(unshard_local_state(state), axis_name)
 
     def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -1166,8 +1173,8 @@ class Metric:
                 "_state",
                 {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k not in self._RESERVED_STATE_KEYS},
             )
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"), jax.named_scope(
-                f"tm_tpu.update/{type(self).__name__}"
+            with obs.span(obs.SPAN_UPDATE, suffix=type(self).__name__), obs.device_span(
+                obs.SPAN_UPDATE, suffix=type(self).__name__
             ):
                 self._update_fn(*args, **kwargs)
             return self._copy_state_dict()
@@ -1183,7 +1190,7 @@ class Metric:
                 "_state",
                 {k: (list(v) if isinstance(v, list) else v) for k, v in state.items() if k not in self._RESERVED_STATE_KEYS},
             )
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+            with obs.span(obs.SPAN_COMPUTE, suffix=type(self).__name__):
                 return _squeeze_if_scalar(self._compute_fn())
         finally:
             object.__setattr__(self, "_state", saved)

@@ -1,0 +1,109 @@
+"""torchmetrics_tpu.obs — the unified runtime observability surface.
+
+One package, three parts (docs/OBSERVABILITY.md):
+
+- **Span tracer** (``tracer``): :func:`span` wraps every hot seam of the
+  runtime (executor dispatch, bucket padding, compile, disk-cache load/store,
+  deferred reduce, sync/gather, checkpoint save/restore, autosave ticks) with
+  a host-side ring-buffer event AND a ``jax.profiler`` annotation under the
+  same canonical ``tm_tpu.*`` name, so host spans line up with device traces
+  in xprof/Perfetto. :func:`device_span` is the in-trace (``named_scope``)
+  side of the same names. Gated by ``TORCHMETRICS_TPU_TRACE`` (default off).
+- **Counter/gauge registry** (``registry``): :func:`telemetry_snapshot`
+  (per-metric and process-global), :func:`counter_inc` / :func:`gauge_set`
+  for the low-frequency seams, :func:`breadcrumb` +
+  :func:`dump_diagnostics` for the fault paths. Gated by
+  ``TORCHMETRICS_TPU_TELEMETRY`` (default on).
+- **Exporters** (``export``): Chrome trace-event JSON
+  (:func:`write_chrome_trace` — load in Perfetto), Prometheus text
+  exposition (:func:`prometheus_text`), and a :class:`PeriodicExporter`
+  structured-log sink — all draining the ring off the hot path and writing
+  through the atomic-IO primitive.
+
+Nothing here ever blocks async dispatch: device completion is timed via
+:func:`observe_ready` (a background observer blocks on the ready future, the
+step loop does not), and with both flags off a :func:`span` costs exactly the
+``TraceAnnotation`` the pre-obs call sites already paid.
+"""
+from torchmetrics_tpu.obs.tracer import (  # noqa: F401
+    SPAN_AUTOSAVE,
+    SPAN_CACHE_LOAD,
+    SPAN_CACHE_STORE,
+    SPAN_CKPT_RESTORE,
+    SPAN_CKPT_SAVE,
+    SPAN_COMPILE,
+    SPAN_COMPUTE,
+    SPAN_DISPATCH,
+    SPAN_EXPORT,
+    SPAN_NAMES,
+    SPAN_PAD,
+    SPAN_REDUCE,
+    SPAN_SYNC_GATHER,
+    SPAN_UPDATE,
+    SPAN_WARMUP,
+    TELEMETRY_ENV,
+    TRACE_BUFFER_ENV,
+    TRACE_ENV,
+    SpanEvent,
+    device_span,
+    drain_events,
+    flush_ready_observations,
+    observe_ready,
+    peek_events,
+    record_span,
+    reset_ring,
+    ring_stats,
+    set_telemetry,
+    set_tracing,
+    span,
+    telemetry_enabled,
+    tracing_enabled,
+)
+from torchmetrics_tpu.obs.registry import (  # noqa: F401
+    breadcrumb,
+    counter_inc,
+    counters_snapshot,
+    dump_diagnostics,
+    gauge_set,
+    register_executor,
+    reset,
+    telemetry_snapshot,
+)
+from torchmetrics_tpu.obs.export import (  # noqa: F401
+    PeriodicExporter,
+    chrome_trace,
+    prometheus_text,
+    write_chrome_trace,
+    write_prometheus,
+)
+
+__all__ = [
+    "SPAN_NAMES",
+    "SpanEvent",
+    "PeriodicExporter",
+    "breadcrumb",
+    "chrome_trace",
+    "counter_inc",
+    "counters_snapshot",
+    "device_span",
+    "drain_events",
+    "dump_diagnostics",
+    "flush_ready_observations",
+    "gauge_set",
+    "observe_ready",
+    "peek_events",
+    "prometheus_text",
+    "record_span",
+    "register_executor",
+    "reset",
+    "reset_ring",
+    "ring_stats",
+    "set_telemetry",
+    "set_tracing",
+    "span",
+    "telemetry_enabled",
+    "telemetry_snapshot",
+    "tracing_enabled",
+    "write_chrome_trace",
+    "write_prometheus",
+]

@@ -1,0 +1,211 @@
+"""Telemetry exporters — Chrome trace JSON, Prometheus text, periodic sink.
+
+All exporters run OFF the hot path: they drain (or snapshot) the span ring
+and the counter registry on demand, format outside any lock, and write
+through ``io.checkpoint.atomic_write_bytes`` — the package-wide durable-write
+primitive — so a preempted export can never leave a torn file for a
+dashboard scraper to half-parse.
+
+- :func:`chrome_trace` / :func:`write_chrome_trace` — trace-event JSON
+  (``ph: "X"`` complete events) loadable in Perfetto / ``chrome://tracing``;
+  span attrs land in ``args``, nesting falls out of timestamp containment
+  per thread lane.
+- :func:`prometheus_text` / :func:`write_prometheus` — text exposition
+  (``tm_tpu_*`` families, ``# TYPE`` annotated) for a node scraper.
+- :class:`PeriodicExporter` — a daemon thread emitting one structured
+  snapshot per interval to a callback (default: debug log) and optionally an
+  atomically-replaced JSON file, riding the same
+  background-worker discipline as the Autosaver (io/checkpoint.py): the step
+  loop never waits on an export.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from torchmetrics_tpu.obs import registry as _registry
+from torchmetrics_tpu.obs import tracer as _tracer
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
+
+
+# ----------------------------------------------------------- chrome trace
+def chrome_trace(
+    events: Optional[Sequence[_tracer.SpanEvent]] = None, drain: bool = False
+) -> Dict[str, Any]:
+    """Buffered spans as a Chrome trace-event JSON object.
+
+    ``drain=True`` removes the events from the ring (the post-run export);
+    default peeks without clearing. Timestamps are microseconds on the
+    process-local monotonic clock — relative placement is exact, absolute
+    wall time is carried once in ``metadata``.
+    """
+    with _tracer.span(_tracer.SPAN_EXPORT, fmt="chrome_trace"):
+        if events is None:
+            events = _tracer.drain_events() if drain else _tracer.peek_events()
+        trace_events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        for ev in events:
+            entry: Dict[str, Any] = {
+                "name": ev.name,
+                "cat": "tm_tpu",
+                "ph": "X",
+                "ts": ev.t_start_ns / 1e3,
+                "dur": max(0.0, (ev.t_end_ns - ev.t_start_ns) / 1e3),
+                "pid": pid,
+                "tid": ev.tid,
+            }
+            if ev.attrs:
+                entry["args"] = dict(ev.attrs)
+            trace_events.append(entry)
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "producer": "torchmetrics_tpu.obs",
+                "clock": "perf_counter_ns/1e3 (us, monotonic)",
+                "exported_unix": time.time(),
+            },
+        }
+
+
+def write_chrome_trace(path: str, drain: bool = True) -> str:
+    """Atomically write :func:`chrome_trace` JSON at ``path`` (drains the
+    ring by default — the end-of-run export). Returns ``path``."""
+    from torchmetrics_tpu.io.checkpoint import atomic_write_bytes
+
+    payload = json.dumps(chrome_trace(drain=drain)).encode("utf-8")
+    atomic_write_bytes(path, payload)
+    return path
+
+
+# ------------------------------------------------------------- prometheus
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    return "".join(out)
+
+
+def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """The counter/gauge registry in Prometheus text exposition format.
+
+    Counters render as ``tm_tpu_<name>_total`` with ``# TYPE … counter``;
+    gauges as ``tm_tpu_<name>``. Dots in registry names become underscores.
+    ``snapshot`` defaults to a fresh :func:`~torchmetrics_tpu.obs.telemetry_snapshot`.
+    """
+    with _tracer.span(_tracer.SPAN_EXPORT, fmt="prometheus"):
+        if snapshot is None:
+            snapshot = _registry.telemetry_snapshot()
+        lines: List[str] = []
+        for name, value in sorted(snapshot.get("counters", {}).items()):
+            metric = f"tm_tpu_{_sanitize(name)}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {value}")
+        for name, value in sorted(snapshot.get("gauges", {}).items()):
+            metric = f"tm_tpu_{_sanitize(name)}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        spans = snapshot.get("spans") or {}
+        for key in ("buffered", "recorded_total", "dropped_total"):
+            if key in spans:
+                metric = f"tm_tpu_spans_{key}"
+                kind = "gauge" if key == "buffered" else "counter"
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {spans[key]}")
+        return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str) -> str:
+    """Atomically write :func:`prometheus_text` at ``path`` (node-exporter
+    textfile-collector style). Returns ``path``."""
+    from torchmetrics_tpu.io.checkpoint import atomic_write_bytes
+
+    atomic_write_bytes(path, prometheus_text().encode("utf-8"))
+    return path
+
+
+# ---------------------------------------------------------- periodic sink
+class PeriodicExporter:
+    """Structured-log telemetry sink on a daemon thread.
+
+    Every ``interval_s`` the exporter builds one record —
+    ``{"time_unix", "telemetry", "span_count"}`` (spans optionally drained so
+    the ring never wraps between ticks) — and hands it to ``sink`` (default:
+    one debug-log JSON line). ``json_path`` additionally atomically replaces
+    a snapshot file each tick, a cheap always-current scrape target.
+
+    The thread is daemon (cannot wedge interpreter exit), a failing sink is
+    counted and logged but never raises into the loop, and ``stop()`` joins
+    with a bounded wait. Export work shares the ring-drain discipline of the
+    other exporters: the recording hot path is never blocked.
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 10.0,
+        sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+        json_path: Optional[str] = None,
+        drain_spans: bool = True,
+    ) -> None:
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = interval_s
+        self.sink = sink
+        self.json_path = json_path
+        self.drain_spans = drain_spans
+        self.stats: Dict[str, Any] = {"ticks": 0, "sink_errors": 0, "last_error": None}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _emit(self) -> None:
+        record: Dict[str, Any] = {
+            "time_unix": time.time(),
+            "telemetry": _registry.telemetry_snapshot(),
+        }
+        if self.drain_spans:
+            events = _tracer.drain_events()
+            record["span_count"] = len(events)
+            by_name: Dict[str, int] = {}
+            for ev in events:
+                by_name[ev.name] = by_name.get(ev.name, 0) + 1
+            record["spans_by_name"] = by_name
+        try:
+            if self.sink is not None:
+                self.sink(record)
+            else:
+                rank_zero_debug(f"tm_tpu telemetry: {json.dumps(record, default=str)}")
+            if self.json_path is not None:
+                from torchmetrics_tpu.io.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(
+                    self.json_path, json.dumps(record, default=str).encode("utf-8")
+                )
+        except Exception as err:  # the sink must never take the process down
+            self.stats["sink_errors"] += 1
+            self.stats["last_error"] = f"{type(err).__name__}: {err}"
+            rank_zero_warn(f"tm_tpu telemetry sink failed: {type(err).__name__}: {err}")
+        self.stats["ticks"] += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._emit()
+
+    def start(self) -> "PeriodicExporter":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="tm_tpu_obs_export", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, final_emit: bool = True, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if final_emit:
+            self._emit()

@@ -1,0 +1,209 @@
+"""Counter/gauge registry and diagnostics dump — the one stats surface.
+
+Five PRs of runtime machinery each grew private counters (executor stats,
+compile-cache hit/miss, Autosaver saves, retry/watchdog breadcrumbs). This
+module is where they all meet:
+
+- **Process-global counters/gauges** (:func:`counter_inc` / :func:`gauge_set`)
+  for the low-frequency seams that have no owning executor: sync timeouts,
+  rollbacks, retries, watchdog stalls, checkpoint saves/restores, autosave
+  ticks. Counters are monotonic; gauges are last-write-wins.
+- **Executor aggregation**: every ``_ExecutorBase`` registers itself in a
+  weak set at construction, so :func:`telemetry_snapshot` can sum the
+  per-instance stats (``calls``, ``compiles``, ``disk_hits``, …) into
+  process-global ``executor.*`` counters with ZERO hot-path cost — the
+  executors keep incrementing their plain dicts; aggregation happens only
+  when somebody asks.
+- **Breadcrumbs** (:func:`breadcrumb`): a bounded trail of fault-path
+  records (stalls, evictions, sync degradations) that
+  :func:`dump_diagnostics` surfaces — the stall watchdog and fault paths
+  route through here so a post-incident dump carries the last N things that
+  went wrong, not just the final exception.
+
+Everything respects the master switch (``TORCHMETRICS_TPU_TELEMETRY=0`` makes
+:func:`counter_inc`/:func:`breadcrumb` no-ops); snapshot/dump always work so a
+disabled process can still report "telemetry was off".
+
+Duration convention: every duration key ends in ``_us`` (microseconds).
+``compile_ms_total`` survives one release as a deprecated alias of
+``compile_us_total`` in executor stats (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from torchmetrics_tpu.obs import tracer as _tracer
+
+_BREADCRUMB_CAP = 256
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+_breadcrumbs: List[Dict[str, Any]] = []
+#: executors register here at construction (ops/executor.py); weak so a
+#: dropped metric releases its executor and its stats leave the global view
+_executors: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def counter_inc(name: str, value: float = 1) -> None:
+    """Bump a monotonic process-global counter (no-op when telemetry is off).
+
+    ``value`` must be >= 0 — counters only move forward; use a gauge for
+    anything that can fall.
+    """
+    if not _tracer.telemetry_enabled():
+        return
+    if value < 0:
+        raise ValueError(f"counter {name!r} increment must be >= 0, got {value}")
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def gauge_set(name: str, value: float) -> None:
+    """Set a last-write-wins gauge (no-op when telemetry is off)."""
+    if not _tracer.telemetry_enabled():
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def breadcrumb(kind: str, data: Optional[Dict[str, Any]] = None) -> None:
+    """Append a fault-path record to the bounded diagnostic trail.
+
+    The stall watchdog, disk-cache evictions, sync degradations, and autosave
+    failures all route through here; :func:`dump_diagnostics` returns the
+    trail newest-last. Bounded at 256 entries — a crash loop cannot grow it
+    without bound."""
+    if not _tracer.telemetry_enabled():
+        return
+    entry = {"time_unix": time.time(), "kind": kind, "data": data or {}}
+    with _lock:
+        _breadcrumbs.append(entry)
+        if len(_breadcrumbs) > _BREADCRUMB_CAP:
+            del _breadcrumbs[: len(_breadcrumbs) - _BREADCRUMB_CAP]
+
+
+def register_executor(executor: Any) -> None:
+    """Called by ``_ExecutorBase.__init__``: adds the executor to the weak
+    aggregation set. Never raises — observability must not break dispatch."""
+    try:
+        _executors.add(executor)
+    except TypeError:  # unweakrefable test double: stats just stay local to it
+        pass
+
+
+def _aggregate_executor_stats() -> Dict[str, float]:
+    """Sum numeric stats across live executors into ``executor.<stat>`` keys.
+
+    Reads racing concurrent increments see values at most one step stale —
+    fine for monotonic counters; no lock is taken on the executors' side."""
+    agg: Dict[str, float] = {}
+    instances = 0
+    for ex in list(_executors):
+        stats = getattr(ex, "stats", None)
+        if not isinstance(stats, dict):
+            continue
+        instances += 1
+        for k, v in stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                agg[f"executor.{k}"] = agg.get(f"executor.{k}", 0) + v
+    agg["executor.instances"] = instances
+    return agg
+
+
+def reset(counters: bool = True, gauges: bool = True, breadcrumbs: bool = True) -> None:
+    """Zero the global registry (tests/bench isolation). Executor-local stats
+    are owned by their instances and are NOT touched."""
+    with _lock:
+        if counters:
+            _counters.clear()
+        if gauges:
+            _gauges.clear()
+        if breadcrumbs:
+            del _breadcrumbs[:]
+
+
+def counters_snapshot() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def telemetry_snapshot(obj: Any = None) -> Dict[str, Any]:
+    """The unified stats surface (ISSUE 6 tentpole).
+
+    ``telemetry_snapshot()`` — process-global: explicit counters, gauges,
+    the ``executor.*`` aggregate summed over every live executor, and span
+    ring occupancy. ``telemetry_snapshot(metric_or_collection)`` — one
+    instance: its ``executor_status`` flattened into the same ``counters``
+    shape (``executor.calls``, ``executor.disk_hits``, …) plus the
+    deferred-reduction observables, so dashboards read one schema whether
+    they watch a process or a metric.
+
+    Counters are monotonic over the life of the process (or instance); take
+    two snapshots and subtract for a per-interval view.
+    """
+    if obj is not None:
+        status = obj.executor_status
+        stats = status.get("stats", {})
+        counters = {
+            f"executor.{k}": v
+            for k, v in stats.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        return {
+            "scope": type(obj).__name__,
+            "counters": counters,
+            "enabled": status.get("enabled"),
+            "engaged": status.get("engaged"),
+            "fallback_reason": status.get("fallback_reason"),
+            "deferred_pending": status.get("deferred_pending"),
+            "last_reduce_us": status.get("last_reduce_us"),
+            "telemetry_enabled": _tracer.telemetry_enabled(),
+        }
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+    counters.update(_aggregate_executor_stats())
+    return {
+        "scope": "process",
+        "counters": counters,
+        "gauges": gauges,
+        "spans": _tracer.ring_stats(),
+        "telemetry_enabled": _tracer.telemetry_enabled(),
+    }
+
+
+def dump_diagnostics(obj: Any = None) -> Dict[str, Any]:
+    """Everything an operator (or the stall watchdog's error message) needs in
+    one dict: the telemetry snapshot, the breadcrumb trail (newest last), the
+    resolved ``TORCHMETRICS_TPU_*`` environment, and toolchain versions.
+    Always works, even with telemetry off — it then reports that fact."""
+    import jax
+
+    env = {k: v for k, v in sorted(os.environ.items()) if k.startswith("TORCHMETRICS_TPU_")}
+    with _lock:
+        crumbs = list(_breadcrumbs)
+    versions: Dict[str, Any] = {"jax": jax.__version__}
+    try:
+        import jaxlib
+
+        versions["jaxlib"] = jaxlib.__version__
+    except (ImportError, AttributeError):
+        versions["jaxlib"] = None
+    try:
+        from torchmetrics_tpu import __version__ as _pkg_version
+
+        versions["torchmetrics_tpu"] = _pkg_version
+    except (ImportError, AttributeError):
+        versions["torchmetrics_tpu"] = None
+    return {
+        "time_unix": time.time(),
+        "telemetry": telemetry_snapshot(obj),
+        "breadcrumbs": crumbs,
+        "env": env,
+        "versions": versions,
+    }

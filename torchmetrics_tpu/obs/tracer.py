@@ -1,0 +1,390 @@
+"""Host-side span tracer: a lock-cheap ring buffer aligned with device traces.
+
+The runtime grew five layers of machinery (donated-state executor, deferred
+reduction, durability workers, compile-ahead cache) and each hot seam burns
+wall time somewhere a plain profiler cannot attribute: was a slow step a cold
+compile, a ragged-batch pad, a disk-cache deserialize, or the deferred reduce
+finally paying its rendezvous? This module answers that with one primitive:
+
+    with span(SPAN_DISPATCH, owner="MulticlassAccuracy"):
+        fn(state, *batch)
+
+Every :func:`span` ALWAYS emits a ``jax.profiler.TraceAnnotation`` under the
+same name, so host spans line up with device traces in xprof/Perfetto — and,
+when tracing is enabled (``TORCHMETRICS_TPU_TRACE=1`` or :func:`set_tracing`),
+additionally records a ``(name, t_start_ns, t_end_ns, attrs)`` event into a
+bounded ring buffer that exporters (``obs/export.py``) drain OFF the hot path.
+The ring keeps the NEWEST events when it wraps (oldest are dropped and
+counted), so a post-incident export always shows the steps closest to the
+incident.
+
+Cost model (the tracer must never be the thing it measures):
+
+- tracing off (default): one ``TraceAnnotation`` enter/exit — exactly what
+  the pre-obs call sites already paid — plus one attribute read.
+- tracing on: two ``perf_counter_ns`` reads and one locked ring append per
+  span. The lock is held for a single append/rotate; exporters copy under the
+  same lock and format outside it.
+- device work is NEVER timed by blocking the dispatch thread:
+  :func:`observe_ready` hands the ready-future to a background observer
+  thread, so ``block_until_ready`` runs off the hot path and the recorded
+  span covers enqueue→ready without stalling the step loop.
+
+Naming: the ``SPAN_*`` constants below are the single source of truth for
+both host spans and in-trace ``jax.named_scope`` annotations
+(:func:`device_span`), so the host and device sides of a seam can never
+drift apart (docs/OBSERVABILITY.md lists them all).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax
+
+#: master telemetry switch (counters + gauges + breadcrumbs); default ON —
+#: counter increments are a handful of dict ops per step
+TELEMETRY_ENV = "TORCHMETRICS_TPU_TELEMETRY"
+#: span ring-buffer recording; default OFF (spans cost two clock reads and a
+#: locked append per section — opt in for tracing sessions and benches)
+TRACE_ENV = "TORCHMETRICS_TPU_TRACE"
+#: ring capacity in events (default 65536, ~6 MB; newest events win on wrap)
+TRACE_BUFFER_ENV = "TORCHMETRICS_TPU_TRACE_BUFFER"
+
+_DEFAULT_CAPACITY = 65536
+
+# --------------------------------------------------------------- span names
+# Canonical span names — the ONLY place these strings are defined. Host-side
+# spans (TraceAnnotation + ring) and in-trace device scopes (named_scope) both
+# draw from here, which is what keeps xprof's host and device lanes aligned.
+SPAN_DISPATCH = "tm_tpu.dispatch"          # compiled executor dispatch (per owner)
+SPAN_UPDATE = "tm_tpu.update"              # functional update body (device scope)
+SPAN_COMPUTE = "tm_tpu.compute"            # metric compute
+SPAN_REDUCE = "tm_tpu.reduce"              # sync / deferred reduce / shard fold
+SPAN_PAD = "tm_tpu.pad"                    # ragged-batch bucket padding
+SPAN_COMPILE = "tm_tpu.compile"            # trace+compile (foreground or worker)
+SPAN_CACHE_LOAD = "tm_tpu.cache.load"      # persistent executable deserialization
+SPAN_CACHE_STORE = "tm_tpu.cache.store"    # background export + store
+SPAN_SYNC_GATHER = "tm_tpu.sync.gather"    # bounded multi-host process_allgather
+SPAN_CKPT_SAVE = "tm_tpu.checkpoint.save"      # atomic snapshot write
+SPAN_CKPT_RESTORE = "tm_tpu.checkpoint.restore"  # snapshot load + validate
+SPAN_AUTOSAVE = "tm_tpu.autosave"          # Autosaver tick (host copy on hot path)
+SPAN_WARMUP = "tm_tpu.warmup"              # warmup API precompiles
+SPAN_EXPORT = "tm_tpu.export"              # telemetry export itself (allowlisted blocking)
+
+#: every canonical span name, for docs/tests
+SPAN_NAMES = (
+    SPAN_DISPATCH,
+    SPAN_UPDATE,
+    SPAN_COMPUTE,
+    SPAN_REDUCE,
+    SPAN_PAD,
+    SPAN_COMPILE,
+    SPAN_CACHE_LOAD,
+    SPAN_CACHE_STORE,
+    SPAN_SYNC_GATHER,
+    SPAN_CKPT_SAVE,
+    SPAN_CKPT_RESTORE,
+    SPAN_AUTOSAVE,
+    SPAN_WARMUP,
+    SPAN_EXPORT,
+)
+
+
+def _env_on(name: str, default: str) -> bool:
+    return os.environ.get(name, default).strip().lower() not in ("0", "false", "off", "no")
+
+
+class _Flags:
+    """Resolved telemetry flags; env is read once (and on :func:`refresh`),
+    never per span — the off path must cost one attribute read."""
+
+    __slots__ = ("telemetry", "tracing")
+
+    def __init__(self) -> None:
+        self.refresh()
+
+    def refresh(self) -> None:
+        self.telemetry = _env_on(TELEMETRY_ENV, "1")
+        self.tracing = self.telemetry and _env_on(TRACE_ENV, "0")
+
+
+_flags = _Flags()
+
+
+def telemetry_enabled() -> bool:
+    """Whether counters/gauges/breadcrumbs record (``TORCHMETRICS_TPU_TELEMETRY``)."""
+    return _flags.telemetry
+
+
+def tracing_enabled() -> bool:
+    """Whether spans record into the ring buffer (``TORCHMETRICS_TPU_TRACE``)."""
+    return _flags.tracing
+
+
+def set_telemetry(enabled: Optional[bool]) -> None:
+    """Override the master telemetry switch (None restores the env default).
+    Turning telemetry off also stops span recording."""
+    if enabled is None:
+        _flags.refresh()
+    else:
+        _flags.telemetry = bool(enabled)
+        if not enabled:
+            _flags.tracing = False
+
+
+def set_tracing(enabled: Optional[bool]) -> None:
+    """Override span recording (None restores the env default). Tracing only
+    engages while telemetry itself is on."""
+    if enabled is None:
+        _flags.tracing = _flags.telemetry and _env_on(TRACE_ENV, "0")
+    else:
+        _flags.tracing = bool(enabled) and _flags.telemetry
+
+
+class SpanEvent(NamedTuple):
+    """One completed host-side span. Times are ``time.perf_counter_ns`` values
+    (monotonic, process-local); exporters convert to µs."""
+
+    name: str
+    t_start_ns: int
+    t_end_ns: int
+    tid: int
+    attrs: Optional[Dict[str, Any]]
+
+    @property
+    def duration_us(self) -> float:
+        return (self.t_end_ns - self.t_start_ns) / 1e3
+
+
+class _Ring:
+    """Bounded span store: fixed capacity, newest events displace oldest.
+
+    One lock guards (buffer, head, totals); it is held only for the append /
+    copy itself — formatting, JSON, and file IO happen outside in the
+    exporters, so a concurrent drain can never stall a recording thread for
+    longer than a list copy.
+    """
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._buf: List[Optional[SpanEvent]] = [None] * self.capacity
+        self._head = 0          # next write slot
+        self._size = 0          # live events in the buffer
+        self.total_recorded = 0
+        self.total_dropped = 0  # overwritten before any drain saw them
+
+    def append(self, ev: SpanEvent) -> None:
+        with self._lock:
+            if self._size == self.capacity:
+                self.total_dropped += 1
+            else:
+                self._size += 1
+            self._buf[self._head] = ev
+            self._head = (self._head + 1) % self.capacity
+            self.total_recorded += 1
+
+    def _ordered(self) -> List[SpanEvent]:
+        start = (self._head - self._size) % self.capacity
+        return [
+            self._buf[(start + i) % self.capacity]  # type: ignore[misc]
+            for i in range(self._size)
+        ]
+
+    def snapshot(self) -> List[SpanEvent]:
+        with self._lock:
+            return self._ordered()
+
+    def drain(self) -> List[SpanEvent]:
+        with self._lock:
+            out = self._ordered()
+            self._buf = [None] * self.capacity
+            self._head = 0
+            self._size = 0
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "buffered": self._size,
+                "capacity": self.capacity,
+                "recorded_total": self.total_recorded,
+                "dropped_total": self.total_dropped,
+            }
+
+
+def _default_capacity() -> int:
+    raw = os.environ.get(TRACE_BUFFER_ENV, "").strip()
+    if not raw:
+        return _DEFAULT_CAPACITY
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{TRACE_BUFFER_ENV} must be an integer event count, got {raw!r}")
+    return value if value > 0 else _DEFAULT_CAPACITY
+
+
+_ring = _Ring(_default_capacity())
+
+
+def reset_ring(capacity: Optional[int] = None) -> None:
+    """Replace the ring (tests / capacity changes); buffered events are lost."""
+    global _ring
+    _ring = _Ring(capacity if capacity is not None else _default_capacity())
+
+
+def peek_events() -> List[SpanEvent]:
+    """Buffered spans, oldest→newest, WITHOUT clearing the ring."""
+    return _ring.snapshot()
+
+
+def drain_events() -> List[SpanEvent]:
+    """Remove and return all buffered spans, oldest→newest — the exporters'
+    entry point; draining off the hot path is the whole design."""
+    return _ring.drain()
+
+
+def ring_stats() -> Dict[str, Any]:
+    """Ring occupancy/drop counters plus the resolved flags."""
+    out = _ring.stats()
+    out["enabled"] = _flags.tracing
+    return out
+
+
+def record_span(
+    name: str, t_start_ns: int, t_end_ns: int, attrs: Optional[Dict[str, Any]] = None
+) -> None:
+    """Record a pre-timed span (the :func:`observe_ready` observer and tests
+    use this; prefer the :class:`span` context manager)."""
+    if _flags.tracing:
+        _ring.append(SpanEvent(name, t_start_ns, t_end_ns, threading.get_ident(), attrs))
+
+
+class span:
+    """Host-side span: ``TraceAnnotation`` always, ring event when tracing.
+
+    ``with span(SPAN_REDUCE): ...`` or ``with span(SPAN_DISPATCH, owner=name)``.
+    The owner/attrs ride into the chrome-trace ``args`` and the profiler
+    annotation name stays the bare canonical name plus an optional ``/suffix``
+    (``span(SPAN_DISPATCH, suffix=owner)`` renders ``tm_tpu.dispatch/Owner``,
+    the spelling the pre-obs call sites used).
+    """
+
+    __slots__ = ("name", "attrs", "_ann", "_t0")
+
+    def __init__(self, name: str, suffix: Optional[str] = None, **attrs: Any) -> None:
+        self.name = f"{name}/{suffix}" if suffix else name
+        self.attrs = attrs or None
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._t0 = 0
+
+    def __enter__(self) -> "span":
+        self._ann.__enter__()
+        if _flags.tracing:
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0:
+            t1 = time.perf_counter_ns()
+            attrs = self.attrs
+            if exc_type is not None:
+                attrs = dict(attrs or ())
+                attrs["error"] = exc_type.__name__
+            _ring.append(SpanEvent(self.name, self._t0, t1, threading.get_ident(), attrs))
+            self._t0 = 0
+        return self._ann.__exit__(exc_type, exc, tb)
+
+
+def device_span(name: str, suffix: Optional[str] = None):
+    """In-trace scope under a canonical span name: ``jax.named_scope`` for
+    function bodies that run INSIDE jit/shard_map, where host timestamps are
+    trace-time artifacts and only the XLA-op annotation is meaningful. Using
+    this (instead of a literal string) is what guarantees the device-side
+    name matches the host-side :class:`span` name for the same seam."""
+    return jax.named_scope(f"{name}/{suffix}" if suffix else name)
+
+
+# ------------------------------------------------------- async device timing
+class _ReadyObserver:
+    """One daemon thread that blocks on ready-futures SO THE HOT PATH NEVER
+    DOES: :func:`observe_ready` enqueues (name, t0, value) and returns
+    immediately; the observer calls ``jax.block_until_ready`` here and records
+    the enqueue→ready span. A bounded queue sheds observations (counted in the
+    drop stat) instead of backpressuring dispatch."""
+
+    def __init__(self, maxsize: int = 256) -> None:
+        self._jobs: Any = queue.Queue(maxsize=maxsize)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="tm_tpu_obs_ready", daemon=True
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            name, t0, value, attrs = self._jobs.get()
+            try:
+                jax.block_until_ready(value)
+                record_span(name, t0, time.perf_counter_ns(), attrs)
+            except Exception as err:
+                # a donated-away or deleted buffer is not an incident; record
+                # the attempt so the trace shows the observation was shed
+                from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+                rank_zero_debug(
+                    f"tm_tpu obs ready-observer: {name} unobservable ({type(err).__name__}: {err})"
+                )
+                record_span(
+                    name, t0, time.perf_counter_ns(),
+                    {**(attrs or {}), "error": type(err).__name__},
+                )
+            finally:
+                self._jobs.task_done()
+
+    def submit(self, name: str, t0: int, value: Any, attrs: Optional[Dict[str, Any]]) -> bool:
+        self._ensure_thread()
+        try:
+            self._jobs.put_nowait((name, t0, value, attrs))
+            return True
+        except queue.Full:
+            self.dropped += 1
+            return False
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Best-effort wait for queued observations (tests/exporters)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._jobs.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+
+_ready_observer = _ReadyObserver()
+
+
+def observe_ready(name: str, value: Any, **attrs: Any) -> Any:
+    """Time device work WITHOUT blocking dispatch: returns ``value``
+    immediately; a background observer blocks on it and records an
+    enqueue→ready span. The library's answer to "how long did the device
+    take" that never puts ``block_until_ready`` on the step loop
+    (docs/OBSERVABILITY.md). No-op when tracing is off."""
+    if _flags.tracing:
+        _ready_observer.submit(name, time.perf_counter_ns(), value, attrs or None)
+    return value
+
+
+def flush_ready_observations(timeout: float = 10.0) -> bool:
+    """Wait for pending :func:`observe_ready` observations to land in the ring."""
+    return _ready_observer.flush(timeout)

@@ -494,29 +494,40 @@ class CompileWorker:
                 self._thread.start()
 
     def _run(self) -> None:
+        from torchmetrics_tpu import obs  # deferred: keep import-time deps minimal
+
         while True:
             job = self._q.get()
             try:
                 job()
                 self.stats["completed"] += 1
+                obs.counter_inc("compile_worker.completed")
             except Exception as err:
                 # background work must never crash the process; the eager
                 # path it backs is already correct — record and move on
                 self.stats["errors"] += 1
+                obs.counter_inc("compile_worker.errors")
+                obs.breadcrumb("compile_worker_job_failed", {"error": f"{type(err).__name__}: {err}"})
                 rank_zero_debug(
                     f"torchmetrics_tpu compile worker: job failed ({type(err).__name__}: {err})"
                 )
             finally:
                 self._q.task_done()
+                obs.gauge_set("compile_worker.pending", self._q.unfinished_tasks)
 
     def submit(self, job: Callable[[], None]) -> bool:
         """Enqueue without blocking; False when the bounded queue is full."""
+        from torchmetrics_tpu import obs  # deferred: keep import-time deps minimal
+
         try:
             self._q.put_nowait(job)
         except queue.Full:
             self.stats["dropped"] += 1
+            obs.counter_inc("compile_worker.dropped")
             return False
         self.stats["submitted"] += 1
+        obs.counter_inc("compile_worker.submitted")
+        obs.gauge_set("compile_worker.pending", self._q.unfinished_tasks)
         self._ensure_thread()
         return True
 

@@ -83,6 +83,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu import obs
 from torchmetrics_tpu.ops import compile_cache
 from torchmetrics_tpu.utils.exceptions import DispatchStallError
 from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
@@ -448,7 +449,9 @@ def _new_stats() -> Dict[str, Any]:
         "disk_evictions": 0,      # persisted entries that failed at dispatch and were dropped
         "background_compiles": 0, # cold keys compiled on the worker and swapped in warm
         "eager_misses": 0,        # calls served eagerly while their compile ran in background
-        "compile_ms_total": 0.0,  # wall-clock spent in cold (trace+compile) dispatches
+        # duration keys standardize on _us (ISSUE 6 satellite); stats_dict()
+        # still emits compile_ms_total as a deprecated alias for one release
+        "compile_us_total": 0.0,  # wall-clock spent in cold (trace+compile) dispatches
         "warmup": 0,              # executables precompiled through the warmup API
     }
 
@@ -459,6 +462,10 @@ class _ExecutorBase:
     def __init__(self) -> None:
         self._cache: Dict[Any, Callable] = {}
         self.stats = _new_stats()
+        # global telemetry aggregation (obs/registry.py): weak registration,
+        # zero hot-path cost — stats stay plain dict increments here and the
+        # registry sums them only when telemetry_snapshot() is asked
+        obs.register_executor(self)
         self.disabled_reason: Optional[str] = None
         self._static_reason_cached: Any = ()  # sentinel: not yet computed
         self._pad_validated = False
@@ -492,6 +499,7 @@ class _ExecutorBase:
                 f"torchmetrics_tpu executor disabled for {self._owner_name()}: {reason}"
                 " (eager fallback; see Metric.executor_status)"
             )
+            obs.breadcrumb("executor_disabled", {"owner": self._owner_name(), "reason": reason})
         self.disabled_reason = reason
 
     def _snapshot(self, state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -611,19 +619,20 @@ class _ExecutorBase:
         :class:`_DiskEntryFailure` (evict + fresh recompile, NOT the sticky
         eager fallback a trace failure earns) and unwraps itself back to the
         bare jitted call once one dispatch has succeeded."""
-        sections = compile_cache.load_executable_blob(persist.key_desc)
-        if sections is None:
-            return None
-        loaded = None
-        for fmt, blob in sections:  # best format first; fall through on failure
-            try:
-                loaded = compile_cache.deserialize_executable(blob, fmt)
-                break
-            except Exception as err:
-                rank_zero_debug(
-                    f"torchmetrics_tpu compile cache: section {fmt!r} for {self._owner_name()}"
-                    f" failed to deserialize ({type(err).__name__}: {err}); trying next section"
-                )
+        with obs.span(obs.SPAN_CACHE_LOAD, owner=self._owner_name()):
+            sections = compile_cache.load_executable_blob(persist.key_desc)
+            if sections is None:
+                return None
+            loaded = None
+            for fmt, blob in sections:  # best format first; fall through on failure
+                try:
+                    loaded = compile_cache.deserialize_executable(blob, fmt)
+                    break
+                except Exception as err:
+                    rank_zero_debug(
+                        f"torchmetrics_tpu compile cache: section {fmt!r} for {self._owner_name()}"
+                        f" failed to deserialize ({type(err).__name__}: {err}); trying next section"
+                    )
         if loaded is None:
             rank_zero_warn(
                 f"torchmetrics_tpu compile cache: persisted executable for {self._owner_name()}"
@@ -661,6 +670,10 @@ class _ExecutorBase:
             self._cache.pop(failure.key, None)
         self._unlink_entry(failure.key_desc)
         self.stats["disk_evictions"] += 1
+        obs.breadcrumb(
+            "disk_entry_evicted",
+            {"owner": self._owner_name(), "error": f"{type(failure.original).__name__}: {failure.original}"},
+        )
         rank_zero_warn(
             f"torchmetrics_tpu compile cache: persisted executable for {self._owner_name()}"
             f" failed at dispatch ({type(failure.original).__name__}: {failure.original});"
@@ -691,8 +704,9 @@ class _ExecutorBase:
         def job() -> None:
             t0 = time.perf_counter()
             try:
-                fn = jax.jit(clone_builder(), donate_argnums=0)
-                jax.block_until_ready(fn(*persist.dummy_args()))
+                with obs.span(obs.SPAN_COMPILE, owner=self._owner_name(), background=True):
+                    fn = jax.jit(clone_builder(), donate_argnums=0)
+                    jax.block_until_ready(fn(*persist.dummy_args()))
             except Exception as err:
                 with self._cache_lock:
                     self._pending_keys.discard(key)
@@ -701,7 +715,7 @@ class _ExecutorBase:
             self._install_fn(key, fn)
             self.stats["compiles"] += 1
             self.stats["background_compiles"] += 1
-            self.stats["compile_ms_total"] += (time.perf_counter() - t0) * 1e3
+            self.stats["compile_us_total"] += (time.perf_counter() - t0) * 1e6
             self._persist_body(fn, persist)
 
         if not compile_cache.get_worker().submit(job):
@@ -736,7 +750,8 @@ class _ExecutorBase:
         it, and pre-warm the persisted form into the XLA persistent cache so
         the NEXT process's first dispatch is a cache hit, not a compile."""
         try:
-            sections = compile_cache.export_executable(fn, persist.avals)
+            with obs.span(obs.SPAN_CACHE_STORE, owner=self._owner_name()):
+                sections = compile_cache.export_executable(fn, persist.avals)
         except Exception as err:
             # unserializable computation: this key stays memory-only (the XLA
             # persistent cache still covers its compile); record why once
@@ -941,20 +956,23 @@ class _ExecutorBase:
         if key in self._cache:
             return "already_warm"
         t0 = time.perf_counter()
-        clone_builder = persist.make_clone_builder()
-        fn, _ = self._get_fn(key, clone_builder, lambda: persist, allow_background=False)
-        try:
-            jax.block_until_ready(fn(*persist.dummy_args()))
-        except _DiskEntryFailure as df:
-            self._evict_disk_entry(df)
-            fn, _ = self._get_fn(key, clone_builder, None, allow_background=False)
-            jax.block_until_ready(fn(*persist.dummy_args()))
+        with obs.span(obs.SPAN_WARMUP, owner=self._owner_name()):
+            clone_builder = persist.make_clone_builder()
+            fn, _ = self._get_fn(key, clone_builder, lambda: persist, allow_background=False)
+            try:
+                jax.block_until_ready(fn(*persist.dummy_args()))
+            except _DiskEntryFailure as df:
+                self._evict_disk_entry(df)
+                fn, _ = self._get_fn(key, clone_builder, None, allow_background=False)
+                jax.block_until_ready(fn(*persist.dummy_args()))
         self.stats["warmup"] += 1
-        self.stats["compile_ms_total"] += (time.perf_counter() - t0) * 1e3
+        self.stats["compile_us_total"] += (time.perf_counter() - t0) * 1e6
         return "warmed"
 
     def stats_dict(self) -> Dict[str, Any]:
         out = dict(self.stats)
+        # deprecated alias (one release): duration keys standardized on _us
+        out["compile_ms_total"] = out["compile_us_total"] / 1e3
         out["disabled_reason"] = self.disabled_reason
         out["fallback_reason"] = self.disabled_reason
         out["bucketing_enabled"] = self._bucketing_ok
@@ -1215,12 +1233,13 @@ class MetricExecutor(_ExecutorBase):
             bucket = bucket_size(n)
             padded = bucket != n
         if padded:
-            batched = tuple(
-                _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
-                for l in leaves
-            )
-            call_leaves = _pad_leaves(leaves, batched, bucket)
-            sig = _classify_leaves(call_leaves)
+            with obs.span(obs.SPAN_PAD, n=int(n), bucket=int(bucket)):
+                batched = tuple(
+                    _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
+                    for l in leaves
+                )
+                call_leaves = _pad_leaves(leaves, batched, bucket)
+                sig = _classify_leaves(call_leaves)
         else:
             batched = None
             call_leaves = list(leaves)
@@ -1298,16 +1317,20 @@ class MetricExecutor(_ExecutorBase):
         # profiler span naming the metric so wall time attributes to it
         # (ISSUE 3 observability; the traced body carries matching
         # jax.named_scope annotations via functional_update)
-        t_cold = time.perf_counter() if fresh else None
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+        t_cold_ns = time.perf_counter_ns() if fresh else None
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
             new_state = self._guarded_dispatch(
                 lambda: call_fn(state_in),
                 lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
                 fresh,
                 lambda: self._restore(m, recovery) if not need_copy else None,
             )
-        if t_cold is not None:
-            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
+        if t_cold_ns is not None:
+            t_now_ns = time.perf_counter_ns()
+            self.stats["compile_us_total"] += (t_now_ns - t_cold_ns) / 1e3
+            # the cold dispatch IS the foreground compile: give it its own
+            # span so a Perfetto trace separates compile stalls from warm steps
+            obs.record_span(obs.SPAN_COMPILE, t_cold_ns, t_now_ns, {"owner": self._owner_name()})
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -1407,16 +1430,20 @@ class MetricExecutor(_ExecutorBase):
                 return fn(state_arg, count_arr, jnp.asarray(n, jnp.int32), *call_leaves)
             return fn(state_arg, count_arr, *call_leaves)
 
-        t_cold = time.perf_counter() if fresh else None
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+        t_cold_ns = time.perf_counter_ns() if fresh else None
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
             new_state, value = self._guarded_dispatch(
                 lambda: call_fn(state_in),
                 lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
                 fresh,
                 lambda: self._restore(m, recovery) if not need_copy else None,
             )
-        if t_cold is not None:
-            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
+        if t_cold_ns is not None:
+            t_now_ns = time.perf_counter_ns()
+            self.stats["compile_us_total"] += (t_now_ns - t_cold_ns) / 1e3
+            # the cold dispatch IS the foreground compile: give it its own
+            # span so a Perfetto trace separates compile stalls from warm steps
+            obs.record_span(obs.SPAN_COMPILE, t_cold_ns, t_now_ns, {"owner": self._owner_name()})
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -1733,12 +1760,13 @@ class CollectionExecutor(_ExecutorBase):
             bucket = bucket_size(n)
             padded = bucket != n
         if padded:
-            batched = tuple(
-                _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
-                for l in leaves
-            )
-            call_leaves = _pad_leaves(leaves, batched, bucket)
-            sig = _classify_leaves(call_leaves)
+            with obs.span(obs.SPAN_PAD, n=int(n), bucket=int(bucket)):
+                batched = tuple(
+                    _is_concrete_array(l) and getattr(l, "ndim", 0) >= 1 and int(l.shape[0]) == n
+                    for l in leaves
+                )
+                call_leaves = _pad_leaves(leaves, batched, bucket)
+                sig = _classify_leaves(call_leaves)
         else:
             batched = None
             call_leaves = list(leaves)
@@ -1843,16 +1871,20 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
-        t_cold = time.perf_counter() if fresh else None
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+        t_cold_ns = time.perf_counter_ns() if fresh else None
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
             new_states = self._guarded_dispatch(
                 lambda: call_fn(states),
                 lambda: call_fn(copied_states()),
                 fresh,
                 lambda: self._restore_groups(donated),
             )
-        if t_cold is not None:
-            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
+        if t_cold_ns is not None:
+            t_now_ns = time.perf_counter_ns()
+            self.stats["compile_us_total"] += (t_now_ns - t_cold_ns) / 1e3
+            # the cold dispatch IS the foreground compile: give it its own
+            # span so a Perfetto trace separates compile stalls from warm steps
+            obs.record_span(obs.SPAN_COMPILE, t_cold_ns, t_now_ns, {"owner": self._owner_name()})
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -1974,16 +2006,20 @@ class CollectionExecutor(_ExecutorBase):
                 for name, m, _, _ in leader_execs
             }
 
-        t_cold = time.perf_counter() if fresh else None
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{self._owner_name()}"):
+        t_cold_ns = time.perf_counter_ns() if fresh else None
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
             new_states, values = self._guarded_dispatch(
                 lambda: call_fn(states),
                 lambda: call_fn(copied_states()),
                 fresh,
                 lambda: self._restore_groups(donated),
             )
-        if t_cold is not None:
-            self.stats["compile_ms_total"] += (time.perf_counter() - t_cold) * 1e3
+        if t_cold_ns is not None:
+            t_now_ns = time.perf_counter_ns()
+            self.stats["compile_us_total"] += (t_now_ns - t_cold_ns) / 1e3
+            # the cold dispatch IS the foreground compile: give it its own
+            # span so a Perfetto trace separates compile stalls from warm steps
+            obs.record_span(obs.SPAN_COMPILE, t_cold_ns, t_now_ns, {"owner": self._owner_name()})
         if padded:
             self.stats["padded_calls"] += 1
 
@@ -2105,7 +2141,7 @@ def _make_deferred_bodies(collection: Any, axis_name: str, pack_values: bool):
 
     def local_step(states, *args, **kwargs):
         # purely local accumulation: unshard -> update -> reshard, no collectives
-        with jax.named_scope("tm_tpu.update"):
+        with obs.device_span(obs.SPAN_UPDATE):
             local = collection.functional_update(unshard_local_state(states), *args, **kwargs)
         return reshard_local_state(local)
 
@@ -2200,7 +2236,7 @@ class DeferredCollectionStep:
             return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
 
         fn = self._get(("local", len(batch)), build)
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{type(self._coll).__name__}"):
+        with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
             return fn(states, *batch)
 
     def local_epoch(self, states, *stacked):
@@ -2213,7 +2249,7 @@ class DeferredCollectionStep:
                 def one(carry, batch):
                     return self._coll.functional_update(carry, *batch), None
 
-                with jax.named_scope("tm_tpu.update"):
+                with obs.device_span(obs.SPAN_UPDATE):
                     out, _ = jax.lax.scan(one, local, tuple(chunk))
                 return reshard_local_state(out)
 
@@ -2223,7 +2259,7 @@ class DeferredCollectionStep:
             return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
 
         fn = self._get(("epoch", len(stacked)), build)
-        with jax.profiler.TraceAnnotation(f"tm_tpu.dispatch/{type(self._coll).__name__}"):
+        with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
             return fn(states, *stacked)
 
     def reduce(self, states):
@@ -2236,7 +2272,7 @@ class DeferredCollectionStep:
             return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
 
         fn = self._get("reduce", build)
-        with jax.profiler.TraceAnnotation("tm_tpu.reduce"):
+        with obs.span(obs.SPAN_REDUCE):
             return self._unpack(fn(states))
 
 
@@ -2311,6 +2347,7 @@ def executor_stats(obj: Any) -> Dict[str, Any]:
     ex = getattr(obj, "_executor_obj", None)
     if ex is None:
         out = _new_stats()
+        out["compile_ms_total"] = 0.0  # deprecated alias of compile_us_total
         out["disabled_reason"] = None
         out["fallback_reason"] = None
         out["bucketing_enabled"] = True

@@ -150,29 +150,37 @@ def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
     at most one parked worker — but a timeout that repeats is this process's
     cue to checkpoint local state (io/checkpoint.py) and exit.
     """
+    # deferred: utils/__init__ itself imports from this module (reduce/class_reduce),
+    # so obs (whose exporters pull in utils.prints) cannot be imported at module scope
+    from torchmetrics_tpu import obs
+
     if timeout is None:
-        return _process_allgather(value)
+        with obs.span(obs.SPAN_SYNC_GATHER, bounded=False):
+            return _process_allgather(value)
     global _gather_pool
 
-    # deferred: utils/__init__ itself imports from this module (reduce/class_reduce)
     from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
 
-    worker = _gather_pool
-    if worker is None or not worker.usable():
-        worker = _GatherWorker()
-        _gather_pool = worker
-    box, done = worker.submit(_process_allgather, value)
-    if not done.wait(timeout):
-        # the worker is now parked on the abandoned gather: retire it so the
-        # next sync starts with a free worker instead of queueing behind it
-        _gather_pool = None
-        worker.retire()
-        raise SyncTimeoutError(
-            f"multi-host state sync (process_allgather) did not complete within {timeout}s"
-        )
-    if "err" in box:
-        raise box["err"]
-    return box["ok"]
+    with obs.span(obs.SPAN_SYNC_GATHER, timeout_s=timeout):
+        worker = _gather_pool
+        if worker is None or not worker.usable():
+            worker = _GatherWorker()
+            _gather_pool = worker
+        box, done = worker.submit(_process_allgather, value)
+        if not done.wait(timeout):
+            # the worker is now parked on the abandoned gather: retire it so the
+            # next sync starts with a free worker instead of queueing behind it
+            _gather_pool = None
+            worker.retire()
+            obs.counter_inc("sync.timeouts")
+            obs.breadcrumb("sync_timeout", {"timeout_s": timeout})
+            raise SyncTimeoutError(
+                f"multi-host state sync (process_allgather) did not complete within {timeout}s"
+            )
+        if "err" in box:
+            obs.counter_inc("sync.gather_errors")
+            raise box["err"]
+        return box["ok"]
 
 
 def in_named_axis_context(axis_name: Union[str, Sequence[str]]) -> bool:
@@ -382,7 +390,9 @@ def reduce_sharded_states(
     fused collective rendezvous. Returns replicated (reduced) states without
     the shard axis.
     """
-    with jax.named_scope("tm_tpu.reduce"):
+    from torchmetrics_tpu import obs  # deferred: see _gather_with_timeout
+
+    with obs.device_span(obs.SPAN_REDUCE):
         return sync_states(unshard_local_state(states), reductions, axis_name)
 
 
@@ -394,7 +404,9 @@ def fold_sharded_states(states: Dict[str, Any], reductions: Dict[str, Reduction]
     demand — the same arithmetic :func:`reduce_sharded_states` performs with
     collectives, run on the gathered stack instead.
     """
-    with jax.named_scope("tm_tpu.reduce"):
+    from torchmetrics_tpu import obs  # deferred: see _gather_with_timeout
+
+    with obs.device_span(obs.SPAN_REDUCE):
         return {k: reduce_stacked(v, reductions.get(k)) for k, v in states.items()}
 
 
