@@ -106,8 +106,13 @@ class TestStore:
             time.sleep(0.01)  # distinct mtimes for deterministic eviction order
         store = os.path.join(str(cache_env), "executables")
         assert len(_entries(cache_env)) == 6
-        entry_size = os.path.getsize(os.path.join(store, _entries(cache_env)[0]))
-        removed = compile_cache.prune_store(store, max_bytes=3 * entry_size + 1)
+        # entry headers embed a JSON float timestamp, so sizes vary by a byte
+        # or two: cap at the exact total of the 3 NEWEST entries (by mtime)
+        by_mtime = sorted(
+            (os.path.join(store, p) for p in _entries(cache_env)), key=os.path.getmtime
+        )
+        cap = sum(os.path.getsize(p) for p in by_mtime[3:])
+        removed = compile_cache.prune_store(store, max_bytes=cap)
         assert removed == 3
         assert compile_cache.load_executable_blob("key-5") is not None  # newest survives
         assert compile_cache.load_executable_blob("key-0") is None  # oldest evicted

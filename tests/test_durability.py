@@ -614,3 +614,119 @@ class TestCheckFiniteSharded:
         m = _SumLike(executor=False)
         m.load_state(self._stacked_with_nan(), validate="off", check_finite=False)
         assert m.deferred_pending  # installed (explicitly unchecked fast path)
+
+
+# ---------------------------------------------------------------------------
+# laned state durability (ISSUE 7 satellite): kill/restore of 1k-lane metrics
+# ---------------------------------------------------------------------------
+
+
+class TestLanedDurability:
+    """Kill/restore exactness of a 1000-session laned metric through the
+    rotating snapshot store: stacked layout + lane directory round-trip,
+    per-lane restore validation, and the torn-write skip."""
+
+    N_SESSIONS = 1000
+
+    def _laned(self):
+        from torchmetrics_tpu.lanes import LanedMetric
+
+        return LanedMetric(_SumLike(), capacity=self.N_SESSIONS)
+
+    def _drive(self, laned, rounds=2, seed=0):
+        r = np.random.RandomState(seed)
+        for step in range(rounds):
+            items = [
+                (f"u{i}", (jnp.asarray(r.randint(-9, 9, 4).astype(np.float32)),))
+                for i in range(self.N_SESSIONS)
+            ]
+            laned.update_sessions(items)
+
+    def test_1k_lane_kill_restore_exact(self, tmp_path):
+        laned = self._laned()
+        assert laned.capacity == 1024  # 1000 sessions -> power-of-two bucket
+        self._drive(laned, rounds=2)
+        store = str(tmp_path / "store")
+        save_state(laned, store, keep=3)
+
+        # "kill": a fresh process constructs a fresh instance and restores
+        fresh = self._laned()
+        manifest = restore_state(store, fresh)
+        assert manifest["lanes"]["active"] == self.N_SESSIONS
+        assert manifest["lanes"]["capacity"] == 1024
+        assert fresh.sessions == laned.sessions
+        want = laned.lane_values()
+        got = fresh.lane_values()
+        for sid in (f"u{i}" for i in range(0, self.N_SESSIONS, 97)):
+            _values_equal(got[sid], want[sid])
+        _values_equal(fresh.compute(), laned.compute())
+
+    def test_torn_newest_snapshot_falls_back_to_previous(self, tmp_path):
+        laned = self._laned()
+        self._drive(laned, rounds=1, seed=1)
+        store = str(tmp_path / "store")
+        save_state(laned, store, keep=3)
+        checkpoint_values = {s: np.asarray(v).copy() for s, v in laned.lane_values().items()}
+        self._drive(laned, rounds=1, seed=2)  # progress past the snapshot
+        newest = save_state(laned, store, keep=3)
+        faults.torn_write(newest)  # the newest snapshot is damaged
+
+        fresh = self._laned()
+        with pytest.warns(UserWarning, match="skipping damaged snapshot"):
+            manifest = restore_state(store, fresh)
+        assert manifest["fallbacks_skipped"] == 1
+        got = fresh.lane_values()
+        for sid in (f"u{i}" for i in range(0, self.N_SESSIONS, 211)):
+            _values_equal(got[sid], checkpoint_values[sid])
+
+    def test_restored_lane_resumes_exactly(self, tmp_path):
+        """Resume-equivalence: save, restore into a fresh instance, continue
+        identical traffic on both — still bit-identical per lane."""
+        laned = self._laned()
+        self._drive(laned, rounds=1, seed=3)
+        path = str(tmp_path / "snap.ckpt")
+        save_state(laned, path)
+        fresh = self._laned()
+        restore_state(path, fresh)
+        self._drive(laned, rounds=1, seed=4)
+        self._drive(fresh, rounds=1, seed=4)
+        a, b = laned.lane_values(), fresh.lane_values()
+        for sid in (f"u{i}" for i in range(0, self.N_SESSIONS, 131)):
+            _values_equal(a[sid], b[sid])
+
+    def test_poisoned_lane_named_on_restore(self, tmp_path):
+        from torchmetrics_tpu.lanes import LanedMetric
+
+        laned = LanedMetric(_SumLike(), capacity=8)
+        laned.update_sessions([("a", (jnp.ones(2),)), ("b", (jnp.ones(2),))])
+        export = laned.state()
+        poisoned = np.asarray(export["total"]).copy()
+        victim = laned.sessions["b"]
+        poisoned[victim] = np.inf
+        export["total"] = poisoned
+        fresh = LanedMetric(_SumLike(), capacity=8)
+        with pytest.raises(StateCorruptionError, match=rf"shard\(s\) \[{victim}\]"):
+            fresh.load_state(export, check_finite=True)
+
+    def test_autosaver_rides_laned_updates(self, tmp_path):
+        """The committed-update observer seam fires for laned dispatches, so
+        the Autosaver checkpoints lane traffic with no extra wiring. The
+        reused recovery snapshot describes the PREVIOUS committed update
+        (docs/DURABILITY.md), so the restored lanes equal that prefix."""
+        laned = self._laned()
+        prefix_values = {}
+        saver = Autosaver(laned, str(tmp_path / "auto"), every_n_updates=2, background=False).attach()
+        try:
+            self._drive(laned, rounds=1, seed=5)
+            prefix_values = {s: np.asarray(v).copy() for s, v in laned.lane_values().items()}
+            self._drive(laned, rounds=1, seed=6)  # 2nd commit triggers the save
+        finally:
+            saver.detach()
+        assert saver.stats["saves"] >= 1
+        assert saver.stats["reused_recovery_snapshots"] >= 1  # zero extra device sync
+        fresh = self._laned()
+        restore_state(str(tmp_path / "auto"), fresh)
+        assert fresh.sessions == laned.sessions
+        got = fresh.lane_values()
+        for sid in (f"u{i}" for i in range(0, self.N_SESSIONS, 173)):
+            _values_equal(got[sid], prefix_values[sid])

@@ -1,0 +1,667 @@
+"""Multi-tenant session lanes acceptance battery (ISSUE 7, torchmetrics_tpu/lanes.py).
+
+Covers: per-lane bit-exactness vs N independently-updated metric instances
+for all five state families in both ``reduce="step"`` and
+``reduce="deferred"`` modes (the deferred runs on the 8-device CPU mesh with
+the lane axis stacked inside each shard), the masked-lane identity property
+(an inactive/padded lane never perturbs any state family, even when padding
+rows carry NaN/Inf garbage), lane lifecycle (admission, eviction, reset,
+idle reclamation, occupancy accounting), power-of-two capacity growth that
+preserves live lanes bit-for-bit and — with compile-ahead on — resolves the
+grown executable through the persistent store instead of a cold step-path
+compile, checkpoint round-trips of the stacked layout with per-lane restore
+validation, and the fused LanedCollection path sharing one session table.
+
+Values are integer-valued floats throughout the exactness tests, so sums are
+exact in f32 regardless of reduction order and "bit-exact" is meaningful
+across the vmapped / scanned / psum'd execution shapes.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import (
+    LanedCollection,
+    LanedMetric,
+    MetricCollection,
+    StateCorruptionError,
+    TorchMetricsUserError,
+    make_deferred_lane_step,
+    obs,
+)
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.lanes import LaneTable, lane_capacity_bucket
+from torchmetrics_tpu.ops.executor import bucket_size
+
+NUM_CLASSES = 5
+
+
+def _agg(cls, **kw):
+    """Aggregation metric with tracing-safe nan handling (compiled lanes)."""
+    return cls(nan_strategy="disable", **kw)
+
+
+def _int_rows(rng, n, lo=-20, hi=20):
+    return jnp.asarray(rng.randint(lo, hi, n).astype(np.float32))
+
+
+FAMILIES = {
+    "sum": lambda: _agg(SumMetric),
+    "mean": lambda: _agg(MeanMetric),
+    "max": lambda: _agg(MaxMetric),
+    "min": lambda: _agg(MinMetric),
+    "cat": lambda: _agg(CatMetric),  # list state -> exact eager lane mode
+}
+
+
+def _family_batch(family, rng, n=6):
+    if family == "mean":
+        return (_int_rows(rng, n), jnp.ones((n,), jnp.float32))
+    return (_int_rows(rng, n),)
+
+
+# --------------------------------------------------------------------- table
+
+class TestLaneTable:
+    def test_capacity_bucket_ladder(self):
+        assert [lane_capacity_bucket(n) for n in (1, 8, 9, 1000, 1024, 1025)] == [
+            8, 8, 16, 1024, 1024, 2048,
+        ]
+
+    def test_allocate_release_reuse(self):
+        t = LaneTable(8)
+        lanes = [t.allocate(f"s{i}") for i in range(8)]
+        assert lanes == list(range(8)) and t.free == 0
+        with pytest.raises(TorchMetricsUserError, match="full"):
+            t.allocate("overflow")
+        assert t.release("s3") == 3
+        assert t.allocate("fresh") == 3  # freed lane is reused
+        assert t.allocate("fresh") == 3  # idempotent for known sessions
+
+    def test_grow_keeps_assignments(self):
+        t = LaneTable(8)
+        for i in range(8):
+            t.allocate(i)
+        t.grow(16)
+        assert t.capacity == 16 and t.free == 8
+        assert all(t.sessions[i] == i for i in range(8))
+
+    def test_directory_round_trip_mixed_ids(self):
+        t = LaneTable(8)
+        for sid in ("user-a", 42, True):
+            t.allocate(sid)
+        t2 = LaneTable.from_json(t.to_json())
+        assert t2.sessions == t.sessions and t2.capacity == 8
+
+    def test_directory_rejects_out_of_range_and_duplicate_lanes(self):
+        with pytest.raises(StateCorruptionError, match="outside capacity"):
+            LaneTable.from_json({"capacity": 4, "sessions": [["s", "a", 9]]})
+        with pytest.raises(StateCorruptionError, match="two sessions"):
+            LaneTable.from_json({"capacity": 4, "sessions": [["s", "a", 1], ["s", "b", 1]]})
+
+
+# --------------------------------------------- per-lane exactness (step mode)
+
+class TestPerLaneExactness:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_bit_exact_vs_independent_instances_step(self, family):
+        rng = np.random.RandomState(7)
+        laned = LanedMetric(FAMILIES[family](), capacity=8)
+        sessions = [f"s{i}" for i in range(5)]
+        refs = {s: FAMILIES[family]() for s in sessions}
+        for _round in range(4):
+            items = []
+            for s in sessions:
+                if rng.rand() < 0.3:
+                    continue  # sessions go quiet some rounds
+                batch = _family_batch(family, rng)
+                items.append((s, batch))
+                refs[s].update(*batch)
+            if items:
+                laned.update_sessions(items)
+        vals = laned.lane_values()
+        for s in sessions:
+            got = np.asarray(vals[s])
+            want = np.asarray(refs[s].compute())
+            assert got.shape == want.shape and (got == want).all(), (family, s)
+
+    def test_accuracy_bit_exact_and_single_dispatch_per_round(self):
+        rng = np.random.RandomState(0)
+        laned = LanedMetric(
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            capacity=16,
+        )
+        sessions = [f"u{i}" for i in range(10)]
+        refs = {
+            s: MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+            for s in sessions
+        }
+        for _round in range(3):
+            items = []
+            for s in sessions:
+                logits = jnp.asarray(rng.randn(8, NUM_CLASSES).astype(np.float32))
+                target = jnp.asarray(rng.randint(0, NUM_CLASSES, 8))
+                items.append((s, (logits, target)))
+                refs[s].update(logits, target)
+            assert laned.update_sessions(items) == 1  # one dispatch per round
+        stats = laned.executor_status["stats"]
+        assert stats["calls"] == 3 and stats["compiles"] == 1  # compiled once, reused
+        vals = laned.lane_values()
+        for s in sessions:
+            assert np.asarray(vals[s]) == np.asarray(refs[s].compute())
+
+    def test_duplicate_session_in_one_call_applies_sequentially(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        n = laned.update_sessions(
+            [("a", jnp.asarray([1.0])), ("a", jnp.asarray([2.0])), ("b", jnp.asarray([5.0]))]
+        )
+        assert n == 2  # two rounds: "a" twice cannot share one scatter
+        vals = laned.lane_values()
+        assert float(np.asarray(vals["a"])) == 3.0 and float(np.asarray(vals["b"])) == 5.0
+
+    def test_forward_is_rejected(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        with pytest.raises(TorchMetricsUserError, match="update_sessions"):
+            laned(jnp.asarray([1.0]))
+
+    def test_mismatched_row_shapes_raise(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        with pytest.raises(ValueError, match="share shapes"):
+            laned.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([1.0]))])
+
+
+# ------------------------------------------------- masked-lane identity (sat)
+
+class TestMaskedLaneIdentity:
+    """Property: a lane that receives no row in a dispatch — whether inactive,
+    evicted, or covered by a padding sentinel — keeps its exact prior bits,
+    for every state family, even when the padding rows carry poison."""
+
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_padded_rows_never_perturb_any_family_step(self, family):
+        rng = np.random.RandomState(3)
+        laned = LanedMetric(FAMILIES[family](), capacity=8)
+        batch = _family_batch(family, rng)
+        laned.update_sessions([("live", batch), ("quiet", batch)])
+        before = np.asarray(laned.compute_session("quiet")).copy()
+        # rounds naming ONLY "live": packing pads 1 row up to the bucket floor
+        # (8), so 7 sentinel rows flow through the dispatch every time — the
+        # quiet lane must keep its exact prior bits through all of them
+        for _ in range(3):
+            laned.update_sessions([("live", _family_batch(family, rng))])
+        after = np.asarray(laned.compute_session("quiet"))
+        assert after.shape == before.shape and (after == before).all(), family
+
+    def test_sentinel_rows_with_poison_values_compiled(self):
+        """Drive the low-level update directly: sentinel rows carrying
+        NaN/Inf/huge values must leave EVERY lane bit-identical."""
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([3.0, 4.0]))])
+        before = {f: np.asarray(laned._state[f]).copy() for f in ("sum_value", "lane_updates")}
+        sentinel = laned.capacity
+        lane_ids = jnp.asarray([sentinel] * 8, jnp.int32)
+        poison = jnp.stack([jnp.asarray([np.nan, np.inf])] * 8)
+        laned.update(lane_ids, poison)
+        for f, want in before.items():
+            got = np.asarray(laned._state[f])
+            assert got.dtype == want.dtype and (got == want).all(), f
+
+    @pytest.mark.parametrize("family", ["sum", "mean", "max", "min"])
+    def test_padded_rows_never_perturb_deferred(self, family, mesh):
+        """Deferred mode: sentinel rows scattered under shard_map leave every
+        lane's sharded accumulation bit-identical."""
+        laned = LanedMetric(FAMILIES[family](), capacity=8, reduce="deferred")
+        laned.admit("a")
+        step = make_deferred_lane_step(laned, mesh)
+        states = step.init_states()
+        rng = np.random.RandomState(1)
+        batch = _family_batch(family, rng, n=2)
+        rows = 8
+        lane_ids = [laned.sessions["a"]] + [laned.capacity] * (rows - 1)
+        stacked = tuple(jnp.stack([leaf] * rows) for leaf in batch)
+        states = step.local_step(states, jnp.asarray(lane_ids, jnp.int32), *stacked)
+        before = {k: np.asarray(v).copy() for k, v in states.items()}
+        # now a round of ONLY sentinel rows carrying poison
+        poison = tuple(jnp.full_like(s, np.nan) for s in stacked)
+        states = step.local_step(states, jnp.asarray([laned.capacity] * rows, jnp.int32), *poison)
+        for k, want in before.items():
+            got = np.asarray(states[k])
+            assert (got == want).all(), k
+
+    def test_inactive_lanes_contribute_identity_to_aggregate(self):
+        """The all-lane fold masks inactive lanes with the family's identity
+        element (parallel.sync.reduction_identity): admitting and evicting
+        extra sessions never moves the aggregate."""
+        for family, make in FAMILIES.items():
+            if family == "cat":
+                continue  # array-cat aggregate is undefined by design
+            rng = np.random.RandomState(11)
+            laned = LanedMetric(make(), capacity=8)
+            batch = _family_batch(family, rng)
+            laned.update_sessions([("keep", batch)])
+            want = np.asarray(laned.compute())
+            laned.admit("idle-1")
+            laned.admit("idle-2")
+            got = np.asarray(laned.compute())
+            assert (got == want).all(), family
+            laned.evict("idle-1")
+            laned.evict("idle-2")
+            assert (np.asarray(laned.compute()) == want).all(), family
+
+
+# --------------------------------------------------------- deferred exactness
+
+class TestDeferredLanes:
+    @pytest.mark.parametrize("family", ["sum", "mean", "max", "min"])
+    def test_bit_exact_vs_independent_instances_deferred(self, family, mesh):
+        """Per-lane results after the single deferred reduce match N
+        independent instances fed the same rows (integer-valued data: sums
+        are exact whatever the reduction order)."""
+        rng = np.random.RandomState(5)
+        laned = LanedMetric(FAMILIES[family](), capacity=8, reduce="deferred")
+        sessions = ["a", "b", "c"]
+        for s in sessions:
+            laned.admit(s)
+        refs = {s: FAMILIES[family]() for s in sessions}
+        step = make_deferred_lane_step(laned, mesh)
+        states = step.init_states()
+        for _round in range(3):
+            rows = 16  # divisible by the 8-device mesh
+            lane_ids, leaves = [], []
+            for i in range(rows):
+                sid = sessions[i % 3] if i < 15 else None
+                batch = _family_batch(family, rng, n=2)
+                if sid is None:
+                    lane_ids.append(laned.capacity)
+                else:
+                    lane_ids.append(laned.sessions[sid])
+                    refs[sid].update(*batch)
+                leaves.append(batch)
+            stacked = tuple(
+                jnp.stack([leaves[i][j] for i in range(rows)]) for j in range(len(leaves[0]))
+            )
+            states = step.local_step(states, jnp.asarray(lane_ids, jnp.int32), *stacked)
+        step.install_reduced(step.reduce(states))
+        vals = laned.lane_values()
+        for s in sessions:
+            got, want = np.asarray(vals[s]), np.asarray(refs[s].compute())
+            assert (got == want).all(), (family, s)
+
+    def test_accuracy_deferred_matches_step_mode(self, mesh):
+        """The same traffic through step-mode lanes and deferred-mode lanes
+        lands on identical per-lane values (8-device mesh, ISSUE 7
+        acceptance)."""
+        def mk(**kw):
+            return LanedMetric(
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+                capacity=8,
+                **kw,
+            )
+
+        rng = np.random.RandomState(9)
+        step_mode = mk()
+        deferred = mk(reduce="deferred")
+        for s in ("a", "b"):
+            deferred.admit(s)
+        dstep = make_deferred_lane_step(deferred, mesh)
+        states = dstep.init_states()
+        for _round in range(2):
+            rows, items, lane_ids, logits, targets = 8, [], [], [], []
+            for i in range(rows):
+                sid = ("a", "b")[i % 2] if i < 6 else None
+                l = rng.randn(4, NUM_CLASSES).astype(np.float32)
+                t = rng.randint(0, NUM_CLASSES, 4)
+                if sid is not None:
+                    items.append((sid, (jnp.asarray(l), jnp.asarray(t))))
+                    lane_ids.append(deferred.sessions[sid])
+                else:
+                    lane_ids.append(deferred.capacity)
+                logits.append(l)
+                targets.append(t)
+            # step mode routes through the packing router; deferred through
+            # the sharded local step — same rows either way
+            for sid, batch in items:
+                step_mode.update_sessions([(sid, batch)])
+            states = dstep.local_step(
+                states,
+                jnp.asarray(lane_ids, jnp.int32),
+                jnp.asarray(np.stack(logits)),
+                jnp.asarray(np.stack(targets)),
+            )
+        dstep.install_reduced(dstep.reduce(states))
+        a, b = step_mode.lane_values(), deferred.lane_values()
+        for s in ("a", "b"):
+            assert np.asarray(a[s]) == np.asarray(b[s]), s
+
+    def test_cat_family_deferred_single_process(self):
+        """List ("cat") states cannot shard a lane axis; the eager lane mode
+        still honors reduce="deferred" with single-process semantics — values
+        match step-mode lanes exactly."""
+        rng = np.random.RandomState(2)
+        step_mode = LanedMetric(_agg(CatMetric), capacity=8)
+        deferred = LanedMetric(_agg(CatMetric), capacity=8, reduce="deferred")
+        for _ in range(3):
+            batch = (_int_rows(rng, 4),)
+            step_mode.update_sessions([("a", batch)])
+            deferred.update_sessions([("a", batch)])
+        got = np.asarray(deferred.lane_values()["a"])
+        want = np.asarray(step_mode.lane_values()["a"])
+        assert (got == want).all()
+
+
+# ------------------------------------------------------------------ lifecycle
+
+class TestLifecycle:
+    def test_admit_evict_reset_occupancy(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([2.0])), ("b", jnp.asarray([3.0]))])
+        status = laned.lane_status
+        assert status["active"] == 2 and status["admissions"] == 2
+        laned.reset_session("a")
+        assert float(np.asarray(laned.compute_session("a"))) == 0.0
+        assert float(np.asarray(laned.compute_session("b"))) == 3.0  # untouched
+        lane_a = laned.sessions["a"]
+        assert laned.evict("a") == lane_a
+        assert "a" not in laned.sessions
+        with pytest.raises(KeyError):
+            laned.compute_session("a")
+        # the freed lane readmits CLEAN
+        laned.update_sessions([("c", jnp.asarray([7.0]))])
+        assert laned.sessions["c"] == lane_a
+        assert float(np.asarray(laned.compute_session("c"))) == 7.0
+
+    def test_evict_idle_reclaims_only_stale_lanes(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("old", jnp.asarray([1.0]))])
+        table = laned.__dict__["_table"]
+        table.last_seen[laned.sessions["old"]] -= 3600.0  # fake an hour of silence
+        laned.update_sessions([("fresh", jnp.asarray([1.0]))])
+        assert laned.evict_idle(60.0) == ["old"]
+        assert list(laned.sessions) == ["fresh"]
+        assert laned.lane_status["evictions"] == 1
+
+    def test_reset_clears_lanes_but_keeps_sessions(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([5.0]))])
+        laned.reset()
+        assert "a" in laned.sessions
+        assert float(np.asarray(laned.compute_session("a"))) == 0.0
+
+    def test_growth_preserves_lane_bits_and_buckets(self):
+        rng = np.random.RandomState(4)
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        items = [(f"s{i}", (_int_rows(rng, 3),)) for i in range(8)]
+        laned.update_sessions(items)
+        before = {s: np.asarray(v).copy() for s, v in laned.lane_values().items()}
+        # 9th session forces growth 8 -> 16
+        laned.update_sessions([("s8", (_int_rows(rng, 3),))])
+        assert laned.capacity == 16 and laned.lane_status["grows"] == 1
+        after = laned.lane_values()
+        for s, want in before.items():
+            assert np.asarray(after[s]) == want, s
+
+    def test_max_capacity_is_enforced(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8, max_capacity=8)
+        for i in range(8):
+            laned.admit(i)
+        with pytest.raises(TorchMetricsUserError, match="max_capacity"):
+            laned.admit("overflow")
+
+    def test_wrapping_a_laned_metric_is_rejected(self):
+        with pytest.raises(ValueError, match="cannot wrap"):
+            LanedMetric(LanedMetric(_agg(SumMetric)))
+
+
+# ------------------------------------------------- growth reuses cached exec
+
+class TestGrowthCachedCompile:
+    def test_grow_resolves_through_persistent_store(self, monkeypatch, tmp_path):
+        """ISSUE 7 acceptance: capacity growth 8->16 reuses the prewarmed
+        persisted executable — the step path records a disk hit and ZERO new
+        compiles (verified via executor_status counters)."""
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path / "store"))
+        rng = np.random.RandomState(0)
+        laned = LanedMetric(
+            MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            capacity=8,
+        )
+
+        def batch():
+            return (
+                jnp.asarray(rng.randn(4, NUM_CLASSES).astype(np.float32)),
+                jnp.asarray(rng.randint(0, NUM_CLASSES, 4)),
+            )
+
+        laned.update_sessions([(f"s{i}", batch()) for i in range(6)])
+        report = laned.prewarm_growth(
+            (
+                jax.ShapeDtypeStruct((4, NUM_CLASSES), jnp.float32),
+                jax.ShapeDtypeStruct((4,), jnp.int32),
+            ),
+            rows=[16],
+            levels=1,
+        )
+        assert report["warmed"] >= 1 and not report["skipped"]
+        pre = dict(laned.executor_status["stats"])
+        laned.grow(16)
+        # 12 sessions -> row bucket 16, the prewarmed shape
+        laned.update_sessions([(f"s{i}", batch()) for i in range(12)])
+        post = laned.executor_status["stats"]
+        assert post["disk_hits"] - pre["disk_hits"] == 1
+        assert post["compiles"] == pre["compiles"]  # no cold compile on the step path
+        assert post["eager_misses"] == pre["eager_misses"]
+
+    def test_prewarm_reports_skip_without_compile_ahead(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "0")
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        report = laned.prewarm_growth((jax.ShapeDtypeStruct((4,), jnp.float32),), rows=[8])
+        assert report["warmed"] == 0 and report["skipped"]
+
+
+# ----------------------------------------------------------------- durability
+
+class TestLanedCheckpoint:
+    def _traffic(self, laned, rng, sessions, rounds=3):
+        for _ in range(rounds):
+            laned.update_sessions([(s, (_int_rows(rng, 4),)) for s in sessions])
+
+    def test_round_trip_compiled_mode(self, tmp_path):
+        rng = np.random.RandomState(8)
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        self._traffic(laned, rng, ["a", "b", "c"])
+        path = str(tmp_path / "lanes.ckpt")
+        tm.save_state(laned, path)
+        fresh = LanedMetric(_agg(SumMetric), capacity=8)
+        manifest = tm.restore_state(path, fresh)
+        assert manifest["lanes"] == {"capacity": 8, "active": 3, "compiled": True}
+        assert fresh.sessions == laned.sessions
+        a, b = laned.lane_values(), fresh.lane_values()
+        for s in a:
+            assert np.asarray(a[s]) == np.asarray(b[s]), s
+
+    def test_round_trip_adapts_capacity(self, tmp_path):
+        rng = np.random.RandomState(8)
+        laned = LanedMetric(_agg(SumMetric), capacity=16)
+        self._traffic(laned, rng, [f"s{i}" for i in range(12)])
+        path = str(tmp_path / "wide.ckpt")
+        tm.save_state(laned, path)
+        fresh = LanedMetric(_agg(SumMetric), capacity=8)  # narrower construction
+        tm.restore_state(path, fresh)
+        assert fresh.capacity == 16
+        assert fresh.sessions == laned.sessions
+
+    def test_round_trip_eager_cat_mode(self, tmp_path):
+        rng = np.random.RandomState(8)
+        laned = LanedMetric(_agg(CatMetric), capacity=8)
+        self._traffic(laned, rng, ["a", "b"])
+        path = str(tmp_path / "cat.ckpt")
+        tm.save_state(laned, path)
+        fresh = LanedMetric(_agg(CatMetric), capacity=8)
+        tm.restore_state(path, fresh)
+        a, b = laned.lane_values(), fresh.lane_values()
+        for s in a:
+            assert (np.asarray(a[s]) == np.asarray(b[s])).all(), s
+
+    def test_directory_capacity_mismatch_rejected(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([1.0]))])
+        export = laned.state()
+        export["sum_value"] = np.zeros((16,), np.float32)  # arrays claim 16 lanes
+        export["lane_updates"] = np.zeros((16,), np.int32)
+        fresh = LanedMetric(_agg(SumMetric), capacity=8)
+        with pytest.raises(StateCorruptionError, match="capacity"):
+            fresh.load_state(export)
+
+    def test_check_finite_names_poisoned_lane(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([1.0])), ("b", jnp.asarray([2.0]))])
+        export = laned.state()
+        poisoned = np.asarray(export["sum_value"]).copy()
+        poisoned[laned.sessions["b"]] = np.nan
+        export["sum_value"] = poisoned
+        fresh = LanedMetric(_agg(SumMetric), capacity=8)
+        with pytest.raises(StateCorruptionError, match=f"shard\\(s\\) \\[{laned.sessions['b']}\\]"):
+            fresh.load_state(export, check_finite=True)
+
+    def test_negative_lane_counts_rejected(self):
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([("a", jnp.asarray([1.0]))])
+        export = laned.state()
+        bad = np.asarray(export["lane_updates"]).copy()
+        bad[0] = -3
+        export["lane_updates"] = bad
+        fresh = LanedMetric(_agg(SumMetric), capacity=8)
+        with pytest.raises(StateCorruptionError, match="negative per-lane"):
+            fresh.load_state(export)
+
+
+# ----------------------------------------------------------------- collection
+
+class TestLanedCollection:
+    def _mk(self, **kw):
+        return LanedCollection(
+            {"sum": _agg(SumMetric), "max": _agg(MaxMetric), "min": _agg(MinMetric)},
+            capacity=8,
+            **kw,
+        )
+
+    def test_values_match_independent_collections(self):
+        rng = np.random.RandomState(6)
+        lc = self._mk()
+        sessions = ["a", "b", "c"]
+        refs = {
+            s: MetricCollection({"sum": _agg(SumMetric), "max": _agg(MaxMetric), "min": _agg(MinMetric)})
+            for s in sessions
+        }
+        for _round in range(3):
+            items = []
+            for s in sessions:
+                batch = (_int_rows(rng, 4),)
+                items.append((s, batch))
+                refs[s].update(*batch)
+            assert lc.update_sessions(items) == 1
+        vals = lc.lane_values()
+        for s in sessions:
+            want = refs[s].compute()
+            for name, v in vals[s].items():
+                assert np.asarray(v) == np.asarray(want[name]), (s, name)
+
+    def test_members_share_one_table(self):
+        lc = self._mk()
+        lc.update_sessions([("a", (jnp.asarray([1.0]),))])
+        tables = {id(m.__dict__["_table"]) for m in lc._members.values()}
+        assert tables == {id(lc._table)}
+        assert lc["sum"].sessions == lc.sessions
+
+    def test_eviction_resets_every_member(self):
+        lc = self._mk()
+        lc.update_sessions([("a", (jnp.asarray([5.0]),)), ("b", (jnp.asarray([2.0]),))])
+        lane = lc.sessions["a"]
+        lc.evict("a")
+        lc.update_sessions([("c", (jnp.asarray([1.0]),))])
+        assert lc.sessions["c"] == lane
+        vals = lc.lane_values()["c"]
+        assert float(np.asarray(vals["sum"])) == 1.0 and float(np.asarray(vals["max"])) == 1.0
+
+    def test_growth_spans_all_members(self):
+        rng = np.random.RandomState(1)
+        lc = self._mk()
+        lc.update_sessions([(f"s{i}", (_int_rows(rng, 2),)) for i in range(8)])
+        before = {s: {k: np.asarray(v).copy() for k, v in d.items()} for s, d in lc.lane_values().items()}
+        lc.update_sessions([("s8", (_int_rows(rng, 2),))])
+        assert lc.capacity == 16
+        for m in lc._members.values():
+            assert m.capacity == 16
+        after = lc.lane_values()
+        for s, d in before.items():
+            for k, want in d.items():
+                assert np.asarray(after[s][k]) == want, (s, k)
+
+    def test_checkpoint_round_trip_relinks_table(self, tmp_path):
+        rng = np.random.RandomState(2)
+        lc = self._mk()
+        lc.update_sessions([("a", (_int_rows(rng, 4),)), ("b", (_int_rows(rng, 4),))])
+        path = str(tmp_path / "coll.ckpt")
+        tm.save_state(lc, path)
+        fresh = self._mk()
+        tm.restore_state(path, fresh)
+        assert fresh.sessions == lc.sessions
+        tables = {id(m.__dict__["_table"]) for m in fresh._members.values()}
+        assert tables == {id(fresh._table)}
+        a, b = lc.lane_values(), fresh.lane_values()
+        for s in a:
+            for k in a[s]:
+                assert np.asarray(a[s][k]) == np.asarray(b[s][k]), (s, k)
+
+    def test_fused_executor_engages(self):
+        lc = self._mk()
+        rng = np.random.RandomState(3)
+        for _ in range(3):
+            lc.update_sessions([("a", (_int_rows(rng, 4),)), ("b", (_int_rows(rng, 4),))])
+        stats = lc.executor_status["stats"]
+        assert stats["calls"] >= 1  # the fused collection dispatch ran
+
+
+# ------------------------------------------------------------------ telemetry
+
+class TestLaneTelemetry:
+    def test_dispatch_span_and_counters(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_TRACE", "1")
+        obs.set_tracing(True)
+        obs.reset_ring()
+        obs.reset(counters=True, gauges=True, breadcrumbs=False)
+        try:
+            laned = LanedMetric(_agg(SumMetric), capacity=8)
+            laned.update_sessions([("a", jnp.asarray([1.0])), ("b", jnp.asarray([2.0]))])
+            laned.evict("b")
+            events = obs.drain_events()
+            assert any(e.name == obs.SPAN_LANES for e in events)
+            counters = obs.telemetry_snapshot()["counters"]
+            assert counters["lanes.dispatches"] >= 1
+            assert counters["lanes.rows"] >= 2
+            assert counters["lanes.admissions"] == 2
+            assert counters["lanes.evictions"] == 1
+            gauges = obs.telemetry_snapshot()["gauges"]
+            assert gauges["lanes.occupancy"] == 1.0
+            assert gauges["lanes.capacity"] == 8.0
+        finally:
+            obs.set_tracing(None)
+            obs.reset_ring()
+
+    def test_bucket_size_reuse_across_ragged_session_counts(self):
+        """5 sessions and 7 sessions land in the same row bucket (8): one
+        executable serves both round shapes."""
+        rng = np.random.RandomState(0)
+        laned = LanedMetric(_agg(SumMetric), capacity=8)
+        laned.update_sessions([(f"s{i}", (_int_rows(rng, 2),)) for i in range(5)])
+        laned.update_sessions([(f"s{i}", (_int_rows(rng, 2),)) for i in range(7)])
+        stats = laned.executor_status["stats"]
+        assert bucket_size(5) == bucket_size(7) == 8
+        assert stats["compiles"] == 1 and stats["calls"] == 2

@@ -142,6 +142,22 @@ def test_no_blocking_host_sync_in_hot_paths():
     assert not stale, f"stale lint allowlist entries (calls gone — remove them): {stale}"
 
 
+def test_blocking_sync_linter_fails_on_missing_module(monkeypatch):
+    """A typo'd (or moved) HOT_PATH_FILES entry used to silently lint nothing;
+    it must now fail so the rule cannot rot when a file is renamed (ISSUE 7
+    satellite)."""
+    linter = _load_tool("lint_blocking_host_sync")
+    monkeypatch.setattr(linter, "HOT_PATH_FILES", ("metric.py", "ops/no_such_module.py"))
+    monkeypatch.setattr(
+        linter,
+        "ALLOWLIST",
+        {k: v for k, v in linter.ALLOWLIST.items() if k.startswith("metric.py::")},
+    )
+    violations, _stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    missing = [v for v in violations if v.path == "ops/no_such_module.py"]
+    assert missing and "does not exist" in missing[0].snippet
+
+
 def test_blocking_sync_linter_catches_violations(tmp_path):
     """The linter actually fires on all three forbidden forms."""
     linter = _load_tool("lint_blocking_host_sync")
@@ -184,13 +200,34 @@ def test_bench_regression_gate_fires_on_synthetic():
     violations, notes = checker.check_bench(bench, {})
     assert len(violations) == 1 and violations[0].config == "x_conf"
 
-    accepted = {"accepted_regressions": {"x_conf": {"floor": 0.8, "reason": "reviewed"}}}
+    accepted = {
+        "bench_baselines": {"x_conf": {"value": 100.0}},
+        "accepted_regressions": {"x_conf": {"floor": 0.8, "reason": "reviewed"}},
+    }
     violations, notes = checker.check_bench(bench, accepted)
     assert not violations and len(notes) == 1
 
     worse = {"configs": {"x_conf": {"value": 70.0, "vs_baseline": 0.70}}}
     violations, _ = checker.check_bench(worse, accepted)
     assert len(violations) == 1 and "worsened" in violations[0].detail
+
+
+def test_bench_regression_gate_flags_stale_accepted_entries():
+    """An accepted_regressions entry naming a config absent from
+    bench_baselines is a stale waiver shielding nothing — it must fail the
+    gate instead of passing silently (ISSUE 7 satellite)."""
+    checker = _load_tool("check_bench_regression")
+    bench = {"configs": {"real_conf": {"value": 100.0, "vs_baseline": 1.0}}}
+    baseline = {
+        "bench_baselines": {"real_conf": {"value": 100.0}, "_note": "meta"},
+        "accepted_regressions": {
+            "_note": "meta keys are skipped",
+            "retired_conf": {"floor": 0.8, "reason": "config was renamed"},
+        },
+    }
+    violations, _ = checker.check_bench(bench, baseline)
+    assert len(violations) == 1
+    assert violations[0].config == "retired_conf" and "stale waiver" in violations[0].detail
 
 
 def test_bench_regression_gate_recomputes_from_baseline_bump():

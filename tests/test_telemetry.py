@@ -5,10 +5,10 @@ Covers the obs/ package end to end: snapshot counters agreeing with
 background-compile paths, ring-buffer wrap semantics (newest events always
 survive), Chrome-trace export round-tripping as valid trace-event JSON (the
 Perfetto acceptance), span nesting under concurrent background compile +
-autosave, zero-cost-when-off, the duration-key standardization
-(``compile_us_total`` + deprecated ``compile_ms_total`` alias), the
-Prometheus exposition format, breadcrumb routing from the fault paths, and
-the non-blocking ``observe_ready`` device-timing seam.
+autosave, zero-cost-when-off, the duration-key standardization (every
+duration key carries ``_us``; the one-release ``compile_ms_total`` alias is
+gone), the Prometheus exposition format, breadcrumb routing from the fault
+paths, and the non-blocking ``observe_ready`` device-timing seam.
 
 Runs on the 8-fake-device CPU mesh from conftest.py.
 """
@@ -380,16 +380,18 @@ class TestNesting:
 
 
 class TestUnitsAndDiagnostics:
-    def test_compile_duration_standardized_on_us_with_alias(self):
+    def test_compile_duration_standardized_on_us(self):
         m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
         m.update(*_batch())
         stats = m.executor_status["stats"]
         assert stats["compile_us_total"] > 0
-        assert stats["compile_ms_total"] == pytest.approx(stats["compile_us_total"] / 1e3)
-        # every duration-ish stats key carries the _us suffix (alias excepted)
+        # the one-release deprecated alias is gone (ISSUE 7 satellite)
+        assert "compile_ms_total" not in stats
+        # every duration-ish stats key carries the _us suffix
         for key in stats:
-            if key.endswith(("_ms", "_s", "_seconds")) or "_ms_" in key:
-                assert key == "compile_ms_total", f"non-_us duration key {key!r}"
+            assert not (key.endswith(("_ms", "_s", "_seconds")) or "_ms_" in key), (
+                f"non-_us duration key {key!r}"
+            )
 
     def test_executor_status_still_reports_last_reduce_us(self):
         m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)

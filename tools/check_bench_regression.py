@@ -19,6 +19,10 @@ that rides along unnoticed because the bench records ratios but nothing
   drift that keeps worsening fails again.
 - A config that recorded an ``"error"`` instead of a value fails outright —
   a bench that could not measure is not a pass.
+- Every ``accepted_regressions`` entry must name a config present in
+  ``BASELINE.json``'s ``bench_baselines`` — a stale entry (its config renamed
+  or retired) used to pass silently, which is exactly the invisible-waiver
+  failure mode the gate exists to prevent.
 
 Run directly (``python tools/check_bench_regression.py [BENCH.json]``;
 default: the newest ``BENCH_r*.json`` in the repo root) or through
@@ -125,6 +129,21 @@ def check_bench(
                 threshold,
                 f"ratio {ratio:.3f} < {threshold} with no accepted_regressions entry in"
                 " BASELINE.json — fix the regression or record an accepted floor + reason",
+            )
+        )
+    # stale waivers: an accepted_regressions entry whose config no longer
+    # exists in bench_baselines shields nothing and must not linger
+    for name in sorted(accepted):
+        if name.startswith("_") or name in baselines:
+            continue
+        violations.append(
+            Violation(
+                name,
+                None,
+                threshold,
+                "accepted_regressions entry names no config in BASELINE.json"
+                " bench_baselines — stale waiver; remove it (or restore the config's"
+                " baseline row)",
             )
         )
     return violations, notes

@@ -37,6 +37,7 @@ from typing import List, NamedTuple
 HOT_PATH_FILES = (
     "metric.py",
     "collections.py",
+    "lanes.py",
     "ops/executor.py",
     "ops/compile_cache.py",
     "parallel/sync.py",
@@ -119,6 +120,23 @@ ALLOWLIST = {
         "the ready-observer thread: block_until_ready HERE is the design —"
         " observe_ready exists so the step loop never blocks"
     ),
+    # --- lanes: the router pack point + restore-surface validation
+    "lanes.py::_stack_rows": (
+        "router pack point: per-session batches arrive as host rows by design;"
+        " one np.stack + one H2D upload per dispatch replaces a"
+        " thousand-operand device concatenation"
+    ),
+    "lanes.py::_decode_directory": (
+        "lane-directory restore: decoding a host-side uint8 JSON blob from a"
+        " checkpoint — pure host data, never a device array"
+    ),
+    "lanes.py::_validate_lanes": (
+        "per-lane restore validation: reading lane_updates as host ints IS the"
+        " validation read point (docs/LANES.md)"
+    ),
+    "lanes.py::_load_state_eager": (
+        "eager-mode restore: per-lane count arrives as a host scalar by design"
+    ),
 }
 
 
@@ -174,6 +192,16 @@ def collect_violations(package_root: Path):
     for rel in HOT_PATH_FILES:
         path = package_root / rel
         if not path.exists():
+            # a typo'd (or deleted) module name must FAIL, not silently lint
+            # nothing — the rule would otherwise rot the moment a file moves
+            violations.append(
+                Violation(
+                    rel,
+                    0,
+                    "<module>",
+                    "listed hot-path module does not exist — fix HOT_PATH_FILES",
+                )
+            )
             continue
         for v in lint_file(path, rel):
             key = f"{v.path}::{v.func}"

@@ -789,8 +789,18 @@ class MetricCollection:
                     )
                 else:
                     # wrappers override load_state with their own layouts (and
-                    # signatures); they validate structurally themselves
-                    member.load_state(st, update_count=update_count)
+                    # signatures); forward only the knobs the override accepts
+                    # (LanedMetric keeps the full validated signature; older
+                    # wrappers validate structurally themselves)
+                    import inspect
+
+                    params = inspect.signature(member.load_state).parameters
+                    extra = {
+                        k: v
+                        for k, v in (("validate", validate), ("check_finite", check_finite), ("sharded", sharded))
+                        if k in params
+                    }
+                    member.load_state(st, update_count=update_count, **extra)
 
     def merge_states(
         self,
@@ -887,6 +897,15 @@ class MetricCollection:
 
         val = val if val is not None else self.compute()
         return plot_single_or_multi_val(val, ax=ax)
+
+    def laned(self, capacity: int = 8, max_capacity: Optional[int] = None, **kwargs: Any) -> Any:
+        """A :class:`~torchmetrics_tpu.lanes.LanedCollection` holding N
+        independent copies of every member's state, all sharing one
+        session→lane table — the whole suite advances per traffic round with
+        one fused dispatch (docs/LANES.md)."""
+        from torchmetrics_tpu.lanes import LanedCollection
+
+        return LanedCollection(self, capacity=capacity, max_capacity=max_capacity, **kwargs)
 
     @property
     def compute_groups(self) -> Dict[int, List[str]]:

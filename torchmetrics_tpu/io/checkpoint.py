@@ -202,6 +202,17 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
     except Exception as err:  # objects without a spec (exotic wrappers) still snapshot
         rank_zero_debug(f"torchmetrics_tpu checkpoint: no state_spec for {type(obj).__name__} ({err})")
         spec = None
+    # laned objects (torchmetrics_tpu/lanes.py) describe their occupancy in
+    # the manifest so load_manifest can answer "how many sessions does this
+    # snapshot hold" without touching the payload arrays
+    lanes = None
+    try:
+        status = getattr(obj, "lane_status", None)
+        if isinstance(status, dict):
+            lanes = {k: status.get(k) for k in ("capacity", "active", "compiled") if k in status}
+    except Exception as err:  # a broken status probe must not block the save
+        rank_zero_debug(f"torchmetrics_tpu checkpoint: lane_status probe failed ({err})")
+
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "library_version": __version__,
@@ -210,6 +221,7 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
         "kind": "collection" if nested else "metric",
         "class": type(obj).__name__,
         "spec": spec,
+        "lanes": lanes,
         "update_count": update_count,
         "reduce_policy": getattr(obj, "reduce_policy", None),
         "mesh": {

@@ -1,0 +1,1320 @@
+"""Multi-tenant session lanes: one compiled dispatch advances thousands of
+independent metric states.
+
+One ``Metric`` instance has always equaled one logical stream, so a service
+tracking per-user / per-model / per-slice metrics for N concurrent sessions
+paid N executors, N dispatches per step, and N copies of compile overhead.
+This module stacks N independent copies of a metric's state along a leading
+**lane axis** and advances every active lane with ONE donated-state executor
+dispatch — DrJAX's map-over-independent-client-state primitive
+(PAPERS.md) applied to metric state, generalising the PR 3 sharded layout
+from "one lane per device" to "M lanes per device":
+
+    laned = LanedMetric(MulticlassAccuracy(num_classes=10), capacity=1024)
+    laned.update_sessions([("user-7", (logits_a, target_a)),
+                           ("user-42", (logits_b, target_b))])
+    laned.lane_values()          # {"user-7": ..., "user-42": ...}
+    laned.compute()              # all-lane aggregate
+
+Mechanics
+    The router packs incoming ``(session_id, batch)`` pairs into a
+    lane-batched dispatch: per-session batches are stacked along a new
+    leading row axis, ragged row counts are padded up the executor's
+    power-of-two bucket ladder, and each row carries the ``lane id`` its
+    session was admitted to. Inside the (single, compiled, donated) update::
+
+        gathered = states[lane_ids]                     # (rows, *field)
+        new      = vmap(inner.functional_update)(gathered, *batch)
+        states   = states.at[lane_ids].set(new, mode="drop")
+
+    Padding rows carry the out-of-range sentinel lane id (== capacity), so
+    their scatter is **dropped**: an inactive or padded lane contributes the
+    identity element of every state family by construction — no arithmetic
+    masking can leak into it. The all-lane aggregate fold is where explicit
+    identity elements appear (``parallel.sync.reduction_identity``): masked
+    sums/cats fold through 0, max through -inf, min through +inf, and mean
+    divides by the *active* lane count.
+
+Lifecycle
+    ``admit``/``evict``/``reset_session`` manage the session→lane directory;
+    eviction and reset reinstall lane defaults through a shape-stable masked
+    reset (the mask is data, so no recompile), and idle lanes can be
+    reclaimed with ``evict_idle``. Capacity grows by power-of-two lane-count
+    buckets; the executor keys every executable on the state signature, so a
+    grown metric resolves NEW executables through the persistent disk store
+    (``prewarm_growth`` precompiles the next rungs ahead of time) — growing
+    1k→2k lanes is a cached load, not a stall.
+
+Composition
+    - ``reduce="deferred"``: the lane axis stacks *inside* the per-device
+      shard — ``init_sharded_state`` yields ``(num_shards, lanes, *field)``
+      and :class:`DeferredLaneStep` runs zero-collective local lane scatter
+      under ``shard_map`` with one fused reduce at the read point.
+    - Checkpointing: ``state()`` exports carry the lane directory; restores
+      re-register capacity, route through the validated ``load_state`` path,
+      and check every lane (docs/LANES.md "Durability").
+    - Telemetry: dispatches emit ``tm_tpu.lanes.dispatch`` spans plus
+      ``lanes.*`` counters and occupancy/capacity gauges.
+
+Metrics whose inner state includes list ("cat") accumulators cannot carry a
+lane axis (a growing pytree cannot stack); those fall back to an exact
+host-side per-lane loop — every lifecycle/correctness guarantee holds, only
+the single-dispatch speedup does not (see docs/LANES.md "Two execution
+modes").
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.parallel.sync import reduction_identity
+from torchmetrics_tpu.utils.exceptions import StateCorruptionError, TorchMetricsUserError
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "DeferredLaneStep",
+    "LaneTable",
+    "LanedCollection",
+    "LanedMetric",
+    "lane_capacity_bucket",
+    "make_deferred_lane_step",
+]
+
+#: lane-count buckets are powers of two with this floor (mirrors the
+#: executor's batch bucket ladder — ops/executor.py)
+LANE_FLOOR = 8
+
+DEFAULT_CAPACITY = 8
+
+
+def lane_capacity_bucket(n: int) -> int:
+    """Smallest power-of-two lane capacity holding ``n`` sessions (floor 8).
+
+    >>> [lane_capacity_bucket(n) for n in (1, 8, 9, 1000, 1024, 1025)]
+    [8, 8, 16, 1024, 1024, 2048]
+    """
+    n = int(n)
+    if n <= LANE_FLOOR:
+        return LANE_FLOOR
+    return 1 << (n - 1).bit_length()
+
+
+class LaneTable:
+    """Host-side session→lane directory shared by every laned member.
+
+    Pure bookkeeping — no device state lives here. ``allocate`` hands out the
+    lowest free lane, ``release`` returns it, and per-lane ``last_seen``
+    timestamps drive idle reclamation. One table may be shared across the
+    members of a :class:`LanedCollection`, so a session occupies the SAME
+    lane index in every member's stacked state.
+    """
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = int(capacity)
+        self.sessions: Dict[Any, int] = {}
+        self.lane_session: List[Optional[Any]] = [None] * self.capacity
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))  # pop() -> lowest
+        self.last_seen: List[float] = [0.0] * self.capacity
+        self.stats: Dict[str, int] = {"admissions": 0, "evictions": 0, "resets": 0, "grows": 0}
+
+    @property
+    def active(self) -> int:
+        return len(self.sessions)
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def lane_of(self, session_id: Any) -> int:
+        try:
+            return self.sessions[session_id]
+        except KeyError:
+            raise KeyError(f"unknown session {session_id!r} (admit it first or route via update_sessions)")
+
+    def allocate(self, session_id: Any) -> int:
+        if session_id in self.sessions:
+            return self.sessions[session_id]
+        if not self._free:
+            raise TorchMetricsUserError(
+                f"lane table is full ({self.active}/{self.capacity} lanes); grow capacity first"
+            )
+        lane = self._free.pop()
+        self.sessions[session_id] = lane
+        self.lane_session[lane] = session_id
+        self.last_seen[lane] = time.monotonic()
+        self.stats["admissions"] += 1
+        return lane
+
+    def release(self, session_id: Any) -> int:
+        lane = self.lane_of(session_id)
+        del self.sessions[session_id]
+        self.lane_session[lane] = None
+        self._free.append(lane)
+        self.stats["evictions"] += 1
+        return lane
+
+    def touch(self, lanes: Iterable[int]) -> None:
+        now = time.monotonic()
+        for lane in lanes:
+            self.last_seen[lane] = now
+
+    def idle_sessions(self, idle_s: float) -> List[Any]:
+        cutoff = time.monotonic() - float(idle_s)
+        return [sid for sid, lane in self.sessions.items() if self.last_seen[lane] < cutoff]
+
+    def grow(self, new_capacity: int) -> None:
+        new_capacity = int(new_capacity)
+        if new_capacity <= self.capacity:
+            raise ValueError(f"grow target {new_capacity} <= current capacity {self.capacity}")
+        self._free = list(range(new_capacity - 1, self.capacity - 1, -1)) + self._free
+        self.lane_session.extend([None] * (new_capacity - self.capacity))
+        self.last_seen.extend([0.0] * (new_capacity - self.capacity))
+        self.capacity = new_capacity
+        self.stats["grows"] += 1
+
+    def active_mask(self) -> List[bool]:
+        mask = [False] * self.capacity
+        for lane in self.sessions.values():
+            mask[lane] = True
+        return mask
+
+    # --------------------------------------------------------- serialisation
+    def to_json(self) -> Dict[str, Any]:
+        """JSON-serialisable directory. Session ids round-trip as strings:
+        non-string ids are tagged so common scalar keys (ints) restore
+        exactly; exotic hashables restore as their repr string."""
+        entries = []
+        for sid, lane in sorted(self.sessions.items(), key=lambda kv: kv[1]):
+            if isinstance(sid, str):
+                entries.append(["s", sid, lane])
+            elif isinstance(sid, bool):
+                entries.append(["b", int(sid), lane])
+            elif isinstance(sid, int):
+                entries.append(["i", sid, lane])
+            else:
+                entries.append(["r", repr(sid), lane])
+        return {"directory_version": 1, "capacity": self.capacity, "sessions": entries}
+
+    @classmethod
+    def from_json(cls, payload: Dict[str, Any]) -> "LaneTable":
+        capacity = int(payload["capacity"])
+        table = cls(capacity)
+        for kind, sid, lane in payload.get("sessions", []):
+            lane = int(lane)
+            if not 0 <= lane < capacity:
+                raise StateCorruptionError(
+                    f"lane directory maps session {sid!r} to lane {lane}, outside capacity {capacity}"
+                )
+            if table.lane_session[lane] is not None:
+                raise StateCorruptionError(
+                    f"lane directory maps two sessions to lane {lane} ({table.lane_session[lane]!r}, {sid!r})"
+                )
+            if kind == "i":
+                sid = int(sid)
+            elif kind == "b":
+                sid = bool(sid)
+            table.sessions[sid] = lane
+            table.lane_session[lane] = sid
+            table._free.remove(lane)
+            table.last_seen[lane] = time.monotonic()
+        return table
+
+
+def _encode_directory(table: LaneTable) -> np.ndarray:
+    return np.frombuffer(json.dumps(table.to_json(), sort_keys=True).encode("utf-8"), dtype=np.uint8).copy()
+
+
+def _decode_directory(blob: Any) -> LaneTable:
+    try:
+        raw = np.asarray(blob, dtype=np.uint8).tobytes().decode("utf-8")
+        return LaneTable.from_json(json.loads(raw))
+    except StateCorruptionError:
+        raise
+    except Exception as err:
+        raise StateCorruptionError(f"lane directory blob is unreadable ({type(err).__name__}: {err})") from err
+
+
+def _pack_rounds(
+    items: Iterable[Tuple[Any, Tuple[Any, ...]]],
+) -> List[List[Tuple[Any, Tuple[Any, ...]]]]:
+    """Split (session_id, batch) pairs into rounds with at most ONE batch per
+    session each — a dispatch scatters every row to a distinct lane, so a
+    session sending two batches in one window updates sequentially across
+    rounds (scatter order among duplicate indices is undefined)."""
+    rounds: List[List[Tuple[Any, Tuple[Any, ...]]]] = []
+    seen: List[set] = []
+    for sid, batch in items:
+        if not isinstance(batch, tuple):
+            batch = (batch,)
+        for i, used in enumerate(seen):
+            if sid not in used:
+                rounds[i].append((sid, batch))
+                used.add(sid)
+                break
+        else:
+            rounds.append([(sid, batch)])
+            seen.append({sid})
+    return rounds
+
+
+class LanedMetric(Metric):
+    """N independent copies of ``inner``'s state advanced by one dispatch.
+
+    Args:
+        inner: the metric to lane. A detached clone is held — the wrapper
+            only ever calls its pure ``functional_update``/``functional_compute``.
+        capacity: initial lane capacity; rounded up to the power-of-two lane
+            bucket ladder (floor 8).
+        max_capacity: hard ceiling for automatic growth (``None`` = unbounded).
+        table: a shared :class:`LaneTable` (``LanedCollection`` passes one so
+            every member agrees on session→lane assignment).
+        kwargs: forwarded to :class:`~torchmetrics_tpu.Metric` (``reduce=``,
+            ``executor=``, ``sync_axis=``, ...).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> from torchmetrics_tpu.lanes import LanedMetric
+        >>> laned = LanedMetric(SumMetric(), capacity=8)
+        >>> laned.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([4.0, 9.0]))])
+        1
+        >>> {k: float(v) for k, v in sorted(laned.lane_values().items())}
+        {'a': 3.0, 'b': 13.0}
+        >>> float(laned.compute())  # all-lane aggregate
+        16.0
+    """
+
+    full_state_update: Optional[bool] = False
+
+    #: the executor must never pad rows with duplicates of row 0: scatter
+    #: updates route rows to lanes, so a duplicated row would double-apply
+    _executor_bucketable = False
+
+    _LANE_DIR_KEY = "_lane_directory"
+    _RESERVED_STATE_KEYS = Metric._RESERVED_STATE_KEYS + (_LANE_DIR_KEY,)
+
+    def __init__(
+        self,
+        inner: Metric,
+        capacity: int = DEFAULT_CAPACITY,
+        max_capacity: Optional[int] = None,
+        table: Optional[LaneTable] = None,
+        **kwargs: Any,
+    ) -> None:
+        if not isinstance(inner, Metric):
+            raise ValueError(f"LanedMetric wraps a Metric, got {type(inner).__name__}")
+        if isinstance(inner, LanedMetric):
+            raise ValueError("LanedMetric cannot wrap another LanedMetric")
+        super().__init__(**kwargs)
+        if int(capacity) < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        inner = inner.clone()
+        inner.__dict__["_executor_enabled"] = False  # used functionally only
+        self.__dict__["_inner"] = inner
+        self.max_capacity = None if max_capacity is None else lane_capacity_bucket(max_capacity)
+        capacity = lane_capacity_bucket(capacity)
+        if self.max_capacity is not None and capacity > self.max_capacity:
+            raise ValueError(f"capacity {capacity} exceeds max_capacity {self.max_capacity}")
+        # list ("cat") accumulators cannot stack a lane axis: exact host-side
+        # per-lane fallback (docs/LANES.md "Two execution modes")
+        self.__dict__["_compiled_lanes"] = not any(isinstance(v, list) for v in inner._defaults.values())
+        self.__dict__["_table"] = table if table is not None else LaneTable(capacity)
+        if table is not None and table.capacity != capacity:
+            capacity = table.capacity  # shared table wins: members must agree
+        if self._compiled_lanes:
+            for name, default in inner._defaults.items():
+                self.add_state(
+                    name,
+                    self._stacked_default(default, capacity),
+                    dist_reduce_fx=inner._reductions[name],
+                )
+            self.add_state("lane_updates", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.__dict__["_lane_states"] = [inner.init_state() for _ in range(capacity)]
+            self.__dict__["_lane_counts"] = [0] * capacity
+        obs.gauge_set("lanes.capacity", self.capacity)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def inner(self) -> Metric:
+        """The wrapped (detached) metric."""
+        return self.__dict__["_inner"]
+
+    @property
+    def capacity(self) -> int:
+        return self.__dict__["_table"].capacity
+
+    @property
+    def sessions(self) -> Dict[Any, int]:
+        """Live session→lane assignments (a copy)."""
+        return dict(self.__dict__["_table"].sessions)
+
+    @property
+    def lane_status(self) -> Dict[str, Any]:
+        """Occupancy + lifecycle counters + execution mode, the lane analogue
+        of :attr:`executor_status` (which still reports compile/cache stats)."""
+        table: LaneTable = self.__dict__["_table"]
+        return {
+            "capacity": table.capacity,
+            "active": table.active,
+            "free": table.free,
+            "max_capacity": self.max_capacity,
+            "compiled": self._compiled_lanes,
+            **table.stats,
+        }
+
+    def _executor_identity(self) -> str:
+        """Joins the executor's cross-process cache key: the compiled
+        computation is the INNER metric's update, so two laned wrappers with
+        identical stacked state specs but different inner metrics must never
+        share a persisted executable (ops/executor.py ``_owner_desc``)."""
+        import sys
+
+        from torchmetrics_tpu.ops import compile_cache
+
+        inner = self.inner
+        cls = type(inner)
+        mod = sys.modules.get(cls.__module__)
+        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}"
+
+    @staticmethod
+    def _stacked_default(default: Any, capacity: int) -> jnp.ndarray:
+        arr = jnp.asarray(default)
+        return jnp.broadcast_to(arr[None], (capacity,) + arr.shape)
+
+    def _inner_fields(self) -> List[str]:
+        return list(self.inner._defaults)
+
+    # ------------------------------------------------------------ update path
+    def update(self, lane_ids: Any, *args: Any) -> None:
+        """Advance the lanes named by ``lane_ids`` with the row-stacked batch.
+
+        ``lane_ids`` is an int array ``(rows,)``; every batch leaf carries a
+        matching leading row axis. Rows whose lane id is out of range (the
+        router's padding sentinel ``== capacity``) are DROPPED by the scatter
+        — a padded row cannot perturb any lane, whatever the state family.
+        Prefer :meth:`update_sessions`, which packs, pads, admits and stamps
+        sessions for you; this low-level entry is what the executor compiles.
+        """
+        lane_ids = jnp.asarray(lane_ids, jnp.int32)
+        if self._compiled_lanes:
+            self._update_compiled(lane_ids, args)
+        else:
+            self._update_eager(lane_ids, args)
+
+    def _update_compiled(self, lane_ids: Any, args: Tuple[Any, ...]) -> None:
+        inner = self.inner
+        fields = self._inner_fields()
+        states = {f: self._state[f] for f in fields}
+        cap = next(iter(states.values())).shape[0] if fields else self.capacity
+        safe_ids = jnp.minimum(lane_ids, cap - 1)  # gather side: sentinel reads lane cap-1, result dropped
+        gathered = {f: jnp.take(v, safe_ids, axis=0) for f, v in states.items()}
+
+        def one(state: Dict[str, Any], *row: Any) -> Dict[str, Any]:
+            return inner.functional_update(state, *row)
+
+        with obs.device_span(obs.SPAN_UPDATE, suffix=type(inner).__name__):
+            updated = jax.vmap(one)(gathered, *args)
+        for f in fields:
+            # sentinel ids are out of range: mode="drop" discards those rows,
+            # so padded lanes keep their exact prior bits (identity element of
+            # every reduction family by construction)
+            self._state[f] = states[f].at[lane_ids].set(updated[f], mode="drop")
+        self._state["lane_updates"] = self._state["lane_updates"].at[lane_ids].add(1, mode="drop")
+
+    def _update_eager(self, lane_ids: Any, args: Tuple[Any, ...]) -> None:
+        inner = self.inner
+        lanes = self.__dict__["_lane_states"]
+        counts = self.__dict__["_lane_counts"]
+        cap = self.capacity
+        # staged then committed: an inner update raising mid-round must leave
+        # every lane exactly as it was (the transactional contract the array
+        # path gets from the wrapper's snapshot/rollback)
+        pending: Dict[int, Any] = {}
+        for i, lane in enumerate([int(x) for x in lane_ids]):
+            if not 0 <= lane < cap:
+                continue  # padding sentinel: masked row never lands anywhere
+            row = tuple(leaf[i] for leaf in args)
+            pending[lane] = inner.functional_update(pending.get(lane, lanes[lane]), *row)
+        for lane, st in pending.items():
+            lanes[lane] = st
+            counts[lane] += 1
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise TorchMetricsUserError(
+            "LanedMetric has no single-stream forward; route traffic through"
+            " update_sessions((session_id, batch), ...) and read lane_values()/compute()"
+        )
+
+    # ----------------------------------------------------------------- router
+    def update_sessions(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
+        """Pack ``(session_id, batch)`` traffic into lane-batched dispatches.
+
+        ``items`` is a dict or iterable of pairs; each batch is a tuple of
+        per-session arrays (or a single array). Unknown sessions are admitted
+        (growing capacity by power-of-two buckets when full), rows are padded
+        up the power-of-two row ladder with sentinel lane ids, and one
+        compiled ``update`` dispatch advances every session in the round — a
+        session appearing k times spans k sequential rounds. Returns the
+        number of dispatches issued.
+        """
+        from torchmetrics_tpu.ops.executor import bucket_size
+
+        if isinstance(items, dict):
+            items = list(items.items())
+        rounds = _pack_rounds(items)
+        table: LaneTable = self.__dict__["_table"]
+        dispatches = 0
+        for round_items in rounds:
+            lanes = [self._admit_for_update(sid) for sid, _ in round_items]
+            rows = len(round_items)
+            bucket = bucket_size(rows)
+            sentinel = self.capacity  # out of range -> scatter-dropped
+            lane_ids = jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32)
+            batch = self._stack_rows([b for _, b in round_items], bucket)
+            with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, rows=rows, bucket=bucket):
+                self.update(lane_ids, *batch)
+            table.touch(lanes)
+            obs.counter_inc("lanes.dispatches")
+            obs.counter_inc("lanes.rows", rows)
+            dispatches += 1
+        return dispatches
+
+    @staticmethod
+    def _stack_rows(batches: List[Tuple[Any, ...]], bucket: int) -> Tuple[Any, ...]:
+        """Pack per-session rows into one ``(bucket, *row)`` leaf per argument.
+
+        The pack runs on HOST (numpy) with ONE device upload per leaf: a
+        thousand-session round costs one H2D transfer, not a thousand-operand
+        device concatenation. Per-session batches therefore should arrive as
+        host arrays (the service-ingestion shape); device-array rows are
+        accepted but pay a copy back to host here.
+        """
+        n_leaves = len(batches[0])
+        if any(len(b) != n_leaves for b in batches):
+            raise ValueError("every session batch in a dispatch must have the same number of leaves")
+        out = []
+        for leaf_idx in range(n_leaves):
+            rows = [np.asarray(b[leaf_idx]) for b in batches]
+            shapes = {r.shape for r in rows}
+            if len(shapes) != 1:
+                raise ValueError(
+                    f"per-session batches in one dispatch must share shapes; leaf {leaf_idx}"
+                    f" has {sorted(shapes)} — send differently-shaped traffic in separate"
+                    " update_sessions calls"
+                )
+            pad = bucket - len(rows)
+            if pad:
+                rows.extend([rows[0]] * pad)  # values irrelevant: sentinel rows are dropped
+            out.append(jnp.asarray(np.stack(rows, axis=0)))
+        return tuple(out)
+
+    def _admit_for_update(self, session_id: Any) -> int:
+        table: LaneTable = self.__dict__["_table"]
+        lane = table.sessions.get(session_id)
+        return lane if lane is not None else self.admit(session_id)
+
+    # -------------------------------------------------------------- lifecycle
+    def admit(self, session_id: Any) -> int:
+        """Allocate a lane to ``session_id`` (growing capacity if needed);
+        returns the lane index. Idempotent for known sessions."""
+        table: LaneTable = self.__dict__["_table"]
+        if session_id in table.sessions:
+            return table.sessions[session_id]
+        if table.free == 0:
+            self.grow()
+        lane = table.allocate(session_id)
+        self._computed = None
+        obs.counter_inc("lanes.admissions")
+        obs.gauge_set("lanes.occupancy", table.active)
+        return lane
+
+    def evict(self, session_id: Any) -> int:
+        """Reclaim ``session_id``'s lane: the lane state is reset to defaults
+        (masked, shape-stable — no recompile) and returned to the free pool."""
+        table: LaneTable = self.__dict__["_table"]
+        lane = table.release(session_id)
+        self._reset_lane_indices([lane])
+        self._computed = None
+        obs.counter_inc("lanes.evictions")
+        obs.gauge_set("lanes.occupancy", table.active)
+        return lane
+
+    def evict_idle(self, idle_s: float) -> List[Any]:
+        """Evict every session idle longer than ``idle_s`` seconds; returns
+        the evicted session ids."""
+        idle = self.__dict__["_table"].idle_sessions(idle_s)
+        for sid in idle:
+            self.evict(sid)
+        return idle
+
+    def reset_session(self, session_id: Any) -> None:
+        """Reset one session's accumulated state to defaults WITHOUT releasing
+        its lane (the mask is data: no recompile)."""
+        table: LaneTable = self.__dict__["_table"]
+        self._reset_lane_indices([table.lane_of(session_id)])
+        table.stats["resets"] += 1
+        self._computed = None
+        obs.counter_inc("lanes.resets")
+
+    def _reset_lane_indices(self, lanes: Sequence[int]) -> None:
+        if not self._compiled_lanes:
+            inner = self.inner
+            for lane in lanes:
+                self.__dict__["_lane_states"][lane] = inner.init_state()
+                self.__dict__["_lane_counts"][lane] = 0
+            return
+        mask = np.zeros(self.capacity, dtype=bool)
+        mask[list(lanes)] = True
+        fn = self.__dict__.get("_reset_fn")
+        if fn is None:
+            inner = self.inner
+            cap = self.capacity
+            defaults = {f: self._stacked_default(d, cap) for f, d in inner._defaults.items()}
+            defaults["lane_updates"] = jnp.zeros((cap,), jnp.int32)
+
+            def body(states: Dict[str, Any], m: Any) -> Dict[str, Any]:
+                out = {}
+                for f, v in states.items():
+                    mm = m.reshape((-1,) + (1,) * (v.ndim - 1))
+                    out[f] = jnp.where(mm, defaults[f], v)
+                return out
+
+            fn = jax.jit(body)
+            self.__dict__["_reset_fn"] = fn
+        fields = self._inner_fields() + ["lane_updates"]
+        new_states = fn({f: self._state[f] for f in fields}, jnp.asarray(mask))
+        for f in fields:
+            self._state[f] = new_states[f]
+        self.__dict__["_state_escaped"] = True
+
+    def reset(self) -> None:
+        """Reset EVERY lane's state to defaults. Session→lane assignments are
+        kept (a service reset clears accumulators, not its routing table)."""
+        super().reset()
+        if not self._compiled_lanes:
+            inner = self.inner
+            self.__dict__["_lane_states"] = [inner.init_state() for _ in range(self.capacity)]
+            self.__dict__["_lane_counts"] = [0] * self.capacity
+
+    # ----------------------------------------------------------------- growth
+    def grow(self, new_capacity: Optional[int] = None) -> int:
+        """Grow lane capacity to ``new_capacity`` (default: the next
+        power-of-two bucket). Existing lanes keep their state bit-for-bit;
+        new lanes hold defaults. The executor keys executables on the state
+        signature, so the first post-growth dispatch resolves a NEW
+        executable — via the persistent disk store when
+        :meth:`prewarm_growth` (or a previous process) populated it."""
+        table: LaneTable = self.__dict__["_table"]
+        target = lane_capacity_bucket(table.capacity + 1 if new_capacity is None else new_capacity)
+        if target <= table.capacity:
+            return table.capacity
+        if self.max_capacity is not None and target > self.max_capacity:
+            raise TorchMetricsUserError(
+                f"cannot grow lanes to {target}: max_capacity={self.max_capacity}"
+                f" (active sessions: {table.active})"
+            )
+        self._grow_state(target)
+        table.grow(target)
+        obs.counter_inc("lanes.grows")
+        obs.gauge_set("lanes.capacity", target)
+        return target
+
+    def _grow_state(self, target: int) -> None:
+        old = self.capacity
+        if not self._compiled_lanes:
+            inner = self.inner
+            self.__dict__["_lane_states"].extend(inner.init_state() for _ in range(target - old))
+            self.__dict__["_lane_counts"].extend([0] * (target - old))
+            return
+        inner = self.inner
+        for f, default in inner._defaults.items():
+            stacked = self._stacked_default(default, target)
+            self._defaults[f] = stacked
+            self._state[f] = jnp.concatenate([self._state[f], stacked[old:]], axis=0)
+        self._defaults["lane_updates"] = jnp.zeros((target,), jnp.int32)
+        self._state["lane_updates"] = jnp.concatenate(
+            [self._state["lane_updates"], jnp.zeros((target - old,), jnp.int32)]
+        )
+        self.__dict__["_state_escaped"] = True
+        self.__dict__["_reset_fn"] = None  # capacity-shaped closures rebuild lazily
+        self.__dict__["_lane_compute_fn"] = None
+        # invalidate the executor's memoized state signature (ops/executor.py
+        # _state_sig): the stacked layout just changed shape
+        self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
+
+    def prewarm_growth(
+        self,
+        batch_specs: Any,
+        rows: Union[int, Sequence[int]],
+        levels: int = 1,
+    ) -> Dict[str, Any]:
+        """Precompile the update executables the NEXT ``levels`` capacity
+        rungs will need, so live growth is a cached (persisted) load instead
+        of a foreground compile.
+
+        ``batch_specs`` describes ONE session's batch — a tuple of example
+        arrays or ``jax.ShapeDtypeStruct`` leaves WITHOUT the row axis;
+        ``rows`` lists the dispatch row-bucket sizes to warm (each is rounded
+        up the executor's bucket ladder). A detached clone grown to each rung
+        traces and persists through the executor's warmup machinery
+        (``ops/compile_cache.py``); the entries are keyed by state signature,
+        so this instance's post-growth dispatch loads them from the store.
+        Requires compile-ahead (``TORCHMETRICS_TPU_COMPILE_AHEAD``) — returns
+        a report with ``skipped`` reasons otherwise.
+        """
+        import copy
+
+        from torchmetrics_tpu.ops import compile_cache
+        from torchmetrics_tpu.ops.executor import bucket_size
+
+        report: Dict[str, Any] = {"warmed": 0, "already_warm": 0, "skipped": [], "rungs": []}
+        if not self._compiled_lanes:
+            report["skipped"].append("eager lane mode (list states): nothing to compile")
+            return report
+        if not compile_cache.compile_ahead_enabled():
+            report["skipped"].append("compile-ahead disabled: grown executables cannot persist")
+            return report
+        if isinstance(rows, int):
+            rows = [rows]
+        if not isinstance(batch_specs, tuple):
+            batch_specs = (batch_specs,)
+        rung = self.capacity
+        for _ in range(int(levels)):
+            rung = lane_capacity_bucket(rung + 1)
+            if self.max_capacity is not None and rung > self.max_capacity:
+                report["skipped"].append(f"rung {rung} exceeds max_capacity {self.max_capacity}")
+                break
+            clone = copy.deepcopy(self)
+            clone.__dict__["_table"] = LaneTable(self.capacity)
+            clone._grow_state(rung)
+            clone.__dict__["_table"].grow(rung)
+            specs = []
+            for r in rows:
+                rb = bucket_size(int(r))
+                spec_leaves = [jax.ShapeDtypeStruct((rb,), jnp.int32)]
+                for leaf in batch_specs:
+                    shape = tuple(leaf.shape) if hasattr(leaf, "shape") else tuple(np.shape(leaf))
+                    dtype = leaf.dtype if hasattr(leaf, "dtype") else jnp.asarray(leaf).dtype
+                    spec_leaves.append(jax.ShapeDtypeStruct((rb,) + shape, dtype))
+                specs.append(tuple(spec_leaves))
+            sub = clone.warmup(specs, ladder=False)
+            report["rungs"].append({"capacity": rung, **{k: sub[k] for k in ("warmed", "already_warm")}})
+            report["warmed"] += sub["warmed"]
+            report["already_warm"] += sub["already_warm"]
+            report["skipped"].extend(sub["skipped"])
+        compile_cache.drain_worker(60)  # persisted entries must land before growth needs them
+        return report
+
+    # ------------------------------------------------------------- read paths
+    def _active_mask(self) -> jnp.ndarray:
+        return jnp.asarray(self.__dict__["_table"].active_mask())
+
+    def compute(self) -> Any:
+        """All-lane aggregate: fold ACTIVE lanes per declared reduction
+        (inactive lanes contribute the family's identity element —
+        ``parallel.sync.reduction_identity``), then the inner compute."""
+        inner = self.inner
+        table: LaneTable = self.__dict__["_table"]
+        if table.active == 0:
+            return inner.functional_compute(inner.init_state())
+        if not self._compiled_lanes:
+            return inner.functional_compute(self._fold_eager())
+        folded = self._fold_lanes({f: self._state[f] for f in self._inner_fields()}, self._active_mask())
+        return inner.functional_compute(folded)
+
+    def _fold_lanes(self, states: Dict[str, Any], mask: jnp.ndarray) -> Dict[str, Any]:
+        inner = self.inner
+        n_active = jnp.maximum(mask.sum(), 1)
+        out: Dict[str, Any] = {}
+        for f, v in states.items():
+            fx = inner._reductions.get(f)
+            if callable(fx) or fx in ("cat", None):
+                # custom reductions have no derivable identity; "cat"/None on
+                # array states stack per contributor (order/shape-dependent)
+                raise TorchMetricsUserError(
+                    f"all-lane aggregate is undefined for {fx!r} reduction on field {f!r};"
+                    " read per-lane values via lane_values()"
+                )
+            ident = reduction_identity(fx, v.dtype)
+            m = mask.reshape((-1,) + (1,) * (v.ndim - 1))
+            masked = jnp.where(m, v, ident)
+            if fx == "sum":
+                out[f] = masked.sum(0)
+            elif fx == "mean":
+                out[f] = masked.sum(0) / n_active.astype(v.dtype)
+            elif fx == "max":
+                out[f] = masked.max(0)
+            else:
+                out[f] = masked.min(0)
+        return out
+
+    def _fold_eager(self) -> Dict[str, Any]:
+        inner = self.inner
+        table: LaneTable = self.__dict__["_table"]
+        lanes = sorted(table.sessions.values())
+        folded = None
+        for lane in lanes:
+            st = self.__dict__["_lane_states"][lane]
+            folded = st if folded is None else inner.merge_states(folded, st)
+        return folded
+
+    def lane_values(self) -> Dict[Any, Any]:
+        """Per-lane ``compute()`` for every active session: one vmapped
+        compute over the stacked state, indexed back per session."""
+        self._fold_pending()  # a sharded (deferred) restore folds first
+        table: LaneTable = self.__dict__["_table"]
+        if not table.sessions:
+            return {}
+        if not self._compiled_lanes:
+            inner = self.inner
+            return {
+                sid: inner.functional_compute(self.__dict__["_lane_states"][lane])
+                for sid, lane in table.sessions.items()
+            }
+        fn = self.__dict__.get("_lane_compute_fn")
+        if fn is None:
+            inner = self.inner
+
+            def body(states: Dict[str, Any]) -> Any:
+                return jax.vmap(inner.functional_compute)(states)
+
+            fn = jax.jit(body)
+            self.__dict__["_lane_compute_fn"] = fn
+        with obs.span(obs.SPAN_COMPUTE, suffix=f"Laned{type(self.inner).__name__}"):
+            vals = fn({f: self._state[f] for f in self._inner_fields()})
+        return {
+            sid: jax.tree_util.tree_map(lambda v: v[lane], vals)
+            for sid, lane in table.sessions.items()
+        }
+
+    def compute_session(self, session_id: Any) -> Any:
+        """One session's ``compute()`` value."""
+        self._fold_pending()
+        table: LaneTable = self.__dict__["_table"]
+        lane = table.lane_of(session_id)
+        inner = self.inner
+        if not self._compiled_lanes:
+            return inner.functional_compute(self.__dict__["_lane_states"][lane])
+        state = {f: self._state[f][lane] for f in self._inner_fields()}
+        return inner.functional_compute(state)
+
+    # ------------------------------------------------------------- durability
+    def _export_extras(self) -> Dict[str, Any]:
+        """Host-side metadata a recovery-reused snapshot must carry alongside
+        the array states (ops/executor.py ``latest_recovery_snapshot``)."""
+        return {self._LANE_DIR_KEY: _encode_directory(self.__dict__["_table"])}
+
+    def state(self) -> Dict[str, Any]:
+        """Stacked state export carrying the session→lane directory under the
+        reserved ``"_lane_directory"`` key (a uint8 JSON blob the snapshot
+        store persists as an ordinary leaf), so ``save_state``/``restore_state``
+        round-trip routing as well as accumulators."""
+        if self._compiled_lanes:
+            out = super().state()
+            out.update(self._export_extras())
+            return out
+        table: LaneTable = self.__dict__["_table"]
+        out = {
+            f"lane_{i:05d}": {**self.__dict__["_lane_states"][i], self._STATE_COUNT_KEY: self.__dict__["_lane_counts"][i]}
+            for i in range(table.capacity)
+        }
+        out["_lanes"] = {self._LANE_DIR_KEY: _encode_directory(table)}
+        return out
+
+    def load_state(
+        self,
+        state: Dict[str, Any],
+        update_count: Optional[int] = None,
+        validate: str = "strict",
+        check_finite: bool = False,
+        sharded: Optional[bool] = None,
+    ) -> None:
+        """Install a laned export: re-registers capacity from the carried
+        directory, routes through the inherited validated restore, then
+        verifies every lane (directory within capacity, no double-assigned
+        lanes, non-negative per-lane counts; ``check_finite=True`` names
+        poisoned lanes individually)."""
+        if not isinstance(state, dict):
+            raise StateCorruptionError(f"{type(self).__name__}: state must be a dict, got {type(state).__name__}")
+        state = dict(state)
+        if not self._compiled_lanes:
+            self._load_state_eager(state, validate=validate, check_finite=check_finite)
+            return
+        blob = state.pop(self._LANE_DIR_KEY, None)
+        table = _decode_directory(blob) if blob is not None else None
+        if sharded is None:
+            sharded = state.get(self._STATE_SHARDS_KEY) is not None
+        cap = self._infer_capacity(state, sharded=bool(sharded))
+        if table is not None and validate != "off" and table.capacity != cap:
+            raise StateCorruptionError(
+                f"{type(self).__name__}: lane directory says capacity {table.capacity} but state"
+                f" arrays carry {cap} lanes"
+            )
+        if cap != self.capacity:
+            self._respec_capacity(cap)
+        # the stacked-lane finite scan runs per-lane below (naming poisoned
+        # lanes); the sharded layout keeps the inherited per-shard scan
+        super().load_state(
+            state,
+            update_count=update_count,
+            validate=validate,
+            check_finite=check_finite and bool(sharded),
+            sharded=sharded,
+        )
+        if table is not None:
+            self.__dict__["_table"] = table
+        self._validate_lanes(check_finite=check_finite, sharded=bool(sharded), mode=validate)
+        obs.gauge_set("lanes.capacity", self.capacity)
+        obs.gauge_set("lanes.occupancy", self.__dict__["_table"].active)
+
+    def _infer_capacity(self, state: Dict[str, Any], sharded: bool) -> int:
+        axis = 1 if sharded else 0
+        for f in self._inner_fields() + ["lane_updates"]:
+            v = state.get(f)
+            if v is None:
+                continue
+            shape = np.shape(v)
+            if len(shape) > axis:
+                return int(shape[axis])
+        raise StateCorruptionError(f"{type(self).__name__}: no state field carries a lane axis")
+
+    def _respec_capacity(self, capacity: int) -> None:
+        """Re-register the stacked defaults (and fresh states) at ``capacity``
+        — the restore path's analogue of :meth:`grow`, also used to shrink
+        back to a smaller checkpoint's layout."""
+        inner = self.inner
+        for f, default in inner._defaults.items():
+            stacked = self._stacked_default(default, capacity)
+            self._defaults[f] = stacked
+            self._state[f] = stacked
+        self._defaults["lane_updates"] = jnp.zeros((capacity,), jnp.int32)
+        self._state["lane_updates"] = jnp.zeros((capacity,), jnp.int32)
+        self.__dict__["_state_escaped"] = True
+        self.__dict__["_reset_fn"] = None
+        self.__dict__["_lane_compute_fn"] = None
+        self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
+        table: LaneTable = self.__dict__["_table"]
+        if capacity != table.capacity:
+            self.__dict__["_table"] = LaneTable(capacity)
+
+    def _validate_lanes(self, check_finite: bool, sharded: bool, mode: str) -> None:
+        """Per-lane restore validation (docs/LANES.md "Durability")."""
+        table: LaneTable = self.__dict__["_table"]
+        if mode != "off":
+            if table.capacity != self.capacity:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: directory capacity {table.capacity} !="
+                    f" state capacity {self.capacity}"
+                )
+            counts = np.asarray(self._state["lane_updates"])
+            if sharded:
+                counts = counts.sum(axis=0)
+            if counts.ndim != 1 or counts.shape[0] != self.capacity:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: lane_updates has shape {counts.shape},"
+                    f" expected ({self.capacity},)"
+                )
+            bad = np.flatnonzero(counts < 0)
+            if bad.size:
+                raise StateCorruptionError(
+                    f"{type(self).__name__}: negative per-lane update counts in lane(s)"
+                    f" {[int(b) for b in bad[:8]]}"
+                )
+        if check_finite and not sharded:
+            # the stacked lane layout shares the sharded per-shard scan: a
+            # poisoned lane is NAMED instead of failing the whole array
+            for f in self._inner_fields():
+                self._check_field_finite(f, self._state[f], per_shard=True)
+
+    def _load_state_eager(self, state: Dict[str, Any], validate: str, check_finite: bool) -> None:
+        inner = self.inner
+        lanes_meta = state.pop("_lanes", None)
+        blob = (lanes_meta or {}).get(self._LANE_DIR_KEY)
+        table = _decode_directory(blob) if blob is not None else None
+        lane_keys = sorted(k for k in state if isinstance(k, str) and k.startswith("lane_"))
+        if not lane_keys:
+            raise StateCorruptionError(f"{type(self).__name__}: export holds no lane_* states")
+        capacity = len(lane_keys)
+        if table is not None and validate != "off" and table.capacity != capacity:
+            raise StateCorruptionError(
+                f"{type(self).__name__}: lane directory says capacity {table.capacity} but export"
+                f" holds {capacity} lanes"
+            )
+        staged, counts = [], []
+        for key in lane_keys:
+            sub = dict(state[key])
+            count = int(np.asarray(sub.get(self._STATE_COUNT_KEY, 0)))
+            try:
+                checked = inner.validate_state(sub, mode=validate, check_finite=check_finite)
+            except StateCorruptionError as err:
+                raise StateCorruptionError(f"{type(self).__name__}: {key}: {err}") from err
+            staged.append(
+                {
+                    f: (list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
+                    for f, v in checked.items()
+                    if f in inner._defaults
+                }
+            )
+            counts.append(count)
+        self.__dict__["_lane_states"] = staged
+        self.__dict__["_lane_counts"] = counts
+        if table is not None:
+            self.__dict__["_table"] = table
+        elif capacity != self.capacity:
+            self.__dict__["_table"] = LaneTable(capacity)
+        self._computed = None
+        self._update_count = self._restored_count(None, fallback=max(counts) if counts else 1)
+
+    # --------------------------------------------------------------- plumbing
+    def __getstate__(self) -> Dict[str, Any]:
+        out = super().__getstate__()
+        # capacity-shaped jitted closures are process-local; rebuilt lazily
+        out["_reset_fn"] = None
+        out["_lane_compute_fn"] = None
+        return out
+
+    def __repr__(self) -> str:
+        table: LaneTable = self.__dict__["_table"]
+        return (
+            f"LanedMetric({type(self.inner).__name__}, capacity={table.capacity},"
+            f" active={table.active})"
+        )
+
+
+class LanedCollection:
+    """Session lanes over a whole metric suite: every member is a
+    :class:`LanedMetric` sharing ONE session→lane table, and a round of
+    traffic advances all of them through the collection's fused executor —
+    one compiled, donated dispatch per round for the entire suite.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MaxMetric, SumMetric
+        >>> from torchmetrics_tpu.lanes import LanedCollection
+        >>> lc = LanedCollection({"s": SumMetric(), "m": MaxMetric()}, capacity=8)
+        >>> lc.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([5.0, 7.0]))])
+        1
+        >>> {k: float(v) for k, v in sorted(lc.lane_values()["a"].items())}
+        {'m': 2.0, 's': 3.0}
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Dict[str, Metric], Sequence[Metric], "Any"],
+        capacity: int = DEFAULT_CAPACITY,
+        max_capacity: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        from torchmetrics_tpu.collections import MetricCollection
+
+        if isinstance(metrics, MetricCollection):
+            metrics = {name: m for name, m in metrics.items(keep_base=True)}
+        elif isinstance(metrics, Metric):
+            metrics = {type(metrics).__name__: metrics}
+        elif not isinstance(metrics, dict):
+            named: Dict[str, Metric] = {}
+            for m in metrics:
+                name = type(m).__name__
+                if name in named:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                named[name] = m
+            metrics = named
+        capacity = lane_capacity_bucket(capacity)
+        self._table = LaneTable(capacity)
+        self._members: Dict[str, LanedMetric] = {
+            name: LanedMetric(m, capacity=capacity, max_capacity=max_capacity, table=self._table, **kwargs)
+            for name, m in metrics.items()
+        }
+        self.collection = MetricCollection(dict(self._members))
+        self.max_capacity = None if max_capacity is None else lane_capacity_bucket(max_capacity)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def capacity(self) -> int:
+        return self._table.capacity
+
+    @property
+    def sessions(self) -> Dict[Any, int]:
+        return dict(self._table.sessions)
+
+    @property
+    def lane_status(self) -> Dict[str, Any]:
+        return {
+            "capacity": self._table.capacity,
+            "active": self._table.active,
+            "free": self._table.free,
+            "max_capacity": self.max_capacity,
+            "members": sorted(self._members),
+            **self._table.stats,
+        }
+
+    @property
+    def executor_status(self) -> Dict[str, Any]:
+        return self.collection.executor_status
+
+    @property
+    def update_count(self) -> int:
+        return self.collection.update_count
+
+    def keys(self) -> Iterable[str]:
+        return self._members.keys()
+
+    def __getitem__(self, name: str) -> LanedMetric:
+        return self._members[name]
+
+    # ----------------------------------------------------------------- router
+    def update_sessions(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
+        """Pack ``(session_id, batch)`` traffic and advance EVERY member with
+        one fused collection dispatch per round (see
+        :meth:`LanedMetric.update_sessions`). Returns the dispatch count."""
+        from torchmetrics_tpu.ops.executor import bucket_size
+
+        if isinstance(items, dict):
+            items = list(items.items())
+        rounds = _pack_rounds(items)
+        dispatches = 0
+        for round_items in rounds:
+            lanes = [self.admit(sid) for sid, _ in round_items]
+            rows = len(round_items)
+            bucket = bucket_size(rows)
+            sentinel = self.capacity
+            lane_ids = jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32)
+            batch = LanedMetric._stack_rows([b for _, b in round_items], bucket)
+            with obs.span(obs.SPAN_LANES, owner="LanedCollection", rows=rows, bucket=bucket):
+                self.collection.update(lane_ids, *batch)
+            self._table.touch(lanes)
+            obs.counter_inc("lanes.dispatches")
+            obs.counter_inc("lanes.rows", rows)
+            dispatches += 1
+        return dispatches
+
+    # -------------------------------------------------------------- lifecycle
+    def admit(self, session_id: Any) -> int:
+        if session_id in self._table.sessions:
+            return self._table.sessions[session_id]
+        if self._table.free == 0:
+            self.grow()
+        lane = self._table.allocate(session_id)
+        for m in self._members.values():
+            m._computed = None
+        obs.counter_inc("lanes.admissions")
+        obs.gauge_set("lanes.occupancy", self._table.active)
+        return lane
+
+    def evict(self, session_id: Any) -> int:
+        lane = self._table.release(session_id)
+        for m in self._members.values():
+            m._reset_lane_indices([lane])
+            m._computed = None
+        obs.counter_inc("lanes.evictions")
+        obs.gauge_set("lanes.occupancy", self._table.active)
+        return lane
+
+    def evict_idle(self, idle_s: float) -> List[Any]:
+        idle = self._table.idle_sessions(idle_s)
+        for sid in idle:
+            self.evict(sid)
+        return idle
+
+    def reset_session(self, session_id: Any) -> None:
+        lane = self._table.lane_of(session_id)
+        for m in self._members.values():
+            m._reset_lane_indices([lane])
+            m._computed = None
+        self._table.stats["resets"] += 1
+        obs.counter_inc("lanes.resets")
+
+    def reset(self) -> None:
+        self.collection.reset()
+
+    def grow(self, new_capacity: Optional[int] = None) -> int:
+        target = lane_capacity_bucket(self._table.capacity + 1 if new_capacity is None else new_capacity)
+        if target <= self._table.capacity:
+            return self._table.capacity
+        if self.max_capacity is not None and target > self.max_capacity:
+            raise TorchMetricsUserError(f"cannot grow lanes to {target}: max_capacity={self.max_capacity}")
+        for m in self._members.values():
+            m._grow_state(target)
+        self._table.grow(target)
+        obs.counter_inc("lanes.grows")
+        obs.gauge_set("lanes.capacity", target)
+        return target
+
+    # ------------------------------------------------------------- read paths
+    def compute(self) -> Dict[str, Any]:
+        """All-lane aggregate per member (the collection's renamed dict)."""
+        return self.collection.compute()
+
+    def lane_values(self) -> Dict[Any, Dict[str, Any]]:
+        """``{session_id: {member_name: value}}`` for every active session."""
+        per_member = {name: m.lane_values() for name, m in self._members.items()}
+        out: Dict[Any, Dict[str, Any]] = {}
+        for sid in self._table.sessions:
+            out[sid] = {name: vals[sid] for name, vals in per_member.items()}
+        return out
+
+    def compute_session(self, session_id: Any) -> Dict[str, Any]:
+        return {name: m.compute_session(session_id) for name, m in self._members.items()}
+
+    # ------------------------------------------------------------- durability
+    def state(self) -> Dict[str, Any]:
+        return self.collection.state()
+
+    def state_spec(self) -> Dict[str, Any]:
+        return self.collection.state_spec()
+
+    def load_state(
+        self,
+        states: Dict[str, Any],
+        update_count: Optional[int] = None,
+        validate: str = "strict",
+        check_finite: bool = False,
+        sharded: Optional[bool] = None,
+    ) -> None:
+        """Restore every member, then re-link them onto ONE shared table
+        (each member's restore decoded its own directory copy)."""
+        self.collection.load_state(
+            states, update_count=update_count, validate=validate, check_finite=check_finite, sharded=sharded
+        )
+        tables = [m.__dict__["_table"] for m in self._members.values()]
+        first = tables[0]
+        for t in tables[1:]:
+            if t.sessions != first.sessions or t.capacity != first.capacity:
+                raise StateCorruptionError(
+                    "restored members disagree on the session->lane directory;"
+                    " the snapshot does not describe one coherent laned collection"
+                )
+        self._table = first
+        for m in self._members.values():
+            m.__dict__["_table"] = first
+
+    def add_update_observer(self, callback: Callable[[Any], None]) -> Callable[[], None]:
+        return self.collection.add_update_observer(callback)
+
+    def warmup(self, *args: Any, **kwargs: Any) -> Any:
+        return self.collection.warmup(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return (
+            f"LanedCollection({sorted(self._members)}, capacity={self._table.capacity},"
+            f" active={self._table.active})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# deferred-reduction composition: the lane axis stacks INSIDE the shard
+# ---------------------------------------------------------------------------
+
+
+class DeferredLaneStep:
+    """Zero-collective laned accumulation on a mesh (docs/SHARDING.md meets
+    docs/LANES.md): state is ``(num_shards, lanes, *field)`` — the lane axis
+    stacked INSIDE each device's shard — every dispatch scatters its rows
+    into the local lane copies with no rendezvous, and :meth:`reduce` applies
+    each declared ``dist_reduce_fx`` across shards exactly once, yielding the
+    replicated per-lane states the read paths consume.
+
+    Built by :func:`make_deferred_lane_step`; the laned metric must be in
+    compiled-lane mode (fixed-shape states).
+    """
+
+    def __init__(self, laned: LanedMetric, mesh: Any, axis_name: str, donate: bool) -> None:
+        if not laned._compiled_lanes:
+            raise TorchMetricsUserError(
+                "deferred lane accumulation needs fixed-shape lane states (no list/'cat' states)"
+            )
+        self._laned = laned
+        self._mesh = mesh
+        self._axis = axis_name
+        self._donate = donate
+        self._spec = laned.sharded_state_spec(axis_name)
+        self._compiled: Dict[Any, Callable] = {}
+
+    def init_states(self):
+        """Fresh sharded laned states placed on the mesh."""
+        from jax.sharding import NamedSharding
+
+        states = self._laned.init_sharded_state(len(self._mesh.devices.flatten()))
+        shardings = jax.tree_util.tree_map(lambda sp: NamedSharding(self._mesh, sp), self._spec)
+        return jax.device_put(states, shardings)
+
+    def _get(self, key: Any, builder: Callable[[], Callable]) -> Callable:
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = builder()
+            self._compiled[key] = fn
+        return fn
+
+    def local_step(self, states, lane_ids, *batch):
+        """One donated dispatch: each device scatters ITS rows into ITS local
+        lane copies — zero collectives. ``lane_ids`` and every batch leaf are
+        sharded along the mesh axis on their leading row dim (row count must
+        divide the mesh size; the router's power-of-two padding guarantees
+        it)."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import reshard_local_state, shard_map_compat, unshard_local_state
+
+        laned = self._laned
+
+        def build():
+            def body(st, ids, *b):
+                local = laned.functional_update(unshard_local_state(st), ids, *b)
+                return reshard_local_state(local)
+
+            in_specs = (self._spec, P(self._axis)) + tuple(P(self._axis) for _ in batch)
+            mapped = shard_map_compat(body, self._mesh, in_specs, self._spec)
+            return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
+
+        fn = self._get(("local", len(batch)), build)
+        with obs.span(obs.SPAN_LANES, owner=type(laned.inner).__name__, deferred=True):
+            return fn(states, lane_ids, *batch)
+
+    def reduce(self, states):
+        """The single deferred rendezvous: fold the shard axis per declared
+        reduction, returning replicated per-lane states ``(lanes, *field)``."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import shard_map_compat
+
+        laned = self._laned
+
+        def build():
+            return jax.jit(
+                shard_map_compat(
+                    lambda st: laned.reduce_sharded_state(st, self._axis), self._mesh, (self._spec,), P()
+                )
+            )
+
+        fn = self._get("reduce", build)
+        with obs.span(obs.SPAN_REDUCE, owner=type(laned.inner).__name__, kind="lanes"):
+            return fn(states)
+
+    def install_reduced(self, states) -> None:
+        """Install reduced per-lane states into the laned metric so its read
+        paths (``lane_values``/``compute``/checkpointing) serve them."""
+        laned = self._laned
+        reduced = dict(states)
+        new_state = dict(laned._state)
+        new_state.update({k: jnp.asarray(v) for k, v in reduced.items() if k in laned._defaults})
+        object.__setattr__(laned, "_state", new_state)
+        laned.__dict__["_state_escaped"] = True
+        laned.__dict__["_reduced"] = True
+        laned.__dict__["_pending_shards"] = None
+        laned._computed = None
+
+
+def make_deferred_lane_step(
+    laned: LanedMetric, mesh: Any, axis_name: str = "batch", donate: bool = True
+) -> DeferredLaneStep:
+    """Compile the deferred-reduction lane loop for ``laned`` on ``mesh``
+    (see :class:`DeferredLaneStep`)."""
+    return DeferredLaneStep(laned, mesh, axis_name, donate)

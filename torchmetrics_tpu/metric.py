@@ -1372,6 +1372,15 @@ class Metric:
         """Deep copy of the metric (reference metric.py:696-698)."""
         return copy.deepcopy(self)
 
+    def laned(self, capacity: int = 8, max_capacity: Optional[int] = None, **kwargs: Any) -> Any:
+        """A :class:`~torchmetrics_tpu.lanes.LanedMetric` stacking N
+        independent copies of this metric's state along a lane axis, one
+        compiled dispatch advancing every active session (docs/LANES.md).
+        The wrapper holds a detached clone; this instance is untouched."""
+        from torchmetrics_tpu.lanes import LanedMetric
+
+        return LanedMetric(self, capacity=capacity, max_capacity=max_capacity, **kwargs)
+
     def persistent(self, mode: bool = False) -> None:
         """Toggle persistence of all states (reference metric.py:840-843)."""
         for key in self._persistent:

@@ -35,6 +35,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_COMPUTE,
     SPAN_DISPATCH,
     SPAN_EXPORT,
+    SPAN_LANES,
     SPAN_NAMES,
     SPAN_PAD,
     SPAN_REDUCE,

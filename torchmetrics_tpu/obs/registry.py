@@ -24,9 +24,8 @@ Everything respects the master switch (``TORCHMETRICS_TPU_TELEMETRY=0`` makes
 :func:`counter_inc`/:func:`breadcrumb` no-ops); snapshot/dump always work so a
 disabled process can still report "telemetry was off".
 
-Duration convention: every duration key ends in ``_us`` (microseconds).
-``compile_ms_total`` survives one release as a deprecated alias of
-``compile_us_total`` in executor stats (docs/OBSERVABILITY.md).
+Duration convention: every duration key ends in ``_us`` (microseconds); the
+one-release ``compile_ms_total`` alias is gone (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 

@@ -74,6 +74,7 @@ SPAN_CKPT_RESTORE = "tm_tpu.checkpoint.restore"  # snapshot load + validate
 SPAN_AUTOSAVE = "tm_tpu.autosave"          # Autosaver tick (host copy on hot path)
 SPAN_WARMUP = "tm_tpu.warmup"              # warmup API precompiles
 SPAN_EXPORT = "tm_tpu.export"              # telemetry export itself (allowlisted blocking)
+SPAN_LANES = "tm_tpu.lanes.dispatch"       # lane-batched multi-session dispatch (pack+scatter)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -91,6 +92,7 @@ SPAN_NAMES = (
     SPAN_AUTOSAVE,
     SPAN_WARMUP,
     SPAN_EXPORT,
+    SPAN_LANES,
 )
 
 

@@ -449,8 +449,7 @@ def _new_stats() -> Dict[str, Any]:
         "disk_evictions": 0,      # persisted entries that failed at dispatch and were dropped
         "background_compiles": 0, # cold keys compiled on the worker and swapped in warm
         "eager_misses": 0,        # calls served eagerly while their compile ran in background
-        # duration keys standardize on _us (ISSUE 6 satellite); stats_dict()
-        # still emits compile_ms_total as a deprecated alias for one release
+        # duration keys standardize on _us (ISSUE 6 satellite)
         "compile_us_total": 0.0,  # wall-clock spent in cold (trace+compile) dispatches
         "warmup": 0,              # executables precompiled through the warmup API
     }
@@ -479,6 +478,7 @@ class _ExecutorBase:
         self._bg_compile: Optional[bool] = None  # None -> env default
         self._profile: Dict[str, Dict[str, Any]] = {}  # replayable shape specs
         self._profile_keys: set = set()  # cache keys already profiled (O(1) warm-path gate)
+        self._state_sig_memo: Any = None  # (layout_version, sig) — see _state_sig
         # most recent committed donating call's host-side recovery snapshot,
         # kept so the Autosaver (io/checkpoint.py) can serialize it instead of
         # fetching the live state again — zero extra device sync per autosave.
@@ -971,8 +971,6 @@ class _ExecutorBase:
 
     def stats_dict(self) -> Dict[str, Any]:
         out = dict(self.stats)
-        # deprecated alias (one release): duration keys standardized on _us
-        out["compile_ms_total"] = out["compile_us_total"] / 1e3
         out["disabled_reason"] = self.disabled_reason
         out["fallback_reason"] = self.disabled_reason
         out["bucketing_enabled"] = self._bucketing_ok
@@ -1034,6 +1032,10 @@ class MetricExecutor(_ExecutorBase):
         if not self._bucketing_ok:
             return False
         m = self._metric
+        # a metric can declare its update non-row-additive (laned scatter
+        # updates route rows to lanes — duplicating row 0 would double-scatter)
+        if getattr(m, "_executor_bucketable", True) is False:
+            return False
         for field, fx in m._reductions.items():
             if fx not in _FUSABLE_REDUCTIONS:
                 return False
@@ -1055,7 +1057,13 @@ class MetricExecutor(_ExecutorBase):
             f"{k}:{jnp.asarray(v).dtype}:{tuple(np.shape(v))}:{m._reductions.get(k)}"
             for k, v in m._defaults.items()
         )
-        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}"
+        # wrappers whose computation depends on an INNER metric (LanedMetric
+        # vmaps inner.functional_update) contribute that identity too — two
+        # wrappers with identical state specs but different inner updates must
+        # never share a persisted executable
+        extra = getattr(m, "_executor_identity", None)
+        ident = f"|inner={extra()}" if callable(extra) else ""
+        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}{ident}"
 
     def _key_desc(self, key: Any) -> str:
         return "|".join(
@@ -1067,6 +1075,31 @@ class MetricExecutor(_ExecutorBase):
                 "donate=0",
             )
         )
+
+    def _state_sig(self) -> Tuple[Any, ...]:
+        """Shape/dtype signature of the registered state — part of every cache
+        key so a metric whose state layout can change at runtime (a LanedMetric
+        growing its lane capacity) resolves to a NEW executable through
+        ``_get_fn`` (and so the persistent disk store / warmed entries) instead
+        of silently retracing inside a stale cached ``jax.jit`` callable.
+
+        Memoized per ``_state_layout_version`` — ``_defaults`` is immutable
+        after ``add_state`` for every metric except the laned wrappers, which
+        bump the version on every growth/respec — so the steady dispatch path
+        pays one integer getattr, not a rebuilt shape/dtype tuple per call."""
+        ver = getattr(self._metric, "_state_layout_version", 0)
+        cached = self._state_sig_memo
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        sig = (
+            ver,
+            tuple(
+                (k, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
+                for k, v in self._metric._defaults.items()
+            ),
+        )
+        self._state_sig_memo = (ver, sig)
+        return sig
 
     def _clone_owner(self):
         """A fully-detached deep copy of the metric for off-main-thread
@@ -1134,7 +1167,7 @@ class MetricExecutor(_ExecutorBase):
         treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
         zero_state = {k: jnp.zeros(np.shape(v), jnp.asarray(v).dtype) for k, v in m._defaults.items()}
         if kind == "update":
-            key = ("u", treedef, sig, batched, bucket if padded else None)
+            key = ("u", treedef, sig, batched, bucket if padded else None, self._state_sig())
 
             def build(metric=None):
                 return self._build_update(treedef, batched, bucket, padded, bool_spec, n_leaves, metric=metric)
@@ -1144,7 +1177,7 @@ class MetricExecutor(_ExecutorBase):
             if not self._plain_forward or m.dist_sync_on_step:
                 return "forward: not fusable (custom forward or dist_sync_on_step)"
             variant = "reduce" if m.full_state_update is False else "full"
-            key = ("f", variant, treedef, sig, batched, bucket if padded else None)
+            key = ("f", variant, treedef, sig, batched, bucket if padded else None, self._state_sig())
 
             def build(metric=None):
                 return self._build_forward(treedef, batched, bucket, padded, variant, bool_spec, n_leaves, metric=metric)
@@ -1285,7 +1318,7 @@ class MetricExecutor(_ExecutorBase):
         treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
         m = self._metric
 
-        key = ("u", treedef, sig, batched, bucket if padded else None)
+        key = ("u", treedef, sig, batched, bucket if padded else None, self._state_sig())
         self._record_profile(key, "update", args, kwargs)
         state = {k: m._state[k] for k in m._defaults}
 
@@ -1399,7 +1432,7 @@ class MetricExecutor(_ExecutorBase):
         m = self._metric
         variant = "reduce" if m.full_state_update is False else "full"
 
-        key = ("f", variant, treedef, sig, batched, bucket if padded else None)
+        key = ("f", variant, treedef, sig, batched, bucket if padded else None, self._state_sig())
         self._record_profile(key, "forward", args, kwargs)
         state = {k: m._state[k] for k in m._defaults}
 
@@ -1582,6 +1615,33 @@ class CollectionExecutor(_ExecutorBase):
             )
         )
 
+    def _state_sig(self) -> Tuple[Any, ...]:
+        """Per-leader state shape/dtype signature (see MetricExecutor._state_sig):
+        a member whose state layout changes at runtime (laned capacity growth)
+        must key a new fused executable, not retrace inside a stale one.
+        Memoized per member ``_state_layout_version`` tuple (a handful of
+        integer getattrs per call, vs rebuilding every member's shape/dtype
+        tuple per dispatch)."""
+        vers = tuple(
+            getattr(m, "_state_layout_version", 0) for _, m, _ in self._leaders()
+        )
+        cached = self._state_sig_memo
+        if cached is not None and cached[0] == vers:
+            return cached[1]
+        sig = tuple(
+            (
+                name,
+                ver,
+                tuple(
+                    (k, tuple(np.shape(v)), str(getattr(v, "dtype", type(v).__name__)))
+                    for k, v in m._defaults.items()
+                ),
+            )
+            for ver, (name, m, _) in zip(vers, self._leaders())
+        )
+        self._state_sig_memo = (vers, sig)
+        return sig
+
     def _clone_owner(self):
         """A fully-detached deep copy of the collection (every member's
         ``__getstate__`` rebuilds its wrapped methods around the copy), with
@@ -1660,7 +1720,7 @@ class CollectionExecutor(_ExecutorBase):
         treedef, call_leaves, sig, batched, bucket, n, padded, bool_spec, n_leaves = prep
         kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
         if kind == "update":
-            key = ("u", treedef, sig, batched, bucket if padded else None, kw_map)
+            key = ("u", treedef, sig, batched, bucket if padded else None, kw_map, self._state_sig())
 
             def builder(coll=None):
                 specs = [
@@ -1674,7 +1734,7 @@ class CollectionExecutor(_ExecutorBase):
             reason = self._forward_unfusable_reason(leader_execs)
             if reason is not None:
                 return f"forward: {reason}"
-            key = ("f", treedef, sig, batched, bucket if padded else None, kw_map)
+            key = ("f", treedef, sig, batched, bucket if padded else None, kw_map, self._state_sig())
 
             def builder(coll=None):
                 specs = [
@@ -1822,7 +1882,7 @@ class CollectionExecutor(_ExecutorBase):
         coll = self._coll
 
         kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
-        key = ("u", treedef, sig, batched, bucket if padded else None, kw_map)
+        key = ("u", treedef, sig, batched, bucket if padded else None, kw_map, self._state_sig())
         self._record_profile(key, "update", args, kwargs)
 
         def builder(coll=None):
@@ -1945,7 +2005,7 @@ class CollectionExecutor(_ExecutorBase):
         coll = self._coll
 
         kw_map = tuple((name, self._kwarg_names(m, kwargs)) for name, m, _ in self._leaders())
-        key = ("f", treedef, sig, batched, bucket if padded else None, kw_map)
+        key = ("f", treedef, sig, batched, bucket if padded else None, kw_map, self._state_sig())
         self._record_profile(key, "forward", args, kwargs)
 
         def builder(coll=None):
@@ -2316,6 +2376,16 @@ def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
     rec = getattr(ex, "_last_recovery", None)
     if rec is None:
         return None
+
+    def augment(metric: Any, entry: Dict[str, Any]) -> Dict[str, Any]:
+        # wrappers carrying host-side metadata alongside their array states
+        # (LanedMetric's session->lane directory) contribute it here so a
+        # recovery-reused autosave snapshot restores completely
+        extras = getattr(metric, "_export_extras", None)
+        if callable(extras):
+            entry.update(extras())
+        return entry
+
     if isinstance(ex, CollectionExecutor):
         coll = ex._coll
         export: Dict[str, Any] = {}
@@ -2325,7 +2395,7 @@ def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
                 return None
             entry = dict(snap)
             entry[STATE_COUNT_KEY] = int(count)
-            export[leader] = entry
+            export[leader] = augment(coll._modules[leader], entry)
             counts.append(int(count))
         if not counts:
             return None
@@ -2335,7 +2405,7 @@ def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
         return None
     export = dict(snap)
     export[STATE_COUNT_KEY] = int(count)
-    return int(count), export
+    return int(count), augment(ex._metric, export)
 
 
 def executor_stats(obj: Any) -> Dict[str, Any]:
@@ -2347,7 +2417,6 @@ def executor_stats(obj: Any) -> Dict[str, Any]:
     ex = getattr(obj, "_executor_obj", None)
     if ex is None:
         out = _new_stats()
-        out["compile_ms_total"] = 0.0  # deprecated alias of compile_us_total
         out["disabled_reason"] = None
         out["fallback_reason"] = None
         out["bucketing_enabled"] = True

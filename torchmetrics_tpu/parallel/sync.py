@@ -270,6 +270,32 @@ def sync_states(
     return out
 
 
+def reduction_identity(reduction: Reduction, dtype: Any) -> Optional[Any]:
+    """The identity element of a declared ``dist_reduce_fx`` for ``dtype`` —
+    the value a masked-out contributor (an inactive/padded session lane, a
+    hole in a ragged gather) must carry so it cannot perturb the fold:
+
+    - ``sum``/``mean``/``cat``/``None``: 0 (mean folds divide by the *active*
+      count, so the masked slot only needs to vanish from the numerator),
+    - ``max``: ``-inf`` for floats, the dtype's minimum for ints, False for bool,
+    - ``min``: ``+inf`` for floats, the dtype's maximum for ints, True for bool,
+    - callables: ``None`` — a custom reduction has no derivable identity; the
+      caller must mask structurally (drop the contributor) instead.
+    """
+    dtype = jnp.dtype(dtype)
+    if callable(reduction):
+        return None
+    if reduction in ("max", "min"):
+        lo = reduction == "max"
+        if dtype == jnp.bool_:
+            return jnp.asarray(not lo, dtype)
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(-jnp.inf if lo else jnp.inf, dtype)
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.min if lo else info.max, dtype)
+    return jnp.zeros((), dtype)
+
+
 def reduce_stacked(gathered: Any, reduction: Reduction) -> Any:
     """Collapse the leading rank/shard axis of a stacked value per the declared
     reduction — the shared read-point fold behind :func:`host_sync_value` (the
