@@ -1,0 +1,703 @@
+"""Per-tenant blast-radius containment chaos battery (ISSUE 8,
+torchmetrics_tpu/quarantine.py + lanes.py, docs/LANES.md "Failure semantics").
+
+The acceptance property: with one tenant poisoned, every OTHER lane's
+per-lane ``compute()`` is bit-exact vs a fault-free run — in step and
+deferred modes, under every ``on_lane_fault`` policy, across kill/restore.
+Covers the three fault channels (admission screening, device-side poison
+attribution fused into the dispatch, attributed dispatch faults), the
+per-session circuit breaker, clean-probe auto-unquarantine, degraded reads
+with staleness metadata, the incremental recovery mirror, the
+``on_sync_failure="last_good"`` extension on plain metrics, quarantine
+state riding the checkpoint, and ``dump_diagnostics``'s quarantine table.
+
+Values are integer-valued floats so sums are exact in f32 and "bit-exact"
+is meaningful (same discipline as tests/test_lanes.py).
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import (
+    DegradedValue,
+    LaneFaultError,
+    LanedCollection,
+    LanedMetric,
+    io,
+    make_deferred_lane_step,
+    obs,
+)
+from torchmetrics_tpu.aggregation import MaxMetric, SumMetric
+from torchmetrics_tpu.quarantine import LaneGuard, LaneStateMirror, row_spec_majority, screen_row
+from torchmetrics_tpu.testing import faults
+
+
+def _sum(**kw):
+    # nan_strategy="disable" passes NaN through to the state, so BOTH fault
+    # channels (admission finite screen, fused device scan) can observe it
+    return SumMetric(nan_strategy="disable", **kw)
+
+
+def _max(**kw):
+    return MaxMetric(nan_strategy="disable", **kw)
+
+
+def _rows(rng, n=4):
+    return np.asarray(rng.randint(-20, 20, n)).astype(np.float32)
+
+
+def _traffic(rng, sessions, n=4):
+    return [(s, _rows(rng, n)) for s in sessions]
+
+
+# ----------------------------------------------------------- fault channels
+
+
+class TestFaultChannels:
+    def test_admission_screen_diverts_nonfinite_row(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([3.0]))])
+        vals = laned.lane_values()
+        assert isinstance(vals["a"], DegradedValue)
+        assert float(vals["a"].value) == 1.0
+        assert vals["a"].updates_behind == 1 and vals["a"].age_updates == 1
+        assert float(vals["b"]) == 5.0
+        assert laned.guard.last_fault["a"]["where"] == "admission"
+        assert laned.lane_status["diverted_rows"] == 1
+
+    def test_admission_screen_diverts_malformed_shape_row(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        # majority (3 of 4) defines the round layout; the deviant is diverted
+        items = [
+            ("a", np.asarray([1.0, 1.0])),
+            ("b", np.asarray([2.0, 2.0])),
+            ("c", np.asarray([3.0, 3.0])),
+            ("weird", np.asarray([9.0, 9.0, 9.0])),
+        ]
+        laned.update_sessions(items)
+        vals = laned.lane_values()
+        assert float(vals["a"]) == 2.0 and float(vals["c"]) == 6.0
+        assert isinstance(vals["weird"], DegradedValue)
+        assert "shape" in laned.guard.last_fault["weird"]["reason"]
+
+    def test_admission_screen_diverts_wrong_dtype_kind(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        items = [
+            ("a", np.asarray([1.0, 1.0], np.float32)),
+            ("b", np.asarray([2.0, 2.0], np.float32)),
+            ("c", np.asarray([7, 7], np.int64)),  # int row in a float round
+        ]
+        laned.update_sessions(items)
+        vals = laned.lane_values()
+        assert float(vals["a"]) == 2.0 and float(vals["b"]) == 4.0
+        assert isinstance(vals["c"], DegradedValue)
+        assert "dtype kind" in laned.guard.last_fault["c"]["reason"]
+
+    def test_majority_vote_survives_malformed_majority_candidate(self):
+        # one malformed tenant cannot redefine the round: 2 conforming rows
+        # out-vote 1 deviant even when the deviant arrives first
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        items = [
+            ("weird", np.asarray([9.0, 9.0, 9.0])),
+            ("a", np.asarray([1.0, 1.0])),
+            ("b", np.asarray([2.0, 2.0])),
+        ]
+        laned.update_sessions(items)
+        vals = laned.lane_values()
+        assert float(vals["a"]) == 2.0 and float(vals["b"]) == 4.0
+        assert isinstance(vals["weird"], DegradedValue)
+
+    def test_whole_round_unstackable_is_diverted_not_raised(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        n = laned.update_sessions([("a", object())])  # not array-like
+        assert n == 0  # nothing dispatchable
+        assert laned.guard.fault_total["a"] == 1
+        assert float(laned.lane_values()["a"].value) == 1.0
+
+    def test_device_scan_attributes_nan_produced_by_update(self):
+        # screen OFF: the NaN input reaches the dispatch; the updated state
+        # goes non-finite and the fused screen diverts it at the scatter
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", admission_screen=False
+        )
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([3.0]))])
+        vals = laned.lane_values()
+        assert isinstance(vals["a"], DegradedValue)
+        assert float(vals["a"].value) == 1.0 and vals["a"].updates_behind == 1
+        assert float(vals["b"]) == 5.0
+        assert laned.guard.last_fault["a"]["where"] == "device"
+        # containment by construction: the poisoned update never landed
+        lane = laned.sessions["a"]
+        assert float(laned._state["sum_value"][lane]) == 1.0
+        assert int(np.asarray(laned._state["lane_health"])[lane]) == 1
+        assert int(np.asarray(laned._state["lane_updates"])[lane]) == 1
+
+    def test_dispatch_fault_redispatches_without_culprit(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0])), ("c", np.asarray([3.0]))]
+        laned.update_sessions(base)
+        with faults.fail_lane_dispatch(laned, "b", fail_n=1):
+            laned.update_sessions(base)
+        vals = laned.lane_values()
+        # the other lanes sharing the dispatch still got their step
+        assert float(vals["a"]) == 2.0 and float(vals["c"]) == 6.0
+        assert isinstance(vals["b"], DegradedValue)
+        assert float(vals["b"].value) == 2.0 and vals["b"].updates_behind == 1
+        assert laned.guard.last_fault["b"]["where"] == "dispatch"
+
+    def test_guard_off_keeps_pre_containment_behavior(self):
+        # no policy: NaN lands in the lane state (no silent divert), nothing
+        # is quarantined, and reads serve the poisoned value as-is
+        laned = LanedMetric(_sum(), capacity=8)
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan]))])
+        assert np.isnan(float(laned.lane_values()["a"]))
+        assert laned.lane_status["quarantined"] == 0
+
+
+# ------------------------------------------------------------- policy matrix
+
+
+class TestPolicies:
+    def test_reset_policy_zeroes_lane_and_keeps_flowing(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="reset")
+        laned.update_sessions([("a", np.asarray([5.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        vals = laned.lane_values()
+        assert float(vals["a"]) == 1.0  # 5 wiped by the reset, 1 kept
+        assert float(vals["b"]) == 6.0
+        assert laned.lane_status["resets"] == 1
+
+    def test_evict_policy_drops_session(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="evict")
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        assert "a" not in laned.sessions
+        assert float(laned.lane_values()["b"]) == 4.0
+        # the evicted tenant's records are forgotten (no ghost staleness)
+        assert "a" not in laned.guard.fault_total
+        assert "a" not in laned.guard.diverted
+
+    def test_raise_policy_propagates_with_attribution_and_intact_state(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="raise")
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        with pytest.raises(LaneFaultError) as ei:
+            laned.update_sessions([("a", np.asarray([np.nan]))])
+        assert ei.value.session_id == "a" and ei.value.where == "admission"
+        assert float(laned.lane_values()["a"]) == 1.0  # round never dispatched
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_lane_fault"):
+            LanedMetric(_sum(), capacity=8, on_lane_fault="explode")
+
+
+# ------------------------------------------------- breaker + unquarantine
+
+
+class TestBreakerAndProbes:
+    def test_breaker_escalates_to_evict(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", breaker_threshold=2, breaker_window=8
+        )
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        assert laned.guard.is_quarantined("a")
+        assert laned.guard.breaker_state("a") == "probation"
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        assert "a" not in laned.sessions  # breaker tripped: quarantine -> evict
+        assert laned.guard.stats["breaker_trips"] == 1
+        assert float(laned.lane_values()["b"]) == 6.0
+
+    def test_breaker_window_slides(self):
+        guard = LaneGuard(policy="quarantine", breaker_threshold=2, breaker_window=3)
+        guard.begin_round()
+        assert guard.record_fault("a", "admission", "x") == "quarantine"
+        for _ in range(4):  # fault ages out of the window
+            guard.begin_round()
+        assert guard.record_fault("a", "admission", "x") == "quarantine"  # no trip
+        guard.begin_round()
+        assert guard.record_fault("a", "admission", "x") == "evict"  # 2 in window
+
+    def test_clean_probes_unquarantine(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", admission_screen=False,
+            unquarantine_after=2, breaker_threshold=100,
+        )
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        assert isinstance(laned.lane_values()["a"], DegradedValue)
+        # quarantined rows keep dispatching: each committed clean update is a
+        # validated probe (the device screen would divert any poison)
+        laned.update_sessions([("a", np.asarray([10.0])), ("b", np.asarray([2.0]))])
+        v1 = laned.lane_values()["a"]
+        assert isinstance(v1, DegradedValue) and v1.updates_behind == 2
+        laned.update_sessions([("a", np.asarray([10.0])), ("b", np.asarray([2.0]))])
+        v2 = laned.lane_values()["a"]
+        assert not isinstance(v2, DegradedValue)
+        assert float(v2) == 21.0  # probation commits were kept, only the NaN is missing
+        assert laned.lane_status["unquarantines"] == 1
+
+    def test_fault_during_probation_resets_probe_count(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", admission_screen=False,
+            unquarantine_after=2, breaker_threshold=100,
+        )
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        laned.lane_values()
+        laned.update_sessions([("a", np.asarray([5.0])), ("b", np.asarray([2.0]))])  # probe 1
+        laned.lane_values()
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])  # fault again
+        laned.lane_values()
+        assert laned.guard.quarantined["a"]["clean_probes"] == 0
+        laned.update_sessions([("a", np.asarray([5.0])), ("b", np.asarray([2.0]))])
+        assert isinstance(laned.lane_values()["a"], DegradedValue)  # still in (1 < 2 probes)
+
+    def test_quarantined_lane_excluded_from_aggregate_until_readmitted(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", admission_screen=False,
+            unquarantine_after=1, breaker_threshold=100,
+        )
+        laned.update_sessions([("a", np.asarray([10.0])), ("b", np.asarray([2.0]))])
+        assert float(laned.compute()) == 12.0
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([3.0]))])
+        assert float(laned.compute()) == 5.0  # a's rolled-back state must not leak in
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([1.0]))])
+        assert float(laned.compute()) == 17.0  # re-admitted with full history
+
+
+# -------------------------------------------------------- degraded reads
+
+
+class TestDegradedReads:
+    def test_staleness_metadata_counts_everything_missing(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", breaker_threshold=100,
+            unquarantine_after=100,
+        )
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([2.0])), ("b", np.asarray([2.0]))])
+        healthy = laned.lane_values()["a"]
+        assert float(healthy) == 3.0
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        dv = laned.lane_values()["a"]
+        assert isinstance(dv, DegradedValue)
+        assert float(dv.value) == 3.0 and dv.age_updates == 2 and dv.updates_behind == 1
+        # diverted screen rejects and committed probes both count as missing
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([4.0])), ("b", np.asarray([2.0]))])
+        dv2 = laned.lane_values()["a"]
+        assert float(dv2.value) == 3.0 and dv2.updates_behind == 3
+        assert dv2.age_updates == 2  # unchanged: how much data the value reflects
+
+    def test_compute_session_serves_degraded_value(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan]))])
+        dv = laned.compute_session("a")
+        assert isinstance(dv, DegradedValue) and float(dv.value) == 1.0
+
+    def test_healthy_reads_refresh_last_good_cache(self):
+        laned = LanedMetric(
+            _sum(), capacity=8, on_lane_fault="quarantine", breaker_threshold=100
+        )
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        laned.lane_values()
+        laned.update_sessions([("a", np.asarray([2.0]))])
+        laned.lane_values()  # refresh: last-good now 3.0
+        laned.update_sessions([("a", np.asarray([np.nan]))])
+        dv = laned.lane_values()["a"]
+        assert float(dv.value) == 3.0 and dv.age_updates == 2
+
+    @staticmethod
+    def _dist_metric(**kw):
+        # believes it runs multi-host, so compute() takes the gather path the
+        # fault harness can break (same seam as tests/test_fault_containment)
+        return SumMetric(
+            nan_strategy="disable", executor=False,
+            distributed_available_fn=lambda: True, **kw,
+        )
+
+    def test_plain_metric_last_good_sync_policy(self):
+        m = self._dist_metric(on_sync_failure="last_good")
+        m.update(jnp.asarray([1.0, 2.0]))
+        assert float(m.compute()) == 3.0  # healthy read populates the cache
+        m.update(jnp.asarray([4.0]))
+        m._computed = None
+        with faults.break_sync():
+            with pytest.warns(UserWarning, match="last-good"):
+                dv = m.compute()
+        assert isinstance(dv, DegradedValue)
+        assert float(dv.value) == 3.0
+        assert dv.updates_behind == 1 and dv.age_updates == 1
+        assert m.last_sync_ok is False
+        # after the seam heals, reads serve live values again
+        m._computed = None
+        assert float(m.compute()) == 7.0
+        assert m.last_sync_ok is True
+
+    def test_plain_metric_last_good_falls_back_to_local_without_cache(self):
+        m = self._dist_metric(on_sync_failure="last_good")
+        m.update(jnp.asarray([1.0, 2.0]))
+        with faults.break_sync(), pytest.warns(UserWarning, match="local-only"):
+            v = m.compute()
+        assert not isinstance(v, DegradedValue) and float(v) == 3.0
+
+    def test_invalid_sync_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_sync_failure"):
+            SumMetric(nan_strategy="disable", on_sync_failure="shrug")
+
+
+# -------------------------------------------------------- recovery mirror
+
+
+class TestRecoveryMirror:
+    def test_mirror_folds_incrementally_on_steady_rounds(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            laned.update_sessions(_traffic(rng, ["a", "b"]))
+        stats = laned.__dict__["_lane_mirror"].stats
+        assert stats["rebuilds"] == 1  # first donating call only
+        assert stats["incremental"] >= 4
+
+    def test_mirror_restores_state_after_donation_death(self):
+        laned = LanedMetric(_sum(), capacity=8)
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            laned.update_sessions(_traffic(rng, ["a", "b"]))
+        before = {s: float(v) for s, v in laned.lane_values().items()}
+        with faults.fail_dispatch(fail_n=1):
+            with pytest.raises(faults.FaultInjected):
+                laned.update_sessions(_traffic(rng, ["a", "b"]))
+        after = {s: float(v) for s, v in laned.lane_values().items()}
+        assert after == before  # the mirror reinstalled the pre-call state
+        assert laned.executor_status["stats"]["recovery_restores"] >= 1
+        # and the metric keeps working afterwards
+        laned.update_sessions([("a", np.asarray([1.0]))])
+        assert float(laned.lane_values()["a"]) == before["a"] + 1.0
+
+    def test_mirror_rebuilds_after_out_of_band_mutation(self):
+        laned = LanedMetric(_sum(), capacity=8)
+        rng = np.random.RandomState(2)
+        laned.update_sessions(_traffic(rng, ["a", "b"]))
+        laned.update_sessions(_traffic(rng, ["a", "b"]))
+        laned.reset_session("a")  # out-of-band: invalidates the mirror
+        assert laned.__dict__["_lane_mirror"]._mirror is None
+        laned.update_sessions(_traffic(rng, ["a", "b"]))
+        laned.update_sessions(_traffic(rng, ["a", "b"]))
+        assert laned.__dict__["_lane_mirror"].stats["rebuilds"] >= 2
+
+    def test_mirror_known_rows_fold_matches_device_gather(self):
+        # unit-level: folding from caller-provided rows equals a device gather
+        mirror = LaneStateMirror()
+        state1 = {"v": jnp.arange(8.0)}
+        mirror.snapshot(state1, np.asarray([0, 1]), update_count=1, capacity=8)
+        state2 = {"v": jnp.asarray([10.0, 11.0, 2, 3, 4, 5, 6, 7])}
+        known = (np.asarray([0, 1]), {"v": np.asarray([[10.0], [11.0]]).reshape(2)})
+        mirror.snapshot(state2, np.asarray([2]), update_count=2, capacity=8, known_rows=known)
+        assert mirror.stats == {"rebuilds": 1, "incremental": 1}
+        assert list(mirror._mirror["v"][:2]) == [10.0, 11.0]
+
+
+# ------------------------------------------------ collection (shared guard)
+
+
+class TestLanedCollectionFaults:
+    def _lc(self, **kw):
+        return LanedCollection({"s": _sum(), "m": _max()}, capacity=8, **kw)
+
+    def test_quarantine_spans_every_member(self):
+        lc = self._lc(on_lane_fault="quarantine", breaker_threshold=100)
+        lc.update_sessions([("a", np.asarray([1.0, 2.0])), ("b", np.asarray([5.0, 7.0]))])
+        lc.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([1.0]))])
+        vals = lc.lane_values()
+        assert isinstance(vals["a"]["s"], DegradedValue)
+        assert isinstance(vals["a"]["m"], DegradedValue)
+        assert float(vals["a"]["s"].value) == 3.0 and float(vals["a"]["m"].value) == 2.0
+        assert float(vals["b"]["s"]) == 13.0 and float(vals["b"]["m"]) == 7.0
+        assert list(lc.guard.quarantined) == ["a"]
+
+    def test_member_attributed_breaker_evicts_suite_wide(self):
+        # the fault is attributed by ONE member's health scan, but eviction
+        # must release the lane in EVERY member (shared table coherence)
+        lc = self._lc(on_lane_fault="quarantine", breaker_threshold=2, admission_screen=False)
+        lc.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        lc.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        lc.lane_values()
+        lc.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        lc.lane_values()
+        assert "a" not in lc.sessions
+        lane_states = lc["s"]._state["sum_value"]
+        freed_lane_value = float(np.asarray(lane_states).min())
+        assert freed_lane_value == 0.0  # reclaimed lane reset in members
+        assert float(lc.lane_values()["b"]["s"]) == 6.0
+
+    def test_dispatch_fault_contained_in_collection(self):
+        lc = self._lc(on_lane_fault="quarantine")
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0]))]
+        lc.update_sessions(base)
+        with faults.fail_lane_dispatch(lc, "a", fail_n=1):
+            lc.update_sessions(base)
+        vals = lc.lane_values()
+        assert isinstance(vals["a"]["s"], DegradedValue)
+        assert float(vals["b"]["s"]) == 4.0 and float(vals["b"]["m"]) == 2.0
+
+
+# ------------------------------------------------------------ poison storm
+
+
+class TestPoisonStorm:
+    """The ISSUE 8 acceptance chaos suite: 1k lanes, one tenant poisoned
+    every step, the other 999 lanes bit-exact vs a fault-free run."""
+
+    N_SESSIONS = 1000
+    STEPS = 100
+
+    def _storm(self, policy, steps=None, n=None, **kw):
+        n = n or self.N_SESSIONS
+        steps = steps or self.STEPS
+        sessions = [f"s{i:04d}" for i in range(n)]
+        victim = sessions[7]
+        clean = LanedMetric(_sum(), capacity=n)
+        guarded = LanedMetric(_sum(), capacity=n, on_lane_fault=policy, **kw)
+        rng_a, rng_b = np.random.RandomState(3), np.random.RandomState(3)
+        read_every = max(1, steps // 10)
+        for step in range(steps):
+            items_clean = _traffic(rng_a, sessions)
+            items_poison = []
+            for sid, batch in _traffic(rng_b, sessions):
+                if sid == victim:
+                    bad = np.array(batch)
+                    bad[0] = np.nan
+                    batch = bad
+                items_poison.append((sid, batch))
+            clean.update_sessions(items_clean)
+            guarded.update_sessions(items_poison)
+            if (step + 1) % read_every == 0:
+                guarded.lane_values()  # read points drive attribution/probes
+        return clean, guarded, sessions, victim
+
+    @pytest.mark.parametrize("policy", ["quarantine", "reset", "evict"])
+    def test_poison_storm_isolation_step_mode(self, policy):
+        clean, guarded, sessions, victim = self._storm(
+            policy, breaker_threshold=10**6 if policy == "quarantine" else 3
+        )
+        want = clean.lane_values()
+        got = guarded.lane_values()
+        for s in sessions:
+            if s == victim:
+                continue
+            assert float(got[s]) == float(want[s]), s
+        if policy == "quarantine":
+            dv = got[victim]
+            assert isinstance(dv, DegradedValue)
+            assert dv.updates_behind >= self.STEPS - 1  # ~every storm offer missed
+            assert guarded.lane_status["quarantined"] == 1
+        # the clean aggregate (minus the victim) matches exactly
+        victim_lane = clean.sessions[victim]
+        clean_total = float(clean.compute()) - float(np.asarray(clean._state["sum_value"])[victim_lane])
+        guarded_total = float(guarded.compute())
+        if policy == "quarantine":
+            assert guarded_total == clean_total
+        assert guarded.lane_status["faults"] >= self.STEPS // 2
+
+    def test_poison_storm_raise_policy_round_is_transactional(self):
+        n, steps = 64, 10
+        sessions = [f"s{i:02d}" for i in range(n)]
+        victim = sessions[5]
+        clean = LanedMetric(_sum(), capacity=n)
+        guarded = LanedMetric(_sum(), capacity=n, on_lane_fault="raise")
+        rng_a, rng_b = np.random.RandomState(4), np.random.RandomState(4)
+        for _ in range(steps):
+            items = _traffic(rng_a, sessions)
+            poisoned = []
+            for s, b in _traffic(rng_b, sessions):
+                if s == victim:
+                    b = np.array(b)
+                    b[0] = np.nan
+                poisoned.append((s, b))
+            clean.update_sessions(items)
+            with pytest.raises(LaneFaultError):
+                guarded.update_sessions(poisoned)
+            # caller's recourse: re-send without the culprit
+            guarded.update_sessions([(s, b) for s, b in poisoned if s != victim])
+        want, got = clean.lane_values(), guarded.lane_values()
+        for s in sessions:
+            if s != victim:
+                assert float(got[s]) == float(want[s]), s
+
+    def test_poison_storm_isolation_deferred_mode(self, mesh):
+        n, steps, rows = 1000, 50, 64
+        capacity = 1024
+        laned_clean = LanedMetric(_sum(), capacity=capacity, reduce="deferred")
+        laned_guard = LanedMetric(
+            _sum(), capacity=capacity, reduce="deferred",
+            on_lane_fault="quarantine", breaker_threshold=10**6, admission_screen=False,
+        )
+        sessions = [f"d{i:04d}" for i in range(n)]
+        for laned in (laned_clean, laned_guard):
+            for s in sessions:
+                laned.admit(s)
+        victim_lane = laned_guard.sessions[sessions[3]]
+        step_c = make_deferred_lane_step(laned_clean, mesh)
+        step_g = make_deferred_lane_step(laned_guard, mesh)
+        states_c, states_g = step_c.init_states(), step_g.init_states()
+        rng = np.random.RandomState(5)
+        for step in range(steps):
+            lanes = rng.choice(n, size=rows, replace=False)
+            if victim_lane not in lanes:
+                lanes[0] = victim_lane
+            vals = rng.randint(-20, 20, rows).astype(np.float32)
+            ids = jnp.asarray(lanes, jnp.int32)
+            states_c = step_c.local_step(states_c, ids, jnp.asarray(vals))
+            bad = vals.copy()
+            bad[np.where(lanes == victim_lane)[0]] = np.nan
+            states_g = step_g.local_step(states_g, ids, jnp.asarray(bad))
+        step_c.install_reduced(step_c.reduce(states_c))
+        step_g.install_reduced(step_g.reduce(states_g))
+        want = laned_clean.lane_values()
+        got = laned_guard.lane_values()
+        for s in sessions:
+            if laned_guard.sessions[s] == victim_lane:
+                assert isinstance(got[s], DegradedValue)
+                continue
+            assert float(got[s]) == float(want[s]), s
+        assert int(np.asarray(laned_guard._state["lane_health"])[victim_lane]) == steps
+
+    def test_storm_checkpoint_restore_preserves_containment(self, tmp_path):
+        clean, guarded, sessions, victim = self._storm(
+            "quarantine", steps=20, n=64, breaker_threshold=10**6
+        )
+        path = io.save_state(guarded, str(tmp_path / "storm"))
+        fresh = LanedMetric(
+            _sum(), capacity=64, on_lane_fault="quarantine", breaker_threshold=10**6
+        )
+        io.restore_state(path, fresh, check_finite=True)
+        assert fresh.guard.is_quarantined(victim)
+        assert fresh.guard.fault_total[victim] == guarded.guard.fault_total[victim]
+        got, want = fresh.lane_values(), guarded.lane_values()
+        for s in sessions:
+            if s == victim:
+                assert isinstance(got[s], DegradedValue)
+                continue
+            assert float(got[s]) == float(want[s]), s
+        # the restored breaker and probes keep working
+        rng = np.random.RandomState(9)
+        for _ in range(3):
+            fresh.update_sessions(_traffic(rng, sessions))
+            fresh.lane_values()
+        assert not fresh.guard.is_quarantined(victim)  # clean probes re-admitted it
+
+
+# -------------------------------------------------- harness + diagnostics
+
+
+class TestHarnessAndDiagnostics:
+    def test_poison_session_corrupts_only_target(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        base = [("a", np.asarray([1.0, 1.0])), ("b", np.asarray([2.0, 2.0]))]
+        laned.update_sessions(base)
+        with faults.poison_session(laned, "a", mode="nan", frac=1.0):
+            laned.update_sessions(base)
+        vals = laned.lane_values()
+        assert isinstance(vals["a"], DegradedValue)
+        assert float(vals["b"]) == 8.0
+        # the patch restores on exit
+        laned.update_sessions(base)
+        assert laned.guard.fault_total["a"] == 1
+
+    def test_poison_session_composes_with_fail_lane_dispatch(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine", breaker_threshold=100)
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0])), ("c", np.asarray([4.0]))]
+        laned.update_sessions(base)
+        with faults.poison_session(laned, "a", frac=1.0), faults.fail_lane_dispatch(laned, "b", fail_n=1):
+            laned.update_sessions(base)
+        vals = laned.lane_values()
+        assert isinstance(vals["a"], DegradedValue) and isinstance(vals["b"], DegradedValue)
+        assert float(vals["c"]) == 8.0  # the one clean tenant still advanced
+
+    def test_dump_diagnostics_includes_quarantine_table(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine", breaker_threshold=100)
+        laned.update_sessions([("a", np.asarray([1.0])), ("b", np.asarray([2.0]))])
+        laned.update_sessions([("a", np.asarray([np.nan])), ("b", np.asarray([2.0]))])
+        laned.lane_values()
+        report = obs.dump_diagnostics(laned)
+        table = report["lane_quarantine"]
+        assert isinstance(table, list) and table
+        row = table[0]
+        assert row["session"] == "a" and row["quarantined"] is True
+        assert row["lane"] == laned.sessions["a"]
+        assert row["faults"] == 1 and row["breaker"] == "probation"
+        assert row["last_good_age_updates"] == 1
+        # quarantined rows sort first
+        assert all(not r["quarantined"] for r in table[1:])
+
+    def test_lane_status_carries_guard_counters(self):
+        laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+        laned.update_sessions([("a", np.asarray([np.nan]))])
+        status = laned.lane_status
+        for key in ("policy", "quarantined", "faults", "quarantines", "diverted_rows", "degraded_reads"):
+            assert key in status
+        assert status["policy"] == "quarantine" and status["faults"] == 1
+
+    def test_quarantine_span_emitted(self):
+        obs.set_tracing(True)
+        try:
+            obs.reset_ring()
+            laned = LanedMetric(_sum(), capacity=8, on_lane_fault="quarantine")
+            laned.update_sessions([("a", np.asarray([1.0]))])
+            laned.update_sessions([("a", np.asarray([np.nan]))])
+            laned.lane_values()
+            names = {e.name for e in obs.drain_events()}
+        finally:
+            obs.set_tracing(None)
+        assert obs.SPAN_QUARANTINE in names
+
+
+# ------------------------------------------------------ guard serialization
+
+
+class TestGuardSerialization:
+    def test_to_json_round_trip_rearms_exactly(self):
+        guard = LaneGuard(policy="quarantine", breaker_threshold=3, breaker_window=16)
+        for _ in range(2):
+            guard.begin_round()
+            guard.record_fault("a", "device", "nan")
+        guard.quarantine("a")
+        guard.note_diverted("a", 3)
+        payload = guard.to_json()
+        fresh = LaneGuard(policy="quarantine", breaker_threshold=3, breaker_window=16)
+        fresh.load_json(payload)
+        assert fresh.round == guard.round
+        assert fresh.fault_total == {"a": 2}
+        assert fresh.fault_rounds == guard.fault_rounds
+        assert fresh.is_quarantined("a")
+        assert fresh.diverted == {"a": 3}
+        # one more fault trips the re-armed breaker
+        fresh.begin_round()
+        assert fresh.record_fault("a", "device", "nan") == "evict"
+
+    def test_load_json_drops_unknown_sessions(self):
+        guard = LaneGuard(policy="quarantine")
+        guard.begin_round()
+        guard.record_fault("ghost", "device", "nan")
+        guard.quarantine("ghost")
+        payload = guard.to_json()
+        fresh = LaneGuard(policy="quarantine")
+        fresh.load_json(payload, known_sessions={"real"})
+        assert not fresh.is_quarantined("ghost") and not fresh.fault_total
+
+    def test_screen_helpers(self):
+        spec = row_spec_majority([(np.zeros(2),), (np.zeros(2),), (np.zeros(3),)])
+        assert spec == [((2,), "f")]
+        assert screen_row((np.zeros(2),), spec) is None
+        assert "shape" in screen_row((np.zeros(3),), spec)
+        assert "dtype kind" in screen_row((np.zeros(2, np.int32),), spec)
+        assert "non-finite" in screen_row((np.asarray([1.0, np.nan]),), spec)
+        assert screen_row((np.asarray([1.0, np.nan]),), spec, check_finite=False) is None

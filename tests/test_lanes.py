@@ -480,7 +480,13 @@ class TestLanedCheckpoint:
         tm.save_state(laned, path)
         fresh = LanedMetric(_agg(SumMetric), capacity=8)
         manifest = tm.restore_state(path, fresh)
-        assert manifest["lanes"] == {"capacity": 8, "active": 3, "compiled": True}
+        assert manifest["lanes"] == {
+            "capacity": 8,
+            "active": 3,
+            "compiled": True,
+            "policy": None,
+            "quarantined": 0,
+        }
         assert fresh.sessions == laned.sessions
         a, b = laned.lane_values(), fresh.lane_values()
         for s in a:

@@ -99,6 +99,24 @@ def check_bench(
                 Violation(name, None, threshold, f"bench config errored: {result['error']}")
             )
             continue
+        # isolation-overhead cap (ISSUE 8): a config reporting an
+        # isolation_overhead_pct column is gated against its baseline cap
+        # (default 1% — the lane fault-containment acceptance bound); noise
+        # can make the column slightly negative, which always passes
+        overhead = result.get("isolation_overhead_pct")
+        if isinstance(overhead, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("isolation_overhead_max_pct", 1.0) if isinstance(base, dict) else 1.0
+            if float(overhead) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"isolation_overhead_pct {overhead:.2f} exceeds the {cap}% cap —"
+                        " the lane fault-containment machinery is taxing the steady path",
+                    )
+                )
         ratio = effective_ratio(name, result, baselines)
         if ratio is None or ratio >= threshold:
             continue

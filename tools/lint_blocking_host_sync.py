@@ -38,6 +38,7 @@ HOT_PATH_FILES = (
     "metric.py",
     "collections.py",
     "lanes.py",
+    "quarantine.py",
     "ops/executor.py",
     "ops/compile_cache.py",
     "parallel/sync.py",
@@ -136,6 +137,90 @@ ALLOWLIST = {
     ),
     "lanes.py::_load_state_eager": (
         "eager-mode restore: per-lane count arrives as a host scalar by design"
+    ),
+    # --- lane fault containment (docs/LANES.md "Failure semantics"): every
+    #     sync below runs at a READ POINT, on a FAULT path, or only when an
+    #     on_lane_fault policy is active — never on the policy-off steady path
+    "lanes.py::_decode_json_blob": (
+        "checkpoint-blob decode (directory/quarantine state): pure host uint8"
+        " data from a snapshot, never a live device array"
+    ),
+    "lanes.py::_eager_state_finite": (
+        "eager-lane health scan: host-loopy mode by construction, runs only"
+        " when a fault policy is active"
+    ),
+    "lanes.py::_lane_counts_host": (
+        "degraded-read/probe staleness anchors: reading the per-lane commit"
+        " counters IS the read point (guard-active reads only)"
+    ),
+    "lanes.py::_stack_rows_screened": (
+        "router pack point with admission screening: host rows by design"
+        " (like _stack_rows); the finite scan is one vectorized pass over the"
+        " host-stacked leaf, before upload"
+    ),
+    "lanes.py::_fetch_round_baseline": (
+        "guard-active pre-round rows baseline: the lane-granular rollback"
+        " source AND the mirror's fold feed — ONE rows-sized fetch replacing"
+        " PR 2's whole-capacity copy, taken only when an on_lane_fault policy"
+        " is set"
+    ),
+    "lanes.py::_ensure_lane_clean": (
+        "fault path: one-lane finite check + masked restore after a lane"
+        " fault was attributed"
+    ),
+    "lanes.py::_host_rows_finite": (
+        "fault path: finite validation of already-host lane rows (np view,"
+        " no device fetch on the steady path)"
+    ),
+    "lanes.py::_restore_lane_rows": (
+        "fault path: scattering clean rows back into the stacked state after"
+        " a lane fault (keeps the recovery mirror in step)"
+    ),
+    "lanes.py::_scan_lane_health": (
+        "read-point poison attribution: the fused lane_health counters are"
+        " fetched where the caller is already reading values — zero extra"
+        " per-step syncs"
+    ),
+    "lanes.py::_grow_state": (
+        "growth: carrying the host health baseline across a capacity change"
+        " (np view of an existing host array, no device fetch)"
+    ),
+    "lanes.py::load_state": (
+        "restore path: back-filling the lane_health counter for"
+        " pre-containment checkpoints (host payload data)"
+    ),
+    "lanes.py::_restore_guard": (
+        "restore path: re-seeding the host health baseline from the restored"
+        " counters so historical faults are not re-attributed"
+    ),
+    "lanes.py::_recovery_snapshot": (
+        "recovery hook fallback: a tiny host fetch of the lane-id leaf when a"
+        " low-level update() bypassed the router (the router path is free)"
+    ),
+    "quarantine.py::row_spec_majority": (
+        "admission screening: per-row layout vote over HOST rows at the router"
+        " pack point (rows arrive as host arrays by design, like _stack_rows)"
+    ),
+    "quarantine.py::screen_row": (
+        "admission screening: shape/dtype/finite validation of host rows"
+        " before packing — the divert-don't-dispatch tentpole"
+    ),
+    "quarantine.py::materialize": (
+        "Autosaver recovery-reuse: detaching the (already host-side) mirror"
+        " is a host-to-host memcpy at autosave cadence, no device fetch"
+    ),
+    "quarantine.py::snapshot": (
+        "the incremental recovery mirror IS a deliberate host copy — rows-"
+        "sized on the warm path, replacing the whole-capacity executor"
+        " _snapshot for laned dispatches"
+    ),
+    "quarantine.py::rows": (
+        "fault path: reading pre-round rows out of the (already host-side)"
+        " mirror for lane-granular rollback"
+    ),
+    "quarantine.py::patch_rows": (
+        "fault path: folding a lane rollback into the host mirror (np view of"
+        " host arrays, no device fetch)"
     ),
 }
 

@@ -93,6 +93,11 @@ class BaseAggregator(Metric):
         """Value that is a no-op for this aggregator's reduction."""
         return 0.0
 
+    def _trace_config(self) -> tuple:
+        # nan_strategy changes the traced computation (neutral-mask vs float
+        # replacement vs passthrough) without moving the state spec
+        return (f"nan_strategy={self.nan_strategy}",)
+
     def _executor_traceable(self) -> bool:
         """The "error"/"warn" nan strategies need concrete values — tracing the
         update would silently skip the raise/warning, so those instances keep

@@ -209,7 +209,11 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
     try:
         status = getattr(obj, "lane_status", None)
         if isinstance(status, dict):
-            lanes = {k: status.get(k) for k in ("capacity", "active", "compiled") if k in status}
+            lanes = {
+                k: status.get(k)
+                for k in ("capacity", "active", "compiled", "policy", "quarantined")
+                if k in status
+            }
     except Exception as err:  # a broken status probe must not block the save
         rank_zero_debug(f"torchmetrics_tpu checkpoint: lane_status probe failed ({err})")
 

@@ -55,6 +55,11 @@ Composition
       and check every lane (docs/LANES.md "Durability").
     - Telemetry: dispatches emit ``tm_tpu.lanes.dispatch`` spans plus
       ``lanes.*`` counters and occupancy/capacity gauges.
+    - Fault containment: ``on_lane_fault="quarantine"|"reset"|"evict"|"raise"``
+      makes the LANE the unit of failure (``torchmetrics_tpu/quarantine.py``,
+      docs/LANES.md "Failure semantics") — admission screening at the pack,
+      a device-side row screen fused into the dispatch, lane quarantine with
+      degraded reads, and a per-session circuit breaker.
 
 Metrics whose inner state includes list ("cat") accumulators cannot carry a
 lane axis (a growing pytree cannot stack); those fall back to an exact
@@ -75,11 +80,25 @@ import numpy as np
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.parallel.sync import reduction_identity
-from torchmetrics_tpu.utils.exceptions import StateCorruptionError, TorchMetricsUserError
+from torchmetrics_tpu.quarantine import (
+    DegradedValue,
+    LaneGuard,
+    LaneStateMirror,
+    row_spec_majority,
+    screen_row,
+)
+from torchmetrics_tpu.utils.exceptions import (
+    LaneFaultError,
+    StateCorruptionError,
+    TorchMetricsUserError,
+)
+from torchmetrics_tpu.utils.prints import rank_zero_debug
 
 __all__ = [
     "DEFAULT_CAPACITY",
+    "DegradedValue",
     "DeferredLaneStep",
+    "LaneGuard",
     "LaneTable",
     "LanedCollection",
     "LanedMetric",
@@ -227,8 +246,19 @@ class LaneTable:
         return table
 
 
+def _encode_json_blob(payload: Dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(json.dumps(payload, sort_keys=True).encode("utf-8"), dtype=np.uint8).copy()
+
+
+def _decode_json_blob(blob: Any, what: str) -> Dict[str, Any]:
+    try:
+        return json.loads(np.asarray(blob, dtype=np.uint8).tobytes().decode("utf-8"))
+    except Exception as err:
+        raise StateCorruptionError(f"{what} blob is unreadable ({type(err).__name__}: {err})") from err
+
+
 def _encode_directory(table: LaneTable) -> np.ndarray:
-    return np.frombuffer(json.dumps(table.to_json(), sort_keys=True).encode("utf-8"), dtype=np.uint8).copy()
+    return _encode_json_blob(table.to_json())
 
 
 def _decode_directory(blob: Any) -> LaneTable:
@@ -239,6 +269,67 @@ def _decode_directory(blob: Any) -> LaneTable:
         raise
     except Exception as err:
         raise StateCorruptionError(f"lane directory blob is unreadable ({type(err).__name__}: {err})") from err
+
+
+class _ScreenSlowPath(Exception):
+    """Internal: a round failed the fast uniform-layout screen assumptions."""
+
+
+def _host_rows_finite(rows: Dict[str, Any]) -> bool:
+    """Finite check over already-host lane rows (fault-path validation)."""
+    return all(
+        not np.issubdtype(np.asarray(v).dtype, np.floating) or bool(np.isfinite(v).all())
+        for v in rows.values()
+    )
+
+
+def _eager_state_finite(state: Dict[str, Any]) -> bool:
+    """Host-side finite scan of one eager-mode lane state (the eager analogue
+    of the fused ``lane_health`` device scan — this mode is host-loopy by
+    construction, and the scan only runs when a fault policy is active)."""
+    for v in state.values():
+        leaves = v if isinstance(v, list) else [v]
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.floating) and not bool(np.isfinite(arr).all()):
+                return False
+    return True
+
+
+def _divert_screened_rows(
+    guard: "LaneGuard",
+    apply_action: Callable[[Any, str, LaneFaultError], None],
+    current: List[Tuple[Any, Tuple[Any, ...]]],
+    lanes: List[int],
+    reasons: List[Optional[str]],
+    sentinel: int,
+) -> List[int]:
+    """Apply admission-screen verdicts to one packed round (shared by the
+    LanedMetric and LanedCollection routers): a rejected row's lane id is
+    swapped for the scatter-dropped sentinel — the row ships with the
+    dispatch but cannot land anywhere — and the fault is logged against its
+    tenant. Returns the (possibly sentinel-substituted) lane-id list."""
+    out = list(lanes)
+    for i, reason in enumerate(reasons):
+        if reason is None:
+            continue
+        sid = current[i][0]
+        out[i] = sentinel
+        action = guard.record_fault(sid, "admission", reason)
+        apply_action(
+            sid,
+            action,
+            LaneFaultError(
+                f"admission screening rejected a row for session {sid!r}: {reason}",
+                session_id=sid,
+                where="admission",
+            ),
+        )
+        if action != "evict":
+            # counted AFTER any quarantine-time last-good capture, so the
+            # diverted offer itself registers as staleness (updates_behind)
+            guard.note_diverted(sid)
+    return out
 
 
 def _pack_rounds(
@@ -275,6 +366,19 @@ class LanedMetric(Metric):
         max_capacity: hard ceiling for automatic growth (``None`` = unbounded).
         table: a shared :class:`LaneTable` (``LanedCollection`` passes one so
             every member agrees on session→lane assignment).
+        on_lane_fault: per-tenant fault policy (docs/LANES.md "Failure
+            semantics"): ``None`` (default — guard off, pre-containment
+            behavior), ``"raise"``, ``"quarantine"``, ``"reset"``, or
+            ``"evict"``.
+        breaker_threshold / breaker_window: the per-session circuit breaker —
+            K faults within W router rounds escalate quarantine/reset to
+            evict.
+        unquarantine_after: clean probes that re-admit a quarantined tenant.
+        admission_screen: run per-row shape/dtype/finite screening in the
+            router before packing (default: on whenever a policy is set).
+        guard: a shared :class:`~torchmetrics_tpu.quarantine.LaneGuard`
+            (``LanedCollection`` passes one, like ``table``); overrides the
+            policy kwargs above.
         kwargs: forwarded to :class:`~torchmetrics_tpu.Metric` (``reduce=``,
             ``executor=``, ``sync_axis=``, ...).
 
@@ -298,7 +402,14 @@ class LanedMetric(Metric):
     _executor_bucketable = False
 
     _LANE_DIR_KEY = "_lane_directory"
-    _RESERVED_STATE_KEYS = Metric._RESERVED_STATE_KEYS + (_LANE_DIR_KEY,)
+    _QUARANTINE_KEY = "_lane_quarantine"
+    _RESERVED_STATE_KEYS = Metric._RESERVED_STATE_KEYS + (_LANE_DIR_KEY, _QUARANTINE_KEY)
+
+    #: wrapper-owned per-lane bookkeeping states riding next to the inner
+    #: fields: update counts (durability validation) and the fused health
+    #: scan's per-lane poisoned-update counter (docs/LANES.md "Failure
+    #: semantics") — both sum across shards in deferred mode
+    _LANE_AUX_FIELDS = ("lane_updates", "lane_health")
 
     def __init__(
         self,
@@ -306,6 +417,12 @@ class LanedMetric(Metric):
         capacity: int = DEFAULT_CAPACITY,
         max_capacity: Optional[int] = None,
         table: Optional[LaneTable] = None,
+        on_lane_fault: Optional[str] = None,
+        breaker_threshold: int = 3,
+        breaker_window: int = 32,
+        unquarantine_after: int = 2,
+        admission_screen: Optional[bool] = None,
+        guard: Optional[LaneGuard] = None,
         **kwargs: Any,
     ) -> None:
         if not isinstance(inner, Metric):
@@ -328,6 +445,23 @@ class LanedMetric(Metric):
         self.__dict__["_table"] = table if table is not None else LaneTable(capacity)
         if table is not None and table.capacity != capacity:
             capacity = table.capacity  # shared table wins: members must agree
+        # lane fault containment (docs/LANES.md "Failure semantics"): the
+        # guard holds policy + breaker + quarantine + last-good bookkeeping;
+        # a LanedCollection passes ONE shared guard so a faulting tenant is
+        # quarantined suite-wide, like the shared LaneTable
+        if guard is not None:
+            self.__dict__["_guard"] = guard
+        else:
+            self.__dict__["_guard"] = LaneGuard(
+                policy=on_lane_fault,
+                breaker_threshold=breaker_threshold,
+                breaker_window=breaker_window,
+                unquarantine_after=unquarantine_after,
+                screen=admission_screen,
+            )
+        self.__dict__["_guard_slot"] = ""  # collection members get their name
+        self.__dict__["_lane_mirror"] = LaneStateMirror()
+        self.__dict__["_health_seen"] = np.zeros((capacity,), np.int64)
         if self._compiled_lanes:
             for name, default in inner._defaults.items():
                 self.add_state(
@@ -336,9 +470,11 @@ class LanedMetric(Metric):
                     dist_reduce_fx=inner._reductions[name],
                 )
             self.add_state("lane_updates", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("lane_health", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="sum")
         else:
             self.__dict__["_lane_states"] = [inner.init_state() for _ in range(capacity)]
             self.__dict__["_lane_counts"] = [0] * capacity
+            self.__dict__["_lane_health_counts"] = [0] * capacity
         obs.gauge_set("lanes.capacity", self.capacity)
 
     # ------------------------------------------------------------- properties
@@ -361,14 +497,31 @@ class LanedMetric(Metric):
         """Occupancy + lifecycle counters + execution mode, the lane analogue
         of :attr:`executor_status` (which still reports compile/cache stats)."""
         table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
         return {
             "capacity": table.capacity,
             "active": table.active,
             "free": table.free,
             "max_capacity": self.max_capacity,
             "compiled": self._compiled_lanes,
+            "policy": guard.policy,
+            "quarantined": len(guard.quarantined),
             **table.stats,
+            **{k: v for k, v in guard.stats.items()},
         }
+
+    @property
+    def guard(self) -> LaneGuard:
+        """The lane fault-containment registry (policy, breaker, quarantine,
+        last-good cache)."""
+        return self.__dict__["_guard"]
+
+    def quarantine_table(self) -> List[Dict[str, Any]]:
+        """The per-tenant fault/quarantine/staleness table
+        (``obs.dump_diagnostics`` includes it — a stalled-tenant report is
+        one call)."""
+        table: LaneTable = self.__dict__["_table"]
+        return self.__dict__["_guard"].table(lane_of=dict(table.sessions))
 
     def _executor_identity(self) -> str:
         """Joins the executor's cross-process cache key: the compiled
@@ -383,6 +536,17 @@ class LanedMetric(Metric):
         cls = type(inner)
         mod = sys.modules.get(cls.__module__)
         return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}"
+
+    def _trace_config(self) -> tuple:
+        """The inner metric's trace config, plus the device-side row screen:
+        the guard-active trace diverts poisoned rows at the scatter, so it
+        must never share a persisted executable with the guard-off trace —
+        ``on_lane_fault`` is constructor-fixed, so the marker is stable for
+        the instance's lifetime."""
+        cfg = tuple(self.inner._trace_config())
+        if self.__dict__["_guard"].active:
+            cfg = cfg + ("lane_screen",)
+        return cfg
 
     @staticmethod
     def _stacked_default(default: Any, capacity: int) -> jnp.ndarray:
@@ -422,12 +586,37 @@ class LanedMetric(Metric):
 
         with obs.device_span(obs.SPAN_UPDATE, suffix=type(inner).__name__):
             updated = jax.vmap(one)(gathered, *args)
+        # per-lane health scan, fused into the SAME dispatch (zero extra host
+        # syncs): a row whose updated state carries NaN/Inf increments its
+        # owning lane's poisoned-update counter; the host attributes faults by
+        # diffing this state at the next read point (docs/LANES.md)
+        row_bad = None
+        for f in fields:
+            v = updated[f]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                bad = ~jnp.isfinite(v).reshape(v.shape[0], -1).all(axis=1)
+                row_bad = bad if row_bad is None else (row_bad | bad)
+        scatter_ids = lane_ids
+        if row_bad is not None and self.__dict__["_guard"].active:
+            # device-side row screen (guard-active trace — the executor disk
+            # key carries a marker, see _executor_identity): a poisoned row is
+            # DIVERTED at the scatter by swapping in the sentinel id, so its
+            # lane keeps the last clean bits — containment by construction,
+            # no rollback needed for the device fault channel. Guard-off keeps
+            # the pre-containment behavior (non-finite updates land).
+            scatter_ids = jnp.where(row_bad, jnp.int32(cap), lane_ids)
         for f in fields:
             # sentinel ids are out of range: mode="drop" discards those rows,
             # so padded lanes keep their exact prior bits (identity element of
             # every reduction family by construction)
-            self._state[f] = states[f].at[lane_ids].set(updated[f], mode="drop")
-        self._state["lane_updates"] = self._state["lane_updates"].at[lane_ids].add(1, mode="drop")
+            self._state[f] = states[f].at[scatter_ids].set(updated[f], mode="drop")
+        # committed counts follow the rows that actually landed; the health
+        # counter follows the ORIGINAL ids so diverted rows are attributed
+        self._state["lane_updates"] = self._state["lane_updates"].at[scatter_ids].add(1, mode="drop")
+        if row_bad is not None:
+            self._state["lane_health"] = (
+                self._state["lane_health"].at[lane_ids].add(row_bad.astype(jnp.int32), mode="drop")
+            )
 
     def _update_eager(self, lane_ids: Any, args: Tuple[Any, ...]) -> None:
         inner = self.inner
@@ -443,7 +632,16 @@ class LanedMetric(Metric):
                 continue  # padding sentinel: masked row never lands anywhere
             row = tuple(leaf[i] for leaf in args)
             pending[lane] = inner.functional_update(pending.get(lane, lanes[lane]), *row)
+        guard_active = self.__dict__["_guard"].active
+        health = self.__dict__["_lane_health_counts"]
         for lane, st in pending.items():
+            if guard_active and not _eager_state_finite(st):
+                # eager-mode row screen (the host analogue of the compiled
+                # divert-at-scatter): the poisoned pending state is DIVERTED
+                # — never committed — and attributed via the health counter;
+                # the lane keeps its last clean state
+                health[lane] += 1
+                continue
             lanes[lane] = st
             counts[lane] += 1
 
@@ -471,21 +669,303 @@ class LanedMetric(Metric):
             items = list(items.items())
         rounds = _pack_rounds(items)
         table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
         dispatches = 0
         for round_items in rounds:
-            lanes = [self._admit_for_update(sid) for sid, _ in round_items]
-            rows = len(round_items)
-            bucket = bucket_size(rows)
-            sentinel = self.capacity  # out of range -> scatter-dropped
-            lane_ids = jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32)
-            batch = self._stack_rows([b for _, b in round_items], bucket)
-            with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, rows=rows, bucket=bucket):
-                self.update(lane_ids, *batch)
-            table.touch(lanes)
-            obs.counter_inc("lanes.dispatches")
-            obs.counter_inc("lanes.rows", rows)
-            dispatches += 1
+            if guard.active:
+                guard.begin_round()
+            excluded: set = set()
+            while True:
+                current = [(sid, b) for sid, b in round_items if sid not in excluded]
+                if not current:
+                    break
+                lanes = [self._admit_for_update(sid) for sid, _ in current]
+                rows = len(current)
+                bucket = bucket_size(rows)
+                sentinel = self.capacity  # out of range -> scatter-dropped
+                if guard.active and guard.screen:
+                    # admission screening (tentpole #1): validate the round at
+                    # the pack — vectorized over the stacked batch — and
+                    # divert failing rows by sentinel-ing their lane id
+                    batch, reasons = self._stack_rows_screened([b for _, b in current], bucket)
+                    lanes = _divert_screened_rows(
+                        guard, self._apply_fault_action, current, lanes, reasons, sentinel
+                    )
+                else:
+                    batch = self._stack_rows([b for _, b in current], bucket)
+                live = [lane for lane in lanes if lane != sentinel]
+                if not live:
+                    break  # the whole round was diverted: nothing to dispatch
+                baseline = self._fetch_round_baseline(live) if guard.active else None
+                # one-shot handoff to the executor's recovery hook: the lanes
+                # this round touches, plus the already-on-host baseline rows
+                # the incremental mirror can fold from for free
+                self.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
+                try:
+                    with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, rows=rows, bucket=bucket):
+                        self.update(jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch)
+                except LaneFaultError as err:
+                    culprit = getattr(err, "session_id", None)
+                    if not guard.active or culprit is None or culprit not in {s for s, _ in current}:
+                        raise
+                    # lane-granular containment: restore the round's touched
+                    # lanes to their pre-round rows, fault the attributed
+                    # tenant, and re-dispatch the round WITHOUT it — the other
+                    # lanes sharing the dispatch still get their step
+                    self._rollback_round(live, baseline)
+                    action = guard.record_fault(culprit, "dispatch", str(err))
+                    self._apply_fault_action(culprit, action, err)
+                    if action != "evict":
+                        guard.note_diverted(culprit)  # the rolled-back offer is traffic the lane missed
+                    excluded.add(culprit)
+                    continue
+                finally:
+                    self.__dict__.pop("_round_ctx", None)
+                table.touch(live)
+                obs.counter_inc("lanes.dispatches")
+                obs.counter_inc("lanes.rows", len(live))
+                dispatches += 1
+                break
         return dispatches
+
+    # ------------------------------------------------------ fault containment
+    def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
+        """Execute a resolved ``on_lane_fault`` action for one tenant. A
+        collection member delegates to its owning LanedCollection so eviction
+        and reset stay suite-coherent (the lane is shared by every member)."""
+        owner = self.__dict__.get("_fault_owner")
+        if owner is not None:
+            owner._apply_fault_action(sid, action, err)
+            return
+        table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
+        if action == "raise":
+            raise err
+        if action == "evict":
+            if sid in table.sessions:
+                self.evict(sid)
+            guard.forget(sid)
+        elif action == "reset":
+            if sid in table.sessions:
+                self.reset_session(sid)
+        elif action == "quarantine":
+            self._quarantine_session(sid)
+
+    def _quarantine_session(self, sid: Any) -> None:
+        guard: LaneGuard = self.__dict__["_guard"]
+        table: LaneTable = self.__dict__["_table"]
+        lane = table.sessions.get(sid)
+        if lane is not None:
+            self._quarantine_restore_lane(sid, lane)
+        guard.quarantine(sid)
+
+    def _quarantine_restore_lane(self, sid: Any, lane: int) -> None:
+        """Member-local quarantine hygiene: make sure the quarantined lane
+        holds clean rows (the divert-at-scatter screen usually already
+        guarantees it — restore/reset happens only when poison actually
+        landed), and capture a last-good value so degraded reads have
+        something to serve immediately."""
+        guard: LaneGuard = self.__dict__["_guard"]
+        slot = self.__dict__.get("_guard_slot", "")
+        with obs.span(obs.SPAN_QUARANTINE, owner=type(self.inner).__name__, lane=lane):
+            committed, health = self._ensure_lane_clean(lane)
+            if not guard.has_last_good(sid, slot=slot):
+                value = self._lane_value(lane)
+                guard.capture_last_good(sid, value, committed=committed, health=health, slot=slot)
+
+    def _degraded_read(
+        self,
+        sid: Any,
+        lane: int,
+        committed_now: Optional[int] = None,
+        health_now: Optional[int] = None,
+    ) -> DegradedValue:
+        guard: LaneGuard = self.__dict__["_guard"]
+        slot = self.__dict__.get("_guard_slot", "")
+        if committed_now is None:
+            committed_now = self._lane_update_count(lane)
+        if health_now is None:
+            seen = self.__dict__.get("_health_seen")
+            health_now = int(seen[lane]) if seen is not None and lane < len(seen) else 0
+        dv = guard.degraded(sid, committed_now, health_now, slot=slot)
+        if dv is not None:
+            return dv
+        # no cached value (e.g. quarantine restored from a checkpoint):
+        # serve the current (clean) lane state as last-good
+        value = self._lane_value(lane)
+        guard.capture_last_good(sid, value, committed=committed_now, health=health_now, slot=slot)
+        dv = guard.degraded(sid, committed_now, health_now, slot=slot)
+        assert dv is not None
+        return dv
+
+    def _lane_value(self, lane: int) -> Any:
+        """One lane's raw compute value (no health scan, no degraded logic)."""
+        inner = self.inner
+        if not self._compiled_lanes:
+            return inner.functional_compute(self.__dict__["_lane_states"][lane])
+        state = {f: self._state[f][lane] for f in self._inner_fields()}
+        return inner.functional_compute(state)
+
+    def _lane_counts_host(self) -> np.ndarray:
+        """Host copy of the per-lane committed-update counters — the
+        staleness/probe anchors, fetched once per guard-active read point
+        (the caller is already reading values there)."""
+        if not self._compiled_lanes:
+            return np.asarray(self.__dict__["_lane_counts"], dtype=np.int64)
+        counts = np.asarray(self._state["lane_updates"])
+        if counts.ndim > 1:  # stacked sharded layout: updates sum across shards
+            counts = counts.sum(axis=0)
+        return counts
+
+    def _lane_update_count(self, lane: int) -> int:
+        return int(self._lane_counts_host()[lane])
+
+    def _fetch_round_baseline(self, lanes: Sequence[int]) -> Dict[str, Any]:
+        """The touched lanes' pre-dispatch rows — this round's lane-granular
+        rollback source AND the incremental mirror's fold feed (the executor's
+        recovery hook receives it via ``_round_ctx``, so the guarded path pays
+        ONE rows-sized host fetch per round, not two). ``np.asarray`` here is
+        that deliberate fetch — the laned analogue of the executor
+        ``_snapshot`` — rows-sized vs the whole-capacity copy PR 2 paid."""
+        if not self._compiled_lanes:
+            states = self.__dict__["_lane_states"]
+            counts = self.__dict__["_lane_counts"]
+            health = self.__dict__["_lane_health_counts"]
+            return {
+                lane: (
+                    {k: (list(v) if isinstance(v, list) else v) for k, v in states[lane].items()},
+                    counts[lane],
+                    health[lane],
+                )
+                for lane in lanes
+            }
+        fields = self._inner_fields() + list(self._LANE_AUX_FIELDS)
+        idx = jnp.asarray(list(lanes), jnp.int32)
+        return {f: np.asarray(jnp.take(self._state[f], idx, axis=0)) for f in fields}
+
+    def _rollback_round(self, lanes: Sequence[int], baseline: Optional[Dict[str, Any]]) -> None:
+        """Restore every lane touched by a failed round to its pre-round rows
+        (eager mode: reinstall the staged per-lane dicts). ``baseline`` is the
+        round's :meth:`_fetch_round_baseline` capture."""
+        if baseline is None:
+            return
+        if not self._compiled_lanes:
+            states = self.__dict__["_lane_states"]
+            counts = self.__dict__["_lane_counts"]
+            health = self.__dict__["_lane_health_counts"]
+            for lane in lanes:
+                entry = baseline.get(lane)
+                if entry is not None:
+                    states[lane], counts[lane], health[lane] = (
+                        {k: (list(v) if isinstance(v, list) else v) for k, v in entry[0].items()},
+                        entry[1],
+                        entry[2],
+                    )
+            self._computed = None
+            return
+        self._restore_lane_rows(list(lanes), baseline)
+
+    def _ensure_lane_clean(self, lane: int) -> Tuple[int, int]:
+        """Guarantee ``lane`` holds finite rows. The divert-at-scatter screen
+        keeps a guarded lane clean by construction, so the fast path is a
+        check; when poison actually landed (e.g. corruption outside the
+        dispatch), the lane restores from the recovery mirror's last clean
+        rows, or a masked reset as last resort — other lanes' committed
+        updates survive either way. Returns the ``(committed, health)``
+        counters the (restored) lane reflects — the staleness anchors for a
+        last-good capture. ``np.asarray`` here is a one-lane fault-path fetch.
+        """
+        stash = self.__dict__.get("_pending_capture_health") or {}
+        if not self._compiled_lanes:
+            if _eager_state_finite(self.__dict__["_lane_states"][lane]):
+                anchor = stash.get(lane, self.__dict__["_lane_health_counts"][lane])
+                return int(self.__dict__["_lane_counts"][lane]), int(anchor)
+            self._reset_lane_indices([lane])
+            self.__dict__["_lane_health_counts"][lane] = 0
+            return 0, 0
+        fields = self._inner_fields() + list(self._LANE_AUX_FIELDS)
+        current = {f: np.asarray(self._state[f][lane]) for f in fields}
+        if _host_rows_finite(current):
+            anchor = stash.get(lane, int(current["lane_health"]))
+            return int(current["lane_updates"]), int(anchor)
+        rows = self.__dict__["_lane_mirror"].rows([lane])
+        if rows is not None:
+            rows = {f: np.asarray(v)[0] for f, v in rows.items()}
+            if _host_rows_finite(rows):
+                self._restore_lane_rows([lane], {f: v[None] for f, v in rows.items()})
+                self.__dict__["_health_seen"][lane] = int(rows["lane_health"])
+                return int(rows["lane_updates"]), int(rows["lane_health"])
+        self._reset_lane_indices([lane])
+        return 0, 0
+
+    def _restore_lane_rows(self, lanes: Sequence[int], rows: Dict[str, Any]) -> None:
+        """Scatter ``rows`` back into the stacked state at ``lanes`` — the
+        masked, shape-stable restore other lanes never observe."""
+        idx = jnp.asarray(list(lanes), jnp.int32)
+        for f in self._inner_fields() + list(self._LANE_AUX_FIELDS):
+            if f in rows:
+                self._state[f] = self._state[f].at[idx].set(jnp.asarray(rows[f]))
+        self.__dict__["_state_escaped"] = True
+        self._computed = None
+        self.__dict__["_lane_mirror"].patch_rows(lanes, {f: np.asarray(v) for f, v in rows.items()})
+
+    def _scan_lane_health(self) -> None:
+        """Read-point device-side poison attribution (tentpole #2): diff the
+        fused ``lane_health`` counters against the last scan and apply the
+        fault policy to newly-poisoned lanes. The counters ride the update
+        dispatch itself, so the steady path pays zero extra host syncs —
+        attribution happens here, where the caller is already reading values."""
+        guard: LaneGuard = self.__dict__["_guard"]
+        if not guard.active:
+            return
+        table: LaneTable = self.__dict__["_table"]
+        if self._compiled_lanes:
+            self._fold_pending()
+            health = np.asarray(self._state["lane_health"])
+            if health.ndim > 1:  # stacked sharded layout: faults sum across shards
+                health = health.sum(axis=0)
+        else:
+            health = np.asarray(self.__dict__["_lane_health_counts"])
+        seen = self.__dict__.get("_health_seen")
+        if seen is None or np.shape(seen) != health.shape:
+            seen = np.zeros_like(health)
+        newly = np.flatnonzero(health > seen)
+        self.__dict__["_health_seen"] = health.astype(np.int64).copy()
+        # anchors for any last-good capture this scan triggers: the PRE-fault
+        # health count, so the quarantining poisoned update itself counts as
+        # traffic the served value is missing (updates_behind >= 1)
+        self.__dict__["_pending_capture_health"] = {int(lane): int(seen[int(lane)]) for lane in newly}
+        try:
+            for lane in newly:
+                sid = table.lane_session[int(lane)]
+                if sid is None:
+                    continue
+                action = guard.record_fault(
+                    sid, "device", f"non-finite update in lane {int(lane)} (health={int(health[lane])})"
+                )
+                self._apply_fault_action(
+                    sid,
+                    action,
+                    LaneFaultError(
+                        f"lane {int(lane)} (session {sid!r}) produced a non-finite update",
+                        session_id=sid,
+                        lane=int(lane),
+                        where="device",
+                    ),
+                )
+        finally:
+            self.__dict__.pop("_pending_capture_health", None)
+        if guard.quarantined:
+            # probation progress: committed updates since the last scan with
+            # no new fault are clean probes (the divert-at-scatter screen
+            # already validated them on device)
+            counts = self._lane_counts_host()
+            newly_set = {int(lane) for lane in newly}
+            for sid in list(guard.quarantined):
+                lane = table.sessions.get(sid)
+                if lane is None:
+                    continue
+                guard.probe_progress(sid, int(counts[lane]), faulted=lane in newly_set)
 
     @staticmethod
     def _stack_rows(batches: List[Tuple[Any, ...]], bucket: int) -> Tuple[Any, ...]:
@@ -516,6 +996,123 @@ class LanedMetric(Metric):
             out.append(jnp.asarray(np.stack(rows, axis=0)))
         return tuple(out)
 
+    @staticmethod
+    def _stack_rows_screened(
+        batches: List[Tuple[Any, ...]], bucket: int
+    ) -> Tuple[Tuple[Any, ...], List[Optional[str]]]:
+        """:meth:`_stack_rows` with admission screening (docs/LANES.md
+        "Failure semantics"): instead of one malformed tenant failing the
+        whole pack, every row is validated — leaf count, per-leaf shape,
+        dtype KIND, finiteness of float leaves — and the per-row rejection
+        reason (or None) is returned alongside the stacked leaves. Rejected
+        rows are substituted with a conforming row so the stack stays
+        uniform; the router diverts them by sentinel-ing their lane ids, so
+        the substitute values can never land. The screen is vectorized: the
+        shape/dtype checks ride the stacking pass itself and the finite scan
+        is ONE ``np.isfinite`` over each stacked float leaf — per-row Python
+        work only happens for rows that already failed."""
+        n = len(batches)
+        reasons: List[Optional[str]] = [None] * n
+        n_leaves = len(batches[0])
+        # FAST PATH — every row conforms (the overwhelmingly common round):
+        # identical to _stack_rows plus one dtype-uniformity set and one
+        # vectorized finite pass per float leaf; the first deviant falls
+        # through to the per-row screen below
+        if not any(len(b) != n_leaves for b in batches):
+            try:
+                out = []
+                for leaf_idx in range(n_leaves):
+                    rows = [np.asarray(b[leaf_idx]) for b in batches]
+                    kinds = {r.dtype.kind for r in rows}
+                    # KIND-level check: exact-width drift (int32 vs int64) is
+                    # promotion, not corruption — np.stack upcasts, same as
+                    # the unscreened pack
+                    if len(kinds) != 1 or rows[0].dtype.kind not in "fiub":
+                        raise _ScreenSlowPath()
+                    pad = bucket - n
+                    if pad:
+                        rows.extend([rows[0]] * pad)  # values irrelevant: sentinel rows are dropped
+                    stacked = np.stack(rows, axis=0)  # raises on ragged shapes -> slow path
+                    if stacked.dtype.kind == "f":
+                        finite = np.isfinite(stacked[:n].reshape(n, -1)).all(axis=1)
+                        if not finite.all():
+                            for i in np.flatnonzero(~finite):
+                                if reasons[i] is None:
+                                    reasons[i] = f"leaf {leaf_idx} carries non-finite values"
+                    out.append(jnp.asarray(stacked))
+                return tuple(out), reasons
+            except Exception as err:  # any deviant (ragged/mixed/garbage row)
+                rank_zero_debug(f"_stack_rows_screened: round fell to the per-row screen ({err!r})")
+                reasons = [None] * n
+        # SLOW PATH — at least one deviant row: majority-vote the round's
+        # reference layout so one malformed tenant cannot redefine it, and
+        # screen each row against it
+        counts: Dict[int, int] = {}
+        for b in batches:
+            counts[len(b)] = counts.get(len(b), 0) + 1
+        n_leaves = max(counts, key=lambda k: (counts[k], -k))
+        arrs: List[Optional[List[np.ndarray]]] = []
+        for i, b in enumerate(batches):
+            if len(b) != n_leaves:
+                reasons[i] = f"row has {len(b)} leaves, round expects {n_leaves}"
+                arrs.append(None)
+                continue
+            try:
+                leaves = [np.asarray(leaf) for leaf in b]
+                bad_kind = next((a for a in leaves if a.dtype.kind not in "fiub"), None)
+                if bad_kind is not None:
+                    # np.asarray(garbage) yields an object array, not an error
+                    reasons[i] = f"row carries non-numeric dtype {bad_kind.dtype}"
+                    arrs.append(None)
+                else:
+                    arrs.append(leaves)
+            except Exception as err:
+                # the reason IS the fault record: it lands in the guard's log
+                rank_zero_debug(f"_stack_rows_screened: row {i} not array-like ({type(err).__name__}: {err})")
+                reasons[i] = f"row is not array-like ({type(err).__name__})"
+                arrs.append(None)
+        if all(a is None for a in arrs):
+            return None, reasons  # nothing stackable: the router diverts the whole round
+        spec = row_spec_majority([tuple(a) for a in arrs if a is not None])
+        candidates = sum(1 for a in arrs if a is not None)
+        for i, a in enumerate(arrs):
+            if a is None or reasons[i] is not None or spec is None:
+                continue
+            reason = screen_row(tuple(a), spec, check_finite=False)
+            if reason is not None:
+                reasons[i] = reason
+                arrs[i] = None
+        kept_n = sum(1 for i, a in enumerate(arrs) if a is not None and reasons[i] is None)
+        if kept_n * 2 <= candidates:
+            # no STRICT majority layout (e.g. a 1-vs-1 shape tie): this is
+            # legitimately mixed traffic, not one malformed tenant — keep the
+            # unscreened contract (raise) instead of arbitrarily faulting half
+            # the round
+            raise ValueError(
+                "per-session batches in one dispatch must share shapes/layout; no"
+                " majority layout exists — send differently-shaped traffic in"
+                " separate update_sessions calls"
+            )
+        out = []
+        for leaf_idx in range(n_leaves):
+            rows = [a[leaf_idx] if a is not None else None for a in arrs]
+            live = [r for r in rows if r is not None]
+            if not live:
+                return None, reasons
+            template = live[0]
+            filled = [r if r is not None else template for r in rows]
+            pad = bucket - len(filled)
+            if pad:
+                filled.extend([template] * pad)  # values irrelevant: sentinel rows are dropped
+            stacked = np.stack(filled, axis=0)
+            if stacked.dtype.kind == "f":
+                finite = np.isfinite(stacked[:n].reshape(n, -1)).all(axis=1)
+                for i in np.flatnonzero(~finite):
+                    if reasons[i] is None:
+                        reasons[i] = f"leaf {leaf_idx} carries non-finite values"
+            out.append(jnp.asarray(stacked))
+        return tuple(out), reasons
+
     def _admit_for_update(self, session_id: Any) -> int:
         table: LaneTable = self.__dict__["_table"]
         lane = table.sessions.get(session_id)
@@ -542,6 +1139,7 @@ class LanedMetric(Metric):
         table: LaneTable = self.__dict__["_table"]
         lane = table.release(session_id)
         self._reset_lane_indices([lane])
+        self.__dict__["_guard"].forget(session_id)
         self._computed = None
         obs.counter_inc("lanes.evictions")
         obs.gauge_set("lanes.occupancy", table.active)
@@ -565,11 +1163,13 @@ class LanedMetric(Metric):
         obs.counter_inc("lanes.resets")
 
     def _reset_lane_indices(self, lanes: Sequence[int]) -> None:
+        self.__dict__["_lane_mirror"].invalidate()  # out-of-band state mutation
         if not self._compiled_lanes:
             inner = self.inner
             for lane in lanes:
                 self.__dict__["_lane_states"][lane] = inner.init_state()
                 self.__dict__["_lane_counts"][lane] = 0
+                self.__dict__["_lane_health_counts"][lane] = 0
             return
         mask = np.zeros(self.capacity, dtype=bool)
         mask[list(lanes)] = True
@@ -578,7 +1178,8 @@ class LanedMetric(Metric):
             inner = self.inner
             cap = self.capacity
             defaults = {f: self._stacked_default(d, cap) for f, d in inner._defaults.items()}
-            defaults["lane_updates"] = jnp.zeros((cap,), jnp.int32)
+            for aux in self._LANE_AUX_FIELDS:
+                defaults[aux] = jnp.zeros((cap,), jnp.int32)
 
             def body(states: Dict[str, Any], m: Any) -> Dict[str, Any]:
                 out = {}
@@ -589,20 +1190,28 @@ class LanedMetric(Metric):
 
             fn = jax.jit(body)
             self.__dict__["_reset_fn"] = fn
-        fields = self._inner_fields() + ["lane_updates"]
+        fields = self._inner_fields() + list(self._LANE_AUX_FIELDS)
         new_states = fn({f: self._state[f] for f in fields}, jnp.asarray(mask))
         for f in fields:
             self._state[f] = new_states[f]
+        seen = self.__dict__.get("_health_seen")
+        if seen is not None:
+            for lane in lanes:
+                if lane < len(seen):
+                    seen[lane] = 0
         self.__dict__["_state_escaped"] = True
 
     def reset(self) -> None:
         """Reset EVERY lane's state to defaults. Session→lane assignments are
         kept (a service reset clears accumulators, not its routing table)."""
         super().reset()
+        self.__dict__["_lane_mirror"].invalidate()
+        self.__dict__["_health_seen"] = np.zeros((self.capacity,), np.int64)
         if not self._compiled_lanes:
             inner = self.inner
             self.__dict__["_lane_states"] = [inner.init_state() for _ in range(self.capacity)]
             self.__dict__["_lane_counts"] = [0] * self.capacity
+            self.__dict__["_lane_health_counts"] = [0] * self.capacity
 
     # ----------------------------------------------------------------- growth
     def grow(self, new_capacity: Optional[int] = None) -> int:
@@ -629,20 +1238,28 @@ class LanedMetric(Metric):
 
     def _grow_state(self, target: int) -> None:
         old = self.capacity
+        self.__dict__["_lane_mirror"].invalidate()
+        seen = self.__dict__.get("_health_seen")
+        grown_seen = np.zeros((target,), np.int64)
+        if seen is not None:
+            grown_seen[: min(old, len(seen))] = np.asarray(seen)[: min(old, len(seen))]
+        self.__dict__["_health_seen"] = grown_seen
         if not self._compiled_lanes:
             inner = self.inner
             self.__dict__["_lane_states"].extend(inner.init_state() for _ in range(target - old))
             self.__dict__["_lane_counts"].extend([0] * (target - old))
+            self.__dict__["_lane_health_counts"].extend([0] * (target - old))
             return
         inner = self.inner
         for f, default in inner._defaults.items():
             stacked = self._stacked_default(default, target)
             self._defaults[f] = stacked
             self._state[f] = jnp.concatenate([self._state[f], stacked[old:]], axis=0)
-        self._defaults["lane_updates"] = jnp.zeros((target,), jnp.int32)
-        self._state["lane_updates"] = jnp.concatenate(
-            [self._state["lane_updates"], jnp.zeros((target - old,), jnp.int32)]
-        )
+        for aux in self._LANE_AUX_FIELDS:
+            self._defaults[aux] = jnp.zeros((target,), jnp.int32)
+            self._state[aux] = jnp.concatenate(
+                [self._state[aux], jnp.zeros((target - old,), jnp.int32)]
+            )
         self.__dict__["_state_escaped"] = True
         self.__dict__["_reset_fn"] = None  # capacity-shaped closures rebuild lazily
         self.__dict__["_lane_compute_fn"] = None
@@ -715,18 +1332,32 @@ class LanedMetric(Metric):
 
     # ------------------------------------------------------------- read paths
     def _active_mask(self) -> jnp.ndarray:
-        return jnp.asarray(self.__dict__["_table"].active_mask())
+        """Lanes contributing to the all-lane aggregate: active sessions MINUS
+        quarantined ones — a quarantined tenant's (rolled-back) state must not
+        leak into the aggregate while it serves degraded reads."""
+        table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
+        mask = table.active_mask()
+        if guard.active and guard.quarantined:
+            for sid in guard.quarantined:
+                lane = table.sessions.get(sid)
+                if lane is not None:
+                    mask[lane] = False
+        return jnp.asarray(mask)
 
     def compute(self) -> Any:
-        """All-lane aggregate: fold ACTIVE lanes per declared reduction
-        (inactive lanes contribute the family's identity element —
-        ``parallel.sync.reduction_identity``), then the inner compute."""
+        """All-lane aggregate: fold ACTIVE (non-quarantined) lanes per
+        declared reduction (inactive lanes contribute the family's identity
+        element — ``parallel.sync.reduction_identity``), then the inner
+        compute."""
+        self._scan_lane_health()
         inner = self.inner
         table: LaneTable = self.__dict__["_table"]
         if table.active == 0:
             return inner.functional_compute(inner.init_state())
         if not self._compiled_lanes:
-            return inner.functional_compute(self._fold_eager())
+            folded = self._fold_eager()
+            return inner.functional_compute(folded if folded is not None else inner.init_state())
         folded = self._fold_lanes({f: self._state[f] for f in self._inner_fields()}, self._active_mask())
         return inner.functional_compute(folded)
 
@@ -756,10 +1387,15 @@ class LanedMetric(Metric):
                 out[f] = masked.min(0)
         return out
 
-    def _fold_eager(self) -> Dict[str, Any]:
+    def _fold_eager(self) -> Optional[Dict[str, Any]]:
         inner = self.inner
         table: LaneTable = self.__dict__["_table"]
-        lanes = sorted(table.sessions.values())
+        guard: LaneGuard = self.__dict__["_guard"]
+        lanes = sorted(
+            lane
+            for sid, lane in table.sessions.items()
+            if not (guard.active and guard.is_quarantined(sid))
+        )
         folded = None
         for lane in lanes:
             st = self.__dict__["_lane_states"][lane]
@@ -768,49 +1404,99 @@ class LanedMetric(Metric):
 
     def lane_values(self) -> Dict[Any, Any]:
         """Per-lane ``compute()`` for every active session: one vmapped
-        compute over the stacked state, indexed back per session."""
+        compute over the stacked state, indexed back per session. Quarantined
+        sessions serve their last-good value as a
+        :class:`~torchmetrics_tpu.quarantine.DegradedValue` (staleness
+        metadata attached); healthy reads refresh the last-good cache."""
+        self._scan_lane_health()
         self._fold_pending()  # a sharded (deferred) restore folds first
         table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
+        slot = self.__dict__.get("_guard_slot", "")
         if not table.sessions:
             return {}
         if not self._compiled_lanes:
             inner = self.inner
-            return {
-                sid: inner.functional_compute(self.__dict__["_lane_states"][lane])
-                for sid, lane in table.sessions.items()
+            vals_by_lane = {
+                lane: inner.functional_compute(self.__dict__["_lane_states"][lane])
+                for lane in table.sessions.values()
             }
-        fn = self.__dict__.get("_lane_compute_fn")
-        if fn is None:
-            inner = self.inner
 
-            def body(states: Dict[str, Any]) -> Any:
-                return jax.vmap(inner.functional_compute)(states)
+            def value_of(lane: int) -> Any:
+                return vals_by_lane[lane]
 
-            fn = jax.jit(body)
-            self.__dict__["_lane_compute_fn"] = fn
-        with obs.span(obs.SPAN_COMPUTE, suffix=f"Laned{type(self.inner).__name__}"):
-            vals = fn({f: self._state[f] for f in self._inner_fields()})
-        return {
-            sid: jax.tree_util.tree_map(lambda v: v[lane], vals)
-            for sid, lane in table.sessions.items()
-        }
+        else:
+            fn = self.__dict__.get("_lane_compute_fn")
+            if fn is None:
+                inner = self.inner
+
+                def body(states: Dict[str, Any]) -> Any:
+                    return jax.vmap(inner.functional_compute)(states)
+
+                fn = jax.jit(body)
+                self.__dict__["_lane_compute_fn"] = fn
+            with obs.span(obs.SPAN_COMPUTE, suffix=f"Laned{type(self.inner).__name__}"):
+                vals = fn({f: self._state[f] for f in self._inner_fields()})
+
+            def value_of(lane: int) -> Any:
+                return jax.tree_util.tree_map(lambda v: v[lane], vals)
+
+        counts = self._lane_counts_host() if guard.active else None
+        seen = self.__dict__.get("_health_seen")
+        out: Dict[Any, Any] = {}
+        for sid, lane in table.sessions.items():
+            if guard.active and guard.is_quarantined(sid):
+                out[sid] = self._degraded_read(
+                    sid,
+                    lane,
+                    committed_now=int(counts[lane]),
+                    health_now=int(seen[lane]) if seen is not None and lane < len(seen) else 0,
+                )
+                continue
+            value = value_of(lane)
+            if guard.active:
+                guard.capture_last_good(
+                    sid,
+                    value,
+                    committed=int(counts[lane]),
+                    health=int(seen[lane]) if seen is not None and lane < len(seen) else 0,
+                    slot=slot,
+                )
+            out[sid] = value
+        return out
 
     def compute_session(self, session_id: Any) -> Any:
-        """One session's ``compute()`` value."""
+        """One session's ``compute()`` value — or its last-good
+        :class:`~torchmetrics_tpu.quarantine.DegradedValue` while the session
+        is quarantined."""
+        self._scan_lane_health()
         self._fold_pending()
         table: LaneTable = self.__dict__["_table"]
+        guard: LaneGuard = self.__dict__["_guard"]
         lane = table.lane_of(session_id)
-        inner = self.inner
-        if not self._compiled_lanes:
-            return inner.functional_compute(self.__dict__["_lane_states"][lane])
-        state = {f: self._state[f][lane] for f in self._inner_fields()}
-        return inner.functional_compute(state)
+        if guard.active and guard.is_quarantined(session_id):
+            return self._degraded_read(session_id, lane)
+        value = self._lane_value(lane)
+        if guard.active:
+            seen = self.__dict__.get("_health_seen")
+            guard.capture_last_good(
+                session_id,
+                value,
+                committed=self._lane_update_count(lane),
+                health=int(seen[lane]) if seen is not None and lane < len(seen) else 0,
+                slot=self.__dict__.get("_guard_slot", ""),
+            )
+        return value
 
     # ------------------------------------------------------------- durability
     def _export_extras(self) -> Dict[str, Any]:
         """Host-side metadata a recovery-reused snapshot must carry alongside
         the array states (ops/executor.py ``latest_recovery_snapshot``)."""
-        return {self._LANE_DIR_KEY: _encode_directory(self.__dict__["_table"])}
+        out = {self._LANE_DIR_KEY: _encode_directory(self.__dict__["_table"])}
+        guard: LaneGuard = self.__dict__["_guard"]
+        if guard.active:
+            out[self._QUARANTINE_KEY] = _encode_json_blob(guard.to_json())
+        return out
 
     def state(self) -> Dict[str, Any]:
         """Stacked state export carrying the session→lane directory under the
@@ -826,7 +1512,7 @@ class LanedMetric(Metric):
             f"lane_{i:05d}": {**self.__dict__["_lane_states"][i], self._STATE_COUNT_KEY: self.__dict__["_lane_counts"][i]}
             for i in range(table.capacity)
         }
-        out["_lanes"] = {self._LANE_DIR_KEY: _encode_directory(table)}
+        out["_lanes"] = dict(self._export_extras())
         return out
 
     def load_state(
@@ -850,9 +1536,14 @@ class LanedMetric(Metric):
             return
         blob = state.pop(self._LANE_DIR_KEY, None)
         table = _decode_directory(blob) if blob is not None else None
+        qblob = state.pop(self._QUARANTINE_KEY, None)
         if sharded is None:
             sharded = state.get(self._STATE_SHARDS_KEY) is not None
         cap = self._infer_capacity(state, sharded=bool(sharded))
+        if "lane_health" not in state and "lane_updates" in state:
+            # pre-containment checkpoint (no fused health counter): lanes were
+            # never device-attributed, so a zero counter is the exact restore
+            state["lane_health"] = np.zeros_like(np.asarray(state["lane_updates"]))
         if table is not None and validate != "off" and table.capacity != cap:
             raise StateCorruptionError(
                 f"{type(self).__name__}: lane directory says capacity {table.capacity} but state"
@@ -872,8 +1563,32 @@ class LanedMetric(Metric):
         if table is not None:
             self.__dict__["_table"] = table
         self._validate_lanes(check_finite=check_finite, sharded=bool(sharded), mode=validate)
+        self._restore_guard(qblob)
         obs.gauge_set("lanes.capacity", self.capacity)
         obs.gauge_set("lanes.occupancy", self.__dict__["_table"].active)
+
+    def _restore_guard(self, qblob: Any) -> None:
+        """Re-arm the fault guard from a checkpointed quarantine blob (restore
+        re-validates: records for sessions absent from the restored directory
+        are dropped) and re-seed the host health baseline from the restored
+        ``lane_health`` counters so historical faults are not re-attributed."""
+        guard: LaneGuard = self.__dict__["_guard"]
+        table: LaneTable = self.__dict__["_table"]
+        if qblob is not None:
+            guard.load_json(
+                _decode_json_blob(qblob, f"{type(self).__name__} quarantine state"),
+                known_sessions=set(table.sessions),
+            )
+        if self._compiled_lanes:
+            health = np.asarray(self._state["lane_health"])
+            if health.ndim > 1:
+                health = health.sum(axis=0)
+            self.__dict__["_health_seen"] = health.astype(np.int64).copy()
+        else:
+            self.__dict__["_health_seen"] = np.asarray(
+                self.__dict__["_lane_health_counts"], dtype=np.int64
+            )
+        self.__dict__["_lane_mirror"].invalidate()
 
     def _infer_capacity(self, state: Dict[str, Any], sharded: bool) -> int:
         axis = 1 if sharded else 0
@@ -895,8 +1610,11 @@ class LanedMetric(Metric):
             stacked = self._stacked_default(default, capacity)
             self._defaults[f] = stacked
             self._state[f] = stacked
-        self._defaults["lane_updates"] = jnp.zeros((capacity,), jnp.int32)
-        self._state["lane_updates"] = jnp.zeros((capacity,), jnp.int32)
+        for aux in self._LANE_AUX_FIELDS:
+            self._defaults[aux] = jnp.zeros((capacity,), jnp.int32)
+            self._state[aux] = jnp.zeros((capacity,), jnp.int32)
+        self.__dict__["_lane_mirror"].invalidate()
+        self.__dict__["_health_seen"] = np.zeros((capacity,), np.int64)
         self.__dict__["_state_escaped"] = True
         self.__dict__["_reset_fn"] = None
         self.__dict__["_lane_compute_fn"] = None
@@ -914,20 +1632,21 @@ class LanedMetric(Metric):
                     f"{type(self).__name__}: directory capacity {table.capacity} !="
                     f" state capacity {self.capacity}"
                 )
-            counts = np.asarray(self._state["lane_updates"])
-            if sharded:
-                counts = counts.sum(axis=0)
-            if counts.ndim != 1 or counts.shape[0] != self.capacity:
-                raise StateCorruptionError(
-                    f"{type(self).__name__}: lane_updates has shape {counts.shape},"
-                    f" expected ({self.capacity},)"
-                )
-            bad = np.flatnonzero(counts < 0)
-            if bad.size:
-                raise StateCorruptionError(
-                    f"{type(self).__name__}: negative per-lane update counts in lane(s)"
-                    f" {[int(b) for b in bad[:8]]}"
-                )
+            for aux in self._LANE_AUX_FIELDS:
+                counts = np.asarray(self._state[aux])
+                if sharded:
+                    counts = counts.sum(axis=0)
+                if counts.ndim != 1 or counts.shape[0] != self.capacity:
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: {aux} has shape {counts.shape},"
+                        f" expected ({self.capacity},)"
+                    )
+                bad = np.flatnonzero(counts < 0)
+                if bad.size:
+                    raise StateCorruptionError(
+                        f"{type(self).__name__}: negative per-lane {aux} counts in lane(s)"
+                        f" {[int(b) for b in bad[:8]]}"
+                    )
         if check_finite and not sharded:
             # the stacked lane layout shares the sharded per-shard scan: a
             # poisoned lane is NAMED instead of failing the whole array
@@ -966,12 +1685,45 @@ class LanedMetric(Metric):
             counts.append(count)
         self.__dict__["_lane_states"] = staged
         self.__dict__["_lane_counts"] = counts
+        self.__dict__["_lane_health_counts"] = [0] * capacity
         if table is not None:
             self.__dict__["_table"] = table
         elif capacity != self.capacity:
             self.__dict__["_table"] = LaneTable(capacity)
         self._computed = None
         self._update_count = self._restored_count(None, fallback=max(counts) if counts else 1)
+        self._restore_guard((lanes_meta or {}).get(self._QUARANTINE_KEY))
+
+    def _recovery_snapshot(self, state: Dict[str, Any], args: Tuple[Any, ...]) -> Any:
+        """Executor recovery hook (ops/executor.py ``_take_recovery``): the
+        incremental :class:`~torchmetrics_tpu.quarantine.LaneStateMirror`
+        replaces the whole-capacity host snapshot PR 2's containment paid on
+        every donating laned call — the warm path folds forward only the rows
+        the previous round touched; a dispatch death reinstalls the full
+        pre-call state from the mirror. Returns None (full-snapshot fallback)
+        when lane-granular bookkeeping is impossible."""
+        if not self._compiled_lanes:
+            return None
+        ctx = self.__dict__.pop("_round_ctx", None)
+        known_rows = None
+        if ctx is not None:
+            lanes = ctx["lanes"]
+            baseline = ctx["baseline"]
+            if baseline is not None:
+                # the router's guard-active pre-round baseline holds these
+                # lanes' CURRENT rows already on host: the mirror folds its
+                # pending set from it for free (steady same-sessions rounds
+                # need no extra device fetch at all)
+                known_rows = (np.asarray(lanes, dtype=np.int64), baseline)
+        else:
+            if not args:
+                return None
+            lanes = np.asarray(args[0])  # low-level update(): tiny host fetch of the ids
+            if lanes.ndim != 1 or lanes.dtype.kind not in "iu" or int(lanes.max(initial=0)) > self.capacity:
+                return None  # not a lane-id leaf: fall back to the full snapshot
+        return self.__dict__["_lane_mirror"].snapshot(
+            state, lanes, int(self._update_count), self.capacity, known_rows=known_rows
+        )
 
     # --------------------------------------------------------------- plumbing
     def __getstate__(self) -> Dict[str, Any]:
@@ -979,7 +1731,19 @@ class LanedMetric(Metric):
         # capacity-shaped jitted closures are process-local; rebuilt lazily
         out["_reset_fn"] = None
         out["_lane_compute_fn"] = None
+        # the recovery mirror chains off this process's commit stream
+        out["_lane_mirror"] = LaneStateMirror()
+        out.pop("_round_ctx", None)
+        out.pop("_pending_capture_health", None)
+        out.pop("_fault_owner", None)  # re-linked by the owning LanedCollection
         return out
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        self.__dict__.setdefault("_guard", LaneGuard())
+        self.__dict__.setdefault("_guard_slot", "")
+        self.__dict__.setdefault("_lane_mirror", LaneStateMirror())
+        self.__dict__.setdefault("_health_seen", np.zeros((self.capacity,), np.int64))
 
     def __repr__(self) -> str:
         table: LaneTable = self.__dict__["_table"]
@@ -1011,6 +1775,11 @@ class LanedCollection:
         metrics: Union[Dict[str, Metric], Sequence[Metric], "Any"],
         capacity: int = DEFAULT_CAPACITY,
         max_capacity: Optional[int] = None,
+        on_lane_fault: Optional[str] = None,
+        breaker_threshold: int = 3,
+        breaker_window: int = 32,
+        unquarantine_after: int = 2,
+        admission_screen: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         from torchmetrics_tpu.collections import MetricCollection
@@ -1029,10 +1798,32 @@ class LanedCollection:
             metrics = named
         capacity = lane_capacity_bucket(capacity)
         self._table = LaneTable(capacity)
+        # ONE guard across the suite (like the shared table): a faulting
+        # tenant is quarantined in every member at once
+        self._guard = LaneGuard(
+            policy=on_lane_fault,
+            breaker_threshold=breaker_threshold,
+            breaker_window=breaker_window,
+            unquarantine_after=unquarantine_after,
+            screen=admission_screen,
+        )
         self._members: Dict[str, LanedMetric] = {
-            name: LanedMetric(m, capacity=capacity, max_capacity=max_capacity, table=self._table, **kwargs)
+            name: LanedMetric(
+                m,
+                capacity=capacity,
+                max_capacity=max_capacity,
+                table=self._table,
+                guard=self._guard,
+                **kwargs,
+            )
             for name, m in metrics.items()
         }
+        for name, member in self._members.items():
+            member.__dict__["_guard_slot"] = name  # distinct last-good caches
+            # fault actions route through the collection: eviction/reset must
+            # span every member sharing the lane, never just the member whose
+            # health scan attributed the fault
+            member.__dict__["_fault_owner"] = self
         self.collection = MetricCollection(dict(self._members))
         self.max_capacity = None if max_capacity is None else lane_capacity_bucket(max_capacity)
 
@@ -1053,8 +1844,20 @@ class LanedCollection:
             "free": self._table.free,
             "max_capacity": self.max_capacity,
             "members": sorted(self._members),
+            "policy": self._guard.policy,
+            "quarantined": len(self._guard.quarantined),
             **self._table.stats,
+            **{k: v for k, v in self._guard.stats.items()},
         }
+
+    @property
+    def guard(self) -> LaneGuard:
+        """The suite-wide lane fault-containment registry."""
+        return self._guard
+
+    def quarantine_table(self) -> List[Dict[str, Any]]:
+        """The per-tenant fault/quarantine/staleness table for the suite."""
+        return self._guard.table(lane_of=dict(self._table.sessions))
 
     @property
     def executor_status(self) -> Dict[str, Any]:
@@ -1080,21 +1883,81 @@ class LanedCollection:
         if isinstance(items, dict):
             items = list(items.items())
         rounds = _pack_rounds(items)
+        guard = self._guard
         dispatches = 0
         for round_items in rounds:
-            lanes = [self.admit(sid) for sid, _ in round_items]
-            rows = len(round_items)
-            bucket = bucket_size(rows)
-            sentinel = self.capacity
-            lane_ids = jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32)
-            batch = LanedMetric._stack_rows([b for _, b in round_items], bucket)
-            with obs.span(obs.SPAN_LANES, owner="LanedCollection", rows=rows, bucket=bucket):
-                self.collection.update(lane_ids, *batch)
-            self._table.touch(lanes)
-            obs.counter_inc("lanes.dispatches")
-            obs.counter_inc("lanes.rows", rows)
-            dispatches += 1
+            if guard.active:
+                guard.begin_round()
+            excluded: set = set()
+            while True:
+                current = [(sid, b) for sid, b in round_items if sid not in excluded]
+                if not current:
+                    break
+                lanes = [self.admit(sid) for sid, _ in current]
+                rows = len(current)
+                bucket = bucket_size(rows)
+                sentinel = self.capacity
+                if guard.active and guard.screen:
+                    batch, reasons = LanedMetric._stack_rows_screened([b for _, b in current], bucket)
+                    lanes = _divert_screened_rows(
+                        guard, self._apply_fault_action, current, lanes, reasons, sentinel
+                    )
+                else:
+                    batch = LanedMetric._stack_rows([b for _, b in current], bucket)
+                live = [lane for lane in lanes if lane != sentinel]
+                if not live:
+                    break  # the whole round was diverted: nothing to dispatch
+                baselines: Dict[str, Any] = {}
+                for name, m in self._members.items():
+                    baseline = m._fetch_round_baseline(live) if guard.active else None
+                    baselines[name] = baseline
+                    m.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
+                try:
+                    with obs.span(obs.SPAN_LANES, owner="LanedCollection", rows=rows, bucket=bucket):
+                        self.collection.update(
+                            jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch
+                        )
+                except LaneFaultError as err:
+                    culprit = getattr(err, "session_id", None)
+                    if not guard.active or culprit is None or culprit not in {s for s, _ in current}:
+                        raise
+                    for name, m in self._members.items():
+                        m._rollback_round(live, baselines[name])
+                    action = guard.record_fault(culprit, "dispatch", str(err))
+                    self._apply_fault_action(culprit, action, err)
+                    if action != "evict":
+                        guard.note_diverted(culprit)
+                    excluded.add(culprit)
+                    continue
+                finally:
+                    for m in self._members.values():
+                        m.__dict__.pop("_round_ctx", None)
+                self._table.touch(live)
+                obs.counter_inc("lanes.dispatches")
+                obs.counter_inc("lanes.rows", len(live))
+                dispatches += 1
+                break
         return dispatches
+
+    def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
+        """Suite-wide ``on_lane_fault`` action: eviction/reset span every
+        member through the shared table; quarantine rolls back the tenant's
+        lane in each member and registers it once in the shared guard."""
+        if action == "raise":
+            raise err
+        if action == "evict":
+            if sid in self._table.sessions:
+                self.evict(sid)
+            self._guard.forget(sid)
+        elif action == "reset":
+            if sid in self._table.sessions:
+                self.reset_session(sid)
+        elif action == "quarantine":
+            lane = self._table.sessions.get(sid)
+            if lane is not None:
+                for m in self._members.values():
+                    m._quarantine_restore_lane(sid, lane)
+            self._guard.quarantine(sid)
 
     # -------------------------------------------------------------- lifecycle
     def admit(self, session_id: Any) -> int:
@@ -1114,6 +1977,7 @@ class LanedCollection:
         for m in self._members.values():
             m._reset_lane_indices([lane])
             m._computed = None
+        self._guard.forget(session_id)
         obs.counter_inc("lanes.evictions")
         obs.gauge_set("lanes.occupancy", self._table.active)
         return lane
@@ -1309,6 +2173,7 @@ class DeferredLaneStep:
         laned.__dict__["_state_escaped"] = True
         laned.__dict__["_reduced"] = True
         laned.__dict__["_pending_shards"] = None
+        laned.__dict__["_lane_mirror"].invalidate()  # reduced layout replaced the arrays
         laned._computed = None
 
 

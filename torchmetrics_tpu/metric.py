@@ -39,6 +39,7 @@ from jax import Array
 
 from torchmetrics_tpu.parallel.sync import (
     REDUCE_POLICIES,
+    SYNC_FAILURE_POLICIES,
     Reduction,
     default_reduce_policy,
     default_sync_timeout,
@@ -101,7 +102,11 @@ class Metric:
               ``"retry"`` re-attempts the gather with capped exponential
               backoff (``sync_retries`` / ``TORCHMETRICS_TPU_SYNC_RETRIES``
               attempts, io/retry.py) and propagates only when the budget is
-              exhausted.
+              exhausted; ``"last_good"`` serves the most recent
+              successfully-synced compute value instead, wrapped in a
+              :class:`~torchmetrics_tpu.quarantine.DegradedValue` carrying
+              staleness metadata (falling back to ``"local"`` semantics when
+              no value has been cached yet).
             - ``sync_retries``: how many backed-off re-attempts
               ``on_sync_failure="retry"`` makes before giving up; ``None``
               (default) follows ``TORCHMETRICS_TPU_SYNC_RETRIES`` (3 when
@@ -172,9 +177,10 @@ class Metric:
         elif not isinstance(self.sync_timeout, (int, float)) or isinstance(self.sync_timeout, bool) or self.sync_timeout <= 0:
             raise ValueError(f"Expected keyword argument `sync_timeout` to be a positive number of seconds but got {self.sync_timeout}")
         self.on_sync_failure = kwargs.pop("on_sync_failure", "raise")
-        if self.on_sync_failure not in ("raise", "local", "retry"):
+        if self.on_sync_failure not in SYNC_FAILURE_POLICIES:
             raise ValueError(
-                f"Expected keyword argument `on_sync_failure` to be 'raise', 'local' or 'retry' but got {self.on_sync_failure}"
+                f"Expected keyword argument `on_sync_failure` to be one of {SYNC_FAILURE_POLICIES}"
+                f" but got {self.on_sync_failure}"
             )
         self.sync_retries = kwargs.pop("sync_retries", None)
         if self.sync_retries is not None and (
@@ -437,6 +443,17 @@ class Metric:
             object.__setattr__(self, "_executor_obj", ex)
         return ex
 
+    def _trace_config(self) -> tuple:
+        """Trace-affecting configuration NOT visible in the state spec.
+
+        The executor's cross-process cache key is class + module source hash +
+        state shapes/dtypes (ops/executor.py ``_owner_desc``); config that
+        changes the traced computation while leaving the state layout
+        unchanged (an aggregator's ``nan_strategy``, a laned wrapper's
+        device-side row screen) must be surfaced here or two differently-
+        configured instances could share a persisted executable."""
+        return ()
+
     def _state_snapshot(self) -> Dict[str, Any]:
         """Shallow pre-call snapshot for transactional rollback: jnp arrays are
         immutable so references suffice; list states are list-copied. Unlike
@@ -572,16 +589,34 @@ class Metric:
             if self._computed is not None:
                 return self._computed
             self._fold_pending()  # sharded restore: re-reduce before sync/compute
+            self.__dict__.pop("_serve_last_good", None)
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ), obs.span(obs.SPAN_COMPUTE, suffix=type(self).__name__):
+                if self.__dict__.pop("_serve_last_good", False):
+                    # the sync just degraded under on_sync_failure="last_good":
+                    # serve the cached value + staleness instead of computing
+                    # a silently-partial local result (never cached as
+                    # _computed — it is stale by definition)
+                    from torchmetrics_tpu.quarantine import DegradedValue
+
+                    count, cached = self.__dict__["_last_good_compute"]
+                    return DegradedValue(
+                        value=cached,
+                        updates_behind=int(self._update_count) - count,
+                        age_updates=count,
+                    )
                 # routed through self._compute_fn (not the closed-over bound
                 # method) so the fault harness can intercept compute too
                 value = _squeeze_if_scalar(self._compute_fn(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
+            if self.__dict__.get("_last_sync_ok", True):
+                # the last-good cache behind on_sync_failure="last_good": only
+                # values whose sync (if any) succeeded qualify
+                self.__dict__["_last_good_compute"] = (int(self._update_count), value)
             return value
 
         return wrapped_func
@@ -828,9 +863,26 @@ class Metric:
             else:
                 synced = gather_all()
         except Exception as err:
-            if self.on_sync_failure != "local":
+            if self.on_sync_failure not in ("local", "last_good"):
                 raise
             self.__dict__["_last_sync_ok"] = False
+            if self.on_sync_failure == "last_good" and self.__dict__.get("_last_good_compute") is not None:
+                # degraded read (docs/LANES.md "Failure semantics"): serve the
+                # last successfully-synced value with staleness metadata
+                # instead of a silently-partial local one
+                self.__dict__["_serve_last_good"] = True
+                obs.counter_inc("sync.degraded_last_good")
+                obs.breadcrumb(
+                    "sync_degraded_last_good",
+                    {"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
+                )
+                rank_zero_warn(
+                    f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
+                    " serving the last-good value per on_sync_failure='last_good'"
+                    " (staleness metadata attached).",
+                    TorchMetricsUserWarning,
+                )
+                return
             obs.counter_inc("sync.degraded_local")
             obs.breadcrumb(
                 "sync_degraded_local",
@@ -838,7 +890,7 @@ class Metric:
             )
             rank_zero_warn(
                 f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
-                " degrading to local-only state per on_sync_failure='local'."
+                f" degrading to local-only state per on_sync_failure={self.on_sync_failure!r}."
                 " Values computed this step cover THIS process's data only.",
                 TorchMetricsUserWarning,
             )

@@ -38,6 +38,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_LANES,
     SPAN_NAMES,
     SPAN_PAD,
+    SPAN_QUARANTINE,
     SPAN_REDUCE,
     SPAN_SYNC_GATHER,
     SPAN_UPDATE,

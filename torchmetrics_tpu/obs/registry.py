@@ -199,10 +199,22 @@ def dump_diagnostics(obj: Any = None) -> Dict[str, Any]:
         versions["torchmetrics_tpu"] = _pkg_version
     except (ImportError, AttributeError):
         versions["torchmetrics_tpu"] = None
-    return {
+    out = {
         "time_unix": time.time(),
         "telemetry": telemetry_snapshot(obj),
         "breadcrumbs": crumbs,
         "env": env,
         "versions": versions,
     }
+    # laned objects (LanedMetric/LanedCollection) carry a per-tenant fault/
+    # quarantine/staleness table — a stalled-tenant report is one call
+    quarantine_table = getattr(obj, "quarantine_table", None)
+    if callable(quarantine_table):
+        try:
+            out["lane_quarantine"] = quarantine_table()
+        except Exception as err:  # diagnostics must not raise past a broken probe
+            from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+            rank_zero_debug(f"dump_diagnostics: quarantine_table probe failed ({err})")
+            out["lane_quarantine"] = {"error": f"{type(err).__name__}: {err}"}
+    return out

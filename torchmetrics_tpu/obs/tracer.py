@@ -75,6 +75,7 @@ SPAN_AUTOSAVE = "tm_tpu.autosave"          # Autosaver tick (host copy on hot pa
 SPAN_WARMUP = "tm_tpu.warmup"              # warmup API precompiles
 SPAN_EXPORT = "tm_tpu.export"              # telemetry export itself (allowlisted blocking)
 SPAN_LANES = "tm_tpu.lanes.dispatch"       # lane-batched multi-session dispatch (pack+scatter)
+SPAN_QUARANTINE = "tm_tpu.lanes.quarantine"  # lane fault containment (rollback + quarantine)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -93,6 +94,7 @@ SPAN_NAMES = (
     SPAN_WARMUP,
     SPAN_EXPORT,
     SPAN_LANES,
+    SPAN_QUARANTINE,
 )
 
 

@@ -516,10 +516,28 @@ class _ExecutorBase:
             return None
         return {k: np.array(v) for k, v in state.items()}
 
-    def _restore(self, metric: Any, recovery: Optional[Dict[str, Any]]) -> None:
+    def _take_recovery(self, metric: Any, state: Dict[str, Any], args: tuple) -> Any:
+        """The recovery reference for a donating call: a metric-provided
+        partial snapshot when the metric offers one (``_recovery_snapshot`` —
+        LanedMetric's incremental lane mirror, which copies only the rows a
+        round touches instead of the whole stacked state), else the classic
+        full host copy."""
+        if not self._keep_recovery:
+            return None
+        hook = getattr(metric, "_recovery_snapshot", None)
+        if hook is not None:
+            snap = hook(state, args)
+            if snap is not None:
+                return snap
+        return self._snapshot(state)
+
+    def _restore(self, metric: Any, recovery: Any) -> None:
         """Reinstall a recovery snapshot (or defaults when recovery is off)
         into ``metric`` after a donated dispatch failed."""
-        if recovery is not None:
+        if recovery is not None and hasattr(recovery, "as_state"):
+            restored = recovery.as_state()
+            self.stats["recovery_restores"] += 1
+        elif recovery is not None:
             restored = {k: jnp.asarray(v) for k, v in recovery.items()}
             self.stats["recovery_restores"] += 1
         else:
@@ -1063,7 +1081,13 @@ class MetricExecutor(_ExecutorBase):
         # never share a persisted executable
         extra = getattr(m, "_executor_identity", None)
         ident = f"|inner={extra()}" if callable(extra) else ""
-        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}{ident}"
+        # trace-affecting config invisible to the state spec (an aggregator's
+        # nan_strategy, a laned wrapper's device-side row screen): two
+        # instances whose compiled computation differs must never share a
+        # persisted executable
+        cfg = ",".join(map(str, m._trace_config()))
+        cfg = f"|cfg={cfg}" if cfg else ""
+        return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}{ident}{cfg}"
 
     def _key_desc(self, key: Any) -> str:
         return "|".join(
@@ -1337,7 +1361,7 @@ class MetricExecutor(_ExecutorBase):
         need_copy = fresh or m._state_escaped or m._state_shared
         state_in = _tree_copy(state) if need_copy else state
         # donation in play -> keep a host-side recovery reference (ISSUE 2)
-        recovery = None if need_copy else self._snapshot(state)
+        recovery = None if need_copy else self._take_recovery(m, state, args)
 
         do_probe = padded and not self._pad_validated
         oracle = m.functional_update(state, *args, **kwargs) if do_probe else None
@@ -1387,7 +1411,9 @@ class MetricExecutor(_ExecutorBase):
         m.__dict__["_state_escaped"] = False
         # the wrapper bumped _update_count before this call, so the pre-call
         # recovery snapshot describes exactly count-1 committed updates — the
-        # Autosaver reuses it as a free (already host-side) checkpoint source
+        # Autosaver reuses it as a free (already host-side) checkpoint source.
+        # Partial (mirror) snapshots materialize a detached copy at reuse time
+        # (latest_recovery_snapshot) — the mirror itself keeps folding.
         self._last_recovery = None if recovery is None else (int(m._update_count) - 1, recovery)
         return True
 
@@ -1601,7 +1627,14 @@ class CollectionExecutor(_ExecutorBase):
                 f"{k}:{jnp.asarray(v).dtype}:{tuple(np.shape(v))}:{m._reductions.get(k)}"
                 for k, v in m._defaults.items()
             )
-            parts.append(f"{name}:[{members}]|{fields}")
+            cfgs = ";".join(
+                cfg
+                for cfg in (
+                    ",".join(map(str, coll._modules[mn]._trace_config())) for mn in cg
+                )
+                if cfg
+            )
+            parts.append(f"{name}:[{members}]|{fields}" + (f"|cfg={cfgs}" if cfgs else ""))
         return "Collection{" + ";".join(parts) + "}"
 
     def _key_desc(self, key: Any) -> str:
@@ -1909,7 +1942,7 @@ class CollectionExecutor(_ExecutorBase):
                 st = _tree_copy(st)
                 copied = True
             else:
-                donated.append((name, m, cg, self._snapshot(st)))
+                donated.append((name, m, cg, self._take_recovery(m, st, args)))
             states[name] = st
 
         do_probe = padded and not self._pad_validated
@@ -2038,7 +2071,7 @@ class CollectionExecutor(_ExecutorBase):
                 st = _tree_copy(st)
                 copied = True
             else:
-                donated.append((name, m, cg, self._snapshot(st)))
+                donated.append((name, m, cg, self._take_recovery(m, st, args)))
             states[name] = st
             counts[name] = jnp.asarray(int(m._update_count), jnp.int32)
 
@@ -2386,12 +2419,24 @@ def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
             entry.update(extras())
         return entry
 
+    def resolve(snap: Any) -> Optional[Dict[str, Any]]:
+        # partial (lane-mirror) recoveries are folded forward by later rounds:
+        # materialize a detached host copy NOW (host-to-host memcpy, still
+        # zero device sync); the count+1 freshness checks below guarantee the
+        # mirror still equals the count-committed state
+        if hasattr(snap, "materialize"):
+            return snap.materialize()
+        return snap
+
     if isinstance(ex, CollectionExecutor):
         coll = ex._coll
         export: Dict[str, Any] = {}
         counts = []
         for leader, (count, snap) in rec.items():
             if int(coll._modules[leader]._update_count) != count + 1:
+                return None
+            snap = resolve(snap)
+            if snap is None:
                 return None
             entry = dict(snap)
             entry[STATE_COUNT_KEY] = int(count)
@@ -2402,6 +2447,9 @@ def latest_recovery_snapshot(obj: Any) -> Optional[Tuple[int, Dict[str, Any]]]:
         return max(counts), export
     count, snap = rec
     if int(ex._metric._update_count) != count + 1:
+        return None
+    snap = resolve(snap)
+    if snap is None:
         return None
     export = dict(snap)
     export[STATE_COUNT_KEY] = int(count)

@@ -37,6 +37,12 @@ REDUCE_POLICY_ENV = "TORCHMETRICS_TPU_REDUCE"
 
 REDUCE_POLICIES = ("step", "deferred")
 
+#: valid ``on_sync_failure`` degradation policies for the bounded multi-host
+#: sync path (docs/ROBUSTNESS.md): propagate, keep local-only state, retry
+#: with backoff, or serve the last successfully-synced compute value with
+#: staleness metadata (``quarantine.DegradedValue``)
+SYNC_FAILURE_POLICIES = ("raise", "local", "retry", "last_good")
+
 
 def default_reduce_policy() -> str:
     """The environment-configured reduction policy (``TORCHMETRICS_TPU_REDUCE``).

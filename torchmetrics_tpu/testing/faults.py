@@ -28,6 +28,11 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
   never crash or change a result.
 - :func:`preempt_after` — raise a simulated preemption after the n-th
   COMMITTED update (drives autosave + kill/restore chaos tests).
+- :func:`poison_session` / :func:`fail_lane_dispatch` — lane-targeted faults
+  against ONE tenant of a laned metric (docs/LANES.md "Failure semantics"):
+  corrupt only that session's rows, or raise an attributed
+  ``LaneFaultError`` inside the laned update path — the blast-radius
+  primitives behind the per-tenant isolation chaos suite.
 
 All context managers restore the patched seam on exit, including when the
 body raises. They are process-local and NOT thread-safe (they patch module
@@ -137,6 +142,98 @@ def raise_in_compute(metric: Any, exc: Optional[BaseException] = None) -> Genera
         yield
     finally:
         object.__setattr__(metric, "_compute_fn", orig)
+
+
+# -------------------------------------------------------------------- lanes
+
+@contextmanager
+def poison_session(
+    laned: Any, session_id: Any, mode: str = "nan", frac: float = 0.25, seed: int = 0
+) -> Generator[None, None, None]:
+    """Corrupt ONLY ``session_id``'s rows in every ``update_sessions`` round
+    on ``laned`` (a ``LanedMetric`` or ``LanedCollection``) — the one-bad-
+    tenant scenario the lane isolation property is asserted against: every
+    OTHER session's per-lane ``compute()`` must stay bit-exact vs a fault-free
+    run. Composes with the other chaos context managers; ``mode``/``frac``/
+    ``seed`` are :func:`poison_batch`'s."""
+    orig = laned.update_sessions
+
+    def poisoned(items: Any) -> int:
+        items = list(items.items()) if isinstance(items, dict) else list(items)
+        out = []
+        for sid, batch in items:
+            if sid == session_id:
+                was_tuple = isinstance(batch, tuple)
+                leaves = batch if was_tuple else (batch,)
+                leaves = poison_batch(*leaves, mode=mode, frac=frac, seed=seed)
+                batch = leaves if was_tuple else leaves[0]
+            out.append((sid, batch))
+        return orig(out)
+
+    object.__setattr__(laned, "update_sessions", poisoned)
+    try:
+        yield
+    finally:
+        if laned.__dict__.get("update_sessions") is poisoned:
+            del laned.__dict__["update_sessions"]
+
+
+@contextmanager
+def fail_lane_dispatch(
+    laned: Any, session_id: Any, fail_n: Optional[int] = None, exc: Optional[BaseException] = None
+) -> Generator[None, None, None]:
+    """Raise an attributed ``LaneFaultError(session_id)`` from inside the
+    laned update path whenever a dispatched round contains that session's
+    lane — AFTER the real update ran (the committed-then-faulted worst case,
+    like ``raise_in_update(after_mutation=True)``). The router's containment
+    must roll the touched lanes back and re-dispatch the round without the
+    culprit, so the other lanes sharing the dispatch still get their step.
+    ``fail_n=k`` faults only the first k hits; ``None`` faults every one."""
+    from torchmetrics_tpu.utils.exceptions import LaneFaultError
+
+    targets = list(laned._members.values()) if hasattr(laned, "_members") else [laned]
+    orig_update = targets[0].update if len(targets) == 1 else None
+    orig_coll_update = laned.collection.update if hasattr(laned, "collection") else None
+    remaining = {"n": fail_n}
+
+    def should_fail(lane_ids: Any) -> bool:
+        lane = laned.sessions.get(session_id)
+        if lane is None or lane not in np.asarray(lane_ids).reshape(-1):
+            return False
+        if remaining["n"] is not None:
+            if remaining["n"] <= 0:
+                return False
+            remaining["n"] -= 1
+        return True
+
+    error = exc
+
+    def make_failing(orig: Any) -> Any:
+        def failing(lane_ids: Any, *args: Any, **kwargs: Any) -> Any:
+            hit = should_fail(lane_ids)
+            out = orig(lane_ids, *args, **kwargs)
+            if hit:
+                raise error if error is not None else LaneFaultError(
+                    f"injected lane dispatch failure for session {session_id!r}",
+                    session_id=session_id,
+                    where="dispatch",
+                )
+            return out
+
+        return failing
+
+    if orig_coll_update is not None:
+        patched_target, attr = laned.collection, "update"
+        object.__setattr__(patched_target, attr, make_failing(orig_coll_update))
+    else:
+        patched_target, attr = targets[0], "update"
+        object.__setattr__(patched_target, attr, make_failing(orig_update))
+    try:
+        yield
+    finally:
+        object.__setattr__(
+            patched_target, attr, orig_coll_update if orig_coll_update is not None else orig_update
+        )
 
 
 # ----------------------------------------------------------------- executor

@@ -53,6 +53,25 @@ class CheckpointCorruptionError(StateCorruptionError):
     """
 
 
+class LaneFaultError(TorchMetricsUserError):
+    """A fault attributed to ONE session's lane in a laned dispatch.
+
+    Raised by the lane fault-containment layer (``torchmetrics_tpu/quarantine.py``,
+    docs/LANES.md "Failure semantics") when admission screening rejects a
+    session's row, a dispatch failure is attributed to a session, or a
+    read-point health scan finds a lane poisoned — under the
+    ``on_lane_fault="raise"`` policy. Carries the attribution so callers (and
+    the router's containment loop) can act on the single offending tenant
+    instead of the whole dispatch.
+    """
+
+    def __init__(self, message: str, session_id=None, lane=None, where=None) -> None:
+        super().__init__(message)
+        self.session_id = session_id
+        self.lane = lane
+        self.where = where
+
+
 class DispatchStallError(TorchMetricsUserError, TimeoutError):
     """A donating compiled dispatch (or guarded sync) exceeded its deadline.
 
