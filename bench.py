@@ -678,6 +678,137 @@ def bench_config2():
             lambda: jax.block_until_ready(step_unsynced(logits1, target1)), steps=30, warmup=3
         )
 
+    # asynchronous-read rows (ISSUE 9): a train loop that READS EVERY STEP —
+    # today's worst case (the blocking row pays the whole read latency
+    # synchronously). Two shapes:
+    #
+    # (a) OO API in deferred mode: per-step update through the donated-state
+    #     executor, then compute() materialized to host (blocking) vs
+    #     compute_async() (the step loop only pays snapshot+submit; the
+    #     ready-wait and D2H drain on the read-pipeline worker).
+    # (b) the deferred shard_map harness: per-step local_step + reduce
+    #     (blocking, today's epoch-end read run every step) vs reduce_async.
+    #
+    # Measurement note (docs/ASYNC.md): on this 1-vCPU VM the pipeline worker
+    # timeshares the SAME core as the step loop, so an e2e row (drain
+    # included) measures CPU contention, not pipeline stalls — real host+
+    # device hardware overlaps them. The acceptance metric is therefore the
+    # submit-rate row (what the step loop actually pays per step, reads
+    # draining in background) plus the e2e row recorded honestly alongside.
+    from torchmetrics_tpu.ops.async_read import drain_pipeline as _drain_reads
+
+    READ_STEPS = 30
+    with jax.default_device(jax.devices("cpu")[0]):
+        coll_oo = MetricCollection(
+            {
+                "confmat": MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+                "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+                "precision": MulticlassPrecision(num_classes=NUM_CLASSES, validate_args=False),
+                "recall": MulticlassRecall(num_classes=NUM_CLASSES, validate_args=False),
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            },
+            reduce="deferred",
+        )
+        # warm: group resolution, executor compile, read-clone build, one
+        # full async round (the pipeline thread + member clones exist after)
+        coll_oo.update(logits1, target1)
+        jax.block_until_ready(coll_oo.compute())
+        warm_async = coll_oo.compute_async()
+        warm_async.result(60.0)
+        _drain_reads(60.0)
+        async_values_agree = all(
+            bool(np.allclose(np.asarray(warm_async.result()[k]), np.asarray(v)))
+            for k, v in coll_oo.compute().items()
+        )
+
+        def _oo_update_only():
+            t0 = time.perf_counter()
+            for _ in range(READ_STEPS):
+                coll_oo.update(logits1, target1)
+            for _m in coll_oo.values():
+                jax.block_until_ready({k: v for k, v in _m._state.items() if not isinstance(v, list)})
+            return (time.perf_counter() - t0) / READ_STEPS
+
+        def _oo_blocking_read():
+            t0 = time.perf_counter()
+            for _ in range(READ_STEPS):
+                coll_oo.update(logits1, target1)
+                jax.block_until_ready(coll_oo.compute())
+            return (time.perf_counter() - t0) / READ_STEPS
+
+        _async_box = {}
+
+        def _oo_async_read():
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(READ_STEPS):
+                coll_oo.update(logits1, target1)
+                last = coll_oo.compute_async()
+            submit_s = time.perf_counter() - t0
+            last.result(60.0)
+            _drain_reads(60.0)
+            _async_box["e2e"] = (time.perf_counter() - t0) / READ_STEPS
+            return submit_s / READ_STEPS
+
+        def _oo_async_read_parked():
+            # the step loop's OWN cost per step: worker parked on a barrier,
+            # so this single core isn't timesharing with the drain — the
+            # number a machine with a spare host core (or a real device
+            # running the reduce) sees at the step loop
+            from torchmetrics_tpu.testing.faults import pause_async_reads
+
+            last = None
+            with pause_async_reads(max_s=120.0):
+                t0 = time.perf_counter()
+                for _ in range(READ_STEPS):
+                    coll_oo.update(logits1, target1)
+                    last = coll_oo.compute_async()
+                submit_s = time.perf_counter() - t0
+            last.result(60.0)
+            _drain_reads(60.0)
+            return submit_s / READ_STEPS
+
+        per_oo_update = _stable_min(_oo_update_only, repeats=3)
+        per_oo_blocking = _stable_min(_oo_blocking_read, repeats=3)
+        per_oo_async = _stable_min(_oo_async_read, repeats=3)
+        per_oo_async_e2e = _async_box["e2e"]
+        per_oo_async_parked = _stable_min(_oo_async_read_parked, repeats=3)
+
+    # (b) harness rows on the existing deferred step: per-step fused reduce
+    st_async = deferred.local_step(deferred.init_states(), logits, target)
+    deferred.reduce_async(st_async).result(60.0)  # warm the async-unpack path
+    _drain_reads(60.0)
+
+    def _deferred_blocking_read():
+        st = deferred.local_step(deferred.init_states(), logits, target)
+        t0 = time.perf_counter()
+        for _ in range(READ_STEPS):
+            st = deferred.local_step(st, logits, target)
+            deferred.reduce(st)
+        return (time.perf_counter() - t0) / READ_STEPS
+
+    def _deferred_async_read():
+        st = deferred.local_step(deferred.init_states(), logits, target)
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(READ_STEPS):
+            st = deferred.local_step(st, logits, target)
+            last = deferred.reduce_async(st)
+        submit_s = time.perf_counter() - t0
+        last.result(60.0)
+        _drain_reads(60.0)
+        _async_box["def_e2e"] = (time.perf_counter() - t0) / READ_STEPS
+        return submit_s / READ_STEPS
+
+    per_def_blocking = _stable_min(_deferred_blocking_read, repeats=3)
+    per_def_async = _stable_min(_deferred_async_read, repeats=3)
+    per_def_async_e2e = _async_box["def_e2e"]
+    # the acceptance ratio uses the parked row: the step loop's own per-step
+    # cost with reads draining elsewhere (on this 1-core VM the un-parked
+    # submit row times-shares with the worker and measures contention)
+    async_read_ratio = per_oo_update / per_oo_async_parked if per_oo_async_parked else None
+    async_submit_overhead_pct = 100.0 * (per_oo_async_parked - per_oo_update) / per_oo_update
+
     ref_val = None
     try:
         _ref()
@@ -751,6 +882,28 @@ def bench_config2():
         "telemetry_overhead_dispatch_pct": round(telemetry_overhead_dispatch_pct, 2),
         "telemetry_off_us_per_step": round(per_epoch_off * 1e6, 1),
         "telemetry_on_us_per_step": round(per_epoch_on * 1e6, 1),
+        # asynchronous-read rows (ISSUE 9; docs/ASYNC.md): per-step read
+        # loops. value_read_async is the SUBMIT rate — what the step loop
+        # pays with reads draining in background (the "never stalls" claim;
+        # async_read_ratio = its fraction of the update-only rate, gated via
+        # async_read_ratio_min). value_read_async_e2e includes the drain,
+        # which on this 1-vCPU VM timeshares the step loop's core — real
+        # hardware overlaps it (host worker vs device), so that row is a
+        # contention bound, not the pipeline's overlap win.
+        "value_read_update_only": round(1.0 / per_oo_update, 2),
+        "value_read_blocking": round(1.0 / per_oo_blocking, 2),
+        "value_read_async": round(1.0 / per_oo_async_parked, 2),
+        "value_read_async_contended": round(1.0 / per_oo_async, 2),
+        "value_read_async_e2e": round(1.0 / per_oo_async_e2e, 2),
+        "async_read_ratio": round(async_read_ratio, 3) if async_read_ratio else None,
+        "async_submit_overhead_pct": round(async_submit_overhead_pct, 2),
+        "blocking_read_overhead_pct": round(100.0 * (per_oo_blocking - per_oo_update) / per_oo_update, 2),
+        "async_values_agree": bool(async_values_agree),
+        # deferred harness per-step read: the fused reduce every step,
+        # blocking vs dispatched-and-drained (DeferredCollectionStep.reduce_async)
+        "value_read_deferred_blocking": round(1.0 / per_def_blocking, 2),
+        "value_read_deferred_async": round(1.0 / per_def_async, 2),
+        "value_read_deferred_async_e2e": round(1.0 / per_def_async_e2e, 2),
     }
 
 

@@ -1,0 +1,485 @@
+"""Fully asynchronous read path (ISSUE 9): ``compute_async``/``sync_async``.
+
+Covers the acceptance properties:
+
+- ``compute_async().result()`` bit-exact vs blocking ``compute()`` for all
+  five state families (sum/mean/max/min/cat) in step AND deferred modes,
+  for collections, and for laned metrics including quarantined lanes;
+- snapshot isolation: mutating the metric (update/reset/load_state) before
+  the future resolves never changes what the future serves, and the live
+  deferred flags stay coherent;
+- failure contracts: ``on_sync_failure`` policies inside an in-flight future
+  (raise -> future error; local -> local value; last_good -> DegradedValue),
+  sync timeouts, and no wedged worker afterwards;
+- chaos composition (testing/faults.py): preemption flush with a read in
+  flight, kill/restore while a future is pending;
+- the Autosaver ride-along and the reads.* telemetry.
+"""
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MetricCollection,
+    MinMetric,
+    SumMetric,
+    drain_async_reads,
+    obs,
+    pending_reads,
+)
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+from torchmetrics_tpu.io import Autosaver, restore_state, save_state
+from torchmetrics_tpu.io.checkpoint import install_preemption_handler
+from torchmetrics_tpu.lanes import LanedCollection, LanedMetric
+from torchmetrics_tpu.ops.async_read import MetricFuture, ReadPipeline, get_pipeline
+from torchmetrics_tpu.quarantine import DegradedValue
+from torchmetrics_tpu.testing import faults
+from torchmetrics_tpu.utils.exceptions import SyncTimeoutError
+
+TIMEOUT = 30.0
+
+
+def _vals_equal(a, b):
+    la = jnp.asarray(a) if not isinstance(a, (list, tuple, dict)) else a
+    if isinstance(a, dict):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            _vals_equal(a[k], b[k])
+        return
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(b))
+
+
+FAMILIES = [
+    (SumMetric, [2.0, -1.5, 3.25]),
+    (MeanMetric, [2.0, 5.0, 6.5]),
+    (MaxMetric, [1.0, 9.0, -2.0]),
+    (MinMetric, [4.0, -3.0, 7.0]),
+    (CatMetric, [1.0, 2.0, 3.0]),
+]
+
+
+class TestExactness:
+    @pytest.mark.parametrize("cls,vals", FAMILIES, ids=lambda p: getattr(p, "__name__", ""))
+    @pytest.mark.parametrize("reduce", ["step", "deferred"])
+    def test_family_bit_exact(self, cls, vals, reduce):
+        m = cls(reduce=reduce)
+        ref = cls(reduce=reduce)
+        for v in vals:
+            batch = jnp.asarray([v, v + 0.5])
+            m.update(batch)
+            ref.update(batch)
+        fut = m.compute_async()
+        blocking = ref.compute()
+        _vals_equal(fut.result(TIMEOUT), blocking)
+        assert fut.done() and fut.exception() is None
+
+    def test_classification_metric(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(32, 5).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 5, 32))
+        m = MulticlassAccuracy(num_classes=5)
+        m.update(logits, target)
+        fut = m.compute_async()
+        _vals_equal(fut.result(TIMEOUT), m.compute())
+
+    def test_collection_matches_blocking(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(16, 5).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 5, 16))
+        coll = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=5), "cm": MulticlassConfusionMatrix(num_classes=5)}
+        )
+        coll.update(logits, target)
+        fut = coll.compute_async()
+        res = fut.result(TIMEOUT)
+        blocking = coll.compute()
+        assert sorted(res) == sorted(blocking)
+        for k in blocking:
+            _vals_equal(res[k], blocking[k])
+
+    def test_executor_donation_interplay(self):
+        """An in-flight read's snapshot survives the next donating dispatch:
+        the escape flag forces copy-before-donate (the double buffer)."""
+        m = SumMetric()  # executor on by default
+        with faults.pause_async_reads():
+            m.update(jnp.asarray([1.0, 2.0]))
+            fut = m.compute_async()
+            for _ in range(5):  # donating dispatches while the read is parked
+                m.update(jnp.asarray([10.0, 10.0]))
+        assert float(fut.result(TIMEOUT)) == 3.0
+        assert float(m.compute()) == 103.0
+
+    def test_value_is_ready(self):
+        m = SumMetric()
+        m.update(jnp.asarray([1.0]))
+        v = m.compute_async().result(TIMEOUT)
+        # resolved values are block_until_ready'd: float() is a memcpy
+        assert float(v) == 1.0
+
+
+class TestFutureSemantics:
+    def test_snapshot_isolation_and_flag_coherence(self):
+        m = SumMetric(reduce="deferred")
+        m.update(jnp.asarray([1.0]))
+        with faults.pause_async_reads():
+            fut = m.compute_async()
+            m.update(jnp.asarray([5.0]))
+            # live deferred flags reflect the LIVE accumulation, untouched by
+            # the in-flight read
+            assert m.deferred_pending
+            assert not fut.done()
+        assert float(fut.result(TIMEOUT)) == 1.0
+        assert float(m.compute()) == 6.0
+
+    def test_reset_before_resolve(self):
+        m = SumMetric()
+        m.update(jnp.asarray([7.0]))
+        with faults.pause_async_reads():
+            fut = m.compute_async()
+            m.reset()
+        assert float(fut.result(TIMEOUT)) == 7.0
+        assert int(m.update_count) == 0
+
+    def test_cache_writeback_only_when_unchanged(self):
+        m = SumMetric()
+        m.update(jnp.asarray([2.0]))
+        fut = m.compute_async()
+        fut.result(TIMEOUT)
+        drain_async_reads()
+        assert m.__dict__.get("_computed") is not None  # refreshed: no update since
+        m2 = SumMetric()
+        m2.update(jnp.asarray([2.0]))
+        with faults.pause_async_reads():
+            fut2 = m2.compute_async()
+            m2.update(jnp.asarray([1.0]))
+        fut2.result(TIMEOUT)
+        drain_async_reads()
+        assert m2.__dict__.get("_computed") is None  # stale read must not cache
+        assert float(m2.compute()) == 3.0
+
+    def test_done_callback(self):
+        m = SumMetric()
+        m.update(jnp.asarray([1.0]))
+        seen = []
+        fut = m.compute_async()
+        fut.result(TIMEOUT)
+        fut.add_done_callback(lambda f: seen.append(float(f.result())))
+        assert seen == [1.0]
+
+    def test_result_timeout(self):
+        with faults.pause_async_reads():
+            m = SumMetric()
+            m.update(jnp.asarray([1.0]))
+            fut = m.compute_async()
+            with pytest.raises(TimeoutError):
+                fut.result(0.05)
+        assert float(fut.result(TIMEOUT)) == 1.0
+
+    def test_repeated_reads_chain(self):
+        m = SumMetric()
+        futures = []
+        for i in range(5):
+            m.update(jnp.asarray([float(i)]))
+            futures.append(m.compute_async())
+        expected = np.cumsum(np.arange(5.0))
+        for fut, want in zip(futures, expected):
+            assert float(fut.result(TIMEOUT)) == want
+
+    def test_wrapper_metrics_resolve_inline(self):
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        w = MinMaxMetric(MeanMetric())
+        w.update(jnp.asarray([3.0]))
+        res = w.compute_async().result(TIMEOUT)
+        blocking = w.compute()
+        for k in blocking:
+            _vals_equal(res[k], blocking[k])
+
+    def test_sync_async_returns_state(self):
+        m = SumMetric()
+        m.update(jnp.asarray([4.0]))
+        st = m.sync_async().result(TIMEOUT)
+        assert float(st["sum_value"]) == 4.0
+        assert int(st["_update_count"]) == 1
+        # live metric untouched: no _is_synced latch
+        assert not m._is_synced
+
+
+def _dist_metric(**kwargs):
+    return SumMetric(
+        nan_strategy="ignore", executor=False, distributed_available_fn=lambda: True, **kwargs
+    )
+
+
+class TestSyncFailurePolicies:
+    def test_break_sync_raise_policy(self):
+        m = _dist_metric(on_sync_failure="raise")
+        m.update(jnp.asarray([1.0]))
+        with faults.break_sync():
+            fut = m.compute_async()
+            err = fut.exception(TIMEOUT)  # waits inside the armed context
+        assert isinstance(err, faults.FaultInjected)
+        with pytest.raises(faults.FaultInjected):
+            fut.result(TIMEOUT)
+        # worker not wedged: the next read resolves fine (sync healthy again)
+        fut2 = m.compute_async()
+        assert float(fut2.result(TIMEOUT)) == 1.0
+
+    def test_break_sync_local_policy(self):
+        m = _dist_metric(on_sync_failure="local")
+        m.update(jnp.asarray([2.0]))
+        with faults.break_sync():
+            fut = m.compute_async()
+            assert float(fut.result(TIMEOUT)) == 2.0
+        drain_async_reads()
+        assert m.last_sync_ok is False  # degradation visible on the live metric
+
+    def test_break_sync_last_good_policy(self):
+        m = _dist_metric(on_sync_failure="last_good")
+        m.update(jnp.asarray([3.0]))
+        assert float(m.compute()) == 3.0  # seeds the last-good cache
+        m.update(jnp.asarray([1.0]))
+        with faults.break_sync():
+            fut = m.compute_async()
+            res = fut.result(TIMEOUT)
+        assert isinstance(res, DegradedValue)
+        assert float(res.value) == 3.0
+        assert res.updates_behind == 1
+        assert fut.degraded
+
+    def test_hang_sync_timeout(self):
+        m = _dist_metric(sync_timeout=0.2, on_sync_failure="raise")
+        m.update(jnp.asarray([1.0]))
+        with faults.hang_sync(seconds=5.0):
+            fut = m.compute_async()
+            err = fut.exception(TIMEOUT)
+        assert isinstance(err, SyncTimeoutError)
+        # the pipeline worker survived the timed-out gather
+        fut2 = m.compute_async()
+        assert float(fut2.result(TIMEOUT)) == 1.0
+
+
+class TestLanedReads:
+    def test_laned_aggregate_exact(self):
+        lm = LanedMetric(SumMetric(), capacity=8)
+        lm.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([4.0, 0.5]))])
+        fut = lm.compute_async()
+        _vals_equal(fut.result(TIMEOUT), lm.compute())
+
+    def test_laned_quarantined_lanes_excluded(self):
+        lq = LanedMetric(SumMetric(), capacity=8, on_lane_fault="quarantine")
+        lq.update_sessions([("good", jnp.asarray([1.0])), ("bad", jnp.asarray([2.0]))])
+        assert float(lq.compute()) == 3.0  # seeds last-good for everyone
+        lq.update_sessions([("good", jnp.asarray([1.0])), ("bad", jnp.asarray([np.nan]))])
+        fut = lq.compute_async()
+        v_async = fut.result(TIMEOUT)
+        # the async scan quarantined 'bad' on the LIVE guard
+        assert "bad" in lq.guard.quarantined
+        v_block = lq.compute()
+        _vals_equal(v_async, v_block)
+        assert float(v_async) == 2.0  # good's lane only
+        degraded = lq.compute_session("bad")
+        assert isinstance(degraded, DegradedValue)
+
+    def test_laned_eager_mode_inline(self):
+        lm = LanedMetric(CatMetric(), capacity=8)  # list state -> eager lanes
+        lm.update_sessions([("a", jnp.asarray([1.0, 2.0]))])
+        fut = lm.compute_async()
+        _vals_equal(fut.result(TIMEOUT), lm.compute())
+
+    def test_laned_collection(self):
+        lc = LanedCollection({"s": SumMetric(), "m": MaxMetric()}, capacity=8)
+        lc.update_sessions([("a", jnp.asarray([1.0, 2.0])), ("b", jnp.asarray([5.0, 1.0]))])
+        fut = lc.compute_async()
+        res = fut.result(TIMEOUT)
+        blocking = lc.compute()
+        assert sorted(res) == sorted(blocking)
+        for k in blocking:
+            _vals_equal(res[k], blocking[k])
+
+    def test_laned_update_while_read_in_flight(self):
+        lm = LanedMetric(SumMetric(), capacity=8, on_lane_fault="quarantine")
+        lm.update_sessions([("a", jnp.asarray([1.0])), ("b", jnp.asarray([2.0]))])
+        with faults.pause_async_reads():
+            fut = lm.compute_async()
+            lm.update_sessions([("a", jnp.asarray([10.0])), ("b", jnp.asarray([20.0]))])
+        assert float(fut.result(TIMEOUT)) == 3.0
+        assert float(lm.compute()) == 33.0
+
+
+class TestChaosComposition:
+    def test_preemption_flush_with_read_in_flight(self, tmp_path):
+        """SIGTERM lands while a read is parked in the pipeline: the flush
+        saves the live state, the handler chains, and the future still
+        resolves to its submission-time value afterwards."""
+        m = SumMetric(executor=False)
+        m.update(jnp.asarray([5.0]))
+        saver = Autosaver(m, str(tmp_path / "ckpt"), every_n_updates=1000)
+        chained = []
+        previous = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+        try:
+            handle = install_preemption_handler(saver, signums=(signal.SIGTERM,))
+            try:
+                with faults.pause_async_reads():
+                    fut = m.compute_async()
+                    m.update(jnp.asarray([2.0]))
+                    os.kill(os.getpid(), signal.SIGTERM)
+                assert chained == [signal.SIGTERM]
+            finally:
+                handle.uninstall()
+        finally:
+            signal.signal(signal.SIGTERM, previous)
+        assert float(fut.result(TIMEOUT)) == 5.0
+        fresh = SumMetric(executor=False)
+        restore_state(str(tmp_path / "ckpt"), fresh)
+        assert float(fresh.compute()) == 7.0  # the flush saved the LIVE state
+
+    def test_kill_restore_while_future_pending(self, tmp_path):
+        m = SumMetric(executor=False)
+        m.update(jnp.asarray([3.0]))
+        save_state(m, str(tmp_path / "ckpt"))
+        with faults.pause_async_reads():
+            fut = m.compute_async()
+            # "kill": a fresh process restores from the snapshot while the old
+            # future is still pending
+            fresh = SumMetric(executor=False)
+            restore_state(str(tmp_path / "ckpt"), fresh)
+            # and the SAME instance can also be overwritten mid-flight
+            m.load_state(fresh.state())
+        assert float(fut.result(TIMEOUT)) == 3.0
+        assert float(fresh.compute()) == 3.0
+        assert float(m.compute()) == 3.0
+
+    def test_no_wedged_worker_with_abandoned_future(self):
+        """A future nobody waits on must not wedge anything: the barrier
+        (bounded) releases, the pipeline drains, and the worker thread is a
+        daemon so interpreter exit can never block on it."""
+        m = SumMetric()
+        m.update(jnp.asarray([1.0]))
+        with faults.pause_async_reads(max_s=0.2):
+            m.compute_async()  # abandoned on purpose
+        assert drain_async_reads(timeout=TIMEOUT)
+        pipeline = get_pipeline()
+        assert pipeline._thread is not None and pipeline._thread.daemon
+
+
+class TestPipeline:
+    def test_inline_fallback_on_full_queue(self):
+        import threading
+
+        pipeline = ReadPipeline(maxsize=1)
+        release = threading.Event()
+        pipeline.submit(lambda: release.wait(10.0), owner="barrier")  # occupies the worker
+        pipeline.submit(lambda: 1, owner="queued")  # fills the queue
+        fut = pipeline.submit(lambda: 42, owner="overflow")  # runs inline
+        assert fut.done() and fut.result() == 42
+        assert pipeline.stats["inline"] == 1
+        release.set()
+        assert pipeline.drain(TIMEOUT)
+
+    def test_pending_gauge_and_counters(self):
+        before = obs.counters_snapshot()
+        m = SumMetric()
+        m.update(jnp.asarray([1.0]))
+        fut = m.compute_async()
+        fut.result(TIMEOUT)
+        drain_async_reads()
+        after = obs.counters_snapshot()
+        assert after.get("reads.async_submitted", 0) > before.get("reads.async_submitted", 0)
+        assert after.get("reads.async_completed", 0) > before.get("reads.async_completed", 0)
+        assert pending_reads() == 0
+
+    def test_degraded_counter(self):
+        before = obs.counters_snapshot().get("reads.async_degraded", 0)
+        m = _dist_metric(on_sync_failure="last_good")
+        m.update(jnp.asarray([1.0]))
+        m.compute()
+        m.update(jnp.asarray([1.0]))
+        with faults.break_sync():
+            m.compute_async().result(TIMEOUT)
+        drain_async_reads()
+        assert obs.counters_snapshot().get("reads.async_degraded", 0) == before + 1
+
+    def test_compute_async_span(self):
+        obs.reset_ring()
+        obs.set_tracing(True)
+        try:
+            m = SumMetric()
+            m.update(jnp.asarray([1.0]))
+            m.compute_async().result(TIMEOUT)
+        finally:
+            obs.set_tracing(None)
+        names = {ev.name for ev in obs.peek_events()}
+        assert any(n.startswith("tm_tpu.compute_async") for n in names)
+
+
+class TestAutosaverRideAlong:
+    def test_background_save_rides_pipeline(self, tmp_path):
+        m = SumMetric(executor=False)
+        saver = Autosaver(
+            m, str(tmp_path / "ckpt"), every_n_updates=1, background=True, reuse_recovery=False
+        ).attach()
+        try:
+            m.update(jnp.asarray([4.0]))
+            saver.flush(TIMEOUT)
+        finally:
+            saver.detach()
+        assert saver.stats["async_rides"] >= 1
+        assert saver.stats["saves"] >= 1
+        fresh = SumMetric(executor=False)
+        restore_state(str(tmp_path / "ckpt"), fresh)
+        assert float(fresh.compute()) == 4.0
+
+    def test_ride_along_snapshot_is_consistent(self, tmp_path):
+        """The staged references are immutable: updates landing after the
+        stage (but before the worker's D2H) never leak into the snapshot."""
+        m = SumMetric(executor=False)
+        saver = Autosaver(
+            m, str(tmp_path / "ckpt"), every_n_updates=1000, background=True, reuse_recovery=False
+        )
+        m.update(jnp.asarray([1.0]))
+        with faults.pause_async_reads():
+            saver.save_now()
+            m.update(jnp.asarray([100.0]))
+        saver.flush(TIMEOUT)
+        fresh = SumMetric(executor=False)
+        restore_state(str(tmp_path / "ckpt"), fresh)
+        assert float(fresh.compute()) == 1.0
+
+    def test_recovery_reuse_still_wins(self, tmp_path):
+        """With a fresh executor recovery snapshot available, the Autosaver
+        keeps the zero-copy reuse path (no pipeline ride needed)."""
+        from torchmetrics_tpu import Metric
+
+        class _SumLike(Metric):  # executor-eligible (aggregators self-declare untraceable)
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        m = _SumLike()
+        for _ in range(3):
+            m.update(jnp.asarray([1.0, 2.0]))  # warm the executor into donation
+        assert m.executor_status["stats"]["donated_calls"] >= 1
+        saver = Autosaver(m, str(tmp_path / "ckpt"), every_n_updates=2, background=True).attach()
+        try:
+            m.update(jnp.asarray([1.0, 2.0]))
+            m.update(jnp.asarray([1.0, 2.0]))  # trigger: recovery is fresh
+            saver.flush(TIMEOUT)
+        finally:
+            saver.detach()
+        assert saver.stats["reused_recovery_snapshots"] >= 1
+        assert saver.stats["async_rides"] == 0  # zero-copy reuse beat the ride

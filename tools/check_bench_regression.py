@@ -117,6 +117,52 @@ def check_bench(
                         " the lane fault-containment machinery is taxing the steady path",
                     )
                 )
+        # async-read gates (ISSUE 9): a config reporting the per-step read
+        # rows is gated on (a) the submit-rate ratio vs the update-only rate
+        # (the "never stalls the step loop" acceptance; floor from the
+        # baseline's async_read_ratio_min) and (b) the submit overhead cap.
+        # Both floors live in BASELINE.json so a reviewed re-anchor moves the
+        # gate; see docs/ASYNC.md "Benchmarking" for why the 1-vCPU VM floor
+        # sits below the real-hardware 0.9 target.
+        aratio = result.get("async_read_ratio")
+        if isinstance(aratio, (int, float)):
+            base = baselines.get(name, {})
+            floor = base.get("async_read_ratio_min", 0.5) if isinstance(base, dict) else 0.5
+            if float(aratio) < float(floor):
+                violations.append(
+                    Violation(
+                        name,
+                        float(aratio),
+                        threshold,
+                        f"async_read_ratio {aratio:.3f} below the {floor} floor — per-step"
+                        " compute_async() is stalling the step loop",
+                    )
+                )
+        aoverhead = result.get("async_submit_overhead_pct")
+        if isinstance(aoverhead, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("async_submit_overhead_max_pct", 100.0) if isinstance(base, dict) else 100.0
+            if float(aoverhead) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"async_submit_overhead_pct {aoverhead:.2f} exceeds the {cap}% cap —"
+                        " the async read submission path is taxing the step loop",
+                    )
+                )
+        agree = result.get("async_values_agree")
+        if agree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "async_values_agree is false — compute_async() diverged from blocking"
+                    " compute(); exactness is the contract, fail outright",
+                )
+            )
         ratio = effective_ratio(name, result, baselines)
         if ratio is None or ratio >= threshold:
             continue

@@ -41,6 +41,7 @@ HOT_PATH_FILES = (
     "quarantine.py",
     "ops/executor.py",
     "ops/compile_cache.py",
+    "ops/async_read.py",
     "parallel/sync.py",
     "io/checkpoint.py",
     "io/retry.py",
@@ -81,6 +82,23 @@ ALLOWLIST = {
     "ops/executor.py::unpack": (
         "host-side value unpacker: runs on values the caller is about to read"
         " anyway (the read point), not on the update dispatch path"
+    ),
+    # --- async read pipeline (docs/ASYNC.md): the WORKER is the one
+    #     sanctioned place a read blocks — these two functions run only on
+    #     the pipeline thread (or on a caller that explicitly degraded to an
+    #     inline read under queue backpressure), never on the step loop
+    "ops/async_read.py::materialize": (
+        "the pipeline worker's ready-wait IS the design: compute_async"
+        " resolves with arrays block_until_ready'd HERE so the step loop"
+        " never waits on device work"
+    ),
+    "ops/async_read.py::_ready_leaf": (
+        "leaf-wise fallback of materialize for pytrees with non-blockable"
+        " leaves — same worker-side ready-wait"
+    ),
+    "ops/async_read.py::fetch_host": (
+        "worker-side D2H fetch (the laned health scan's counter read rides"
+        " here so lanes.py stays free of worker-side blocking calls)"
     ),
     # --- metric: read/serialisation surfaces, not the update dispatch path
     "metric.py::state_dict": (

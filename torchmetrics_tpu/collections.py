@@ -115,6 +115,7 @@ class MetricCollection:
         state["_executor_obj"] = None  # compiled executables are process-local
         # observers are process-local callbacks (autosavers, fault hooks)
         state.pop("_update_observers", None)
+        state.pop("_read_clone_cache", None)  # async-read clone is process-local
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -451,6 +452,50 @@ class MetricCollection:
 
     def compute(self) -> Dict[str, Any]:
         return self._compute_and_reduce("compute")
+
+    # ----------------------------------------------------- asynchronous reads
+    def compute_async(self) -> Any:
+        """Non-blocking :meth:`compute`: one
+        :class:`~torchmetrics_tpu.ops.async_read.MetricFuture` resolving to
+        the full renamed/flattened result dict a blocking ``compute()`` would
+        return for every member's state as of this call (docs/ASYNC.md).
+
+        Each member contributes its own caller-side snapshot (so the whole
+        collection reads consistently against later updates) and the worker
+        runs the member bodies as ONE pipeline job — a per-step read of a
+        5-metric collection costs one queue slot, not five."""
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.ops import async_read as _async
+
+        owner = type(self).__name__
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix=owner):
+            bodies = {name: m._prepare_async_read() for name, m in self._modules.items()}
+
+            def job() -> Dict[str, Any]:
+                return self._flatten_results({name: body() for name, body in bodies.items()})
+
+            return _async.get_pipeline().submit(
+                job, owner=owner, submitted_count=int(self.update_count)
+            )
+
+    def sync_async(self, axis_name: Any = None) -> Any:
+        """Non-blocking read-side :meth:`sync`: a future resolving to
+        ``{member_name: synced_state_pytree}`` (base names, every array
+        ready), computed from each member's state as of this call. The live
+        collection is never mutated — see ``Metric.sync_async``."""
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.ops import async_read as _async
+
+        owner = type(self).__name__
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix=owner, kind="sync"):
+            bodies = {name: m._prepare_async_sync(axis_name) for name, m in self._modules.items()}
+
+            def job() -> Dict[str, Any]:
+                return {name: body() for name, body in bodies.items()}
+
+            return _async.get_pipeline().submit(
+                job, owner=owner, submitted_count=int(self.update_count)
+            )
 
     def _compute_and_reduce(self, method_name: str) -> Dict[str, Any]:
         """Per metric compute/forward, flatten dict results (reference :314-359)."""

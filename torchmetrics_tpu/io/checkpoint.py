@@ -577,8 +577,12 @@ class Autosaver:
     - The host-side copy *reuses the executor's forced-copy recovery
       snapshot* when one is fresh enough (every donating call takes one
       anyway — ops/executor.py), so triggering a save usually costs zero
-      extra device synchronisation; only eager/escaped states pay one
-      device→host fetch.
+      extra device synchronisation. When no snapshot is reusable, a
+      background save *rides the async read pipeline* (ops/async_read.py,
+      ROADMAP): the hot path stages device REFERENCES (free — arrays are
+      immutable and ``state()`` marks them escaped, double-buffering them
+      against the next donating dispatch) and the D2H fetch runs on the
+      pipeline worker instead of the step loop.
     - Serialization, hashing, and the fsync'd write run on a single
       background worker thread. If a save is still in flight when the next
       one triggers, the new one is SKIPPED (counted in ``stats`` — cadence
@@ -617,6 +621,7 @@ class Autosaver:
             "saves": 0,
             "skipped_inflight": 0,
             "reused_recovery_snapshots": 0,
+            "async_rides": 0,
             "save_errors": 0,
             "last_path": None,
             "last_error": None,
@@ -624,9 +629,18 @@ class Autosaver:
         }
         self._updates_since_save = 0
         self._last_save_t = time.monotonic()
-        self._inflight: Optional[threading.Thread] = None
+        # a background thread OR an async-read-pipeline future (the ride-along)
+        self._inflight: Optional[Any] = None
         self._lock = threading.Lock()
         self._detach_fns: List[Callable[[], None]] = []
+
+    def _inflight_alive(self) -> bool:
+        inflight = self._inflight
+        if inflight is None:
+            return False
+        if isinstance(inflight, threading.Thread):
+            return inflight.is_alive()
+        return not inflight.done()  # MetricFuture (ops/async_read.py)
 
     # ------------------------------------------------------------ observation
     def attach(self) -> "Autosaver":
@@ -664,48 +678,52 @@ class Autosaver:
             return None
         return self.save_now(states=states, sharded=sharded)
 
-    def _host_snapshot(self) -> Tuple[Dict[str, Any], Optional[int]]:
-        """(host-copied export, update_count) — reusing the executor's recovery
-        snapshot when it describes the current state history."""
-        if self.reuse_recovery:
-            from torchmetrics_tpu.ops.executor import latest_recovery_snapshot
-
-            reusable = latest_recovery_snapshot(self.obj)
-            if reusable is not None:
-                count, export = reusable  # already np copies, count keys embedded
-                self.stats["reused_recovery_snapshots"] += 1
-                return export, int(count)
-        export = host_copy_tree(self.obj.state())
-        return export, _resolve_update_count(self.obj, export)
-
     def save_now(self, states: Optional[Dict[str, Any]] = None, sharded: bool = False) -> Optional[str]:
         """Trigger a save immediately: host copy on the calling thread, write
         on the worker (or inline when ``background=False``). Returns the
         (eventual) snapshot path, or None when skipped for an in-flight write."""
         with self._lock:
-            if self._inflight is not None and self._inflight.is_alive():
+            if self._inflight_alive():
                 self.stats["skipped_inflight"] += 1
                 obs.counter_inc("autosave.skipped_inflight")
                 return None
-            # the autosave span covers exactly what the HOT PATH pays: the
-            # host-side copy; serialization + fsync run on the worker, whose
-            # cost shows up as the checkpoint.save span on its own lane
+            # the autosave span covers exactly what the HOT PATH pays; with
+            # the async-read ride-along (docs/ASYNC.md) a background save's
+            # hot-path cost drops to staging device REFERENCES — the D2H copy
+            # itself moves to the read-pipeline worker alongside the
+            # serialization + fsync (which always ran off-thread)
+            staged: Optional[Dict[str, Any]] = None
             with obs.span(obs.SPAN_AUTOSAVE, owner=type(self.obj).__name__):
                 obs.counter_inc("autosave.ticks")
+                payload_states: Optional[Dict[str, Any]] = None
                 if states is not None:
-                    export = host_copy_tree(states)
-                    count = _resolve_update_count(self.obj, export)
-                    payload_states: Optional[Dict[str, Any]] = export
+                    payload_states = host_copy_tree(states)
                 else:
-                    export, count = self._host_snapshot()
-                    payload_states = export
+                    reusable = None
+                    if self.reuse_recovery:
+                        from torchmetrics_tpu.ops.executor import latest_recovery_snapshot
+
+                        reusable = latest_recovery_snapshot(self.obj)
+                    if reusable is not None:
+                        _count, export = reusable  # already np copies, count keys embedded
+                        self.stats["reused_recovery_snapshots"] += 1
+                        payload_states = export
+                    elif self.background:
+                        # ROADMAP ride-along: no host copy on this thread at
+                        # all — jax arrays are immutable, so staging
+                        # references is free and state() marks them escaped
+                        # (the executor's next donating dispatch copies first);
+                        # the D2H runs on the read-pipeline worker
+                        staged = self.obj.state()
+                    else:
+                        payload_states = host_copy_tree(self.obj.state())
                 self._updates_since_save = 0
                 self._last_save_t = time.monotonic()
 
-            def write() -> None:
+            def write(export: Optional[Dict[str, Any]]) -> None:
                 try:
                     written = save_state(
-                        self.obj, self.directory, keep=self.keep, states=payload_states, sharded=sharded
+                        self.obj, self.directory, keep=self.keep, states=export, sharded=sharded
                     )
                     self.stats["saves"] += 1
                     self.stats["last_path"] = written
@@ -719,10 +737,24 @@ class Autosaver:
                     obs.breadcrumb("autosave_failed", {"error": f"{type(err).__name__}: {err}"})
                     rank_zero_warn(f"torchmetrics_tpu autosave failed: {type(err).__name__}: {err}")
 
+            if staged is not None:
+                from torchmetrics_tpu.ops.async_read import get_pipeline
+
+                def ride() -> None:
+                    write(host_copy_tree(staged))
+
+                self.stats["async_rides"] += 1
+                obs.counter_inc("autosave.async_rides")
+                self._inflight = get_pipeline().submit(
+                    ride, owner=f"Autosaver({type(self.obj).__name__})"
+                )
+                return self.directory
             if not self.background:
-                write()
+                write(payload_states)
                 return self.stats["last_path"]
-            worker = threading.Thread(target=write, name="tm_tpu_autosave", daemon=True)
+            worker = threading.Thread(
+                target=write, args=(payload_states,), name="tm_tpu_autosave", daemon=True
+            )
             self._inflight = worker
             worker.start()
         # background mode: the concrete snapshot path lands in stats["last_path"]
@@ -730,10 +762,16 @@ class Autosaver:
         return self.directory
 
     def flush(self, timeout: Optional[float] = None) -> None:
-        """Block until any in-flight background write completes."""
+        """Block until any in-flight background write completes (a dedicated
+        writer thread or a read-pipeline ride-along future alike)."""
         worker = self._inflight
-        if worker is not None and worker.is_alive():
-            worker.join(timeout)
+        if worker is None:
+            return
+        if isinstance(worker, threading.Thread):
+            if worker.is_alive():
+                worker.join(timeout)
+        else:
+            worker.wait(timeout)  # MetricFuture: resolves when the write landed
 
     def final_save(self) -> Optional[str]:
         """Synchronous last-gasp snapshot (the preemption-handler path): waits

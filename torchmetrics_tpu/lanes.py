@@ -71,6 +71,7 @@ from __future__ import annotations
 
 import json
 import time
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -662,7 +663,15 @@ class LanedMetric(Metric):
         compiled ``update`` dispatch advances every session in the round — a
         session appearing k times spans k sequential rounds. Returns the
         number of dispatches issued.
+
+        Guard-active rounds run under the shared read mutex so an in-flight
+        asynchronous read's scan-and-attribute step (docs/ASYNC.md) never
+        interleaves with the round's guard/state mutations.
         """
+        with self._read_mutex():
+            return self._update_sessions_impl(items)
+
+    def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
         from torchmetrics_tpu.ops.executor import bucket_size
 
         if isinstance(items, dict):
@@ -909,63 +918,90 @@ class LanedMetric(Metric):
         self._computed = None
         self.__dict__["_lane_mirror"].patch_rows(lanes, {f: np.asarray(v) for f, v in rows.items()})
 
-    def _scan_lane_health(self) -> None:
+    def _read_mutex(self):
+        """The critical-section lock serialising the async read pipeline's
+        scan-and-attribute step against router/lifecycle mutations — shared
+        across a LanedCollection's members exactly the way the guard is
+        (ops/async_read.py ``guard_lock``). A null context while no fault
+        policy is active: without a guard the pipeline worker never mutates
+        live state, so the steady path pays nothing."""
+        guard: LaneGuard = self.__dict__["_guard"]
+        if not guard.active:
+            return nullcontext()
+        from torchmetrics_tpu.ops.async_read import guard_lock
+
+        return guard_lock(guard)
+
+    def _scan_lane_health(self, health_host: Optional[np.ndarray] = None) -> None:
         """Read-point device-side poison attribution (tentpole #2): diff the
         fused ``lane_health`` counters against the last scan and apply the
         fault policy to newly-poisoned lanes. The counters ride the update
         dispatch itself, so the steady path pays zero extra host syncs —
-        attribution happens here, where the caller is already reading values."""
+        attribution happens here, where the caller is already reading values.
+
+        ``health_host`` is the async read pipeline's seam: the worker fetches
+        the counters OUTSIDE the lock (ops/async_read.py ``fetch_host``) and
+        hands the host array in, so the step loop can only ever wait on the
+        host-side bookkeeping below, never on a D2H. A pre-fetched array whose
+        shape no longer matches the live capacity (a grow landed after the
+        snapshot) skips the scan — the next live read attributes from the
+        grown counters."""
         guard: LaneGuard = self.__dict__["_guard"]
         if not guard.active:
             return
         table: LaneTable = self.__dict__["_table"]
-        if self._compiled_lanes:
-            self._fold_pending()
-            health = np.asarray(self._state["lane_health"])
-            if health.ndim > 1:  # stacked sharded layout: faults sum across shards
-                health = health.sum(axis=0)
-        else:
-            health = np.asarray(self.__dict__["_lane_health_counts"])
-        seen = self.__dict__.get("_health_seen")
-        if seen is None or np.shape(seen) != health.shape:
-            seen = np.zeros_like(health)
-        newly = np.flatnonzero(health > seen)
-        self.__dict__["_health_seen"] = health.astype(np.int64).copy()
-        # anchors for any last-good capture this scan triggers: the PRE-fault
-        # health count, so the quarantining poisoned update itself counts as
-        # traffic the served value is missing (updates_behind >= 1)
-        self.__dict__["_pending_capture_health"] = {int(lane): int(seen[int(lane)]) for lane in newly}
-        try:
-            for lane in newly:
-                sid = table.lane_session[int(lane)]
-                if sid is None:
-                    continue
-                action = guard.record_fault(
-                    sid, "device", f"non-finite update in lane {int(lane)} (health={int(health[lane])})"
-                )
-                self._apply_fault_action(
-                    sid,
-                    action,
-                    LaneFaultError(
-                        f"lane {int(lane)} (session {sid!r}) produced a non-finite update",
-                        session_id=sid,
-                        lane=int(lane),
-                        where="device",
-                    ),
-                )
-        finally:
-            self.__dict__.pop("_pending_capture_health", None)
-        if guard.quarantined:
-            # probation progress: committed updates since the last scan with
-            # no new fault are clean probes (the divert-at-scatter screen
-            # already validated them on device)
-            counts = self._lane_counts_host()
-            newly_set = {int(lane) for lane in newly}
-            for sid in list(guard.quarantined):
-                lane = table.sessions.get(sid)
-                if lane is None:
-                    continue
-                guard.probe_progress(sid, int(counts[lane]), faulted=lane in newly_set)
+        with self._read_mutex():
+            if health_host is not None:
+                health = health_host
+                if health.shape != (self.capacity,):
+                    return  # stale pre-grow snapshot: leave attribution to a live read
+            elif self._compiled_lanes:
+                self._fold_pending()
+                health = np.asarray(self._state["lane_health"])
+                if health.ndim > 1:  # stacked sharded layout: faults sum across shards
+                    health = health.sum(axis=0)
+            else:
+                health = np.asarray(self.__dict__["_lane_health_counts"])
+            seen = self.__dict__.get("_health_seen")
+            if seen is None or np.shape(seen) != health.shape:
+                seen = np.zeros_like(health)
+            newly = np.flatnonzero(health > seen)
+            self.__dict__["_health_seen"] = health.astype(np.int64).copy()
+            # anchors for any last-good capture this scan triggers: the PRE-fault
+            # health count, so the quarantining poisoned update itself counts as
+            # traffic the served value is missing (updates_behind >= 1)
+            self.__dict__["_pending_capture_health"] = {int(lane): int(seen[int(lane)]) for lane in newly}
+            try:
+                for lane in newly:
+                    sid = table.lane_session[int(lane)]
+                    if sid is None:
+                        continue
+                    action = guard.record_fault(
+                        sid, "device", f"non-finite update in lane {int(lane)} (health={int(health[lane])})"
+                    )
+                    self._apply_fault_action(
+                        sid,
+                        action,
+                        LaneFaultError(
+                            f"lane {int(lane)} (session {sid!r}) produced a non-finite update",
+                            session_id=sid,
+                            lane=int(lane),
+                            where="device",
+                        ),
+                    )
+            finally:
+                self.__dict__.pop("_pending_capture_health", None)
+            if guard.quarantined:
+                # probation progress: committed updates since the last scan with
+                # no new fault are clean probes (the divert-at-scatter screen
+                # already validated them on device)
+                counts = self._lane_counts_host()
+                newly_set = {int(lane) for lane in newly}
+                for sid in list(guard.quarantined):
+                    lane = table.sessions.get(sid)
+                    if lane is None:
+                        continue
+                    guard.probe_progress(sid, int(counts[lane]), faulted=lane in newly_set)
 
     @staticmethod
     def _stack_rows(batches: List[Tuple[Any, ...]], bucket: int) -> Tuple[Any, ...]:
@@ -1122,28 +1158,30 @@ class LanedMetric(Metric):
     def admit(self, session_id: Any) -> int:
         """Allocate a lane to ``session_id`` (growing capacity if needed);
         returns the lane index. Idempotent for known sessions."""
-        table: LaneTable = self.__dict__["_table"]
-        if session_id in table.sessions:
-            return table.sessions[session_id]
-        if table.free == 0:
-            self.grow()
-        lane = table.allocate(session_id)
-        self._computed = None
-        obs.counter_inc("lanes.admissions")
-        obs.gauge_set("lanes.occupancy", table.active)
-        return lane
+        with self._read_mutex():
+            table: LaneTable = self.__dict__["_table"]
+            if session_id in table.sessions:
+                return table.sessions[session_id]
+            if table.free == 0:
+                self.grow()
+            lane = table.allocate(session_id)
+            self._computed = None
+            obs.counter_inc("lanes.admissions")
+            obs.gauge_set("lanes.occupancy", table.active)
+            return lane
 
     def evict(self, session_id: Any) -> int:
         """Reclaim ``session_id``'s lane: the lane state is reset to defaults
         (masked, shape-stable — no recompile) and returned to the free pool."""
-        table: LaneTable = self.__dict__["_table"]
-        lane = table.release(session_id)
-        self._reset_lane_indices([lane])
-        self.__dict__["_guard"].forget(session_id)
-        self._computed = None
-        obs.counter_inc("lanes.evictions")
-        obs.gauge_set("lanes.occupancy", table.active)
-        return lane
+        with self._read_mutex():
+            table: LaneTable = self.__dict__["_table"]
+            lane = table.release(session_id)
+            self._reset_lane_indices([lane])
+            self.__dict__["_guard"].forget(session_id)
+            self._computed = None
+            obs.counter_inc("lanes.evictions")
+            obs.gauge_set("lanes.occupancy", table.active)
+            return lane
 
     def evict_idle(self, idle_s: float) -> List[Any]:
         """Evict every session idle longer than ``idle_s`` seconds; returns
@@ -1156,11 +1194,12 @@ class LanedMetric(Metric):
     def reset_session(self, session_id: Any) -> None:
         """Reset one session's accumulated state to defaults WITHOUT releasing
         its lane (the mask is data: no recompile)."""
-        table: LaneTable = self.__dict__["_table"]
-        self._reset_lane_indices([table.lane_of(session_id)])
-        table.stats["resets"] += 1
-        self._computed = None
-        obs.counter_inc("lanes.resets")
+        with self._read_mutex():
+            table: LaneTable = self.__dict__["_table"]
+            self._reset_lane_indices([table.lane_of(session_id)])
+            table.stats["resets"] += 1
+            self._computed = None
+            obs.counter_inc("lanes.resets")
 
     def _reset_lane_indices(self, lanes: Sequence[int]) -> None:
         self.__dict__["_lane_mirror"].invalidate()  # out-of-band state mutation
@@ -1221,20 +1260,21 @@ class LanedMetric(Metric):
         signature, so the first post-growth dispatch resolves a NEW
         executable — via the persistent disk store when
         :meth:`prewarm_growth` (or a previous process) populated it."""
-        table: LaneTable = self.__dict__["_table"]
-        target = lane_capacity_bucket(table.capacity + 1 if new_capacity is None else new_capacity)
-        if target <= table.capacity:
-            return table.capacity
-        if self.max_capacity is not None and target > self.max_capacity:
-            raise TorchMetricsUserError(
-                f"cannot grow lanes to {target}: max_capacity={self.max_capacity}"
-                f" (active sessions: {table.active})"
-            )
-        self._grow_state(target)
-        table.grow(target)
-        obs.counter_inc("lanes.grows")
-        obs.gauge_set("lanes.capacity", target)
-        return target
+        with self._read_mutex():
+            table: LaneTable = self.__dict__["_table"]
+            target = lane_capacity_bucket(table.capacity + 1 if new_capacity is None else new_capacity)
+            if target <= table.capacity:
+                return table.capacity
+            if self.max_capacity is not None and target > self.max_capacity:
+                raise TorchMetricsUserError(
+                    f"cannot grow lanes to {target}: max_capacity={self.max_capacity}"
+                    f" (active sessions: {table.active})"
+                )
+            self._grow_state(target)
+            table.grow(target)
+            obs.counter_inc("lanes.grows")
+            obs.gauge_set("lanes.capacity", target)
+            return target
 
     def _grow_state(self, target: int) -> None:
         old = self.capacity
@@ -1488,6 +1528,98 @@ class LanedMetric(Metric):
             )
         return value
 
+    # ----------------------------------------------------- asynchronous reads
+    def _read_inner_clone(self) -> Metric:
+        """Detached clone of ``inner`` for worker-side ``functional_compute``:
+        the live inner swaps its ``_state`` during traces, so the pipeline
+        worker must never compute on it (same rule as the compile worker)."""
+        cached = self.__dict__.get("_inner_clone_cache")
+        if cached is None:
+            cached = self.inner.clone()
+            cached.__dict__["_executor_enabled"] = False
+            self.__dict__["_inner_clone_cache"] = cached
+        return cached
+
+    def _prepare_async_read(self) -> Callable[[], Any]:
+        """Lane-aware asynchronous aggregate read (docs/ASYNC.md "Laned
+        reads"): the caller snapshots the stacked state by reference (the
+        escape flag double-buffers it against the next donating round) plus
+        the submission-time lane membership; the worker fetches the fused
+        ``lane_health`` counters, runs the scan-and-attribute step under the
+        shared read mutex (quarantine decisions land on the LIVE guard,
+        exactly as a blocking read's scan would), folds the snapshot over the
+        surviving lanes and computes on a detached inner clone. Eager-mode
+        (list/cat state) metrics and true multi-host worlds fall back to an
+        inline read."""
+        from torchmetrics_tpu.ops import async_read as _async
+
+        cached = self._computed
+        if cached is not None:
+            return lambda: _async.materialize(cached)
+        # a raising world-check surfaces here at submit, exactly where the
+        # blocking compute()'s sync would have raised it
+        distributed = bool(self.distributed_available_fn())
+        if not self._compiled_lanes or distributed:
+            # eager per-lane loop, or a multi-host sync whose collective
+            # semantics belong on the blocking path: inline fallback
+            obs.counter_inc("reads.inline_compute")
+            value = self.compute()
+            return lambda: _async.materialize(value)
+        self._fold_pending()  # deferred shards: dispatch the fold, don't wait
+        table: LaneTable = self.__dict__["_table"]
+        snapshot = self._copy_state_dict()  # by-reference; marks state escaped
+        flags = self._capture_read_flags()
+        mask_list = list(table.active_mask())
+        sessions_map = dict(table.sessions)
+        active_n = table.active
+        inner_clone = self._read_inner_clone()
+        return lambda: self._async_laned_job(
+            snapshot, flags, mask_list, sessions_map, active_n, inner_clone
+        )
+
+    def _async_laned_job(
+        self,
+        snapshot: Dict[str, Any],
+        flags: Dict[str, Any],
+        mask_list: List[bool],
+        sessions_map: Dict[Any, int],
+        active_n: int,
+        inner_clone: Metric,
+    ) -> Any:
+        """WORKER-SIDE: health scan (locked), masked fold, inner compute,
+        materialize, guarded cache write-back."""
+        from torchmetrics_tpu.ops import async_read as _async
+
+        guard: LaneGuard = self.__dict__["_guard"]
+        if guard.active:
+            health = _async.fetch_host(snapshot["lane_health"])
+            if health.ndim > 1:  # stacked sharded layout: faults sum across shards
+                health = health.sum(axis=0)
+            with self._read_mutex():
+                self._scan_lane_health(health_host=health)
+                quarantined = set(guard.quarantined)
+        else:
+            quarantined = set()
+        if active_n == 0:
+            value = inner_clone.functional_compute(inner_clone.init_state())
+        else:
+            mask = jnp.asarray(mask_list)
+            bad = [sessions_map[sid] for sid in quarantined if sid in sessions_map]
+            if bad:
+                mask = mask.at[jnp.asarray(bad)].set(False)
+            folded = self._fold_lanes({f: snapshot[f] for f in self._inner_fields()}, mask)
+            value = inner_clone.functional_compute(folded)
+        value = _async.materialize(value)
+        if (
+            self.__dict__.get("_update_count") == flags["count"]
+            and flags["cache"]
+            and self.__dict__.get("_computed") is None
+        ):
+            self.__dict__["_computed"] = value
+            if self.__dict__.get("_update_count") != flags["count"]:
+                self.__dict__["_computed"] = None  # an update landed mid-write
+        return value
+
     # ------------------------------------------------------------- durability
     def _export_extras(self) -> Dict[str, Any]:
         """Host-side metadata a recovery-reused snapshot must carry alongside
@@ -1736,6 +1868,7 @@ class LanedMetric(Metric):
         out.pop("_round_ctx", None)
         out.pop("_pending_capture_health", None)
         out.pop("_fault_owner", None)  # re-linked by the owning LanedCollection
+        out.pop("_inner_clone_cache", None)  # async-read clone is process-local
         return out
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -1874,10 +2007,23 @@ class LanedCollection:
         return self._members[name]
 
     # ----------------------------------------------------------------- router
+    def _read_mutex(self):
+        """Shared critical-section lock for the suite (see
+        ``LanedMetric._read_mutex`` — one guard, one lock, every member)."""
+        if not self._guard.active:
+            return nullcontext()
+        from torchmetrics_tpu.ops.async_read import guard_lock
+
+        return guard_lock(self._guard)
+
     def update_sessions(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
         """Pack ``(session_id, batch)`` traffic and advance EVERY member with
         one fused collection dispatch per round (see
         :meth:`LanedMetric.update_sessions`). Returns the dispatch count."""
+        with self._read_mutex():
+            return self._update_sessions_impl(items)
+
+    def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
         from torchmetrics_tpu.ops.executor import bucket_size
 
         if isinstance(items, dict):
@@ -1961,26 +2107,28 @@ class LanedCollection:
 
     # -------------------------------------------------------------- lifecycle
     def admit(self, session_id: Any) -> int:
-        if session_id in self._table.sessions:
-            return self._table.sessions[session_id]
-        if self._table.free == 0:
-            self.grow()
-        lane = self._table.allocate(session_id)
-        for m in self._members.values():
-            m._computed = None
-        obs.counter_inc("lanes.admissions")
-        obs.gauge_set("lanes.occupancy", self._table.active)
-        return lane
+        with self._read_mutex():
+            if session_id in self._table.sessions:
+                return self._table.sessions[session_id]
+            if self._table.free == 0:
+                self.grow()
+            lane = self._table.allocate(session_id)
+            for m in self._members.values():
+                m._computed = None
+            obs.counter_inc("lanes.admissions")
+            obs.gauge_set("lanes.occupancy", self._table.active)
+            return lane
 
     def evict(self, session_id: Any) -> int:
-        lane = self._table.release(session_id)
-        for m in self._members.values():
-            m._reset_lane_indices([lane])
-            m._computed = None
-        self._guard.forget(session_id)
-        obs.counter_inc("lanes.evictions")
-        obs.gauge_set("lanes.occupancy", self._table.active)
-        return lane
+        with self._read_mutex():
+            lane = self._table.release(session_id)
+            for m in self._members.values():
+                m._reset_lane_indices([lane])
+                m._computed = None
+            self._guard.forget(session_id)
+            obs.counter_inc("lanes.evictions")
+            obs.gauge_set("lanes.occupancy", self._table.active)
+            return lane
 
     def evict_idle(self, idle_s: float) -> List[Any]:
         idle = self._table.idle_sessions(idle_s)
@@ -1989,17 +2137,23 @@ class LanedCollection:
         return idle
 
     def reset_session(self, session_id: Any) -> None:
-        lane = self._table.lane_of(session_id)
-        for m in self._members.values():
-            m._reset_lane_indices([lane])
-            m._computed = None
-        self._table.stats["resets"] += 1
-        obs.counter_inc("lanes.resets")
+        with self._read_mutex():
+            lane = self._table.lane_of(session_id)
+            for m in self._members.values():
+                m._reset_lane_indices([lane])
+                m._computed = None
+            self._table.stats["resets"] += 1
+            obs.counter_inc("lanes.resets")
 
     def reset(self) -> None:
-        self.collection.reset()
+        with self._read_mutex():
+            self.collection.reset()
 
     def grow(self, new_capacity: Optional[int] = None) -> int:
+        with self._read_mutex():
+            return self._grow_impl(new_capacity)
+
+    def _grow_impl(self, new_capacity: Optional[int] = None) -> int:
         target = lane_capacity_bucket(self._table.capacity + 1 if new_capacity is None else new_capacity)
         if target <= self._table.capacity:
             return self._table.capacity
@@ -2016,6 +2170,18 @@ class LanedCollection:
     def compute(self) -> Dict[str, Any]:
         """All-lane aggregate per member (the collection's renamed dict)."""
         return self.collection.compute()
+
+    def compute_async(self) -> Any:
+        """Non-blocking :meth:`compute`: one future resolving to every
+        member's all-lane aggregate (docs/ASYNC.md "Laned reads") — member
+        snapshots taken now, health scans and quarantine exclusions applied
+        on the pipeline worker under the shared read mutex."""
+        return self.collection.compute_async()
+
+    def sync_async(self, axis_name: Any = None) -> Any:
+        """Non-blocking read-side sync over every member (see
+        ``MetricCollection.sync_async``)."""
+        return self.collection.sync_async(axis_name)
 
     def lane_values(self) -> Dict[Any, Dict[str, Any]]:
         """``{session_id: {member_name: value}}`` for every active session."""

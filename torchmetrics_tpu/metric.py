@@ -68,6 +68,15 @@ def jit_distributed_available() -> bool:
     return jax.process_count() > 1
 
 
+def _async_materialize(value: Any) -> Any:
+    """Worker-side ready-wait, routed through the read pipeline's sanctioned
+    blocking point (ops/async_read.py ``materialize`` — this module stays
+    clean under tools/lint_blocking_host_sync.py by construction)."""
+    from torchmetrics_tpu.ops.async_read import materialize
+
+    return materialize(value)
+
+
 class Metric:
     """Base class for all metrics.
 
@@ -524,8 +533,13 @@ class Metric:
             self._fold_pending()
             pre_count, pre_computed = self._update_count, self._computed
             pre_reduced = self.__dict__.get("_reduced", True)
-            self._computed = None
+            # count bumps BEFORE the cache clears: the async read pipeline's
+            # compute-cache write-back double-checks the count around its
+            # write (docs/ASYNC.md "Cache coherence"), and that check is only
+            # race-free if an update's count moves first and its cache clear
+            # lands second
             self._update_count += 1
+            self._computed = None
             ex = self._get_executor()
             if ex is not None:
                 handled = False
@@ -942,6 +956,225 @@ class Metric:
             yield
         finally:
             self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    # ----------------------------------------------------- asynchronous reads
+    #
+    # compute_async()/sync_async() (docs/ASYNC.md): the blocking tail of a
+    # read — waiting on the fused reduce, the bounded multi-host gather, the
+    # host finalize and D2H — runs on the read pipeline's worker thread
+    # (ops/async_read.py) against a by-reference snapshot of the live state.
+    # The snapshot marks the state escaped, so the executor's next donating
+    # dispatch copies before it donates (the same seam the recovery snapshot
+    # uses): the step loop's next update() writes a fresh buffer while the
+    # in-flight read drains the old one. Worker-side evaluation runs on a
+    # cached detached clone because functional_compute/compute swap the live
+    # _state during the call — tracing or computing on the live object off
+    # the main thread races every concurrent update.
+
+    def _read_clone(self) -> "Metric":
+        """The detached clone the pipeline worker computes on (cached; rebuilt
+        when the declared state layout changes — a laned capacity respec, a
+        ``set_dtype``). Only its CODE and declared metadata matter: every read
+        installs a fresh state snapshot before running."""
+        sig = tuple(
+            (k, "list") if isinstance(v, list) else (k, str(v.dtype), tuple(int(d) for d in v.shape))
+            for k, v in self._defaults.items()
+        )
+        cached = self.__dict__.get("_read_clone_cache")
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        clone = copy.deepcopy(self)
+        # reads never dispatch through an executor; a clone must never own one
+        clone.__dict__["_executor_enabled"] = False
+        self.__dict__["_read_clone_cache"] = (sig, clone)
+        return clone
+
+    def _async_inline_reason(self) -> Optional[str]:
+        """Why this metric's reads must resolve inline (None = fully async).
+
+        A metric holding CHILD metric objects (wrappers, compositional
+        metrics) keeps state outside ``_state``, so a snapshot-and-clone read
+        would serve the children's state as of clone creation — stale. Those
+        metrics evaluate on the calling thread instead (the future resolves
+        through the pipeline, but the compute cost lands inline; documented
+        in docs/ASYNC.md "Inline fallbacks")."""
+        cached = self.__dict__.get("_async_inline_reason_c", "?")
+        if cached != "?":
+            return cached
+        reason = None
+        for k, v in self.__dict__.items():
+            if k in ("_state", "_defaults", "_read_clone_cache"):
+                continue
+            if isinstance(v, Metric):
+                reason = f"holds child metric under attribute {k!r}"
+                break
+            if isinstance(v, (list, tuple)) and any(isinstance(el, Metric) for el in v):
+                reason = f"holds child metrics under attribute {k!r}"
+                break
+            if isinstance(v, dict) and any(isinstance(el, Metric) for el in v.values()):
+                reason = f"holds child metrics under attribute {k!r}"
+                break
+        self.__dict__["_async_inline_reason_c"] = reason
+        return reason
+
+    def _capture_read_flags(self) -> Dict[str, Any]:
+        """Submission-time bookkeeping a read job needs: the committed count,
+        the deferred-reduction flags, the last-good cache and sync intent —
+        captured here so caller-side mutations after submission cannot bleed
+        into an in-flight read (and vice versa)."""
+        d = self.__dict__
+        return {
+            "count": int(d.get("_update_count", 0)),
+            "reduced": d.get("_reduced", True),
+            "pending_shards": d.get("_pending_shards"),
+            "last_good": d.get("_last_good_compute"),
+            "to_sync": d.get("_to_sync", True),
+            "cache": bool(d.get("compute_with_cache", True)),
+        }
+
+    def compute_async(self) -> Any:
+        """Non-blocking :meth:`compute`: returns a
+        :class:`~torchmetrics_tpu.ops.async_read.MetricFuture` resolving to
+        exactly what a blocking ``compute()`` would return for the state as
+        of THIS call — same value bit-for-bit, same ``on_sync_failure``
+        policies, same :class:`~torchmetrics_tpu.quarantine.DegradedValue`
+        degraded serving, same errors (re-raised by ``future.result()``).
+
+        The caller never blocks: the fused reduce is *dispatched* here (JAX
+        async dispatch enqueues device work without waiting) and everything
+        that must wait — device completion, the bounded multi-host gather,
+        D2H — runs on the read pipeline's worker. The live state is
+        double-buffered by construction: this call marks it escaped, so the
+        next ``update()``'s donating dispatch copies first, and the step
+        loop proceeds immediately while the read drains. Mutating the metric
+        (update/reset/load_state) before the future resolves is safe — the
+        future still serves the submission-time value, and the live
+        ``_reduced``/``deferred_pending`` flags are never touched by the
+        in-flight read. See docs/ASYNC.md for the staleness and cache
+        contract."""
+        from torchmetrics_tpu.ops import async_read as _async
+
+        owner = type(self).__name__
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix=owner):
+            body = self._prepare_async_read()
+            return _async.get_pipeline().submit(
+                body, owner=owner, submitted_count=int(self._update_count)
+            )
+
+    def _prepare_async_read(self) -> Callable[[], Any]:
+        """The caller-side half of one asynchronous compute: dispatch what can
+        be dispatched, snapshot what must stay consistent, and return the
+        worker-side body. Collections compose member bodies into one job
+        through this seam (and :class:`~torchmetrics_tpu.lanes.LanedMetric`
+        overrides it with the lane-aware read body)."""
+        cached = self._computed
+        if cached is not None:
+            return lambda: _async_materialize(cached)
+        reason = self._async_inline_reason()
+        if reason is not None:
+            obs.counter_inc("reads.inline_compute")
+            value = self.compute()  # inline fallback: blocking semantics on the caller
+            return lambda: _async_materialize(value)
+        self._fold_pending()  # device dispatch only: enqueued, not awaited
+        snapshot = self._copy_state_dict()  # by-reference; marks state escaped
+        flags = self._capture_read_flags()
+        clone = self._read_clone()
+        return lambda: self._async_compute_job(clone, snapshot, flags)
+
+    def _install_read_snapshot(self, clone: "Metric", snapshot: Dict[str, Any], flags: Dict[str, Any]) -> None:
+        """WORKER-SIDE: stage a submission-time snapshot into the read clone
+        so the clone's ``compute``/``sync`` replays blocking semantics against
+        it (single worker thread -> the shared clone is used serially)."""
+        object.__setattr__(clone, "_state", dict(snapshot))
+        d = clone.__dict__
+        d["_state_escaped"] = True
+        d["_update_count"] = flags["count"]
+        d["_computed"] = None
+        d["_reduced"] = flags["reduced"]
+        d["_pending_shards"] = flags["pending_shards"]
+        d["_is_synced"] = False
+        d["_cache"] = None
+        d["_last_sync_ok"] = True
+        d["_last_good_compute"] = flags["last_good"]
+        d.pop("_serve_last_good", None)
+        d["_to_sync"] = flags["to_sync"]
+        d["_should_unsync"] = True
+
+    def _async_compute_job(self, clone: "Metric", snapshot: Dict[str, Any], flags: Dict[str, Any]) -> Any:
+        """WORKER-SIDE: the pipelined read body — reduce/sync per policy,
+        host finalize, materialize, then the guarded cache write-back."""
+        self._install_read_snapshot(clone, snapshot, flags)
+        value = _async_materialize(clone.compute())
+        self._writeback_read_result(clone, flags, value)
+        return value
+
+    def _writeback_read_result(self, clone: "Metric", flags: Dict[str, Any], value: Any) -> None:
+        """WORKER-SIDE cache coherence (docs/ASYNC.md): a resolved read may
+        refresh the live compute cache and last-good/sync bookkeeping ONLY
+        while the live metric still sits at the submission-time update count.
+        The count-bump-then-cache-clear ordering in ``_wrap_update`` plus the
+        re-check after the write make a concurrent update always win: either
+        this write never happens, or the update's cache clear lands after it,
+        or the re-check undoes it."""
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        if self.__dict__.get("_update_count") != flags["count"]:
+            return
+        self.__dict__["_last_sync_ok"] = clone.__dict__.get("_last_sync_ok", True)
+        last_good = clone.__dict__.get("_last_good_compute")
+        if last_good is not None:
+            self.__dict__["_last_good_compute"] = last_good
+        if flags["cache"] and not isinstance(value, DegradedValue) and self.__dict__.get("_computed") is None:
+            self.__dict__["_computed"] = value
+            if self.__dict__.get("_update_count") != flags["count"]:
+                self.__dict__["_computed"] = None  # an update landed mid-write: drop the stale cache
+
+    def sync_async(self, axis_name: Optional[Union[str, Sequence[str]]] = None) -> Any:
+        """Non-blocking read-side :meth:`sync`: returns a
+        :class:`~torchmetrics_tpu.ops.async_read.MetricFuture` resolving to
+        the SYNCED state pytree (the dict :meth:`state` would export after a
+        blocking ``sync()``, every array ready) for the state as of this
+        call. Unlike blocking ``sync()``, the live metric is never mutated —
+        this is a read, so there is nothing to ``unsync`` and no
+        ``_is_synced`` latch to manage from another thread. Honors
+        ``sync_timeout`` and every ``on_sync_failure`` policy; failures
+        surface through ``future.result()`` exactly as ``sync()`` would
+        raise them."""
+        from torchmetrics_tpu.ops import async_read as _async
+
+        owner = type(self).__name__
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix=owner, kind="sync"):
+            body = self._prepare_async_sync(axis_name)
+            return _async.get_pipeline().submit(
+                body, owner=owner, submitted_count=int(self._update_count)
+            )
+
+    def _prepare_async_sync(self, axis_name: Any = None) -> Callable[[], Any]:
+        """Caller-side half of one asynchronous sync (see
+        :meth:`_prepare_async_read`)."""
+        self._fold_pending()
+        reason = self._async_inline_reason()
+        if reason is not None:
+            obs.counter_inc("reads.inline_compute")
+            with self.sync_context(should_sync=True, should_unsync=True, axis_name=axis_name):
+                out = self.state()  # inline fallback: blocking semantics on the caller
+            return lambda: _async_materialize(out)
+        snapshot = self._copy_state_dict()
+        flags = self._capture_read_flags()
+        clone = self._read_clone()
+        return lambda: self._async_sync_job(clone, snapshot, flags, axis_name)
+
+    def _async_sync_job(
+        self, clone: "Metric", snapshot: Dict[str, Any], flags: Dict[str, Any], axis_name: Any
+    ) -> Dict[str, Any]:
+        """WORKER-SIDE: bounded sync on the snapshot via the clone, then the
+        materialized state export."""
+        self._install_read_snapshot(clone, snapshot, flags)
+        clone.sync(should_sync=True, axis_name=axis_name)
+        out = _async_materialize(clone.state())
+        if self.__dict__.get("_update_count") == flags["count"]:
+            self.__dict__["_last_sync_ok"] = clone.__dict__.get("_last_sync_ok", True)
+        return out
 
     # ------------------------------------------------------- pure / functional
     def _copy_state_dict(self) -> Dict[str, Any]:
@@ -1544,6 +1777,10 @@ class Metric:
         # pickled/cloned copy must not inherit another instance's triggers
         state.pop("_update_observers", None)
         state.pop("_forward_depth", None)
+        # the async-read clone and its inline verdict are process-local (and
+        # keeping the clone would deep-copy it into every clone-of-a-clone)
+        state.pop("_read_clone_cache", None)
+        state.pop("_async_inline_reason_c", None)
         state.pop("_update_fn", None)
         state.pop("_compute_fn", None)
         state.pop("_update_signature", None)

@@ -33,6 +33,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_CKPT_SAVE,
     SPAN_COMPILE,
     SPAN_COMPUTE,
+    SPAN_COMPUTE_ASYNC,
     SPAN_DISPATCH,
     SPAN_EXPORT,
     SPAN_LANES,

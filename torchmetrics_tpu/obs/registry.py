@@ -14,6 +14,13 @@ module is where they all meet:
   process-global ``executor.*`` counters with ZERO hot-path cost — the
   executors keep incrementing their plain dicts; aggregation happens only
   when somebody asks.
+- **Async-read telemetry** (docs/ASYNC.md): the read pipeline
+  (ops/async_read.py) counts ``reads.async_submitted`` /
+  ``reads.async_completed`` / ``reads.async_degraded`` /
+  ``reads.async_errors`` / ``reads.inline_fallback`` and keeps the
+  ``reads.pending`` gauge at the current in-flight depth — the first thing
+  to look at when futures resolve slowly (a growing gauge means reads are
+  submitted faster than the worker drains them).
 - **Breadcrumbs** (:func:`breadcrumb`): a bounded trail of fault-path
   records (stalls, evictions, sync degradations) that
   :func:`dump_diagnostics` surfaces — the stall watchdog and fault paths

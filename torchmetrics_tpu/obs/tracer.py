@@ -76,6 +76,7 @@ SPAN_WARMUP = "tm_tpu.warmup"              # warmup API precompiles
 SPAN_EXPORT = "tm_tpu.export"              # telemetry export itself (allowlisted blocking)
 SPAN_LANES = "tm_tpu.lanes.dispatch"       # lane-batched multi-session dispatch (pack+scatter)
 SPAN_QUARANTINE = "tm_tpu.lanes.quarantine"  # lane fault containment (rollback + quarantine)
+SPAN_COMPUTE_ASYNC = "tm_tpu.compute_async"  # async-read submission (caller-side half only)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -95,6 +96,7 @@ SPAN_NAMES = (
     SPAN_EXPORT,
     SPAN_LANES,
     SPAN_QUARANTINE,
+    SPAN_COMPUTE_ASYNC,
 )
 
 

@@ -1,0 +1,355 @@
+"""Fully asynchronous read path: the pipeline behind ``compute_async()``.
+
+Updates are zero-collective (``reduce="deferred"``, docs/SHARDING.md) and
+compiles are stall-free (the compile-ahead worker, ops/compile_cache.py), but
+a blocking ``compute()``/``sync()`` still serialises the step loop on the
+fused reduce plus the device→host transfer — the exact overlap failure the
+pjit/TPUv4 dispatch-ahead discipline exists to avoid (PAPERS.md). This module
+closes that last hot-path stall:
+
+- **``MetricFuture``** — what ``compute_async()``/``sync_async()`` return: a
+  thread-safe future resolving to exactly the value the matching blocking
+  call would have produced from the state at submission time (or raising
+  exactly the error it would have raised — ``on_sync_failure`` policies,
+  :class:`~torchmetrics_tpu.quarantine.DegradedValue` degraded serving and
+  all). The resolved value is *ready*: ``block_until_ready`` already ran on
+  the worker, so ``float(fut.result())`` costs a host memcpy, never a device
+  round-trip.
+
+- **``ReadPipeline``** — one daemon worker thread + bounded queue running the
+  blocking tail of every read: wait-for-device (the fused reduce was already
+  *dispatched* on the caller thread — JAX async dispatch enqueues it without
+  waiting), the bounded multi-host gather when one is due, the host finalize,
+  and the D2H materialisation. This is the read-side sibling of the compile
+  worker (ops/compile_cache.py): background work layered over a correct
+  blocking path, never able to wedge interpreter exit (daemon thread), with
+  a full queue degrading to an *inline* (caller-side, blocking) read rather
+  than dropping the job — a read produces a value someone is waiting on, so
+  unlike a compile it can never be discarded.
+
+Consistency (the double-buffer): the caller-side half of ``compute_async``
+snapshots the live state by *reference* — jax arrays are immutable, so the
+snapshot is free — and marks the state escaped, which makes the executor's
+next donating dispatch copy-before-donate (ops/executor.py ``need_copy``).
+The step loop's next ``update()`` therefore writes a fresh buffer while the
+in-flight read drains the old one; no second copy path exists (the same
+``_state_escaped`` seam the recovery snapshot and ``LaneStateMirror`` already
+rely on). Worker-side evaluation runs against a cached detached clone of the
+owner, because ``functional_compute`` swaps live ``_state`` during the call —
+the same live-object-off-thread race the compile worker learned to avoid.
+
+The blocking-host-sync lint (tools/lint_blocking_host_sync.py) covers this
+module: ``block_until_ready``/``np.asarray`` may land ONLY in the worker-side
+functions allowlisted there (``materialize``, ``fetch_host``) — the pipeline
+worker is the one sanctioned place a read blocks.
+
+See docs/ASYNC.md for the full API and staleness contract.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+__all__ = [
+    "MetricFuture",
+    "ReadPipeline",
+    "get_pipeline",
+    "drain_pipeline",
+    "pending_reads",
+]
+
+#: bounded depth of the read queue; a full queue degrades the submitting call
+#: to an inline (blocking) read instead of stalling or dropping
+QUEUE_MAXSIZE_ENV = "TORCHMETRICS_TPU_READ_QUEUE"
+DEFAULT_QUEUE_MAXSIZE = 256
+
+
+class MetricFuture:
+    """Handle to one in-flight asynchronous read.
+
+    Resolves to exactly what the matching blocking call would have returned
+    for the state at submission time — including a
+    :class:`~torchmetrics_tpu.quarantine.DegradedValue` under degraded-read
+    policies — or raises exactly the error the blocking call would have
+    raised (``result()`` re-raises it; ``exception()`` returns it).
+    """
+
+    def __init__(self, owner: str = "", submitted_count: Optional[int] = None) -> None:
+        self.owner = owner
+        #: the owner's committed update count at submission — the value this
+        #: future resolves to reflects exactly this many updates
+        self.submitted_count = submitted_count
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: list = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- consumers
+    def done(self) -> bool:
+        """True once the read resolved (value or error) — never blocks."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until resolved (or ``timeout`` seconds); True when done."""
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The read's value; blocks until resolved. Raises the read's error
+        if it failed, or ``TimeoutError`` when ``timeout`` expires first."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"asynchronous read of {self.owner or 'metric'} did not resolve within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def exception(self, timeout: Optional[float] = None) -> Optional[BaseException]:
+        """The error the read failed with (None on success); blocks like
+        :meth:`result`."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"asynchronous read of {self.owner or 'metric'} did not resolve within {timeout}s"
+            )
+        return self._error
+
+    @property
+    def degraded(self) -> bool:
+        """True when the resolved value is a
+        :class:`~torchmetrics_tpu.quarantine.DegradedValue` (requires the
+        future to be done; False while pending)."""
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        return self.done() and self._error is None and isinstance(self._value, DegradedValue)
+
+    def add_done_callback(self, fn: Callable[["MetricFuture"], None]) -> None:
+        """Run ``fn(future)`` when the read resolves (immediately if it
+        already has). Callbacks run on the pipeline worker thread; exceptions
+        out of them are swallowed (a monitoring hook must not kill reads)."""
+        run_now = False
+        with self._lock:
+            if self._event.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            try:
+                fn(self)
+            except Exception as err:
+                rank_zero_debug(f"MetricFuture done-callback failed: {type(err).__name__}: {err}")
+
+    # -------------------------------------------------------------- producer
+    def _finish(self, value: Any, error: Optional[BaseException]) -> None:
+        with self._lock:
+            self._value = value
+            self._error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception as err:
+                rank_zero_debug(f"MetricFuture done-callback failed: {type(err).__name__}: {err}")
+
+    def __repr__(self) -> str:
+        state = "pending"
+        if self.done():
+            state = "error" if self._error is not None else ("degraded" if self.degraded else "done")
+        return f"MetricFuture(owner={self.owner!r}, {state})"
+
+
+def resolved_future(value: Any, owner: str = "", submitted_count: Optional[int] = None) -> MetricFuture:
+    """An already-done future (the inline-read degradation path)."""
+    fut = MetricFuture(owner=owner, submitted_count=submitted_count)
+    fut._finish(value, None)
+    return fut
+
+
+# ------------------------------------------------------- worker-side blocking
+
+def materialize(value: Any) -> Any:
+    """WORKER-SIDE ONLY: wait until every array in ``value`` is ready.
+
+    The sanctioned blocking point of the read pipeline (allowlisted in
+    tools/lint_blocking_host_sync.py): after this, converting any leaf to
+    host (``float``, ``np.asarray``) is a memcpy, not a device round-trip.
+    Returns ``value`` unchanged (jax arrays stay jax arrays — ready ones)."""
+    try:
+        return jax.block_until_ready(value)
+    except (TypeError, ValueError):
+        # pytrees carrying non-blockable leaves (None, python scalars, host
+        # objects): block leaf-wise, skipping anything without device buffers
+        def _ready_leaf(x: Any) -> Any:
+            if hasattr(x, "block_until_ready"):
+                x.block_until_ready()
+            return x
+
+        return jax.tree_util.tree_map(_ready_leaf, value)
+
+
+def fetch_host(value: Any) -> np.ndarray:
+    """WORKER-SIDE ONLY: one array's device→host fetch (allowlisted). The
+    laned health scan feeds through here so lanes.py itself stays clean of
+    worker-side blocking calls."""
+    return np.asarray(value)
+
+
+# ---------------------------------------------------------------- the worker
+
+class ReadPipeline:
+    """One daemon thread + bounded queue draining asynchronous reads.
+
+    ``submit`` is non-blocking: a full queue runs the job INLINE on the
+    calling thread (counted — the caller momentarily pays blocking-read cost,
+    the documented backpressure mode) because a read, unlike a background
+    compile, produces a value its future's holder is waiting on. Jobs run in
+    submission order on a single worker, so per-metric read clones are used
+    serially by construction."""
+
+    def __init__(self, maxsize: Optional[int] = None) -> None:
+        if maxsize is None:
+            try:
+                maxsize = int(os.environ.get(QUEUE_MAXSIZE_ENV, "") or DEFAULT_QUEUE_MAXSIZE)
+            except ValueError:
+                maxsize = DEFAULT_QUEUE_MAXSIZE
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, maxsize))
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {
+            "submitted": 0,
+            "completed": 0,
+            "errors": 0,
+            "degraded": 0,
+            "inline": 0,
+        }
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="tm_tpu_read_pipeline", daemon=True
+                )
+                self._thread.start()
+
+    def _execute(self, job: Callable[[], Any], fut: MetricFuture) -> None:
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        try:
+            value = job()
+        except BaseException as err:  # the future carries it to result()
+            self.stats["errors"] += 1
+            obs.counter_inc("reads.async_errors")
+            rank_zero_debug(f"async read of {fut.owner or 'metric'} failed: {type(err).__name__}: {err}")
+            fut._finish(None, err)
+            return
+        self.stats["completed"] += 1
+        if isinstance(value, DegradedValue):
+            self.stats["degraded"] += 1
+            obs.counter_inc("reads.async_degraded")
+        obs.counter_inc("reads.async_completed")
+        fut._finish(value, None)
+
+    def _run(self) -> None:
+        from torchmetrics_tpu import obs
+
+        while True:
+            job, fut = self._q.get()
+            try:
+                self._execute(job, fut)
+            finally:
+                self._q.task_done()
+                obs.gauge_set("reads.pending", self._q.unfinished_tasks)
+
+    def submit(self, job: Callable[[], Any], owner: str = "", submitted_count: Optional[int] = None) -> MetricFuture:
+        """Enqueue one read; returns its future immediately. Never blocks on
+        the queue: when full, the job runs inline (blocking THIS call, which
+        is the documented backpressure degradation, not a stall bug)."""
+        from torchmetrics_tpu import obs
+
+        fut = MetricFuture(owner=owner, submitted_count=submitted_count)
+        self.stats["submitted"] += 1
+        obs.counter_inc("reads.async_submitted")
+        try:
+            self._q.put_nowait((job, fut))
+        except queue.Full:
+            self.stats["inline"] += 1
+            obs.counter_inc("reads.inline_fallback")
+            self._execute(job, fut)
+            return fut
+        obs.gauge_set("reads.pending", self._q.unfinished_tasks)
+        self._ensure_thread()
+        return fut
+
+    def pending(self) -> int:
+        return self._q.unfinished_tasks
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every submitted read resolved; True when the queue
+        drained within ``timeout`` (tests, benchmarks, shutdown flushes)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+
+_PIPELINE: Optional[ReadPipeline] = None
+_PIPELINE_LOCK = threading.Lock()
+
+
+def get_pipeline() -> ReadPipeline:
+    """The process-wide read pipeline (created on first use)."""
+    global _PIPELINE
+    with _PIPELINE_LOCK:
+        if _PIPELINE is None:
+            _PIPELINE = ReadPipeline()
+        return _PIPELINE
+
+
+def drain_pipeline(timeout: float = 60.0) -> bool:
+    """Wait for all in-flight asynchronous reads (no-op when none started)."""
+    with _PIPELINE_LOCK:
+        pipeline = _PIPELINE
+    return True if pipeline is None else pipeline.drain(timeout)
+
+
+def pending_reads() -> int:
+    """Reads submitted but not yet resolved, process-wide."""
+    with _PIPELINE_LOCK:
+        pipeline = _PIPELINE
+    return 0 if pipeline is None else pipeline.pending()
+
+
+# -------------------------------------------------- laned read serialisation
+
+#: one RLock per LaneGuard (shared across a LanedCollection's members exactly
+#: the way the guard itself is): the pipeline worker's scan-and-attribute
+#: critical section and the router's guard/state mutations serialise on it.
+#: Held only around HOST-side bookkeeping — never around device work or D2H —
+#: so the step loop can wait microseconds on it, not milliseconds. Keyed
+#: weakly so guards stay picklable (a lock never rides a checkpoint).
+_GUARD_LOCKS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_GUARD_LOCKS_LOCK = threading.Lock()
+
+
+def guard_lock(guard: Any) -> threading.RLock:
+    """The (lazily created) RLock serialising reads/mutations for ``guard``."""
+    with _GUARD_LOCKS_LOCK:
+        lock = _GUARD_LOCKS.get(guard)
+        if lock is None:
+            lock = threading.RLock()
+            _GUARD_LOCKS[guard] = lock
+        return lock

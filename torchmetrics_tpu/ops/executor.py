@@ -2368,6 +2368,31 @@ class DeferredCollectionStep:
         with obs.span(obs.SPAN_REDUCE):
             return self._unpack(fn(states))
 
+    def reduce_async(self, states):
+        """Non-blocking :meth:`reduce` (docs/ASYNC.md): the fused read-point
+        executable is *dispatched* here — JAX async dispatch enqueues the
+        rendezvous + compute without waiting — and a
+        :class:`~torchmetrics_tpu.ops.async_read.MetricFuture` resolves to
+        the unpacked values once the device work drains, with the ready-wait
+        and the host-side unpack on the pipeline worker. The epoch loop can
+        keep feeding :meth:`local_step`/:meth:`local_epoch` immediately;
+        pass a non-donated ``states`` alias (the reduce executable does not
+        donate, so the same states remain live for the next step)."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.ops.async_read import get_pipeline, materialize
+        from torchmetrics_tpu.parallel.sync import shard_map_compat
+
+        def build():
+            return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
+
+        fn = self._get("reduce", build)
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix="DeferredCollectionStep"):
+            packed = fn(states)  # enqueued on the device stream, not awaited
+        return get_pipeline().submit(
+            lambda: self._unpack(materialize(packed)), owner="DeferredCollectionStep.reduce"
+        )
+
 
 def make_deferred_collection_step(
     collection: Any,

@@ -40,6 +40,7 @@ and class attributes) — use from a single test thread.
 """
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, Generator, Optional, Tuple
@@ -282,6 +283,33 @@ def fail_dispatch(
 
 
 # --------------------------------------------------------------------- sync
+
+@contextmanager
+def pause_async_reads(max_s: float = 30.0) -> Generator[threading.Event, None, None]:
+    """Park the async read pipeline's worker (ops/async_read.py) on a barrier
+    job, so every read submitted INSIDE the context stays in flight until the
+    context exits (or ``max_s`` elapses — a safety valve so a crashed test
+    cannot wedge the worker for the rest of the suite). Yields the release
+    event; set it early to unpark before the context ends.
+
+    Composes with the other managers: ``break_sync`` + ``pause_async_reads``
+    lets a test assert policy handling of a failure that is *guaranteed* to
+    happen while the future is still pending; a preemption flush with a read
+    in flight is ``pause_async_reads`` + ``install_preemption_handler``.
+    """
+    from torchmetrics_tpu.ops.async_read import get_pipeline
+
+    release = threading.Event()
+
+    def barrier() -> None:
+        release.wait(max_s)
+
+    get_pipeline().submit(barrier, owner="faults.pause_async_reads")
+    try:
+        yield release
+    finally:
+        release.set()
+
 
 @contextmanager
 def hang_sync(seconds: float = 30.0) -> Generator[None, None, None]:
