@@ -803,6 +803,60 @@ def bench_config2():
     per_def_blocking = _stable_min(_deferred_blocking_read, repeats=3)
     per_def_async = _stable_min(_deferred_async_read, repeats=3)
     per_def_async_e2e = _async_box["def_e2e"]
+
+    # elastic-topology rows (ISSUE 10): (a) shard-shadow steady-path overhead
+    # — the deferred epoch loop with the bounded-lag host shadow attached
+    # (one async fold DISPATCH per 30-step chunk; the ready-wait + D2H drain
+    # on the read-pipeline worker, parked here so this 1-vCPU core is not
+    # timesharing the drain into the timed loop) vs the bare loop; gated via
+    # shard_shadow_overhead_max_pct in BASELINE.json (real-hardware target
+    # <1%; on this 1-vCPU virtual mesh the fold dispatch pays the serial
+    # 8-partition enqueue floor on the step loop's own core — see the
+    # baseline note). (b) elastic restore latency: an 8-shard mid-epoch
+    # snapshot restored into a 4-device world (testing/faults.shrink_world)
+    # — integrity checks + the reshard-seam fold to canonical, in ms
+    # (recorded, ungated: a rare-event latency).
+    from torchmetrics_tpu.io import restore_state as _restore_state
+    from torchmetrics_tpu.testing.faults import pause_async_reads as _pause_reads, shrink_world as _shrink_world
+
+    shadow_step = make_deferred_collection_step(coll, mesh, axis_name="data")
+    shadow_step.attach_shadow(every_n_steps=EPOCH_STEPS, on_shard_loss="degraded")
+    st_sh = shadow_step.local_epoch(shadow_step.init_states(), logits_e, target_e)  # compile
+    jax.block_until_ready(st_sh)
+    _drain_reads(60.0)
+
+    def _epoch_shadow_block():
+        with _pause_reads(max_s=120.0):
+            st = shadow_step.init_states()
+            t0 = time.perf_counter()
+            st = shadow_step.local_epoch(st, logits_e, target_e)
+            jax.block_until_ready(st)
+            dt = (time.perf_counter() - t0) / EPOCH_STEPS
+        _drain_reads(60.0)
+        return dt
+
+    # both sides of the overhead ratio re-measured back-to-back (the
+    # telemetry-row pattern): an epoch number captured minutes earlier on
+    # this 1-vCPU VM is not a valid denominator for a sub-1% comparison
+    per_epoch_plain = _stable_min(_epoch_loop, repeats=3)
+    per_epoch_shadow = _stable_min(_epoch_shadow_block, repeats=3)
+    shard_shadow_overhead_pct = 100.0 * (per_epoch_shadow - per_epoch_plain) / per_epoch_plain
+
+    ckpt_dir_el = _tempfile.mkdtemp(prefix="tm_tpu_bench_elastic_")
+    try:
+        path_el = os.path.join(ckpt_dir_el, "epoch.ckpt")
+        st_el = deferred.local_step(deferred.init_states(), logits, target)
+        _save_state(coll, path_el, states=st_el, sharded=True)
+        with _shrink_world(4):
+            _restore_state(path_el, coll, topology="elastic")  # warm (compile the fold)
+            elastic_restore_ms = 1000.0 * _stable_min(
+                lambda: _time_host(
+                    lambda: _restore_state(path_el, coll, topology="elastic"), steps=5, warmup=1
+                ),
+                repeats=2,
+            )
+    finally:
+        _shutil.rmtree(ckpt_dir_el, ignore_errors=True)
     # the acceptance ratio uses the parked row: the step loop's own per-step
     # cost with reads draining elsewhere (on this 1-core VM the un-parked
     # submit row times-shares with the worker and measures contention)
@@ -904,6 +958,14 @@ def bench_config2():
         "value_read_deferred_blocking": round(1.0 / per_def_blocking, 2),
         "value_read_deferred_async": round(1.0 / per_def_async, 2),
         "value_read_deferred_async_e2e": round(1.0 / per_def_async_e2e, 2),
+        # elastic-topology rows (ISSUE 10; real-hardware acceptance <1%,
+        # VM floor + evidence in the BASELINE.json _elastic_note): the
+        # bounded-lag host shadow costs the step loop one async fold
+        # dispatch per chunk; elastic_restore_ms is the 8-shard ->
+        # 4-device fold-and-reinstall latency (ungated)
+        "shard_shadow_overhead_pct": round(shard_shadow_overhead_pct, 2),
+        "shadow_epoch_us_per_step": round(per_epoch_shadow * 1e6, 1),
+        "elastic_restore_ms": round(elastic_restore_ms, 2),
     }
 
 

@@ -1,0 +1,780 @@
+"""Elastic topology resilience suite (ISSUE 10).
+
+Metric state must survive a *changed world*: checkpoints saved on d devices
+restore onto d' (strict refusal vs elastic fold through the one audited
+``parallel/reshard.py`` seam), laned directories remap into a different
+capacity, and a deferred-mode shard that dies is covered by the bounded-lag
+host shadow (``on_shard_loss`` policies). The acceptance property throughout:
+``compute()`` after save-on-d / restore-on-d' / continue is bit-exact
+(allclose) vs the never-interrupted accumulation over the same batches, for
+all five reduction families, in step and deferred execution, plain and laned.
+
+Runs on the 8-fake-device CPU mesh from conftest.py; world-size changes are
+simulated via ``testing/faults.shrink_world``/``grow_world`` (the checkpoint
+layer's world-topology probe + a matching sub-mesh).
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu import Metric, MetricCollection  # noqa: E402
+from torchmetrics_tpu import obs  # noqa: E402
+from torchmetrics_tpu.io import restore_state, save_state  # noqa: E402
+from torchmetrics_tpu.io.checkpoint import load_manifest  # noqa: E402
+from torchmetrics_tpu.lanes import LanedMetric  # noqa: E402
+from torchmetrics_tpu.ops.async_read import drain_pipeline  # noqa: E402
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+from torchmetrics_tpu.parallel.reshard import (  # noqa: E402
+    ShardLayout,
+    ShardShadow,
+    expand_canonical,
+    fold_canonical,
+    layout_of,
+    merge_folded,
+    reshard_states,
+)
+from torchmetrics_tpu.quarantine import DegradedValue  # noqa: E402
+from torchmetrics_tpu.testing import faults  # noqa: E402
+from torchmetrics_tpu.utils.exceptions import (  # noqa: E402
+    ShardLossError,
+    TopologyMismatchError,
+)
+
+WORLDS = (1, 2, 4, 8)
+BATCH = 8  # divisible by every world size, so shard slices stay equal
+
+
+def _mesh(d):
+    return Mesh(np.array(jax.devices()[:d]), ("batch",))
+
+
+def _put(mesh, arr, spec=P("batch")):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+# ------------------------------------------------------- five state families
+class _SumLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+class _MeanRed(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def update(self, x):
+        self.avg = self.avg + x.mean()
+
+    def compute(self):
+        return self.avg
+
+
+class _MaxLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("m", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.m = jnp.maximum(self.m, x.max())
+
+    def compute(self):
+        return self.m
+
+
+class _MinLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("m", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+
+    def update(self, x):
+        self.m = jnp.minimum(self.m, x.min())
+
+    def compute(self):
+        return self.m
+
+
+class _CatSum(Metric):
+    """Growing 'cat' array state; compute is order-invariant (sum) so the
+    shard-order difference between topologies cannot hide errors."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("vals", jnp.zeros((0,), jnp.float32), dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals = jnp.concatenate([self.vals, x.reshape(-1)])
+
+    def compute(self):
+        return self.vals.sum()
+
+
+FAMILIES = [
+    ("sum", _SumLike),
+    ("mean", _MeanRed),
+    ("max", _MaxLike),
+    ("min", _MinLike),
+    ("cat", _CatSum),
+]
+
+#: families whose stacked layout re-splits IN the stack (cat carries a baseline)
+IN_STACK = [(f, c) for f, c in FAMILIES if f != "cat"]
+
+
+def _batches(n, seed=0, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(batch).astype(np.float32)) for _ in range(n)]
+
+
+def _eager_value(cls, batches):
+    m = cls(executor=False)
+    for x in batches:
+        m.update(x)
+    return np.asarray(m.compute())
+
+
+# ---------------------------------------------------------------------------
+# the reshard seam
+# ---------------------------------------------------------------------------
+
+
+class TestReshardSeam:
+    @pytest.mark.parametrize("family,cls", IN_STACK, ids=[f for f, _ in IN_STACK])
+    @pytest.mark.parametrize("n,m", [(8, 4), (8, 1), (2, 8), (4, 4), (1, 8)])
+    def test_fold_expand_refolds_exact(self, family, cls, n, m):
+        """reshard N->M preserves the fold for every in-stack family."""
+        metric = cls(executor=False)
+        rng = np.random.RandomState(1)
+        stacked = {
+            k: jnp.asarray(rng.randn(n, *np.shape(v)).astype(np.float32))
+            for k, v in metric.init_state().items()
+        }
+        before = fold_canonical(stacked, metric._reductions)
+        resharded = reshard_states(
+            stacked, ShardLayout(n), ShardLayout(m), metric._reductions
+        )
+        assert layout_of(resharded).num_shards == m
+        after = fold_canonical(resharded, metric._reductions)
+        for k in before:
+            np.testing.assert_allclose(np.asarray(after[k]), np.asarray(before[k]), rtol=1e-6)
+
+    def test_cat_refuses_in_stack_expand(self):
+        metric = _CatSum(executor=False)
+        stacked = {"vals": jnp.ones((4, 3), jnp.float32)}
+        with pytest.raises(TopologyMismatchError):
+            expand_canonical(fold_canonical(stacked, metric._reductions), metric._reductions, 2)
+
+    def test_merge_folded_segments(self):
+        """Segment combination per family: sum/mean add (the fold is linear),
+        max/min are idempotent, cat concatenates."""
+        reds = {"s": "sum", "a": "mean", "x": "max", "n": "min", "c": "cat"}
+        a = {"s": jnp.asarray(2.0), "a": jnp.asarray(1.5), "x": jnp.asarray(3.0),
+             "n": jnp.asarray(-1.0), "c": jnp.asarray([1.0, 2.0])}
+        b = {"s": jnp.asarray(1.0), "a": jnp.asarray(0.5), "x": jnp.asarray(2.0),
+             "n": jnp.asarray(-4.0), "c": jnp.asarray([3.0])}
+        got = merge_folded(a, b, reds)
+        assert float(got["s"]) == 3.0 and float(got["a"]) == 2.0
+        assert float(got["x"]) == 3.0 and float(got["n"]) == -4.0
+        np.testing.assert_array_equal(np.asarray(got["c"]), [1.0, 2.0, 3.0])
+
+    def test_same_layout_is_noop(self):
+        metric = _SumLike(executor=False)
+        stacked = {"total": jnp.arange(4.0), "_sharded_shards": 4}
+        out = reshard_states(stacked, ShardLayout(4), ShardLayout(4), metric._reductions)
+        np.testing.assert_array_equal(np.asarray(out["total"]), np.arange(4.0))
+        assert "_sharded_shards" not in out
+
+    def test_layout_mismatch_raises(self):
+        metric = _SumLike(executor=False)
+        with pytest.raises(TopologyMismatchError):
+            reshard_states({"total": jnp.arange(4.0)}, ShardLayout(8), ShardLayout(2), metric._reductions)
+
+    def test_metric_and_collection_surfaces(self):
+        m = _SumLike(executor=False)
+        out = m.reshard_state({"total": jnp.arange(8.0)}, 2)
+        assert np.asarray(out["total"]).shape == (2,)
+        coll = MetricCollection({"s": _SumLike(executor=False)}, compute_groups=False)
+        out = coll.reshard_states({"s": {"total": jnp.arange(8.0)}}, 4)
+        assert np.asarray(out["s"]["total"]).shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# cross-topology restore matrix
+# ---------------------------------------------------------------------------
+
+
+class TestCrossTopologyStep:
+    """Step-mode (plain OO) metrics: state is replicated, so every (d, d')
+    pair must restore cleanly under BOTH policies — the matrix here asserts
+    no false topology trips — and resume bit-exact."""
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f for f, _ in FAMILIES])
+    def test_matrix_save_d_restore_dprime(self, tmp_path, family, cls):
+        batches = _batches(6, seed=11)
+        for d in WORLDS:
+            for d2 in WORLDS:
+                path = str(tmp_path / f"{family}-{d}-{d2}.ckpt")
+                m = cls(executor=False)
+                with faults.shrink_world(d):
+                    for x in batches[:3]:
+                        m.update(x)
+                    save_state(m, path)
+                assert load_manifest(path)["topology"]["device_count"] == d
+                m2 = cls(executor=False)
+                with faults.shrink_world(d2):
+                    restore_state(path, m2)  # strict: unsharded never mismatches
+                    m3 = cls(executor=False)
+                    restore_state(path, m3, topology="elastic")
+                for x in batches[3:]:
+                    m2.update(x)
+                np.testing.assert_allclose(
+                    np.asarray(m2.compute()), _eager_value(cls, batches), rtol=1e-5
+                )
+
+
+class TestCrossTopologyDeferred:
+    """Deferred-mode external sharded states: save on a d-shard mesh, restore
+    elastically onto d', continue, read — bit-exact vs the uninterrupted
+    accumulation for all five families over the full {1,2,4,8}^2 matrix."""
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f for f, _ in FAMILIES])
+    def test_matrix_save_d_restore_dprime(self, tmp_path, family, cls):
+        batches = _batches(6, seed=23)
+        reference = _eager_value(cls, batches)
+        coll = MetricCollection({"m": cls(executor=False)}, compute_groups=False)
+        meshes = {d: _mesh(d) for d in WORLDS}
+        steps = {
+            d: make_deferred_collection_step(coll, meshes[d], axis_name="batch")
+            for d in WORLDS
+        }
+        for d in WORLDS:
+            for d2 in WORLDS:
+                step_a, step_b = steps[d], steps[d2]
+                st = step_a.init_states()
+                for x in batches[:3]:
+                    st = step_a.local_step(st, _put(meshes[d], x))
+                path = str(tmp_path / f"{family}-{d}-{d2}.ckpt")
+                coll2 = MetricCollection({"m": cls(executor=False)}, compute_groups=False)
+                with faults.shrink_world(d):
+                    save_state(coll, path, states=st, sharded=True)
+                manifest = load_manifest(path)
+                assert manifest["topology"] == {
+                    "topology_version": 1, "device_count": d, "process_count": 1,
+                    "mesh_shape": None, "sharded": True, "num_shards": d,
+                    "lane_capacity": None,
+                }
+                with faults.shrink_world(d2):
+                    if d != d2:
+                        strict_target = MetricCollection(
+                            {"m": cls(executor=False)}, compute_groups=False
+                        )
+                        with pytest.raises(TopologyMismatchError):
+                            restore_state(path, strict_target)
+                    info = restore_state(path, coll2, topology="elastic")
+                    assert info["topology_action"] == ("fold" if d != d2 else "match")
+                # the folded (or still-stacked, on the diagonal) restore feeds
+                # the new mesh through the step's reshard-seam reinstall
+                st2 = step_b.restore_states(coll2.state())
+                for x in batches[3:]:
+                    st2 = step_b.local_step(st2, _put(meshes[d2], x))
+                vals = step_b.reduce(st2)
+                np.testing.assert_allclose(
+                    np.asarray(vals["m"]), reference, rtol=1e-5,
+                    err_msg=f"{family}: save on {d}, restore on {d2}",
+                )
+
+
+# ---------------------------------------------------------------------------
+# rotation + back-compat satellites
+# ---------------------------------------------------------------------------
+
+
+class TestRotationTopologySkip:
+    def test_mismatched_newest_is_skipped_not_fatal(self, tmp_path):
+        """A rotating store whose NEWEST snapshot was saved on a different
+        world: strict restore skips it with a typed TopologyMismatchError
+        breadcrumb (like a torn file) and installs the next older matching
+        one — the scan never aborts."""
+        store = str(tmp_path / "store")
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step8 = make_deferred_collection_step(coll, _mesh(8), axis_name="batch")
+        step2 = make_deferred_collection_step(coll, _mesh(2), axis_name="batch")
+        xs = _batches(2, seed=5)
+        st8 = step8.local_step(step8.init_states(), _put(_mesh(8), xs[0]))
+        with faults.shrink_world(8):
+            save_state(coll, store, states=st8, keep=3, sharded=True)  # older, matches
+        st2 = step2.local_step(step2.init_states(), _put(_mesh(2), xs[1]))
+        with faults.shrink_world(2):
+            save_state(coll, store, states=st2, keep=3, sharded=True)  # newest, mismatched
+        skipped = []
+        coll2 = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        with faults.shrink_world(8):
+            info = restore_state(store, coll2, on_fallback=lambda p, e: skipped.append(e))
+        assert info["fallbacks_skipped"] == 1
+        assert len(skipped) == 1 and isinstance(skipped[0], TopologyMismatchError)
+        # the restored (older) snapshot holds segment A only
+        np.testing.assert_allclose(
+            np.asarray(coll2.compute()["m"]), float(np.asarray(xs[0]).sum()), rtol=1e-6
+        )
+
+    def test_elastic_restores_the_newest_instead(self, tmp_path):
+        store = str(tmp_path / "store")
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step2 = make_deferred_collection_step(coll, _mesh(2), axis_name="batch")
+        xs = _batches(1, seed=6)
+        st2 = step2.local_step(step2.init_states(), _put(_mesh(2), xs[0]))
+        with faults.shrink_world(2):
+            save_state(coll, store, states=st2, keep=3, sharded=True)
+        coll2 = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        with faults.grow_world(8):
+            info = restore_state(store, coll2, topology="elastic")
+        assert info["topology_action"] == "fold" and info["fallbacks_skipped"] == 0
+        np.testing.assert_allclose(
+            np.asarray(coll2.compute()["m"]), float(np.asarray(xs[0]).sum()), rtol=1e-6
+        )
+
+
+class TestLegacySnapshotBackCompat:
+    FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures_real", "legacy_snapshot_v1.ckpt")
+
+    def test_pinned_v1_fixture_restores_with_warning(self):
+        """The in-tree pre-topology-block (manifest v1) snapshot must keep
+        restoring across manifest bumps: a logged warning in strict mode,
+        never a CheckpointCorruptionError."""
+        manifest = load_manifest(self.FIXTURE)
+        assert manifest["manifest_version"] == 1 and "topology" not in manifest
+        before = obs.counters_snapshot().get("checkpoint.legacy_topology_reads", 0)
+        m = tm.SumMetric()
+        with pytest.warns(UserWarning, match="predates the topology block"):
+            info = restore_state(self.FIXTURE, m)
+        assert info["topology_action"] == "legacy"
+        assert m.update_count == 2
+        np.testing.assert_allclose(float(m.compute()), 11.0)
+        assert obs.counters_snapshot()["checkpoint.legacy_topology_reads"] == before + 1
+
+    def test_v1_fixture_restores_under_elastic_too(self):
+        m = tm.SumMetric()
+        with pytest.warns(UserWarning, match="predates the topology block"):
+            restore_state(self.FIXTURE, m, topology="elastic")
+        np.testing.assert_allclose(float(m.compute()), 11.0)
+
+    def test_current_writer_emits_topology_block(self, tmp_path):
+        m = tm.SumMetric()
+        m.update(jnp.ones(3))
+        path = str(tmp_path / "new.ckpt")
+        save_state(m, path)
+        manifest = load_manifest(path)
+        assert manifest["manifest_version"] == 2
+        assert manifest["topology"]["sharded"] is False
+
+    def test_invalid_topology_policy_rejected(self, tmp_path):
+        m = tm.SumMetric()
+        with pytest.raises(ValueError, match="topology must be one of"):
+            restore_state(str(tmp_path / "x.ckpt"), m, topology="bogus")
+
+
+# ---------------------------------------------------------------------------
+# shard loss: the bounded-lag shadow + on_shard_loss policies
+# ---------------------------------------------------------------------------
+
+
+def _make_step(coll, d=8, **kw):
+    return make_deferred_collection_step(coll, _mesh(d), axis_name="batch", **kw)
+
+
+class TestShardLoss:
+    def _run(self, step, mesh, batches, st=None):
+        st = step.init_states() if st is None else st
+        for x in batches:
+            st = step.local_step(st, _put(mesh, x))
+        return st
+
+    def test_raise_policy_propagates(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1, on_shard_loss="raise")
+        st = self._run(step, _mesh(8), _batches(2, seed=31))
+        drain_pipeline(30.0)
+        with faults.drop_shard(step, shard=3):
+            with pytest.raises(ShardLossError) as err:
+                step.reduce(st)
+        assert err.value.shard == 3
+
+    def test_degraded_serves_shadow_with_staleness(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        shadow = step.attach_shadow(every_n_steps=2, on_shard_loss="degraded")
+        batches = _batches(5, seed=32)
+        st = self._run(step, _mesh(8), batches)
+        drain_pipeline(30.0)
+        behind = shadow.updates_behind(step.steps)
+        assert behind is not None and behind < 2  # the documented bounded lag
+        with faults.drop_shard(step, shard=0):
+            got = step.reduce(st)
+        assert isinstance(got, DegradedValue)
+        assert got.updates_behind == behind
+        assert got.age_updates == step.steps - behind
+        # the shadow value is the fold of the refreshed prefix
+        np.testing.assert_allclose(
+            np.asarray(got.value["m"]),
+            _eager_value(_SumLike, batches[: got.age_updates]),
+            rtol=1e-5,
+        )
+
+    def test_restore_policy_continues_run_exact(self):
+        """drop_shard under on_shard_loss='restore' with a per-step shadow:
+        the step re-dispatches on the reinstalled shadow and the finished run
+        is EXACT (nothing was behind) — the acceptance chaos property."""
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1, on_shard_loss="restore")
+        mesh = _mesh(8)
+        batches = _batches(6, seed=33)
+        st = self._run(step, mesh, batches[:3])
+        drain_pipeline(30.0)
+        with faults.drop_shard(step, shard=1, fail_n=1):
+            st = step.local_step(st, _put(mesh, batches[3]))  # loses + recovers + re-applies
+        for x in batches[4:]:
+            st = step.local_step(st, _put(mesh, x))
+        vals = step.reduce(st)
+        np.testing.assert_allclose(
+            np.asarray(vals["m"]), _eager_value(_SumLike, batches), rtol=1e-5
+        )
+
+    def test_restore_policy_bounded_loss(self):
+        """With a lazier cadence the recovery loses at most every_n-1 steps:
+        the resumed value equals a reference over the refreshed prefix plus
+        everything after the loss."""
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        shadow = step.attach_shadow(every_n_steps=3, on_shard_loss="restore")
+        mesh = _mesh(8)
+        batches = _batches(8, seed=34)
+        st = self._run(step, mesh, batches[:5])
+        drain_pipeline(30.0)
+        snap = shadow.snapshot()
+        assert snap is not None
+        kept_prefix = snap[1]
+        assert 5 - kept_prefix < 3  # bounded lag
+        with faults.drop_shard(step, shard=2, fail_n=1):
+            st = step.local_step(st, _put(mesh, batches[5]))
+        for x in batches[6:]:
+            st = step.local_step(st, _put(mesh, x))
+        vals = step.reduce(st)
+        survived = batches[:kept_prefix] + batches[5:]
+        np.testing.assert_allclose(
+            np.asarray(vals["m"]), _eager_value(_SumLike, survived), rtol=1e-5
+        )
+
+    def test_read_point_restore_hands_back_fresh_states(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1, on_shard_loss="restore")
+        mesh = _mesh(8)
+        batches = _batches(4, seed=35)
+        st = self._run(step, mesh, batches)
+        drain_pipeline(30.0)
+        with faults.drop_shard(step, shard=0, fail_n=1):
+            got = step.reduce(st)
+        assert isinstance(got, DegradedValue) and got.updates_behind == 0
+        fresh = step.take_recovered_states()
+        assert fresh is not None
+        assert step.take_recovered_states() is None  # popped
+        vals = step.reduce(fresh)
+        np.testing.assert_allclose(
+            np.asarray(vals["m"]), _eager_value(_SumLike, batches), rtol=1e-5
+        )
+
+    def test_reduce_async_resolves_policy_future(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1, on_shard_loss="degraded")
+        st = self._run(step, _mesh(8), _batches(3, seed=36))
+        drain_pipeline(30.0)
+        with faults.drop_shard(step, shard=0):
+            fut = step.reduce_async(st)
+        got = fut.result(30.0)
+        assert isinstance(got, DegradedValue) and fut.degraded
+
+    def test_no_shadow_raises_whatever_the_policy(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1000, on_shard_loss="degraded")
+        st = self._run(step, _mesh(8), _batches(1, seed=37))
+        # cadence 1000: first observe() fires at step 1... seed it unfired by
+        # dropping before any refresh could complete
+        step._shadow._shadow = None
+        with faults.drop_shard(step, shard=0):
+            with pytest.raises(ShardLossError):
+                step.reduce(st)
+
+    def test_invalid_policy_rejected(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        with pytest.raises(ValueError, match="on_shard_loss"):
+            step.attach_shadow(on_shard_loss="bogus")
+
+    def test_shadow_overhead_counters(self):
+        before = obs.counters_snapshot().get("shards.shadow_refreshes", 0)
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = _make_step(coll)
+        step.attach_shadow(every_n_steps=1, on_shard_loss="degraded")
+        self._run(step, _mesh(8), _batches(3, seed=38))
+        drain_pipeline(30.0)
+        assert obs.counters_snapshot()["shards.shadow_refreshes"] >= before + 3
+
+
+class TestShardShadowUnit:
+    def test_cadence_and_staleness(self):
+        shadow = ShardShadow(lambda: {"m": {"v": "sum"}}, every_n_steps=4)
+        assert shadow.due(0)  # first observation always refreshes
+        shadow.seed({"m": {"v": np.asarray(1.0)}}, 4)
+        assert not shadow.due(6) and shadow.due(8)
+        assert shadow.updates_behind(7) == 3
+        snap, count = shadow.snapshot()
+        assert count == 4 and float(snap["m"]["v"]) == 1.0
+
+    def test_invalid_cadence(self):
+        with pytest.raises(ValueError):
+            ShardShadow(lambda: {}, every_n_steps=0)
+
+    def test_unrefreshed_shadow_reports_none(self):
+        shadow = ShardShadow(lambda: {}, every_n_steps=2)
+        assert shadow.snapshot() is None and shadow.updates_behind(10) is None
+
+
+# ---------------------------------------------------------------------------
+# composed chaos: kill + torn write + world resize in one scenario
+# ---------------------------------------------------------------------------
+
+
+class TestResizeChaos:
+    def test_kill_torn_write_and_shrink_world(self, tmp_path):
+        """The full disaster: rotating aut.checkpoints mid-epoch, the newest
+        snapshot torn by the crash, and the job rescheduled onto HALF the
+        devices — the restore falls back to the older valid snapshot, folds
+        it elastically into the new world, and the resumed run is exact over
+        the surviving prefix + post-restore batches."""
+        store = str(tmp_path / "store")
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step8 = _make_step(coll, 8)
+        mesh8 = _mesh(8)
+        batches = _batches(6, seed=41)
+        st = step8.init_states()
+        with faults.shrink_world(8):
+            for i, x in enumerate(batches[:4]):
+                st = step8.local_step(st, _put(mesh8, x))
+                save_state(coll, store, states=st, keep=4, sharded=True)
+        snaps = sorted(os.listdir(store))
+        faults.torn_write(os.path.join(store, snaps[-1]), mode="truncate")
+
+        coll2 = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        with faults.shrink_world(4) as mesh4:
+            info = restore_state(store, coll2, topology="elastic")
+            assert info["fallbacks_skipped"] == 1  # the torn newest
+            assert info["topology_action"] == "fold"
+            step4 = _make_step(coll, 4)
+            st4 = step4.restore_states(coll2.state())
+            for x in batches[4:]:
+                st4 = step4.local_step(st4, _put(mesh4, x))
+            vals = step4.reduce(st4)
+        # torn newest lost batch 3 (0-indexed): prefix of 3 steps survived
+        survived = batches[:3] + batches[4:]
+        np.testing.assert_allclose(
+            np.asarray(vals["m"]), _eager_value(_SumLike, survived), rtol=1e-5
+        )
+
+    def test_elastic_restore_counter(self, tmp_path):
+        before = obs.counters_snapshot().get("checkpoint.elastic_restores", 0)
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step2 = _make_step(coll, 2)
+        st = step2.local_step(step2.init_states(), _put(_mesh(2), _batches(1, seed=42)[0]))
+        path = str(tmp_path / "c.ckpt")
+        with faults.shrink_world(2):
+            save_state(coll, path, states=st, sharded=True)
+        coll2 = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        with faults.shrink_world(8):
+            restore_state(path, coll2, topology="elastic")
+        assert obs.counters_snapshot()["checkpoint.elastic_restores"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# laned: capacity remap (deterministic rehousing, evict-with-warning)
+# ---------------------------------------------------------------------------
+
+
+class TestLanedElastic:
+    def _fill(self, laned, sessions, seed=0):
+        rng = np.random.RandomState(seed)
+        rows = {}
+        for sid in sessions:
+            rows[sid] = jnp.asarray(rng.randn(4).astype(np.float32))
+        laned.update_sessions(rows)
+        return rows
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f for f, _ in FAMILIES])
+    def test_remap_grow_preserves_sessions(self, family, cls):
+        laned = LanedMetric(cls(), capacity=8)
+        rows = self._fill(laned, [f"s{i}" for i in range(5)], seed=50)
+        before = {sid: np.asarray(laned.compute_session(sid)) for sid in rows}
+        assert laned.remap_capacity(32) == 32
+        assert laned.capacity == 32
+        for sid, val in before.items():
+            np.testing.assert_allclose(np.asarray(laned.compute_session(sid)), val, rtol=1e-6)
+
+    def test_remap_is_deterministic(self):
+        a = LanedMetric(_SumLike(), capacity=16)
+        b = LanedMetric(_SumLike(), capacity=16)
+        for laned in (a, b):
+            self._fill(laned, [f"s{i}" for i in range(9)], seed=51)
+            laned.remap_capacity(8)  # shrink below... 9 > 8: evicts
+        assert a.sessions == b.sessions
+
+    def test_shrink_below_occupancy_evicts_with_warning(self):
+        laned = LanedMetric(_SumLike(), capacity=16)
+        rows = self._fill(laned, [f"s{i}" for i in range(10)], seed=52)
+        before = {sid: np.asarray(laned.compute_session(sid)) for sid in rows}
+        evictions_before = obs.counters_snapshot().get("lanes.elastic_evictions", 0)
+        with pytest.warns(UserWarning, match="shrinks below occupancy"):
+            laned.remap_capacity(8)
+        assert laned.capacity == 8 and len(laned.sessions) == 8
+        assert obs.counters_snapshot()["lanes.elastic_evictions"] == evictions_before + 2
+        # survivors (lowest old lanes) keep exact values; evictees are gone
+        survivors = sorted(laned.sessions, key=lambda s: laned.sessions[s])
+        for sid in survivors:
+            np.testing.assert_allclose(
+                np.asarray(laned.compute_session(sid)), before[sid], rtol=1e-6
+            )
+        evicted = set(rows) - set(laned.sessions)
+        assert len(evicted) == 2
+        for sid in evicted:
+            with pytest.raises(KeyError):
+                laned.compute_session(sid)
+
+    def test_checkpoint_elastic_restore_remaps_into_instance_capacity(self, tmp_path):
+        """restore_state(topology='elastic') keeps the TARGET's configured
+        capacity and rehouses the snapshot's directory into it; strict keeps
+        the historical adopt-the-snapshot behavior."""
+        laned = LanedMetric(_SumLike(), capacity=16)
+        rows = self._fill(laned, [f"s{i}" for i in range(6)], seed=53)
+        before = {sid: np.asarray(laned.compute_session(sid)) for sid in rows}
+        path = str(tmp_path / "laned.ckpt")
+        save_state(laned, path)
+        assert load_manifest(path)["topology"]["lane_capacity"] == 16
+
+        adopt = LanedMetric(_SumLike(), capacity=8)
+        restore_state(path, adopt)  # strict: adopts snapshot capacity
+        assert adopt.capacity == 16
+
+        elastic = LanedMetric(_SumLike(), capacity=8)
+        info = restore_state(path, elastic, topology="elastic")
+        assert info["topology_action"] == "remap"
+        assert elastic.capacity == 8
+        for sid, val in before.items():
+            np.testing.assert_allclose(
+                np.asarray(elastic.compute_session(sid)), val, rtol=1e-6
+            )
+
+    @pytest.mark.parametrize("family,cls", FAMILIES, ids=[f for f, _ in FAMILIES])
+    def test_kill_restore_resize_continue_per_family(self, tmp_path, family, cls):
+        """The laned half of the acceptance matrix: save mid-run at one
+        capacity, elastic-restore into another, CONTINUE feeding sessions —
+        every session's final compute() bit-exact vs an uninterrupted laned
+        run at the target capacity."""
+        rng = np.random.RandomState(60)
+        sessions = [f"s{i}" for i in range(5)]
+        round1 = {sid: jnp.asarray(rng.randn(4).astype(np.float32)) for sid in sessions}
+        round2 = {sid: jnp.asarray(rng.randn(4).astype(np.float32)) for sid in sessions}
+
+        laned = LanedMetric(cls(), capacity=16)
+        laned.update_sessions(round1)
+        path = str(tmp_path / f"laned-{family}.ckpt")
+        save_state(laned, path)
+
+        resumed = LanedMetric(cls(), capacity=8)
+        restore_state(path, resumed, topology="elastic")
+        assert resumed.capacity == 8
+        resumed.update_sessions(round2)
+
+        reference = LanedMetric(cls(), capacity=8)
+        reference.update_sessions(round1)
+        reference.update_sessions(round2)
+        for sid in sessions:
+            np.testing.assert_allclose(
+                np.asarray(resumed.compute_session(sid)),
+                np.asarray(reference.compute_session(sid)),
+                rtol=1e-6,
+                err_msg=f"{family}: session {sid}",
+            )
+
+    def test_remap_carries_quarantine_and_counts(self):
+        laned = LanedMetric(_SumLike(), capacity=16, on_lane_fault="quarantine")
+        self._fill(laned, [f"s{i}" for i in range(4)], seed=54)
+        with faults.poison_session(laned, "s2", mode="nan", frac=1.0):
+            laned.update_sessions({"s2": jnp.ones(4), "s0": jnp.ones(4)})
+        assert laned.guard.is_quarantined("s2")
+        counts_before = {sid: laned._lane_update_count(laned.sessions[sid]) for sid in laned.sessions}
+        laned.remap_capacity(32)
+        assert laned.guard.is_quarantined("s2")  # record rode the remap
+        for sid, n in counts_before.items():
+            assert laned._lane_update_count(laned.sessions[sid]) == n
+
+    def test_remap_noop_and_bounds(self):
+        laned = LanedMetric(_SumLike(), capacity=8, max_capacity=16)
+        assert laned.remap_capacity(8) == 8
+        with pytest.raises(tm.TorchMetricsUserError):
+            laned.remap_capacity(64)
+
+    def test_laned_collection_remap_keeps_shared_table(self):
+        lc = tm.LanedCollection({"s": _SumLike(), "x": _MaxLike()}, capacity=8)
+        rng = np.random.RandomState(56)
+        rows = {f"s{i}": jnp.asarray(rng.randn(4).astype(np.float32)) for i in range(3)}
+        lc.update_sessions(rows)
+        before = {sid: lc.compute_session(sid) for sid in rows}
+        assert lc.remap_capacity(16) == 16
+        tables = {id(m.__dict__["_table"]) for m in lc._members.values()}
+        assert len(tables) == 1  # members re-linked onto ONE shared table
+        for sid, vals in before.items():
+            after = lc.compute_session(sid)
+            for name in vals:
+                np.testing.assert_allclose(
+                    np.asarray(after[name]), np.asarray(vals[name]), rtol=1e-6
+                )
+
+    def test_eager_lanes_remap(self):
+        """cat/list-state metrics run the eager lane path; remap rehouses the
+        per-lane state list the same way."""
+        laned = LanedMetric(tm.CatMetric(), capacity=8)
+        rng = np.random.RandomState(55)
+        rows = {f"s{i}": jnp.asarray(rng.randn(3).astype(np.float32)) for i in range(4)}
+        laned.update_sessions(rows)
+        before = {sid: np.asarray(laned.compute_session(sid)) for sid in rows}
+        laned.remap_capacity(16)
+        for sid, val in before.items():
+            np.testing.assert_allclose(np.asarray(laned.compute_session(sid)), val, rtol=1e-6)

@@ -117,6 +117,25 @@ def check_bench(
                         " the lane fault-containment machinery is taxing the steady path",
                     )
                 )
+        # shard-shadow gate (ISSUE 10): a config reporting the bounded-lag
+        # host shadow's steady-path overhead column is gated against its
+        # baseline cap (default 1% — the shard-loss-tolerance acceptance
+        # bound); the elastic_restore_ms row rides along ungated (latency of
+        # a rare event, recorded for trajectory only)
+        soverhead = result.get("shard_shadow_overhead_pct")
+        if isinstance(soverhead, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("shard_shadow_overhead_max_pct", 1.0) if isinstance(base, dict) else 1.0
+            if float(soverhead) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"shard_shadow_overhead_pct {soverhead:.2f} exceeds the {cap}% cap —"
+                        " the shard-shadow refresh is taxing the steady deferred step loop",
+                    )
+                )
         # async-read gates (ISSUE 9): a config reporting the per-step read
         # rows is gated on (a) the submit-rate ratio vs the update-only rate
         # (the "never stalls the step loop" acceptance; floor from the

@@ -43,6 +43,7 @@ HOT_PATH_FILES = (
     "ops/compile_cache.py",
     "ops/async_read.py",
     "parallel/sync.py",
+    "parallel/reshard.py",
     "io/checkpoint.py",
     "io/retry.py",
     "obs/tracer.py",
@@ -239,6 +240,36 @@ ALLOWLIST = {
     "quarantine.py::patch_rows": (
         "fault path: folding a lane rollback into the host mirror (np view of"
         " host arrays, no device fetch)"
+    ),
+    # --- elastic topology (docs/DURABILITY.md "Elastic restore"): every sync
+    #     below runs at a RESTORE/RECOVERY point or on the read-pipeline
+    #     WORKER — the steady step loop only ever pays an async dispatch
+    "parallel/reshard.py::layout_of": (
+        "restore surface: inferring the shard layout reads a (host) leaf's"
+        " shape from a decoded checkpoint, never on the step loop"
+    ),
+    "parallel/reshard.py::fold_canonical": (
+        "elastic restore/recovery fold: collapses a checkpoint-decoded (host)"
+        " stack to canonical form at restore points only"
+    ),
+    "parallel/reshard.py::_refresh_job": (
+        "shard-shadow refresh: runs ONLY on the async read pipeline worker"
+        " (the sanctioned blocking place) — D2H of the already-dispatched"
+        " fold output"
+    ),
+    "parallel/reshard.py::seed": (
+        "restore-time shadow seed: host-to-host copy of an already-canonical"
+        " value, no device fetch"
+    ),
+    "ops/executor.py::export_canonical": (
+        "checkpoint surface: folding the live sharded states + carried"
+        " baseline into one canonical host pytree IS the save point (rare,"
+        " never the step loop)"
+    ),
+    "lanes.py::remap_capacity": (
+        "elastic restore / live lane resharding: host gather/scatter of lane"
+        " rows at a restore point (deterministic rehousing), never the"
+        " steady dispatch path"
     ),
 }
 

@@ -671,6 +671,16 @@ class MetricCollection:
         with obs.device_span(obs.SPAN_REDUCE):
             return self.functional_sync(unshard_local_state(states), axis_name)
 
+    def reshard_states(self, states: Dict[str, Dict[str, Any]], to_num_shards: int) -> Dict[str, Dict[str, Any]]:
+        """Re-split every group leader's stacked sharded state onto
+        ``to_num_shards`` via :meth:`Metric.reshard_state` — the collection
+        face of the audited ``parallel/reshard.py`` seam (elastic restore of
+        a mid-epoch deferred checkpoint onto a resized mesh)."""
+        return {
+            leader: self._modules[leader].reshard_state(sub, to_num_shards)
+            for leader, sub in states.items()
+        }
+
     def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
         """Pure update: one leader ``functional_update`` per compute group."""
         out: Dict[str, Dict[str, Any]] = {}

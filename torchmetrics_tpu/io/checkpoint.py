@@ -47,6 +47,7 @@ from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.exceptions import (
     CheckpointCorruptionError,
     StateCorruptionError,
+    TopologyMismatchError,
     TorchMetricsUserError,
 )
 from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
@@ -54,8 +55,17 @@ from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
 #: file magic: 10 bytes, includes the container version
 _MAGIC = b"TMTPUCKv1\n"
 
-#: manifest schema version (bump on incompatible manifest changes)
-MANIFEST_VERSION = 1
+#: manifest schema version (bump on incompatible manifest changes).
+#: v2 added the ``topology`` block (docs/DURABILITY.md "Elastic restore");
+#: v1 snapshots (no block) still read — see the back-compat shim in
+#: ``_check_topology`` and the pinned fixture in tests/fixtures_real/.
+MANIFEST_VERSION = 2
+
+#: valid ``restore_state`` topology policies: ``"strict"`` refuses a snapshot
+#: whose saved shard layout no longer matches this world
+#: (:class:`TopologyMismatchError` — skipped like a torn file in rotating
+#: stores); ``"elastic"`` folds/reshards through ``parallel/reshard.py``
+TOPOLOGY_POLICIES = ("strict", "elastic")
 
 #: rotating-store snapshot filename pattern
 _SNAP_RE = re.compile(r"^snapshot-(\d{8})\.ckpt$")
@@ -71,6 +81,20 @@ _SHARDS_KEY = "_sharded_shards"
 
 def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
+
+
+def _world_topology() -> Dict[str, Any]:
+    """The restoring/saving world's topology descriptor — a module-level seam
+    so the chaos harness (``testing/faults.shrink_world``/``grow_world``) can
+    simulate a preemption rescheduled onto a different slice shape without a
+    real cluster."""
+    import jax
+
+    return {
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
 
 
 def host_copy_tree(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -217,6 +241,26 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
     except Exception as err:  # a broken status probe must not block the save
         rank_zero_debug(f"torchmetrics_tpu checkpoint: lane_status probe failed ({err})")
 
+    world = _world_topology()
+    # topology block (manifest v2, docs/DURABILITY.md "Elastic restore"): the
+    # world shape this snapshot's layout is bound to, so a restore onto a
+    # DIFFERENT slice shape is a decision (strict refuse / elastic fold), not
+    # an accident. num_shards comes from the reserved shard marks; lane
+    # capacity from the lanes block.
+    shard_counts = [
+        int(sub[_SHARDS_KEY])
+        for sub in ([scalars] if not nested else scalars.values())
+        if isinstance(sub, dict) and _SHARDS_KEY in sub
+    ]
+    topology = {
+        "topology_version": 1,
+        "device_count": world["device_count"],
+        "process_count": world["process_count"],
+        "mesh_shape": None,  # reserved for explicit mesh-shape binding
+        "sharded": bool(shard_counts),
+        "num_shards": max(shard_counts) if shard_counts else None,
+        "lane_capacity": (lanes or {}).get("capacity"),
+    }
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "library_version": __version__,
@@ -226,12 +270,13 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
         "class": type(obj).__name__,
         "spec": spec,
         "lanes": lanes,
+        "topology": topology,
         "update_count": update_count,
         "reduce_policy": getattr(obj, "reduce_policy", None),
         "mesh": {
-            "device_count": jax.device_count(),
-            "process_count": jax.process_count(),
-            "process_index": jax.process_index(),
+            "device_count": world["device_count"],
+            "process_count": world["process_count"],
+            "process_index": world["process_index"],
         },
         "scalars": scalars,
         "leaves": leaf_manifest,
@@ -459,8 +504,73 @@ def _decode_state(path: str, manifest: Dict[str, Any], payload: bytes) -> Dict[s
     return _unflatten_export(leaves, manifest.get("scalars") or {}, manifest.get("kind") == "collection")
 
 
+def _check_topology(path: str, manifest: Dict[str, Any], obj: Any, topology: str) -> str:
+    """Compare the snapshot's saved topology block against the restoring
+    world; returns the action taken (``"match"``/``"legacy"``/``"fold"``/
+    ``"remap"``). Under ``topology="strict"`` a shard-layout mismatch raises
+    :class:`TopologyMismatchError` (a rotating-store scan skips it like a
+    torn file and tries the next older snapshot). Lane capacity is NOT a
+    strict gate: a laned restore has always re-registered the snapshot's
+    capacity (docs/LANES.md "Durability"); elastic mode instead REMAPS the
+    directory into the instance's configured capacity."""
+    saved = manifest.get("topology")
+    if saved is None:
+        # pre-topology-block snapshot (manifest v1): restore proceeds — old
+        # checkpoints must keep reading across manifest bumps — but the
+        # missing validation is logged, not silent
+        obs.counter_inc("checkpoint.legacy_topology_reads")
+        rank_zero_warn(
+            f"torchmetrics_tpu checkpoint: {path} predates the topology block"
+            " (manifest v1); restoring without topology validation —"
+            " re-save to bind the snapshot to its world shape"
+        )
+        return "legacy"
+    world = _world_topology()
+    if saved.get("sharded") and saved.get("num_shards") and saved["num_shards"] != world["device_count"]:
+        if topology == "strict":
+            obs.counter_inc("checkpoint.topology_mismatches")
+            obs.breadcrumb(
+                "topology_mismatch",
+                {
+                    "snapshot": os.path.basename(path),
+                    "saved_num_shards": saved["num_shards"],
+                    "device_count": world["device_count"],
+                },
+            )
+            raise TopologyMismatchError(
+                f"{path} holds a {saved['num_shards']}-shard stacked state but this world"
+                f" has {world['device_count']} device(s); restore with topology='elastic'"
+                " to fold to the topology-neutral form, or restore on the saved topology",
+                saved=saved,
+                current=world,
+            )
+        return "fold"
+    lane_cap = saved.get("lane_capacity")
+    if (
+        topology == "elastic"
+        and lane_cap is not None
+        and getattr(obj, "capacity", None) not in (None, lane_cap)
+    ):
+        return "remap"
+    return "match"
+
+
+def _force_fold(obj: Any) -> None:
+    """Collapse any pending sharded install to the canonical (reduced) form
+    NOW — the elastic restore's eager fold (lazy folding would otherwise hide
+    the reshard until the next update/compute)."""
+    fold = getattr(obj, "_fold_pending", None)
+    if callable(fold):
+        fold()
+        return
+    for member in (getattr(obj, "_modules", None) or {}).values():
+        member_fold = getattr(member, "_fold_pending", None)
+        if callable(member_fold):
+            member_fold()
+
+
 def _restore_file(
-    path: str, obj: Any, validate: str, check_finite: bool
+    path: str, obj: Any, validate: str, check_finite: bool, topology: str = "strict"
 ) -> Dict[str, Any]:
     manifest, payload = _read_file(path)
     if validate != "off" and manifest.get("class") not in (None, type(obj).__name__):
@@ -468,6 +578,8 @@ def _restore_file(
             f"{path} holds state for {manifest.get('class')!r}, not {type(obj).__name__!r}"
             " (use validate='off' to force)"
         )
+    action = _check_topology(path, manifest, obj, topology)
+    target_capacity = getattr(obj, "capacity", None) if action == "remap" else None
     state = _decode_state(path, manifest, payload)
     # wrappers with their own state layouts override load_state without the
     # validate/check_finite kwargs (they validate structurally themselves) —
@@ -480,7 +592,24 @@ def _restore_file(
         kwargs["validate"] = validate
     if "check_finite" in params:
         kwargs["check_finite"] = check_finite
+    if target_capacity is not None and "target_capacity" in params:
+        kwargs["target_capacity"] = target_capacity
     obj.load_state(state, **kwargs)
+    if action == "fold":
+        # elastic: the stacked layout no longer matches this world — fold to
+        # the topology-neutral canonical form NOW; the folded value is the
+        # carried accumulation and the declared reductions make continued
+        # updates exact (parallel/reshard.py)
+        _force_fold(obj)
+        obs.counter_inc("checkpoint.elastic_restores")
+        rank_zero_debug(
+            f"torchmetrics_tpu checkpoint: elastic restore folded {path}"
+            f" ({(manifest.get('topology') or {}).get('num_shards')} shards ->"
+            " topology-neutral canonical form)"
+        )
+    elif action == "remap":
+        obs.counter_inc("checkpoint.elastic_restores")
+    manifest["topology_action"] = action
     return manifest
 
 
@@ -490,6 +619,7 @@ def restore_state(
     validate: str = "strict",
     check_finite: bool = False,
     on_fallback: Optional[Callable[[str, Exception], None]] = None,
+    topology: str = "strict",
 ) -> Dict[str, Any]:
     """Restore ``obj``'s state from a snapshot file or rotating store.
 
@@ -500,18 +630,39 @@ def restore_state(
     the full docs/ROBUSTNESS.md validation, including stacked sharded
     (deferred) layouts via the reserved shard-count key.
 
-    Rotating store (``path`` is a directory): snapshots are tried NEWEST
-    first; a torn/corrupt/invalid snapshot is skipped (``on_fallback(path,
-    error)`` observes each skip, default a rank-zero warning) and the next
-    older one is tried — a damaged file is never silently installed. Raises
-    :class:`CheckpointCorruptionError` when no snapshot is restorable.
+    ``topology`` decides what happens when the snapshot's saved world shape
+    (the manifest's topology block) no longer matches this one — the
+    preempted-and-rescheduled-onto-a-different-slice case
+    (docs/DURABILITY.md "Elastic restore"):
 
-    Returns the restored snapshot's manifest, with ``"path"`` and
-    ``"fallbacks_skipped"`` attached.
+    - ``"strict"`` (default): a stacked sharded snapshot whose shard count
+      differs from this world's device count raises
+      :class:`TopologyMismatchError` (in a rotating store it is *skipped*
+      with a breadcrumb, like a torn file, and the next older snapshot is
+      tried). Pre-topology-block (v1) snapshots restore with a logged
+      warning, never an error.
+    - ``"elastic"``: the stacked state is folded to its topology-neutral
+      canonical form through the ``parallel/reshard.py`` seam and installed
+      on THIS world — exact for all five reduction families; a laned
+      snapshot is remapped into the instance's configured capacity
+      (deterministic rehousing, evict-with-warning on shrink below
+      occupancy).
+
+    Rotating store (``path`` is a directory): snapshots are tried NEWEST
+    first; a torn/corrupt/invalid/topology-mismatched snapshot is skipped
+    (``on_fallback(path, error)`` observes each skip, default a rank-zero
+    warning) and the next older one is tried — a damaged file is never
+    silently installed. Raises :class:`CheckpointCorruptionError` when no
+    snapshot is restorable.
+
+    Returns the restored snapshot's manifest, with ``"path"``,
+    ``"fallbacks_skipped"`` and ``"topology_action"`` attached.
     """
+    if topology not in TOPOLOGY_POLICIES:
+        raise ValueError(f"topology must be one of {TOPOLOGY_POLICIES}, got {topology!r}")
     with obs.span(obs.SPAN_CKPT_RESTORE, owner=type(obj).__name__):
         obs.counter_inc("checkpoint.restores")
-        return _restore_state_body(path, obj, validate, check_finite, on_fallback)
+        return _restore_state_body(path, obj, validate, check_finite, on_fallback, topology)
 
 
 def _restore_state_body(
@@ -520,9 +671,10 @@ def _restore_state_body(
     validate: str,
     check_finite: bool,
     on_fallback: Optional[Callable[[str, Exception], None]],
+    topology: str = "strict",
 ) -> Dict[str, Any]:
     if not os.path.isdir(path):
-        manifest = _restore_file(path, obj, validate, check_finite)
+        manifest = _restore_file(path, obj, validate, check_finite, topology)
         manifest["path"] = path
         manifest["fallbacks_skipped"] = 0
         return manifest
@@ -534,7 +686,7 @@ def _restore_state_body(
     errors: List[str] = []
     for _, snap in reversed(snaps):
         try:
-            manifest = _restore_file(snap, obj, validate, check_finite)
+            manifest = _restore_file(snap, obj, validate, check_finite, topology)
         except (CheckpointCorruptionError, StateCorruptionError) as err:
             skipped += 1
             errors.append(f"{os.path.basename(snap)}: {type(err).__name__}: {err}")

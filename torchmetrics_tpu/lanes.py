@@ -93,7 +93,7 @@ from torchmetrics_tpu.utils.exceptions import (
     StateCorruptionError,
     TorchMetricsUserError,
 )
-from torchmetrics_tpu.utils.prints import rank_zero_debug
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_warn
 
 __all__ = [
     "DEFAULT_CAPACITY",
@@ -1307,6 +1307,101 @@ class LanedMetric(Metric):
         # _state_sig): the stacked layout just changed shape
         self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
 
+    def remap_capacity(self, new_capacity: int) -> int:
+        """Rehouse every active session into a table of ``new_capacity`` lanes
+        — the lane-axis half of elastic topology (docs/DURABILITY.md "Elastic
+        restore"): a directory checkpointed at one capacity reinstalls into an
+        instance configured for another, and a live instance can re-split its
+        lane axis without losing a single session's accumulators.
+
+        Rehousing is DETERMINISTIC: sessions in ascending old-lane order
+        receive new lanes in ascending order, so two replicas remapping the
+        same directory agree on every assignment. Shrinking below occupancy
+        evicts the overflow (the sessions housed in the HIGHEST old lanes)
+        with a warning naming the count — never silently. Per-lane state rows,
+        update/health counters, staleness baselines and quarantine records
+        ride along; records of evicted sessions are dropped. Returns the new
+        (power-of-two bucketed) capacity."""
+        with self._read_mutex():
+            target = lane_capacity_bucket(int(new_capacity))
+            if self.max_capacity is not None and target > self.max_capacity:
+                raise TorchMetricsUserError(
+                    f"cannot remap lanes to {target}: max_capacity={self.max_capacity}"
+                )
+            table: LaneTable = self.__dict__["_table"]
+            if target == table.capacity:
+                return target
+            # a pending sharded install folds first: the remap operates on the
+            # canonical stacked-lane layout (the fold is exact per reduction)
+            self._fold_pending()
+            housed = sorted(table.sessions.items(), key=lambda kv: kv[1])
+            evicted = housed[target:]
+            housed = housed[:target]
+            if evicted:
+                obs.counter_inc("lanes.elastic_evictions", len(evicted))
+                rank_zero_warn(
+                    f"{type(self).__name__}: remapping {table.capacity} -> {target} lanes"
+                    f" shrinks below occupancy ({len(housed) + len(evicted)} active);"
+                    f" evicting {len(evicted)} session(s): "
+                    + ", ".join(repr(sid) for sid, _ in evicted[:8])
+                    + ("..." if len(evicted) > 8 else "")
+                )
+            new_table = LaneTable(target)
+            old_idx, new_idx = [], []
+            for sid, old_lane in housed:
+                new_lane = new_table.allocate(sid)
+                new_table.last_seen[new_lane] = table.last_seen[old_lane]
+                old_idx.append(old_lane)
+                new_idx.append(new_lane)
+            old_rows = np.asarray(old_idx, dtype=np.int64)
+            new_rows = np.asarray(new_idx, dtype=np.int64)
+            inner = self.inner
+            if self._compiled_lanes:
+                for f, default in inner._defaults.items():
+                    stacked = self._stacked_default(default, target)
+                    rehoused = np.array(stacked)
+                    if len(old_rows):
+                        rehoused[new_rows] = np.asarray(self._state[f])[old_rows]
+                    self._defaults[f] = stacked
+                    self._state[f] = jnp.asarray(rehoused)
+                for aux in self._LANE_AUX_FIELDS:
+                    rehoused = np.zeros((target,), np.int32)
+                    if len(old_rows):
+                        rehoused[new_rows] = np.asarray(self._state[aux])[old_rows]
+                    self._defaults[aux] = jnp.zeros((target,), jnp.int32)
+                    self._state[aux] = jnp.asarray(rehoused)
+            else:
+                states = self.__dict__["_lane_states"]
+                counts = self.__dict__["_lane_counts"]
+                health = self.__dict__["_lane_health_counts"]
+                new_states = [inner.init_state() for _ in range(target)]
+                new_counts, new_health = [0] * target, [0] * target
+                for o, n in zip(old_idx, new_idx):
+                    new_states[n], new_counts[n], new_health[n] = states[o], counts[o], health[o]
+                self.__dict__["_lane_states"] = new_states
+                self.__dict__["_lane_counts"] = new_counts
+                self.__dict__["_lane_health_counts"] = new_health
+            seen = np.zeros((target,), np.int64)
+            old_seen = self.__dict__.get("_health_seen")
+            if old_seen is not None and len(old_rows):
+                seen[new_rows] = np.asarray(old_seen)[old_rows]
+            self.__dict__["_health_seen"] = seen
+            self.__dict__["_table"] = new_table
+            self.__dict__["_lane_mirror"].invalidate()
+            self.__dict__["_state_escaped"] = True
+            self.__dict__["_reset_fn"] = None
+            self.__dict__["_lane_compute_fn"] = None
+            self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
+            guard: LaneGuard = self.__dict__["_guard"]
+            if guard.active:
+                # re-validate against the rehoused directory: records for
+                # evicted sessions must not pin a fresh tenant's lane
+                guard.load_json(guard.to_json(), known_sessions=set(new_table.sessions))
+            obs.counter_inc("lanes.remaps")
+            obs.gauge_set("lanes.capacity", target)
+            obs.gauge_set("lanes.occupancy", new_table.active)
+            return target
+
     def prewarm_growth(
         self,
         batch_specs: Any,
@@ -1654,17 +1749,27 @@ class LanedMetric(Metric):
         validate: str = "strict",
         check_finite: bool = False,
         sharded: Optional[bool] = None,
+        target_capacity: Optional[int] = None,
     ) -> None:
         """Install a laned export: re-registers capacity from the carried
         directory, routes through the inherited validated restore, then
         verifies every lane (directory within capacity, no double-assigned
         lanes, non-negative per-lane counts; ``check_finite=True`` names
-        poisoned lanes individually)."""
+        poisoned lanes individually).
+
+        ``target_capacity`` (the elastic-restore path,
+        ``restore_state(..., topology="elastic")``) REMAPS the snapshot's
+        directory into that capacity after the install via
+        :meth:`remap_capacity` — deterministic rehousing, evict-with-warning
+        on shrink below occupancy — instead of leaving the instance at the
+        snapshot's capacity (the default, historical behavior)."""
         if not isinstance(state, dict):
             raise StateCorruptionError(f"{type(self).__name__}: state must be a dict, got {type(state).__name__}")
         state = dict(state)
         if not self._compiled_lanes:
             self._load_state_eager(state, validate=validate, check_finite=check_finite)
+            if target_capacity is not None and lane_capacity_bucket(int(target_capacity)) != self.capacity:
+                self.remap_capacity(target_capacity)
             return
         blob = state.pop(self._LANE_DIR_KEY, None)
         table = _decode_directory(blob) if blob is not None else None
@@ -1696,6 +1801,8 @@ class LanedMetric(Metric):
             self.__dict__["_table"] = table
         self._validate_lanes(check_finite=check_finite, sharded=bool(sharded), mode=validate)
         self._restore_guard(qblob)
+        if target_capacity is not None and lane_capacity_bucket(int(target_capacity)) != self.capacity:
+            self.remap_capacity(target_capacity)
         obs.gauge_set("lanes.capacity", self.capacity)
         obs.gauge_set("lanes.occupancy", self.__dict__["_table"].active)
 
@@ -2208,12 +2315,21 @@ class LanedCollection:
         validate: str = "strict",
         check_finite: bool = False,
         sharded: Optional[bool] = None,
+        target_capacity: Optional[int] = None,
     ) -> None:
         """Restore every member, then re-link them onto ONE shared table
-        (each member's restore decoded its own directory copy)."""
+        (each member's restore decoded its own directory copy).
+        ``target_capacity`` (the elastic-restore path) remaps the restored
+        directory into that capacity afterwards — see
+        :meth:`LanedMetric.load_state`."""
         self.collection.load_state(
             states, update_count=update_count, validate=validate, check_finite=check_finite, sharded=sharded
         )
+        self._relink_tables()
+        if target_capacity is not None and lane_capacity_bucket(int(target_capacity)) != self.capacity:
+            self.remap_capacity(target_capacity)
+
+    def _relink_tables(self) -> None:
         tables = [m.__dict__["_table"] for m in self._members.values()]
         first = tables[0]
         for t in tables[1:]:
@@ -2225,6 +2341,17 @@ class LanedCollection:
         self._table = first
         for m in self._members.values():
             m.__dict__["_table"] = first
+
+    def remap_capacity(self, new_capacity: int) -> int:
+        """Rehouse every member into ``new_capacity`` lanes (deterministic, so
+        every member computes the SAME assignment — see
+        :meth:`LanedMetric.remap_capacity`), then re-link them onto one shared
+        table. Returns the new (bucketed) capacity."""
+        target = self.capacity
+        for m in self._members.values():
+            target = m.remap_capacity(new_capacity)
+        self._relink_tables()
+        return target
 
     def add_update_observer(self, callback: Callable[[Any], None]) -> Callable[[], None]:
         return self.collection.add_update_observer(callback)

@@ -1444,6 +1444,21 @@ class Metric:
         with obs.device_span(obs.SPAN_REDUCE):
             return self.functional_sync(unshard_local_state(state), axis_name)
 
+    def reshard_state(self, state: Dict[str, Any], to_num_shards: int) -> Dict[str, Any]:
+        """Re-split this metric's stacked sharded state from its current shard
+        count onto ``to_num_shards`` — save on N devices, continue on M
+        (docs/SHARDING.md "Resharding"). Routes through the ONE audited
+        ``parallel/reshard.py`` seam: fold to the topology-neutral canonical
+        form, then reinstall per each field's declared ``dist_reduce_fx``
+        (exact for the sum/mean/max/min families; ``cat``/``None``/callable
+        fields raise :class:`TopologyMismatchError` — carry those as a
+        read-point baseline, see ``DeferredCollectionStep.restore_states``)."""
+        from torchmetrics_tpu.parallel.reshard import ShardLayout, layout_of, reshard_states
+
+        return reshard_states(
+            state, layout_of(state), ShardLayout(int(to_num_shards)), self._reductions
+        )
+
     def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``(state, batch) -> state'``. jit/vmap/shard_map-safe.
 

@@ -41,6 +41,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_PAD,
     SPAN_QUARANTINE,
     SPAN_REDUCE,
+    SPAN_RESHARD,
     SPAN_SYNC_GATHER,
     SPAN_UPDATE,
     SPAN_WARMUP,

@@ -77,6 +77,7 @@ SPAN_EXPORT = "tm_tpu.export"              # telemetry export itself (allowliste
 SPAN_LANES = "tm_tpu.lanes.dispatch"       # lane-batched multi-session dispatch (pack+scatter)
 SPAN_QUARANTINE = "tm_tpu.lanes.quarantine"  # lane fault containment (rollback + quarantine)
 SPAN_COMPUTE_ASYNC = "tm_tpu.compute_async"  # async-read submission (caller-side half only)
+SPAN_RESHARD = "tm_tpu.reshard"            # elastic N->M re-split (restore / shard-loss recovery)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -97,6 +98,7 @@ SPAN_NAMES = (
     SPAN_LANES,
     SPAN_QUARANTINE,
     SPAN_COMPUTE_ASYNC,
+    SPAN_RESHARD,
 )
 
 

@@ -102,6 +102,9 @@ RECOVERY_ENV_FLAG = "TORCHMETRICS_TPU_EXECUTOR_RECOVERY"
 #: reserved key carried by ``Metric.state()`` exports (see metric.py)
 STATE_COUNT_KEY = "_update_count"
 
+#: reserved key marking a stacked sharded export (mirrors Metric._STATE_SHARDS_KEY)
+STATE_SHARDS_KEY = "_sharded_shards"
+
 _BUCKET_FLOOR = 8
 _FUSABLE_REDUCTIONS = ("sum", "max", "min")
 _PY_SCALARS = (bool, int, float, complex, np.generic)
@@ -2201,7 +2204,12 @@ def make_synced_collection_step(
     in ``shard_map``/``jit`` (donation intact) for you.
     """
     if reduce == "deferred":
-        return _make_deferred_bodies(collection, axis_name, pack_values)
+        # the documented 3-tuple; the shadow's fold body is a
+        # DeferredCollectionStep-internal surface
+        local_step, reduce_step, _fold, unpack = _make_deferred_bodies(
+            collection, axis_name, pack_values
+        )
+        return local_step, reduce_step, unpack
     if reduce != "step":
         raise ValueError(f"reduce must be 'step' or 'deferred', got {reduce!r}")
     box: Dict[str, Any] = {}
@@ -2224,10 +2232,17 @@ def make_synced_collection_step(
     return step, unpack
 
 
-def _make_deferred_bodies(collection: Any, axis_name: str, pack_values: bool):
-    """(local_step, reduce_step, unpack) raw bodies for the deferred policy;
-    both are meant to run inside the caller's ``shard_map`` with the state
-    spec from ``collection.sharded_state_spec(axis_name)``."""
+def _make_deferred_bodies(collection: Any, axis_name: str, pack_values: bool, baseline_box: Optional[Dict[str, Any]] = None):
+    """(local_step, reduce_step, fold_step, unpack) raw bodies for the
+    deferred policy; all are meant to run inside the caller's ``shard_map``
+    with the state spec from ``collection.sharded_state_spec(axis_name)``.
+
+    ``baseline_box`` (a mutable dict read at TRACE time) may carry a
+    ``"baseline"`` canonical pytree from an elastic restore / shard-loss
+    recovery (parallel/reshard.py): the read point then merges the carried
+    segment with the freshly-folded live value per the declared reductions,
+    so continued accumulation after a topology change stays exact."""
+    from torchmetrics_tpu.parallel.reshard import merge_folded
     from torchmetrics_tpu.parallel.sync import reshard_local_state, unshard_local_state
 
     box: Dict[str, Any] = {}
@@ -2238,23 +2253,46 @@ def _make_deferred_bodies(collection: Any, axis_name: str, pack_values: bool):
             local = collection.functional_update(unshard_local_state(states), *args, **kwargs)
         return reshard_local_state(local)
 
-    def reduce_step(states):
-        # the single deferred rendezvous: one fused collective per
-        # (reduction, dtype) for the whole collection, then compute
+    def _merged(states):
+        # one fused collective per (reduction, dtype) for the whole collection,
+        # then the carried-baseline merge (a trace constant; elastic restores
+        # bump the executable key so stale baselines can never be served)
         synced = collection.reduce_sharded_states(states, axis_name)
-        values = collection.functional_compute(synced)
+        baseline = (baseline_box or {}).get("baseline")
+        if baseline is None:
+            return synced
+        return {
+            leader: merge_folded(
+                baseline[leader], sub, collection._modules[leader]._reductions
+            )
+            if leader in baseline
+            else sub
+            for leader, sub in synced.items()
+        }
+
+    def reduce_step(states):
+        # the single deferred rendezvous, then every member's compute
+        values = collection.functional_compute(_merged(states))
         if pack_values:
             if "pack" not in box:
                 box["pack"], box["unpack"] = make_value_packer(values)
             values = box["pack"](values)
         return values
 
+    def fold_step(states):
+        # the shard shadow's refresh body: the SAME fused rendezvous but
+        # returning the reduced (replicated) states instead of computed
+        # values — the canonical form the host shadow stores. The baseline
+        # merge happens on the pipeline worker (host side), not here, so the
+        # executable survives baseline changes.
+        return collection.reduce_sharded_states(states, axis_name)
+
     def unpack(packed):
         if not pack_values:
             return packed
         return box["unpack"](packed)
 
-    return local_step, reduce_step, unpack
+    return local_step, reduce_step, fold_step, unpack
 
 
 class DeferredCollectionStep:
@@ -2276,6 +2314,22 @@ class DeferredCollectionStep:
     - :meth:`reduce` — ``states -> values``: the separately cached read-point
       executable; one fused collective per (reduction, dtype) for the whole
       collection, then every metric's compute.
+
+    Elastic topology (docs/DURABILITY.md "Elastic restore",
+    docs/ROBUSTNESS.md "Shard loss"):
+
+    - :meth:`restore_states` — reinstall a checkpointed stacked state saved
+      on ANY shard count: the fold/expand goes through the audited
+      ``parallel/reshard.py`` seam; the folded value becomes a carried
+      baseline merged at the read point and fresh identity accumulators go
+      back on this mesh.
+    - :meth:`attach_shadow` — maintain a bounded-lag host shadow of the
+      folded reduce (refreshed via the async read pipeline; the step loop
+      only pays an async dispatch every ``every_n_steps``), and resolve
+      shard loss (:class:`~torchmetrics_tpu.utils.exceptions.ShardLossError`)
+      per ``on_shard_loss``: ``"raise"`` propagates, ``"degraded"`` serves
+      the shadow as a ``DegradedValue``, ``"restore"`` reinstalls the shadow
+      and continues.
     """
 
     def __init__(self, collection: Any, mesh: Any, axis_name: str, pack_values: bool, batch_specs: Any, donate: bool) -> None:
@@ -2284,11 +2338,21 @@ class DeferredCollectionStep:
         self._axis = axis_name
         self._batch_specs = batch_specs
         self._donate = donate
-        self._local_body, self._reduce_body, self._unpack = _make_deferred_bodies(
-            collection, axis_name, pack_values
+        #: carried canonical baseline from an elastic restore / recovery; read
+        #: at trace time by the reduce body (key versioned via _baseline_version)
+        self._baseline_box: Dict[str, Any] = {}
+        self._baseline_version = 0
+        self._local_body, self._reduce_body, self._fold_body, self._unpack = _make_deferred_bodies(
+            collection, axis_name, pack_values, self._baseline_box
         )
         self._state_spec = collection.sharded_state_spec(axis_name)
         self._compiled: Dict[Any, Callable] = {}
+        #: committed local steps (one per batch; epochs add their chunk length)
+        #: — the anchor of the shadow's updates_behind staleness contract
+        self._steps = 0
+        self._shadow: Optional[Any] = None
+        self._on_shard_loss = "raise"
+        self._recovered_states: Optional[Any] = None
 
     def _b_specs(self, batch):
         from jax.sharding import PartitionSpec as P
@@ -2321,6 +2385,7 @@ class DeferredCollectionStep:
 
     def local_step(self, states, *batch):
         from torchmetrics_tpu.parallel.sync import shard_map_compat
+        from torchmetrics_tpu.utils.exceptions import ShardLossError
 
         def build():
             mapped = shard_map_compat(
@@ -2329,11 +2394,25 @@ class DeferredCollectionStep:
             return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
 
         fn = self._get(("local", len(batch)), build)
-        with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
-            return fn(states, *batch)
+        try:
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+                out = fn(states, *batch)
+        except ShardLossError:
+            if self._on_shard_loss != "restore" or self._shadow is None:
+                raise
+            # reinstall the bounded-lag shadow through the reshard seam and
+            # re-apply THIS batch on the fresh accumulators: the run lost at
+            # most updates_behind steps, never the whole epoch
+            fresh = self.recover()
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+                out = fn(fresh, *batch)
+        self._steps += 1
+        self._tick_shadow(out)
+        return out
 
     def local_epoch(self, states, *stacked):
         from torchmetrics_tpu.parallel.sync import shard_map_compat, reshard_local_state, unshard_local_state
+        from torchmetrics_tpu.utils.exceptions import ShardLossError
 
         def build():
             def epoch_body(st, *chunk):
@@ -2352,21 +2431,35 @@ class DeferredCollectionStep:
             return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
 
         fn = self._get(("epoch", len(stacked)), build)
-        with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
-            return fn(states, *stacked)
+        try:
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+                out = fn(states, *stacked)
+        except ShardLossError:
+            if self._on_shard_loss != "restore" or self._shadow is None:
+                raise
+            fresh = self.recover()
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+                out = fn(fresh, *stacked)
+        self._steps += int(jnp.shape(stacked[0])[0]) if stacked else 0
+        self._tick_shadow(out)
+        return out
 
     def reduce(self, states):
         from jax.sharding import PartitionSpec as P
 
         from torchmetrics_tpu.parallel.sync import shard_map_compat
+        from torchmetrics_tpu.utils.exceptions import ShardLossError
 
         def build():
             # values are replicated after the fused collectives; out_specs=P()
             return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
 
-        fn = self._get("reduce", build)
-        with obs.span(obs.SPAN_REDUCE):
-            return self._unpack(fn(states))
+        fn = self._get(("reduce", self._baseline_version), build)
+        try:
+            with obs.span(obs.SPAN_REDUCE):
+                return self._unpack(fn(states))
+        except ShardLossError as err:
+            return self._serve_shard_loss(err)
 
     def reduce_async(self, states):
         """Non-blocking :meth:`reduce` (docs/ASYNC.md): the fused read-point
@@ -2380,18 +2473,215 @@ class DeferredCollectionStep:
         donate, so the same states remain live for the next step)."""
         from jax.sharding import PartitionSpec as P
 
-        from torchmetrics_tpu.ops.async_read import get_pipeline, materialize
+        from torchmetrics_tpu.ops.async_read import get_pipeline, materialize, resolved_future
         from torchmetrics_tpu.parallel.sync import shard_map_compat
+        from torchmetrics_tpu.utils.exceptions import ShardLossError
 
         def build():
             return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
 
-        fn = self._get("reduce", build)
-        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix="DeferredCollectionStep"):
-            packed = fn(states)  # enqueued on the device stream, not awaited
+        fn = self._get(("reduce", self._baseline_version), build)
+        try:
+            with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix="DeferredCollectionStep"):
+                packed = fn(states)  # enqueued on the device stream, not awaited
+        except ShardLossError as err:
+            # shard loss surfaces at dispatch: resolve the future per policy
+            # (the caller still gets a future, like every degradation path)
+            return resolved_future(
+                self._serve_shard_loss(err), owner="DeferredCollectionStep.reduce"
+            )
         return get_pipeline().submit(
             lambda: self._unpack(materialize(packed)), owner="DeferredCollectionStep.reduce"
         )
+
+    # ------------------------------------------------------- elastic topology
+    def _fold_fn(self):
+        """The shadow's separately compiled fold executable: the same fused
+        rendezvous as :meth:`reduce` but returning the reduced (replicated)
+        states — the canonical form the host shadow stores. Non-donating, so
+        its output buffers are safe against later donating local steps."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import shard_map_compat
+
+        def build():
+            out_spec = jax.tree_util.tree_map(lambda _: P(), self._state_spec)
+            return jax.jit(shard_map_compat(self._fold_body, self._mesh, (self._state_spec,), out_spec))
+
+        return self._get("shadow_fold", build)
+
+    def _tick_shadow(self, states) -> None:
+        """Cadence hook on every committed local step/epoch: when a refresh
+        is due, DISPATCH the fold executable (JAX async dispatch — the step
+        loop never waits) and hand the fresh buffers to the read-pipeline
+        worker for the ready-wait + D2H (docs/ROBUSTNESS.md "Shard loss")."""
+        shadow = self._shadow
+        if shadow is None or not shadow.due(self._steps):
+            return
+        folded = self._fold_fn()(states)  # enqueued, not awaited
+        shadow.observe(folded, self._steps, baseline=self._baseline_box.get("baseline"))
+
+    def attach_shadow(self, every_n_steps: int = 8, on_shard_loss: str = "degraded"):
+        """Maintain a bounded-lag host shadow of the folded reduce and resolve
+        :class:`~torchmetrics_tpu.utils.exceptions.ShardLossError` per
+        ``on_shard_loss`` (docs/ROBUSTNESS.md "Shard loss" policy table).
+        Returns the :class:`~torchmetrics_tpu.parallel.reshard.ShardShadow`.
+
+        Staleness contract: the shadow trails the live accumulation by at
+        most ``every_n_steps - 1`` committed steps plus any refresh still in
+        flight on the pipeline; a served ``DegradedValue.updates_behind`` is
+        anchored on the shadow's step counter at its last completed refresh.
+        """
+        from torchmetrics_tpu.parallel.reshard import SHARD_LOSS_POLICIES, ShardShadow
+
+        if on_shard_loss not in SHARD_LOSS_POLICIES:
+            raise ValueError(
+                f"on_shard_loss must be one of {SHARD_LOSS_POLICIES}, got {on_shard_loss!r}"
+            )
+
+        def reductions_of():
+            return {
+                leader: self._coll._modules[leader]._reductions
+                for leader in self._coll.state_spec()
+            }
+
+        self._shadow = ShardShadow(reductions_of, every_n_steps=every_n_steps)
+        self._on_shard_loss = on_shard_loss
+        return self._shadow
+
+    @property
+    def shadow(self):
+        return self._shadow
+
+    @property
+    def steps(self) -> int:
+        """Committed local steps since construction (or the last restore)."""
+        return self._steps
+
+    @property
+    def baseline(self):
+        """The carried canonical baseline from an elastic restore/recovery
+        (None on the straight-through path)."""
+        return self._baseline_box.get("baseline")
+
+    def _set_baseline(self, canonical) -> None:
+        self._baseline_box["baseline"] = canonical
+        # the reduce executable closes over the baseline as trace constants:
+        # a new baseline must never be served by a stale executable
+        self._baseline_version += 1
+
+    def restore_states(self, states, step_count: Optional[int] = None, stacked: Optional[bool] = None):
+        """Reinstall checkpointed deferred state on THIS mesh, whatever world
+        it was saved on (the elastic-restore read path, docs/DURABILITY.md).
+
+        ``states`` is a leader-keyed pytree — either the stacked sharded
+        layout a mid-epoch checkpoint carries (auto-detected via the reserved
+        ``"_sharded_shards"`` mark; override with ``stacked=``) or an
+        already-canonical (folded) value. The fold routes through the audited
+        ``parallel/reshard.py`` seam; the canonical value becomes the carried
+        baseline merged at every read, and FRESH identity accumulators (per
+        each state's declared ``dist_reduce_fx``) are returned, placed on the
+        mesh — exact for all five reduction families. ``step_count`` re-anchors
+        the staleness clock (default: the count is left where it was)."""
+        from torchmetrics_tpu.parallel.reshard import fold_canonical
+
+        canonical: Dict[str, Dict[str, Any]] = {}
+        for leader, sub in states.items():
+            reds = self._coll._modules[leader]._reductions
+            is_stacked = stacked
+            if is_stacked is None:
+                is_stacked = isinstance(sub, dict) and sub.get(STATE_SHARDS_KEY) is not None
+            # a restore REPLACES any previously carried baseline: the snapshot
+            # is the whole accumulation (export_canonical folds a live baseline
+            # into the checkpoint, so nothing is ever double-counted)
+            canonical[leader] = fold_canonical(sub, reds) if is_stacked else {
+                k: v for k, v in sub.items() if k not in (STATE_COUNT_KEY, STATE_SHARDS_KEY)
+            }
+        obs.counter_inc("shards.elastic_restores")
+        self._set_baseline(canonical)
+        if step_count is not None:
+            self._steps = int(step_count)
+        if self._shadow is not None:
+            self._shadow.seed(canonical, self._steps)
+        return self.init_states()
+
+    def export_canonical(self, states):
+        """The checkpointable whole-truth of the accumulation: fold the live
+        sharded ``states`` and merge the carried baseline (if any) into ONE
+        canonical host pytree — what ``save_state(coll, path, states=...)``
+        should persist once a baseline exists (saving the raw sharded states
+        alone would silently drop the pre-restore segment). A checkpoint
+        surface: it blocks on the fold's D2H, so call it at save points, not
+        on the step loop."""
+        from torchmetrics_tpu.parallel.reshard import merge_folded
+
+        folded = self._fold_fn()(states)
+        baseline = self._baseline_box.get("baseline")
+        out: Dict[str, Dict[str, Any]] = {}
+        for leader, sub in folded.items():
+            host = {f: np.asarray(v) for f, v in sub.items()}
+            if baseline is not None and leader in baseline:
+                host = {
+                    f: np.asarray(v)
+                    for f, v in merge_folded(
+                        baseline[leader], host, self._coll._modules[leader]._reductions
+                    ).items()
+                }
+            out[leader] = host
+        return out
+
+    def recover(self):
+        """Reinstall the shadow's last completed refresh as the carried
+        baseline and return fresh accumulators on this mesh — the
+        ``on_shard_loss="restore"`` action. Raises when no shadow refresh has
+        completed yet (nothing to recover from)."""
+        snap = None if self._shadow is None else self._shadow.snapshot()
+        if snap is None:
+            raise RuntimeError(
+                "shard-loss recovery requested but no shadow refresh has completed;"
+                " attach_shadow() earlier or lower every_n_steps"
+            )
+        canonical, shadow_steps = snap
+        obs.counter_inc("shards.shadow_restores")
+        obs.breadcrumb(
+            "shard_loss_restore",
+            {"shadow_steps": shadow_steps, "live_steps": self._steps,
+             "updates_behind": max(0, self._steps - shadow_steps)},
+        )
+        self._set_baseline(canonical)
+        self._steps = int(shadow_steps)
+        self._shadow.seed(canonical, self._steps)
+        fresh = self.init_states()
+        self._recovered_states = fresh
+        return fresh
+
+    def take_recovered_states(self):
+        """Pop the fresh states a read-point recovery installed (None when no
+        recovery happened since the last call) — the epoch loop swaps its
+        carry for these after a ``reduce()`` came back degraded-restored."""
+        out, self._recovered_states = self._recovered_states, None
+        return out
+
+    def _serve_shard_loss(self, err):
+        """Resolve a ShardLossError at the read point per ``on_shard_loss``."""
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        shadow = self._shadow
+        snap = None if shadow is None else shadow.snapshot()
+        if self._on_shard_loss == "raise" or snap is None:
+            raise err
+        canonical, shadow_steps = snap
+        behind = max(0, self._steps - shadow_steps)
+        obs.gauge_set("shards.shadow_age_updates", behind)
+        obs.counter_inc("shards.degraded_reads")
+        if self._on_shard_loss == "restore":
+            self.recover()
+        # the shadow IS canonical: compute values from it host-side (eager —
+        # the mesh just failed us, so no shard_map rendezvous here)
+        values = self._coll.functional_compute(
+            {k: {f: jnp.asarray(v) for f, v in sub.items()} for k, sub in canonical.items()}
+        )
+        return DegradedValue(value=values, updates_behind=behind, age_updates=shadow_steps)
 
 
 def make_deferred_collection_step(

@@ -1,0 +1,351 @@
+"""Elastic topology: the ONE audited N→M reshard seam + the shard shadow.
+
+Every robustness layer before this PR assumed the *world is fixed*: a
+checkpoint saved on an 8-device mesh restores only onto 8 devices, and a
+deferred-mode shard that dies takes its locally-accumulated counts with it.
+Large TPU jobs are routinely preempted and rescheduled onto a *different*
+slice shape (arXiv:2204.06514), so metric state must survive a changed
+world. This module is the seam everything elastic routes through
+(docs/SHARDING.md "Resharding", docs/DURABILITY.md "Elastic restore"):
+
+- :func:`fold_canonical` — collapse a stacked sharded state (leading axis =
+  num_shards) to the **topology-neutral canonical form**: the exact value
+  the declared ``dist_reduce_fx`` would produce at the read point. Canonical
+  state has no shard axis and can be reinstalled on ANY world.
+- :func:`expand_canonical` — reinstall a canonical value onto M shards
+  exactly: the folded value becomes the carried content and fresh identity
+  accumulators fill the rest, per reduction family (see below).
+- :func:`merge_folded` — combine two canonical *segments* (a carried
+  baseline and a freshly-folded live value) per the declared reduction.
+- :func:`reshard_states` — the audited N→M path built from the two halves;
+  ``DeferredCollectionStep.restore_states``, the elastic checkpoint restore
+  (io/checkpoint.py) and the shard-loss recovery all call THIS function, so
+  re-splitting logic exists exactly once.
+- :class:`ShardShadow` — a bounded-lag host-side shadow of the folded
+  reduce for deferred state, refreshed through the async read pipeline
+  (ops/async_read.py): the step loop only *dispatches* the (non-donating)
+  fold executable; the ready-wait and D2H land on the pipeline worker. On
+  shard loss the shadow is what ``on_shard_loss="degraded"|"restore"``
+  serves or reinstalls.
+
+Exactness per reduction family (why elastic restore is exact, not
+approximate):
+
+====== ============================== ===============================
+family fold (shard axis)              expand onto M shards
+====== ============================== ===============================
+sum    add                            canonical in shard 0, zeros elsewhere
+mean   linear (mean over shards)      canonical REPLICATED on every shard —
+                                      ``mean_i(b + c_i) = b + mean_i(c_i)``
+max    idempotent                     canonical replicated
+min    idempotent                     canonical replicated
+cat    concat                         cannot live in a uniform stack: the
+                                      canonical value is carried as a host
+                                      baseline and merged at the read point
+====== ============================== ===============================
+
+``None``/callable reductions have no derivable identity or segment merge;
+elastic restore refuses them (``TopologyMismatchError``) — save/restore on
+matching topology (``topology="strict"``) instead.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.parallel.sync import (
+    Reduction,
+    reduce_stacked,
+    reduction_identity,
+)
+from torchmetrics_tpu.utils.exceptions import TopologyMismatchError
+
+__all__ = [
+    "ShardLayout",
+    "ShardShadow",
+    "expand_canonical",
+    "fold_canonical",
+    "layout_of",
+    "merge_folded",
+    "reshard_states",
+]
+
+#: reduction families an elastic reshard can re-split exactly INTO the stack
+_IN_STACK = ("sum", "mean", "max", "min")
+
+#: reserved keys a state export may carry that are not declared fields
+_COUNT_KEY = "_update_count"
+_SHARDS_KEY = "_sharded_shards"
+
+
+class ShardLayout(NamedTuple):
+    """Topology descriptor of a stacked sharded state: how many per-device
+    shards the leading axis carries (the deferred layout of docs/SHARDING.md).
+    ``axis_name`` records the mesh axis the layout partitions along (metadata
+    only — the fold/expand arithmetic never needs it)."""
+
+    num_shards: int
+    axis_name: Optional[str] = None
+
+
+def layout_of(states: Dict[str, Any]) -> ShardLayout:
+    """Infer the :class:`ShardLayout` of a stacked state pytree from its
+    first array leaf's leading axis (every leaf agrees by construction —
+    ``Metric.validate_state(sharded=True)`` enforces it on restore paths)."""
+    for v in states.values():
+        if isinstance(v, dict):
+            return layout_of(v)
+        arr = v if hasattr(v, "shape") else np.asarray(v)
+        if getattr(arr, "ndim", 0) >= 1:
+            return ShardLayout(int(arr.shape[0]))
+    raise TopologyMismatchError("cannot infer shard layout: no array leaf carries a shard axis")
+
+
+def _strip_reserved(states: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in states.items() if k not in (_COUNT_KEY, _SHARDS_KEY)}
+
+
+def fold_canonical(states: Dict[str, Any], reductions: Dict[str, Reduction]) -> Dict[str, Any]:
+    """Collapse the leading shard axis of every field per its declared
+    reduction — the topology-neutral canonical form (the same arithmetic as
+    ``parallel.sync.fold_sharded_states``; reserved count/shard-mark keys are
+    stripped). Works on host (np) and device (jnp) stacks alike."""
+    return {
+        k: reduce_stacked(v if hasattr(v, "sum") else np.asarray(v), reductions.get(k))
+        for k, v in _strip_reserved(states).items()
+    }
+
+
+def expand_canonical(
+    canonical: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    num_shards: int,
+) -> Dict[str, Any]:
+    """Reinstall a canonical (folded) state onto ``num_shards`` shards such
+    that the next fold returns exactly the canonical value and subsequent
+    local accumulation stays exact (the table in the module docstring).
+
+    Raises :class:`TopologyMismatchError` for fields whose reduction cannot
+    be re-split into a uniform stack (``cat``, ``None``, callables) — those
+    are carried as a read-point baseline instead (:func:`merge_folded`)."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    out: Dict[str, Any] = {}
+    for name, value in _strip_reserved(canonical).items():
+        fx = reductions.get(name)
+        if fx not in _IN_STACK:
+            raise TopologyMismatchError(
+                f"field {name!r} (dist_reduce_fx={fx!r}) cannot be re-split into a"
+                f" {num_shards}-shard stack — carry it as a baseline (merge_folded)"
+                " or restore on the saved topology"
+            )
+        arr = jnp.asarray(value)
+        if fx == "sum":
+            ident = jnp.broadcast_to(
+                reduction_identity(fx, arr.dtype), (num_shards - 1,) + arr.shape
+            )
+            out[name] = jnp.concatenate([arr[None], ident], axis=0)
+        else:  # mean (linear fold), max/min (idempotent): replicate exactly
+            out[name] = jnp.broadcast_to(arr[None], (num_shards,) + arr.shape)
+    return out
+
+
+def merge_folded(
+    baseline: Dict[str, Any], fresh: Dict[str, Any], reductions: Dict[str, Reduction]
+) -> Dict[str, Any]:
+    """Combine two canonical *segments* of the same accumulation — a carried
+    baseline (everything folded before the topology change / shard loss) and
+    a freshly-folded live value — per the declared reduction.
+
+    Segment combination differs from the shard fold itself for ``mean``: the
+    fold over the shard axis is LINEAR, so two folded segments of the same
+    physical accumulators combine by addition (``mean_i(a_i + c_i) =
+    mean_i(a_i) + mean_i(c_i)``) — exactly what an uninterrupted run's single
+    fold would have produced."""
+    out: Dict[str, Any] = {}
+    for name, b in baseline.items():
+        fx = reductions.get(name)
+        v = fresh[name]
+        if fx in ("sum", "mean"):
+            out[name] = b + v
+        elif fx == "max":
+            out[name] = jnp.maximum(b, v)
+        elif fx == "min":
+            out[name] = jnp.minimum(b, v)
+        elif fx == "cat":
+            out[name] = jnp.concatenate([jnp.atleast_1d(jnp.asarray(b)), jnp.atleast_1d(jnp.asarray(v))], axis=0)
+        else:
+            raise TopologyMismatchError(
+                f"field {name!r} (dist_reduce_fx={fx!r}) has no derivable segment merge;"
+                " elastic restore cannot carry it across a topology change"
+            )
+    for name, v in fresh.items():
+        if name not in out:
+            out[name] = v
+    return out
+
+
+def reshard_states(
+    states: Dict[str, Any],
+    from_layout: ShardLayout,
+    to_layout: ShardLayout,
+    reductions: Dict[str, Reduction],
+) -> Dict[str, Any]:
+    """The audited N→M re-split: fold ``states`` (stacked with
+    ``from_layout.num_shards`` leading) to canonical, then expand onto
+    ``to_layout.num_shards`` shards. Exact for the sum/mean/max/min families
+    (module docstring table); ``cat``/``None``/callable fields raise
+    :class:`TopologyMismatchError` — carry those as a read-point baseline.
+
+    N == M is a validated no-op (the stack is returned unchanged), so every
+    restore path can route through here unconditionally and the mismatch
+    logic lives in exactly one place.
+    """
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    got = layout_of(states)
+    if got.num_shards != from_layout.num_shards:
+        raise TopologyMismatchError(
+            f"state carries {got.num_shards} shards but from_layout declares"
+            f" {from_layout.num_shards}",
+            saved={"num_shards": from_layout.num_shards},
+            current={"num_shards": got.num_shards},
+        )
+    if from_layout.num_shards == to_layout.num_shards:
+        return _strip_reserved(states)
+    with obs.span(obs.SPAN_RESHARD, src=from_layout.num_shards, dst=to_layout.num_shards):
+        obs.counter_inc("shards.resharded")
+        return expand_canonical(fold_canonical(states, reductions), reductions, to_layout.num_shards)
+
+
+# ---------------------------------------------------------------------------
+# Shard-loss tolerance: the bounded-lag host shadow of the folded reduce
+# ---------------------------------------------------------------------------
+
+#: valid ``on_shard_loss`` policies (docs/ROBUSTNESS.md "Shard loss")
+SHARD_LOSS_POLICIES = ("raise", "degraded", "restore")
+
+
+class ShardShadow:
+    """Bounded-lag host copy of a deferred accumulation's folded reduce.
+
+    The deferred layout's whole point is that unreduced state lives only on
+    the devices — which means a lost shard loses history. The shadow closes
+    that hole without new blocking points: every ``every_n_steps`` local
+    steps the owner *dispatches* its (separately compiled, non-donating)
+    fold executable — JAX async dispatch, zero wait on the step loop — and
+    hands the resulting replicated arrays to the async read pipeline, whose
+    worker does the ready-wait + D2H (the ONLY sanctioned blocking points,
+    tools/lint_blocking_host_sync.py). The freshest completed refresh is the
+    recovery anchor: at most ``every_n_steps - 1`` updates behind the live
+    state, plus whatever is still in flight.
+
+    The shadow value is CANONICAL (topology-neutral, :func:`fold_canonical`
+    shape), so recovery composes with elastic restore: a shard lost at the
+    same moment the world is resized reinstalls through the same
+    :func:`reshard_states`/baseline seam.
+    """
+
+    def __init__(
+        self,
+        reductions_of: Callable[[], Dict[str, Dict[str, Reduction]]],
+        every_n_steps: int = 8,
+    ) -> None:
+        if every_n_steps < 1:
+            raise ValueError(f"every_n_steps must be >= 1, got {every_n_steps}")
+        self.every_n_steps = int(every_n_steps)
+        self._reductions_of = reductions_of
+        self._lock = threading.Lock()
+        #: freshest COMPLETED refresh: (canonical host pytree, step counter)
+        self._shadow: Optional[Tuple[Dict[str, Dict[str, Any]], int]] = None
+        self._last_submitted = -every_n_steps  # first observe() always refreshes
+        self.stats: Dict[str, int] = {"refreshes": 0, "submitted": 0, "errors": 0}
+
+    # ------------------------------------------------------------- observation
+    def due(self, step_count: int) -> bool:
+        """True when the cadence says a refresh should be submitted now."""
+        return step_count - self._last_submitted >= self.every_n_steps
+
+    def observe(self, folded_device: Any, step_count: int, baseline: Optional[Dict[str, Any]] = None) -> None:
+        """Stage one refresh: ``folded_device`` is the ALREADY-DISPATCHED
+        output of the owner's fold executable (fresh non-donated buffers —
+        later donating local steps cannot invalidate them). The worker-side
+        job materializes it, host-copies, merges any carried ``baseline``
+        segment, and installs the result as the freshest shadow."""
+        from torchmetrics_tpu.ops.async_read import get_pipeline
+
+        self._last_submitted = int(step_count)
+        self.stats["submitted"] += 1
+        get_pipeline().submit(
+            lambda: self._refresh_job(folded_device, int(step_count), baseline),
+            owner="ShardShadow.refresh",
+        )
+
+    def _refresh_job(self, folded_device: Any, step_count: int, baseline: Optional[Dict[str, Any]]) -> None:
+        """WORKER-SIDE ONLY (async read pipeline): ready-wait + D2H + install."""
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.ops.async_read import materialize
+
+        try:
+            ready = materialize(folded_device)
+            host = {
+                leader: {f: np.array(v) for f, v in sub.items()}
+                for leader, sub in ready.items()
+            }
+            if baseline is not None:
+                reds = self._reductions_of()
+                host = {
+                    leader: {
+                        f: np.asarray(v)
+                        for f, v in merge_folded(baseline[leader], sub, reds[leader]).items()
+                    }
+                    for leader, sub in host.items()
+                }
+            with self._lock:
+                # refreshes resolve in submission order (single worker), but a
+                # stale install would still be wrong after a recover() reset
+                if self._shadow is None or step_count >= self._shadow[1]:
+                    self._shadow = (host, step_count)
+            self.stats["refreshes"] += 1
+            obs.counter_inc("shards.shadow_refreshes")
+        except Exception as err:
+            # a failed refresh must not kill the pipeline; the previous shadow
+            # stays the recovery anchor (lag grows, visible in the gauge)
+            from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+            self.stats["errors"] += 1
+            obs.counter_inc("shards.shadow_errors")
+            obs.breadcrumb("shadow_refresh_failed", {"error": f"{type(err).__name__}: {err}"})
+            rank_zero_debug(f"shard shadow refresh failed: {type(err).__name__}: {err}")
+
+    # ------------------------------------------------------------------ reads
+    def snapshot(self) -> Optional[Tuple[Dict[str, Dict[str, Any]], int]]:
+        """The freshest completed refresh as ``(canonical_host_state,
+        step_counter)``, or None when no refresh has completed yet."""
+        with self._lock:
+            if self._shadow is None:
+                return None
+            host, count = self._shadow
+            return {k: dict(v) for k, v in host.items()}, count
+
+    def seed(self, canonical: Dict[str, Dict[str, Any]], step_count: int) -> None:
+        """Install a known-good canonical value directly (restore-time seed /
+        post-recovery reset) without a device round-trip."""
+        host = {
+            leader: {f: np.asarray(v) for f, v in sub.items()} for leader, sub in canonical.items()
+        }
+        with self._lock:
+            self._shadow = (host, int(step_count))
+        self._last_submitted = int(step_count)
+
+    def updates_behind(self, live_step_count: int) -> Optional[int]:
+        """How many committed local steps the shadow trails the live state by
+        (the staleness contract of docs/ROBUSTNESS.md); None before the first
+        completed refresh."""
+        with self._lock:
+            if self._shadow is None:
+                return None
+            return max(0, int(live_step_count) - self._shadow[1])

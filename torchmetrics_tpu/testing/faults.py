@@ -28,6 +28,15 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
   never crash or change a result.
 - :func:`preempt_after` — raise a simulated preemption after the n-th
   COMMITTED update (drives autosave + kill/restore chaos tests).
+- :func:`drop_shard` — make a deferred step's compiled dispatches raise an
+  attributed ``ShardLossError`` (a device shard's locally-accumulated state
+  is gone; drives the ``on_shard_loss`` policies + shard shadow,
+  docs/ROBUSTNESS.md "Shard loss").
+- :func:`shrink_world` / :func:`grow_world` — simulate a preemption
+  rescheduled onto a DIFFERENT slice shape: the checkpoint layer's
+  world-topology probe reports ``to`` devices and a matching sub-mesh is
+  yielded (drives ``restore_state(topology="strict"|"elastic")`` and the
+  ``parallel/reshard.py`` seam).
 - :func:`poison_session` / :func:`fail_lane_dispatch` — lane-targeted faults
   against ONE tenant of a laned metric (docs/LANES.md "Failure semantics"):
   corrupt only that session's rows, or raise an attributed
@@ -280,6 +289,104 @@ def fail_dispatch(
         yield
     finally:
         executor_mod._ExecutorBase._get_fn = orig
+
+
+# ---------------------------------------------------------- elastic topology
+
+@contextmanager
+def drop_shard(
+    step: Any, shard: int = 0, fail_n: Optional[int] = 1, exc: Optional[BaseException] = None
+) -> Generator[None, None, None]:
+    """Make ``step``'s (a ``DeferredCollectionStep``) compiled dispatches
+    raise an attributed ``ShardLossError`` — the deferred-mode failure where
+    a device dies and its locally-accumulated shard of state dies with it.
+
+    ``fail_n=k`` (default 1) faults only the first k dispatches inside the
+    context, then passes calls through — the shape of a shard lost once and
+    recovered (``on_shard_loss="restore"`` reinstalls the host shadow and the
+    re-dispatch succeeds); ``None`` faults every dispatch (a world that stays
+    broken: even ``"restore"`` recovery re-raises). Composes with
+    :func:`preempt_after` / :func:`torn_write` / :func:`shrink_world` for the
+    kill-restore-resize chaos suite.
+    """
+    from torchmetrics_tpu.utils.exceptions import ShardLossError
+
+    orig = step._get
+    remaining = {"n": fail_n}
+
+    def patched(key: Any, builder: Any) -> Any:
+        fn = orig(key, builder)
+
+        def failing(*args: Any, **kwargs: Any) -> Any:
+            if remaining["n"] is not None and remaining["n"] <= 0:
+                return fn(*args, **kwargs)
+            if remaining["n"] is not None:
+                remaining["n"] -= 1
+            raise exc if exc is not None else ShardLossError(
+                f"injected loss of shard {shard} (device died mid-epoch)", shard=shard
+            )
+
+        return failing
+
+    step._get = patched
+    try:
+        yield
+    finally:
+        if step.__dict__.get("_get") is patched:
+            del step.__dict__["_get"]
+
+
+@contextmanager
+def _resized_world(to: int) -> Generator[Any, None, None]:
+    """Shared body of :func:`shrink_world`/:func:`grow_world`: patch the
+    checkpoint layer's world-topology probe to report ``to`` devices and
+    yield a Mesh over the first ``to`` local devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu.io import checkpoint as checkpoint_mod
+
+    devices = jax.devices()
+    if not 1 <= to <= len(devices):
+        raise ValueError(
+            f"resized world must fit the local device pool (1..{len(devices)}), got {to}"
+        )
+    orig = checkpoint_mod._world_topology
+
+    def patched() -> Dict[str, Any]:
+        out = dict(orig())
+        out["device_count"] = int(to)
+        return out
+
+    checkpoint_mod._world_topology = patched
+    try:
+        yield Mesh(np.array(devices[:to]), ("batch",))
+    finally:
+        checkpoint_mod._world_topology = orig
+
+
+@contextmanager
+def shrink_world(to: int) -> Generator[Any, None, None]:
+    """Simulate the job being rescheduled onto a SMALLER slice: snapshots
+    saved (and restores attempted) inside the context see a world of ``to``
+    devices, and the yielded ``Mesh`` spans exactly those devices — so a
+    checkpoint saved on the full mesh hits ``restore_state``'s topology gate
+    (``TopologyMismatchError`` under ``"strict"``, fold/reshard under
+    ``"elastic"``). Composes with :func:`preempt_after` (kill, then restore
+    into a shrunken world) and :func:`torn_write` (rotation fallback across
+    a topology change)."""
+    with _resized_world(to) as mesh:
+        yield mesh
+
+
+@contextmanager
+def grow_world(to: int) -> Generator[Any, None, None]:
+    """Simulate rescheduling onto a BIGGER slice (bounded by the local
+    device pool — under the 8-virtual-device test harness, up to 8). Same
+    seam as :func:`shrink_world`; the direction only matters to the test's
+    semantics."""
+    with _resized_world(to) as mesh:
+        yield mesh
 
 
 # --------------------------------------------------------------------- sync

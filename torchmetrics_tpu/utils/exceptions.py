@@ -53,6 +53,47 @@ class CheckpointCorruptionError(StateCorruptionError):
     """
 
 
+class TopologyMismatchError(StateCorruptionError):
+    """A snapshot's saved topology does not match the restoring world.
+
+    Raised by ``torchmetrics_tpu.io.checkpoint.restore_state(...,
+    topology="strict")`` when the manifest's topology block (device count,
+    shard layout, lane capacity — docs/DURABILITY.md "Elastic restore")
+    disagrees with the world the restore is running on: a stacked sharded
+    state saved on N devices cannot be reinstalled shard-for-shard on M≠N,
+    and a laned directory saved at one capacity cannot be installed verbatim
+    into another. A rotating-store scan treats it like a torn file — skip
+    with a breadcrumb, try the next older snapshot — and
+    ``topology="elastic"`` folds/reshards instead of raising (the
+    ``parallel/reshard.py`` seam). Carries ``saved`` and ``current``
+    topology descriptors for diagnostics.
+    """
+
+    def __init__(self, message: str, saved=None, current=None) -> None:
+        super().__init__(message)
+        self.saved = saved
+        self.current = current
+
+
+class ShardLossError(TorchMetricsUserError):
+    """A per-device shard of deferred (locally-accumulated) state is gone.
+
+    The deferred-reduction layout keeps unreduced state resident on each
+    device; a device/host failure mid-epoch takes that shard's accumulated
+    counts with it — the read point (or the next local step) surfaces the
+    loss as this error. ``DeferredCollectionStep``'s ``on_shard_loss``
+    policy decides what happens next: ``"raise"`` propagates, ``"degraded"``
+    serves the bounded-lag host shadow as a ``DegradedValue``, ``"restore"``
+    reinstalls the shadow via the reshard seam and continues
+    (docs/ROBUSTNESS.md "Shard loss"). ``testing/faults.drop_shard`` injects
+    it deterministically. Carries the (believed) lost ``shard`` index.
+    """
+
+    def __init__(self, message: str, shard=None) -> None:
+        super().__init__(message)
+        self.shard = shard
+
+
 class LaneFaultError(TorchMetricsUserError):
     """A fault attributed to ONE session's lane in a laned dispatch.
 
