@@ -1262,10 +1262,120 @@ def bench_config6():
         ref_val = 1.0 / _time_host(lambda: _binary_precision_recall_curve_update(rp, rt, thr), steps=5)
     except Exception:
         pass
+
+    # ---- per-kernel microbench rows (ISSUE 11): the megakernel pass ----
+    # fused-vs-unfused collection scatter: acc+confusion+stat-scores through
+    # one shared scatter-accumulate vs one counting pass per compute group.
+    # Gated via fused_collection_ratio_min in BASELINE.json.
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassStatScores,
+    )
+    from torchmetrics_tpu.ops import kernels as _kernels
+
+    cp = jnp.asarray(rng.randn(8192, 10).astype(np.float32))
+    ct = jnp.asarray(rng.randint(0, 10, 8192))
+
+    def _collection_rate(flag: str) -> float:
+        os.environ["TORCHMETRICS_TPU_FUSED_CLASSIFICATION"] = flag
+        _kernels.clear_shared_results()
+        coll = MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=10, validate_args=False),
+                MulticlassConfusionMatrix(num_classes=10, validate_args=False),
+                MulticlassStatScores(num_classes=10, validate_args=False),
+            ],
+            executor=False,
+        )
+        coll.resolve_compute_groups(cp, ct)
+        cstep = jax.jit(coll.functional_update)
+        st = coll.functional_init()
+        return 1.0 / _time_jax(lambda p, t: cstep(st, p, t), cp, ct, steps=30)
+
+    try:
+        fused_rate = _collection_rate("1")
+        unfused_rate = _collection_rate("0")
+        fused_ratio = round(fused_rate / unfused_rate, 3)
+    finally:
+        os.environ.pop("TORCHMETRICS_TPU_FUSED_CLASSIFICATION", None)
+
+    # fused retrieval top-k stats: precision+recall+fall-out+hit-rate from one
+    # sweep over the ranked grid vs the four pre-seam masked passes. Gated via
+    # topk_fused_ratio_min.
+    from torchmetrics_tpu.ops.topk_kernel import retrieval_topk_stats
+    from torchmetrics_tpu.utils.compute import _safe_divide
+
+    gt = jnp.asarray(rng.randint(0, 2, (4096, 256)).astype(np.float32))
+    gc = jnp.asarray(rng.randint(1, 257, 4096).astype(np.int32))
+
+    @jax.jit
+    def _topk_fused(t, c):
+        s = retrieval_topk_stats(t, c, 10)
+        return (
+            _safe_divide(s[:, 0], jnp.full_like(c, 10).astype(s.dtype)),
+            _safe_divide(s[:, 0], s[:, 1]),
+            _safe_divide(s[:, 2], s[:, 3]),
+            (s[:, 0] > 0).astype(jnp.float32),
+        )
+
+    # the unfused comparator mirrors the pre-seam reality: each padded metric
+    # evaluates at its own read point (a separate dispatch), rebuilding the
+    # masks — no cross-metric CSE, which is exactly what the shared-result
+    # memo buys back
+    def _mask(t, c):
+        pos = jnp.arange(t.shape[-1])[None, :]
+        return pos, (pos < jnp.minimum(10, c[:, None])).astype(t.dtype)
+
+    @jax.jit
+    def _u_precision(t, c):
+        _, mask = _mask(t, c)
+        return _safe_divide(jnp.sum(t * mask, axis=-1), jnp.full_like(c, 10).astype(t.dtype))
+
+    @jax.jit
+    def _u_recall(t, c):
+        _, mask = _mask(t, c)
+        return _safe_divide(jnp.sum(t * mask, axis=-1), jnp.sum(t, axis=-1))
+
+    @jax.jit
+    def _u_fallout(t, c):
+        pos, mask = _mask(t, c)
+        inv = jnp.where(pos < c[:, None], 1.0 - t, 0.0)
+        return _safe_divide(jnp.sum(inv * mask, axis=-1), jnp.sum(inv, axis=-1))
+
+    @jax.jit
+    def _u_hitrate(t, c):
+        _, mask = _mask(t, c)
+        return (jnp.sum(t * mask, axis=-1) > 0).astype(jnp.float32)
+
+    def _topk_unfused(t, c):
+        return (_u_precision(t, c), _u_recall(t, c), _u_fallout(t, c), _u_hitrate(t, c))
+
+    topk_fused_rate = 1.0 / _time_jax(_topk_fused, gt, gc, steps=30)
+    topk_unfused_rate = 1.0 / _time_jax(_topk_unfused, gt, gc, steps=30)
+
+    # SSIM windowed-stats trajectory row (ungated): on CPU both sides run the
+    # reference einsum pair, so this records the seam's steady rate; the
+    # Pallas win only shows on a TPU/GPU capture.
+    from torchmetrics_tpu.functional.image.ssim import _ssim_update
+
+    sp = jnp.asarray(rng.rand(4, 3, 128, 128).astype(np.float32))
+    st_img = jnp.asarray(rng.rand(4, 3, 128, 128).astype(np.float32))
+    ssim_step = jax.jit(lambda a, b: _ssim_update(a, b, data_range=1.0))
+    ssim_rate = 1.0 / _time_jax(ssim_step, sp, st_img, steps=10)
+
     return {
         "value": round(ours, 2),
         "unit": "steps/s (binned PR-curve update, N=1M, T=100, fused pallas kernel)",
         "vs_baseline": round(ours / ref_val, 3) if ref_val else None,
+        "fused_collection_ratio": fused_ratio,
+        "fused_collection_steps_per_s": round(fused_rate, 1),
+        "unfused_collection_steps_per_s": round(unfused_rate, 1),
+        "topk_fused_ratio": round(topk_fused_rate / topk_unfused_rate, 3),
+        "topk_fused_steps_per_s": round(topk_fused_rate, 1),
+        "ssim_window_steps_per_s": round(ssim_rate, 2),
+        "kernel_gates": _kernels.gate_snapshot(),
         **perf,
     }
 

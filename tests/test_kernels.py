@@ -1,0 +1,490 @@
+"""Kernel-layer suite (ISSUE 11): backend dispatch seam, interpret-mode
+parity, and the fused classification megakernel.
+
+Contracts proven here:
+
+- **Registry**: every registered kernel carries a TPU (Mosaic) body, a Triton
+  (GPU) lowering and a pure-XLA reference fallback; the static check in
+  tests/test_static_checks.py pins every ``pallas_call`` site to this
+  registry and this parity suite.
+- **Parity**: every Pallas body (both lowerings) runs ``interpret=True`` on
+  CPU against its reference body — exact for integer-count kernels, ulp-tight
+  for float contractions.
+- **Megakernel**: an accuracy + confusion-matrix + stat-scores collection
+  lands every accumulator from ONE scatter-accumulate launch
+  (jaxpr-verified, counter-verified) and is bit-exact vs the unfused path in
+  step AND deferred modes, plain AND laned — including sentinel/poison rows
+  diverted by the PR 8 device row screen inside the same dispatch.
+- **Cache partition**: the executor's persistent key pins backend/device
+  kind and the fused flag, so a Triton lowering (or an unfused A/B) can never
+  share a persisted executable with the Mosaic one.
+
+Runs on the 8-fake-device CPU mesh from conftest.py.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+from torchmetrics_tpu import Metric, MetricCollection, obs  # noqa: E402
+from torchmetrics_tpu.classification import (  # noqa: E402
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryStatScores,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassStatScores,
+    MultilabelAccuracy,
+    MultilabelConfusionMatrix,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.ops import fused_classification as fused  # noqa: E402
+from torchmetrics_tpu.ops import kernels  # noqa: E402
+from torchmetrics_tpu.ops.bincount import (  # noqa: E402
+    _wbincount_pallas,
+    _wbincount_reference,
+    _wbincount_triton,
+)
+from torchmetrics_tpu.ops.binned_curve import (  # noqa: E402
+    _binned_counts_pallas,
+    _binned_counts_searchsorted,
+    _binned_counts_triton,
+)
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+from torchmetrics_tpu.ops.ssim_kernel import _windowed_pallas, _windowed_reference  # noqa: E402
+from torchmetrics_tpu.ops.topk_kernel import (  # noqa: E402
+    _topk_stats_pallas,
+    _topk_stats_reference,
+    retrieval_topk_stats,
+)
+from torchmetrics_tpu.testing import faults  # noqa: E402
+
+NUM_CLASSES = 7
+BATCH = 96
+
+
+def _mc_batch(seed=0, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(batch, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, batch)),
+    )
+
+
+def _mc_collection(**kw):
+    kw.setdefault("executor", False)
+    return MetricCollection(
+        [
+            MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+            MulticlassStatScores(num_classes=NUM_CLASSES, validate_args=False),
+        ],
+        **kw,
+    )
+
+
+def _assert_tree_equal(a, b, msg=""):
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=f"{msg}{k}")
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistry:
+    def test_every_kernel_has_three_bodies(self):
+        reg = kernels.registered_kernels()
+        assert {"bincount", "binned_curve", "ssim_windows", "retrieval_topk_stats"} <= set(reg)
+        for name, spec in reg.items():
+            assert spec.reference is not None, name
+            assert spec.tpu is not None, f"{name}: no Mosaic body"
+            assert spec.triton is not None, f"{name}: no Triton lowering"
+
+    def test_resolve_backend_cpu_and_forced(self, monkeypatch):
+        assert kernels.resolve_backend() == "xla"  # CPU CI
+        monkeypatch.setenv(kernels.BACKEND_ENV, "triton")
+        assert kernels.resolve_backend() == "triton"
+        monkeypatch.setenv(kernels.BACKEND_ENV, "tpu")
+        assert kernels.resolve_backend() == "tpu"
+
+    def test_gate_min_n_and_extent_env_overrides(self, monkeypatch):
+        # force the tpu gate table without running Mosaic: min_n override is
+        # high, so the decision falls back to the reference body with the
+        # gate reason recorded — the bench's path-attribution contract
+        monkeypatch.setenv(kernels.BACKEND_ENV, "tpu")
+        monkeypatch.setenv(kernels.MIN_N_ENV, str(1 << 30))
+        kernels.reset_gate_log()
+        out = kernels.dispatch(
+            "bincount", jnp.asarray([0, 1, 1]), jnp.ones((1, 3)), 4, n=3, extent=4
+        )
+        assert out.shape == (1, 4)
+        gate = kernels.gate_snapshot()["bincount"]
+        assert gate["path"] == "xla" and "below min_n" in gate["reason"]
+
+        monkeypatch.delenv(kernels.MIN_N_ENV)
+        monkeypatch.setenv(kernels.MAX_EXTENT_ENV, "2")
+        kernels.reset_gate_log()
+        # n clears the registered min_n so only the extent gate can fire
+        kernels.dispatch("bincount", jnp.asarray([0, 1, 1]), jnp.ones((1, 3)), 4, n=1 << 20, extent=4)
+        gate = kernels.gate_snapshot()["bincount"]
+        assert gate["path"] == "xla" and "above max_extent" in gate["reason"]
+
+    def test_gate_log_rides_executor_status(self):
+        kernels.reset_gate_log()
+        m = MulticlassConfusionMatrix(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        status = m.executor_status["kernels"]
+        assert "bincount" in status
+        assert status["bincount"]["path"] == "xla"  # CPU CI: reference body
+        assert status["bincount"]["selections"]["xla"] >= 1
+
+    def test_kernel_counters_flow_to_obs(self):
+        before = obs.counters_snapshot().get("kernels.xla_fallbacks", 0)
+        kernels.dispatch("bincount", jnp.asarray([0, 1]), jnp.ones((1, 2)), 2, n=2, extent=2)
+        after = obs.counters_snapshot().get("kernels.xla_fallbacks", 0)
+        assert after == before + 1
+
+
+# ------------------------------------------------------------------ parity
+class TestInterpretParity:
+    """Every registered kernel body, interpret=True on CPU vs its reference."""
+
+    def test_bincount_mosaic_and_triton(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(-5, 300, 4000))  # includes out-of-range
+        w = jnp.asarray(rng.rand(3, 4000).astype(np.float32))
+        ref = _wbincount_reference(x, w, 290)
+        np.testing.assert_allclose(
+            np.asarray(_wbincount_pallas(x, w, 290, interpret=True)), np.asarray(ref), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(_wbincount_triton(x, w, 290, interpret=True)), np.asarray(ref), rtol=1e-5
+        )
+
+    def test_bincount_integer_counts_exact(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randint(0, 50, 3000))
+        w = jnp.ones((1, 3000), jnp.float32)
+        ref = _wbincount_reference(x, w, 50)
+        np.testing.assert_array_equal(
+            np.asarray(_wbincount_pallas(x, w, 50, interpret=True)), np.asarray(ref)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(_wbincount_triton(x, w, 50, interpret=True)), np.asarray(ref)
+        )
+
+    def test_binned_curve_mosaic_and_triton(self):
+        rng = np.random.RandomState(2)
+        p = jnp.asarray(rng.rand(3000).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 3000))
+        v = jnp.asarray((rng.rand(3000) > 0.1).astype(np.float32))
+        thr = jnp.linspace(0, 1, 37)
+        ref = _binned_counts_searchsorted(p, t, v, thr)
+        np.testing.assert_array_equal(
+            np.asarray(_binned_counts_pallas(p, t, v, thr, interpret=True)), np.asarray(ref)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(_binned_counts_triton(p, t, v, thr, interpret=True)), np.asarray(ref)
+        )
+
+    def test_ssim_windows(self):
+        rng = np.random.RandomState(3)
+        from torchmetrics_tpu.functional.image.utils import _band_matrix, _gaussian
+
+        x = jnp.asarray(rng.rand(10, 44, 52).astype(np.float32))
+        bh = _band_matrix(_gaussian(11, 1.5), 34)
+        bw = _band_matrix(_gaussian(11, 1.5), 42)
+        ref = _windowed_reference(x, bh, bw)
+        got = _windowed_pallas(x, bh, bw, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-6, atol=2e-6)
+
+    def test_retrieval_topk_stats(self):
+        rng = np.random.RandomState(4)
+        t = jnp.asarray(rng.randint(0, 2, (37, 53)).astype(np.float32))
+        c = jnp.asarray(rng.randint(1, 54, 37).astype(np.int32))
+        for k in (-1, 1, 5, 200):
+            ref = _topk_stats_reference(t, c, k)
+            got = _topk_stats_pallas(t, c, k, interpret=True)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(ref), err_msg=f"k={k}")
+
+    def test_topk_shared_result_memo(self):
+        rng = np.random.RandomState(5)
+        t = jnp.asarray(rng.randint(0, 2, (8, 16)).astype(np.float32))
+        c = jnp.full((8,), 16, jnp.int32)
+        before = obs.counters_snapshot().get("kernels.fused_reuses", 0)
+        a = retrieval_topk_stats(t, c, 3)
+        b = retrieval_topk_stats(t, c, 3)  # identical arrays -> memo hit
+        assert a is b
+        assert obs.counters_snapshot().get("kernels.fused_reuses", 0) == before + 1
+
+
+# ------------------------------------------------- fused classification core
+class TestMegakernelExactness:
+    """Bit-exact fused vs unfused for every task family and dispatch mode."""
+
+    def _run_pair(self, build, drive, monkeypatch):
+        values = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            kernels.clear_shared_results()
+            obj = build()
+            drive(obj)
+            values[flag] = obj.compute()
+        if isinstance(values["1"], dict):
+            _assert_tree_equal(values["1"], values["0"])
+        else:
+            np.testing.assert_array_equal(np.asarray(values["1"]), np.asarray(values["0"]))
+
+    @pytest.mark.parametrize("ignore_index", [None, 3])
+    def test_multiclass_family(self, monkeypatch, ignore_index):
+        preds, target = _mc_batch(7)
+
+        def build():
+            return MetricCollection(
+                [
+                    MulticlassAccuracy(num_classes=NUM_CLASSES, ignore_index=ignore_index, validate_args=False),
+                    MulticlassConfusionMatrix(num_classes=NUM_CLASSES, ignore_index=ignore_index, validate_args=False),
+                    MulticlassStatScores(num_classes=NUM_CLASSES, ignore_index=ignore_index, validate_args=False),
+                ],
+                executor=False,
+            )
+
+        self._run_pair(build, lambda c: [c.update(preds, target) for _ in range(3)], monkeypatch)
+
+    def test_binary_family(self, monkeypatch):
+        rng = np.random.RandomState(8)
+        preds = jnp.asarray(rng.rand(200).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 200))
+
+        def build():
+            return MetricCollection(
+                [BinaryAccuracy(validate_args=False), BinaryConfusionMatrix(validate_args=False), BinaryStatScores(validate_args=False)],
+                executor=False,
+            )
+
+        self._run_pair(build, lambda c: [c.update(preds, target) for _ in range(2)], monkeypatch)
+
+    def test_multilabel_family(self, monkeypatch):
+        rng = np.random.RandomState(9)
+        preds = jnp.asarray(rng.rand(100, 5).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, (100, 5)))
+
+        def build():
+            return MetricCollection(
+                [
+                    MultilabelAccuracy(num_labels=5, validate_args=False),
+                    MultilabelConfusionMatrix(num_labels=5, validate_args=False),
+                    MultilabelStatScores(num_labels=5, validate_args=False),
+                ],
+                executor=False,
+            )
+
+        self._run_pair(build, lambda c: [c.update(preds, target) for _ in range(2)], monkeypatch)
+
+    def test_executor_fused_dispatch(self, monkeypatch):
+        preds, target = _mc_batch(10)
+
+        def drive(coll):
+            for _ in range(3):
+                coll.update(preds, target)
+
+        self._run_pair(lambda: _mc_collection(executor=True), drive, monkeypatch)
+
+    def test_forward_batch_values(self, monkeypatch):
+        preds, target = _mc_batch(11)
+        out = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            kernels.clear_shared_results()
+            coll = _mc_collection(executor=True)
+            out[flag] = coll(preds, target)
+        _assert_tree_equal(out["1"], out["0"])
+
+    def test_samplewise_and_topk_stay_unfused(self, monkeypatch):
+        monkeypatch.setenv(fused.FUSED_ENV, "1")
+        assert not MulticlassStatScores(
+            num_classes=NUM_CLASSES, multidim_average="samplewise", validate_args=False
+        )._fused_active()
+        assert not MulticlassStatScores(
+            num_classes=NUM_CLASSES, top_k=2, validate_args=False
+        )._fused_active()
+        monkeypatch.setenv(fused.FUSED_ENV, "0")
+        assert not MulticlassStatScores(num_classes=NUM_CLASSES, validate_args=False)._fused_active()
+
+
+# ------------------------------------------- one-launch + counter verification
+class TestMegakernelFusion:
+    def test_one_scatter_in_fused_collection_jaxpr(self, monkeypatch):
+        """The compiled collection update contains exactly ONE
+        scatter-accumulate serving accuracy + confusion + stat-scores."""
+        preds, target = _mc_batch(12)
+
+        def scatters(flag):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            kernels.clear_shared_results()
+            coll = _mc_collection()
+            coll.resolve_compute_groups(preds, target)
+            jaxpr = str(jax.make_jaxpr(coll.functional_update)(coll.functional_init(), preds, target))
+            return jaxpr.count("scatter-add")
+
+        assert scatters("1") == 1
+        assert scatters("0") == 2  # one per counting group, unfused
+
+    def test_memo_counters_one_build_two_reuses(self, monkeypatch):
+        monkeypatch.setenv(fused.FUSED_ENV, "1")
+        preds, target = _mc_batch(13)
+        kernels.clear_shared_results()
+        coll = _mc_collection()
+        coll.resolve_compute_groups(preds, target)
+        before = obs.counters_snapshot()
+        jax.make_jaxpr(coll.functional_update)(coll.functional_init(), preds, target)
+        after = obs.counters_snapshot()
+        # 2 counting groups in one trace: 1 shared build + 1 reuse
+        assert after.get("kernels.fused_builds", 0) - before.get("kernels.fused_builds", 0) == 1
+        assert after.get("kernels.fused_reuses", 0) - before.get("kernels.fused_reuses", 0) == 1
+
+    def test_memo_rejects_different_arrays(self, monkeypatch):
+        monkeypatch.setenv(fused.FUSED_ENV, "1")
+        kernels.clear_shared_results()
+        p1, t1 = _mc_batch(14)
+        p2, t2 = _mc_batch(15)
+        a = fused.multiclass_confusion_counts(p1, t1, NUM_CLASSES, None)
+        b = fused.multiclass_confusion_counts(p2, t2, NUM_CLASSES, None)
+        assert a is not b
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_memo_keyed_on_config(self, monkeypatch):
+        monkeypatch.setenv(fused.FUSED_ENV, "1")
+        kernels.clear_shared_results()
+        p, t = _mc_batch(16)
+        a = fused.multiclass_confusion_counts(p, t, NUM_CLASSES, None)
+        b = fused.multiclass_confusion_counts(p, t, NUM_CLASSES, 3)  # different ignore_index
+        assert a is not b
+
+
+# -------------------------------------------------------- deferred + laned
+class TestMegakernelComposition:
+    """Fused counts under shard_map (deferred) and vmap (laned), composing
+    with the five reduction families and the PR 8 device row screen."""
+
+    NUM_DEVICES = 8
+
+    def _mesh(self):
+        return Mesh(np.array(jax.devices()[: self.NUM_DEVICES]), ("batch",))
+
+    def test_deferred_epoch_bit_exact(self, monkeypatch):
+        mesh = self._mesh()
+        batches = [_mc_batch(20 + i, batch=64) for i in range(3)]
+        vals = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            kernels.clear_shared_results()
+            coll = _mc_collection(reduce="deferred")
+            coll.resolve_compute_groups(*batches[0])
+            deferred = make_deferred_collection_step(coll, mesh, axis_name="batch")
+            st = deferred.init_states()
+            for lg, tg in batches:
+                st = deferred.local_step(
+                    st,
+                    jax.device_put(lg, NamedSharding(mesh, P("batch"))),
+                    jax.device_put(tg, NamedSharding(mesh, P("batch"))),
+                )
+            vals[flag] = deferred.reduce(st)
+        _assert_tree_equal(vals["1"], vals["0"], msg="deferred:")
+
+    def test_laned_all_families_bit_exact(self, monkeypatch):
+        """A laned collection mixing the fused classification family with
+        mean/max-reduced aggregator states: per-session values bit-exact
+        fused vs unfused (cat/list states take the eager lane loop and are
+        covered by the plain-mode tests)."""
+        from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric
+
+        def build():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+                    "conf": MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+                    "stat": MulticlassStatScores(num_classes=NUM_CLASSES, validate_args=False),
+                },
+                executor=False,
+            )
+
+        batches = {sid: _mc_batch(30 + i, batch=32) for i, sid in enumerate("abcd")}
+        vals = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            kernels.clear_shared_results()
+            laned = build().laned(capacity=8)
+            for _ in range(2):
+                laned.update_sessions([(sid, b) for sid, b in batches.items()])
+            vals[flag] = {sid: laned.compute_session(sid) for sid in batches}
+        for sid in batches:
+            _assert_tree_equal(vals["1"][sid], vals["0"][sid], msg=f"lane {sid}:")
+
+    def test_laned_poison_rows_through_fused_row_screen(self, monkeypatch):
+        """Sentinel/poison rows: one tenant ships NaN batches every round with
+        the device row screen active — its rows are diverted at the scatter
+        inside the same dispatch that runs the fused counts, and every OTHER
+        session's compute stays bit-exact vs a fault-free fused run."""
+        monkeypatch.setenv(fused.FUSED_ENV, "1")
+
+        def build():
+            return MetricCollection(
+                [
+                    MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+                    MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False),
+                    MulticlassStatScores(num_classes=NUM_CLASSES, validate_args=False),
+                ],
+                executor=False,
+            ).laned(capacity=8, on_lane_fault="quarantine")
+
+        batches = {sid: _mc_batch(40 + i, batch=32) for i, sid in enumerate("abcd")}
+
+        kernels.clear_shared_results()
+        clean = build()
+        for _ in range(3):
+            clean.update_sessions([(sid, b) for sid, b in batches.items() if sid != "a"])
+        clean_vals = {sid: clean.compute_session(sid) for sid in "bcd"}
+
+        kernels.clear_shared_results()
+        stormy = build()
+        with faults.poison_session(stormy, "a", mode="nan", frac=1.0):
+            for _ in range(3):
+                stormy.update_sessions([(sid, b) for sid, b in batches.items()])
+        for sid in "bcd":
+            _assert_tree_equal(stormy.compute_session(sid), clean_vals[sid], msg=f"lane {sid}:")
+
+
+# ------------------------------------------------------- cache-key partition
+class TestCacheKeyPartition:
+    def test_backend_fingerprint_partitions_key(self, monkeypatch):
+        """A Triton (GPU) lowering lands in its own persistent-cache
+        partition: the executor key embeds backend/device_kind."""
+        from torchmetrics_tpu.ops import compile_cache
+
+        m = MulticlassAccuracy(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        ex = m._get_executor()
+        key = ("u", None, (), None, None, ())
+        cpu_desc = ex._key_desc(key)
+        assert compile_cache.backend_fingerprint() in cpu_desc
+        monkeypatch.setattr(
+            compile_cache, "backend_fingerprint", lambda: "gpu/NVIDIA H100"
+        )
+        gpu_desc = ex._key_desc(key)
+        assert gpu_desc != cpu_desc and "gpu/NVIDIA H100" in gpu_desc
+
+    def test_fused_flag_partitions_key(self, monkeypatch):
+        """fused-on and fused-off traces can never share a persisted
+        executable: the flag rides _trace_config into the owner descriptor."""
+        descs = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv(fused.FUSED_ENV, flag)
+            coll = _mc_collection(executor=True)
+            coll.resolve_compute_groups(*_mc_batch(50))
+            descs[flag] = coll._get_executor()._owner_desc()
+        assert descs["1"] != descs["0"]
+        assert "fused=1" in descs["1"] and "fused=0" in descs["0"]

@@ -171,6 +171,30 @@ def check_bench(
                         " the async read submission path is taxing the step loop",
                     )
                 )
+        # megakernel gates (ISSUE 11): the fused classification collection and
+        # the fused retrieval top-k stats must keep beating their unfused
+        # counterparts — the whole point of the kernel pass. Floors live in
+        # BASELINE.json (fused_collection_ratio_min / topk_fused_ratio_min;
+        # default 1.0: fused strictly less work, a ratio under parity means
+        # the fusion seam itself regressed)
+        for ratio_key, floor_key, what in (
+            ("fused_collection_ratio", "fused_collection_ratio_min", "fused classification megakernel"),
+            ("topk_fused_ratio", "topk_fused_ratio_min", "fused retrieval top-k stats"),
+        ):
+            kratio = result.get(ratio_key)
+            if isinstance(kratio, (int, float)):
+                base = baselines.get(name, {})
+                floor = base.get(floor_key, 1.0) if isinstance(base, dict) else 1.0
+                if float(kratio) < float(floor):
+                    violations.append(
+                        Violation(
+                            name,
+                            float(kratio),
+                            threshold,
+                            f"{ratio_key} {kratio:.3f} below the {floor} floor — the"
+                            f" {what} is slower than the unfused path it replaces",
+                        )
+                    )
         agree = result.get("async_values_agree")
         if agree is False:
             violations.append(

@@ -42,6 +42,12 @@ HOT_PATH_FILES = (
     "ops/executor.py",
     "ops/compile_cache.py",
     "ops/async_read.py",
+    "ops/kernels.py",
+    "ops/fused_classification.py",
+    "ops/bincount.py",
+    "ops/binned_curve.py",
+    "ops/ssim_kernel.py",
+    "ops/topk_kernel.py",
     "parallel/sync.py",
     "parallel/reshard.py",
     "io/checkpoint.py",

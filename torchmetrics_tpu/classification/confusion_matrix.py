@@ -25,6 +25,7 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _multilabel_confusion_matrix_update,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops import fused_classification as _fused
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
@@ -63,9 +64,17 @@ class BinaryConfusionMatrix(Metric):
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
 
+    def _trace_config(self) -> tuple:
+        # fused flag keys the persisted executable (see _AbstractStatScores)
+        return super()._trace_config() + (f"fused={int(_fused.fused_enabled())}",)
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _binary_confusion_matrix_tensor_validation(preds, target, self.ignore_index)
+        if _fused.fused_enabled():
+            counts = _fused.binary_confusion_counts(preds, target, self.threshold, self.ignore_index)
+            self.confmat = self.confmat + counts.astype(jnp.int32)
+            return
         preds, target, valid = _binary_confusion_matrix_format(preds, target, self.threshold, self.ignore_index)
         self.confmat = self.confmat + _binary_confusion_matrix_update(preds, target, valid)
 
@@ -114,9 +123,17 @@ class MulticlassConfusionMatrix(Metric):
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
 
+    def _trace_config(self) -> tuple:
+        # fused flag keys the persisted executable (see _AbstractStatScores)
+        return super()._trace_config() + (f"fused={int(_fused.fused_enabled())}",)
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        if _fused.fused_enabled():
+            counts = _fused.multiclass_confusion_counts(preds, target, self.num_classes, self.ignore_index)
+            self.confmat = self.confmat + counts.astype(jnp.int32)
+            return
         preds, target, valid = _multiclass_confusion_matrix_format(preds, target, self.ignore_index)
         self.confmat = self.confmat + _multiclass_confusion_matrix_update(preds, target, valid, self.num_classes)
 
@@ -167,9 +184,19 @@ class MultilabelConfusionMatrix(Metric):
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
 
+    def _trace_config(self) -> tuple:
+        # fused flag keys the persisted executable (see _AbstractStatScores)
+        return super()._trace_config() + (f"fused={int(_fused.fused_enabled())}",)
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multilabel_confusion_matrix_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        if _fused.fused_enabled():
+            counts = _fused.multilabel_confusion_counts(
+                preds, target, self.num_labels, self.threshold, self.ignore_index
+            )
+            self.confmat = self.confmat + counts.astype(jnp.int32)
+            return
         preds, target, valid = _multilabel_confusion_matrix_format(
             preds, target, self.num_labels, self.threshold, self.ignore_index
         )

@@ -30,12 +30,32 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _multilabel_stat_scores_update,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.ops import fused_classification as _fused
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
 class _AbstractStatScores(Metric):
-    """Holds tp/fp/tn/fn states and the shared update plumbing."""
+    """Holds tp/fp/tn/fn states and the shared update plumbing.
+
+    Eligible configurations (``multidim_average="global"``, multiclass
+    ``top_k == 1``) derive their counts from the task's shared confusion-count
+    megakernel (ops/fused_classification.py): in a collection every
+    stat-scores-family group and the confusion matrix then land their
+    accumulators from ONE scatter-accumulate launch. Bit-exact vs the
+    per-metric path; ``TORCHMETRICS_TPU_FUSED_CLASSIFICATION=0`` restores it.
+    """
+
+    def _fused_active(self) -> bool:
+        """Whether this instance's update derives from the shared
+        confusion-count kernel (megakernel-eligible AND the flag is on)."""
+        return False
+
+    def _trace_config(self) -> tuple:
+        # the fused flag changes the traced computation while leaving the
+        # state layout unchanged: it must key the persisted executable, or an
+        # A/B across the flag would silently share one compiled artifact
+        return super()._trace_config() + (f"fused={int(self._fused_active())}",)
 
     def _create_state(self, size: int, multidim_average: str = "global") -> None:
         """Initialize states (reference classification/stat_scores.py:43-88)."""
@@ -106,11 +126,18 @@ class BinaryStatScores(_AbstractStatScores):
         self.validate_args = validate_args
         self._create_state(size=1, multidim_average=multidim_average)
 
+    def _fused_active(self) -> bool:
+        return _fused.fused_enabled() and self.multidim_average == "global"
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _binary_stat_scores_tensor_validation(preds, target, self.multidim_average, self.ignore_index)
-        preds, target, valid = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
-        tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, self.multidim_average)
+        if self._fused_active():
+            confmat = _fused.binary_confusion_counts(preds, target, self.threshold, self.ignore_index)
+            tp, fp, tn, fn = _fused.binary_stats(confmat)
+        else:
+            preds, target, valid = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+            tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
     def compute(self) -> Array:
@@ -157,16 +184,23 @@ class MulticlassStatScores(_AbstractStatScores):
         self.validate_args = validate_args
         self._create_state(size=1 if (average == "micro" and top_k == 1) else num_classes, multidim_average=multidim_average)
 
+    def _fused_active(self) -> bool:
+        return _fused.fused_enabled() and self.top_k == 1 and self.multidim_average == "global"
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multiclass_stat_scores_tensor_validation(
                 preds, target, self.num_classes, self.multidim_average, self.ignore_index
             )
-        if self.top_k == 1:
-            preds, target = _multiclass_stat_scores_format(preds, target, self.top_k)
-        tp, fp, tn, fn = _multiclass_stat_scores_update(
-            preds, target, self.num_classes, self.top_k, self.average, self.multidim_average, self.ignore_index
-        )
+        if self._fused_active():
+            confmat = _fused.multiclass_confusion_counts(preds, target, self.num_classes, self.ignore_index)
+            tp, fp, tn, fn = _fused.multiclass_stats(confmat)
+        else:
+            if self.top_k == 1:
+                preds, target = _multiclass_stat_scores_format(preds, target, self.top_k)
+            tp, fp, tn, fn = _multiclass_stat_scores_update(
+                preds, target, self.num_classes, self.top_k, self.average, self.multidim_average, self.ignore_index
+            )
         if self.average == "micro" and self.top_k == 1 and not isinstance(self._state["tp"], list):
             tp, fp, tn, fn = tp.sum(), fp.sum(), tn.sum(), fn.sum()
         self._update_state(tp, fp, tn, fn)
@@ -215,15 +249,24 @@ class MultilabelStatScores(_AbstractStatScores):
         self.validate_args = validate_args
         self._create_state(size=num_labels, multidim_average=multidim_average)
 
+    def _fused_active(self) -> bool:
+        return _fused.fused_enabled() and self.multidim_average == "global"
+
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multilabel_stat_scores_tensor_validation(
                 preds, target, self.num_labels, self.multidim_average, self.ignore_index
             )
-        preds, target, valid = _multilabel_stat_scores_format(
-            preds, target, self.num_labels, self.threshold, self.ignore_index
-        )
-        tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, self.multidim_average)
+        if self._fused_active():
+            confmat = _fused.multilabel_confusion_counts(
+                preds, target, self.num_labels, self.threshold, self.ignore_index
+            )
+            tp, fp, tn, fn = _fused.multilabel_stats(confmat)
+        else:
+            preds, target, valid = _multilabel_stat_scores_format(
+                preds, target, self.num_labels, self.threshold, self.ignore_index
+            )
+            tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
     def compute(self) -> Array:

@@ -682,11 +682,20 @@ class MetricCollection:
         }
 
     def functional_update(self, states: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
-        """Pure update: one leader ``functional_update`` per compute group."""
+        """Pure update: one leader ``functional_update`` per compute group.
+
+        The ``shared_scope`` makes this call the megakernel fusion unit: every
+        leader sees the same batch tracers, so classification-family groups
+        resolve their counting cores to ONE shared kernel result for the
+        duration of this call (ops/fused_classification.py); the scope pops
+        with the call, so traced intermediates never outlive their trace."""
+        from torchmetrics_tpu.ops.kernels import shared_scope
+
         out: Dict[str, Dict[str, Any]] = {}
-        for cg in self._groups.values():
-            m0 = self._modules[cg[0]]
-            out[cg[0]] = m0.functional_update(states[cg[0]], *args, **m0._filter_kwargs(**kwargs))
+        with shared_scope():
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                out[cg[0]] = m0.functional_update(states[cg[0]], *args, **m0._filter_kwargs(**kwargs))
         return out
 
     def functional_sync(
@@ -769,6 +778,7 @@ class MetricCollection:
         """Fused-executor diagnosis for the collection plus per-member status
         (see :attr:`Metric.executor_status`)."""
         from torchmetrics_tpu.ops.executor import executor_enabled_default, executor_stats
+        from torchmetrics_tpu.ops.kernels import gate_snapshot
 
         enabled = self._executor_enabled
         enabled = executor_enabled_default() if enabled is None else enabled
@@ -779,6 +789,9 @@ class MetricCollection:
             "fallback_reason": None if enabled is False else stats.get("fallback_reason"),
             "deferred_pending": any(m.deferred_pending for m in self._modules.values()),
             "stats": stats,
+            # last gate decision per backend-dispatched kernel (ISSUE 11);
+            # process-global, duplicated per member under members[...]
+            "kernels": gate_snapshot(),
             "members": {name: m.executor_status for name, m in self._modules.items()},
         }
 
@@ -879,9 +892,15 @@ class MetricCollection:
         updates already merged into ``states``) so ``"mean"``-reduced states
         merge count-weighted.
         """
+        from torchmetrics_tpu.ops.kernels import shared_scope
+
         new_states: Dict[str, Dict[str, Any]] = {}
         result: Dict[str, Any] = {}
         counts = (update_count, 1) if update_count is not None else None
+        with shared_scope():
+            return self._functional_forward_in_scope(states, new_states, result, counts, args, kwargs)
+
+    def _functional_forward_in_scope(self, states, new_states, result, counts, args, kwargs):
         for cg in self._groups.values():
             m0 = self._modules[cg[0]]
             if type(m0).functional_forward is not Metric.functional_forward:

@@ -66,11 +66,15 @@ def _separable_window_2d(x: Array, g_h: Array, g_w: Array) -> Array:
     wo = x.shape[3] - g_w.shape[0] + 1
     bh = _band_matrix(g_h.astype(x.dtype), ho)  # (Hp, Ho)
     bw = _band_matrix(g_w.astype(x.dtype), wo)  # (Wp, Wo)
-    # HIGHEST: the TPU MXU's default f32 einsum truncates operands to bf16,
-    # which is far too coarse for windowed moment statistics (E[x^2]-mu^2
-    # cancellation); full-precision passes keep metric values backend-stable.
-    out = jnp.einsum("nchw,hi->nciw", x, bh, precision=lax.Precision.HIGHEST)
-    return jnp.einsum("nciw,wj->ncij", out, bw, precision=lax.Precision.HIGHEST)
+    # the contraction pair runs through the ops/kernels.py seam: a fused
+    # VMEM-resident Pallas kernel on TPU/GPU, the einsum pair (full-precision
+    # passes — windowed moment statistics cannot survive bf16 truncation)
+    # as the reference body everywhere else
+    from torchmetrics_tpu.ops.ssim_kernel import windowed_sum_2d
+
+    n, c = x.shape[0], x.shape[1]
+    out = windowed_sum_2d(x.reshape(n * c, x.shape[2], x.shape[3]), bh, bw)
+    return out.reshape(n, c, ho, wo).astype(x.dtype)
 
 
 def _separable_window_3d(x: Array, g_d: Array, g_h: Array, g_w: Array) -> Array:

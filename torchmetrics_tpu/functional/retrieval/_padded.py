@@ -63,16 +63,25 @@ def _topk_mask(counts: Array, top_k: Optional[int], length: int) -> Array:
     return pos < k
 
 
+def _grid_stats(ranked_target: Array, counts: Array, top_k: Optional[int]) -> Array:
+    """(Q, 4) fused [hits@k, total_rel, inv_hits@k, total_inv] — one sweep
+    over the ranked grid through the ``"retrieval_topk_stats"`` kernel seam,
+    shared across every padded metric reading the same grid (ops/topk_kernel.py)."""
+    from torchmetrics_tpu.ops.topk_kernel import retrieval_topk_stats
+
+    return retrieval_topk_stats(ranked_target, counts, top_k)
+
+
 def hit_counts(ranked_target: Array, counts: Array, top_k: Optional[int]) -> Array:
     """Number of relevant docs retrieved in the top k of each query."""
-    return jnp.sum(ranked_target * _topk_mask(counts, top_k, ranked_target.shape[-1]), axis=-1)
+    return _grid_stats(ranked_target, counts, top_k)[:, 0]
 
 
 def precision_padded(
     ranked_target: Array, counts: Array, top_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Array:
     """Precision@k per query (reference functional/retrieval/precision.py)."""
-    hits = hit_counts(ranked_target, counts, top_k)
+    hits = _grid_stats(ranked_target, counts, top_k)[:, 0]
     if top_k is None:
         denom = counts
     elif adaptive_k:
@@ -84,24 +93,19 @@ def precision_padded(
 
 def recall_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
     """Recall@k per query (reference functional/retrieval/recall.py)."""
-    hits = hit_counts(ranked_target, counts, top_k)
-    total = jnp.sum(ranked_target, axis=-1)
-    return _safe_divide(hits, total)
+    stats = _grid_stats(ranked_target, counts, top_k)
+    return _safe_divide(stats[:, 0], stats[:, 1])
 
 
 def fall_out_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
     """Fall-out@k per query: non-relevant retrieved / all non-relevant."""
-    pos = jnp.arange(ranked_target.shape[-1])[None, :]
-    valid = pos < counts[:, None]
-    inv = jnp.where(valid, 1.0 - ranked_target, 0.0)
-    hits = jnp.sum(inv * _topk_mask(counts, top_k, ranked_target.shape[-1]), axis=-1)
-    total = jnp.sum(inv, axis=-1)
-    return _safe_divide(hits, total)
+    stats = _grid_stats(ranked_target, counts, top_k)
+    return _safe_divide(stats[:, 2], stats[:, 3])
 
 
 def hit_rate_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:
     """1.0 if any relevant doc in the top k (reference functional/retrieval/hit_rate.py)."""
-    return (hit_counts(ranked_target, counts, top_k) > 0).astype(jnp.float32)
+    return (_grid_stats(ranked_target, counts, top_k)[:, 0] > 0).astype(jnp.float32)
 
 
 def average_precision_padded(ranked_target: Array, counts: Array, top_k: Optional[int] = None) -> Array:

@@ -310,6 +310,7 @@ class Metric:
         when the executor stepped aside (also logged once at debug level).
         """
         from torchmetrics_tpu.ops.executor import executor_enabled_default, executor_stats
+        from torchmetrics_tpu.ops.kernels import gate_snapshot
 
         enabled = self.__dict__.get("_executor_enabled")
         enabled = executor_enabled_default() if enabled is None else enabled
@@ -323,6 +324,11 @@ class Metric:
             "deferred_pending": self.deferred_pending,
             "last_reduce_us": self.__dict__.get("_last_reduce_us"),
             "stats": stats,
+            # which body served each backend-dispatched kernel (ISSUE 11):
+            # the last gate decision + per-path selection counts, so a bench
+            # run can attribute its numbers to the path that actually ran.
+            # Process-global — kernel selection is per-process, not per-metric
+            "kernels": gate_snapshot(),
         }
 
     # -------------------------------------------------- compile-ahead surface

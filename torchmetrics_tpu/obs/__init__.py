@@ -36,6 +36,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_COMPUTE_ASYNC,
     SPAN_DISPATCH,
     SPAN_EXPORT,
+    SPAN_KERNEL,
     SPAN_LANES,
     SPAN_NAMES,
     SPAN_PAD,

@@ -78,6 +78,7 @@ SPAN_LANES = "tm_tpu.lanes.dispatch"       # lane-batched multi-session dispatch
 SPAN_QUARANTINE = "tm_tpu.lanes.quarantine"  # lane fault containment (rollback + quarantine)
 SPAN_COMPUTE_ASYNC = "tm_tpu.compute_async"  # async-read submission (caller-side half only)
 SPAN_RESHARD = "tm_tpu.reshard"            # elastic N->M re-split (restore / shard-loss recovery)
+SPAN_KERNEL = "tm_tpu.kernel"              # backend-dispatched Pallas/XLA kernel body (per kernel name)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -99,6 +100,7 @@ SPAN_NAMES = (
     SPAN_QUARANTINE,
     SPAN_COMPUTE_ASYNC,
     SPAN_RESHARD,
+    SPAN_KERNEL,
 )
 
 

@@ -1,11 +1,32 @@
-"""Pallas TPU kernels for the framework's hot ops.
+"""Pallas kernels for the framework's hot ops (docs/KERNELS.md).
 
 These sit below the functional layer: XLA already fuses most of the compute
 path well, so kernels live here only where a hand-tiled VMEM-resident loop
 beats the default lowering (SURVEY §7: "pallas kernels for the hot ops").
-Every kernel has an XLA fallback and is dispatched by backend + problem size.
+Every kernel registers THREE bodies in the ops/kernels.py backend dispatch
+seam — a Pallas→Mosaic TPU lowering, a Pallas→Triton GPU lowering, and the
+pure-XLA reference that doubles as the interpret-mode parity oracle — and is
+selected per process by backend + problem-size gates (env-overridable), with
+the decision recorded in the gate log behind ``executor_status["kernels"]``.
 """
 from torchmetrics_tpu.ops.bincount import weighted_bincount, weighted_bincount_multi  # noqa: F401
 from torchmetrics_tpu.ops.binned_curve import binned_curve_counts, binned_curve_counts_classwise  # noqa: F401
+from torchmetrics_tpu.ops.kernels import (  # noqa: F401
+    gate_snapshot,
+    registered_kernels,
+    resolve_backend,
+)
+from torchmetrics_tpu.ops.ssim_kernel import windowed_sum_2d  # noqa: F401
+from torchmetrics_tpu.ops.topk_kernel import retrieval_topk_stats  # noqa: F401
 
-__all__ = ["binned_curve_counts", "binned_curve_counts_classwise", "weighted_bincount", "weighted_bincount_multi"]
+__all__ = [
+    "binned_curve_counts",
+    "binned_curve_counts_classwise",
+    "gate_snapshot",
+    "registered_kernels",
+    "resolve_backend",
+    "retrieval_topk_stats",
+    "weighted_bincount",
+    "weighted_bincount_multi",
+    "windowed_sum_2d",
+]

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: fused weighted bincount (deterministic scatter-add).
+"""Pallas kernel: fused weighted bincount (deterministic scatter-add).
 
 The counting core of the classification stack — confusion matrices
 (``num_classes*target + preds`` flattened indices), binned PR-curve states and
@@ -8,12 +8,20 @@ stream against the bin axis and accumulates per-tile one-hot partial sums in
 VMEM — an embarrassingly parallel compare+reduce the VPU is built for, with a
 (TILE_N, TILE_C) working set that never leaves on-chip memory.
 
-Grid layout: ``(num_bin_tiles, num_index_tiles)`` with the index axis
-minormost, so each output tile stays resident in VMEM while every index tile
-streams past it (standard revisited-output reduction pattern).
+Two lowerings of the same tile body (registered as kernel ``"bincount"`` in
+the ops/kernels.py dispatch seam):
+
+- **Mosaic (TPU)**: grid ``(num_bin_tiles, num_index_tiles)`` with the index
+  axis minormost — each output tile stays VMEM-resident while every index
+  tile streams past it (the revisited-output reduction pattern, which relies
+  on the TPU grid being sequential).
+- **Triton (GPU)**: one program per bin tile, index tiles consumed by an
+  in-kernel ``fori_loop`` — Triton grids run concurrently, so the reduction
+  must live inside the program instead of across grid steps. Tile sizes are
+  provisional until a GPU capture tunes them.
 
 Out-of-range indices contribute nothing (they match no bin tile) — the same
-drop semantics as jnp's default scatter mode.
+drop semantics as the masked XLA reference body.
 """
 from __future__ import annotations
 
@@ -24,14 +32,24 @@ import jax.numpy as jnp
 from jax import Array
 from jax.experimental import pallas as pl
 
-TILE_N = 1024  # indices per step
+from torchmetrics_tpu.ops import kernels
+
+TILE_N = 1024  # indices per step (Mosaic)
 TILE_C = 512  # bins per output tile (multiple of 128 lanes)
+TRITON_TILE_N = 1024  # indices per loop iteration (Triton; provisional)
+TRITON_TILE_C = 128  # bins per program (Triton; provisional)
+
+
+def _onehot_partial(x: Array, w: Array, ci, tile_n: int, tile_c: int) -> Array:
+    """The shared tile body: one-hot the index tile against bin tile ``ci``
+    and contract all K weight rows against it in a single
+    (K, tile_n) @ (tile_n, tile_c) matmul on the MXU/tensor cores."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tile_n, tile_c), 1) + ci * tile_c
+    onehot = (x.reshape(tile_n, 1) == cols).astype(jnp.float32)
+    return jnp.dot(w, onehot, preferred_element_type=jnp.float32)
 
 
 def _wbincount_kernel(x_ref, w_ref, out_ref):
-    # multi-weight variant: K weight rows share one index stream; the one-hot
-    # tile is built once and contracted against all rows in a single
-    # (K, TILE_N) @ (TILE_N, TILE_C) matmul on the MXU
     ci = pl.program_id(0)
     ni = pl.program_id(1)
 
@@ -39,11 +57,7 @@ def _wbincount_kernel(x_ref, w_ref, out_ref):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    x = x_ref[:].reshape(TILE_N, 1)  # (TILE_N, 1) int32
-    w = w_ref[:]  # (K, TILE_N) f32
-    cols = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, TILE_C), 1) + ci * TILE_C
-    onehot = (x == cols).astype(jnp.float32)  # (TILE_N, TILE_C)
-    out_ref[:] += jnp.dot(w, onehot, preferred_element_type=jnp.float32)
+    out_ref[:] += _onehot_partial(x_ref[:], w_ref[:], ci, TILE_N, TILE_C)
 
 
 @functools.partial(jax.jit, static_argnames=("length", "interpret"))
@@ -73,23 +87,104 @@ def _wbincount_pallas(x: Array, weights: Array, length: int, interpret: bool = F
     return out[:k, :length]
 
 
+def _wbincount_kernel_triton(x_ref, w_ref, out_ref, *, num_n_tiles: int, k: int):
+    ci = pl.program_id(0)
+
+    def body(ni, acc):
+        x = x_ref[pl.ds(ni * TRITON_TILE_N, TRITON_TILE_N)]
+        w = w_ref[:, pl.ds(ni * TRITON_TILE_N, TRITON_TILE_N)]
+        return acc + _onehot_partial(x, w, ci, TRITON_TILE_N, TRITON_TILE_C)
+
+    out_ref[:] = jax.lax.fori_loop(
+        0, num_n_tiles, body, jnp.zeros((k, TRITON_TILE_C), jnp.float32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("length", "interpret"))
+def _wbincount_triton(x: Array, weights: Array, length: int, interpret: bool = False) -> Array:
+    """The Triton lowering: bin tiles across programs, index loop inside."""
+    k, n = weights.shape
+    n_pad = -n % TRITON_TILE_N
+    c_pad = -length % TRITON_TILE_C
+    x = jnp.pad(x.astype(jnp.int32), (0, n_pad), constant_values=-1)
+    w = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad)))
+    num_c_tiles = (length + c_pad) // TRITON_TILE_C
+    num_n_tiles = (n + n_pad) // TRITON_TILE_N
+
+    out = pl.pallas_call(
+        functools.partial(_wbincount_kernel_triton, num_n_tiles=num_n_tiles, k=k),
+        grid=(num_c_tiles,),
+        in_specs=[
+            pl.BlockSpec((n + n_pad,), lambda ci: (0,)),
+            pl.BlockSpec((k, n + n_pad), lambda ci: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((k, TRITON_TILE_C), lambda ci: (0, ci)),
+        out_shape=jax.ShapeDtypeStruct((k, num_c_tiles * TRITON_TILE_C), jnp.float32),
+        interpret=interpret,
+    )(x, w)
+    return out[:, :length]
+
+
+@functools.partial(jax.jit, static_argnames=("length", "checked"))
+def _wbincount_reference(x: Array, weights: Array, length: int, checked: bool = True) -> Array:
+    """Pure-XLA fallback and parity oracle: masked scatter-add.
+
+    ``checked=True`` drops out-of-range indices explicitly to match the
+    kernels (jnp's scatter wraps negatives numpy-style even under
+    mode="drop"); callers whose indices are in-range by construction (the
+    fused classification counts: targets zeroed, preds clipped) pass
+    ``checked=False`` and skip the mask. K==1 stays a 1-D scatter — the
+    batched (K, L) scatter lowers ~35% slower on CPU for the single-row case
+    that dominates the classification hot path."""
+    w = weights.astype(jnp.float32)
+    if checked:
+        in_range = (x >= 0) & (x < length)
+        x = jnp.where(in_range, x, 0)
+        w = jnp.where(in_range[None, :], w, 0.0)
+    if weights.shape[0] == 1:
+        return jnp.zeros(int(length), dtype=jnp.float32).at[x].add(w[0])[None, :]
+    return jnp.zeros((weights.shape[0], int(length)), dtype=jnp.float32).at[:, x].add(w)
+
+
+kernels.register_kernel(
+    kernels.KernelSpec(
+        name="bincount",
+        # the Pallas bodies drop out-of-range indices by construction (they
+        # match no bin tile), so ``checked`` only parameterizes the reference
+        reference=lambda x, w, length, interpret=False, checked=True: _wbincount_reference(
+            x, w, length, checked=checked
+        ),
+        tpu=lambda x, w, length, interpret=False, checked=True: _wbincount_pallas(
+            x, w, length, interpret=interpret
+        ),
+        triton=lambda x, w, length, interpret=False, checked=True: _wbincount_triton(
+            x, w, length, interpret=interpret
+        ),
+        # measured on v5e: 3-6.4x faster than XLA's serialized scatter for
+        # length <= 2048 at N >= 1e5-1e7, slower beyond ~4096 bins. The GPU
+        # row is a provisional estimate (Triton one-hot matmuls win earlier,
+        # shared memory caps the resident bin tile) pending a capture.
+        min_n={"tpu": 1 << 16, "triton": 1 << 15},
+        max_extent={"tpu": 2048, "triton": 4096},
+        doc="zeros(L).at[idx].add(w) over K weight rows sharing one index stream",
+    )
+)
+
+
 def weighted_bincount(
     x: Array,
     weights: Array | None = None,
     length: int = 0,
     interpret: bool = False,
-    min_pallas_n: int = 1 << 16,
-    max_pallas_length: int = 2048,
 ) -> Array:
-    """``zeros(length).at[x].add(weights)`` with a Pallas fast path on TPU.
+    """``zeros(length).at[x].add(weights)`` through the kernel dispatch seam.
 
-    The kernel does dense one-hot work (O(N·length)), so it is dispatched only
-    in the regime where that beats XLA's serialized scatter — measured on
-    v5e: 3-6.4x faster for length <= 2048 at N >= 1e5-1e7, slower beyond
-    ~4096 bins. Binned PR-curve states (4·T bins), calibration histograms and
-    small-to-medium confusion matrices all live in the winning regime.
-    Falls back to XLA's scatter-add off-TPU, for small N, or for large bin
-    counts. Returns float32 when weighted, int32 otherwise.
+    The backend (TPU Pallas / GPU Triton / XLA reference), the problem-size
+    gates and their env overrides (``TORCHMETRICS_TPU_PALLAS_MIN_N``,
+    ``TORCHMETRICS_TPU_PALLAS_MAX_EXTENT``) all live in ops/kernels.py; the
+    decision taken for each call is recorded in the gate log surfaced via
+    ``executor_status["kernels"]``. Returns float32 when weighted, int32
+    otherwise.
 
     Example:
         >>> import jax.numpy as jnp
@@ -103,24 +198,15 @@ def weighted_bincount(
     x = jnp.asarray(x).ravel()
     weighted = weights is not None
     w = jnp.asarray(weights).ravel() if weighted else jnp.ones(x.shape, dtype=jnp.float32)
-    # axon (the remote-TPU plugin) also registers its backend as "tpu", but
-    # accept both names defensively
-    use_pallas = interpret or (
-        jax.default_backend() in ("tpu", "axon")
-        and x.size >= min_pallas_n
-        and length <= max_pallas_length
-    )
-    if use_pallas:
-        out = _wbincount_pallas(x, w[None, :], int(length), interpret=interpret)[0]
-    else:
-        # drop out-of-range indices explicitly to match the kernel: jnp's
-        # scatter wraps negatives numpy-style even under mode="drop"
-        in_range = (x >= 0) & (x < length)
-        out = (
-            jnp.zeros(int(length), dtype=jnp.float32)
-            .at[jnp.where(in_range, x, 0)]
-            .add(jnp.where(in_range, w, 0.0))
-        )
+    out = kernels.dispatch(
+        "bincount",
+        x,
+        w[None, :],
+        int(length),
+        n=int(x.size),
+        extent=int(length),
+        interpret=interpret,
+    )[0]
     return out if weighted else out.astype(jnp.int32)
 
 
@@ -129,8 +215,6 @@ def weighted_bincount_multi(
     weights: Array,
     length: int,
     interpret: bool = False,
-    min_pallas_n: int = 1 << 16,
-    max_pallas_length: int = 2048,
 ) -> Array:
     """K weighted bincounts sharing one index stream: weights (K, N) -> (K, length).
 
@@ -142,14 +226,12 @@ def weighted_bincount_multi(
     weights = jnp.asarray(weights, dtype=jnp.float32)
     if weights.ndim != 2 or weights.shape[1] != x.shape[0]:
         raise ValueError(f"weights must be (K, N={x.shape[0]}), got {weights.shape}")
-    use_pallas = interpret or (
-        jax.default_backend() in ("tpu", "axon")
-        and x.size >= min_pallas_n
-        and length <= max_pallas_length
+    return kernels.dispatch(
+        "bincount",
+        x,
+        weights,
+        int(length),
+        n=int(x.size),
+        extent=int(length),
+        interpret=interpret,
     )
-    if use_pallas:
-        return _wbincount_pallas(x, weights, int(length), interpret=interpret)
-    in_range = (x >= 0) & (x < length)
-    xs = jnp.where(in_range, x, 0)
-    ws = jnp.where(in_range[None, :], weights, 0.0)
-    return jnp.zeros((weights.shape[0], int(length)), dtype=jnp.float32).at[:, xs].add(ws)

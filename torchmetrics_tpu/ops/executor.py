@@ -1789,10 +1789,21 @@ class CollectionExecutor(_ExecutorBase):
     # --------------------------------------------------------------- builders
     def _build_update(self, treedef, batched, bucket, padded, leader_specs, bool_spec, n_leaves, coll=None):
         # ``coll`` overrides the traced instance: background jobs pass a
-        # detached clone so tracing never swaps live member state
+        # detached clone so tracing never swaps live member state.
+        #
+        # Megakernel fusion (ISSUE 11) happens inside this trace for free:
+        # every leader's functional_update receives the SAME tracer objects
+        # for (args, kwargs), so classification-family leaders sharing a
+        # task config resolve their counting core to one shared_result hit
+        # (ops/fused_classification.py) — the compiled executable contains a
+        # single scatter-accumulate launch serving accuracy + confusion +
+        # stat-scores, and the padded-bucket row-0 subtraction below reuses
+        # the same shared kernel for its pad oracle.
         coll = coll if coll is not None else self._coll
 
         def raw(states, *rest):
+            from torchmetrics_tpu.ops.kernels import shared_scope
+
             if padded:
                 n_valid, dyn = rest[0], rest[1:]
                 extra = jnp.asarray(bucket, jnp.int32) - n_valid
@@ -1803,14 +1814,15 @@ class CollectionExecutor(_ExecutorBase):
             if extra is not None:
                 r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
             out = {}
-            for leader, kw_names, defaults in leader_specs:
-                m = coll._modules[leader]
-                fkw = {k: kwargs[k] for k in kw_names}
-                g = m.functional_update(states[leader], *args, **fkw)
-                if extra is not None:
-                    rkw = {k: r_kwargs[k] for k in kw_names}
-                    g = _subtract_pad_contribution(m, g, defaults, defaults, r_args, rkw, extra)
-                out[leader] = g
+            with shared_scope():  # one megakernel fusion unit per traced step
+                for leader, kw_names, defaults in leader_specs:
+                    m = coll._modules[leader]
+                    fkw = {k: kwargs[k] for k in kw_names}
+                    g = m.functional_update(states[leader], *args, **fkw)
+                    if extra is not None:
+                        rkw = {k: r_kwargs[k] for k in kw_names}
+                        g = _subtract_pad_contribution(m, g, defaults, defaults, r_args, rkw, extra)
+                    out[leader] = g
             return out
 
         return raw
@@ -1820,6 +1832,8 @@ class CollectionExecutor(_ExecutorBase):
         one = jnp.asarray(1, jnp.int32)
 
         def raw(states, counts, *rest):
+            from torchmetrics_tpu.ops.kernels import shared_scope
+
             if padded:
                 n_valid, dyn = rest[0], rest[1:]
                 extra = jnp.asarray(bucket, jnp.int32) - n_valid
@@ -1830,16 +1844,17 @@ class CollectionExecutor(_ExecutorBase):
             if extra is not None:
                 r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, _row0_leaves(leaves, batched))
             new_states, values = {}, {}
-            for leader, members, kw_names, defaults in leader_specs:
-                m = coll._modules[leader]
-                fkw = {k: kwargs[k] for k in kw_names}
-                bs = m.functional_update(defaults, *args, **fkw)
-                if extra is not None:
-                    rkw = {k: r_kwargs[k] for k in kw_names}
-                    bs = _subtract_pad_contribution(m, bs, defaults, defaults, r_args, rkw, extra)
-                new_states[leader] = m.merge_states(states[leader], bs, counts=(counts[leader], one))
-                for name in members:
-                    values[name] = coll._modules[name].functional_compute(bs)
+            with shared_scope():  # one megakernel fusion unit per traced step
+                for leader, members, kw_names, defaults in leader_specs:
+                    m = coll._modules[leader]
+                    fkw = {k: kwargs[k] for k in kw_names}
+                    bs = m.functional_update(defaults, *args, **fkw)
+                    if extra is not None:
+                        rkw = {k: r_kwargs[k] for k in kw_names}
+                        bs = _subtract_pad_contribution(m, bs, defaults, defaults, r_args, rkw, extra)
+                    new_states[leader] = m.merge_states(states[leader], bs, counts=(counts[leader], one))
+                    for name in members:
+                        values[name] = coll._modules[name].functional_compute(bs)
             return new_states, values
 
         return raw

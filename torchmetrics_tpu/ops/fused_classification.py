@@ -1,0 +1,198 @@
+"""Fused multi-metric scatter-accumulate: the classification megakernel.
+
+An accuracy + confusion-matrix + stat-scores collection shares ONE counting
+core: every accumulator any of them lands is a slice of the task's confusion
+counts. Unfused, each compute-group leader pays its own pass over
+``(preds, target)`` — a bincount scatter for the confusion matrix, a second
+identical scatter for tp/fp/tn/fn, masked boolean sums for the binary family
+— all inside the same compiled collection dispatch. This module collapses
+them: one shared confusion-count kernel per distinct ``(preds, target,
+task-config)``, with every metric deriving its state update from slices of
+that single result.
+
+Fusion mechanism (ops/kernels.py :func:`~torchmetrics_tpu.ops.kernels
+.shared_result`): within one trace, every compute-group leader receives the
+*same* tracer objects for the batch, so the first leader builds the counting
+kernel and the rest reuse its traced result — the compiled executable
+contains exactly ONE scatter-accumulate launch (jaxpr-verified in
+tests/test_kernels.py). The same identity memo serves the eager per-group
+loop, the deferred ``shard_map`` epoch step, and the laned ``vmap`` dispatch,
+where it composes with the PR 8 device row screen: the screen's predicate
+and sentinel scatter evaluate in the same compiled dispatch as the fused
+counts, so poisoned rows are diverted without a second pass.
+
+The counting kernel itself is the ``"bincount"`` kernel behind the backend
+dispatch seam: Pallas→Mosaic on TPU, Pallas→Triton on GPU, the masked XLA
+scatter elsewhere (and as the parity oracle).
+
+Exactness: counts are 0/1-weighted float32 sums — bit-exact integers up to
+2**24 events per update (the same bound the confusion-matrix scatter always
+had). Within that bound the fused path is bit-exact versus the unfused path
+for every derived state, fused on or off (``TORCHMETRICS_TPU_FUSED_CLASSIFICATION=0``
+restores the per-metric passes; the flag rides ``_trace_config()`` so the
+two can never share a persisted executable).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.ops import kernels
+
+#: master switch for the fused classification family (default on); the
+#: unfused path is the bit-exactness oracle and the A/B bench denominator
+FUSED_ENV = "TORCHMETRICS_TPU_FUSED_CLASSIFICATION"
+
+
+def fused_enabled() -> bool:
+    return os.environ.get(FUSED_ENV, "1").strip().lower() not in ("0", "false", "off")
+
+
+def _counts(idx: Array, w: Array, length: int) -> Array:
+    """One scatter-accumulate pass: ``zeros(length).at[idx].add(w)`` through
+    the backend-dispatched ``"bincount"`` kernel. ``checked=False``: every
+    family helper zeroes masked targets and clips preds, so indices are
+    in-range by construction and the reference body skips the drop mask."""
+    return kernels.dispatch(
+        "bincount", idx, w[None, :], length, n=int(idx.size), extent=int(length), checked=False
+    )[0]
+
+
+# ----------------------------------------------------------------- multiclass
+
+def multiclass_confusion_counts(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int]
+) -> Array:
+    """(C, C) float32 confusion counts, shared across every multiclass metric
+    tracing against the same ``(preds, target)``.
+
+    Format semantics replicate both class paths exactly: score preds argmax
+    over axis 1, everything flattened, ``ignore_index`` masked by weight,
+    masked targets zeroed, preds clipped into range.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    spec = ("mc", int(num_classes), ignore_index)
+
+    def build() -> Array:
+        with obs.device_span(obs.SPAN_KERNEL, suffix="fused_classification"):
+            p = preds.argmax(axis=1) if preds.ndim == target.ndim + 1 else preds
+            p = p.reshape(-1)
+            t = target.reshape(-1)
+            if ignore_index is not None:
+                w = (t != ignore_index).astype(jnp.float32)
+                t = jnp.where(t == ignore_index, 0, t)
+            else:
+                w = jnp.ones_like(t, dtype=jnp.float32)
+            t = t.astype(jnp.int32)
+            p = jnp.clip(p.astype(jnp.int32), 0, num_classes - 1)
+            idx = (num_classes * t + p).astype(jnp.int32)
+            return _counts(idx, w, num_classes * num_classes).reshape(num_classes, num_classes)
+
+    return kernels.shared_result((preds, target), spec, build)
+
+
+def multiclass_stats(confmat: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per-class (tp, fp, tn, fn) int32 from (C, C) counts — the exact
+    derivation the unfused stat-scores update performs on its own scatter."""
+    tp = jnp.diagonal(confmat)
+    fp = confmat.sum(0) - tp
+    fn = confmat.sum(1) - tp
+    tn = confmat.sum() - tp - fp - fn
+    return (
+        tp.astype(jnp.int32),
+        fp.astype(jnp.int32),
+        tn.astype(jnp.int32),
+        fn.astype(jnp.int32),
+    )
+
+
+# --------------------------------------------------------------------- binary
+
+def binary_confusion_counts(
+    preds: Array, target: Array, threshold: float, ignore_index: Optional[int]
+) -> Array:
+    """(2, 2) float32 confusion counts shared across the binary family."""
+    from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    spec = ("bin", float(threshold), ignore_index)
+
+    def build() -> Array:
+        with obs.device_span(obs.SPAN_KERNEL, suffix="fused_classification"):
+            p = preds.reshape(-1)
+            t = target.reshape(-1)
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                p = (_sigmoid_if_logits(p) > threshold).astype(jnp.int32)
+            else:
+                p = jnp.clip(p.astype(jnp.int32), 0, 1)
+            if ignore_index is not None:
+                valid = t != ignore_index
+                w = valid.astype(jnp.float32)
+                t = jnp.where(valid, t, 0)
+            else:
+                w = jnp.ones_like(t, dtype=jnp.float32)
+            idx = (t.astype(jnp.int32) * 2 + p).astype(jnp.int32)
+            return _counts(idx, w, 4).reshape(2, 2)
+
+    return kernels.shared_result((preds, target), spec, build)
+
+
+def binary_stats(confmat: Array) -> Tuple[Array, Array, Array, Array]:
+    """Scalar (tp, fp, tn, fn) int32 from the (2, 2) counts."""
+    return (
+        confmat[1, 1].astype(jnp.int32),
+        confmat[0, 1].astype(jnp.int32),
+        confmat[0, 0].astype(jnp.int32),
+        confmat[1, 0].astype(jnp.int32),
+    )
+
+
+# ----------------------------------------------------------------- multilabel
+
+def multilabel_confusion_counts(
+    preds: Array, target: Array, num_labels: int, threshold: float, ignore_index: Optional[int]
+) -> Array:
+    """(L, 2, 2) float32 per-label confusion counts shared across the
+    multilabel family."""
+    from torchmetrics_tpu.functional.classification.stat_scores import _sigmoid_if_logits
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    spec = ("ml", int(num_labels), float(threshold), ignore_index)
+
+    def build() -> Array:
+        with obs.device_span(obs.SPAN_KERNEL, suffix="fused_classification"):
+            p = preds
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                p = (_sigmoid_if_logits(p) > threshold).astype(jnp.int32)
+            p = jnp.moveaxis(p, 1, -1).reshape(-1, num_labels)
+            t = jnp.moveaxis(target, 1, -1).reshape(-1, num_labels)
+            if ignore_index is not None:
+                valid = t != ignore_index
+                w = valid.astype(jnp.float32)
+                t = jnp.where(valid, t, 0)
+                p = jnp.where(valid, p, 0)
+            else:
+                w = jnp.ones_like(t, dtype=jnp.float32)
+            p = jnp.clip(p.astype(jnp.int32), 0, 1)
+            label_idx = jnp.arange(num_labels)[None, :]
+            idx = (label_idx * 4 + t.astype(jnp.int32) * 2 + p).astype(jnp.int32)
+            return _counts(idx.reshape(-1), w.reshape(-1), num_labels * 4).reshape(num_labels, 2, 2)
+
+    return kernels.shared_result((preds, target), spec, build)
+
+
+def multilabel_stats(confmat: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per-label (tp, fp, tn, fn) int32 from the (L, 2, 2) counts."""
+    return (
+        confmat[:, 1, 1].astype(jnp.int32),
+        confmat[:, 0, 1].astype(jnp.int32),
+        confmat[:, 0, 0].astype(jnp.int32),
+        confmat[:, 1, 0].astype(jnp.int32),
+    )

@@ -1,0 +1,302 @@
+"""Backend dispatch seam for the Pallas kernel layer (docs/KERNELS.md).
+
+Every hand-written kernel in ``ops/`` registers here with THREE bodies:
+
+- ``tpu``:       the Pallas body lowered through Mosaic (TPU),
+- ``triton``:    the same Pallas body lowered through Pallas's Triton backend
+                 (GPU) — usually the identical ``pallas_call`` with
+                 GPU-friendly tile parameters,
+- ``reference``: the pure-XLA fallback, which is also the parity oracle every
+                 registered kernel is tested against in interpret mode
+                 (tests/test_kernels.py) and the body every other backend
+                 (CPU, METAL, ...) runs.
+
+:func:`dispatch` selects the body by the default JAX backend plus a
+backend-aware problem-size gate, so callers never hand-roll
+``jax.default_backend() == "tpu"`` checks again. The decision is recorded in
+a process-global gate log (surfaced through ``Metric.executor_status`` under
+``"kernels"`` and via ``gate_snapshot()``) and counted into the obs registry
+(``kernels.pallas_dispatches`` / ``kernels.triton_dispatches`` /
+``kernels.xla_fallbacks``) so a bench run can attribute which path actually
+served it. Under ``jit`` the selection happens at trace time — the counters
+count *selections* (one per compiled executable per kernel site), while eager
+call sites count once per call; both attribute the path, which is what the
+bench needs.
+
+The executor's persistent-cache key already pins ``backend/device_kind``
+(ops/compile_cache.py ``backend_fingerprint``), so a Triton lowering lands in
+its own disk-cache partition with zero new cache machinery — GPU is a new
+partition, not a new architecture (docs/EXECUTOR.md).
+
+Shared-intermediate memo: :func:`shared_result` lets several metrics in one
+trace (or one eager per-group loop) reuse a single kernel result computed
+from the *same* input arrays — the mechanism behind the fused classification
+megakernel (ops/fused_classification.py) and the fused retrieval top-k stats.
+Keys are identity-verified (``entry arrays are the call's arrays``), so stale
+tracers from a dead trace can never leak into a live one.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu import obs
+
+#: env override for the minimum problem size (elements of the streamed axis)
+#: below which every kernel falls back to its pure-XLA reference body
+MIN_N_ENV = "TORCHMETRICS_TPU_PALLAS_MIN_N"
+#: env override for the maximum output extent (bins / thresholds / window dim)
+#: above which the VMEM-resident tiling stops paying
+MAX_EXTENT_ENV = "TORCHMETRICS_TPU_PALLAS_MAX_EXTENT"
+#: force a backend: "tpu" | "triton" | "xla" | "auto" (default)
+BACKEND_ENV = "TORCHMETRICS_TPU_KERNEL_BACKEND"
+
+_COUNTER_BY_PATH = {
+    "tpu": "kernels.pallas_dispatches",
+    "triton": "kernels.triton_dispatches",
+    "xla": "kernels.xla_fallbacks",
+}
+
+
+@dataclass
+class KernelSpec:
+    """One registered kernel: three bodies plus per-backend gates.
+
+    ``min_n`` / ``max_extent`` map backend name → threshold; a backend absent
+    from the map uses the ``"default"`` entry. ``None`` disables the bound.
+    """
+
+    name: str
+    reference: Callable[..., Any]
+    tpu: Optional[Callable[..., Any]] = None
+    triton: Optional[Callable[..., Any]] = None
+    min_n: Dict[str, Optional[int]] = field(default_factory=dict)
+    max_extent: Dict[str, Optional[int]] = field(default_factory=dict)
+    doc: str = ""
+
+    def gate(self, backend: str, kind: str) -> Optional[int]:
+        table = self.min_n if kind == "min_n" else self.max_extent
+        if backend in table:
+            return table[backend]
+        return table.get("default")
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+_GATE_LOG: Dict[str, Dict[str, Any]] = {}
+_GATE_LOCK = threading.Lock()
+
+
+def register_kernel(spec: KernelSpec) -> KernelSpec:
+    """Register (or re-register) a kernel under ``spec.name``."""
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get_kernel(name: str) -> KernelSpec:
+    return _REGISTRY[name]
+
+
+def registered_kernels() -> Dict[str, KernelSpec]:
+    """Live registry view — the static pallas_call check and docs read this."""
+    return dict(_REGISTRY)
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def resolve_backend() -> str:
+    """The kernel backend the current process dispatches to.
+
+    ``"tpu"`` (Pallas→Mosaic) when the default backend is a TPU (axon — the
+    remote-TPU plugin — also registers as "tpu" but is matched by name
+    defensively), ``"triton"`` (Pallas→Triton) on GPU backends, ``"xla"``
+    (reference body) everywhere else. ``TORCHMETRICS_TPU_KERNEL_BACKEND``
+    forces a specific answer — useful to pin the reference body on a TPU for
+    an A/B, or to exercise the Triton gate table off-GPU.
+    """
+    forced = os.environ.get(BACKEND_ENV, "auto").strip().lower()
+    if forced in ("tpu", "triton", "xla"):
+        return forced
+    platform = jax.default_backend()
+    if platform in ("tpu", "axon"):
+        return "tpu"
+    if platform in ("gpu", "cuda", "rocm"):
+        return "triton"
+    return "xla"
+
+
+def _record_gate(name: str, decision: Dict[str, Any]) -> None:
+    with _GATE_LOCK:
+        entry = _GATE_LOG.setdefault(name, {"selections": {}})
+        entry.update(decision)
+        path = decision.get("path")
+        entry["selections"][path] = entry["selections"].get(path, 0) + 1
+
+
+def gate_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Last gate decision + per-path selection counts for every kernel that
+    has dispatched in this process — the bench's path-attribution record
+    (surfaced under ``executor_status["kernels"]``)."""
+    with _GATE_LOCK:
+        return {k: dict(v, selections=dict(v["selections"])) for k, v in _GATE_LOG.items()}
+
+
+def reset_gate_log() -> None:
+    with _GATE_LOCK:
+        _GATE_LOG.clear()
+
+
+def dispatch(
+    name: str,
+    *args: Any,
+    n: int,
+    extent: int = 0,
+    interpret: bool = False,
+    **kwargs: Any,
+) -> Any:
+    """Run kernel ``name`` through the backend-selected body.
+
+    ``n`` is the streamed problem size (elements swept), ``extent`` the
+    resident output extent (bins / thresholds / window edge) — both static
+    Python ints under jit, which is exactly when the gate must decide.
+    ``interpret=True`` forces the TPU Pallas body in interpreter mode (the
+    parity-suite hook); it bypasses the size gates so small test problems
+    still exercise the kernel body.
+    """
+    spec = _REGISTRY[name]
+    if interpret:
+        body, path, reason = spec.tpu, "tpu", "interpret"
+        kwargs["interpret"] = True
+    else:
+        backend = resolve_backend()
+        body, path, reason = spec.reference, "xla", f"backend={backend}"
+        if backend in ("tpu", "triton"):
+            candidate = spec.tpu if backend == "tpu" else spec.triton
+            min_n = spec.gate(backend, "min_n")
+            env_min = _env_int(MIN_N_ENV)
+            if env_min is not None:
+                min_n = env_min
+            max_extent = spec.gate(backend, "max_extent")
+            env_max = _env_int(MAX_EXTENT_ENV)
+            if env_max is not None:
+                max_extent = env_max
+            if candidate is None:
+                reason = f"no {backend} body"
+            elif min_n is not None and n < min_n:
+                reason = f"n={n} below min_n={min_n}"
+            elif max_extent is not None and extent > max_extent:
+                reason = f"extent={extent} above max_extent={max_extent}"
+            else:
+                body, path, reason = candidate, backend, "gates passed"
+    _record_gate(name, {"path": path, "reason": reason, "n": int(n), "extent": int(extent)})
+    obs.counter_inc(_COUNTER_BY_PATH[path])
+    with obs.device_span(obs.SPAN_KERNEL, suffix=name):
+        return body(*args, **kwargs)
+
+
+# ------------------------------------------------------ shared-result memo
+#
+# A tiny identity-keyed cache letting several metrics traced (or run eagerly)
+# against the SAME input arrays share one kernel result. A hit requires every
+# key array to `is`-match, so a reused Python id can never satisfy a lookup.
+#
+# Two stores, by input kind:
+#
+# - CONCRETE arrays memoize in a bounded process-global LRU (entries pin only
+#   arrays — cheap, and the eager per-group collection loop needs reuse to
+#   survive across member update calls).
+# - TRACERS memoize only inside an active :func:`shared_scope` frame, popped
+#   when the enclosing trace finishes. A tracer entry references its trace,
+#   which references the traced closure and (for executor builds) the metric
+#   itself — parking that in a process-global cache would pin dead metrics
+#   and their executors past GC (caught by the telemetry executor-release
+#   test). Without an active scope, tracer results are simply not memoized.
+#   The scope stack is thread-local: background-compile workers trace
+#   concurrently with the main thread.
+
+_MEMO_MAX = 16
+_MEMO: "OrderedDict[Tuple[Any, ...], Tuple[Tuple[Any, ...], Any]]" = OrderedDict()
+_MEMO_LOCK = threading.Lock()
+_SCOPES = threading.local()
+
+
+class shared_scope:
+    """One fusion scope: tracer-keyed shared results live exactly as long as
+    the ``with`` block (the collection trace / eager round) that opened it.
+    Nests; inner lookups see outer frames (an outer trace's tracer is valid
+    inside an inner one, the reverse never `is`-matches)."""
+
+    def __enter__(self) -> "shared_scope":
+        stack = getattr(_SCOPES, "stack", None)
+        if stack is None:
+            stack = _SCOPES.stack = []
+        stack.append({})
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _SCOPES.stack.pop()
+
+
+def _is_tracer(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def shared_result(arrays: Tuple[Any, ...], spec: Tuple[Any, ...], builder: Callable[[], Any]) -> Any:
+    """``builder()`` memoized on the identity of ``arrays`` + a config tuple.
+
+    The fusion primitive: inside one traced collection step every
+    compute-group leader receives the *same* tracer objects for
+    (preds, target), so the first leader builds the shared accumulator kernel
+    and the rest reuse its (traced) result — the compiled executable contains
+    ONE kernel launch. Eager per-group loops get the same saving with
+    concrete arrays through the LRU.
+    """
+    key = tuple(id(a) for a in arrays) + tuple(spec)
+    if any(_is_tracer(a) for a in arrays):
+        stack = getattr(_SCOPES, "stack", None)
+        if not stack:
+            obs.counter_inc("kernels.fused_builds")
+            return builder()
+        for frame in reversed(stack):
+            hit = frame.get(key)
+            if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+                obs.counter_inc("kernels.fused_reuses")
+                return hit[1]
+        value = builder()
+        stack[-1][key] = (tuple(arrays), value)
+        obs.counter_inc("kernels.fused_builds")
+        return value
+
+    with _MEMO_LOCK:
+        hit = _MEMO.get(key)
+        if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+            _MEMO.move_to_end(key)
+            obs.counter_inc("kernels.fused_reuses")
+            return hit[1]
+    value = builder()
+    with _MEMO_LOCK:
+        _MEMO[key] = (tuple(arrays), value)
+        _MEMO.move_to_end(key)
+        while len(_MEMO) > _MEMO_MAX:
+            _MEMO.popitem(last=False)
+    obs.counter_inc("kernels.fused_builds")
+    return value
+
+
+def clear_shared_results() -> None:
+    """Drop every memoized shared result (tests; never required for
+    correctness — identity verification already rejects stale entries)."""
+    with _MEMO_LOCK:
+        _MEMO.clear()

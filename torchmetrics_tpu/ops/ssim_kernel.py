@@ -1,0 +1,101 @@
+"""Pallas kernel: fused separable windowed statistics (the SSIM core).
+
+SSIM's windowed moments run the 5-stacked image batch (preds, target, preds²,
+target², preds·target) through a separable window — two banded-matrix GEMMs
+(functional/image/utils.py ``_separable_window_2d``). Stock lowering
+materialises the (M, Ho, Wp) intermediate between the H-pass and the W-pass
+in HBM; this kernel keeps one image's working set VMEM-resident and runs both
+contractions back-to-back per grid step, so the intermediate never leaves
+on-chip memory.
+
+Registered as kernel ``"ssim_windows"`` in the ops/kernels.py seam. The grid
+is embarrassingly parallel (one program per stacked image plane, each writing
+its own output block), so the SAME body serves the Mosaic (TPU) and Triton
+(GPU) lowerings — only the extent gates differ (VMEM vs shared-memory
+budgets). The reference body is the einsum pair the GEMM path always used,
+kept bit-identical for the off-accelerator dispatch.
+
+Float contractions: fused and reference paths agree to f32 matmul
+accumulation order, not bitwise — the parity suite bounds the difference at
+a few ulps (integer-count exactness is a classification-megakernel property,
+not an SSIM one).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from torchmetrics_tpu.ops import kernels
+
+
+def _window_kernel(x_ref, bh_ref, bw_ref, out_ref):
+    x = x_ref[0]  # (Hp, Wp)
+    # both contractions in VMEM; HIGHEST keeps full-f32 MXU passes — the
+    # E[x^2]-mu^2 cancellation downstream cannot survive bf16 truncation
+    tmp = jnp.dot(
+        bh_ref[:].T, x, preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST
+    )  # (Ho, Wp)
+    out_ref[0] = jnp.dot(
+        tmp, bw_ref[:], preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _windowed_pallas(x: Array, bh: Array, bw: Array, interpret: bool = False) -> Array:
+    """x (M, Hp, Wp), bh (Hp, Ho), bw (Wp, Wo) -> (M, Ho, Wo)."""
+    m, hp, wp = x.shape
+    ho, wo = bh.shape[1], bw.shape[1]
+    return pl.pallas_call(
+        _window_kernel,
+        grid=(m,),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hp, ho), lambda i: (0, 0)),
+            pl.BlockSpec((wp, wo), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, ho, wo), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, ho, wo), jnp.float32),
+        interpret=interpret,
+    )(x.astype(jnp.float32), bh.astype(jnp.float32), bw.astype(jnp.float32))
+
+
+@jax.jit
+def _windowed_reference(x: Array, bh: Array, bw: Array) -> Array:
+    """The einsum pair of the GEMM path, on the stacked (M, Hp, Wp) layout —
+    identical contraction order to the pre-seam ``_separable_window_2d``."""
+    out = jnp.einsum("mhw,hi->miw", x, bh.astype(x.dtype), precision=jax.lax.Precision.HIGHEST)
+    return jnp.einsum("miw,wj->mij", out, bw.astype(x.dtype), precision=jax.lax.Precision.HIGHEST)
+
+
+kernels.register_kernel(
+    kernels.KernelSpec(
+        name="ssim_windows",
+        reference=lambda x, bh, bw, interpret=False: _windowed_reference(x, bh, bw),
+        tpu=_windowed_pallas,
+        triton=_windowed_pallas,
+        # per-plane VMEM working set: x + intermediate + banded matrices;
+        # 512² f32 triple-buffers inside 16 MB. Triton's shared-memory budget
+        # caps the resident plane lower (provisional until a GPU capture).
+        min_n={"tpu": 1 << 18, "triton": 1 << 18},
+        max_extent={"tpu": 512, "triton": 256},
+        doc="fused separable banded-window contraction for SSIM moment stacks",
+    )
+)
+
+
+def windowed_sum_2d(x: Array, bh: Array, bw: Array, interpret: bool = False) -> Array:
+    """Separable windowed sum of a stacked (M, Hp, Wp) plane batch through the
+    kernel seam: ``x_padded @ banded(g_h) @ banded(g_w)`` per plane."""
+    return kernels.dispatch(
+        "ssim_windows",
+        x,
+        bh,
+        bw,
+        n=int(x.size),
+        extent=int(max(x.shape[1], x.shape[2])),
+        interpret=interpret,
+    )

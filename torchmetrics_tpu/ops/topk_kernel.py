@@ -1,0 +1,142 @@
+"""Pallas kernel: fused top-k retrieval statistics over the padded query grid.
+
+The padded retrieval design (functional/retrieval/_padded.py) evaluates every
+metric as masked reductions over one static ``(Q, L)`` ranked-target grid. A
+retrieval collection (precision@k + recall@k + fall-out@k + hit-rate@k) pays
+four separate masked passes over that grid; the four reductions share the
+same masks, so one fused sweep lands them all:
+
+    [hits@k, total_relevant, inverse_hits@k, total_inverse]  per query.
+
+Registered as kernel ``"retrieval_topk_stats"``. The grid is parallel over
+query tiles (each program writes its own rows), so one body serves both the
+Mosaic and Triton lowerings. The reference body is the exact jnp expressions
+the padded kernels always used; with 0/1 relevance the counts are exact
+integers in f32, so the fused path is bit-exact against it.
+
+The shared-result memo in ops/kernels.py deduplicates the sweep across
+metrics reading the same ranked grid in one trace (or one eager loop) —
+the same mechanism as the classification megakernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from torchmetrics_tpu.ops import kernels
+
+TILE_Q = 8  # query rows per program (f32 sublane alignment)
+_OUT_COLS = 128  # lane-aligned output row; 4 used
+
+
+def _topk_stats_kernel(t_ref, c_ref, out_ref, *, top_k: int):
+    t = t_ref[:]  # (TILE_Q, Lp)
+    c = c_ref[:].reshape(TILE_Q, 1)  # (TILE_Q, 1) int32
+    pos = jax.lax.broadcasted_iota(jnp.int32, t.shape, 1)
+    valid = (pos < c).astype(jnp.float32)
+    k = c if top_k < 0 else jnp.minimum(top_k, c)
+    mask = (pos < k).astype(jnp.float32)
+    inv = (1.0 - t) * valid
+    stats = jnp.stack(
+        [
+            (t * mask).sum(axis=1),  # hits in the top k (padding is 0-target)
+            t.sum(axis=1),  # total relevant
+            (inv * mask).sum(axis=1),  # non-relevant retrieved in the top k
+            inv.sum(axis=1),  # total non-relevant
+        ],
+        axis=1,
+    )  # (TILE_Q, 4)
+    out_ref[:] = jnp.pad(stats, ((0, 0), (0, _OUT_COLS - stats.shape[1])))
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "interpret"))
+def _topk_stats_pallas(
+    ranked_target: Array, counts: Array, top_k: int, interpret: bool = False
+) -> Array:
+    q, length = ranked_target.shape
+    q_pad = -q % TILE_Q
+    l_pad = -length % 128
+    t = jnp.pad(ranked_target.astype(jnp.float32), ((0, q_pad), (0, l_pad)))
+    c = jnp.pad(counts.astype(jnp.int32), (0, q_pad))  # pad count 0 -> all-invalid rows
+    num_q_tiles = (q + q_pad) // TILE_Q
+
+    out = pl.pallas_call(
+        functools.partial(_topk_stats_kernel, top_k=top_k),
+        grid=(num_q_tiles,),
+        in_specs=[
+            pl.BlockSpec((TILE_Q, length + l_pad), lambda qi: (qi, 0)),
+            pl.BlockSpec((TILE_Q,), lambda qi: (qi,)),
+        ],
+        out_specs=pl.BlockSpec((TILE_Q, _OUT_COLS), lambda qi: (qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((q + q_pad, _OUT_COLS), jnp.float32),
+        interpret=interpret,
+    )(t, c)
+    return out[:q, :4]
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def _topk_stats_reference(ranked_target: Array, counts: Array, top_k: int) -> Array:
+    """The padded kernels' exact jnp expressions, fused into one (Q, 4) row."""
+    t = ranked_target.astype(jnp.float32)
+    pos = jnp.arange(t.shape[-1])[None, :]
+    c = counts[:, None]
+    k = c if top_k < 0 else jnp.minimum(top_k, c)
+    mask = (pos < k).astype(t.dtype)
+    inv = jnp.where(pos < c, 1.0 - t, 0.0)
+    return jnp.stack(
+        [
+            jnp.sum(t * mask, axis=-1),
+            jnp.sum(t, axis=-1),
+            jnp.sum(inv * mask, axis=-1),
+            jnp.sum(inv, axis=-1),
+        ],
+        axis=1,
+    )
+
+
+kernels.register_kernel(
+    kernels.KernelSpec(
+        name="retrieval_topk_stats",
+        reference=lambda t, c, top_k, interpret=False: _topk_stats_reference(t, c, top_k),
+        tpu=_topk_stats_pallas,
+        triton=_topk_stats_pallas,
+        # one (TILE_Q, Lp) tile must sit resident; Lp caps at the VMEM /
+        # shared-memory budget (GPU row provisional until a capture)
+        min_n={"tpu": 1 << 16, "triton": 1 << 15},
+        max_extent={"tpu": 1 << 15, "triton": 1 << 13},
+        doc="per-query [hits@k, total_rel, inv_hits@k, total_inv] in one sweep",
+    )
+)
+
+
+def retrieval_topk_stats(
+    ranked_target: Array, counts: Array, top_k: Optional[int], interpret: bool = False
+) -> Array:
+    """(Q, 4) ``[hits@k, total_rel, inv_hits@k, total_inv]`` through the seam,
+    memoized on the identity of ``(ranked_target, counts)`` so every padded
+    retrieval metric reading the same grid in one trace shares one sweep.
+
+    ``top_k=None`` selects each query's full document list (the per-query
+    count), matching ``_topk_mask``.
+    """
+    ranked_target = jnp.asarray(ranked_target)
+    counts = jnp.asarray(counts)
+    k = -1 if top_k is None else int(top_k)
+
+    def build() -> Array:
+        return kernels.dispatch(
+            "retrieval_topk_stats",
+            ranked_target,
+            counts,
+            k,
+            n=int(ranked_target.size),
+            extent=int(ranked_target.shape[-1]),
+            interpret=interpret,
+        )
+
+    return kernels.shared_result((ranked_target, counts), ("topk", k), build)
