@@ -863,6 +863,121 @@ def bench_config2():
     async_read_ratio = per_oo_update / per_oo_async_parked if per_oo_async_parked else None
     async_submit_overhead_pct = 100.0 * (per_oo_async_parked - per_oo_update) / per_oo_update
 
+    # quantized-reduce rows (ISSUE 12): the sync_precision="quantized" policy
+    # over the one collective that matters. Two workloads:
+    #
+    # (a) the classification collection above — ALL its states are integer
+    #     (confmat/stat-scores counts), so under the quantized policy the
+    #     reduce must stay BIT-IDENTICAL (the integer-exactness half of the
+    #     values-agree tripwire);
+    # (b) a FID-shaped float-state sync (F=256 feature sums + F² covariance
+    #     sums, the "large float state" the EQuARX direction targets): exact
+    #     vs int8/int16 block-quantized rendezvous measured back-to-back on
+    #     the same mesh, bytes-on-wire computed analytically from the wire
+    #     format (codes = bits/8 per element, one f32 scale per 256-block).
+    #
+    # quantized_bytes_ratio_* is the FLOAT-STATE PAYLOAD ratio (f32 bytes /
+    # code bytes — exactly 4x at int8, 2x at int16); the per-block scales ride
+    # a separately recorded side channel (quantized_scale_overhead_pct,
+    # 4/block per element ≈ 1.6%). quantized_reduce_ratio is exact_us /
+    # quantized_us — on this CPU mesh the encode runs on the step core so the
+    # ratio sits below 1; on real hardware the encode trades against 4x less
+    # wire time (gate floor = VM evidence in BASELINE.json, re-anchor on TPU).
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+    from torchmetrics_tpu.parallel import quantized as _quant
+
+    QF = 256
+
+    def _fid(**kw):
+        return FrechetInceptionDistance(
+            feature_extractor=lambda x: x.mean(axis=(2, 3)),
+            num_features=QF,
+            executor=False,
+            **kw,
+        )
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        fid_e = _fid()
+        fid_q8 = _fid(sync_precision="quantized", sync_quant_bits=8)
+        fid_q16 = _fid(sync_precision="quantized", sync_quant_bits=16)
+        rngq = np.random.RandomState(7)
+        fid_state = {
+            k: (
+                jnp.asarray(rngq.randn(*np.shape(v)).astype(np.float32) * 3.0)
+                if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                else jnp.asarray(v) + 100
+            )
+            for k, v in fid_e.init_state().items()
+        }
+    fid_state = jax.device_put(fid_state, NamedSharding(mesh, P()))
+    fspec = {k: P() for k in fid_state}  # replicated: each shard ships the full state
+
+    def _sync_fn(m):
+        return jax.jit(_shard_map(lambda st: m.functional_sync(st, "data"), mesh, (fspec,), P()))
+
+    ex_fn, q8_fn, q16_fn = _sync_fn(fid_e), _sync_fn(fid_q8), _sync_fn(fid_q16)
+    out_e = jax.block_until_ready(ex_fn(fid_state))  # warm + the parity anchor
+    out_q8 = jax.block_until_ready(q8_fn(fid_state))
+    out_q16 = jax.block_until_ready(q16_fn(fid_state))
+    per_red_exact = _time_host(lambda: jax.block_until_ready(ex_fn(fid_state)), steps=10, warmup=1)
+    per_red_q8 = _time_host(lambda: jax.block_until_ready(q8_fn(fid_state)), steps=10, warmup=1)
+    per_red_q16 = _time_host(lambda: jax.block_until_ready(q16_fn(fid_state)), steps=10, warmup=1)
+
+    # values-agree tripwire, float half: every quantized field inside the
+    # documented per-block bound of exact (contributions = 8 identical
+    # replicas), integer fields bit-equal
+    qvalues_agree = True
+    for bits, out_q in ((8, out_q8), (16, out_q16)):
+        for k, v in out_e.items():
+            e_arr, q_arr = np.asarray(v), np.asarray(out_q[k])
+            if np.issubdtype(e_arr.dtype, np.floating):
+                stack = np.repeat(np.asarray(fid_state[k])[None], 8, axis=0)
+                bound = _quant.reduce_error_bound(stack, "sum", bits, fid_e.sync_quant_block)
+                if not (np.abs(e_arr.astype(np.float64) - q_arr.astype(np.float64)) <= bound + 1e-5).all():
+                    qvalues_agree = False
+            elif not np.array_equal(e_arr, q_arr):
+                qvalues_agree = False
+    # integer half: the classification collection (all-int states) must be
+    # bit-identical under the quantized policy
+    with jax.default_device(jax.devices("cpu")[0]):
+        coll_qint = MetricCollection(
+            {
+                "confmat": MulticlassConfusionMatrix(
+                    num_classes=NUM_CLASSES, validate_args=False, sync_precision="quantized"
+                ),
+                "acc": MulticlassAccuracy(
+                    num_classes=NUM_CLASSES, validate_args=False, sync_precision="quantized"
+                ),
+            }
+        )
+        coll_qint.resolve_compute_groups(
+            jnp.asarray(rngq.randn(8, NUM_CLASSES).astype(np.float32)),
+            jnp.asarray(rngq.randint(0, NUM_CLASSES, 8)),
+        )
+        states_qi = coll_qint.functional_init()
+
+    def _int_body(lg, tg):
+        st = coll_qint.functional_update(states_qi, lg, tg)
+        return coll_qint.functional_sync(st, "data")
+
+    int_q = jax.jit(_shard_map(_int_body, mesh, (P("data"), P("data")), P()))(logits, target)
+    st_ref = coll.functional_update(states0, jnp.asarray(np.asarray(logits)), jnp.asarray(np.asarray(target)))
+    for leader in int_q:
+        for fname, v in int_q[leader].items():
+            arr = np.asarray(v)
+            if not np.issubdtype(arr.dtype, np.floating):
+                # world-summed counts must equal 8x... the exact oracle is the
+                # unsynced single-device accumulation summed over the 8 shards
+                oracle = np.asarray(st_ref[leader][fname]) if leader in st_ref and fname in st_ref[leader] else None
+                if oracle is not None and not np.array_equal(arr, oracle):
+                    qvalues_agree = False
+
+    # analytic bytes-on-wire (parallel.quantized.state_wire_bytes)
+    wb_exact = _quant.state_wire_bytes(fid_state, fid_e._reductions)
+    wb_q8 = _quant.state_wire_bytes(fid_state, fid_e._reductions, qspecs=fid_q8._sync_qspecs())
+    wb_q16 = _quant.state_wire_bytes(fid_state, fid_e._reductions, qspecs=fid_q16._sync_qspecs())
+    float_exact_bytes = wb_exact["total"] - wb_q8["exact"]  # f32 payload of the quantizable fields
+
     ref_val = None
     try:
         _ref()
@@ -966,6 +1081,24 @@ def bench_config2():
         "shard_shadow_overhead_pct": round(shard_shadow_overhead_pct, 2),
         "shadow_epoch_us_per_step": round(per_epoch_shadow * 1e6, 1),
         "elastic_restore_ms": round(elastic_restore_ms, 2),
+        # quantized-reduce rows (ISSUE 12; docs/SHARDING.md "Quantized
+        # reduce"): bytes-on-wire is the analytic per-shard payload of one
+        # reduce of the FID-shaped float state (f32 vs int codes; the
+        # per-block f32 scales are the recorded side channel). Gate floors:
+        # int8 >= 4x, int16 >= 2x on the float payload;
+        # quantized_values_agree false fails outright; the latency ratio
+        # floor lives in BASELINE.json (CPU VM: encode shares the step core).
+        "quantized_bytes_exact": int(wb_exact["total"]),
+        "quantized_bytes_int8": int(wb_q8["total"]),
+        "quantized_bytes_int16": int(wb_q16["total"]),
+        "quantized_bytes_ratio_int8": round(float_exact_bytes / wb_q8["codes"], 3),
+        "quantized_bytes_ratio_int16": round(float_exact_bytes / wb_q16["codes"], 3),
+        "quantized_scale_overhead_pct": round(100.0 * wb_q8["scales"] / wb_q8["codes"], 2),
+        "quantized_reduce_exact_us": round(per_red_exact * 1e6, 1),
+        "quantized_reduce_int8_us": round(per_red_q8 * 1e6, 1),
+        "quantized_reduce_int16_us": round(per_red_q16 * 1e6, 1),
+        "quantized_reduce_ratio": round(per_red_exact / per_red_q8, 3),
+        "quantized_values_agree": bool(qvalues_agree),
     }
 
 

@@ -56,6 +56,7 @@ from torchmetrics_tpu.ops.binned_curve import (  # noqa: E402
     _binned_counts_triton,
 )
 from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+from torchmetrics_tpu.ops.sqrtm_kernel import _sqrtm_pallas, _sqrtm_reference, sqrtm_psd  # noqa: E402
 from torchmetrics_tpu.ops.ssim_kernel import _windowed_pallas, _windowed_reference  # noqa: E402
 from torchmetrics_tpu.ops.topk_kernel import (  # noqa: E402
     _topk_stats_pallas,
@@ -97,7 +98,7 @@ def _assert_tree_equal(a, b, msg=""):
 class TestRegistry:
     def test_every_kernel_has_three_bodies(self):
         reg = kernels.registered_kernels()
-        assert {"bincount", "binned_curve", "ssim_windows", "retrieval_topk_stats"} <= set(reg)
+        assert {"bincount", "binned_curve", "ssim_windows", "retrieval_topk_stats", "fid_sqrtm"} <= set(reg)
         for name, spec in reg.items():
             assert spec.reference is not None, name
             assert spec.tpu is not None, f"{name}: no Mosaic body"
@@ -209,6 +210,37 @@ class TestInterpretParity:
             ref = _topk_stats_reference(t, c, k)
             got = _topk_stats_pallas(t, c, k, interpret=True)
             np.testing.assert_array_equal(np.asarray(got), np.asarray(ref), err_msg=f"k={k}")
+
+    def test_fid_sqrtm(self):
+        """The "fid_sqrtm" Newton–Schulz body vs the exact eigh reference on a
+        covariance-shaped PSD input (ISSUE 12 satellite — the last PR 11
+        kernel leftover). The iteration is a documented approximation, so the
+        tolerance is looser than the exact-count kernels; sqrt(A) @ sqrt(A)
+        must also reconstruct A (the defining property, conditioning-robust)."""
+        rng = np.random.RandomState(6)
+        feats = rng.randn(200, 48).astype(np.float32)
+        sigma = jnp.asarray(np.cov(feats, rowvar=False).astype(np.float32))
+        ref = _sqrtm_reference(sigma)
+        got = _sqrtm_pallas(sigma, interpret=True)
+        scale = float(jnp.abs(ref).max())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-3 * scale)
+        recon = np.asarray(got) @ np.asarray(got)
+        np.testing.assert_allclose(recon, np.asarray(sigma), atol=5e-3 * float(jnp.abs(sigma).max()))
+        # the dispatch wrapper serves the exact reference on CPU (gate closed)
+        kernels.reset_gate_log()
+        out = sqrtm_psd(sigma)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6)
+        assert kernels.gate_snapshot()["fid_sqrtm"]["path"] == "xla"
+
+    def test_fid_sqrtm_rank_deficient_reference(self):
+        """The reference body stays NaN-free on the rank-deficient covariance
+        a small sample count produces (the regression eigh replaced NS for —
+        the gate keeps eigh wherever XLA serves)."""
+        rng = np.random.RandomState(7)
+        feats = rng.randn(3, 32).astype(np.float32)  # rank <= 2 covariance
+        sigma = jnp.asarray(np.cov(feats, rowvar=False).astype(np.float32))
+        out = np.asarray(sqrtm_psd(sigma))
+        assert np.isfinite(out).all()
 
     def test_topk_shared_result_memo(self):
         rng = np.random.RandomState(5)

@@ -282,6 +282,92 @@ def test_every_pallas_call_site_registered_with_fallback_and_parity():
     )
 
 
+def test_no_integer_state_reaches_quantized_encode():
+    """ISSUE 12 satellite: the integer-exactness guarantee of the
+    ``sync_precision="quantized"`` policy is enforced at BOTH layers, and this
+    check pins the guards so neither can silently rot:
+
+    - the encoder (``parallel/quantized.py block_encode``) refuses non-float
+      dtypes outright — no caller bug can ever round a count;
+    - policy resolution (``Metric._sync_qspecs``) never marks a non-float
+      array state quantized, even under a forced per-state override;
+    - the fused engine (``parallel/sync.py sync_states``) only routes a field
+      to the quantized group behind a ``jnp.issubdtype(..., floating)`` test.
+    """
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from torchmetrics_tpu import Metric
+    from torchmetrics_tpu.parallel.quantized import block_encode
+
+    # encoder-level guard fires on every integer/bool dtype
+    for dtype in (jnp.int8, jnp.int32, jnp.uint8, jnp.bool_):
+        with _pytest.raises(TypeError, match="integer-exact"):
+            block_encode(jnp.zeros(4, dtype), bits=8)
+
+    # resolution-level guard: a forced "quantized" override on an int state
+    # still resolves to the exact path
+    class _Counts(Metric):
+        def __init__(self):
+            super().__init__(executor=False, sync_precision="quantized")
+            self.add_state("hist", jnp.zeros(8, jnp.int32), dist_reduce_fx="sum", sync_precision="quantized")
+            self.add_state("f", jnp.zeros(8, jnp.float32), dist_reduce_fx="sum")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.hist
+
+    specs = _Counts()._sync_qspecs()
+    assert specs["hist"] is None and specs["f"] is not None
+
+    # source-level pins: the guards above must stay where the data flows
+    qsrc = (REPO / "torchmetrics_tpu" / "parallel" / "quantized.py").read_text()
+    assert "refusing to quantize non-float dtype" in qsrc
+    ssrc = (REPO / "torchmetrics_tpu" / "parallel" / "sync.py").read_text()
+    assert "jnp.issubdtype(arr.dtype, jnp.floating)" in ssrc
+
+
+def test_bench_regression_gate_quantized_rows():
+    """ISSUE 12 satellite: the config-2 quantized rows are gated — the
+    bytes-on-wire ratios must clear their floors (int8 >= 4x, int16 >= 2x on
+    float payload), a too-slow quantized reduce fails against the baseline
+    floor, and quantized_values_agree=false (the parity tripwire) fails
+    outright."""
+    checker = _load_tool("check_bench_regression")
+    base = {
+        "bench_baselines": {
+            "x_conf": {"value": 100.0, "quantized_reduce_ratio_min": 0.25},
+        }
+    }
+    good = {
+        "configs": {
+            "x_conf": {
+                "value": 100.0,
+                "quantized_bytes_ratio_int8": 4.0,
+                "quantized_bytes_ratio_int16": 2.0,
+                "quantized_reduce_ratio": 0.8,
+                "quantized_values_agree": True,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(good, base)
+    assert not violations
+
+    bad_bytes = {"configs": {"x_conf": {"value": 100.0, "quantized_bytes_ratio_int8": 3.5}}}
+    violations, _ = checker.check_bench(bad_bytes, base)
+    assert len(violations) == 1 and "quantized_bytes_ratio_int8" in violations[0].detail
+
+    slow = {"configs": {"x_conf": {"value": 100.0, "quantized_reduce_ratio": 0.1}}}
+    violations, _ = checker.check_bench(slow, base)
+    assert len(violations) == 1 and "quantized_reduce_ratio" in violations[0].detail
+
+    tripwire = {"configs": {"x_conf": {"value": 100.0, "quantized_values_agree": False}}}
+    violations, _ = checker.check_bench(tripwire, base)
+    assert len(violations) == 1 and "quantized_values_agree" in violations[0].detail
+
+
 def test_collectives_linter_catches_violations(tmp_path):
     """The linter actually fires: a synthetic update-stage function calling
     lax.psum must be flagged (guards against the rule rotting into a no-op)."""

@@ -195,6 +195,62 @@ def check_bench(
                             f" {what} is slower than the unfused path it replaces",
                         )
                     )
+        # quantized-reduce gates (ISSUE 12): a config reporting the
+        # sync_precision="quantized" rows is gated on (a) the payload
+        # bytes-on-wire ratios — the whole point of the wire format is int8 at
+        # 4x / int16 at 2x fewer bytes than f32 on float states (floors
+        # baseline-overridable; scales ride a separately recorded side
+        # channel), (b) the reduce-latency ratio vs the exact rendezvous
+        # (floor from BASELINE.json — the CPU VM runs the encode on the step
+        # core, real accelerators trade it against wire time), and (c) the
+        # values-agree tripwire: quantized outside the documented error bound
+        # of exact, or an integer state not bit-identical, fails outright.
+        for ratio_key, floor_key, default_floor, what in (
+            (
+                "quantized_bytes_ratio_int8",
+                "quantized_bytes_ratio_int8_min",
+                4.0,
+                "int8 float-state payload saving",
+            ),
+            (
+                "quantized_bytes_ratio_int16",
+                "quantized_bytes_ratio_int16_min",
+                2.0,
+                "int16 float-state payload saving",
+            ),
+            (
+                "quantized_reduce_ratio",
+                "quantized_reduce_ratio_min",
+                0.0,
+                "quantized-vs-exact reduce latency",
+            ),
+        ):
+            qval = result.get(ratio_key)
+            if isinstance(qval, (int, float)):
+                base = baselines.get(name, {})
+                floor = base.get(floor_key, default_floor) if isinstance(base, dict) else default_floor
+                if float(qval) < float(floor):
+                    violations.append(
+                        Violation(
+                            name,
+                            float(qval),
+                            threshold,
+                            f"{ratio_key} {qval:.3f} below the {floor} floor — the"
+                            f" {what} regressed (docs/SHARDING.md 'Quantized reduce')",
+                        )
+                    )
+        qagree = result.get("quantized_values_agree")
+        if qagree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "quantized_values_agree is false — the quantized reduce left the"
+                    " documented error bound (or an integer state was not bit-exact);"
+                    " the parity contract is hard, fail outright",
+                )
+            )
         agree = result.get("async_values_agree")
         if agree is False:
             violations.append(

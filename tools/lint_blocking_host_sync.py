@@ -49,6 +49,7 @@ HOT_PATH_FILES = (
     "ops/ssim_kernel.py",
     "ops/topk_kernel.py",
     "parallel/sync.py",
+    "parallel/quantized.py",
     "parallel/reshard.py",
     "io/checkpoint.py",
     "io/retry.py",
@@ -271,6 +272,27 @@ ALLOWLIST = {
         "checkpoint surface: folding the live sharded states + carried"
         " baseline into one canonical host pytree IS the save point (rare,"
         " never the step loop)"
+    ),
+    # --- quantized wire format: the traced collectives (block_encode /
+    #     quantized_all_reduce / quantized_all_gather) stay jnp-only and
+    #     unallowlisted; these are the HOST-side uplink/accounting surfaces
+    "parallel/quantized.py::reduce_error_bound": (
+        "property-test / parity oracle: computes the documented error bound"
+        " on host-fetched contributions, never on the dispatch path"
+    ),
+    "parallel/quantized.py::state_wire_bytes": (
+        "analytic bytes accounting from shapes/dtypes only — np used on"
+        " metadata, no device fetch on the value path (bench surface)"
+    ),
+    "parallel/quantized.py::encode_canonical": (
+        "uplink encode: runs on the already-host-side canonical fold"
+        " (export_canonical output) at ship points, never the step loop"
+    ),
+    "parallel/quantized.py::decode_canonical": (
+        "uplink decode: receiver-side host arithmetic on wire payloads"
+    ),
+    "parallel/quantized.py::wire_payload_bytes": (
+        "uplink accounting on host wire payloads (already np arrays)"
     ),
     "lanes.py::remap_capacity": (
         "elastic restore / live lane resharding: host gather/scatter of lane"

@@ -95,8 +95,9 @@ class BaseAggregator(Metric):
 
     def _trace_config(self) -> tuple:
         # nan_strategy changes the traced computation (neutral-mask vs float
-        # replacement vs passthrough) without moving the state spec
-        return (f"nan_strategy={self.nan_strategy}",)
+        # replacement vs passthrough) without moving the state spec; the base
+        # marker (sync_precision policy) rides along via super()
+        return super()._trace_config() + (f"nan_strategy={self.nan_strategy}",)
 
     def _executor_traceable(self) -> bool:
         """The "error"/"warn" nan strategies need concrete values — tracing the

@@ -744,7 +744,16 @@ class MetricCollection:
                 for field in states[leader]
                 if field != count_key
             }
-            synced = sync_states(flat, reds, axis)
+            # each leader's resolved sync_precision rides into the fused call:
+            # the qspec joins the group key inside sync_states, so a quantized
+            # member fuses only with same-(bits, block) peers and an exact
+            # member's psum arithmetic is never perturbed
+            qspecs = {
+                f"{leader}\x00{field}": spec
+                for leader in leaders
+                for field, spec in self._modules[leader]._sync_qspecs().items()
+            }
+            synced = sync_states(flat, reds, axis, qspecs=qspecs)
             for leader in leaders:
                 out[leader] = {
                     field: synced[f"{leader}\x00{field}"] for field in states[leader] if field != count_key

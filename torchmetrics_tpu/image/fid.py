@@ -27,14 +27,16 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
 
     The reference computes ``sum(sqrt(eigvals(sigma1 @ sigma2)))``; general
     (non-symmetric) eigendecomposition does not exist on TPU, so we use the
-    symmetric identity ``Tr sqrt(S1 S2) = Tr sqrt(S1^1/2 S2 S1^1/2)`` — two
-    ``eigh`` calls, TPU-supported and robust to the rank-deficient covariances
-    a small sample count produces (where a Newton–Schulz sqrtm iteration, the
-    previous implementation, returned NaN).
+    symmetric identity ``Tr sqrt(S1 S2) = Tr sqrt(S1^1/2 S2 S1^1/2)``. The
+    PSD square root routes through the ``"fid_sqrtm"`` kernel seam
+    (ops/sqrtm_kernel.py): the exact eigh body everywhere XLA serves — robust
+    to the rank-deficient covariances a small sample count produces — and an
+    in-VMEM Newton–Schulz iteration where the accelerator gate opens.
     """
+    from torchmetrics_tpu.ops.sqrtm_kernel import sqrtm_psd
+
     diff = mu1 - mu2
-    e1, v1 = jnp.linalg.eigh(sigma1)
-    s1h = (v1 * jnp.sqrt(jnp.clip(e1, 0.0, None))) @ v1.T  # sigma1^(1/2), PSD-projected
+    s1h = sqrtm_psd(sigma1)  # sigma1^(1/2), PSD-projected
     inner = s1h @ sigma2 @ s1h
     inner = 0.5 * (inner + inner.T)  # re-symmetrize float rounding
     tr_covmean = jnp.sqrt(jnp.clip(jnp.linalg.eigvalsh(inner), 0.0, None)).sum()

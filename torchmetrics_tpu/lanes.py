@@ -430,6 +430,12 @@ class LanedMetric(Metric):
             raise ValueError(f"LanedMetric wraps a Metric, got {type(inner).__name__}")
         if isinstance(inner, LanedMetric):
             raise ValueError("LanedMetric cannot wrap another LanedMetric")
+        # the wrapper's collectives ship the inner metric's states stacked on
+        # a lane axis: inherit the inner sync_precision policy (and wire
+        # format) unless the caller overrides it on the wrapper itself
+        kwargs.setdefault("sync_precision", inner.__dict__.get("sync_precision"))
+        kwargs.setdefault("sync_quant_bits", inner.__dict__.get("sync_quant_bits"))
+        kwargs.setdefault("sync_quant_block", inner.__dict__.get("sync_quant_block"))
         super().__init__(**kwargs)
         if int(capacity) < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -469,6 +475,7 @@ class LanedMetric(Metric):
                     name,
                     self._stacked_default(default, capacity),
                     dist_reduce_fx=inner._reductions[name],
+                    sync_precision=inner._sync_precisions.get(name),
                 )
             self.add_state("lane_updates", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="sum")
             self.add_state("lane_health", jnp.zeros((capacity,), jnp.int32), dist_reduce_fx="sum")
@@ -544,7 +551,7 @@ class LanedMetric(Metric):
         must never share a persisted executable with the guard-off trace —
         ``on_lane_fault`` is constructor-fixed, so the marker is stable for
         the instance's lifetime."""
-        cfg = tuple(self.inner._trace_config())
+        cfg = tuple(super()._trace_config()) + tuple(self.inner._trace_config())
         if self.__dict__["_guard"].active:
             cfg = cfg + ("lane_screen",)
         return cfg

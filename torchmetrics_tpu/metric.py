@@ -50,6 +50,12 @@ from torchmetrics_tpu.parallel.sync import (
     local_accumulate_spec,
     sync_states,
 )
+from torchmetrics_tpu.parallel.quantized import (
+    DEFAULT_BITS as _QUANT_DEFAULT_BITS,
+    DEFAULT_BLOCK as _QUANT_DEFAULT_BLOCK,
+    SYNC_PRECISIONS,
+    default_sync_precision,
+)
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.data import (
     _flatten,
@@ -127,6 +133,20 @@ class Metric:
               ``compute()``/``sync()`` time (docs/SHARDING.md). ``None``
               (default) follows the ``TORCHMETRICS_TPU_REDUCE`` env var
               (``"step"`` when unset).
+            - ``sync_precision``: what the in-trace collectives ship for this
+              metric's FLOAT states (docs/SHARDING.md "Quantized reduce"):
+              ``"exact"`` keeps full-precision psum/all_gather;
+              ``"quantized"`` moves int codes + per-block max-abs scales over
+              the wire (4×/2× fewer payload bytes at int8/int16) with a
+              documented error bound. Integer/bool states (counts, bincounts,
+              the reserved update count) are ALWAYS exact regardless.
+              Per-state overrides via ``add_state(..., sync_precision=...)``.
+              ``None`` (default) follows ``TORCHMETRICS_TPU_SYNC_PRECISION``
+              (``"exact"`` when unset).
+            - ``sync_quant_bits``: code width of the quantized wire format,
+              8 (int8, default) or 16 (int16).
+            - ``sync_quant_block``: elements per max-abs scale block
+              (default 256 — a 1.6 % f32-scale side channel).
 
     Example:
         >>> import jax.numpy as jnp
@@ -160,6 +180,9 @@ class Metric:
         self._defaults: Dict[str, Any] = {}
         self._reductions: Dict[str, Reduction] = {}
         self._persistent: Dict[str, bool] = {}
+        #: declared per-state sync_precision overrides (None = inherit the
+        #: metric-level policy); resolution happens in _sync_qspecs
+        self._sync_precisions: Dict[str, Optional[str]] = {}
 
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
         self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
@@ -207,6 +230,32 @@ class Metric:
                 "`reduce='deferred'` defers every collective to compute()/sync() and cannot"
                 " be combined with `dist_sync_on_step=True` (a per-step sync IS the step policy)"
             )
+        self.sync_precision = kwargs.pop("sync_precision", None)
+        if self.sync_precision is None:
+            self.sync_precision = default_sync_precision()
+        elif self.sync_precision not in SYNC_PRECISIONS:
+            raise ValueError(
+                f"Expected keyword argument `sync_precision` to be one of {SYNC_PRECISIONS}"
+                f" but got {self.sync_precision}"
+            )
+        self.sync_quant_bits = kwargs.pop("sync_quant_bits", None)
+        if self.sync_quant_bits is None:
+            self.sync_quant_bits = _QUANT_DEFAULT_BITS
+        elif self.sync_quant_bits not in (8, 16):
+            raise ValueError(
+                f"Expected keyword argument `sync_quant_bits` to be 8 or 16 but got {self.sync_quant_bits}"
+            )
+        self.sync_quant_block = kwargs.pop("sync_quant_block", None)
+        if self.sync_quant_block is None:
+            self.sync_quant_block = _QUANT_DEFAULT_BLOCK
+        elif (
+            not isinstance(self.sync_quant_block, int)
+            or isinstance(self.sync_quant_block, bool)
+            or self.sync_quant_block < 1
+        ):
+            raise ValueError(
+                f"Expected keyword argument `sync_quant_block` to be a positive int but got {self.sync_quant_block}"
+            )
         # deferred-reduction bookkeeping: _reduced is False while locally
         # accumulated state has a pending reduction; _pending_shards is the
         # shard count of an installed (stacked) sharded state awaiting a fold
@@ -247,6 +296,7 @@ class Metric:
         default: Union[Array, List],
         dist_reduce_fx: Reduction = None,
         persistent: bool = False,
+        sync_precision: Optional[str] = None,
     ) -> None:
         """Register a metric state (reference metric.py:195-278).
 
@@ -254,6 +304,12 @@ class Metric:
         list (growing accumulator). ``dist_reduce_fx`` in
         {"sum","mean","max","min","cat", None, callable} declares how the state
         merges across batches (forward), devices (mesh collectives) and hosts.
+
+        ``sync_precision`` overrides the metric-level policy for THIS state:
+        ``"exact"`` pins full-precision collectives, ``"quantized"`` opts a
+        float state into the block-quantized reduce, ``None`` (default)
+        inherits the metric policy. Integer/bool states are always exact no
+        matter what is declared here (docs/SHARDING.md "Quantized reduce").
         """
         if not isinstance(default, (list, int, float, np.ndarray, jnp.ndarray)) and not hasattr(default, "shape"):
             raise ValueError("state variable must be a jax array or an empty list")
@@ -261,6 +317,8 @@ class Metric:
             raise ValueError("state variable must be a jax array or an *empty* list (any data must be appended via update)")
         if dist_reduce_fx not in ("sum", "mean", "cat", "min", "max", None) and not callable(dist_reduce_fx):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if sync_precision is not None and sync_precision not in SYNC_PRECISIONS:
+            raise ValueError(f"`sync_precision` must be None or one of {SYNC_PRECISIONS}, got {sync_precision!r}")
         if isinstance(default, (int, float)):
             default = jnp.asarray(default)
         if not isinstance(default, list):
@@ -268,7 +326,37 @@ class Metric:
         self._defaults[name] = copy.deepcopy(default)
         self._reductions[name] = dist_reduce_fx
         self._persistent[name] = persistent
+        self._sync_precisions[name] = sync_precision
         self._state[name] = copy.deepcopy(default)
+
+    def _sync_qspecs(self) -> Dict[str, Optional[Tuple[int, int]]]:
+        """The RESOLVED per-state quantization policy: field name →
+        ``None`` (exact) or ``(bits, block)`` (block-quantized collective).
+
+        Resolution order: the ``add_state`` override, else the metric-level
+        ``sync_precision``. Non-float array states resolve to ``None``
+        unconditionally — the integer-exactness guarantee (counts, bincounts,
+        ``_update_count`` never round). List (growing) states resolve by
+        policy; the sync engine re-checks the concrete payload's dtype at
+        encode time, so an integer list still takes the exact path."""
+        d = self.__dict__
+        bits = d.get("sync_quant_bits", _QUANT_DEFAULT_BITS)
+        block = d.get("sync_quant_block", _QUANT_DEFAULT_BLOCK)
+        policy = d.get("sync_precision", "exact")
+        overrides = d.get("_sync_precisions", {})
+        out: Dict[str, Optional[Tuple[int, int]]] = {}
+        for name, default in self._defaults.items():
+            resolved = overrides.get(name) or policy
+            if resolved != "quantized":
+                out[name] = None
+                continue
+            if not isinstance(default, list) and not jnp.issubdtype(
+                jnp.asarray(default).dtype, jnp.floating
+            ):
+                out[name] = None  # integer-exact: counts never quantize
+                continue
+            out[name] = (int(bits), int(block))
+        return out
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
@@ -466,8 +554,18 @@ class Metric:
         changes the traced computation while leaving the state layout
         unchanged (an aggregator's ``nan_strategy``, a laned wrapper's
         device-side row screen) must be surfaced here or two differently-
-        configured instances could share a persisted executable."""
-        return ()
+        configured instances could share a persisted executable. Subclass
+        overrides extend ``super()._trace_config()`` — the base marker carries
+        the resolved ``sync_precision`` policy, so an exact and a quantized
+        instance can never share a compiled executable or a persisted cache
+        entry (the policy also joins the grouped-fusion group key in
+        ``parallel/sync.py``)."""
+        qfields = ",".join(
+            f"{name}:q{spec[0]}x{spec[1]}"
+            for name, spec in sorted(self._sync_qspecs().items())
+            if spec is not None
+        )
+        return (f"sync_precision={qfields}",) if qfields else ()
 
     def _state_snapshot(self) -> Dict[str, Any]:
         """Shallow pre-call snapshot for transactional rollback: jnp arrays are
@@ -841,7 +939,9 @@ class Metric:
                 if dist_sync_fn is not None:
                     self._state = {k: dist_sync_fn(v, self._reductions.get(k), axis_name) for k, v in self._state.items()}
                 elif in_trace:
-                    self._state = sync_states(self._state, self._reductions, axis_name)
+                    self._state = sync_states(
+                        self._state, self._reductions, axis_name, qspecs=self._sync_qspecs()
+                    )
                 else:  # multi-host, outside jit: bounded with a degradation policy
                     self._host_sync_bounded()
         except BaseException:
@@ -1536,7 +1636,7 @@ class Metric:
         if self.dist_sync_fn is not None:
             out = {k: self.dist_sync_fn(v, self._reductions.get(k), axis) for k, v in state.items()}
         else:
-            out = sync_states(state, self._reductions, axis)
+            out = sync_states(state, self._reductions, axis, qspecs=self._sync_qspecs())
         if count is not None:
             out[self._STATE_COUNT_KEY] = jax.lax.psum(jnp.asarray(count), axis)
         return out
@@ -1830,6 +1930,10 @@ class Metric:
         self.__dict__.setdefault("sync_retries", None)
         self.__dict__.setdefault("_last_sync_ok", True)
         self.__dict__.setdefault("reduce_policy", default_reduce_policy())
+        self.__dict__.setdefault("sync_precision", default_sync_precision())
+        self.__dict__.setdefault("sync_quant_bits", _QUANT_DEFAULT_BITS)
+        self.__dict__.setdefault("sync_quant_block", _QUANT_DEFAULT_BLOCK)
+        self.__dict__.setdefault("_sync_precisions", {k: None for k in self.__dict__.get("_defaults", {})})
         self.__dict__.setdefault("_reduced", True)
         self.__dict__.setdefault("_pending_shards", None)
         self.__dict__.setdefault("_last_reduce_us", None)

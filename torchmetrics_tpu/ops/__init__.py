@@ -16,6 +16,7 @@ from torchmetrics_tpu.ops.kernels import (  # noqa: F401
     registered_kernels,
     resolve_backend,
 )
+from torchmetrics_tpu.ops.sqrtm_kernel import sqrtm_psd  # noqa: F401
 from torchmetrics_tpu.ops.ssim_kernel import windowed_sum_2d  # noqa: F401
 from torchmetrics_tpu.ops.topk_kernel import retrieval_topk_stats  # noqa: F401
 
@@ -26,6 +27,7 @@ __all__ = [
     "registered_kernels",
     "resolve_backend",
     "retrieval_topk_stats",
+    "sqrtm_psd",
     "weighted_bincount",
     "weighted_bincount_multi",
     "windowed_sum_2d",

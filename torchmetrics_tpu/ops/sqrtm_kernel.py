@@ -1,0 +1,129 @@
+"""Pallas kernel: PSD matrix square root for the FID trace term.
+
+FID's compute is ``Tr sqrt(S1 S2)`` via the symmetric identity
+``sqrt(S1^1/2 S2 S1^1/2)`` (image/fid.py): the expensive half is the PSD
+square root ``S1^1/2`` of the F×F covariance (768² at the standard Inception
+tap). General eigendecomposition maps poorly onto the MXU; the Newton–Schulz
+coupled iteration is nothing but matmuls, so the whole solve fits ONE Pallas
+launch with Y/Z resident in VMEM:
+
+    Y_0 = A / c,  Z_0 = I,  c = ||A||_F
+    T_k = (3 I - Z_k Y_k) / 2
+    Y_{k+1} = Y_k T_k,   Z_{k+1} = T_k Z_k
+    sqrt(A) ≈ Y_K * sqrt(c)
+
+Registered as kernel ``"fid_sqrtm"``. The reference body is the eigh-based
+PSD-projected square root the FID compute always used (exact, and the parity
+oracle); the NS iteration is an APPROXIMATION (documented: ~1e-4 relative
+after 16 iterations on covariance-conditioned inputs), which is why the gate
+keeps the reference body everywhere until an accelerator capture justifies
+the trade. Padding to the 128-lane grid carries an identity block
+(``sqrt(diag(A, I)) = diag(sqrt(A), I)``), so the padded iteration is exact
+in the padded region and the slice-back loses nothing.
+
+The last named leftover of the PR 11 megakernel pass (ROADMAP "Kernel pass
+leftovers").
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from torchmetrics_tpu.ops import kernels
+
+#: Newton–Schulz iterations: 16 lands ~1e-4 relative on covariance-shaped
+#: spectra while staying a fixed, jit-static launch
+NS_ITERS = 16
+
+_LANE = 128
+
+
+def _eye(n: int, dtype=jnp.float32) -> Array:
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    return (rows == cols).astype(dtype)
+
+
+def _sqrtm_ns_kernel(a_ref, out_ref, *, iters: int):
+    a = a_ref[:].astype(jnp.float32)
+    n = a.shape[0]
+    eye = _eye(n)
+    c = jnp.maximum(jnp.sqrt(jnp.sum(a * a)), 1e-30)
+    y = a / c
+    z = eye
+
+    def body(_, yz):
+        y, z = yz
+        t = 0.5 * (3.0 * eye - jnp.dot(z, y, preferred_element_type=jnp.float32))
+        return (
+            jnp.dot(y, t, preferred_element_type=jnp.float32),
+            jnp.dot(t, z, preferred_element_type=jnp.float32),
+        )
+
+    y, _ = jax.lax.fori_loop(0, iters, body, (y, z))
+    out_ref[:] = y * jnp.sqrt(c)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sqrtm_pallas(sigma: Array, interpret: bool = False) -> Array:
+    f = sigma.shape[0]
+    pad = -f % _LANE
+    a = jnp.pad(sigma.astype(jnp.float32), ((0, pad), (0, pad)))
+    if pad:
+        # identity in the pad block: sqrt(diag(A, I)) = diag(sqrt(A), I), so
+        # the padded iteration stays exact and well-conditioned
+        idx = jnp.arange(f + pad)
+        pad_diag = jnp.where(idx >= f, 1.0, 0.0)
+        a = a + jnp.diag(pad_diag)
+    out = pl.pallas_call(
+        functools.partial(_sqrtm_ns_kernel, iters=NS_ITERS),
+        out_shape=jax.ShapeDtypeStruct((f + pad, f + pad), jnp.float32),
+        interpret=interpret,
+    )(a)
+    return out[:f, :f].astype(sigma.dtype)
+
+
+@jax.jit
+def _sqrtm_reference(sigma: Array) -> Array:
+    """The eigh-based PSD-projected square root (image/fid.py's original
+    expression — exact on every backend, and the parity oracle)."""
+    e, v = jnp.linalg.eigh(sigma)
+    return (v * jnp.sqrt(jnp.clip(e, 0.0, None))) @ v.T
+
+
+kernels.register_kernel(
+    kernels.KernelSpec(
+        name="fid_sqrtm",
+        reference=lambda sigma, interpret=False: _sqrtm_reference(sigma),
+        tpu=_sqrtm_pallas,
+        triton=_sqrtm_pallas,
+        # Y/Z/T triple must sit VMEM-resident: F=1024 → ~12.6 MB f32 working
+        # set. Both gate rows are PROVISIONAL estimates (no accelerator
+        # capture yet — ROADMAP "Kernel pass leftovers"); min_n keeps small
+        # covariances (fast exact eigh) off the iterative path
+        min_n={"tpu": 256 * 256, "triton": 256 * 256},
+        max_extent={"tpu": 1024, "triton": 1024},
+        doc="PSD matrix sqrt via in-VMEM Newton-Schulz (FID trace term)",
+    )
+)
+
+
+def sqrtm_psd(sigma: Array, interpret: bool = False) -> Array:
+    """``sigma^(1/2)`` for a symmetric PSD matrix through the backend seam.
+
+    ``n`` is the element count F², ``extent`` the matrix edge F — the gate
+    falls back to the exact eigh reference for small/huge problems and on
+    backends without a Pallas body (CPU always).
+    """
+    sigma = jnp.asarray(sigma)
+    return kernels.dispatch(
+        "fid_sqrtm",
+        sigma,
+        n=int(sigma.size),
+        extent=int(sigma.shape[-1]),
+        interpret=interpret,
+    )

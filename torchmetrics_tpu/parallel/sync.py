@@ -238,8 +238,20 @@ def sync_value(value: Any, reduction: Reduction, axis_name: Union[str, Sequence[
     return [out] if is_list else out
 
 
+def _nbytes_of(value: Any) -> int:
+    """Static payload bytes of one state value (lists sum their elements) —
+    trace-time metadata for the ``sync.bytes_on_wire`` counter."""
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes_of(v) for v in value)
+    arr = jnp.asarray(value)
+    return int(arr.size) * int(jnp.dtype(arr.dtype).itemsize)
+
+
 def sync_states(
-    states: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: Union[str, Sequence[str]]
+    states: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    axis_name: Union[str, Sequence[str]],
+    qspecs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Apply the declared collectives to every state field. Pure; safe under jit.
 
@@ -251,25 +263,82 @@ def sync_states(
     stat-scores tp/fp/tn/fn quartet syncs as a single psum of a 4-vector.
     Lists and ``cat``/callable/None reductions keep the per-field
     :func:`sync_value` path.
+
+    ``qspecs`` (``Metric._sync_qspecs()``) maps field names to their resolved
+    quantization spec: ``None`` = exact, ``(bits, block)`` = route through the
+    block-quantized collective (parallel/quantized.py). The spec JOINS the
+    fusion group key — quantized fields fuse only with same-``(bits, block)``
+    peers, never with exact ones, so one policy can never perturb the other's
+    arithmetic. Integer/bool fields always take the exact path regardless of
+    their spec (the encoder additionally refuses them, by construction).
+
+    Counter semantics (like the ops/kernels.py dispatch counters): under jit
+    this body runs at trace time, so ``sync.bytes_on_wire`` /
+    ``sync.quantized_reduces`` count per *traced* collective — one bump per
+    compiled executable per sync site, attributing which path (and payload
+    size) was built.
     """
+    from torchmetrics_tpu import obs  # deferred: see _gather_with_timeout
+    from torchmetrics_tpu.parallel import quantized as _q
+
     fused_ops = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}
     grouped: Dict[Any, List[Any]] = {}
+    qgrouped: Dict[Any, List[Any]] = {}
     out: Dict[str, Any] = {}
+    qspecs = qspecs or {}
     for name, value in states.items():
         fx = reductions.get(name)
+        q = qspecs.get(name)
         if fx in fused_ops and not isinstance(value, (list, tuple)):
             arr = jnp.asarray(value)
+            if q is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                qgrouped.setdefault((fx, arr.dtype, tuple(q)), []).append((name, arr))
+                continue
             if arr.dtype != jnp.bool_:
                 grouped.setdefault((fx, arr.dtype), []).append((name, arr))
                 continue
+        if q is not None and fx in ("cat", None) and not callable(fx):
+            # quantized gather for float cat/None states (growing accumulators)
+            payload = value
+            is_list = isinstance(payload, (list, tuple))
+            if not (is_list and len(payload) == 0):
+                if is_list:
+                    payload = jnp.concatenate([jnp.atleast_1d(v) for v in payload], axis=0)
+                payload = jnp.atleast_1d(jnp.asarray(payload))
+                if jnp.issubdtype(payload.dtype, jnp.floating):
+                    bits, block = q
+                    obs.counter_inc("sync.quantized_reduces")
+                    obs.counter_inc(
+                        "sync.bytes_on_wire",
+                        _q.quantized_wire_bytes(int(payload.size), bits, block)["total"],
+                    )
+                    gathered = _q.quantized_all_gather(payload, axis_name, bits=bits, block_size=block)
+                    res = gathered.reshape((-1,) + gathered.shape[2:]) if fx == "cat" else gathered
+                    out[name] = [res] if is_list else res
+                    continue
         out[name] = sync_value(value, fx, axis_name)
+        obs.counter_inc("sync.bytes_on_wire", _nbytes_of(value))
     for (fx, _), items in grouped.items():
+        obs.counter_inc("sync.bytes_on_wire", sum(_nbytes_of(arr) for _, arr in items))
         if len(items) == 1:
             name, arr = items[0]
             out[name] = fused_ops[fx](arr, axis_name)
             continue
         flat = jnp.concatenate([arr.ravel() for _, arr in items])
         reduced = fused_ops[fx](flat, axis_name)
+        offsets = np.cumsum([arr.size for _, arr in items])[:-1]
+        for (name, arr), part in zip(items, jnp.split(reduced, offsets)):
+            out[name] = part.reshape(arr.shape)
+    for (fx, _, (bits, block)), items in qgrouped.items():
+        # the quantized analogue of the fused psum: ONE concat-ravel, ONE
+        # block-encode, one gather of codes + scales per (reduction, dtype,
+        # bits, block) group, dequantize-and-accumulate, split back
+        flat = items[0][1].ravel() if len(items) == 1 else jnp.concatenate([arr.ravel() for _, arr in items])
+        obs.counter_inc("sync.quantized_reduces")
+        obs.counter_inc(
+            "sync.bytes_on_wire", _q.quantized_wire_bytes(int(flat.size), bits, block)["total"]
+        )
+        reduced = _q.quantized_all_reduce(flat, axis_name, reduction=fx, bits=bits, block_size=block)
         offsets = np.cumsum([arr.size for _, arr in items])[:-1]
         for (name, arr), part in zip(items, jnp.split(reduced, offsets)):
             out[name] = part.reshape(arr.shape)
@@ -409,7 +478,10 @@ def reshard_local_state(state: Any) -> Any:
 
 
 def reduce_sharded_states(
-    states: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: Union[str, Sequence[str]]
+    states: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    axis_name: Union[str, Sequence[str]],
+    qspecs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The deferred-reduction read point: apply every declared ``dist_reduce_fx``
     exactly once over locally-accumulated shards.
@@ -420,12 +492,14 @@ def reduce_sharded_states(
     :func:`sync_states` — so all sum-family fields of a metric (or, via
     ``MetricCollection.functional_sync``, a whole collection) still share ONE
     fused collective rendezvous. Returns replicated (reduced) states without
-    the shard axis.
+    the shard axis. ``qspecs`` routes marked float fields through the
+    block-quantized collective (``sync_precision="quantized"``); integer
+    fields stay exact regardless.
     """
     from torchmetrics_tpu import obs  # deferred: see _gather_with_timeout
 
     with obs.device_span(obs.SPAN_REDUCE):
-        return sync_states(unshard_local_state(states), reductions, axis_name)
+        return sync_states(unshard_local_state(states), reductions, axis_name, qspecs=qspecs)
 
 
 def fold_sharded_states(states: Dict[str, Any], reductions: Dict[str, Reduction]) -> Dict[str, Any]:
