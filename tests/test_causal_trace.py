@@ -1,0 +1,599 @@
+"""Causal tracing + flight recorder + histogram suite (ISSUE 13).
+
+Three acceptance surfaces:
+
+- **Cross-thread trace integrity**: a ``compute_async()`` under tracing
+  produces a caller-half span and a worker-replay span sharing ONE
+  ``trace_id``, connected by a valid Perfetto flow-event pair (``ph:"s"``
+  bound inside the submitting slice on the submitting thread, ``ph:"f"`` at
+  the worker span with a matching ``id``) — proven for all four async
+  domains: async read, background compile, autosave, shard-shadow refresh.
+- **Fault flight recorder**: every typed fault injected via
+  ``testing/faults.py`` (ShardLossError, LaneFaultError, SyncTimeoutError,
+  StateCorruptionError/CheckpointCorruptionError, DispatchStallError) leaves
+  a breadcrumb whose ``flight`` blob carries the faulting window's spans and
+  counter deltas; the watchdog's fatal path persists the recorder to disk.
+- **Histogram instruments**: async read end-to-end latency + queue wait,
+  dispatch duration, and DegradedValue staleness-age land in fixed-bucket
+  registry histograms exposed in valid Prometheus histogram exposition
+  (``_bucket``/``_sum``/``_count`` with ``# HELP``/``# TYPE`` on every
+  series — the strict-scraper satellite).
+
+Runs on the 8-fake-device CPU mesh from conftest.py.
+"""
+import gc
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu import Metric, MetricCollection, obs  # noqa: E402
+from torchmetrics_tpu.aggregation import SumMetric  # noqa: E402
+from torchmetrics_tpu.classification import (  # noqa: E402
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+)
+from torchmetrics_tpu.io import restore_state, save_state  # noqa: E402
+from torchmetrics_tpu.lanes import LanedMetric  # noqa: E402
+from torchmetrics_tpu.ops import compile_cache  # noqa: E402
+from torchmetrics_tpu.ops.async_read import drain_pipeline  # noqa: E402
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+from torchmetrics_tpu.quarantine import DegradedValue  # noqa: E402
+from torchmetrics_tpu.testing import faults  # noqa: E402
+from torchmetrics_tpu.utils.exceptions import (  # noqa: E402
+    CheckpointCorruptionError,
+    DispatchStallError,
+    ShardLossError,
+    SyncTimeoutError,
+)
+
+NUM_DEVICES = 8
+NUM_CLASSES = 5
+BATCH = 64
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Fresh telemetry state per test: tracing ON, registry/ring/flight
+    zeroed; env-default flags restored afterwards."""
+    obs.set_telemetry(True)
+    obs.set_tracing(True)
+    obs.set_flight(True)
+    obs.reset()
+    obs.reset_ring()
+    obs.reset_flight()
+    yield
+    drain_pipeline(30.0)
+    obs.reset()
+    obs.reset_ring()
+    obs.reset_flight()
+    obs.set_flight(None)
+    obs.set_tracing(None)
+    obs.set_telemetry(None)
+
+
+def _batch(n=BATCH, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, n)),
+    )
+
+
+def _mesh(d=NUM_DEVICES):
+    return Mesh(np.array(jax.devices()[:d]), ("batch",))
+
+
+def _put(mesh, arr, spec=P("batch")):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+class _SumLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+def _assert_linked(events, caller_name, worker_name):
+    """The cross-thread acceptance: the worker-side span shares the caller
+    span's trace_id, is parented under it, and carries the flow source that
+    the exporter turns into the s/f pair. Returns (caller, worker) events."""
+    callers = [e for e in events if e.name.startswith(caller_name)]
+    workers = [e for e in events if e.name.startswith(worker_name)]
+    assert callers, f"no caller span {caller_name} in {sorted({e.name for e in events})}"
+    assert workers, f"no worker span {worker_name} in {sorted({e.name for e in events})}"
+    caller = callers[-1]
+    linked = [w for w in workers if w.trace_id == caller.trace_id]
+    assert linked, (
+        f"no {worker_name} span shares trace_id {caller.trace_id}"
+        f" (worker trace ids: {[w.trace_id for w in workers]})"
+    )
+    worker = linked[-1]
+    assert worker.trace_id == caller.trace_id != 0
+    return caller, worker
+
+
+def _assert_flow_pair(doc, caller, worker):
+    """The Perfetto contract: one s/f pair with a shared id, the start bound
+    inside the submitting slice on the submitting thread, the finish at the
+    worker slice's start on the worker thread."""
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    starts = {e["id"]: e for e in flows if e["ph"] == "s"}
+    finishes = {e["id"]: e for e in flows if e["ph"] == "f"}
+    matching = [
+        fid for fid in starts
+        if fid in finishes and starts[fid]["args"].get("trace_id") == caller.trace_id
+    ]
+    assert matching, f"no flow pair for trace {caller.trace_id}"
+    fid = matching[-1]
+    s, f = starts[fid], finishes[fid]
+    assert s["tid"] == caller.tid and f["tid"] == worker.tid
+    assert caller.t_start_ns / 1e3 <= s["ts"] <= caller.t_end_ns / 1e3, (
+        "flow start must bind inside the submitting slice"
+    )
+    assert f["ts"] == pytest.approx(worker.t_start_ns / 1e3)
+    assert f.get("bp") == "e"
+
+
+# ---------------------------------------------------------------------------
+# trace-context unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_nested_spans_share_trace_and_chain_parents(self):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+        inner, outer = obs.drain_events()
+        assert inner.trace_id == outer.trace_id != 0
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id == 0
+
+    def test_separate_roots_get_separate_traces(self):
+        with obs.span("a"):
+            pass
+        with obs.span("b"):
+            pass
+        a, b = obs.drain_events()
+        assert a.trace_id != b.trace_id
+
+    def test_capture_and_reopen_across_threads(self):
+        with obs.span("submit") as _:
+            ctx = obs.capture_context()
+
+        def worker():
+            with obs.use_context(ctx):
+                with obs.span("replay"):
+                    with obs.span("nested"):
+                        pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        by_name = {e.name: e for e in obs.drain_events()}
+        submit, replay, nested = by_name["submit"], by_name["replay"], by_name["nested"]
+        assert replay.trace_id == nested.trace_id == submit.trace_id
+        assert replay.parent_id == submit.span_id
+        # the flow source lands on the FIRST reopened span only
+        assert replay.flow_src == (submit.span_id, submit.tid, ctx.t_ns)
+        assert nested.flow_src is None
+
+    def test_capture_returns_none_when_tracing_off(self):
+        obs.set_tracing(False)
+        assert obs.capture_context() is None
+        with obs.use_context(None):  # the no-op carry
+            with obs.span("x"):
+                pass
+        assert obs.peek_events() == []
+
+    def test_context_restores_on_exit(self):
+        with obs.span("submit"):
+            ctx = obs.capture_context()
+        with obs.span("outer"):
+            before = obs.current_trace_id()
+            with obs.use_context(ctx):
+                assert obs.current_trace_id() == ctx.trace_id
+            assert obs.current_trace_id() == before
+
+
+# ---------------------------------------------------------------------------
+# cross-thread integrity: the four async domains
+# ---------------------------------------------------------------------------
+
+
+class TestFourDomains:
+    def test_async_read_domain(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        fut = m.compute_async()
+        fut.result(60.0)
+        drain_pipeline(30.0)
+        events = obs.peek_events()
+        caller, worker = _assert_linked(events, "tm_tpu.compute_async", "tm_tpu.read.resolve")
+        assert worker.tid != caller.tid, "worker replay must run off the submitting thread"
+        _assert_flow_pair(obs.chrome_trace(), caller, worker)
+
+    def test_background_compile_domain(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_COMPILE_AHEAD", "1")
+        monkeypatch.setenv("TORCHMETRICS_TPU_CACHE_DIR", str(tmp_path))
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.set_background_compile(True)
+        m.update(*_batch())
+        assert compile_cache.drain_worker(timeout=60.0)
+        events = obs.peek_events()
+        enqueues = [
+            e for e in events
+            if e.name == obs.SPAN_COMPILE and (e.attrs or {}).get("phase") == "enqueue"
+        ]
+        compiles = [
+            e for e in events
+            if e.name == obs.SPAN_COMPILE and (e.attrs or {}).get("background")
+        ]
+        assert enqueues and compiles
+        caller, worker = enqueues[-1], compiles[-1]
+        assert worker.trace_id == caller.trace_id != 0
+        assert worker.tid != caller.tid
+        _assert_flow_pair(obs.chrome_trace(), caller, worker)
+
+    def test_autosave_domain(self, tmp_path):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        saver = tm.Autosaver(m, str(tmp_path / "ckpt"), every_n_updates=1).attach()
+        try:
+            m.update(*_batch())
+            saver.flush(30.0)
+        finally:
+            saver.detach()
+        drain_pipeline(30.0)
+        events = obs.peek_events()
+        caller, worker = _assert_linked(events, "tm_tpu.autosave", "tm_tpu.checkpoint.save")
+        assert worker.tid != caller.tid
+        _assert_flow_pair(obs.chrome_trace(), caller, worker)
+
+    def test_shadow_refresh_domain(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = make_deferred_collection_step(coll, _mesh(), axis_name="batch")
+        step.attach_shadow(every_n_steps=1, on_shard_loss="degraded")
+        mesh = _mesh()
+        st = step.init_states()
+        rng = np.random.RandomState(7)
+        st = step.local_step(st, _put(mesh, jnp.asarray(rng.randn(8).astype(np.float32))))
+        assert drain_pipeline(30.0)
+        events = obs.peek_events()
+        submits = [
+            e for e in events
+            if e.name == obs.SPAN_SHADOW and (e.attrs or {}).get("phase") == "submit"
+        ]
+        refreshes = [
+            e for e in events
+            if e.name == obs.SPAN_SHADOW and (e.attrs or {}).get("phase") == "refresh"
+        ]
+        assert submits and refreshes
+        caller, worker = submits[-1], refreshes[-1]
+        assert worker.trace_id == caller.trace_id != 0
+        assert worker.tid != caller.tid
+        # the pipeline's resolve span is the flow target; the refresh span
+        # nests under it with the same trace
+        resolve = [e for e in events if e.name.startswith("tm_tpu.read.resolve/ShardShadow")]
+        assert resolve and resolve[-1].trace_id == caller.trace_id
+        _assert_flow_pair(obs.chrome_trace(), caller, resolve[-1])
+
+    def test_reduce_async_carries_trace(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = make_deferred_collection_step(coll, _mesh(), axis_name="batch")
+        mesh = _mesh()
+        st = step.local_step(step.init_states(), _put(mesh, jnp.ones(8, jnp.float32)))
+        fut = step.reduce_async(st)
+        fut.result(60.0)
+        drain_pipeline(30.0)
+        caller, worker = _assert_linked(
+            obs.peek_events(), "tm_tpu.compute_async/DeferredCollectionStep", "tm_tpu.read.resolve"
+        )
+        _assert_flow_pair(obs.chrome_trace(), caller, worker)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_always_on_without_tracing(self):
+        """The whole point: flight records exist with the span ring OFF."""
+        obs.set_tracing(False)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        assert obs.peek_events() == []  # ring untouched
+        snap = obs.flight_snapshot()
+        assert snap.get("dispatch"), f"no dispatch flight records: {list(snap)}"
+        names = [r["name"] for r in snap["dispatch"]]
+        assert any(n.startswith("tm_tpu.dispatch/") for n in names)
+
+    def test_kernel_gate_decisions_ride_the_kernels_domain(self):
+        m = MulticlassConfusionMatrix(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        snap = obs.flight_snapshot()
+        assert snap.get("kernels"), f"no kernel gate records: {list(snap)}"
+        assert any("path=" in r["name"] for r in snap["kernels"])
+
+    def test_newest_wins_bound(self):
+        obs.reset_flight(capacity=4)
+        for i in range(10):
+            obs.flight_note("checkpoint", f"rec{i}")
+        snap = obs.flight_snapshot()["checkpoint"]
+        assert [r["name"] for r in snap] == ["rec6", "rec7", "rec8", "rec9"]
+
+    def test_blob_carries_counter_deltas_per_window(self):
+        obs.flight_blob()  # anchor the window
+        obs.counter_inc("test.window_counter", 3)
+        blob = obs.flight_blob("dispatch")
+        assert blob["counters_delta"].get("test.window_counter") == 3
+        # the next window starts empty
+        assert "test.window_counter" not in obs.flight_blob("dispatch")["counters_delta"]
+
+    def test_set_flight_off_stops_recording(self):
+        obs.set_flight(False)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        assert not obs.flight_snapshot().get("dispatch")
+
+    def test_dump_diagnostics_surfaces_flight(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        d = obs.dump_diagnostics()
+        assert "flight" in d and d["flight"].get("dispatch")
+
+    def test_persist_flight_writes_durable_json(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_FLIGHT_DIR", str(tmp_path))
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        path = obs.persist_flight()
+        assert path and os.path.dirname(path) == str(tmp_path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["flight"].get("dispatch") and "counters" in doc
+
+
+# ---------------------------------------------------------------------------
+# flight blobs on every typed fault
+# ---------------------------------------------------------------------------
+
+
+def _last_crumb(kind):
+    crumbs = [c for c in obs.dump_diagnostics()["breadcrumbs"] if c["kind"] == kind]
+    assert crumbs, f"no {kind!r} breadcrumb recorded"
+    return crumbs[-1]
+
+
+def _assert_flight_blob(crumb):
+    blob = crumb["data"].get("flight")
+    assert blob is not None, f"breadcrumb {crumb['kind']!r} carries no flight blob"
+    events = blob["events"]
+    flat = [r for rs in (events.values() if isinstance(events, dict) else [events]) for r in rs]
+    assert flat, "flight blob holds no spans from the faulting window"
+    assert isinstance(blob["counters_delta"], dict)
+    return blob
+
+
+class TestFlightOnTypedFaults:
+    def test_shard_loss_error(self):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = make_deferred_collection_step(coll, _mesh(), axis_name="batch")
+        mesh = _mesh()
+        st = step.local_step(step.init_states(), _put(mesh, jnp.ones(8, jnp.float32)))
+        with faults.drop_shard(step, shard=3):
+            with pytest.raises(ShardLossError):
+                step.reduce(st)
+        crumb = _last_crumb("shard_loss")
+        blob = _assert_flight_blob(crumb)
+        assert blob["domain"] == "shadow"
+        assert crumb["data"]["shard"] == 3
+
+    def test_lane_fault_error(self):
+        laned = LanedMetric(_SumLike(), capacity=8, on_lane_fault="quarantine")
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0]))]
+        laned.update_sessions(base)
+        with faults.poison_session(laned, "a", mode="nan", frac=1.0):
+            laned.update_sessions(base)
+        laned.lane_values()  # the read point attributes the fault
+        crumb = _last_crumb("lane_fault")
+        blob = _assert_flight_blob(crumb)
+        assert blob["domain"] == "lanes"
+
+    def test_sync_timeout_error(self):
+        m = SumMetric(
+            nan_strategy="ignore", executor=False,
+            distributed_available_fn=lambda: True,
+            sync_timeout=0.2, on_sync_failure="raise",
+        )
+        m.update(jnp.asarray([1.0, 2.0]))
+        with faults.hang_sync(seconds=5.0):
+            with pytest.raises(SyncTimeoutError):
+                m.compute()
+        crumb = _last_crumb("sync_timeout")
+        _assert_flight_blob(crumb)
+        assert crumb["data"]["timeout_s"] == 0.2
+
+    def test_checkpoint_corruption_error(self, tmp_path):
+        m = _SumLike()
+        m.update(jnp.ones(3))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        faults.torn_write(path, mode="truncate")
+        with pytest.raises(CheckpointCorruptionError):
+            restore_state(path, _SumLike())
+        _assert_flight_blob(_last_crumb("checkpoint_corruption_error"))
+
+    def test_dispatch_stall_persists_flight_to_disk(self, tmp_path, monkeypatch):
+        import time as _time
+
+        monkeypatch.setenv("TORCHMETRICS_TPU_FLIGHT_DIR", str(tmp_path))
+        from torchmetrics_tpu.io.retry import stall_watchdog
+
+        # run real dispatches first so the recorder holds the history a
+        # post-mortem needs (the stall itself records nothing — it hangs)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        with pytest.raises(DispatchStallError):
+            with stall_watchdog(0.1, what="test hang", status=lambda: {"calls": 1}):
+                _time.sleep(2.0)
+        crumb = _last_crumb("dispatch_stall")
+        _assert_flight_blob(crumb)
+        assert crumb["data"]["what"] == "test hang"
+        dumps = [f for f in os.listdir(tmp_path) if f.startswith("tm_tpu_flight_")]
+        assert dumps, "fatal stall must persist the flight recorder to disk"
+        with open(tmp_path / dumps[0]) as fh:
+            doc = json.load(fh)
+        assert "flight" in doc and "breadcrumbs" in doc
+
+    def test_breaker_trip_carries_flight(self):
+        laned = LanedMetric(
+            _SumLike(), capacity=8, on_lane_fault="quarantine",
+            breaker_threshold=2, breaker_window=10,
+        )
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0]))]
+        laned.update_sessions(base)
+        with faults.poison_session(laned, "a", mode="nan", frac=1.0):
+            for _ in range(3):
+                laned.update_sessions(base)
+                laned.lane_values()
+        _assert_flight_blob(_last_crumb("lane_breaker_trip"))
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def _parse_prometheus(text):
+    """Strict parse: every sample's family must carry # HELP and # TYPE; the
+    return maps family -> (kind, [(labels, value)])."""
+    helped, typed, samples = set(), {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split(" ")[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, fam, kind = line.split(" ")
+            typed[fam] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        name_part, value = line.rsplit(" ", 1)
+        if "{" in name_part:
+            series, labels = name_part.split("{", 1)
+            labels = labels.rstrip("}")
+        else:
+            series, labels = name_part, ""
+        fam = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            if series.endswith(suffix) and series[: -len(suffix)] in typed:
+                fam = series[: -len(suffix)]
+        assert fam in typed, f"sample {series!r} has no # TYPE"
+        assert fam in helped, f"sample {series!r} has no # HELP"
+        samples.setdefault(fam, []).append((series, labels, float(value)))
+    return typed, samples
+
+
+class TestHistograms:
+    def test_async_read_latency_and_queue_wait_recorded(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        m.update(*_batch())
+        m.compute_async().result(60.0)
+        drain_pipeline(30.0)
+        hists = obs.histograms_snapshot()
+        assert hists["reads.e2e_latency_us"]["count"] >= 1
+        assert hists["reads.queue_wait_us"]["count"] >= 1
+        assert hists["reads.e2e_latency_us"]["sum"] > 0
+
+    def test_dispatch_duration_recorded(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+        for seed in range(3):
+            m.update(*_batch(seed=seed))
+        h = obs.histograms_snapshot()["executor.dispatch_us"]
+        assert h["count"] >= 3 and sum(h["counts"]) == h["count"]
+
+    def test_staleness_age_recorded_on_degraded_reads(self):
+        laned = LanedMetric(_SumLike(), capacity=8, on_lane_fault="quarantine")
+        base = [("a", np.asarray([1.0])), ("b", np.asarray([2.0]))]
+        laned.update_sessions(base)
+        with faults.poison_session(laned, "a", mode="nan", frac=1.0):
+            laned.update_sessions(base)
+        vals = laned.lane_values()
+        assert isinstance(vals["a"], DegradedValue)
+        h = obs.histograms_snapshot()["reads.staleness_age_updates"]
+        assert h["count"] >= 1
+
+    def test_prometheus_histogram_exposition_is_strict(self):
+        obs.counter_inc("checkpoint.saves", 2)
+        obs.gauge_set("reads.pending", 1)
+        obs.histogram_observe("reads.e2e_latency_us", 900.0)
+        obs.histogram_observe("reads.e2e_latency_us", 40_000.0)
+        obs.histogram_observe("reads.staleness_age_updates", 3)
+        typed, samples = _parse_prometheus(obs.prometheus_text())
+        assert typed["tm_tpu_reads_staleness_age_updates"] == "histogram"
+        fam = "tm_tpu_reads_e2e_latency_us"
+        assert typed[fam] == "histogram"
+        buckets = [(lab, v) for series, lab, v in samples[fam] if series.endswith("_bucket")]
+        assert buckets[-1][0] == 'le="+Inf"' and buckets[-1][1] == 2
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        assert ('le="1000"', 1.0) in buckets
+        sums = [v for series, _, v in samples[fam] if series.endswith("_sum")]
+        totals = [v for series, _, v in samples[fam] if series.endswith("_count")]
+        assert sums == [40_900.0] and totals == [2.0]
+        assert typed["tm_tpu_checkpoint_saves_total"] == "counter"
+        assert typed["tm_tpu_reads_pending"] == "gauge"
+
+    def test_histogram_off_with_telemetry(self):
+        obs.set_telemetry(False)
+        obs.histogram_observe("reads.e2e_latency_us", 1.0)
+        obs.set_telemetry(True)
+        assert "reads.e2e_latency_us" not in obs.histograms_snapshot()
+
+    def test_custom_buckets_validated(self):
+        with pytest.raises(ValueError, match="ascending"):
+            obs.histogram_observe("bad.hist", 1.0, buckets=(3.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# the executor WeakSet leak test (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotLeak:
+    def test_weakset_releases_a_fleet_of_dead_executors(self):
+        """Long-lived serving processes churn metrics: N registered executors
+        must all leave the aggregate once garbage-collected, returning
+        executor.instances to its baseline (no dead-entry accumulation)."""
+        gc.collect()
+        baseline = obs.telemetry_snapshot()["counters"].get("executor.instances", 0)
+        fleet = []
+        for seed in range(6):
+            m = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+            m.update(*_batch(n=16, seed=seed))
+            fleet.append(m)
+        during = obs.telemetry_snapshot()["counters"]["executor.instances"]
+        assert during >= baseline + 6
+        del fleet, m
+        gc.collect()
+        after = obs.telemetry_snapshot()["counters"].get("executor.instances", 0)
+        assert after <= baseline, (
+            f"dead executors lingering in the WeakSet: baseline {baseline}, after {after}"
+        )

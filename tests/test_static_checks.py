@@ -177,6 +177,49 @@ def test_blocking_sync_linter_catches_violations(tmp_path):
     assert len(found) == 3 and all(v.func == "_hot" for v in found)
 
 
+def test_typed_fault_raises_route_through_flight_helper():
+    """ISSUE 13 satellite: every direct typed-error raise in the covered
+    runtime modules must wrap the constructor in obs.flighted(...) so the
+    breadcrumb carries the faulting window's flight blob — no silent fault
+    paths (tools/lint_fault_breadcrumbs.py)."""
+    linter = _load_tool("lint_fault_breadcrumbs")
+    violations, stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    msg = "\n".join(f"{v.path}:{v.line} in {v.func}: {v.snippet}" for v in violations)
+    assert not violations, f"typed faults without flight breadcrumbs (wrap in obs.flighted):\n{msg}"
+    assert not stale, f"stale lint allowlist entries (raises gone — remove them): {stale}"
+
+
+def test_fault_breadcrumb_linter_catches_violations(tmp_path):
+    """The linter actually fires: a bare typed raise is flagged, the wrapped
+    form passes, and flighted() wrapping a non-typed value is flagged too."""
+    linter = _load_tool("lint_fault_breadcrumbs")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from torchmetrics_tpu.utils.exceptions import ShardLossError\n"
+        "from torchmetrics_tpu import obs\n"
+        "def _bare():\n"
+        "    raise ShardLossError('gone', shard=1)\n"
+        "def _wrapped():\n"
+        "    raise obs.flighted(ShardLossError('gone', shard=1), domain='shadow')\n"
+        "def _rewrapped(err):\n"
+        "    raise obs.flighted(err, domain='shadow')  # re-raise of a caught var: fine\n"
+        "def _fake():\n"
+        "    raise obs.flighted(RuntimeError('x'), domain='shadow')\n"
+    )
+    found = linter.lint_file(bad, "bad.py")
+    assert {v.func for v in found} == {"_bare", "_fake"}, found
+
+
+def test_flight_linter_fails_on_missing_module(monkeypatch):
+    """Same stale-rule guard as the blocking-sync lint: a renamed covered
+    module must fail loudly, not silently lint nothing."""
+    linter = _load_tool("lint_fault_breadcrumbs")
+    monkeypatch.setattr(linter, "COVERED_MODULES", ("metric.py", "ops/no_such_module.py"))
+    violations, _stale = linter.collect_violations(REPO / "torchmetrics_tpu")
+    missing = [v for v in violations if v.path == "ops/no_such_module.py"]
+    assert missing and "does not exist" in missing[0].snippet
+
+
 def test_bench_regression_gate_latest_round():
     """The latest committed BENCH_r*.json passes the 0.9 gate against the
     current BASELINE.json (known drifts carry reviewed accepted_regressions

@@ -136,6 +136,27 @@ def check_bench(
                         " the shard-shadow refresh is taxing the steady deferred step loop",
                     )
                 )
+        # telemetry-overhead gate (ISSUE 13): the counters + flight recorder +
+        # histograms fully on (spans included) must not tax the deferred epoch
+        # loop beyond the cap (real-hardware acceptance <1%; the 1-vCPU VM
+        # floor lives in BASELINE.json with its evidence note, per the
+        # shard-shadow/async-read precedent). Slightly negative overhead is
+        # noise and always passes.
+        toverhead = result.get("telemetry_overhead_pct")
+        if isinstance(toverhead, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("telemetry_overhead_max_pct", 1.0) if isinstance(base, dict) else 1.0
+            if float(toverhead) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"telemetry_overhead_pct {toverhead:.2f} exceeds the {cap}% cap —"
+                        " the flight recorder / histogram instruments are taxing the"
+                        " steady path (docs/OBSERVABILITY.md 'Cost model')",
+                    )
+                )
         # async-read gates (ISSUE 9): a config reporting the per-step read
         # rows is gated on (a) the submit-rate ratio vs the update-only rate
         # (the "never stalls the step loop" acceptance; floor from the

@@ -56,6 +56,7 @@ HOT_PATH_FILES = (
     "obs/tracer.py",
     "obs/registry.py",
     "obs/export.py",
+    "obs/flight.py",
 )
 
 #: deliberate host syncs; keys are "<path>::<function>", values say why

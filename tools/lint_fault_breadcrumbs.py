@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Static pass: no silent typed-fault paths in the covered runtime modules.
+
+The fault flight recorder (obs/flight.py, ISSUE 13) only helps if every typed
+fault actually routes through it — a raise site someone forgets leaves the
+operator with a bare traceback and no black box. This tool pins the contract:
+
+Rule: inside the modules listed in ``COVERED_MODULES``, every ``raise`` whose
+exception is a direct construction of a typed fault error
+(:data:`TYPED_ERRORS` — the exception surface of
+``torchmetrics_tpu/utils/exceptions.py``) must wrap the constructor in the
+breadcrumb-with-flight helper::
+
+    raise obs.flighted(ShardLossError("shard 3 lost", shard=3), domain="shadow")
+
+so the breadcrumb trail carries the faulting window (recent spans + counter
+deltas) alongside the error. Re-raises of caught variables (``raise err``)
+are out of static reach and are covered by the catching seams instead (the
+``_serve_shard_loss``/watchdog/rotation-scan paths all attach flight blobs
+before re-raising or degrading).
+
+The allowlist is the documented inventory of deliberate exceptions; entries
+that match nothing anymore FAIL the run (stale-waiver rule, same as the
+blocking-host-sync lint). Run directly for a report, or through
+``tests/test_static_checks.py`` where it gates the suite.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+#: the typed fault surface of utils/exceptions.py — every construction of one
+#: of these inside a raise statement must route through the flight helper
+TYPED_ERRORS = (
+    "StateCorruptionError",
+    "SyncTimeoutError",
+    "CheckpointCorruptionError",
+    "TopologyMismatchError",
+    "ShardLossError",
+    "LaneFaultError",
+    "DispatchStallError",
+)
+
+#: names that count as the breadcrumb-with-flight helper at a raise site
+HELPER_NAMES = ("flighted",)
+
+#: runtime modules whose typed-fault raises are covered, relative to the
+#: package root (testing/faults.py is deliberately NOT covered — injected
+#: faults are attributed by the seams that catch them, not at the injector)
+COVERED_MODULES = (
+    "metric.py",
+    "collections.py",
+    "lanes.py",
+    "quarantine.py",
+    "ops/executor.py",
+    "ops/compile_cache.py",
+    "ops/async_read.py",
+    "parallel/sync.py",
+    "parallel/reshard.py",
+    "io/checkpoint.py",
+    "io/retry.py",
+)
+
+#: deliberate unwrapped raises; keys are "<path>::<function>", values say why
+ALLOWLIST: dict = {}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    func: str
+    snippet: str
+
+
+def _call_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+    return ""
+
+
+def lint_file(path: Path, rel: str) -> List[Violation]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [Violation(rel, err.lineno or 0, "<module>", f"syntax error: {err.msg}")]
+    lines = source.splitlines()
+    out: List[Violation] = []
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            if isinstance(child, ast.Raise) and child.exc is not None:
+                exc = child.exc
+                name = _call_name(exc)
+                if name in TYPED_ERRORS:
+                    snippet = lines[child.lineno - 1].strip() if child.lineno <= len(lines) else ""
+                    out.append(Violation(rel, child.lineno, child_func, snippet))
+                elif name in HELPER_NAMES and isinstance(exc, ast.Call):
+                    # helper present: its first argument must BE the typed
+                    # constructor (flighted(<TypedError>(...), domain=...)) —
+                    # wrapping something else would fake the coverage
+                    first = exc.args[0] if exc.args else None
+                    if _call_name(first) not in TYPED_ERRORS and not isinstance(first, ast.Name):
+                        snippet = lines[child.lineno - 1].strip() if child.lineno <= len(lines) else ""
+                        out.append(
+                            Violation(rel, child.lineno, child_func, f"flighted() without a typed error: {snippet}")
+                        )
+            visit(child, child_func)
+
+    visit(tree, "<module>")
+    return out
+
+
+def collect_violations(package_root: Path):
+    """(violations, stale_allowlist) over the covered modules; a listed module
+    that does not exist fails (the rule must not rot when files move)."""
+    violations: List[Violation] = []
+    used = set()
+    for rel in COVERED_MODULES:
+        path = package_root / rel
+        if not path.exists():
+            violations.append(
+                Violation(rel, 0, "<module>", "listed covered module does not exist — fix COVERED_MODULES")
+            )
+            continue
+        for v in lint_file(path, rel):
+            key = f"{v.path}::{v.func}"
+            if key in ALLOWLIST:
+                used.add(key)
+                continue
+            violations.append(v)
+    stale = sorted(set(ALLOWLIST) - used)
+    return violations, stale
+
+
+def main() -> int:
+    package_root = Path(__file__).resolve().parent.parent / "torchmetrics_tpu"
+    violations, stale = collect_violations(package_root)
+    for v in violations:
+        print(
+            f"{v.path}:{v.line}: typed fault raised without the flight helper in {v.func!r}"
+            f" (wrap it: raise obs.flighted(<Error>(...), domain=...)): {v.snippet}"
+        )
+    for key in stale:
+        print(f"allowlist entry {key!r} ({ALLOWLIST[key]}) matches no raise anymore — remove it")
+    if violations or stale:
+        return 1
+    print(f"lint_fault_breadcrumbs: clean ({len(COVERED_MODULES)} covered modules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

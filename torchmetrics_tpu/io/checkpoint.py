@@ -177,9 +177,9 @@ def _unflatten_export(
         for field, n in (info.get("_list_fields") or {}).items():
             got = dst.setdefault(field, [])
             if len(got) != n:
-                raise CheckpointCorruptionError(
+                raise obs.flighted(CheckpointCorruptionError(
                     f"list state {field!r} expected {n} elements, payload holds {len(got)}"
-                )
+                ), domain="checkpoint")
         for key in (_COUNT_KEY, _SHARDS_KEY):
             if key in info:
                 dst[key] = int(info[key])
@@ -451,32 +451,32 @@ def _read_file(path: str, want_payload: bool = True) -> Tuple[Dict[str, Any], Op
         with open(path, "rb") as fh:
             blob = fh.read()
     except OSError as err:
-        raise CheckpointCorruptionError(f"cannot read snapshot {path}: {err}") from err
+        raise obs.flighted(CheckpointCorruptionError(f"cannot read snapshot {path}: {err}"), domain="checkpoint") from err
     if len(blob) < len(_MAGIC) + 8 or not blob.startswith(_MAGIC):
-        raise CheckpointCorruptionError(
+        raise obs.flighted(CheckpointCorruptionError(
             f"{path} is not a torchmetrics_tpu snapshot (bad magic/truncated header)"
-        )
+        ), domain="checkpoint")
     mlen = int.from_bytes(blob[len(_MAGIC):len(_MAGIC) + 8], "little")
     m_start = len(_MAGIC) + 8
     if mlen <= 0 or m_start + mlen > len(blob):
-        raise CheckpointCorruptionError(f"{path}: manifest length {mlen} exceeds file size (torn write)")
+        raise obs.flighted(CheckpointCorruptionError(f"{path}: manifest length {mlen} exceeds file size (torn write)"), domain="checkpoint")
     try:
         manifest = json.loads(blob[m_start:m_start + mlen].decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as err:
-        raise CheckpointCorruptionError(f"{path}: manifest is not valid JSON ({err})") from err
+        raise obs.flighted(CheckpointCorruptionError(f"{path}: manifest is not valid JSON ({err})"), domain="checkpoint") from err
     version = manifest.get("manifest_version")
     if not isinstance(version, int) or version > MANIFEST_VERSION:
-        raise CheckpointCorruptionError(
+        raise obs.flighted(CheckpointCorruptionError(
             f"{path}: manifest_version {version!r} unsupported (this build reads <= {MANIFEST_VERSION})"
-        )
+        ), domain="checkpoint")
     payload = blob[m_start + mlen:]
     if len(payload) != manifest.get("payload_len"):
-        raise CheckpointCorruptionError(
+        raise obs.flighted(CheckpointCorruptionError(
             f"{path}: payload is {len(payload)} bytes, manifest promises"
             f" {manifest.get('payload_len')} (torn write)"
-        )
+        ), domain="checkpoint")
     if _sha256(payload) != manifest.get("payload_sha256"):
-        raise CheckpointCorruptionError(f"{path}: payload sha256 mismatch (corrupt/torn write)")
+        raise obs.flighted(CheckpointCorruptionError(f"{path}: payload sha256 mismatch (corrupt/torn write)"), domain="checkpoint")
     return manifest, (payload if want_payload else None)
 
 
@@ -484,22 +484,22 @@ def _decode_state(path: str, manifest: Dict[str, Any], payload: bytes) -> Dict[s
     try:
         archive = np.load(_io.BytesIO(payload), allow_pickle=False)
     except Exception as err:
-        raise CheckpointCorruptionError(f"{path}: payload archive unreadable ({err})") from err
+        raise obs.flighted(CheckpointCorruptionError(f"{path}: payload archive unreadable ({err})"), domain="checkpoint") from err
     leaves: List[Tuple[Dict[str, Any], np.ndarray]] = []
     for entry in manifest.get("leaves", []):
         key = entry["key"]
         if key not in archive.files:
-            raise CheckpointCorruptionError(f"{path}: payload missing leaf {key} ({entry['field']!r})")
+            raise obs.flighted(CheckpointCorruptionError(f"{path}: payload missing leaf {key} ({entry['field']!r})"), domain="checkpoint")
         arr = archive[key]
         if list(arr.shape) != entry["shape"] or str(arr.dtype) != entry["dtype"]:
-            raise CheckpointCorruptionError(
+            raise obs.flighted(CheckpointCorruptionError(
                 f"{path}: leaf {entry['field']!r} is {arr.dtype}{tuple(arr.shape)},"
                 f" manifest promises {entry['dtype']}{tuple(entry['shape'])}"
-            )
+            ), domain="checkpoint")
         if _sha256(np.ascontiguousarray(arr).tobytes()) != entry["sha256"]:
-            raise CheckpointCorruptionError(
+            raise obs.flighted(CheckpointCorruptionError(
                 f"{path}: leaf {entry['field']!r} sha256 mismatch (bit rot / corrupt write)"
-            )
+            ), domain="checkpoint")
         leaves.append(({"leader": entry["leader"], "field": entry["field"], "index": entry["index"]}, arr))
     return _unflatten_export(leaves, manifest.get("scalars") or {}, manifest.get("kind") == "collection")
 
@@ -529,21 +529,22 @@ def _check_topology(path: str, manifest: Dict[str, Any], obj: Any, topology: str
     if saved.get("sharded") and saved.get("num_shards") and saved["num_shards"] != world["device_count"]:
         if topology == "strict":
             obs.counter_inc("checkpoint.topology_mismatches")
-            obs.breadcrumb(
+            obs.fault_breadcrumb(
                 "topology_mismatch",
-                {
+                domain="checkpoint",
+                data={
                     "snapshot": os.path.basename(path),
                     "saved_num_shards": saved["num_shards"],
                     "device_count": world["device_count"],
                 },
             )
-            raise TopologyMismatchError(
+            raise obs.flighted(TopologyMismatchError(
                 f"{path} holds a {saved['num_shards']}-shard stacked state but this world"
                 f" has {world['device_count']} device(s); restore with topology='elastic'"
                 " to fold to the topology-neutral form, or restore on the saved topology",
                 saved=saved,
                 current=world,
-            )
+            ), domain="checkpoint")
         return "fold"
     lane_cap = saved.get("lane_capacity")
     if (
@@ -574,10 +575,10 @@ def _restore_file(
 ) -> Dict[str, Any]:
     manifest, payload = _read_file(path)
     if validate != "off" and manifest.get("class") not in (None, type(obj).__name__):
-        raise StateCorruptionError(
+        raise obs.flighted(StateCorruptionError(
             f"{path} holds state for {manifest.get('class')!r}, not {type(obj).__name__!r}"
             " (use validate='off' to force)"
-        )
+        ), domain="checkpoint")
     action = _check_topology(path, manifest, obj, topology)
     target_capacity = getattr(obj, "capacity", None) if action == "remap" else None
     state = _decode_state(path, manifest, payload)
@@ -681,7 +682,7 @@ def _restore_state_body(
 
     snaps = _list_snapshots(path)
     if not snaps:
-        raise CheckpointCorruptionError(f"no snapshots found in rotating store {path}")
+        raise obs.flighted(CheckpointCorruptionError(f"no snapshots found in rotating store {path}"), domain="checkpoint")
     skipped = 0
     errors: List[str] = []
     for _, snap in reversed(snaps):
@@ -691,9 +692,10 @@ def _restore_state_body(
             skipped += 1
             errors.append(f"{os.path.basename(snap)}: {type(err).__name__}: {err}")
             obs.counter_inc("checkpoint.restore_fallbacks")
-            obs.breadcrumb(
+            obs.fault_breadcrumb(
                 "checkpoint_fallback",
-                {"snapshot": os.path.basename(snap), "error": f"{type(err).__name__}: {err}"},
+                domain="checkpoint",
+                data={"snapshot": os.path.basename(snap), "error": f"{type(err).__name__}: {err}"},
             )
             if on_fallback is not None:
                 on_fallback(snap, err)
@@ -706,9 +708,9 @@ def _restore_state_body(
         manifest["path"] = snap
         manifest["fallbacks_skipped"] = skipped
         return manifest
-    raise CheckpointCorruptionError(
+    raise obs.flighted(CheckpointCorruptionError(
         f"no valid snapshot in rotating store {path}; all {len(snaps)} damaged:\n  " + "\n  ".join(errors)
-    )
+    ), domain="checkpoint")
 
 
 # ------------------------------------------------------------------ autosave
@@ -845,8 +847,13 @@ class Autosaver:
             # itself moves to the read-pipeline worker alongside the
             # serialization + fsync (which always ran off-thread)
             staged: Optional[Dict[str, Any]] = None
+            ctx = None
             with obs.span(obs.SPAN_AUTOSAVE, owner=type(self.obj).__name__):
                 obs.counter_inc("autosave.ticks")
+                # captured INSIDE the tick span: the background write's
+                # checkpoint.save span reopens this context, so the flow
+                # arrow runs tick -> worker write across threads
+                ctx = obs.capture_context()
                 payload_states: Optional[Dict[str, Any]] = None
                 if states is not None:
                     payload_states = host_copy_tree(states)
@@ -886,14 +893,19 @@ class Autosaver:
                     self.stats["save_errors"] += 1
                     self.stats["last_error"] = f"{type(err).__name__}: {err}"
                     obs.counter_inc("autosave.save_errors")
-                    obs.breadcrumb("autosave_failed", {"error": f"{type(err).__name__}: {err}"})
+                    obs.fault_breadcrumb(
+                        "autosave_failed",
+                        domain="autosave",
+                        data={"error": f"{type(err).__name__}: {err}"},
+                    )
                     rank_zero_warn(f"torchmetrics_tpu autosave failed: {type(err).__name__}: {err}")
 
             if staged is not None:
                 from torchmetrics_tpu.ops.async_read import get_pipeline
 
                 def ride() -> None:
-                    write(host_copy_tree(staged))
+                    with obs.use_context(ctx):
+                        write(host_copy_tree(staged))
 
                 self.stats["async_rides"] += 1
                 obs.counter_inc("autosave.async_rides")
@@ -904,9 +916,12 @@ class Autosaver:
             if not self.background:
                 write(payload_states)
                 return self.stats["last_path"]
-            worker = threading.Thread(
-                target=write, args=(payload_states,), name="tm_tpu_autosave", daemon=True
-            )
+
+            def bg_write() -> None:
+                with obs.use_context(ctx):
+                    write(payload_states)
+
+            worker = threading.Thread(target=bg_write, name="tm_tpu_autosave", daemon=True)
             self._inflight = worker
             worker.start()
         # background mode: the concrete snapshot path lands in stats["last_path"]

@@ -247,18 +247,25 @@ def stall_watchdog(
                     breadcrumbs = None
             # route the stall through the diagnostic trail (obs/registry.py):
             # dump_diagnostics() after the crash shows WHAT stalled and the
-            # executor's counters at that moment, not just the final traceback
+            # executor's counters at that moment, not just the final traceback.
+            # A stall is FATAL by contract (checkpoint and exit), so the
+            # flight recorder also persists to disk — the post-mortem black
+            # box survives the process (docs/OBSERVABILITY.md).
             from torchmetrics_tpu import obs  # deferred: io.retry loads before obs in some paths
 
             obs.counter_inc("watchdog.stalls")
-            obs.breadcrumb(
-                "dispatch_stall",
-                {"what": what, "deadline_s": deadline, "executor_status": breadcrumbs},
-            )
-            raise DispatchStallError(
-                f"{what} did not complete within {deadline}s (stalled runtime call;"
-                " checkpoint local state and restart this process)"
-                + (f"; executor_status={breadcrumbs}" if breadcrumbs is not None else ""),
+            raise obs.flighted(
+                DispatchStallError(
+                    f"{what} did not complete within {deadline}s (stalled runtime call;"
+                    " checkpoint local state and restart this process)"
+                    + (f"; executor_status={breadcrumbs}" if breadcrumbs is not None else ""),
+                    executor_status=breadcrumbs,
+                ),
+                domain="dispatch",
+                kind="dispatch_stall",
+                persist=True,
+                what=what,
+                deadline_s=deadline,
                 executor_status=breadcrumbs,
             ) from None
         raise

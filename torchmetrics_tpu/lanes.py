@@ -229,13 +229,13 @@ class LaneTable:
         for kind, sid, lane in payload.get("sessions", []):
             lane = int(lane)
             if not 0 <= lane < capacity:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"lane directory maps session {sid!r} to lane {lane}, outside capacity {capacity}"
-                )
+                ), domain="lanes")
             if table.lane_session[lane] is not None:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"lane directory maps two sessions to lane {lane} ({table.lane_session[lane]!r}, {sid!r})"
-                )
+                ), domain="lanes")
             if kind == "i":
                 sid = int(sid)
             elif kind == "b":
@@ -255,7 +255,7 @@ def _decode_json_blob(blob: Any, what: str) -> Dict[str, Any]:
     try:
         return json.loads(np.asarray(blob, dtype=np.uint8).tobytes().decode("utf-8"))
     except Exception as err:
-        raise StateCorruptionError(f"{what} blob is unreadable ({type(err).__name__}: {err})") from err
+        raise obs.flighted(StateCorruptionError(f"{what} blob is unreadable ({type(err).__name__}: {err})"), domain="lanes") from err
 
 
 def _encode_directory(table: LaneTable) -> np.ndarray:
@@ -269,7 +269,7 @@ def _decode_directory(blob: Any) -> LaneTable:
     except StateCorruptionError:
         raise
     except Exception as err:
-        raise StateCorruptionError(f"lane directory blob is unreadable ({type(err).__name__}: {err})") from err
+        raise obs.flighted(StateCorruptionError(f"lane directory blob is unreadable ({type(err).__name__}: {err})"), domain="lanes") from err
 
 
 class _ScreenSlowPath(Exception):
@@ -718,7 +718,7 @@ class LanedMetric(Metric):
                 # the incremental mirror can fold from for free
                 self.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
                 try:
-                    with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, rows=rows, bucket=bucket):
+                    with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
                         self.update(jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch)
                 except LaneFaultError as err:
                     culprit = getattr(err, "session_id", None)
@@ -1771,7 +1771,7 @@ class LanedMetric(Metric):
         on shrink below occupancy — instead of leaving the instance at the
         snapshot's capacity (the default, historical behavior)."""
         if not isinstance(state, dict):
-            raise StateCorruptionError(f"{type(self).__name__}: state must be a dict, got {type(state).__name__}")
+            raise obs.flighted(StateCorruptionError(f"{type(self).__name__}: state must be a dict, got {type(state).__name__}"), domain="lanes")
         state = dict(state)
         if not self._compiled_lanes:
             self._load_state_eager(state, validate=validate, check_finite=check_finite)
@@ -1789,10 +1789,10 @@ class LanedMetric(Metric):
             # never device-attributed, so a zero counter is the exact restore
             state["lane_health"] = np.zeros_like(np.asarray(state["lane_updates"]))
         if table is not None and validate != "off" and table.capacity != cap:
-            raise StateCorruptionError(
+            raise obs.flighted(StateCorruptionError(
                 f"{type(self).__name__}: lane directory says capacity {table.capacity} but state"
                 f" arrays carry {cap} lanes"
-            )
+            ), domain="lanes")
         if cap != self.capacity:
             self._respec_capacity(cap)
         # the stacked-lane finite scan runs per-lane below (naming poisoned
@@ -1845,7 +1845,7 @@ class LanedMetric(Metric):
             shape = np.shape(v)
             if len(shape) > axis:
                 return int(shape[axis])
-        raise StateCorruptionError(f"{type(self).__name__}: no state field carries a lane axis")
+        raise obs.flighted(StateCorruptionError(f"{type(self).__name__}: no state field carries a lane axis"), domain="lanes")
 
     def _respec_capacity(self, capacity: int) -> None:
         """Re-register the stacked defaults (and fresh states) at ``capacity``
@@ -1874,25 +1874,25 @@ class LanedMetric(Metric):
         table: LaneTable = self.__dict__["_table"]
         if mode != "off":
             if table.capacity != self.capacity:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: directory capacity {table.capacity} !="
                     f" state capacity {self.capacity}"
-                )
+                ), domain="lanes")
             for aux in self._LANE_AUX_FIELDS:
                 counts = np.asarray(self._state[aux])
                 if sharded:
                     counts = counts.sum(axis=0)
                 if counts.ndim != 1 or counts.shape[0] != self.capacity:
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: {aux} has shape {counts.shape},"
                         f" expected ({self.capacity},)"
-                    )
+                    ), domain="lanes")
                 bad = np.flatnonzero(counts < 0)
                 if bad.size:
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: negative per-lane {aux} counts in lane(s)"
                         f" {[int(b) for b in bad[:8]]}"
-                    )
+                    ), domain="lanes")
         if check_finite and not sharded:
             # the stacked lane layout shares the sharded per-shard scan: a
             # poisoned lane is NAMED instead of failing the whole array
@@ -1906,13 +1906,13 @@ class LanedMetric(Metric):
         table = _decode_directory(blob) if blob is not None else None
         lane_keys = sorted(k for k in state if isinstance(k, str) and k.startswith("lane_"))
         if not lane_keys:
-            raise StateCorruptionError(f"{type(self).__name__}: export holds no lane_* states")
+            raise obs.flighted(StateCorruptionError(f"{type(self).__name__}: export holds no lane_* states"), domain="lanes")
         capacity = len(lane_keys)
         if table is not None and validate != "off" and table.capacity != capacity:
-            raise StateCorruptionError(
+            raise obs.flighted(StateCorruptionError(
                 f"{type(self).__name__}: lane directory says capacity {table.capacity} but export"
                 f" holds {capacity} lanes"
-            )
+            ), domain="lanes")
         staged, counts = [], []
         for key in lane_keys:
             sub = dict(state[key])
@@ -1920,7 +1920,7 @@ class LanedMetric(Metric):
             try:
                 checked = inner.validate_state(sub, mode=validate, check_finite=check_finite)
             except StateCorruptionError as err:
-                raise StateCorruptionError(f"{type(self).__name__}: {key}: {err}") from err
+                raise obs.flighted(StateCorruptionError(f"{type(self).__name__}: {key}: {err}"), domain="lanes") from err
             staged.append(
                 {
                     f: (list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
@@ -2173,7 +2173,7 @@ class LanedCollection:
                     baselines[name] = baseline
                     m.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
                 try:
-                    with obs.span(obs.SPAN_LANES, owner="LanedCollection", rows=rows, bucket=bucket):
+                    with obs.span(obs.SPAN_LANES, owner="LanedCollection", histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
                         self.collection.update(
                             jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch
                         )
@@ -2341,10 +2341,10 @@ class LanedCollection:
         first = tables[0]
         for t in tables[1:]:
             if t.sessions != first.sessions or t.capacity != first.capacity:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     "restored members disagree on the session->lane directory;"
                     " the snapshot does not describe one coherent laned collection"
-                )
+                ), domain="lanes")
         self._table = first
         for m in self._members.values():
             m.__dict__["_table"] = first

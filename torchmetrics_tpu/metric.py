@@ -721,6 +721,9 @@ class Metric:
                     from torchmetrics_tpu.quarantine import DegradedValue
 
                     count, cached = self.__dict__["_last_good_compute"]
+                    obs.histogram_observe(
+                        "reads.staleness_age_updates", int(self._update_count) - count
+                    )
                     return DegradedValue(
                         value=cached,
                         updates_behind=int(self._update_count) - count,
@@ -992,9 +995,10 @@ class Metric:
                 # instead of a silently-partial local one
                 self.__dict__["_serve_last_good"] = True
                 obs.counter_inc("sync.degraded_last_good")
-                obs.breadcrumb(
+                obs.fault_breadcrumb(
                     "sync_degraded_last_good",
-                    {"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
+                    domain="sync",
+                    data={"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
                 )
                 rank_zero_warn(
                     f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
@@ -1004,9 +1008,10 @@ class Metric:
                 )
                 return
             obs.counter_inc("sync.degraded_local")
-            obs.breadcrumb(
+            obs.fault_breadcrumb(
                 "sync_degraded_local",
-                {"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
+                domain="sync",
+                data={"metric": type(self).__name__, "error": f"{type(err).__name__}: {err}"},
             )
             rank_zero_warn(
                 f"Multi-host sync of {type(self).__name__} failed ({type(err).__name__}: {err});"
@@ -1416,69 +1421,69 @@ class Metric:
         if mode not in ("strict", "cast"):
             raise ValueError(f"validate must be 'strict', 'cast' or 'off', got {mode!r}")
         if not isinstance(state, dict):
-            raise StateCorruptionError(
+            raise obs.flighted(StateCorruptionError(
                 f"{type(self).__name__}: state must be a dict pytree, got {type(state).__name__}"
-            )
+            ), domain="checkpoint")
         spec = self.state_spec()["fields"]
         out: Dict[str, Any] = dict(state)
         shard_counts: Dict[str, int] = {}
         for name, field_spec in spec.items():
             if name not in state:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: state is missing declared field {name!r}"
                     f" (has {sorted(k for k in state if k not in self._RESERVED_STATE_KEYS)})"
-                )
+                ), domain="checkpoint")
             value = state[name]
             if field_spec["kind"] == "list":
                 if sharded:
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: field {name!r} is a list state; list states"
                         " cannot carry a shard axis (sharded=True)"
-                    )
+                    ), domain="checkpoint")
                 if not isinstance(value, (list, tuple)):
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: field {name!r} is a list state but the restored"
                         f" value is {type(value).__name__}"
-                    )
+                    ), domain="checkpoint")
                 if check_finite:
                     for i, el in enumerate(value):
                         self._check_field_finite(name, el, index=i)
                 continue
             if isinstance(value, (list, tuple)):
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: field {name!r} is an array state but the restored"
                     f" value is a {type(value).__name__}"
-                )
+                ), domain="checkpoint")
             arr = value if hasattr(value, "shape") and hasattr(value, "dtype") else np.asarray(value)
             if sharded:
                 if arr.ndim < 1 or (
                     field_spec["shape_invariant"] and tuple(arr.shape[1:]) != field_spec["shape"]
                 ):
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: sharded field {name!r} has shape {tuple(arr.shape)}"
                         f" but the stacked layout requires (num_shards, *{field_spec['shape']})"
-                    )
+                    ), domain="checkpoint")
                 shard_counts[name] = int(arr.shape[0])
             elif field_spec["shape_invariant"] and tuple(arr.shape) != field_spec["shape"]:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: field {name!r} has shape {tuple(arr.shape)} but this"
                     f" metric's state layout requires {field_spec['shape']}"
-                )
+                ), domain="checkpoint")
             if str(arr.dtype) != field_spec["dtype"]:
                 if mode == "cast":
                     out[name] = jnp.asarray(value).astype(field_spec["dtype"])
                 else:
-                    raise StateCorruptionError(
+                    raise obs.flighted(StateCorruptionError(
                         f"{type(self).__name__}: field {name!r} has dtype {arr.dtype} but this"
                         f" metric's state layout requires {field_spec['dtype']}"
                         " (use validate='cast' to convert)"
-                    )
+                    ), domain="checkpoint")
             if check_finite:
                 self._check_field_finite(name, out[name], per_shard=sharded)
         if sharded and len(set(shard_counts.values())) > 1:
-            raise StateCorruptionError(
+            raise obs.flighted(StateCorruptionError(
                 f"{type(self).__name__}: sharded fields disagree on the shard count: {shard_counts}"
-            )
+            ), domain="checkpoint")
         return out
 
     def _check_field_finite(
@@ -1494,17 +1499,17 @@ class Metric:
             shard_ok = jnp.all(jnp.isfinite(arr).reshape(arr.shape[0], -1), axis=1)
             if not bool(jnp.all(shard_ok)):
                 bad = [int(i) for i in np.flatnonzero(~np.asarray(shard_ok))]
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: sharded field {name!r} contains non-finite values"
                     f" in shard(s) {bad} (check_finite=True rejects NaN/Inf accumulators)"
-                )
+                ), domain="checkpoint")
             return
         if not bool(jnp.all(jnp.isfinite(arr))):
             where = f"{name!r}[{index}]" if index is not None else f"{name!r}"
-            raise StateCorruptionError(
+            raise obs.flighted(StateCorruptionError(
                 f"{type(self).__name__}: field {where} contains non-finite values"
                 " (check_finite=True rejects NaN/Inf accumulators)"
-            )
+            ), domain="checkpoint")
 
     def init_state(self) -> Dict[str, Any]:
         """A fresh default state pytree (the pure analogue of ``reset``)."""
@@ -1726,7 +1731,7 @@ class Metric:
         staged: Dict[str, Any] = {}
         for k in self._defaults:
             if k not in state:
-                raise StateCorruptionError(f"state missing field {k!r}")
+                raise obs.flighted(StateCorruptionError(f"state missing field {k!r}"), domain="checkpoint")
             v = state[k]
             staged[k] = list(v) if isinstance(v, (list, tuple)) else v
         num_shards: Optional[int] = None
@@ -1736,9 +1741,9 @@ class Metric:
                     num_shards = int(jnp.asarray(v).shape[0])
                     break
             if num_shards is None:
-                raise StateCorruptionError(
+                raise obs.flighted(StateCorruptionError(
                     f"{type(self).__name__}: sharded=True but no array field carries a shard axis"
-                )
+                ), domain="checkpoint")
         self._state.update(staged)
         self.__dict__["_state_escaped"] = True  # installed arrays have external aliases
         self._computed = None

@@ -25,6 +25,22 @@ Nothing here ever blocks async dispatch: device completion is timed via
 step loop does not), and with both flags off a :func:`span` costs exactly the
 ``TraceAnnotation`` the pre-obs call sites already paid.
 """
+from torchmetrics_tpu.obs.flight import (  # noqa: F401
+    DOMAIN_OF_SPAN,
+    DOMAINS,
+    FLIGHT_BUFFER_ENV,
+    FLIGHT_DIR_ENV,
+    FLIGHT_ENV,
+    fault_breadcrumb,
+    flighted,
+    persist_flight,
+    reset_flight,
+    set_flight,
+)
+from torchmetrics_tpu.obs.flight import blob as flight_blob  # noqa: F401
+from torchmetrics_tpu.obs.flight import enabled as flight_enabled  # noqa: F401
+from torchmetrics_tpu.obs.flight import note as flight_note  # noqa: F401
+from torchmetrics_tpu.obs.flight import snapshot as flight_snapshot  # noqa: F401
 from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_AUTOSAVE,
     SPAN_CACHE_LOAD,
@@ -41,8 +57,10 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_NAMES,
     SPAN_PAD,
     SPAN_QUARANTINE,
+    SPAN_READ_RESOLVE,
     SPAN_REDUCE,
     SPAN_RESHARD,
+    SPAN_SHADOW,
     SPAN_SYNC_GATHER,
     SPAN_UPDATE,
     SPAN_WARMUP,
@@ -50,6 +68,9 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     TRACE_BUFFER_ENV,
     TRACE_ENV,
     SpanEvent,
+    TraceContext,
+    capture_context,
+    current_trace_id,
     device_span,
     drain_events,
     flush_ready_observations,
@@ -63,13 +84,18 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     span,
     telemetry_enabled,
     tracing_enabled,
+    use_context,
 )
 from torchmetrics_tpu.obs.registry import (  # noqa: F401
+    AGE_BUCKETS_UPDATES,
+    LATENCY_BUCKETS_US,
     breadcrumb,
     counter_inc,
     counters_snapshot,
     dump_diagnostics,
     gauge_set,
+    histogram_observe,
+    histograms_snapshot,
     register_executor,
     reset,
     telemetry_snapshot,
@@ -83,32 +109,48 @@ from torchmetrics_tpu.obs.export import (  # noqa: F401
 )
 
 __all__ = [
+    "DOMAINS",
     "SPAN_NAMES",
     "SpanEvent",
+    "TraceContext",
     "PeriodicExporter",
     "breadcrumb",
+    "capture_context",
     "chrome_trace",
     "counter_inc",
     "counters_snapshot",
+    "current_trace_id",
     "device_span",
     "drain_events",
     "dump_diagnostics",
+    "fault_breadcrumb",
+    "flight_blob",
+    "flight_enabled",
+    "flight_note",
+    "flight_snapshot",
+    "flighted",
     "flush_ready_observations",
     "gauge_set",
+    "histogram_observe",
+    "histograms_snapshot",
     "observe_ready",
     "peek_events",
+    "persist_flight",
     "prometheus_text",
     "record_span",
     "register_executor",
     "reset",
+    "reset_flight",
     "reset_ring",
     "ring_stats",
+    "set_flight",
     "set_telemetry",
     "set_tracing",
     "span",
     "telemetry_enabled",
     "telemetry_snapshot",
     "tracing_enabled",
+    "use_context",
     "write_chrome_trace",
     "write_prometheus",
 ]

@@ -57,9 +57,38 @@ def chrome_trace(
                 "pid": pid,
                 "tid": ev.tid,
             }
-            if ev.attrs:
-                entry["args"] = dict(ev.attrs)
+            args = dict(ev.attrs) if ev.attrs else {}
+            if ev.trace_id:
+                args["trace_id"] = ev.trace_id
+                args["span_id"] = ev.span_id
+                if ev.parent_id:
+                    args["parent_id"] = ev.parent_id
+            if args:
+                entry["args"] = args
             trace_events.append(entry)
+            # a span opened under a reopened TraceContext carries its flow
+            # source: emit the Perfetto flow-event pair (ph "s" inside the
+            # submitting slice on the submitting thread, ph "f" binding to
+            # the worker-side slice) so submit -> worker replay renders as an
+            # arrow across thread lanes (docs/OBSERVABILITY.md)
+            if ev.flow_src:
+                src_span, src_tid, src_t_ns = ev.flow_src
+                flow_id = ev.span_id or src_span
+                flow_args = {"trace_id": ev.trace_id, "from_span": src_span, "to_span": ev.span_id}
+                trace_events.append(
+                    {
+                        "name": "tm_tpu.flow", "cat": "tm_tpu", "ph": "s",
+                        "id": flow_id, "ts": src_t_ns / 1e3, "pid": pid,
+                        "tid": src_tid, "args": flow_args,
+                    }
+                )
+                trace_events.append(
+                    {
+                        "name": "tm_tpu.flow", "cat": "tm_tpu", "ph": "f", "bp": "e",
+                        "id": flow_id, "ts": ev.t_start_ns / 1e3, "pid": pid,
+                        "tid": ev.tid, "args": flow_args,
+                    }
+                )
         return {
             "traceEvents": trace_events,
             "displayTimeUnit": "ms",
@@ -89,30 +118,74 @@ def _sanitize(name: str) -> str:
     return "".join(out)
 
 
-def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
-    """The counter/gauge registry in Prometheus text exposition format.
+#: curated # HELP text for the high-traffic series; everything else gets a
+#: generated line pointing at the glossary (strict scrapers require HELP and
+#: TYPE for EVERY family — bare samples are rejected)
+_HELP_TEXT = {
+    "reads_e2e_latency_us": "end-to-end async read latency, submit to future resolution (microseconds)",
+    "reads_queue_wait_us": "async read queue wait, submit to worker pickup (microseconds)",
+    "reads_staleness_age_updates": "staleness of served DegradedValue reads, in committed updates behind",
+    "shards_shadow_staleness_updates": "shard-shadow staleness at serve/refresh points, in committed updates",
+    "executor_dispatch_us": "host-side compiled dispatch (enqueue) duration (microseconds)",
+    "lanes_dispatch_us": "laned multi-session dispatch duration, pack+scatter (microseconds)",
+}
 
-    Counters render as ``tm_tpu_<name>_total`` with ``# TYPE … counter``;
-    gauges as ``tm_tpu_<name>``. Dots in registry names become underscores.
-    ``snapshot`` defaults to a fresh :func:`~torchmetrics_tpu.obs.telemetry_snapshot`.
+
+def _help_line(metric: str, base: str, kind: str) -> str:
+    text = _HELP_TEXT.get(base, f"torchmetrics_tpu {kind} {base} (docs/OBSERVABILITY.md)")
+    return f"# HELP {metric} {text}"
+
+
+def _format_le(value: float) -> str:
+    return str(int(value)) if float(value).is_integer() else repr(float(value))
+
+
+def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """The counter/gauge/histogram registry in Prometheus text exposition.
+
+    Counters render as ``tm_tpu_<name>_total`` with ``# HELP``/``# TYPE …
+    counter``; gauges as ``tm_tpu_<name>``; histograms as the standard
+    ``_bucket{le=…}``/``_sum``/``_count`` triple under ``# TYPE … histogram``
+    with cumulative bucket counts and a closing ``+Inf`` bucket. Every series
+    carries both HELP and TYPE — strict scrapers reject bare samples. Dots in
+    registry names become underscores. ``snapshot`` defaults to a fresh
+    :func:`~torchmetrics_tpu.obs.telemetry_snapshot`.
     """
     with _tracer.span(_tracer.SPAN_EXPORT, fmt="prometheus"):
         if snapshot is None:
             snapshot = _registry.telemetry_snapshot()
         lines: List[str] = []
         for name, value in sorted(snapshot.get("counters", {}).items()):
-            metric = f"tm_tpu_{_sanitize(name)}_total"
+            base = _sanitize(name)
+            metric = f"tm_tpu_{base}_total"
+            lines.append(_help_line(metric, base, "counter"))
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} {value}")
         for name, value in sorted(snapshot.get("gauges", {}).items()):
-            metric = f"tm_tpu_{_sanitize(name)}"
+            base = _sanitize(name)
+            metric = f"tm_tpu_{base}"
+            lines.append(_help_line(metric, base, "gauge"))
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
+        for name, hist in sorted(snapshot.get("histograms", {}).items()):
+            base = _sanitize(name)
+            metric = f"tm_tpu_{base}"
+            lines.append(_help_line(metric, base, "histogram"))
+            lines.append(f"# TYPE {metric} histogram")
+            cumulative = 0
+            for le, count in zip(hist["buckets"], hist["counts"]):
+                cumulative += count
+                lines.append(f'{metric}_bucket{{le="{_format_le(le)}"}} {cumulative}')
+            cumulative += hist["counts"][-1]
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {cumulative}')
+            lines.append(f"{metric}_sum {hist['sum']}")
+            lines.append(f"{metric}_count {hist['count']}")
         spans = snapshot.get("spans") or {}
         for key in ("buffered", "recorded_total", "dropped_total"):
             if key in spans:
                 metric = f"tm_tpu_spans_{key}"
                 kind = "gauge" if key == "buffered" else "counter"
+                lines.append(_help_line(metric, f"spans_{key}", kind))
                 lines.append(f"# TYPE {metric} {kind}")
                 lines.append(f"{metric} {spans[key]}")
         return "\n".join(lines) + "\n"

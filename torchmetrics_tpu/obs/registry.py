@@ -36,12 +36,14 @@ one-release ``compile_ms_total`` alias is gone (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
+import bisect
 import os
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from torchmetrics_tpu.obs import flight as _flight
 from torchmetrics_tpu.obs import tracer as _tracer
 
 _BREADCRUMB_CAP = 256
@@ -50,9 +52,90 @@ _lock = threading.Lock()
 _counters: Dict[str, float] = {}
 _gauges: Dict[str, float] = {}
 _breadcrumbs: List[Dict[str, Any]] = []
+_histograms: Dict[str, "_Histogram"] = {}
 #: executors register here at construction (ops/executor.py); weak so a
 #: dropped metric releases its executor and its stats leave the global view
 _executors: "weakref.WeakSet" = weakref.WeakSet()
+
+
+# ---------------------------------------------------------------- histograms
+#: default bucket ladder for host-side latency instruments, in MICROSECONDS —
+#: spans two clock ticks (~50 us VM resolution) through multi-second stalls;
+#: the tables are documented in docs/OBSERVABILITY.md "Histograms"
+LATENCY_BUCKETS_US: Tuple[float, ...] = (
+    50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10_000.0, 25_000.0,
+    50_000.0, 100_000.0, 250_000.0, 500_000.0, 1_000_000.0, 5_000_000.0,
+)
+#: default bucket ladder for staleness-age instruments, in COMMITTED UPDATES —
+#: powers of two matching the shadow/lane cadence knobs (every_n_steps,
+#: breaker windows) so "how stale was the degraded value" reads off directly
+AGE_BUCKETS_UPDATES: Tuple[float, ...] = (
+    0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0,
+)
+
+
+class _Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics: bucket ``i``
+    counts observations ``<= buckets[i]``, one overflow slot for +Inf, plus
+    running sum/count). Mutated under the registry lock."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float]) -> None:
+        b = tuple(float(x) for x in buckets)
+        if not b or list(b) != sorted(b):
+            raise ValueError(f"histogram buckets must be non-empty and ascending, got {b}")
+        self.buckets = b
+        self.counts = [0] * (len(b) + 1)  # last slot: > buckets[-1] (+Inf)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.sum += value
+        self.count += 1
+
+
+def default_buckets(name: str) -> Tuple[float, ...]:
+    """Bucket table for a histogram created without an explicit one: ``_us``
+    names get the latency ladder, staleness-age names (``updates``/``age``/
+    ``behind``) the power-of-two update ladder."""
+    if name.endswith("_us"):
+        return LATENCY_BUCKETS_US
+    if any(tok in name for tok in ("updates", "age", "behind")):
+        return AGE_BUCKETS_UPDATES
+    return LATENCY_BUCKETS_US
+
+
+def histogram_observe(name: str, value: float, buckets: Optional[Sequence[float]] = None) -> None:
+    """Record one observation into the named fixed-bucket histogram (created
+    on first observation; ``buckets`` overrides :func:`default_buckets` then).
+    No-op when telemetry is off. Histograms replace last-value gauges for
+    anything distributional — read latency, queue wait, staleness age —
+    because a gauge scraped every 15s hides everything between scrapes."""
+    if not _tracer.telemetry_enabled():
+        return
+    with _lock:
+        hist = _histograms.get(name)
+        if hist is None:
+            hist = _Histogram(buckets if buckets is not None else default_buckets(name))
+            _histograms[name] = hist
+        hist.observe(float(value))
+
+
+def histograms_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Every histogram as ``{"buckets", "counts", "sum", "count"}`` (counts
+    are per-bucket, NOT cumulative; the Prometheus exporter cumulates)."""
+    with _lock:
+        return {
+            name: {
+                "buckets": list(h.buckets),
+                "counts": list(h.counts),
+                "sum": h.sum,
+                "count": h.count,
+            }
+            for name, h in _histograms.items()
+        }
 
 
 def counter_inc(name: str, value: float = 1) -> None:
@@ -121,7 +204,12 @@ def _aggregate_executor_stats() -> Dict[str, float]:
     return agg
 
 
-def reset(counters: bool = True, gauges: bool = True, breadcrumbs: bool = True) -> None:
+def reset(
+    counters: bool = True,
+    gauges: bool = True,
+    breadcrumbs: bool = True,
+    histograms: bool = True,
+) -> None:
     """Zero the global registry (tests/bench isolation). Executor-local stats
     are owned by their instances and are NOT touched."""
     with _lock:
@@ -131,6 +219,8 @@ def reset(counters: bool = True, gauges: bool = True, breadcrumbs: bool = True) 
             _gauges.clear()
         if breadcrumbs:
             del _breadcrumbs[:]
+        if histograms:
+            _histograms.clear()
 
 
 def counters_snapshot() -> Dict[str, float]:
@@ -178,6 +268,7 @@ def telemetry_snapshot(obj: Any = None) -> Dict[str, Any]:
         "scope": "process",
         "counters": counters,
         "gauges": gauges,
+        "histograms": histograms_snapshot(),
         "spans": _tracer.ring_stats(),
         "telemetry_enabled": _tracer.telemetry_enabled(),
     }
@@ -210,6 +301,7 @@ def dump_diagnostics(obj: Any = None) -> Dict[str, Any]:
         "time_unix": time.time(),
         "telemetry": telemetry_snapshot(obj),
         "breadcrumbs": crumbs,
+        "flight": _flight.snapshot(),
         "env": env,
         "versions": versions,
     }
@@ -225,3 +317,9 @@ def dump_diagnostics(obj: Any = None) -> Dict[str, Any]:
             rank_zero_debug(f"dump_diagnostics: quarantine_table probe failed ({err})")
             out["lane_quarantine"] = {"error": f"{type(err).__name__}: {err}"}
     return out
+
+
+# spans constructed with ``histogram=`` feed their duration through this hook;
+# installed here (not imported by the tracer) to keep tracer -> registry
+# dependency-free while the obs package always wires it at import
+_tracer._HISTOGRAM_SINK = histogram_observe

@@ -37,13 +37,17 @@ drift apart (docs/OBSERVABILITY.md lists them all).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, NamedTuple, Optional
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
+
+from torchmetrics_tpu.obs import flight as _flight
 
 #: master telemetry switch (counters + gauges + breadcrumbs); default ON —
 #: counter increments are a handful of dict ops per step
@@ -79,6 +83,8 @@ SPAN_QUARANTINE = "tm_tpu.lanes.quarantine"  # lane fault containment (rollback 
 SPAN_COMPUTE_ASYNC = "tm_tpu.compute_async"  # async-read submission (caller-side half only)
 SPAN_RESHARD = "tm_tpu.reshard"            # elastic N->M re-split (restore / shard-loss recovery)
 SPAN_KERNEL = "tm_tpu.kernel"              # backend-dispatched Pallas/XLA kernel body (per kernel name)
+SPAN_READ_RESOLVE = "tm_tpu.read.resolve"  # read-pipeline worker: the blocking tail of one job
+SPAN_SHADOW = "tm_tpu.shadow.refresh"      # shard-shadow refresh (submit half + worker half)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -101,6 +107,8 @@ SPAN_NAMES = (
     SPAN_COMPUTE_ASYNC,
     SPAN_RESHARD,
     SPAN_KERNEL,
+    SPAN_READ_RESOLVE,
+    SPAN_SHADOW,
 )
 
 
@@ -157,17 +165,118 @@ def set_tracing(enabled: Optional[bool]) -> None:
 
 class SpanEvent(NamedTuple):
     """One completed host-side span. Times are ``time.perf_counter_ns`` values
-    (monotonic, process-local); exporters convert to µs."""
+    (monotonic, process-local); exporters convert to µs.
+
+    The causal fields (ISSUE 13): ``trace_id`` groups every span of one
+    logical operation across threads (a ``compute_async`` submission and its
+    worker-side replay share one), ``span_id``/``parent_id`` form the
+    in-trace tree, and ``flow_src`` — set on the FIRST span a worker opens
+    under a reopened :class:`TraceContext` — carries ``(src_span_id,
+    src_tid, src_t_ns)`` of the submitting side so the exporter can emit the
+    Perfetto flow-event pair (``ph:"s"``/``ph:"f"``) linking submit to
+    worker replay. All default to the pre-causal values so positional
+    construction (tests, :func:`record_span`) keeps working."""
 
     name: str
     t_start_ns: int
     t_end_ns: int
     tid: int
     attrs: Optional[Dict[str, Any]]
+    trace_id: int = 0
+    span_id: int = 0
+    parent_id: int = 0
+    flow_src: Optional[Tuple[int, int, int]] = None
 
     @property
     def duration_us(self) -> float:
         return (self.t_end_ns - self.t_start_ns) / 1e3
+
+
+# ------------------------------------------------------------ causal context
+#: process-wide id source for trace/span ids (next() is atomic under the GIL)
+_ids = itertools.count(1)
+
+
+def _next_id() -> int:
+    return next(_ids)
+
+
+class TraceContext(NamedTuple):
+    """A submission-side capture that rides a job object across threads.
+
+    ``trace_id`` is the logical operation's identity; ``span_id`` the span
+    open at capture time (the flow source a worker-side span links back to);
+    ``tid``/``t_ns`` pin where and when the capture happened so the exporter
+    can bind the Perfetto flow-start inside the submitting slice. Capture
+    with :func:`capture_context` at the submit site, reopen with
+    :func:`use_context` on the worker."""
+
+    trace_id: int
+    span_id: int
+    tid: int
+    t_ns: int
+
+
+class _TraceTLS(threading.local):
+    """Per-thread causal state: the ambient trace id, the open-span stack,
+    and the pending flow source a reopened context plants for the first
+    worker-side span to consume."""
+
+    def __init__(self) -> None:
+        self.trace_id = 0
+        self.stack: List[int] = []
+        self.flow_src: Optional[Tuple[int, int, int]] = None
+
+
+_trace_tls = _TraceTLS()
+
+
+def capture_context() -> Optional[TraceContext]:
+    """Capture the current thread's causal position for a cross-thread
+    handoff (None when tracing is off — the context is then zero-cost to
+    carry and :func:`use_context` is a no-op). Outside any span a fresh
+    trace id is minted so the worker side still groups under one trace."""
+    if not _flags.tracing:
+        return None
+    tls = _trace_tls
+    return TraceContext(
+        tls.trace_id or _next_id(),
+        tls.stack[-1] if tls.stack else 0,
+        threading.get_ident(),
+        time.perf_counter_ns(),
+    )
+
+
+@contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Reopen a captured :class:`TraceContext` on THIS thread: spans opened
+    inside inherit the submitter's ``trace_id`` (parented under the
+    submitting span), and the first of them records the flow source the
+    exporter turns into a Perfetto flow-event pair. ``use_context(None)`` is
+    a no-op, which is what makes carrying the context free when tracing is
+    off."""
+    if ctx is None or not _flags.tracing:
+        yield
+        return
+    tls = _trace_tls
+    prev = (tls.trace_id, tls.stack, tls.flow_src)
+    tls.trace_id = ctx.trace_id
+    tls.stack = [ctx.span_id] if ctx.span_id else []
+    tls.flow_src = (ctx.span_id, ctx.tid, ctx.t_ns) if ctx.span_id else None
+    try:
+        yield
+    finally:
+        tls.trace_id, tls.stack, tls.flow_src = prev
+
+
+def current_trace_id() -> int:
+    """The ambient trace id on this thread (0 outside any span/context)."""
+    return _trace_tls.trace_id
+
+
+#: installed by obs/registry.py at import (avoids a module cycle): spans
+#: constructed with ``histogram="name"`` feed their duration here
+_HISTOGRAM_SINK: Optional[Callable[[str, float], None]] = None
 
 
 class _Ring:
@@ -266,35 +375,87 @@ def ring_stats() -> Dict[str, Any]:
 
 
 def record_span(
-    name: str, t_start_ns: int, t_end_ns: int, attrs: Optional[Dict[str, Any]] = None
+    name: str,
+    t_start_ns: int,
+    t_end_ns: int,
+    attrs: Optional[Dict[str, Any]] = None,
+    ctx: Optional[TraceContext] = None,
 ) -> None:
     """Record a pre-timed span (the :func:`observe_ready` observer and tests
-    use this; prefer the :class:`span` context manager)."""
+    use this; prefer the :class:`span` context manager). ``ctx`` — a
+    submission-side :func:`capture_context` — threads the causal ids through
+    so even observer-recorded spans group under the submitting trace."""
     if _flags.tracing:
-        _ring.append(SpanEvent(name, t_start_ns, t_end_ns, threading.get_ident(), attrs))
+        if ctx is not None:
+            _ring.append(
+                SpanEvent(
+                    name, t_start_ns, t_end_ns, threading.get_ident(), attrs,
+                    ctx.trace_id, _next_id(), ctx.span_id,
+                    (ctx.span_id, ctx.tid, ctx.t_ns) if ctx.span_id else None,
+                )
+            )
+        else:
+            _ring.append(SpanEvent(name, t_start_ns, t_end_ns, threading.get_ident(), attrs))
 
 
 class span:
-    """Host-side span: ``TraceAnnotation`` always, ring event when tracing.
+    """Host-side span: ``TraceAnnotation`` always, ring event when tracing,
+    flight record always-on (telemetry master switch) for seams with a
+    flight domain, causal ids riding every traced event.
 
     ``with span(SPAN_REDUCE): ...`` or ``with span(SPAN_DISPATCH, owner=name)``.
     The owner/attrs ride into the chrome-trace ``args`` and the profiler
     annotation name stays the bare canonical name plus an optional ``/suffix``
     (``span(SPAN_DISPATCH, suffix=owner)`` renders ``tm_tpu.dispatch/Owner``,
-    the spelling the pre-obs call sites used).
+    the spelling the pre-obs call sites used). ``histogram="some.metric_us"``
+    additionally feeds the span's duration into the named registry histogram
+    (telemetry on only) — the dispatch-duration instrument rides this.
+
+    Cost model: telemetry off — the ``TraceAnnotation`` alone, exactly as
+    before. Telemetry on, tracing off (the default): two clock reads and one
+    lock-free deque append, ONLY for spans whose canonical name maps to a
+    flight domain (obs/flight.py) or that declare a histogram. Tracing on:
+    the above plus the causal-id bookkeeping and the locked ring append.
     """
 
-    __slots__ = ("name", "attrs", "_ann", "_t0")
+    __slots__ = (
+        "name", "attrs", "_ann", "_t0", "_sid", "_trace_id", "_parent",
+        "_flow", "_owns_trace", "_domain", "_hist",
+    )
 
-    def __init__(self, name: str, suffix: Optional[str] = None, **attrs: Any) -> None:
+    def __init__(
+        self,
+        name: str,
+        suffix: Optional[str] = None,
+        histogram: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        self._domain = _flight.DOMAIN_OF_SPAN.get(name)
+        self._hist = histogram
         self.name = f"{name}/{suffix}" if suffix else name
         self.attrs = attrs or None
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._t0 = 0
+        self._sid = 0
 
     def __enter__(self) -> "span":
         self._ann.__enter__()
-        if _flags.tracing:
+        f = _flags
+        if f.tracing:
+            self._t0 = time.perf_counter_ns()
+            tls = _trace_tls
+            self._sid = _next_id()
+            self._parent = tls.stack[-1] if tls.stack else 0
+            self._owns_trace = not tls.trace_id
+            if self._owns_trace:
+                tls.trace_id = _next_id()
+            self._trace_id = tls.trace_id
+            self._flow = tls.flow_src
+            tls.flow_src = None
+            tls.stack.append(self._sid)
+        elif f.telemetry and (
+            (self._domain is not None and _flight.enabled()) or self._hist is not None
+        ):
             self._t0 = time.perf_counter_ns()
         return self
 
@@ -305,8 +466,32 @@ class span:
             if exc_type is not None:
                 attrs = dict(attrs or ())
                 attrs["error"] = exc_type.__name__
-            _ring.append(SpanEvent(self.name, self._t0, t1, threading.get_ident(), attrs))
+            trace_id = 0
+            if self._sid:
+                tls = _trace_tls
+                if tls.stack and tls.stack[-1] == self._sid:
+                    tls.stack.pop()
+                if self._owns_trace:
+                    tls.trace_id = 0
+                trace_id = self._trace_id
+                if _flags.tracing:
+                    _ring.append(
+                        SpanEvent(
+                            self.name, self._t0, t1, threading.get_ident(), attrs,
+                            trace_id, self._sid, self._parent, self._flow,
+                        )
+                    )
+            if _flags.telemetry:
+                dur_us = (t1 - self._t0) / 1e3
+                if self._domain is not None and _flight.enabled():
+                    _flight.record(
+                        self._domain, self.name, dur_us, trace_id=trace_id,
+                        error=exc_type.__name__ if exc_type is not None else None,
+                    )
+                if self._hist is not None and _HISTOGRAM_SINK is not None:
+                    _HISTOGRAM_SINK(self._hist, dur_us)
             self._t0 = 0
+            self._sid = 0
         return self._ann.__exit__(exc_type, exc, tb)
 
 
@@ -343,10 +528,10 @@ class _ReadyObserver:
 
     def _run(self) -> None:
         while True:
-            name, t0, value, attrs = self._jobs.get()
+            name, t0, value, attrs, ctx = self._jobs.get()
             try:
                 jax.block_until_ready(value)
-                record_span(name, t0, time.perf_counter_ns(), attrs)
+                record_span(name, t0, time.perf_counter_ns(), attrs, ctx=ctx)
             except Exception as err:
                 # a donated-away or deleted buffer is not an incident; record
                 # the attempt so the trace shows the observation was shed
@@ -358,6 +543,7 @@ class _ReadyObserver:
                 record_span(
                     name, t0, time.perf_counter_ns(),
                     {**(attrs or {}), "error": type(err).__name__},
+                    ctx=ctx,
                 )
             finally:
                 self._jobs.task_done()
@@ -365,7 +551,7 @@ class _ReadyObserver:
     def submit(self, name: str, t0: int, value: Any, attrs: Optional[Dict[str, Any]]) -> bool:
         self._ensure_thread()
         try:
-            self._jobs.put_nowait((name, t0, value, attrs))
+            self._jobs.put_nowait((name, t0, value, attrs, capture_context()))
             return True
         except queue.Full:
             self.dropped += 1

@@ -50,6 +50,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 import weakref
 from typing import Any, Callable, Dict, Optional
 
@@ -240,32 +241,66 @@ class ReadPipeline:
                 )
                 self._thread.start()
 
-    def _execute(self, job: Callable[[], Any], fut: MetricFuture) -> None:
+    def _execute(
+        self,
+        job: Callable[[], Any],
+        fut: MetricFuture,
+        ctx: Any = None,
+        t_submit_ns: int = 0,
+    ) -> None:
+        """Run one read job: the worker-side half of the causal trace.
+
+        The submission-side :class:`~torchmetrics_tpu.obs.TraceContext` is
+        reopened here (``obs.use_context``) so the ``tm_tpu.read.resolve``
+        span — and every span the job itself opens (reduce, sync, checkpoint
+        write) — carries the submitter's ``trace_id`` with a flow-event pair
+        back to the submitting slice. Queue-wait and end-to-end latency land
+        in the registry histograms (``t_submit_ns`` is 0 when telemetry was
+        off at submission — then nothing is observed)."""
         from torchmetrics_tpu import obs
         from torchmetrics_tpu.quarantine import DegradedValue
 
-        try:
-            value = job()
-        except BaseException as err:  # the future carries it to result()
-            self.stats["errors"] += 1
-            obs.counter_inc("reads.async_errors")
-            rank_zero_debug(f"async read of {fut.owner or 'metric'} failed: {type(err).__name__}: {err}")
-            fut._finish(None, err)
-            return
+        if t_submit_ns:
+            obs.histogram_observe(
+                "reads.queue_wait_us", (time.perf_counter_ns() - t_submit_ns) / 1e3
+            )
+        with obs.use_context(ctx):
+            try:
+                # the span wraps the job so an error inside it lands on the
+                # span's error attr AND the read domain's flight ring
+                with obs.span(obs.SPAN_READ_RESOLVE, suffix=fut.owner or None):
+                    value = job()
+            except BaseException as err:  # the future carries it to result()
+                self.stats["errors"] += 1
+                obs.counter_inc("reads.async_errors")
+                rank_zero_debug(
+                    f"async read of {fut.owner or 'metric'} failed: {type(err).__name__}: {err}"
+                )
+                fut._finish(None, err)
+                if t_submit_ns:
+                    obs.histogram_observe(
+                        "reads.e2e_latency_us", (time.perf_counter_ns() - t_submit_ns) / 1e3
+                    )
+                return
         self.stats["completed"] += 1
         if isinstance(value, DegradedValue):
             self.stats["degraded"] += 1
             obs.counter_inc("reads.async_degraded")
+            obs.histogram_observe("reads.staleness_age_updates", value.updates_behind)
         obs.counter_inc("reads.async_completed")
         fut._finish(value, None)
+        if t_submit_ns:
+            obs.histogram_observe(
+                "reads.e2e_latency_us", (time.perf_counter_ns() - t_submit_ns) / 1e3
+            )
 
     def _run(self) -> None:
         from torchmetrics_tpu import obs
 
         while True:
-            job, fut = self._q.get()
+            job, fut, ctx, t_submit_ns = self._q.get()
             try:
-                self._execute(job, fut)
+                self._execute(job, fut, ctx, t_submit_ns)
             finally:
                 self._q.task_done()
                 obs.gauge_set("reads.pending", self._q.unfinished_tasks)
@@ -273,18 +308,23 @@ class ReadPipeline:
     def submit(self, job: Callable[[], Any], owner: str = "", submitted_count: Optional[int] = None) -> MetricFuture:
         """Enqueue one read; returns its future immediately. Never blocks on
         the queue: when full, the job runs inline (blocking THIS call, which
-        is the documented backpressure degradation, not a stall bug)."""
+        is the documented backpressure degradation, not a stall bug). The
+        ambient trace context is captured here and reopened on the worker, so
+        the submitting span and the worker-side replay share one trace id —
+        capture is a thread-local read, zero-cost when tracing is off."""
         from torchmetrics_tpu import obs
 
         fut = MetricFuture(owner=owner, submitted_count=submitted_count)
+        ctx = obs.capture_context()
+        t_submit_ns = time.perf_counter_ns() if obs.telemetry_enabled() else 0
         self.stats["submitted"] += 1
         obs.counter_inc("reads.async_submitted")
         try:
-            self._q.put_nowait((job, fut))
+            self._q.put_nowait((job, fut, ctx, t_submit_ns))
         except queue.Full:
             self.stats["inline"] += 1
             obs.counter_inc("reads.inline_fallback")
-            self._execute(job, fut)
+            self._execute(job, fut, ctx, t_submit_ns)
             return fut
         obs.gauge_set("reads.pending", self._q.unfinished_tasks)
         self._ensure_thread()

@@ -482,7 +482,7 @@ class CompileWorker:
     """
 
     def __init__(self, maxsize: int = 16) -> None:
-        self._q: "queue.Queue[Callable[[], None]]" = queue.Queue(maxsize=maxsize)
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)  # (job, trace ctx) pairs
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self.stats = {"submitted": 0, "dropped": 0, "completed": 0, "errors": 0}
@@ -497,9 +497,13 @@ class CompileWorker:
         from torchmetrics_tpu import obs  # deferred: keep import-time deps minimal
 
         while True:
-            job = self._q.get()
+            job, ctx = self._q.get()
             try:
-                job()
+                # reopen the submitting thread's trace context: the job's own
+                # spans (tm_tpu.compile background=True, tm_tpu.cache.store)
+                # carry the enqueue site's trace_id with a flow-event pair
+                with obs.use_context(ctx):
+                    job()
                 self.stats["completed"] += 1
                 obs.counter_inc("compile_worker.completed")
             except Exception as err:
@@ -507,7 +511,11 @@ class CompileWorker:
                 # path it backs is already correct — record and move on
                 self.stats["errors"] += 1
                 obs.counter_inc("compile_worker.errors")
-                obs.breadcrumb("compile_worker_job_failed", {"error": f"{type(err).__name__}: {err}"})
+                obs.fault_breadcrumb(
+                    "compile_worker_job_failed",
+                    domain="compile",
+                    data={"error": f"{type(err).__name__}: {err}"},
+                )
                 rank_zero_debug(
                     f"torchmetrics_tpu compile worker: job failed ({type(err).__name__}: {err})"
                 )
@@ -516,11 +524,13 @@ class CompileWorker:
                 obs.gauge_set("compile_worker.pending", self._q.unfinished_tasks)
 
     def submit(self, job: Callable[[], None]) -> bool:
-        """Enqueue without blocking; False when the bounded queue is full."""
+        """Enqueue without blocking; False when the bounded queue is full.
+        Captures the ambient trace context for the worker to reopen (a
+        thread-local read; zero-cost when tracing is off)."""
         from torchmetrics_tpu import obs  # deferred: keep import-time deps minimal
 
         try:
-            self._q.put_nowait(job)
+            self._q.put_nowait((job, obs.capture_context()))
         except queue.Full:
             self.stats["dropped"] += 1
             obs.counter_inc("compile_worker.dropped")

@@ -502,7 +502,11 @@ class _ExecutorBase:
                 f"torchmetrics_tpu executor disabled for {self._owner_name()}: {reason}"
                 " (eager fallback; see Metric.executor_status)"
             )
-            obs.breadcrumb("executor_disabled", {"owner": self._owner_name(), "reason": reason})
+            obs.fault_breadcrumb(
+                "executor_disabled",
+                domain="dispatch",
+                data={"owner": self._owner_name(), "reason": reason},
+            )
         self.disabled_reason = reason
 
     def _snapshot(self, state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -691,9 +695,10 @@ class _ExecutorBase:
             self._cache.pop(failure.key, None)
         self._unlink_entry(failure.key_desc)
         self.stats["disk_evictions"] += 1
-        obs.breadcrumb(
+        obs.fault_breadcrumb(
             "disk_entry_evicted",
-            {"owner": self._owner_name(), "error": f"{type(failure.original).__name__}: {failure.original}"},
+            domain="compile",
+            data={"owner": self._owner_name(), "error": f"{type(failure.original).__name__}: {failure.original}"},
         )
         rank_zero_warn(
             f"torchmetrics_tpu compile cache: persisted executable for {self._owner_name()}"
@@ -739,7 +744,11 @@ class _ExecutorBase:
             self.stats["compile_us_total"] += (time.perf_counter() - t0) * 1e6
             self._persist_body(fn, persist)
 
-        if not compile_cache.get_worker().submit(job):
+        # the enqueue span is the flow source the worker-side compile span
+        # links back to (Perfetto flow arrow: miss site -> worker replay)
+        with obs.span(obs.SPAN_COMPILE, owner=self._owner_name(), phase="enqueue"):
+            submitted = compile_cache.get_worker().submit(job)
+        if not submitted:
             with self._cache_lock:
                 self._pending_keys.discard(key)
             return False
@@ -762,9 +771,10 @@ class _ExecutorBase:
                 f" persist ({type(err).__name__}: {err}); key stays memory-only"
             )
             return
-        compile_cache.get_worker().submit(
-            lambda: self._persist_body(jax.jit(clone_builder(), donate_argnums=0), persist)
-        )
+        with obs.span(obs.SPAN_CACHE_STORE, owner=self._owner_name(), phase="enqueue"):
+            compile_cache.get_worker().submit(
+                lambda: self._persist_body(jax.jit(clone_builder(), donate_argnums=0), persist)
+            )
 
     def _persist_body(self, fn: Callable, persist: _PersistSpec) -> None:
         """Worker-side: export the computation at its avals, atomically store
@@ -1378,7 +1388,7 @@ class MetricExecutor(_ExecutorBase):
         # (ISSUE 3 observability; the traced body carries matching
         # jax.named_scope annotations via functional_update)
         t_cold_ns = time.perf_counter_ns() if fresh else None
-        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), histogram="executor.dispatch_us", cold=fresh):
             new_state = self._guarded_dispatch(
                 lambda: call_fn(state_in),
                 lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
@@ -1493,7 +1503,7 @@ class MetricExecutor(_ExecutorBase):
             return fn(state_arg, count_arr, *call_leaves)
 
         t_cold_ns = time.perf_counter_ns() if fresh else None
-        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), histogram="executor.dispatch_us", cold=fresh):
             new_state, value = self._guarded_dispatch(
                 lambda: call_fn(state_in),
                 lambda: call_fn(_tree_copy({k: m._state[k] for k in m._defaults})),
@@ -1983,7 +1993,7 @@ class CollectionExecutor(_ExecutorBase):
             }
 
         t_cold_ns = time.perf_counter_ns() if fresh else None
-        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), histogram="executor.dispatch_us", cold=fresh):
             new_states = self._guarded_dispatch(
                 lambda: call_fn(states),
                 lambda: call_fn(copied_states()),
@@ -2118,7 +2128,7 @@ class CollectionExecutor(_ExecutorBase):
             }
 
         t_cold_ns = time.perf_counter_ns() if fresh else None
-        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), cold=fresh):
+        with obs.span(obs.SPAN_DISPATCH, suffix=self._owner_name(), histogram="executor.dispatch_us", cold=fresh):
             new_states, values = self._guarded_dispatch(
                 lambda: call_fn(states),
                 lambda: call_fn(copied_states()),
@@ -2410,16 +2420,19 @@ class DeferredCollectionStep:
 
         fn = self._get(("local", len(batch)), build)
         try:
-            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__, histogram="executor.dispatch_us"):
                 out = fn(states, *batch)
-        except ShardLossError:
+        except ShardLossError as err:
             if self._on_shard_loss != "restore" or self._shadow is None:
-                raise
+                raise obs.flighted(
+                    err, domain="shadow", kind="shard_loss",
+                    shard=getattr(err, "shard", None), policy=self._on_shard_loss,
+                )
             # reinstall the bounded-lag shadow through the reshard seam and
             # re-apply THIS batch on the fresh accumulators: the run lost at
             # most updates_behind steps, never the whole epoch
             fresh = self.recover()
-            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__, histogram="executor.dispatch_us"):
                 out = fn(fresh, *batch)
         self._steps += 1
         self._tick_shadow(out)
@@ -2447,13 +2460,16 @@ class DeferredCollectionStep:
 
         fn = self._get(("epoch", len(stacked)), build)
         try:
-            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__, histogram="executor.dispatch_us"):
                 out = fn(states, *stacked)
-        except ShardLossError:
+        except ShardLossError as err:
             if self._on_shard_loss != "restore" or self._shadow is None:
-                raise
+                raise obs.flighted(
+                    err, domain="shadow", kind="shard_loss",
+                    shard=getattr(err, "shard", None), policy=self._on_shard_loss,
+                )
             fresh = self.recover()
-            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__):
+            with obs.span(obs.SPAN_DISPATCH, suffix=type(self._coll).__name__, histogram="executor.dispatch_us"):
                 out = fn(fresh, *stacked)
         self._steps += int(jnp.shape(stacked[0])[0]) if stacked else 0
         self._tick_shadow(out)
@@ -2496,18 +2512,21 @@ class DeferredCollectionStep:
             return jax.jit(shard_map_compat(self._reduce_body, self._mesh, (self._state_spec,), P()))
 
         fn = self._get(("reduce", self._baseline_version), build)
-        try:
-            with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix="DeferredCollectionStep"):
+        # the pipeline submit stays INSIDE the submission span so the captured
+        # trace context parents the worker-side resolution under it (the
+        # submit->resolve flow arrow of docs/OBSERVABILITY.md)
+        with obs.span(obs.SPAN_COMPUTE_ASYNC, suffix="DeferredCollectionStep"):
+            try:
                 packed = fn(states)  # enqueued on the device stream, not awaited
-        except ShardLossError as err:
-            # shard loss surfaces at dispatch: resolve the future per policy
-            # (the caller still gets a future, like every degradation path)
-            return resolved_future(
-                self._serve_shard_loss(err), owner="DeferredCollectionStep.reduce"
+            except ShardLossError as err:
+                # shard loss surfaces at dispatch: resolve the future per policy
+                # (the caller still gets a future, like every degradation path)
+                return resolved_future(
+                    self._serve_shard_loss(err), owner="DeferredCollectionStep.reduce"
+                )
+            return get_pipeline().submit(
+                lambda: self._unpack(materialize(packed)), owner="DeferredCollectionStep.reduce"
             )
-        return get_pipeline().submit(
-            lambda: self._unpack(materialize(packed)), owner="DeferredCollectionStep.reduce"
-        )
 
     # ------------------------------------------------------- elastic topology
     def _fold_fn(self):
@@ -2679,10 +2698,11 @@ class DeferredCollectionStep:
             )
         canonical, shadow_steps = snap
         obs.counter_inc("shards.shadow_restores")
-        obs.breadcrumb(
+        obs.fault_breadcrumb(
             "shard_loss_restore",
-            {"shadow_steps": shadow_steps, "live_steps": self._steps,
-             "updates_behind": max(0, self._steps - shadow_steps)},
+            domain="shadow",
+            data={"shadow_steps": shadow_steps, "live_steps": self._steps,
+                  "updates_behind": max(0, self._steps - shadow_steps)},
         )
         self._set_baseline(canonical)
         self._steps = int(shadow_steps)
@@ -2705,11 +2725,26 @@ class DeferredCollectionStep:
         shadow = self._shadow
         snap = None if shadow is None else shadow.snapshot()
         if self._on_shard_loss == "raise" or snap is None:
-            raise err
+            # the flight blob is the shard-loss black box: the last shadow
+            # refreshes / dispatches before the loss plus the counter window
+            raise obs.flighted(
+                err, domain="shadow", kind="shard_loss",
+                shard=getattr(err, "shard", None), policy=self._on_shard_loss,
+            )
         canonical, shadow_steps = snap
         behind = max(0, self._steps - shadow_steps)
         obs.gauge_set("shards.shadow_age_updates", behind)
+        obs.histogram_observe("shards.shadow_staleness_updates", behind)
         obs.counter_inc("shards.degraded_reads")
+        obs.fault_breadcrumb(
+            "shard_loss_degraded",
+            domain="shadow",
+            data={
+                "shard": getattr(err, "shard", None),
+                "policy": self._on_shard_loss,
+                "updates_behind": behind,
+            },
+        )
         if self._on_shard_loss == "restore":
             self.recover()
         # the shadow IS canonical: compute values from it host-side (eager —

@@ -143,6 +143,9 @@ def _record_gate(name: str, decision: Dict[str, Any]) -> None:
         entry.update(decision)
         path = decision.get("path")
         entry["selections"][path] = entry["selections"].get(path, 0) + 1
+    # the flight recorder's kernels domain replays the last N gate decisions
+    # after a fault (bounded deque append; never raises into the dispatch)
+    obs.flight_note("kernels", name, **decision)
 
 
 def gate_snapshot() -> Dict[str, Dict[str, Any]]:

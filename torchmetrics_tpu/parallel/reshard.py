@@ -95,13 +95,18 @@ def layout_of(states: Dict[str, Any]) -> ShardLayout:
     """Infer the :class:`ShardLayout` of a stacked state pytree from its
     first array leaf's leading axis (every leaf agrees by construction —
     ``Metric.validate_state(sharded=True)`` enforces it on restore paths)."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
     for v in states.values():
         if isinstance(v, dict):
             return layout_of(v)
         arr = v if hasattr(v, "shape") else np.asarray(v)
         if getattr(arr, "ndim", 0) >= 1:
             return ShardLayout(int(arr.shape[0]))
-    raise TopologyMismatchError("cannot infer shard layout: no array leaf carries a shard axis")
+    raise obs.flighted(
+        TopologyMismatchError("cannot infer shard layout: no array leaf carries a shard axis"),
+        domain="reshard",
+    )
 
 
 def _strip_reserved(states: Dict[str, Any]) -> Dict[str, Any]:
@@ -131,16 +136,21 @@ def expand_canonical(
     Raises :class:`TopologyMismatchError` for fields whose reduction cannot
     be re-split into a uniform stack (``cat``, ``None``, callables) — those
     are carried as a read-point baseline instead (:func:`merge_folded`)."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
     if num_shards < 1:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
     out: Dict[str, Any] = {}
     for name, value in _strip_reserved(canonical).items():
         fx = reductions.get(name)
         if fx not in _IN_STACK:
-            raise TopologyMismatchError(
-                f"field {name!r} (dist_reduce_fx={fx!r}) cannot be re-split into a"
-                f" {num_shards}-shard stack — carry it as a baseline (merge_folded)"
-                " or restore on the saved topology"
+            raise obs.flighted(
+                TopologyMismatchError(
+                    f"field {name!r} (dist_reduce_fx={fx!r}) cannot be re-split into a"
+                    f" {num_shards}-shard stack — carry it as a baseline (merge_folded)"
+                    " or restore on the saved topology"
+                ),
+                domain="reshard",
             )
         arr = jnp.asarray(value)
         if fx == "sum":
@@ -165,6 +175,8 @@ def merge_folded(
     physical accumulators combine by addition (``mean_i(a_i + c_i) =
     mean_i(a_i) + mean_i(c_i)``) — exactly what an uninterrupted run's single
     fold would have produced."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
     out: Dict[str, Any] = {}
     for name, b in baseline.items():
         fx = reductions.get(name)
@@ -178,9 +190,12 @@ def merge_folded(
         elif fx == "cat":
             out[name] = jnp.concatenate([jnp.atleast_1d(jnp.asarray(b)), jnp.atleast_1d(jnp.asarray(v))], axis=0)
         else:
-            raise TopologyMismatchError(
-                f"field {name!r} (dist_reduce_fx={fx!r}) has no derivable segment merge;"
-                " elastic restore cannot carry it across a topology change"
+            raise obs.flighted(
+                TopologyMismatchError(
+                    f"field {name!r} (dist_reduce_fx={fx!r}) has no derivable segment merge;"
+                    " elastic restore cannot carry it across a topology change"
+                ),
+                domain="reshard",
             )
     for name, v in fresh.items():
         if name not in out:
@@ -208,11 +223,14 @@ def reshard_states(
 
     got = layout_of(states)
     if got.num_shards != from_layout.num_shards:
-        raise TopologyMismatchError(
-            f"state carries {got.num_shards} shards but from_layout declares"
-            f" {from_layout.num_shards}",
-            saved={"num_shards": from_layout.num_shards},
-            current={"num_shards": got.num_shards},
+        raise obs.flighted(
+            TopologyMismatchError(
+                f"state carries {got.num_shards} shards but from_layout declares"
+                f" {from_layout.num_shards}",
+                saved={"num_shards": from_layout.num_shards},
+                current={"num_shards": got.num_shards},
+            ),
+            domain="reshard",
         )
     if from_layout.num_shards == to_layout.num_shards:
         return _strip_reserved(states)
@@ -275,14 +293,19 @@ class ShardShadow:
         later donating local steps cannot invalidate them). The worker-side
         job materializes it, host-copies, merges any carried ``baseline``
         segment, and installs the result as the freshest shadow."""
+        from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
         from torchmetrics_tpu.ops.async_read import get_pipeline
 
         self._last_submitted = int(step_count)
         self.stats["submitted"] += 1
-        get_pipeline().submit(
-            lambda: self._refresh_job(folded_device, int(step_count), baseline),
-            owner="ShardShadow.refresh",
-        )
+        # the submit span is the flow source: the pipeline captures the
+        # ambient context inside it, so the worker-side refresh links back
+        # here with a Perfetto flow arrow (step loop -> pipeline worker)
+        with obs.span(obs.SPAN_SHADOW, phase="submit", step=int(step_count)):
+            get_pipeline().submit(
+                lambda: self._refresh_job(folded_device, int(step_count), baseline),
+                owner="ShardShadow.refresh",
+            )
 
     def _refresh_job(self, folded_device: Any, step_count: int, baseline: Optional[Dict[str, Any]]) -> None:
         """WORKER-SIDE ONLY (async read pipeline): ready-wait + D2H + install."""
@@ -290,11 +313,12 @@ class ShardShadow:
         from torchmetrics_tpu.ops.async_read import materialize
 
         try:
-            ready = materialize(folded_device)
-            host = {
-                leader: {f: np.array(v) for f, v in sub.items()}
-                for leader, sub in ready.items()
-            }
+            with obs.span(obs.SPAN_SHADOW, phase="refresh", step=int(step_count)):
+                ready = materialize(folded_device)
+                host = {
+                    leader: {f: np.array(v) for f, v in sub.items()}
+                    for leader, sub in ready.items()
+                }
             if baseline is not None:
                 reds = self._reductions_of()
                 host = {
@@ -318,7 +342,11 @@ class ShardShadow:
 
             self.stats["errors"] += 1
             obs.counter_inc("shards.shadow_errors")
-            obs.breadcrumb("shadow_refresh_failed", {"error": f"{type(err).__name__}: {err}"})
+            obs.fault_breadcrumb(
+                "shadow_refresh_failed",
+                domain="shadow",
+                data={"error": f"{type(err).__name__}: {err}"},
+            )
             rank_zero_debug(f"shard shadow refresh failed: {type(err).__name__}: {err}")
 
     # ------------------------------------------------------------------ reads

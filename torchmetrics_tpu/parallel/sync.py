@@ -179,9 +179,13 @@ def _gather_with_timeout(value: Any, timeout: Optional[float]) -> Any:
             _gather_pool = None
             worker.retire()
             obs.counter_inc("sync.timeouts")
-            obs.breadcrumb("sync_timeout", {"timeout_s": timeout})
-            raise SyncTimeoutError(
-                f"multi-host state sync (process_allgather) did not complete within {timeout}s"
+            raise obs.flighted(
+                SyncTimeoutError(
+                    f"multi-host state sync (process_allgather) did not complete within {timeout}s"
+                ),
+                domain="sync",
+                kind="sync_timeout",
+                timeout_s=timeout,
             )
         if "err" in box:
             obs.counter_inc("sync.gather_errors")

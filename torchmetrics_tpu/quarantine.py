@@ -203,9 +203,10 @@ class LaneGuard:
         while window and window[0] <= cutoff:
             window.pop(0)
         self.last_fault[session_id] = {"round": self.round, "where": where, "reason": reason}
-        obs.breadcrumb(
+        obs.fault_breadcrumb(
             "lane_fault",
-            {"session": repr(session_id), "where": where, "reason": reason, "round": self.round},
+            domain="lanes",
+            data={"session": repr(session_id), "where": where, "reason": reason, "round": self.round},
         )
         probation = self.quarantined.get(session_id)
         if probation is not None:
@@ -215,9 +216,10 @@ class LaneGuard:
             action = "evict"
             self.stats["breaker_trips"] += 1
             obs.counter_inc("lanes.breaker_trips")
-            obs.breadcrumb(
+            obs.fault_breadcrumb(
                 "lane_breaker_trip",
-                {"session": repr(session_id), "faults_in_window": len(window), "round": self.round},
+                domain="lanes",
+                data={"session": repr(session_id), "faults_in_window": len(window), "round": self.round},
             )
         return action
 
@@ -340,6 +342,7 @@ class LaneGuard:
             return None
         self.stats["degraded_reads"] += 1
         obs.counter_inc("lanes.degraded_reads")
+        obs.histogram_observe("reads.staleness_age_updates", staleness[0])
         return DegradedValue(value=rec["value"], updates_behind=staleness[0], age_updates=staleness[1])
 
     # ------------------------------------------------------------ diagnostics
