@@ -1964,6 +1964,64 @@ def bench_config9():
     out["faulting_1pct_sessions_per_s"] = round(1.0 / _stable_min(faulting_block, repeats=3), 1)
     out["faulting_1pct_quarantined"] = faulty.lane_status["quarantined"]
 
+    # ---- pipelined ingest ceiling (ISSUE 14, the ROADMAP events/sec row):
+    # update-only ingest throughput with multi-round traffic — each session
+    # ships R batches per update_sessions call, so the router stages round
+    # k+1's screen+pack on the ingest worker under round k's H2D + donated
+    # dispatch (docs/LANES.md "Ingest pipeline") — staged slab pipeline vs
+    # the inline pack (TORCHMETRICS_TPU_INGEST_PIPELINE=0), measured
+    # back-to-back per the BASELINE noise protocol. The parity tripwire
+    # compares per-session values across the two paths (identical traffic).
+    from torchmetrics_tpu.ops import ingest as ingest_mod
+
+    INGEST_SESSIONS = 256
+    INGEST_ROUNDS = 4
+    ing_sessions = [f"i{k}" for k in range(INGEST_SESSIONS)]
+    ing_batches = [session_batch() for _ in range(INGEST_SESSIONS)]
+    ingest_items = [
+        (s, b) for _ in range(INGEST_ROUNDS) for s, b in zip(ing_sessions, ing_batches)
+    ]
+    events_per_call = INGEST_SESSIONS * INGEST_ROUNDS * PER_SESSION
+
+    def _measure_ingest(pipeline_on):
+        os.environ["TORCHMETRICS_TPU_INGEST_PIPELINE"] = "1" if pipeline_on else "0"
+        ingest_mod.reset_for_tests()
+        m = LanedMetric(mk(), capacity=INGEST_SESSIONS)
+        m.update_sessions(ingest_items)  # admit + compile the bucket
+        m.update_sessions(ingest_items)  # donation streak + slab/ring warm
+        compile_cache.drain_worker(60)
+
+        def block(m=m):
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS):
+                m.update_sessions(ingest_items)
+            jax.block_until_ready(m._state["tp"])
+            return (time.perf_counter() - t0) / (ROUNDS * events_per_call)
+
+        per_event_s = _stable_min(block, repeats=3)
+        return m, 1.0 / per_event_s
+
+    try:
+        inline_m, inline_rate = _measure_ingest(False)
+        piped_m, piped_rate = _measure_ingest(True)
+    finally:
+        os.environ.pop("TORCHMETRICS_TPU_INGEST_PIPELINE", None)
+        ingest_mod.reset_for_tests()
+    out["ingest_events_per_s_inline"] = round(inline_rate, 1)
+    out["ingest_events_per_s_pipelined"] = round(piped_rate, 1)
+    out["ingest_pipelined_ratio"] = round(piped_rate / inline_rate, 3)
+    out["ingest_rounds_per_call"] = INGEST_ROUNDS
+    out["ingest_sessions"] = INGEST_SESSIONS
+    # parity tripwire: both instances consumed IDENTICAL per-session traffic
+    # (accuracy is count-invariant for identical repeated batches, so the
+    # differing number of timing repeats cannot perturb the comparison)
+    ingest_agree = True
+    for s in (ing_sessions[3], ing_sessions[INGEST_SESSIONS // 2], ing_sessions[-1]):
+        a = float(np.asarray(piped_m.compute_session(s)))
+        b = float(np.asarray(inline_m.compute_session(s)))
+        ingest_agree = ingest_agree and abs(a - b) < 1e-9
+    out["ingest_values_agree"] = bool(ingest_agree)
+
     # correctness spot check: a sampled lane equals its separate instance
     # (same batches were routed to the first SAMPLE sessions)
     idx = 7

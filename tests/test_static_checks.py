@@ -411,6 +411,43 @@ def test_bench_regression_gate_quantized_rows():
     assert len(violations) == 1 and "quantized_values_agree" in violations[0].detail
 
 
+def test_bench_regression_gate_ingest_rows():
+    """ISSUE 14 satellite: the config-9 ingest rows are gated — the
+    pipelined/inline events-per-second ratio must clear its baseline floor,
+    and ingest_values_agree=false (the staged-vs-inline parity tripwire)
+    fails outright."""
+    checker = _load_tool("check_bench_regression")
+    base = {
+        "bench_baselines": {
+            "x_conf": {"value": 100.0, "ingest_pipelined_ratio_min": 0.8},
+        }
+    }
+    good = {
+        "configs": {
+            "x_conf": {
+                "value": 100.0,
+                "ingest_pipelined_ratio": 1.4,
+                "ingest_values_agree": True,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(good, base)
+    assert not violations
+
+    slow = {"configs": {"x_conf": {"value": 100.0, "ingest_pipelined_ratio": 0.5}}}
+    violations, _ = checker.check_bench(slow, base)
+    assert len(violations) == 1 and "ingest_pipelined_ratio" in violations[0].detail
+
+    # without a baseline override the floor defaults to parity (1.0)
+    slow_default = {"configs": {"x_conf": {"value": 100.0, "ingest_pipelined_ratio": 0.9}}}
+    violations, _ = checker.check_bench(slow_default, {"bench_baselines": {"x_conf": {"value": 100.0}}})
+    assert len(violations) == 1 and "ingest_pipelined_ratio" in violations[0].detail
+
+    tripwire = {"configs": {"x_conf": {"value": 100.0, "ingest_values_agree": False}}}
+    violations, _ = checker.check_bench(tripwire, base)
+    assert len(violations) == 1 and "ingest_values_agree" in violations[0].detail
+
+
 def test_collectives_linter_catches_violations(tmp_path):
     """The linter actually fires: a synthetic update-stage function calling
     lax.psum must be flagged (guards against the rule rotting into a no-op)."""

@@ -260,6 +260,40 @@ def check_bench(
                             f" {what} regressed (docs/SHARDING.md 'Quantized reduce')",
                         )
                     )
+        # ingest gates (ISSUE 14): a config reporting the pipelined-ingest rows
+        # is gated on (a) the pipelined/inline events-per-second ratio — the
+        # staged slab pipeline must not be slower than the inline pack it
+        # hides (floor from BASELINE.json ingest_pipelined_ratio_min; the
+        # real-hardware target is >=1.3, the 1-vCPU VM floor lives in the
+        # baseline with its evidence note) — and (b) the values-agree
+        # tripwire: a staged round that diverges from the inline pack breaks
+        # the bit-exactness contract and fails outright.
+        iratio = result.get("ingest_pipelined_ratio")
+        if isinstance(iratio, (int, float)):
+            base = baselines.get(name, {})
+            floor = base.get("ingest_pipelined_ratio_min", 1.0) if isinstance(base, dict) else 1.0
+            if float(iratio) < float(floor):
+                violations.append(
+                    Violation(
+                        name,
+                        float(iratio),
+                        threshold,
+                        f"ingest_pipelined_ratio {iratio:.3f} below the {floor} floor — the"
+                        " staged slab pipeline is slower than the inline pack it replaces"
+                        " (docs/LANES.md 'Ingest pipeline')",
+                    )
+                )
+        iagree = result.get("ingest_values_agree")
+        if iagree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "ingest_values_agree is false — the staged (slab) ingest path diverged"
+                    " from the inline pack; bit-exactness is the contract, fail outright",
+                )
+            )
         qagree = result.get("quantized_values_agree")
         if qagree is False:
             violations.append(

@@ -42,6 +42,7 @@ HOT_PATH_FILES = (
     "ops/executor.py",
     "ops/compile_cache.py",
     "ops/async_read.py",
+    "ops/ingest.py",
     "ops/kernels.py",
     "ops/fused_classification.py",
     "ops/bincount.py",
@@ -223,6 +224,28 @@ ALLOWLIST = {
     "lanes.py::_recovery_snapshot": (
         "recovery hook fallback: a tiny host fetch of the lane-id leaf when a"
         " low-level update() bypassed the router (the router path is free)"
+    ),
+    # --- pipelined lane ingest (docs/LANES.md "Ingest pipeline"): the pack
+    #     WORKER is the one sanctioned place the ingest path blocks; the
+    #     router-side calls below touch HOST rows only, never device arrays
+    "ops/ingest.py::_wait_tokens": (
+        "the pack worker's slab retire wait IS the design: block_until_ready"
+        " on the uploaded input arrays + the consuming dispatch's committed"
+        " leaf runs on the ingest worker (or a rare depth-exhausted inline"
+        " acquire), so a reused slab can never race an in-flight transfer"
+    ),
+    "ops/ingest.py::_probe_alias": (
+        "one-shot import-time device_put semantics probe on a 16-byte scratch"
+        " array — decides whether uploads must copy defensively; never on the"
+        " traffic path"
+    ),
+    "ops/ingest.py::make_spec": (
+        "slab layout derivation reads ONE host row per round (rows arrive as"
+        " host arrays by design, like lanes.py::_stack_rows)"
+    ),
+    "ops/ingest.py::pack_into_slab": (
+        "the in-place slab write: np.asarray on HOST rows at the pack point —"
+        " the zero-copy replacement for the np.stack alloc+copy"
     ),
     "quarantine.py::row_spec_majority": (
         "admission screening: per-row layout vote over HOST rows at the router"

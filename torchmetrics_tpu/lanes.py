@@ -333,6 +333,147 @@ def _divert_screened_rows(
     return out
 
 
+def _route_rounds(host: Any, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
+    """THE router round loop, shared by :class:`LanedMetric` and
+    :class:`LanedCollection` (each provides the small ``_router_*`` adapter
+    surface). One loop means the ingest seam lands once:
+
+    Ingest (ops/ingest.py): each round's rows are written in place into a
+    reusable staging slab (no per-round ``np.stack`` allocation) and — for
+    multi-round traffic — round k+1's screen+pack is STAGED on the ingest
+    worker while round k's H2D and donated dispatch are still in flight (the
+    pjit dispatch-ahead discipline applied to metric ingest). Screening
+    verdicts are applied and lane ids stamped on THIS thread at dispatch
+    time, so guard actions and admissions never race the worker, and a lane
+    reassigned between pack and dispatch can never receive another tenant's
+    rows. Backpressure (busy ring, full queue, layout deviants, eager lane
+    mode) degrades to the inline pack — rounds are consumed strictly in
+    order, so a round can never be dropped or reordered, and per-lane
+    ``compute()`` stays bit-exact vs the inline path (the slab fast path only
+    serves the uniform round; every deviant funnels into the legacy
+    ``_stack_rows``/``_stack_rows_screened``)."""
+    from torchmetrics_tpu.ops import ingest
+    from torchmetrics_tpu.ops.executor import bucket_size
+
+    if isinstance(items, dict):
+        items = list(items.items())
+    rounds = _pack_rounds(items)
+    table: LaneTable = host._router_table()
+    guard: LaneGuard = host._router_guard()
+    members: List[Tuple[str, "LanedMetric"]] = host._router_members()
+    staged = ingest.pipeline_enabled() and host._router_pipelinable()
+    ring = ingest.get_ring() if staged else None
+    pipeline = ingest.get_pipeline() if staged and len(rounds) > 1 else None
+    tickets: List[Optional[Any]] = [None] * len(rounds)
+
+    def stage(k: int) -> None:
+        # pre-pack round k on the ingest worker under the CURRENT round's
+        # H2D + dispatch; lane ids / screen verdicts are NOT staged (see
+        # docstring), so the worker only ever touches the round's row data
+        if pipeline is None or tickets[k] is not None:
+            return
+        round_items = rounds[k]
+        tickets[k] = ingest.pack_async(
+            pipeline,
+            ring,
+            [b for _, b in round_items],
+            len(round_items),
+            bucket_size(len(round_items)),
+            screen=bool(guard.active and guard.screen),
+        )
+
+    if pipeline is not None:
+        stage(1)  # round 0 packs inline; its dispatch hides round 1's pack
+    dispatches = 0
+    for k, round_items in enumerate(rounds):
+        if guard.active:
+            guard.begin_round()
+        excluded: set = set()
+        first_attempt = True
+        while True:
+            current = [(sid, b) for sid, b in round_items if sid not in excluded]
+            if not current:
+                break
+            lanes = [host._router_admit(sid) for sid, _ in current]
+            rows = len(current)
+            bucket = bucket_size(rows)
+            sentinel = host.capacity  # out of range -> scatter-dropped
+            screen = bool(guard.active and guard.screen)
+            packed = None
+            if first_attempt and tickets[k] is not None:
+                # blocks for the worker's HOST pack only (already overlapped
+                # with the previous round); pack errors re-raise here, exactly
+                # where the inline pack would have raised them
+                packed = tickets[k].take()
+                if packed is not None:
+                    obs.counter_inc("lanes.pipelined_rounds")
+            if packed is None and ring is not None:
+                packed = ingest.pack_inline(ring, [b for _, b in current], rows, bucket, screen)
+                if packed is not None:
+                    obs.counter_inc("lanes.inline_packs")
+            if packed is not None:
+                batch = None  # uploaded from the slab below, after lane stamping
+                reasons = packed.reasons
+            elif screen:
+                batch, reasons = LanedMetric._stack_rows_screened(
+                    [b for _, b in current], bucket, kind_memo=host._router_kind_memo()
+                )
+            else:
+                batch = LanedMetric._stack_rows([b for _, b in current], bucket)
+                reasons = None
+            if screen:
+                lanes = _divert_screened_rows(
+                    guard, host._apply_fault_action, current, lanes, reasons, sentinel
+                )
+            live = [lane for lane in lanes if lane != sentinel]
+            if not live:
+                if packed is not None:
+                    ring.release(packed.slab)
+                break  # the whole round was diverted: nothing to dispatch
+            if first_attempt and k + 1 < len(rounds):
+                stage(k + 1)  # overlap window: baseline fetch + H2D + dispatch
+            baselines: Dict[str, Any] = {}
+            for slot, m in members:
+                baseline = m._fetch_round_baseline(live) if guard.active else None
+                baselines[slot] = baseline
+                m.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
+            try:
+                if packed is not None:
+                    lane_arr, batch = ingest.stamp_and_upload(packed, lanes, sentinel)
+                    slab = packed.slab
+                else:
+                    lane_arr = jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32)
+                    slab = None
+                with ingest.dispatch_scope(slab, ring):
+                    host._router_dispatch(lane_arr, batch, rows, bucket)
+            except LaneFaultError as err:
+                culprit = getattr(err, "session_id", None)
+                if not guard.active or culprit is None or culprit not in {s for s, _ in current}:
+                    raise
+                # lane-granular containment: restore the round's touched
+                # lanes to their pre-round rows, fault the attributed
+                # tenant, and re-dispatch the round WITHOUT it — the other
+                # lanes sharing the dispatch still get their step
+                for slot, m in members:
+                    m._rollback_round(live, baselines[slot])
+                action = guard.record_fault(culprit, "dispatch", str(err))
+                host._apply_fault_action(culprit, action, err)
+                if action != "evict":
+                    guard.note_diverted(culprit)  # the rolled-back offer is traffic the lane missed
+                excluded.add(culprit)
+                first_attempt = False  # retries repack inline from `current`
+                continue
+            finally:
+                for _, m in members:
+                    m.__dict__.pop("_round_ctx", None)
+            table.touch(live)
+            obs.counter_inc("lanes.dispatches")
+            obs.counter_inc("lanes.rows", len(live))
+            dispatches += 1
+            break
+    return dispatches
+
+
 def _pack_rounds(
     items: Iterable[Tuple[Any, Tuple[Any, ...]]],
 ) -> List[List[Tuple[Any, Tuple[Any, ...]]]]:
@@ -679,70 +820,33 @@ class LanedMetric(Metric):
             return self._update_sessions_impl(items)
 
     def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
-        from torchmetrics_tpu.ops.executor import bucket_size
+        return _route_rounds(self, items)
 
-        if isinstance(items, dict):
-            items = list(items.items())
-        rounds = _pack_rounds(items)
-        table: LaneTable = self.__dict__["_table"]
-        guard: LaneGuard = self.__dict__["_guard"]
-        dispatches = 0
-        for round_items in rounds:
-            if guard.active:
-                guard.begin_round()
-            excluded: set = set()
-            while True:
-                current = [(sid, b) for sid, b in round_items if sid not in excluded]
-                if not current:
-                    break
-                lanes = [self._admit_for_update(sid) for sid, _ in current]
-                rows = len(current)
-                bucket = bucket_size(rows)
-                sentinel = self.capacity  # out of range -> scatter-dropped
-                if guard.active and guard.screen:
-                    # admission screening (tentpole #1): validate the round at
-                    # the pack — vectorized over the stacked batch — and
-                    # divert failing rows by sentinel-ing their lane id
-                    batch, reasons = self._stack_rows_screened([b for _, b in current], bucket)
-                    lanes = _divert_screened_rows(
-                        guard, self._apply_fault_action, current, lanes, reasons, sentinel
-                    )
-                else:
-                    batch = self._stack_rows([b for _, b in current], bucket)
-                live = [lane for lane in lanes if lane != sentinel]
-                if not live:
-                    break  # the whole round was diverted: nothing to dispatch
-                baseline = self._fetch_round_baseline(live) if guard.active else None
-                # one-shot handoff to the executor's recovery hook: the lanes
-                # this round touches, plus the already-on-host baseline rows
-                # the incremental mirror can fold from for free
-                self.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
-                try:
-                    with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
-                        self.update(jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch)
-                except LaneFaultError as err:
-                    culprit = getattr(err, "session_id", None)
-                    if not guard.active or culprit is None or culprit not in {s for s, _ in current}:
-                        raise
-                    # lane-granular containment: restore the round's touched
-                    # lanes to their pre-round rows, fault the attributed
-                    # tenant, and re-dispatch the round WITHOUT it — the other
-                    # lanes sharing the dispatch still get their step
-                    self._rollback_round(live, baseline)
-                    action = guard.record_fault(culprit, "dispatch", str(err))
-                    self._apply_fault_action(culprit, action, err)
-                    if action != "evict":
-                        guard.note_diverted(culprit)  # the rolled-back offer is traffic the lane missed
-                    excluded.add(culprit)
-                    continue
-                finally:
-                    self.__dict__.pop("_round_ctx", None)
-                table.touch(live)
-                obs.counter_inc("lanes.dispatches")
-                obs.counter_inc("lanes.rows", len(live))
-                dispatches += 1
-                break
-        return dispatches
+    # ------------------------------------------------ shared-router adapters
+    # (the round loop itself lives in _route_rounds — ONE copy for
+    # LanedMetric and LanedCollection, so seams like the ingest pipeline land
+    # once; these small hooks are the only per-shape differences)
+    def _router_table(self) -> LaneTable:
+        return self.__dict__["_table"]
+
+    def _router_guard(self) -> "LaneGuard":
+        return self.__dict__["_guard"]
+
+    def _router_members(self) -> List[Tuple[str, "LanedMetric"]]:
+        return [("", self)]
+
+    def _router_admit(self, session_id: Any) -> int:
+        return self._admit_for_update(session_id)
+
+    def _router_pipelinable(self) -> bool:
+        return self._compiled_lanes
+
+    def _router_kind_memo(self) -> Dict[Any, Any]:
+        return self.__dict__.setdefault("_screen_kind_memo", {})
+
+    def _router_dispatch(self, lane_arr: Any, batch: Tuple[Any, ...], rows: int, bucket: int) -> None:
+        with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
+            self.update(lane_arr, *batch)
 
     # ------------------------------------------------------ fault containment
     def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
@@ -1041,7 +1145,9 @@ class LanedMetric(Metric):
 
     @staticmethod
     def _stack_rows_screened(
-        batches: List[Tuple[Any, ...]], bucket: int
+        batches: List[Tuple[Any, ...]],
+        bucket: int,
+        kind_memo: Optional[Dict[Any, Any]] = None,
     ) -> Tuple[Tuple[Any, ...], List[Optional[str]]]:
         """:meth:`_stack_rows` with admission screening (docs/LANES.md
         "Failure semantics"): instead of one malformed tenant failing the
@@ -1057,6 +1163,10 @@ class LanedMetric(Metric):
         n = len(batches)
         reasons: List[Optional[str]] = [None] * n
         n_leaves = len(batches[0])
+        memo_key = (bucket, n_leaves)
+        memo_ref = kind_memo.get(memo_key) if kind_memo is not None else None
+        if memo_ref is not None and len(memo_ref) != n_leaves:
+            memo_ref = None
         # FAST PATH — every row conforms (the overwhelmingly common round):
         # identical to _stack_rows plus one dtype-uniformity set and one
         # vectorized finite pass per float leaf; the first deviant falls
@@ -1064,14 +1174,18 @@ class LanedMetric(Metric):
         if not any(len(b) != n_leaves for b in batches):
             try:
                 out = []
+                memo_new: List[Any] = []
                 for leaf_idx in range(n_leaves):
                     rows = [np.asarray(b[leaf_idx]) for b in batches]
-                    kinds = {r.dtype.kind for r in rows}
-                    # KIND-level check: exact-width drift (int32 vs int64) is
-                    # promotion, not corruption — np.stack upcasts, same as
-                    # the unscreened pack
-                    if len(kinds) != 1 or rows[0].dtype.kind not in "fiub":
-                        raise _ScreenSlowPath()
+                    ref = memo_ref[leaf_idx] if memo_ref is not None else None
+                    if ref is None or not all(r.dtype == ref for r in rows):
+                        kinds = {r.dtype.kind for r in rows}
+                        # KIND-level check: exact-width drift (int32 vs int64) is
+                        # promotion, not corruption — np.stack upcasts, same as
+                        # the unscreened pack
+                        if len(kinds) != 1 or rows[0].dtype.kind not in "fiub":
+                            raise _ScreenSlowPath()
+                    memo_new.append(rows[0].dtype)
                     pad = bucket - n
                     if pad:
                         rows.extend([rows[0]] * pad)  # values irrelevant: sentinel rows are dropped
@@ -1083,13 +1197,21 @@ class LanedMetric(Metric):
                                 if reasons[i] is None:
                                     reasons[i] = f"leaf {leaf_idx} carries non-finite values"
                     out.append(jnp.asarray(stacked))
+                if kind_memo is not None:
+                    # memoize the uniform round's per-leaf dtype reference so
+                    # steady traffic skips rebuilding the kind set next round
+                    kind_memo[memo_key] = tuple(memo_new)
                 return tuple(out), reasons
             except Exception as err:  # any deviant (ragged/mixed/garbage row)
                 rank_zero_debug(f"_stack_rows_screened: round fell to the per-row screen ({err!r})")
                 reasons = [None] * n
+                if kind_memo is not None:
+                    kind_memo.pop(memo_key, None)  # the memoized layout no longer holds
         # SLOW PATH — at least one deviant row: majority-vote the round's
         # reference layout so one malformed tenant cannot redefine it, and
-        # screen each row against it
+        # screen each row against it. Rows are parsed ONCE into ``arrs``; the
+        # majority vote, the per-row screen and the fill+stack below all
+        # reuse those arrays (no re-walk of the raw batches).
         counts: Dict[int, int] = {}
         for b in batches:
             counts[len(b)] = counts.get(len(b), 0) + 1
@@ -1116,7 +1238,9 @@ class LanedMetric(Metric):
                 arrs.append(None)
         if all(a is None for a in arrs):
             return None, reasons  # nothing stackable: the router diverts the whole round
-        spec = row_spec_majority([tuple(a) for a in arrs if a is not None])
+        # the parsed arrays feed the vote directly (np.asarray inside the vote
+        # is a no-op view on them); n_leaves skips the redundant count pass
+        spec = row_spec_majority([a for a in arrs if a is not None], n_leaves=n_leaves)
         candidates = sum(1 for a in arrs if a is not None)
         for i, a in enumerate(arrs):
             if a is None or reasons[i] is not None or spec is None:
@@ -2138,66 +2262,33 @@ class LanedCollection:
             return self._update_sessions_impl(items)
 
     def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
-        from torchmetrics_tpu.ops.executor import bucket_size
+        return _route_rounds(self, items)
 
-        if isinstance(items, dict):
-            items = list(items.items())
-        rounds = _pack_rounds(items)
-        guard = self._guard
-        dispatches = 0
-        for round_items in rounds:
-            if guard.active:
-                guard.begin_round()
-            excluded: set = set()
-            while True:
-                current = [(sid, b) for sid, b in round_items if sid not in excluded]
-                if not current:
-                    break
-                lanes = [self.admit(sid) for sid, _ in current]
-                rows = len(current)
-                bucket = bucket_size(rows)
-                sentinel = self.capacity
-                if guard.active and guard.screen:
-                    batch, reasons = LanedMetric._stack_rows_screened([b for _, b in current], bucket)
-                    lanes = _divert_screened_rows(
-                        guard, self._apply_fault_action, current, lanes, reasons, sentinel
-                    )
-                else:
-                    batch = LanedMetric._stack_rows([b for _, b in current], bucket)
-                live = [lane for lane in lanes if lane != sentinel]
-                if not live:
-                    break  # the whole round was diverted: nothing to dispatch
-                baselines: Dict[str, Any] = {}
-                for name, m in self._members.items():
-                    baseline = m._fetch_round_baseline(live) if guard.active else None
-                    baselines[name] = baseline
-                    m.__dict__["_round_ctx"] = {"lanes": live, "baseline": baseline}
-                try:
-                    with obs.span(obs.SPAN_LANES, owner="LanedCollection", histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
-                        self.collection.update(
-                            jnp.asarray(lanes + [sentinel] * (bucket - rows), jnp.int32), *batch
-                        )
-                except LaneFaultError as err:
-                    culprit = getattr(err, "session_id", None)
-                    if not guard.active or culprit is None or culprit not in {s for s, _ in current}:
-                        raise
-                    for name, m in self._members.items():
-                        m._rollback_round(live, baselines[name])
-                    action = guard.record_fault(culprit, "dispatch", str(err))
-                    self._apply_fault_action(culprit, action, err)
-                    if action != "evict":
-                        guard.note_diverted(culprit)
-                    excluded.add(culprit)
-                    continue
-                finally:
-                    for m in self._members.values():
-                        m.__dict__.pop("_round_ctx", None)
-                self._table.touch(live)
-                obs.counter_inc("lanes.dispatches")
-                obs.counter_inc("lanes.rows", len(live))
-                dispatches += 1
-                break
-        return dispatches
+    # ------------------------------------------------ shared-router adapters
+    def _router_table(self) -> LaneTable:
+        return self._table
+
+    def _router_guard(self) -> LaneGuard:
+        return self._guard
+
+    def _router_members(self) -> List[Tuple[str, LanedMetric]]:
+        return list(self._members.items())
+
+    def _router_admit(self, session_id: Any) -> int:
+        return self.admit(session_id)
+
+    def _router_pipelinable(self) -> bool:
+        return all(m._compiled_lanes for m in self._members.values())
+
+    def _router_kind_memo(self) -> Dict[Any, Any]:
+        memo = self.__dict__.get("_screen_kind_memo")
+        if memo is None:
+            memo = self.__dict__["_screen_kind_memo"] = {}
+        return memo
+
+    def _router_dispatch(self, lane_arr: Any, batch: Tuple[Any, ...], rows: int, bucket: int) -> None:
+        with obs.span(obs.SPAN_LANES, owner="LanedCollection", histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
+            self.collection.update(lane_arr, *batch)
 
     def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
         """Suite-wide ``on_lane_fault`` action: eviction/reset span every

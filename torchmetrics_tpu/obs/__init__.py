@@ -55,6 +55,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_KERNEL,
     SPAN_LANES,
     SPAN_NAMES,
+    SPAN_PACK,
     SPAN_PAD,
     SPAN_QUARANTINE,
     SPAN_READ_RESOLVE,

@@ -92,6 +92,7 @@ DOMAIN_OF_SPAN = {
     "tm_tpu.autosave": "autosave",
     "tm_tpu.lanes.dispatch": "lanes",
     "tm_tpu.lanes.quarantine": "lanes",
+    "tm_tpu.lanes.pack": "lanes",
     "tm_tpu.compute_async": "read",
     "tm_tpu.read.resolve": "read",
     "tm_tpu.reshard": "reshard",

@@ -85,6 +85,7 @@ SPAN_RESHARD = "tm_tpu.reshard"            # elastic N->M re-split (restore / sh
 SPAN_KERNEL = "tm_tpu.kernel"              # backend-dispatched Pallas/XLA kernel body (per kernel name)
 SPAN_READ_RESOLVE = "tm_tpu.read.resolve"  # read-pipeline worker: the blocking tail of one job
 SPAN_SHADOW = "tm_tpu.shadow.refresh"      # shard-shadow refresh (submit half + worker half)
+SPAN_PACK = "tm_tpu.lanes.pack"            # ingest slab pack (staged worker half + inline half)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -109,6 +110,7 @@ SPAN_NAMES = (
     SPAN_KERNEL,
     SPAN_READ_RESOLVE,
     SPAN_SHADOW,
+    SPAN_PACK,
 )
 
 

@@ -110,6 +110,15 @@ _FUSABLE_REDUCTIONS = ("sum", "max", "min")
 _PY_SCALARS = (bool, int, float, complex, np.generic)
 
 
+def _ingest_notify(new_state: Any) -> None:
+    """The executor half of the slab-aware dispatch seam: hand the committed
+    state to ops/ingest.py so an armed staging slab picks up its strong
+    retire token (a no-op thread-local read outside a lane-router round)."""
+    from torchmetrics_tpu.ops import ingest
+
+    ingest.notify_dispatched(new_state)
+
+
 def executor_enabled_default() -> bool:
     """Global default from the environment (``TORCHMETRICS_TPU_EXECUTOR``)."""
     return os.environ.get(ENV_FLAG, "1").strip().lower() not in ("0", "false", "off", "no")
@@ -1422,6 +1431,13 @@ class MetricExecutor(_ExecutorBase):
         self.stats["copied_calls" if need_copy else "donated_calls"] += 1
         object.__setattr__(m, "_state", dict(new_state))
         m.__dict__["_state_escaped"] = False
+        # slab-aware dispatch seam (ops/ingest.py): when the lane router armed
+        # a staging slab for this dispatch, a committed-state leaf becomes its
+        # strong retire token — the slab is only reused once the computation
+        # that consumed it finished, which keeps slab reuse safe even on
+        # backends where device_put zero-copy aliases host memory. One
+        # thread-local read when no slab is armed.
+        _ingest_notify(new_state)
         # the wrapper bumped _update_count before this call, so the pre-call
         # recovery snapshot describes exactly count-1 committed updates — the
         # Autosaver reuses it as a free (already host-side) checkpoint source.
@@ -2027,6 +2043,9 @@ class CollectionExecutor(_ExecutorBase):
         for name, _, cg, _ in leader_execs:
             self._install(name, new_states[name], cg, bump_count=True)
         self._cache_collection_recovery(donated, leader_execs)
+        # slab-aware dispatch seam: see MetricExecutor._run_update — the fused
+        # collection dispatch retires the router's staging slab the same way
+        _ingest_notify(new_states)
         return True
 
     def run_forward(self, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:

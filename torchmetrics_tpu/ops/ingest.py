@@ -1,0 +1,728 @@
+"""Zero-copy pipelined lane ingest: the staging-slab ring behind the router.
+
+The lane router is the last host-bound stage of the hot path: every round
+used to pay a fresh ``np.stack`` alloc+copy per argument plus one synchronous
+H2D upload before the donated dispatch could go out
+(``lanes.py _stack_rows``), so at production event rates the single host core
+— not the device — capped sessions/s. This module applies the pjit/TPUv4
+dispatch-ahead discipline (PAPERS.md: always have the next step's host work
+hidden under the current step's device work) to metric ingest:
+
+- **Staging slabs** (:class:`StagingSlab`) — per-``(bucket, arg-layout)``
+  preallocated host buffers reused round-over-round. Router rows are written
+  *in place* into the slab (no per-round stack allocation), the PR 8
+  vectorized admission screen runs against the slab region directly
+  (:func:`quarantine.screen_slab_leaf`), and the lane-id vector rides the
+  same buffer. Layout deviants (ragged rows, dtype drift, garbage) fall back
+  to the legacy ``_stack_rows``/``_stack_rows_screened`` path bit-for-bit —
+  the slab fast path only ever serves the uniform round.
+
+- **The slab ring** (:class:`SlabRing`) — a bounded ring of slabs per layout.
+  A slab checked out for round k is only handed out again once its *retire
+  tokens* — the device arrays uploaded from it, plus (via the executor's
+  slab-aware dispatch seam, ``ops/executor.py _ingest_notify``) a leaf of the
+  state the consuming dispatch committed — report ready. A donated dispatch
+  can therefore never observe a slab being overwritten for the next round:
+  the committed-state token is only ready once the computation that consumed
+  the uploads finished, which covers BOTH transfer-in-flight (``device_put``
+  copying semantics) and the zero-copy case where the backend decides
+  PER-ARRAY (by alignment) to alias host memory instead of copying. Any path
+  that cannot produce the committed-state token — a dispatch death, an eager
+  fallback that bypassed the executor — :meth:`~SlabRing.discard`\\ s the slab
+  instead of ever reusing it, and :func:`device_put_aliases_host` (a one-shot
+  probe) additionally forces defensive upload copies on backends that alias
+  globally.
+
+- **The pack pipeline** (:class:`IngestPipeline`) — one bounded single-worker
+  thread (the same shape as ``ReadPipeline``/``CompileWorker``) that screens
+  and packs round k+1 into the next slab while round k's H2D and donated
+  dispatch are still in flight. Backpressure (full queue, busy ring, layout
+  deviants, worker death) degrades to the router's inline pack — a round can
+  never be dropped or reordered, because the router consumes pack tickets
+  strictly in submission order and packs inline whenever no ticket exists.
+
+Observability (inherits the PR 13 substrate): pack submission captures the
+ambient :class:`~torchmetrics_tpu.obs.TraceContext` and the worker reopens it,
+so pack→dispatch renders as Perfetto flow arrows; pack durations land in the
+``lanes.pack_us`` histogram; ``lanes.pipelined_rounds`` / ``lanes.inline_packs``
+/ ``lanes.h2d_bytes`` counters track the split; worker faults route through
+``obs.flighted`` into the ``lanes`` flight domain.
+
+Blocking-host-sync lint: this module is a HOT_PATH_FILES member. The only
+blocking calls live in the documented worker-side allowlist entries
+(``_wait_tokens`` — the pack worker's retire wait; ``_probe_alias`` — the
+one-shot import-time semantics probe on a 16-byte scratch array).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.utils.prints import rank_zero_debug
+
+__all__ = [
+    "IngestPipeline",
+    "PackResult",
+    "PackTicket",
+    "SlabRing",
+    "SlabSpec",
+    "StagingSlab",
+    "device_put_aliases_host",
+    "dispatch_scope",
+    "drain_pipeline",
+    "get_pipeline",
+    "get_ring",
+    "notify_dispatched",
+    "pack_async",
+    "pack_inline",
+    "pipeline_enabled",
+    "reset_for_tests",
+    "stamp_and_upload",
+]
+
+#: pipeline master switch (the inline pack is the degraded mode, not a
+#: different semantics — parity is the contract either way)
+PIPELINE_ENV = "TORCHMETRICS_TPU_INGEST_PIPELINE"
+#: slabs per (bucket, layout) ring entry; depth 1 still works (the acquire
+#: waits for retirement), depth >=2 hides the wait
+RING_DEPTH_ENV = "TORCHMETRICS_TPU_INGEST_RING"
+DEFAULT_RING_DEPTH = 4
+#: bounded pack-queue depth; a full queue degrades the submit to inline
+QUEUE_ENV = "TORCHMETRICS_TPU_INGEST_QUEUE"
+DEFAULT_QUEUE_MAXSIZE = 2
+#: distinct (bucket, layout) ring entries kept before the least-recently-used
+#: one is dropped (its in-flight slabs stay alive via their own references)
+MAX_SPECS = 8
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).strip().lower() not in ("0", "false", "off", "no")
+
+
+def pipeline_enabled() -> bool:
+    """Whether the staged pack pipeline may engage (env master switch)."""
+    return _env_on(PIPELINE_ENV, "1")
+
+
+def _ring_depth() -> int:
+    try:
+        depth = int(os.environ.get(RING_DEPTH_ENV, "") or DEFAULT_RING_DEPTH)
+    except ValueError:
+        depth = DEFAULT_RING_DEPTH
+    return max(1, depth)
+
+
+# --------------------------------------------------------------- alias probe
+
+_ALIAS_PROBE: Optional[bool] = None
+
+
+def _probe_alias() -> bool:
+    """ONE-SHOT probe of this backend's ``device_put`` host-buffer semantics:
+    mutate a 16-byte scratch array after upload and read the device copy back.
+    The ``np.asarray`` here is the deliberate probe read — it runs once per
+    process on a scratch array, never on traffic."""
+    scratch = np.zeros((4,), np.float32)
+    try:
+        dev = jnp.asarray(scratch)
+        scratch[:] = 1.0
+        return bool(np.asarray(dev)[0] == 1.0)
+    except Exception as err:  # an unprobeable backend is treated as aliasing (safe)
+        rank_zero_debug(f"ingest: device_put alias probe failed ({type(err).__name__}: {err})")
+        return True
+
+
+def device_put_aliases_host() -> bool:
+    """True when ``jnp.asarray`` of a host array may alias its memory instead
+    of copying (zero-copy PJRT semantics). Aliasing backends get the
+    defensive per-upload copy so slab reuse can never corrupt an in-flight
+    dispatch; copying backends upload straight from the slab."""
+    global _ALIAS_PROBE
+    if _ALIAS_PROBE is None:
+        _ALIAS_PROBE = _probe_alias()
+    return _ALIAS_PROBE
+
+
+# ------------------------------------------------------------------ the slab
+
+
+class SlabSpec(NamedTuple):
+    """The (bucket, per-arg layout) identity of one slab shape."""
+
+    bucket: int
+    leaves: Tuple[Tuple[Tuple[int, ...], str], ...]  # per-arg (row shape, dtype str)
+
+
+class _SlabFallback(Exception):
+    """Internal: the round deviates from the slab fast-path layout — the
+    router must run the legacy inline pack (exact parity path)."""
+
+
+def make_spec(batches: Sequence[Tuple[Any, ...]], bucket: int) -> Optional[SlabSpec]:
+    """Derive the round's slab layout from its first row; None when the round
+    cannot take the slab fast path (un-arrayable leaves, non-numeric dtypes).
+    Per-row conformance is checked during the in-place write — this only
+    reads ONE row."""
+    if not batches:
+        return None
+    first = batches[0]
+    leaves = []
+    try:
+        for leaf in first:
+            arr = np.asarray(leaf)
+            if arr.dtype.kind not in "fiub" or arr.dtype.hasobject:
+                return None
+            leaves.append((tuple(arr.shape), arr.dtype.str))
+    except Exception as err:  # un-arrayable first row: the legacy pack owns it
+        rank_zero_debug(f"ingest: round cannot take the slab path ({type(err).__name__}: {err})")
+        return None
+    return SlabSpec(int(bucket), tuple(leaves))
+
+
+class StagingSlab:
+    """One preallocated pack target: per-arg ``(bucket, *row)`` host buffers
+    plus the lane-id vector riding the same object. Reused round-over-round;
+    the ring hands it out only once its retire tokens report ready."""
+
+    __slots__ = ("spec", "args", "lane_ids", "tokens", "generation", "busy", "_upload")
+
+    def __init__(self, spec: SlabSpec) -> None:
+        self.spec = spec
+        self.args: List[np.ndarray] = [
+            np.zeros((spec.bucket,) + shape, dtype=np.dtype(dt)) for shape, dt in spec.leaves
+        ]
+        self.lane_ids = np.zeros((spec.bucket,), np.int32)
+        #: device arrays that must be ready before the buffers may be reused
+        self.tokens: Tuple[Any, ...] = ()
+        #: bumped on every acquire — tests use it to prove reuse (not realloc)
+        self.generation = 0
+        #: checked out (being packed / awaiting dispatch) — not reacquirable
+        self.busy = False
+        self._upload: Tuple[Any, ...] = ()
+
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.args) + self.lane_ids.nbytes)
+
+
+def _token_done(t: Any) -> Optional[bool]:
+    """Fast non-blocking verdict for one retire token: True (provably done),
+    False (still pending), None (cannot tell without blocking). A DELETED
+    array — its buffer donated into a LATER dispatch — proves the consuming
+    computation finished long ago, so deletion counts as done."""
+    deleted = getattr(t, "is_deleted", None)
+    if deleted is not None:
+        try:
+            if deleted():
+                return True
+        except Exception as err:
+            rank_zero_debug(f"ingest: token deletion probe failed ({type(err).__name__}: {err})")
+            return None
+    ready = getattr(t, "is_ready", None)
+    if ready is None:
+        return None
+    try:
+        return bool(ready())
+    except Exception as err:  # racing deletion between the two probes
+        rank_zero_debug(f"ingest: token readiness probe failed ({type(err).__name__}: {err})")
+        return None
+
+
+def _tokens_ready(tokens: Tuple[Any, ...]) -> bool:
+    """Non-blocking retire check (the inline path's acquire gate)."""
+    return all(_token_done(t) is True for t in tokens)
+
+
+def _wait_tokens(tokens: Tuple[Any, ...]) -> None:
+    """WORKER-SIDE retire wait (allowlisted): block until every token — the
+    slab's uploaded input arrays plus the consuming dispatch's committed
+    state leaf — is ready, so overwriting the slab cannot race an in-flight
+    transfer or (on aliasing backends) the dispatch itself. A token whose
+    buffer was donated into a LATER dispatch is already proof of completion
+    (:func:`_token_done`)."""
+    for t in tokens:
+        if _token_done(t) is True:
+            continue
+        try:
+            jax.block_until_ready(t)
+        except Exception:  # deleted mid-wait: completion already proven
+            rank_zero_debug("ingest: retire token deleted mid-wait; completion already proven")
+
+
+class SlabRing:
+    """Bounded ring of :class:`StagingSlab` per layout, LRU across layouts."""
+
+    def __init__(self, depth: Optional[int] = None) -> None:
+        self._depth = depth if depth is not None else _ring_depth()
+        self._lock = threading.Lock()
+        self._slabs: Dict[SlabSpec, List[StagingSlab]] = {}
+        self._cursor: Dict[SlabSpec, int] = {}
+        self._touch: Dict[SlabSpec, int] = {}
+        self._clock = 0
+        self.stats: Dict[str, int] = {"allocated": 0, "reused": 0, "busy": 0, "discarded": 0}
+
+    def _entry(self, spec: SlabSpec) -> List[StagingSlab]:
+        slabs = self._slabs.get(spec)
+        if slabs is None:
+            if len(self._slabs) >= MAX_SPECS:
+                oldest = min(self._touch, key=self._touch.get)
+                del self._slabs[oldest], self._cursor[oldest], self._touch[oldest]
+            slabs = []
+            self._slabs[spec] = slabs
+            self._cursor[spec] = 0
+        self._clock += 1
+        self._touch[spec] = self._clock
+        return slabs
+
+    def _try_acquire(self, spec: SlabSpec, allow_unretired: bool):
+        """One locked pass: (slab, wait_tokens). A busy slab (checked out,
+        still being packed or awaiting dispatch) is never handed out twice."""
+        with self._lock:
+            slabs = self._entry(spec)
+            n = len(slabs)
+            for i in range(n):
+                slab = slabs[(self._cursor[spec] + i) % n]
+                if slab.busy:
+                    continue
+                if not slab.tokens or _tokens_ready(slab.tokens):
+                    self._cursor[spec] = (self._cursor[spec] + i + 1) % n
+                    slab.busy = True
+                    slab.tokens = ()
+                    slab._upload = ()
+                    slab.generation += 1
+                    self.stats["reused" if slab.generation > 1 else "allocated"] += 1
+                    return slab, ()
+            if n < self._depth:
+                slab = StagingSlab(spec)
+                slabs.append(slab)
+                slab.busy = True
+                slab.generation = 1
+                self.stats["allocated"] += 1
+                return slab, ()
+            if not allow_unretired:
+                return None, ()
+            for i in range(n):  # oldest non-busy slab, unretired: caller waits
+                slab = slabs[(self._cursor[spec] + i) % n]
+                if slab.busy:
+                    continue
+                self._cursor[spec] = (self._cursor[spec] + i + 1) % n
+                tokens, slab.tokens, slab._upload = slab.tokens, (), ()
+                slab.busy = True
+                slab.generation += 1
+                self.stats["reused"] += 1
+                return slab, tokens
+            return None, ()
+
+    def acquire(self, spec: SlabSpec, block: bool, timeout: float = 30.0) -> Optional[StagingSlab]:
+        """The next reusable slab for ``spec``. Non-blocking (``block=False``,
+        the router's inline path): None when every slab is still in flight —
+        the caller degrades to the legacy pack. Blocking (``block=True``, the
+        pack WORKER only): waits for the oldest slab's retire tokens."""
+        slab, tokens = self._try_acquire(spec, allow_unretired=block)
+        if slab is not None:
+            if tokens:
+                _wait_tokens(tokens)  # outside the lock: the ring stays concurrent
+            return slab
+        if not block:
+            self.stats["busy"] += 1
+            return None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:  # every slab checked out: rare
+            time.sleep(0.0005)
+            slab, tokens = self._try_acquire(spec, allow_unretired=True)
+            if slab is not None:
+                if tokens:
+                    _wait_tokens(tokens)
+                return slab
+        self.stats["busy"] += 1
+        return None
+
+    def commit(self, slab: StagingSlab, tokens: Tuple[Any, ...]) -> None:
+        """Mark ``slab`` in flight behind ``tokens`` (checked at reacquire)."""
+        slab.tokens = tuple(tokens)
+        slab.busy = False
+
+    def release(self, slab: StagingSlab) -> None:
+        """Return an acquired slab unused (its round diverted entirely)."""
+        slab.tokens = ()
+        slab._upload = ()
+        slab.busy = False
+
+    def discard(self, slab: StagingSlab) -> None:
+        """Drop a slab whose consumption cannot be proven (fault path): it is
+        never reused — in-flight readers keep it alive via their own refs and
+        the ring replaces it lazily."""
+        slab.busy = False
+        with self._lock:
+            for spec, slabs in self._slabs.items():
+                if slab in slabs:
+                    slabs.remove(slab)
+                    self._cursor[spec] = 0
+                    break
+        self.stats["discarded"] += 1
+
+
+# ------------------------------------------------------------------ the pack
+
+
+class PackResult(NamedTuple):
+    """A filled slab: the pack product the router stamps lane ids into."""
+
+    slab: StagingSlab
+    reasons: Optional[List[Optional[str]]]  # screening verdicts (None = guard off)
+    rows: int
+
+
+def pack_into_slab(
+    slab: StagingSlab,
+    batches: Sequence[Tuple[Any, ...]],
+    rows: int,
+    screen: bool,
+) -> PackResult:
+    """Write ``rows`` per-session rows in place into ``slab`` (the zero-copy
+    pack: no per-round stack allocation) and — when ``screen`` — run the PR 8
+    vectorized admission screen against the slab region directly. Any layout
+    deviation (leaf count, shape, exact dtype) raises :class:`_SlabFallback`:
+    the router then runs the legacy pack, whose majority-vote slow path is
+    the single source of truth for mixed/malformed rounds. The slab spec IS
+    the memoized uniform-round dtype reference — conformance is one dtype/shape
+    identity check per row, not a per-round set rebuild."""
+    from torchmetrics_tpu.quarantine import screen_slab_leaf
+
+    spec = slab.spec
+    n_leaves = len(spec.leaves)
+    reasons: Optional[List[Optional[str]]] = [None] * rows if screen else None
+    try:
+        if any(len(b) != n_leaves for b in batches):
+            raise _SlabFallback()
+        for leaf_idx, (shape, _dt) in enumerate(spec.leaves):
+            target = slab.args[leaf_idx]
+            dtype = target.dtype
+            arrs = [np.asarray(b[leaf_idx]) for b in batches]
+            # exact-dtype conformance per row BEFORE the copy: np.stack's
+            # out= would silently same-kind-cast (e.g. f64 rows narrowed into
+            # an f32 slab), whereas the legacy pack PROMOTES the whole stack
+            # — any drift must take the legacy path, not change numerics
+            if not all(a.dtype == dtype for a in arrs):
+                raise _SlabFallback()
+            # one C-level copy straight into the slab region (raises on
+            # ragged shapes -> fallback); no per-round stack allocation
+            np.stack(arrs, axis=0, out=target[:rows])
+    except _SlabFallback:
+        raise
+    except Exception as err:  # ragged / un-arrayable rows: legacy pack owns them
+        rank_zero_debug(f"ingest: slab pack fell back ({type(err).__name__}: {err})")
+        raise _SlabFallback() from err
+    if screen:
+        for leaf_idx in range(n_leaves):
+            screen_slab_leaf(slab.args[leaf_idx], rows, leaf_idx, reasons)
+    return PackResult(slab, reasons, rows)
+
+
+class PackTicket:
+    """Future for one staged pack. ``take()`` blocks for the worker's HOST
+    work only (never device work), re-raises the pack's error exactly as the
+    inline pack would have raised it, and returns None when the round fell
+    back to the legacy path."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Optional[PackResult] = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, value: Optional[PackResult], error: Optional[BaseException]) -> None:
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def take(self, timeout: Optional[float] = 60.0) -> Optional[PackResult]:
+        if not self._event.wait(timeout):
+            return None  # a wedged worker degrades to the inline pack
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class IngestPipeline:
+    """One daemon worker + bounded queue packing round k+1 under round k.
+
+    ``submit`` never blocks: a full queue returns None and the router packs
+    inline (the documented backpressure degradation — rounds are consumed in
+    submission order either way, so no round is dropped or reordered). The
+    worker reopens the submitter's trace context so the pack span carries a
+    Perfetto flow arrow from the router's dispatch slice."""
+
+    def __init__(self, maxsize: Optional[int] = None) -> None:
+        if maxsize is None:
+            try:
+                maxsize = int(os.environ.get(QUEUE_ENV, "") or DEFAULT_QUEUE_MAXSIZE)
+            except ValueError:
+                maxsize = DEFAULT_QUEUE_MAXSIZE
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, maxsize))
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {"submitted": 0, "completed": 0, "fallbacks": 0, "errors": 0, "full": 0}
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="tm_tpu_ingest_pack", daemon=True
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job, ticket, ctx = self._q.get()
+            try:
+                self._execute(job, ticket, ctx)
+            finally:
+                self._q.task_done()
+
+    def _execute(self, job: Callable[[], Optional[PackResult]], ticket: PackTicket, ctx: Any) -> None:
+        with obs.use_context(ctx):
+            try:
+                with obs.span(obs.SPAN_PACK, histogram="lanes.pack_us", staged=True):
+                    value = job()
+            except _SlabFallback:
+                self.stats["fallbacks"] += 1
+                ticket._finish(None, None)
+                return
+            except BaseException as err:
+                # the router re-raises this exactly where the inline pack
+                # would have raised; the flight ring keeps the worker-side
+                # window (pack-worker faults land in the lanes domain)
+                self.stats["errors"] += 1
+                rank_zero_debug(f"ingest: staged pack failed ({type(err).__name__}: {err})")
+                obs.flighted(err, domain="lanes")
+                ticket._finish(None, err)
+                return
+        self.stats["completed"] += 1
+        ticket._finish(value, None)
+
+    def submit(self, job: Callable[[], Optional[PackResult]]) -> Optional[PackTicket]:
+        ticket = PackTicket()
+        ctx = obs.capture_context()
+        try:
+            self._q.put_nowait((job, ticket, ctx))
+        except queue.Full:
+            self.stats["full"] += 1
+            return None
+        self.stats["submitted"] += 1
+        self._ensure_thread()
+        return ticket
+
+    def pending(self) -> int:
+        return self._q.unfinished_tasks
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.001)
+        return True
+
+
+# ------------------------------------------------------- process-wide plumbing
+
+_PIPELINE: Optional[IngestPipeline] = None
+_RING: Optional[SlabRing] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_pipeline() -> IngestPipeline:
+    global _PIPELINE
+    with _GLOBAL_LOCK:
+        if _PIPELINE is None:
+            _PIPELINE = IngestPipeline()
+        return _PIPELINE
+
+
+def get_ring() -> SlabRing:
+    global _RING
+    with _GLOBAL_LOCK:
+        if _RING is None:
+            _RING = SlabRing()
+        return _RING
+
+
+def drain_pipeline(timeout: float = 60.0) -> bool:
+    """Wait for in-flight packs (tests / shutdown flushes; no-op when idle)."""
+    with _GLOBAL_LOCK:
+        pipeline = _PIPELINE
+    return True if pipeline is None else pipeline.drain(timeout)
+
+
+def reset_for_tests() -> None:
+    """Drop the process-wide pipeline and ring (tests only): in-flight slabs
+    stay alive through their own references; the next round rebuilds both."""
+    global _PIPELINE, _RING
+    with _GLOBAL_LOCK:
+        _PIPELINE = None
+        _RING = None
+
+
+# ------------------------------------------------------- router-facing surface
+
+
+def pack_async(
+    pipeline: IngestPipeline,
+    ring: SlabRing,
+    batches: Sequence[Tuple[Any, ...]],
+    rows: int,
+    bucket: int,
+    screen: bool,
+) -> Optional[PackTicket]:
+    """Stage one round's pack on the worker; None when the round cannot take
+    the slab path (layout) or the queue is full (backpressure -> inline)."""
+    spec = make_spec(batches, bucket)
+    if spec is None:
+        return None
+
+    def job() -> Optional[PackResult]:
+        slab = ring.acquire(spec, block=True)  # worker-side retire wait
+        if slab is None:  # every slab checked out past the timeout: degrade
+            raise _SlabFallback()
+        try:
+            return pack_into_slab(slab, batches, rows, screen)
+        except BaseException:
+            ring.release(slab)  # partially-written slab goes straight back
+            raise
+
+    # the enqueue half of the causal pair (the PR 13 compile-enqueue idiom):
+    # the ambient context is captured INSIDE this span, so the worker-side
+    # pack span links back to the submitting slice as a Perfetto flow arrow
+    with obs.span(obs.SPAN_PACK, phase="enqueue"):
+        return pipeline.submit(job)
+
+
+def pack_inline(
+    ring: SlabRing,
+    batches: Sequence[Tuple[Any, ...]],
+    rows: int,
+    bucket: int,
+    screen: bool,
+) -> Optional[PackResult]:
+    """The router-thread pack into a slab — the backpressure degradation and
+    the single-round steady path. Never blocks: a busy ring (or a layout
+    deviant) returns None and the caller runs the legacy pack."""
+    spec = make_spec(batches, bucket)
+    if spec is None:
+        return None
+    slab = ring.acquire(spec, block=False)
+    if slab is None:
+        return None
+    try:
+        with obs.span(obs.SPAN_PACK, histogram="lanes.pack_us", staged=False):
+            return pack_into_slab(slab, batches, rows, screen)
+    except _SlabFallback:
+        ring.release(slab)
+        return None
+    except BaseException:
+        ring.release(slab)
+        raise
+
+
+def stamp_and_upload(
+    packed: PackResult, lanes: Sequence[int], sentinel: int
+) -> Tuple[Any, Tuple[Any, ...]]:
+    """Stamp the (possibly sentinel-diverted) lane ids into the slab's id
+    vector — ALWAYS on the router thread at dispatch time, so an admission or
+    eviction between pack and dispatch can never route rows into a reassigned
+    lane — then upload the slab: one H2D per argument plus the id vector.
+    On aliasing backends each upload copies defensively (see
+    :func:`device_put_aliases_host`); the uploaded arrays are stashed on the
+    slab as retire tokens for :func:`dispatch_scope`."""
+    slab = packed.slab
+    rows = packed.rows
+    slab.lane_ids[:rows] = list(lanes)
+    slab.lane_ids[rows:] = np.int32(sentinel)
+    copy = device_put_aliases_host()
+    ids_dev = jnp.asarray(slab.lane_ids.copy() if copy else slab.lane_ids)
+    batch = tuple(jnp.asarray(a.copy() if copy else a) for a in slab.args)
+    slab._upload = (ids_dev,) + batch
+    obs.counter_inc("lanes.h2d_bytes", slab.nbytes())
+    return ids_dev, batch
+
+
+# ------------------------------------------------ executor dispatch-seam hooks
+
+class _DispatchTLS(threading.local):
+    def __init__(self) -> None:
+        self.slab: Optional[StagingSlab] = None
+        self.token: Optional[Any] = None
+
+
+_dispatch_tls = _DispatchTLS()
+
+
+class dispatch_scope:
+    """Arms the executor's slab-aware dispatch seam for one round.
+
+    The router wraps the dispatch in ``with dispatch_scope(slab):``; the
+    executor calls :func:`notify_dispatched` with the state it committed, and
+    on exit the slab goes in flight behind its upload tokens plus that
+    committed leaf. A dispatch that raised without committing cannot prove
+    the slab was fully consumed, so the slab is discarded — never reused.
+    A ``None`` slab (legacy pack path) makes the whole scope a no-op."""
+
+    __slots__ = ("_slab", "_ring")
+
+    def __init__(self, slab: Optional[StagingSlab], ring: Optional[SlabRing] = None) -> None:
+        self._slab = slab
+        self._ring = ring
+
+    def __enter__(self) -> "dispatch_scope":
+        if self._slab is not None:
+            _dispatch_tls.slab = self._slab
+            _dispatch_tls.token = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        slab = self._slab
+        if slab is None:
+            return
+        token = _dispatch_tls.token
+        _dispatch_tls.slab = None
+        _dispatch_tls.token = None
+        ring = self._ring if self._ring is not None else get_ring()
+        if token is None:
+            # no committed-state token: the dispatch died, or it bypassed the
+            # executor (eager fallback) and may still be reading the uploads
+            # asynchronously. device_put zero-copy aliasing is decided
+            # PER-ARRAY by the backend (alignment), so input tokens alone can
+            # never prove the buffers are safe to overwrite — discard the
+            # slab instead of ever reusing it (the degraded mode simply costs
+            # what the old np.stack path always paid: a fresh allocation).
+            ring.discard(slab)
+            return
+        ring.commit(slab, slab._upload + (token,))
+
+
+def notify_dispatched(new_state: Any) -> None:
+    """Executor-side half of the seam (ops/executor.py calls this right after
+    committing a dispatch's new state): attach one committed leaf as the
+    armed slab's strong retire token. No-op outside a :class:`dispatch_scope`
+    — the seam costs one thread-local read per dispatch."""
+    if _dispatch_tls.slab is None:
+        return
+    try:
+        leaves = jax.tree_util.tree_leaves(new_state)
+    except Exception as err:  # an unflattenable state yields no strong token
+        rank_zero_debug(f"ingest: committed state not flattenable ({type(err).__name__}: {err})")
+        leaves = []
+    for leaf in leaves:
+        if hasattr(leaf, "is_ready") or hasattr(leaf, "block_until_ready"):
+            _dispatch_tls.token = leaf
+            return
+    _dispatch_tls.token = leaves[0] if leaves else None

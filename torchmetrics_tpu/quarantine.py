@@ -53,6 +53,7 @@ __all__ = [
     "LaneStateMirror",
     "row_spec_majority",
     "screen_row",
+    "screen_slab_leaf",
 ]
 
 #: valid ``on_lane_fault`` policies (``None`` disables the guard entirely —
@@ -438,17 +439,26 @@ def _kind(dtype: Any) -> str:
     return np.dtype(dtype).kind
 
 
-def row_spec_majority(batches: Sequence[Tuple[Any, ...]]) -> Optional[List[Tuple[Tuple[int, ...], str]]]:
+def row_spec_majority(
+    batches: Sequence[Tuple[Any, ...]], n_leaves: Optional[int] = None
+) -> Optional[List[Tuple[Tuple[int, ...], str]]]:
     """The round's reference row layout by majority vote: per-leaf
     ``(shape, dtype-kind)`` agreed by most rows (leaf COUNT by majority
     first). Majority — not first-row — so one malformed tenant cannot redefine
-    the round's shape and fault everyone else. None when no usable row exists."""
-    counts: Dict[int, int] = {}
-    for b in batches:
-        counts[len(b)] = counts.get(len(b), 0) + 1
-    if not counts:
+    the round's shape and fault everyone else. None when no usable row exists.
+
+    ``n_leaves`` (the router's screened slow path passes it) skips the leaf
+    count vote when the caller already resolved it — the rows it hands in are
+    pre-parsed arrays, so the whole vote is attribute reads, no re-parse."""
+    if n_leaves is None:
+        counts: Dict[int, int] = {}
+        for b in batches:
+            counts[len(b)] = counts.get(len(b), 0) + 1
+        if not counts:
+            return None
+        n_leaves = max(counts, key=lambda k: (counts[k], -k))
+    elif not batches:
         return None
-    n_leaves = max(counts, key=lambda k: (counts[k], -k))
     votes: List[Dict[Tuple[Tuple[int, ...], str], int]] = [{} for _ in range(n_leaves)]
     for b in batches:
         if len(b) != n_leaves:
@@ -493,6 +503,24 @@ def screen_row(
         if check_finite and _kind(arr.dtype) == "f" and not bool(np.isfinite(arr).all()):
             return f"leaf {i} carries non-finite values"
     return None
+
+
+def screen_slab_leaf(
+    stacked: np.ndarray, rows: int, leaf_idx: int, reasons: List[Optional[str]]
+) -> None:
+    """The PR 8 vectorized finite screen run directly against one staging-slab
+    leaf (ops/ingest.py): ONE ``np.isfinite`` over the slab's live region —
+    no per-row Python work, no intermediate stack. Shape/dtype conformance
+    was already proven by the in-place slab write (the slab spec is the
+    memoized uniform-round reference layout), so finiteness is the only check
+    left, and the rejection reasons match the inline screen verbatim."""
+    if stacked.dtype.kind != "f":
+        return
+    finite = np.isfinite(stacked[:rows].reshape(rows, -1)).all(axis=1)
+    if not finite.all():
+        for i in np.flatnonzero(~finite):
+            if reasons[i] is None:
+                reasons[i] = f"leaf {leaf_idx} carries non-finite values"
 
 
 # ---------------------------------------------------------------------------
