@@ -2033,6 +2033,125 @@ def bench_config9():
     return out
 
 
+def bench_config10():
+    """Extreme-cardinality class-axis sharded state (ISSUE 16): a 50k-class
+    MulticlassConfusionMatrix whose dense (C, C) int32 accumulator is 10 GB
+    *per device* runs with ``state_sharding="class_axis"`` over 8 class
+    shards — 1.25 GB per shard — with sparse zero-collective routing on
+    update and the dense view gathered only at compute. Host-CPU by design
+    like configs 2/9 (the measured quantities are layout memory + routing
+    dispatch cost, not device throughput). The per-call host recovery
+    snapshot would copy the full 10 GB state after every donated dispatch,
+    so the 50k rows run with TORCHMETRICS_TPU_EXECUTOR_RECOVERY=0 — the
+    documented mode for memory-wall deployments (docs/EXECUTOR.md); the
+    small-cardinality parity tripwire runs with stock settings."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.parallel import class_shard as cs
+
+    rng = np.random.RandomState(0)
+    out = {
+        "unit": "steady donated updates/s, 50k-class MulticlassConfusionMatrix"
+        " (8 class shards, 4096-sample batches)",
+        "vs_baseline": None,
+    }
+
+    # ---- values-agree tripwire: dense vs class-sharded, bit-exact at a
+    # small odd cardinality (padded tails in play; stock executor settings)
+    C0 = 257
+    dense = MulticlassConfusionMatrix(num_classes=C0, validate_args=False, executor=False)
+    sharded0 = MulticlassConfusionMatrix(
+        num_classes=C0, validate_args=False, executor=False,
+        state_sharding="class_axis", class_shards=8,
+    )
+    for _ in range(3):
+        p = jnp.asarray(rng.randint(0, C0, 2048))
+        t = jnp.asarray(rng.randint(0, C0, 2048))
+        dense.update(p, t)
+        sharded0.update(p, t)
+    out["class_sharded_values_agree"] = bool(
+        np.array_equal(np.asarray(dense.compute()), np.asarray(sharded0.compute()))
+    )
+
+    # ---- 50k-class rows
+    C, S, BATCH = 50_000, 8, 4096
+    prev_recovery = os.environ.get("TORCHMETRICS_TPU_EXECUTOR_RECOVERY")
+    os.environ["TORCHMETRICS_TPU_EXECUTOR_RECOVERY"] = "0"
+    try:
+        m = MulticlassConfusionMatrix(
+            num_classes=C, validate_args=False,
+            state_sharding="class_axis", class_shards=S,
+        )
+        layout = m._class_layout("confmat")
+        p = jnp.asarray(rng.randint(0, C, BATCH))
+        t = jnp.asarray(rng.randint(0, C, BATCH))
+        # first two calls pay the one-time compile + escape-seam state copy
+        # (the installed default aliases _defaults); steady state is donated
+        t0 = time.perf_counter()
+        m.update(p, t)
+        jax.block_until_ready(m._state["confmat"])
+        out["first_update_s"] = round(time.perf_counter() - t0, 2)
+        m.update(p, t)
+        jax.block_until_ready(m._state["confmat"])
+
+        def block():
+            t0 = time.perf_counter()
+            for _ in range(20):
+                m.update(p, t)
+            jax.block_until_ready(m._state["confmat"])
+            return (time.perf_counter() - t0) / 20
+
+        step_s = _stable_min(block, repeats=3)
+        out["value"] = round(1.0 / step_s, 1)
+        out["update_batch"] = BATCH
+
+        # memory rows: the layout property the whole feature exists for
+        itemsize = np.dtype(m._state["confmat"].dtype).itemsize
+        out["dense_state_bytes"] = C * C * itemsize
+        out["per_device_state_bytes"] = layout.shard_size * C * itemsize
+        out["sharded_per_device_ratio"] = round(
+            out["per_device_state_bytes"] / out["dense_state_bytes"], 4
+        )
+        # measured, not just analytic: materialize the stacked layout over
+        # the 8-virtual-device mesh (sharded on the class-shard axis, each
+        # device holding one shard) and read back the peak shard bytes — a
+        # jitted sharded fill, so no 10 GB host-side staging copy
+        mesh = Mesh(np.array(jax.devices()[:S]), ("class",))
+        placed = jax.jit(
+            lambda: jnp.zeros((S, layout.shard_size, C), m._state["confmat"].dtype),
+            out_shardings=NamedSharding(mesh, P("class")),
+        )()
+        jax.block_until_ready(placed)
+        out["per_device_state_bytes_measured"] = int(
+            max(s.data.nbytes for s in placed.addressable_shards)
+        )
+        del placed
+
+        # gather-only-at-compute: the one point the dense view exists
+        t0 = time.perf_counter()
+        val = m.compute()
+        jax.block_until_ready(val)
+        out["compute_gather_s"] = round(time.perf_counter() - t0, 2)
+        # conservation spot check without a 10 GB host pull: total count on
+        # device equals updates x batch (every routed row landed exactly
+        # once; the bench's total stays far inside int32)
+        total = int(jnp.sum(val))
+        out["counts_conserved"] = bool(total == int(m._update_count) * BATCH)
+        out["class_sharded_values_agree"] = bool(
+            out["class_sharded_values_agree"] and out["counts_conserved"]
+        )
+    finally:
+        if prev_recovery is None:
+            os.environ.pop("TORCHMETRICS_TPU_EXECUTOR_RECOVERY", None)
+        else:
+            os.environ["TORCHMETRICS_TPU_EXECUTOR_RECOVERY"] = prev_recovery
+    return out
+
+
 # ----------------------------------------------------------- sync latency
 def bench_sync_latency():
     """psum / all_gather latency vs state size on the 8-device mesh (µs/step)."""
@@ -2074,7 +2193,7 @@ def bench_sync_latency():
     return out
 
 
-def _run_in_cpu_subprocess(name: str):
+def _run_in_cpu_subprocess(name: str, timeout: int = 240):
     """Mesh configs run in a JAX_PLATFORMS=cpu subprocess: with the TPU plugin
     loaded in-process, XLA:CPU's collective rendezvous deadlocks (observed
     fatal 40s timeouts); a clean CPU-only process matches the test env."""
@@ -2087,7 +2206,7 @@ def _run_in_cpu_subprocess(name: str):
         [sys.executable, os.path.abspath(__file__), "--subbench", name],
         capture_output=True,
         text=True,
-        timeout=240,
+        timeout=timeout,
         env=env,
     )
     if proc.returncode != 0:
@@ -2268,11 +2387,13 @@ def main() -> None:
         if "error" not in result and on_accel and not result.get("timing_unstable"):
             _store_cache(cache, name, "tpu", ch, result)
         provenance["live" if on_accel else "cpu_only"].append(name)
-    for name in ("2_collection_mesh_sync", "sync_latency", "9_session_lanes"):
+    for name in ("2_collection_mesh_sync", "sync_latency", "9_session_lanes", "10_extreme_cardinality"):
         # virtual-mesh / dispatch-amortization configs are host-CPU by design
         # (see _run_in_cpu_subprocess) and run live everywhere; the subprocess
-        # reports its own stall signal
-        r = _run_config(lambda name=name: _run_in_cpu_subprocess(name))
+        # reports its own stall signal. Config 10 materializes a 10 GB state
+        # twice (escape-seam copy + gather) on one core — give it headroom
+        to = 560 if name == "10_extreme_cardinality" else 240
+        r = _run_config(lambda name=name, to=to: _run_in_cpu_subprocess(name, timeout=to))
         configs[name] = _apply_baselines(name, r, baselines)
     # config 8 is host-CPU by design too (cold start is a process/compile
     # property, each scenario spawns its own fresh child process)
@@ -2307,6 +2428,7 @@ if __name__ == "__main__":
             "sync_latency": bench_sync_latency,
             "8_cold_start_child": bench_config8_child,
             "9_session_lanes": bench_config9,
+            "10_extreme_cardinality": bench_config10,
         }[sys.argv[2]]
         out = fn()
         if _TIMING_UNSTABLE:  # surface the stall signal across the process boundary

@@ -286,7 +286,7 @@ class TestCrossTopologyDeferred:
                 assert manifest["topology"] == {
                     "topology_version": 1, "device_count": d, "process_count": 1,
                     "mesh_shape": None, "sharded": True, "num_shards": d,
-                    "lane_capacity": None,
+                    "lane_capacity": None, "state_sharding": None,
                 }
                 with faults.shrink_world(d2):
                     if d != d2:
@@ -307,6 +307,79 @@ class TestCrossTopologyDeferred:
                     np.asarray(vals["m"]), reference, rtol=1e-5,
                     err_msg=f"{family}: save on {d}, restore on {d2}",
                 )
+
+
+class TestClassShardedRestoreMatrix:
+    """Cross-topology restore of CLASS-sharded snapshots (ISSUE 16 satellite):
+    state stacked over d class shards, saved under a d-device world, restored
+    onto a d'-shard instance for every (d, d') in {1,2,4,8}^2 — strict refuses
+    off-diagonal, elastic re-splits, and continue-then-compute is bit-exact vs
+    a never-interrupted DENSE (replicated) run over the same batches."""
+
+    C = 10  # deliberately not divisible by 4 or 8: padded tails in play
+
+    def _batches(self, n, seed):
+        rng = np.random.RandomState(seed)
+        return [
+            (rng.randint(0, self.C, BATCH), rng.randint(0, self.C, BATCH))
+            for _ in range(n)
+        ]
+
+    def _sharded(self, d):
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        return MulticlassConfusionMatrix(
+            num_classes=self.C, state_sharding="class_axis", class_shards=d,
+            executor=False,
+        )
+
+    def test_matrix_save_d_restore_dprime(self, tmp_path):
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        batches = self._batches(6, seed=29)
+        dense = MulticlassConfusionMatrix(num_classes=self.C, executor=False)
+        for p, t in batches:
+            dense.update(jnp.asarray(p), jnp.asarray(t))
+        reference = np.asarray(dense.compute())
+
+        for d in WORLDS:
+            src = self._sharded(d)
+            for p, t in batches[:3]:
+                src.update(jnp.asarray(p), jnp.asarray(t))
+            path = str(tmp_path / f"cs-{d}.ckpt")
+            with faults.shrink_world(d):
+                save_state(src, path)
+            assert load_manifest(path)["topology"]["state_sharding"] == d
+            for d2 in WORLDS:
+                with faults.shrink_world(d2):
+                    if d != d2:
+                        with pytest.raises(TopologyMismatchError):
+                            restore_state(path, self._sharded(d2))
+                    target = self._sharded(d2)
+                    info = restore_state(path, target, topology="elastic")
+                    assert info["topology_action"] == ("reshard" if d != d2 else "match")
+                for p, t in batches[3:]:
+                    target.update(jnp.asarray(p), jnp.asarray(t))
+                np.testing.assert_array_equal(
+                    np.asarray(target.compute()), reference,
+                    err_msg=f"class shards: save on {d}, restore on {d2}",
+                )
+
+    def test_sharded_snapshot_restores_onto_dense_twin_elastically(self, tmp_path):
+        from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+        batches = self._batches(4, seed=31)
+        src = self._sharded(8)
+        for p, t in batches:
+            src.update(jnp.asarray(p), jnp.asarray(t))
+        path = str(tmp_path / "cs8.ckpt")
+        save_state(src, path)
+        dense = MulticlassConfusionMatrix(num_classes=self.C, executor=False)
+        with pytest.raises(TopologyMismatchError):
+            restore_state(path, MulticlassConfusionMatrix(num_classes=self.C, executor=False))
+        info = restore_state(path, dense, topology="elastic")
+        assert info["topology_action"] == "reshard"
+        np.testing.assert_array_equal(np.asarray(dense.compute()), np.asarray(src.compute()))
 
 
 # ---------------------------------------------------------------------------

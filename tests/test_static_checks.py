@@ -462,3 +462,60 @@ def test_collectives_linter_catches_violations(tmp_path):
     )
     found = linter.lint_file(bad, "bad.py")
     assert len(found) == 1 and found[0].func == "_foo_update"
+
+
+def test_class_sharding_eligibility_pin():
+    """ISSUE 16 satellite: only sum/mean/max/min ARRAY states are eligible for
+    class-axis sharding. The rule is load-bearing twice over — those are
+    exactly the elementwise reductions that commute with the stacked
+    ``(S, shard_size, *rest)`` layout (parallel/sync.py's module note), and
+    identity padding only reduces to the identity for them — so the constant
+    is pinned here and add_state must gate on that one constant, not a
+    re-spelled copy."""
+    from torchmetrics_tpu.parallel.class_shard import CLASS_SHARDABLE_REDUCTIONS
+    from torchmetrics_tpu.parallel.sync import _VALID_REDUCTIONS
+
+    assert CLASS_SHARDABLE_REDUCTIONS == ("sum", "mean", "max", "min")
+    assert set(CLASS_SHARDABLE_REDUCTIONS) == set(_VALID_REDUCTIONS) - {"cat"}
+    metric_src = (REPO / "torchmetrics_tpu" / "metric.py").read_text()
+    assert "CLASS_SHARDABLE_REDUCTIONS" in metric_src
+    # every eligible reduction has a defined padding identity (the padded
+    # tail must be a no-op under cross-host sync and the canonical fold)
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.parallel.class_shard import identity_pad_value
+
+    for fx in CLASS_SHARDABLE_REDUCTIONS:
+        identity_pad_value(fx, jnp.float32)
+
+
+def test_bench_regression_gate_class_sharded_rows():
+    """The ISSUE 16 gates fire: the dense-vs-sharded parity tripwire is hard,
+    and the per-device memory ratio is capped by BASELINE.json."""
+    checker = _load_tool("check_bench_regression")
+    baseline = json.loads((REPO / "BASELINE.json").read_text())
+    assert "10_extreme_cardinality" in baseline["bench_baselines"]
+    bad = {
+        "configs": {
+            "10_extreme_cardinality": {
+                "value": baseline["bench_baselines"]["10_extreme_cardinality"]["value"],
+                "class_sharded_values_agree": False,
+                "sharded_per_device_ratio": 0.5,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(bad, baseline)
+    reasons = " ".join(v.detail for v in violations)
+    assert "class_sharded_values_agree" in reasons
+    assert "sharded_per_device_ratio" in reasons
+    good = {
+        "configs": {
+            "10_extreme_cardinality": {
+                "value": baseline["bench_baselines"]["10_extreme_cardinality"]["value"],
+                "class_sharded_values_agree": True,
+                "sharded_per_device_ratio": 0.125,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(good, baseline)
+    assert not violations

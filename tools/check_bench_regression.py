@@ -294,6 +294,40 @@ def check_bench(
                     " from the inline pack; bit-exactness is the contract, fail outright",
                 )
             )
+        # class-axis sharding gates (ISSUE 16): the dense-vs-sharded parity
+        # tripwire is hard (bit-exactness is the contract), and the
+        # per-device memory ratio — the property the layout exists for —
+        # must stay at ~1/S (cap from BASELINE.json
+        # sharded_per_device_ratio_max)
+        csagree = result.get("class_sharded_values_agree")
+        if csagree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "class_sharded_values_agree is false — the class-axis sharded"
+                    " update/compute path diverged from the dense twin (or a routed"
+                    " contribution was dropped/doubled); bit-exactness is the"
+                    " contract, fail outright (docs/SHARDING.md 'Class-axis state"
+                    " sharding')",
+                )
+            )
+        csratio = result.get("sharded_per_device_ratio")
+        if isinstance(csratio, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("sharded_per_device_ratio_max", 0.15) if isinstance(base, dict) else 0.15
+            if float(csratio) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        float(csratio),
+                        threshold,
+                        f"sharded_per_device_ratio {csratio:.4f} above the {cap} cap —"
+                        " the class-sharded layout no longer delivers the ~1/S"
+                        " per-device state footprint it exists for",
+                    )
+                )
         qagree = result.get("quantized_values_agree")
         if qagree is False:
             violations.append(

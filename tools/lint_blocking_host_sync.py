@@ -52,6 +52,7 @@ HOT_PATH_FILES = (
     "parallel/sync.py",
     "parallel/quantized.py",
     "parallel/reshard.py",
+    "parallel/class_shard.py",
     "io/checkpoint.py",
     "io/retry.py",
     "obs/tracer.py",

@@ -45,6 +45,13 @@ COLLECTIVE_NAMES = {
 #: "<path relative to functional/>::<function name>", values say why
 ALLOWLIST: dict = {}
 
+#: modules OUTSIDE functional/ whose every function is update-stage by
+#: contract, relative to the package root: class-axis routing
+#: (parallel/class_shard.py) runs inside shard_map'd update bodies and
+#: promises zero collectives until the read point (docs/SHARDING.md
+#: "Class-axis state sharding"), so the whole module is scanned
+EXTRA_SCOPE_FILES = ("parallel/class_shard.py",)
+
 
 class Violation(NamedTuple):
     path: str
@@ -66,7 +73,7 @@ def _called_collective(node: ast.Call):
     return None
 
 
-def lint_file(path: Path, rel: str) -> List[Violation]:
+def lint_file(path: Path, rel: str, all_functions: bool = False) -> List[Violation]:
     source = path.read_text()
     try:
         tree = ast.parse(source, filename=str(path))
@@ -77,7 +84,7 @@ def lint_file(path: Path, rel: str) -> List[Violation]:
     for node in ast.walk(tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        if not _is_update_stage(node.name):
+        if not all_functions and not _is_update_stage(node.name):
             continue
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
@@ -96,6 +103,20 @@ def collect_violations(functional_root: Path):
     for path in sorted(functional_root.rglob("*.py")):
         rel = path.relative_to(functional_root).as_posix()
         for v in lint_file(path, rel):
+            key = f"{v.path}::{v.func}"
+            if key in ALLOWLIST:
+                used.add(key)
+                continue
+            violations.append(v)
+    # whole-module scope: every function of these package-root-relative
+    # modules is update-stage by contract (see EXTRA_SCOPE_FILES)
+    package_root = functional_root.parent
+    for rel in EXTRA_SCOPE_FILES:
+        path = package_root / rel
+        if not path.exists():
+            violations.append(Violation(rel, 0, "<module>", "EXTRA_SCOPE_FILES entry missing on disk"))
+            continue
+        for v in lint_file(path, rel, all_functions=True):
             key = f"{v.path}::{v.func}"
             if key in ALLOWLIST:
                 used.add(key)

@@ -59,6 +59,7 @@ COVERED_MODULES = (
     "ops/async_read.py",
     "parallel/sync.py",
     "parallel/reshard.py",
+    "parallel/class_shard.py",
     "io/checkpoint.py",
     "io/retry.py",
 )

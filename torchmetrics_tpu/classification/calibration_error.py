@@ -58,8 +58,11 @@ class BinaryCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        # growing "cat" sample lists are ineligible for class-axis sharding
+        # (no class axis to partition); pinned replicated so a process-wide
+        # TORCHMETRICS_TPU_STATE_SHARDING=class_axis default cannot drift them
+        self.add_state("confidences", [], dist_reduce_fx="cat", state_sharding="replicated")
+        self.add_state("accuracies", [], dist_reduce_fx="cat", state_sharding="replicated")
 
     def update(self, preds: Array, target: Array) -> None:
         import numpy as np
@@ -119,8 +122,11 @@ class MulticlassCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        # growing "cat" sample lists are ineligible for class-axis sharding
+        # (no class axis to partition); pinned replicated so a process-wide
+        # TORCHMETRICS_TPU_STATE_SHARDING=class_axis default cannot drift them
+        self.add_state("confidences", [], dist_reduce_fx="cat", state_sharding="replicated")
+        self.add_state("accuracies", [], dist_reduce_fx="cat", state_sharding="replicated")
 
     def update(self, preds: Array, target: Array) -> None:
         import numpy as np

@@ -26,6 +26,7 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.ops import fused_classification as _fused
+from torchmetrics_tpu.parallel import class_shard as _class_shard
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 
@@ -130,6 +131,24 @@ class MulticlassConfusionMatrix(Metric):
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        layout = self._class_layout("confmat")
+        if layout is not None:
+            # class-sharded state: emit sparse (row, col, 1) contributions and
+            # route each to the shard owning its target class; ignore_index
+            # holes ship a -1 sentinel row and never land (mode="drop"). The
+            # fused dense-counts kernel is bypassed — it materializes the full
+            # (C, C) grid this layout exists to avoid.
+            preds, target, valid = _multiclass_confusion_matrix_format(preds, target, self.ignore_index)
+            cols = jnp.clip(preds.astype(jnp.int32), 0, self.num_classes - 1)
+            rows = jnp.where(valid, target.astype(jnp.int32), -1)
+            self.confmat = _class_shard.route_scatter_add(
+                self.confmat,
+                rows,
+                jnp.ones(rows.shape, dtype=jnp.int32),
+                inner_idx=cols,
+                layout=layout,
+            )
+            return
         if _fused.fused_enabled():
             counts = _fused.multiclass_confusion_counts(preds, target, self.num_classes, self.ignore_index)
             self.confmat = self.confmat + counts.astype(jnp.int32)
@@ -138,7 +157,11 @@ class MulticlassConfusionMatrix(Metric):
         self.confmat = self.confmat + _multiclass_confusion_matrix_update(preds, target, valid, self.num_classes)
 
     def compute(self) -> Array:
-        return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
+        confmat = self.confmat
+        layout = self._class_layout("confmat")
+        if layout is not None:
+            confmat = _class_shard.gather_dense(confmat, layout)
+        return _multiclass_confusion_matrix_compute(confmat, self.normalize)
 
     def plot(self, val: Optional[Array] = None, ax: Any = None, add_text: bool = True, labels: Any = None):
         from torchmetrics_tpu.utils.plot import plot_confusion_matrix
@@ -191,6 +214,26 @@ class MultilabelConfusionMatrix(Metric):
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
             _multilabel_confusion_matrix_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        layout = self._class_layout("confmat")
+        if layout is not None:
+            # label-axis sharded: each (sample, label) cell contributes 1 to
+            # the owning label shard's 2x2 block at flat cell target*2 + pred;
+            # ignore_index holes ship a -1 label sentinel and never land
+            preds, target, valid = _multilabel_confusion_matrix_format(
+                preds, target, self.num_labels, self.threshold, self.ignore_index
+            )
+            p = jnp.clip(preds.astype(jnp.int32), 0, 1)
+            t = jnp.clip(target.astype(jnp.int32), 0, 1)
+            labels = jnp.broadcast_to(jnp.arange(self.num_labels, dtype=jnp.int32), t.shape)
+            rows = jnp.where(valid, labels, -1)
+            self.confmat = _class_shard.route_scatter_add(
+                self.confmat,
+                rows,
+                jnp.ones(rows.shape, dtype=jnp.int32),
+                inner_idx=t * 2 + p,
+                layout=layout,
+            )
+            return
         if _fused.fused_enabled():
             counts = _fused.multilabel_confusion_counts(
                 preds, target, self.num_labels, self.threshold, self.ignore_index
@@ -203,7 +246,11 @@ class MultilabelConfusionMatrix(Metric):
         self.confmat = self.confmat + _multilabel_confusion_matrix_update(preds, target, valid, self.num_labels)
 
     def compute(self) -> Array:
-        return _multilabel_confusion_matrix_compute(self.confmat, self.normalize)
+        confmat = self.confmat
+        layout = self._class_layout("confmat")
+        if layout is not None:
+            confmat = _class_shard.gather_dense(confmat, layout)
+        return _multilabel_confusion_matrix_compute(confmat, self.normalize)
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):

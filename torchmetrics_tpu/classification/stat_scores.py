@@ -31,6 +31,7 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.ops import fused_classification as _fused
+from torchmetrics_tpu.parallel import class_shard as _class_shard
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
@@ -77,13 +78,30 @@ class _AbstractStatScores(Metric):
             self.fp.append(fp)
             self.tn.append(tn)
             self.fn.append(fn)
-        else:
-            self.tp = self.tp + tp
-            self.fp = self.fp + fp
-            self.tn = self.tn + tn
-            self.fn = self.fn + fn
+            return
+        layout = self._class_layout("tp")
+        if layout is not None:
+            # class-sharded (C,) counters: the update kernels emit dense
+            # per-class vectors, accumulated into the stack via the zero-pad
+            # add (parallel/class_shard.py) — still zero-collective
+            self.tp = _class_shard.add_dense(self.tp, tp, layout)
+            self.fp = _class_shard.add_dense(self.fp, fp, layout)
+            self.tn = _class_shard.add_dense(self.tn, tn, layout)
+            self.fn = _class_shard.add_dense(self.fn, fn, layout)
+            return
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
 
     def _final_state(self):
+        layout = self._class_layout("tp")
+        if layout is not None:
+            # the ONE read-point gather: downstream computes (accuracy,
+            # precision/recall, F-score) see dense (C,) vectors unchanged
+            return tuple(
+                _class_shard.gather_dense(self._state[k], layout) for k in ("tp", "fp", "tn", "fn")
+            )
         tp = dim_zero_cat(self.tp) if isinstance(self._state["tp"], list) else self.tp
         fp = dim_zero_cat(self.fp) if isinstance(self._state["fp"], list) else self.fp
         tn = dim_zero_cat(self.tn) if isinstance(self._state["tn"], list) else self.tn

@@ -260,6 +260,10 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
         "sharded": bool(shard_counts),
         "num_shards": max(shard_counts) if shard_counts else None,
         "lane_capacity": (lanes or {}).get("capacity"),
+        # class-axis placement (parallel/class_shard.py): the shard count the
+        # payload's class-stacked fields were saved under, None when every
+        # state is dense/replicated along its class axis
+        "state_sharding": _class_shard_count_of(obj),
     }
     manifest = {
         "manifest_version": MANIFEST_VERSION,
@@ -504,6 +508,26 @@ def _decode_state(path: str, manifest: Dict[str, Any], payload: bytes) -> Dict[s
     return _unflatten_export(leaves, manifest.get("scalars") or {}, manifest.get("kind") == "collection")
 
 
+def _class_shard_count_of(obj: Any) -> Optional[int]:
+    """The class-axis shard count of ``obj``'s state layout (metric or
+    collection member), or None when no field is class-sharded — the value
+    the manifest topology block binds a snapshot to."""
+
+    def probe(m: Any) -> Optional[int]:
+        layouts = getattr(m, "_class_layouts", None) or {}
+        counts = [int(lay.num_shards) for lay in layouts.values()]
+        return max(counts) if counts else None
+
+    count = probe(obj)
+    if count is not None:
+        return count
+    for member in (getattr(obj, "_modules", None) or {}).values():
+        count = probe(member)
+        if count is not None:
+            return count
+    return None
+
+
 def _check_topology(path: str, manifest: Dict[str, Any], obj: Any, topology: str) -> str:
     """Compare the snapshot's saved topology block against the restoring
     world; returns the action taken (``"match"``/``"legacy"``/``"fold"``/
@@ -546,6 +570,41 @@ def _check_topology(path: str, manifest: Dict[str, Any], obj: Any, topology: str
                 current=world,
             ), domain="checkpoint")
         return "fold"
+    saved_class_shards = saved.get("state_sharding")
+    current_class_shards = _class_shard_count_of(obj)
+    if saved_class_shards != current_class_shards:
+        if topology == "strict":
+            obs.counter_inc("checkpoint.topology_mismatches")
+            obs.fault_breadcrumb(
+                "topology_mismatch",
+                domain="checkpoint",
+                data={
+                    "snapshot": os.path.basename(path),
+                    "saved_class_shards": saved_class_shards,
+                    "class_shards": current_class_shards,
+                },
+            )
+            saved_desc = (
+                f"class-sharded state saved under {saved_class_shards} class shard(s)"
+                if saved_class_shards
+                else "a dense (replicated) class layout"
+            )
+            current_desc = (
+                f"{current_class_shards} class shard(s)"
+                if current_class_shards
+                else "a dense (replicated) class layout"
+            )
+            raise obs.flighted(TopologyMismatchError(
+                f"{path} holds {saved_desc} but this instance is laid out for"
+                f" {current_desc}; restore with topology='elastic' to"
+                " re-split through the layout seam, or restore on the saved layout",
+                saved=saved,
+                current={"class_shards": current_class_shards},
+            ), domain="checkpoint")
+        # elastic: load_state's class-layout adoption re-splits exactly
+        # (gather to dense + re-stack, parallel/class_shard.py) — no fold
+        # needed, but the restore is counted as elastic
+        return "reshard"
     lane_cap = saved.get("lane_capacity")
     if (
         topology == "elastic"
@@ -608,7 +667,7 @@ def _restore_file(
             f" ({(manifest.get('topology') or {}).get('num_shards')} shards ->"
             " topology-neutral canonical form)"
         )
-    elif action == "remap":
+    elif action in ("remap", "reshard"):
         obs.counter_inc("checkpoint.elastic_restores")
     manifest["topology_action"] = action
     return manifest

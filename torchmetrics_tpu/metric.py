@@ -56,6 +56,16 @@ from torchmetrics_tpu.parallel.quantized import (
     SYNC_PRECISIONS,
     default_sync_precision,
 )
+from torchmetrics_tpu.parallel.class_shard import (
+    CLASS_SHARDABLE_REDUCTIONS,
+    STATE_SHARDINGS,
+    ClassShardLayout,
+    default_class_shards,
+    default_state_sharding,
+    identity_pad_value,
+    shard_layout as _class_shard_layout,
+    stack_dense as _class_stack_dense,
+)
 from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.data import (
     _flatten,
@@ -183,6 +193,12 @@ class Metric:
         #: declared per-state sync_precision overrides (None = inherit the
         #: metric-level policy); resolution happens in _sync_qspecs
         self._sync_precisions: Dict[str, Optional[str]] = {}
+        #: RESOLVED per-state placement ("replicated" | "class_axis") and the
+        #: class layout of every class_axis field (parallel/class_shard.py);
+        #: resolution happens at add_state time, so these never change after
+        #: declaration and can key the executor cache via _trace_config
+        self._state_shardings: Dict[str, str] = {}
+        self._class_layouts: Dict[str, ClassShardLayout] = {}
 
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
         self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
@@ -256,6 +272,25 @@ class Metric:
             raise ValueError(
                 f"Expected keyword argument `sync_quant_block` to be a positive int but got {self.sync_quant_block}"
             )
+        self.state_sharding = kwargs.pop("state_sharding", None)
+        if self.state_sharding is None:
+            self.state_sharding = default_state_sharding()
+        elif self.state_sharding not in STATE_SHARDINGS:
+            raise ValueError(
+                f"Expected keyword argument `state_sharding` to be one of {STATE_SHARDINGS}"
+                f" but got {self.state_sharding}"
+            )
+        self.class_shards = kwargs.pop("class_shards", None)
+        if self.class_shards is None:
+            self.class_shards = default_class_shards()
+        elif (
+            not isinstance(self.class_shards, int)
+            or isinstance(self.class_shards, bool)
+            or self.class_shards < 1
+        ):
+            raise ValueError(
+                f"Expected keyword argument `class_shards` to be a positive int but got {self.class_shards}"
+            )
         # deferred-reduction bookkeeping: _reduced is False while locally
         # accumulated state has a pending reduction; _pending_shards is the
         # shard count of an installed (stacked) sharded state awaiting a fold
@@ -297,6 +332,7 @@ class Metric:
         dist_reduce_fx: Reduction = None,
         persistent: bool = False,
         sync_precision: Optional[str] = None,
+        state_sharding: Optional[str] = None,
     ) -> None:
         """Register a metric state (reference metric.py:195-278).
 
@@ -310,19 +346,65 @@ class Metric:
         float state into the block-quantized reduce, ``None`` (default)
         inherits the metric policy. Integer/bool states are always exact no
         matter what is declared here (docs/SHARDING.md "Quantized reduce").
+
+        ``state_sharding`` places THIS state: ``"class_axis"`` partitions the
+        declared array along its first (class/bucket) axis into the metric's
+        ``class_shards`` slices — it then lives as a stacked
+        ``(S, ceil(C/S), *rest)`` array (parallel/class_shard.py) whose dense
+        value is gathered only at the read point — ``"replicated"`` pins the
+        dense layout, ``None`` (default) inherits the metric-level
+        ``state_sharding`` policy. Only fixed-shape array states of rank >= 1
+        with ``dist_reduce_fx`` in {"sum","mean","max","min"} are eligible:
+        an explicit ``"class_axis"`` on anything else raises, while the
+        inherited policy silently leaves ineligible states replicated
+        (docs/SHARDING.md "Class-axis state sharding").
         """
         if not isinstance(default, (list, int, float, np.ndarray, jnp.ndarray)) and not hasattr(default, "shape"):
             raise ValueError("state variable must be a jax array or an empty list")
         if isinstance(default, list) and default:
             raise ValueError("state variable must be a jax array or an *empty* list (any data must be appended via update)")
         if dist_reduce_fx not in ("sum", "mean", "cat", "min", "max", None) and not callable(dist_reduce_fx):
-            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+            raise ValueError(
+                "`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None],"
+                f" got {dist_reduce_fx!r}"
+            )
         if sync_precision is not None and sync_precision not in SYNC_PRECISIONS:
             raise ValueError(f"`sync_precision` must be None or one of {SYNC_PRECISIONS}, got {sync_precision!r}")
+        if state_sharding is not None and state_sharding not in STATE_SHARDINGS:
+            raise ValueError(
+                f"`state_sharding` must be None or one of {STATE_SHARDINGS}, got {state_sharding!r}"
+            )
         if isinstance(default, (int, float)):
             default = jnp.asarray(default)
         if not isinstance(default, list):
             default = jnp.asarray(default)
+        # --- class-axis placement resolution (happens ONCE, at declaration):
+        # eligibility = fixed-shape array, rank >= 1, identity-padded/elementwise
+        # reduction family — the static pin of docs/SHARDING.md's eligibility table
+        eligible = (
+            not isinstance(default, list)
+            and default.ndim >= 1
+            and dist_reduce_fx in CLASS_SHARDABLE_REDUCTIONS
+        )
+        if state_sharding == "class_axis" and not eligible:
+            kind = "list" if isinstance(default, list) else f"rank-{default.ndim} array"
+            raise ValueError(
+                f"state {name!r}: state_sharding='class_axis' requires a fixed-shape array"
+                f" state of rank >= 1 with dist_reduce_fx in {CLASS_SHARDABLE_REDUCTIONS};"
+                f" got a {kind} with dist_reduce_fx={dist_reduce_fx!r}"
+            )
+        resolved = state_sharding
+        if resolved is None:
+            policy = self.__dict__.get("state_sharding", "replicated")
+            resolved = "class_axis" if (policy == "class_axis" and eligible) else "replicated"
+        if resolved == "class_axis":
+            layout = _class_shard_layout(int(default.shape[0]), int(self.class_shards))
+            default = _class_stack_dense(
+                default, layout, pad_value=identity_pad_value(dist_reduce_fx, default.dtype)
+            )
+            self._class_layouts[name] = layout
+            obs.counter_inc("shards.class_sharded_states")
+        self._state_shardings[name] = resolved
         self._defaults[name] = copy.deepcopy(default)
         self._reductions[name] = dist_reduce_fx
         self._persistent[name] = persistent
@@ -565,7 +647,98 @@ class Metric:
             for name, spec in sorted(self._sync_qspecs().items())
             if spec is not None
         )
-        return (f"sync_precision={qfields}",) if qfields else ()
+        out: tuple = (f"sync_precision={qfields}",) if qfields else ()
+        # class-axis placement changes the traced state SHAPES too, but the
+        # marker still matters: it splits the persisted cache key and the
+        # fusion group key for layouts that alias shapes (e.g. a (8, 8) dense
+        # state vs an (8, 8) stack of a 64-class vector)
+        csfields = ",".join(
+            f"{name}:{lay.num_shards}x{lay.shard_size}"
+            for name, lay in sorted(self.__dict__.get("_class_layouts", {}).items())
+        )
+        if csfields:
+            out = out + (f"state_sharding={csfields}",)
+        return out
+
+    # ------------------------------------------------- class-axis placement
+    def _class_layout(self, name: str) -> Optional[ClassShardLayout]:
+        """The :class:`ClassShardLayout` of a class-sharded field, or None
+        when ``name`` is replicated — the one test adopter update/compute
+        bodies branch on (parallel/class_shard.py owns the actual math)."""
+        return self.__dict__.get("_class_layouts", {}).get(name)
+
+    def _adopt_class_layouts(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Re-split incoming class-sharded fields into THIS metric's layout.
+
+        A snapshot may carry a field dense (pre-sharding save, or saved by a
+        replicated twin) or stacked for a different shard count (saved on
+        d devices, restoring on d'). Both are pure metadata transforms —
+        concatenate to dense, trim the padding, re-stack — exact for every
+        eligible reduction, so ``load_state`` self-heals the layout before
+        validation. Data-axis sharded stacks (``sharded=True`` restores) and
+        unknown shapes pass through untouched for validate_state to judge.
+        """
+        layouts = self.__dict__.get("_class_layouts") or {}
+        if not isinstance(state, dict):
+            return state
+        out = dict(state)
+        # reverse direction: a snapshot saved by a class-sharded twin arriving
+        # at a REPLICATED instance carries (S, shard_size, *rest) stacks —
+        # gather them back to dense (reshape + trim the identity padding),
+        # but only for fields whose reduction could legitimately have been
+        # class-sharded elsewhere (same eligibility rule as add_state)
+        for name, policy in (self.__dict__.get("_state_shardings") or {}).items():
+            if policy != "replicated" or name in layouts:
+                continue
+            fx = self._reductions.get(name)
+            if not isinstance(fx, str) or fx not in CLASS_SHARDABLE_REDUCTIONS:
+                continue
+            value = out.get(name)
+            if value is None or isinstance(value, (list, tuple)) or not hasattr(value, "shape"):
+                continue
+            default = self._defaults.get(name)
+            if isinstance(default, list) or not hasattr(default, "shape") or len(default.shape) < 1:
+                continue
+            num_classes, rest = int(default.shape[0]), tuple(default.shape[1:])
+            shape = tuple(value.shape)
+            # only the EXACT stacked geometry heals — any shard count d
+            # yields (d, ceil(C/d), *rest), so shape[1] is determined by
+            # shape[0]; anything else (e.g. a corrupt bogus leading dim,
+            # which would be (2, C)) falls through to validate_state
+            if (
+                len(shape) == 2 + len(rest)
+                and shape[2:] == rest
+                and shape[0] >= 1
+                and shape[1] == -(-num_classes // shape[0])
+            ):
+                out[name] = jnp.asarray(value).reshape((shape[0] * shape[1],) + rest)[:num_classes]
+        if not layouts:
+            return out
+        for name, layout in layouts.items():
+            value = out.get(name)
+            if value is None or isinstance(value, (list, tuple)) or not hasattr(value, "shape"):
+                continue
+            rest = tuple(jnp.asarray(self._defaults[name]).shape[2:])
+            shape = tuple(value.shape)
+            if shape == (layout.num_shards, layout.shard_size) + rest:
+                continue  # already this layout
+            pad = identity_pad_value(self._reductions.get(name), jnp.asarray(value).dtype)
+            if shape == (layout.num_classes,) + rest:
+                # dense snapshot -> stack into our layout
+                out[name] = _class_stack_dense(value, layout, pad_value=pad)
+            elif (
+                len(shape) == 2 + len(rest)
+                and shape[2:] == rest
+                and shape[0] >= 1
+                and shape[1] == -(-layout.num_classes // shape[0])
+            ):
+                # stacked under a different shard count (the exact (d,
+                # ceil(C/d)) geometry — see the reverse heal above):
+                # gather + re-split
+                arr = jnp.asarray(value)
+                dense = arr.reshape((shape[0] * shape[1],) + rest)[: layout.num_classes]
+                out[name] = _class_stack_dense(dense, layout, pad_value=pad)
+        return out
 
     def _state_snapshot(self) -> Dict[str, Any]:
         """Shallow pre-call snapshot for transactional rollback: jnp arrays are
@@ -1368,6 +1541,15 @@ class Metric:
                     "reduction": reduction,
                     "shape_invariant": fx in self._SHAPE_INVARIANT_REDUCTIONS,
                 }
+                layout = self.__dict__.get("_class_layouts", {}).get(name)
+                if layout is not None:
+                    # class-sharded fields record their layout so a restore
+                    # target can tell "(8, 8) stack of 64 classes" from a
+                    # plain (8, 8) dense state (keys absent when replicated,
+                    # keeping replicated specs byte-identical to pre-sharding)
+                    fields[name]["state_sharding"] = "class_axis"
+                    fields[name]["num_classes"] = int(layout.num_classes)
+                    fields[name]["class_shards"] = int(layout.num_shards)
         return {
             "spec_version": 1,
             "class": type(self).__name__,
@@ -1723,6 +1905,11 @@ class Metric:
         """
         if sharded is None:
             sharded = isinstance(state, dict) and state.get(self._STATE_SHARDS_KEY) is not None
+        if not sharded:
+            # class-sharded fields self-heal their layout first (dense or
+            # differently-sharded snapshots re-split exactly — pure metadata
+            # transforms), so validation below judges the adopted layout
+            state = self._adopt_class_layouts(state)
         state = self.validate_state(state, mode=validate, check_finite=check_finite, sharded=sharded)
         carried = state.get(self._STATE_COUNT_KEY)
         if update_count is None and carried is not None:
@@ -1939,6 +2126,14 @@ class Metric:
         self.__dict__.setdefault("sync_quant_bits", _QUANT_DEFAULT_BITS)
         self.__dict__.setdefault("sync_quant_block", _QUANT_DEFAULT_BLOCK)
         self.__dict__.setdefault("_sync_precisions", {k: None for k in self.__dict__.get("_defaults", {})})
+        self.__dict__.setdefault("state_sharding", "replicated")
+        self.__dict__.setdefault("class_shards", default_class_shards())
+        self.__dict__.setdefault("_state_shardings", {k: "replicated" for k in self.__dict__.get("_defaults", {})})
+        self.__dict__.setdefault("_class_layouts", {})
+        self.__dict__["_class_layouts"] = {
+            k: (v if isinstance(v, ClassShardLayout) else ClassShardLayout(*v))
+            for k, v in self.__dict__["_class_layouts"].items()
+        }
         self.__dict__.setdefault("_reduced", True)
         self.__dict__.setdefault("_pending_shards", None)
         self.__dict__.setdefault("_last_reduce_us", None)

@@ -46,6 +46,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_CACHE_LOAD,
     SPAN_CACHE_STORE,
     SPAN_CKPT_RESTORE,
+    SPAN_CLASS_ROUTE,
     SPAN_CKPT_SAVE,
     SPAN_COMPILE,
     SPAN_COMPUTE,

@@ -96,6 +96,7 @@ DOMAIN_OF_SPAN = {
     "tm_tpu.compute_async": "read",
     "tm_tpu.read.resolve": "read",
     "tm_tpu.reshard": "reshard",
+    "tm_tpu.class_route": "reshard",
     "tm_tpu.shadow.refresh": "shadow",
     "tm_tpu.kernel": "kernels",
 }

@@ -86,6 +86,7 @@ SPAN_KERNEL = "tm_tpu.kernel"              # backend-dispatched Pallas/XLA kerne
 SPAN_READ_RESOLVE = "tm_tpu.read.resolve"  # read-pipeline worker: the blocking tail of one job
 SPAN_SHADOW = "tm_tpu.shadow.refresh"      # shard-shadow refresh (submit half + worker half)
 SPAN_PACK = "tm_tpu.lanes.pack"            # ingest slab pack (staged worker half + inline half)
+SPAN_CLASS_ROUTE = "tm_tpu.class_route"    # class-axis shard routing (scatter) + read-point gather
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -111,6 +112,7 @@ SPAN_NAMES = (
     SPAN_READ_RESOLVE,
     SPAN_SHADOW,
     SPAN_PACK,
+    SPAN_CLASS_ROUTE,
 )
 
 

@@ -1104,9 +1104,10 @@ class MetricExecutor(_ExecutorBase):
         extra = getattr(m, "_executor_identity", None)
         ident = f"|inner={extra()}" if callable(extra) else ""
         # trace-affecting config invisible to the state spec (an aggregator's
-        # nan_strategy, a laned wrapper's device-side row screen): two
-        # instances whose compiled computation differs must never share a
-        # persisted executable
+        # nan_strategy, a laned wrapper's device-side row screen, a
+        # class-axis state_sharding layout whose stacked shape aliases some
+        # dense state's): two instances whose compiled computation differs
+        # must never share a persisted executable
         cfg = ",".join(map(str, m._trace_config()))
         cfg = f"|cfg={cfg}" if cfg else ""
         return f"{cls.__module__}.{cls.__qualname__}@{compile_cache.source_hash(mod or cls)}|{fields}{ident}{cfg}"

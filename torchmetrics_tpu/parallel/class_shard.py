@@ -1,0 +1,257 @@
+"""Class-axis state sharding: the layout math + sparse routing seam.
+
+Every placement before this module replicated a declared state per device (or
+stacked it along the DATA axis in deferred mode), so a state's full class
+axis had to fit on every chip — a 100k-class confusion matrix (num_classes²
+f32 ≈ 40 GB) simply could not exist. This module applies the cross-replica
+weight-update sharding idea (Xu et al., arXiv:2004.13336) to *metric state*:
+partition a declared state along its first class/bucket axis into
+``num_shards`` equal slices (docs/SHARDING.md "Class-axis state sharding"),
+and route each sparse ``(index, value)`` update contribution to the shard
+that owns its class range.
+
+Layout (the ONE invariant every consumer of a class-sharded field relies on):
+
+- a field declared dense ``(C, *rest)`` lives as a **stacked** array
+  ``(S, shard_size, *rest)`` with ``shard_size = ceil(C / S)``; the padded
+  tail rows of the last shard hold the reduction identity and never receive
+  contributions, so folds and elementwise merges stay exact;
+- shard ``s`` owns dense classes ``[s * shard_size, min((s+1) * shard_size,
+  C))`` — :meth:`ClassShardLayout.bounds`;
+- the dense value is always recoverable as a pure metadata reshape + trim
+  (:func:`gather_dense`) — no arithmetic, no collective.
+
+Routing (:func:`route_scatter_add`) is the ship-but-never-land trick the
+session lanes use: every contribution is shipped with a shard coordinate,
+and rows nobody owns (``ignore_index`` holes, quarantined-lane rows diverted
+by the row screen) carry a sentinel coordinate one past the last shard so the
+XLA scatter's explicit ``mode="drop"`` discards them on device. Negative
+indices are remapped BEFORE the scatter — JAX scatter treats negative
+indices as wrap-around (counting from the end) even in drop mode, so a raw
+``-1`` sentinel would corrupt the last row instead of vanishing.
+
+Updates therefore stay zero-collective (tools/lint_collectives.py pins this
+module update-stage); ``compute()`` performs the one gather at read, exactly
+like the deferred reduce defers its fold. The data-axis machinery composes
+on TOP of the class stack: deferred mode adds its leading shard axis over
+``(S, shard_size, *rest)`` and every fold stays elementwise.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.parallel.sync import Reduction, reduction_identity
+from torchmetrics_tpu.utils.exceptions import TopologyMismatchError
+
+__all__ = [
+    "CLASS_SHARDABLE_REDUCTIONS",
+    "STATE_SHARDINGS",
+    "STATE_SHARDING_ENV",
+    "ClassShardLayout",
+    "add_dense",
+    "default_state_sharding",
+    "default_class_shards",
+    "gather_dense",
+    "route_scatter_add",
+    "shard_layout",
+    "stack_dense",
+]
+
+#: valid ``state_sharding`` policies (metric ctor knob / ``add_state`` arg)
+STATE_SHARDINGS = ("replicated", "class_axis")
+
+#: process-wide default policy for eligible states (docs/SHARDING.md)
+STATE_SHARDING_ENV = "TORCHMETRICS_TPU_STATE_SHARDING"
+
+#: reduction families whose identity pads + elementwise merges make the
+#: stacked class layout exact (the same families reshard.py can re-split)
+CLASS_SHARDABLE_REDUCTIONS = ("sum", "mean", "max", "min")
+
+
+def default_state_sharding() -> str:
+    """The process-wide default ``state_sharding`` policy, from
+    ``TORCHMETRICS_TPU_STATE_SHARDING`` (``replicated`` when unset). The
+    policy only ever applies to *eligible* states — fixed-shape array states
+    of rank >= 1 with a reduction in :data:`CLASS_SHARDABLE_REDUCTIONS`;
+    everything else silently stays replicated (mirroring how integer states
+    always sync exact regardless of ``sync_precision``)."""
+    value = os.environ.get(STATE_SHARDING_ENV, "replicated").strip().lower()
+    if value not in STATE_SHARDINGS:
+        raise ValueError(
+            f"{STATE_SHARDING_ENV} must be one of {STATE_SHARDINGS}, got {value!r}"
+        )
+    return value
+
+
+def default_class_shards() -> int:
+    """Default shard count for class-axis layouts: one shard per local
+    device, so placing the stacked axis on the mesh gives each chip exactly
+    its slice (the per-device state-bytes ≈ dense/S claim of the bench)."""
+    return int(jax.local_device_count())
+
+
+class ClassShardLayout(NamedTuple):
+    """Descriptor of one class-sharded field: ``num_classes`` dense rows
+    split into ``num_shards`` slices of ``shard_size = ceil(C / S)`` rows,
+    padded to ``padded_classes = S * shard_size``."""
+
+    num_classes: int
+    num_shards: int
+
+    @property
+    def shard_size(self) -> int:
+        return -(-self.num_classes // self.num_shards)
+
+    @property
+    def padded_classes(self) -> int:
+        return self.num_shards * self.shard_size
+
+    def bounds(self, shard: int) -> Tuple[int, int]:
+        """Dense class interval ``[start, stop)`` owned by ``shard`` (clipped
+        to ``num_classes``; trailing shards past the data own nothing)."""
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f"shard must be in [0, {self.num_shards}), got {shard}")
+        start = min(shard * self.shard_size, self.num_classes)
+        stop = min(start + self.shard_size, self.num_classes)
+        return start, stop
+
+
+def shard_layout(num_classes: int, num_shards: int) -> ClassShardLayout:
+    """Validated :class:`ClassShardLayout` constructor."""
+    if int(num_classes) < 1:
+        raise ValueError(f"num_classes must be >= 1, got {num_classes}")
+    if int(num_shards) < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    return ClassShardLayout(int(num_classes), int(num_shards))
+
+
+def _check_stacked(stacked: Any, layout: ClassShardLayout) -> None:
+    """Raise (flighted, reshard domain) when an array does not carry
+    ``layout``'s stacked shape — the one corruption the pure reshapes below
+    would otherwise silently misinterpret."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    shape = tuple(getattr(stacked, "shape", ()))
+    if len(shape) < 2 or shape[0] != layout.num_shards or shape[1] != layout.shard_size:
+        raise obs.flighted(
+            TopologyMismatchError(
+                f"class-sharded state has shape {shape} but the layout expects"
+                f" ({layout.num_shards}, {layout.shard_size}, ...) —"
+                f" {layout.num_classes} classes over {layout.num_shards} shards"
+            ),
+            domain="reshard",
+        )
+
+
+def stack_dense(dense: Any, layout: ClassShardLayout, pad_value: Any = None) -> jnp.ndarray:
+    """Split a dense ``(C, *rest)`` value into the stacked class layout
+    ``(S, shard_size, *rest)``, padding the tail with ``pad_value`` (the
+    reduction identity for live states; 0 for additive contributions)."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    arr = jnp.asarray(dense)
+    if arr.ndim < 1 or arr.shape[0] != layout.num_classes:
+        raise obs.flighted(
+            TopologyMismatchError(
+                f"dense value has shape {tuple(arr.shape)} but the layout expects"
+                f" ({layout.num_classes}, ...)"
+            ),
+            domain="reshard",
+        )
+    pad = layout.padded_classes - layout.num_classes
+    if pad:
+        fill = jnp.full((pad,) + arr.shape[1:], 0 if pad_value is None else pad_value, arr.dtype)
+        arr = jnp.concatenate([arr, fill], axis=0)
+    return arr.reshape((layout.num_shards, layout.shard_size) + arr.shape[1:])
+
+
+def gather_dense(stacked: Any, layout: ClassShardLayout) -> jnp.ndarray:
+    """The one read-point gather: stacked ``(S, shard_size, *rest)`` back to
+    dense ``(C, *rest)`` — a pure metadata reshape + trim, no arithmetic."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    arr = jnp.asarray(stacked)
+    _check_stacked(arr, layout)
+    with obs.device_span(obs.SPAN_CLASS_ROUTE):
+        return arr.reshape((layout.padded_classes,) + arr.shape[2:])[: layout.num_classes]
+
+
+def route_scatter_add(
+    stacked: Any,
+    class_idx: Any,
+    values: Any,
+    inner_idx: Optional[Any] = None,
+    *,
+    layout: ClassShardLayout,
+) -> jnp.ndarray:
+    """Route sparse update contributions into the shards owning them.
+
+    ``class_idx`` (any shape, flattened) carries one dense class index per
+    contribution; ``values`` (same count) the amount to accumulate. With
+    ``inner_idx`` the field's trailing axes are treated as one flattened
+    inner dimension and each contribution lands at ``[class, inner]`` (a
+    confusion-matrix cell); without it the field must be ``(C,)`` per shard
+    row (a per-class counter).
+
+    Contributions whose class index falls outside ``[0, num_classes)`` —
+    ``ignore_index`` holes encoded as ``-1``, rows a quarantine screen
+    diverted, garbage labels — are remapped to a sentinel coordinate one past
+    the last shard and dropped ON DEVICE by the scatter's ``mode="drop"``:
+    they ship but never land, so the routed update stays branch-free and
+    zero-collective. (The remap is load-bearing: JAX scatter wraps negative
+    indices even in drop mode, so ``-1`` would otherwise hit the last row.)
+    """
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    arr = jnp.asarray(stacked)
+    _check_stacked(arr, layout)
+    idx = jnp.asarray(class_idx).reshape(-1).astype(jnp.int32)
+    vals = jnp.asarray(values).reshape(-1).astype(arr.dtype)
+    owned = (idx >= 0) & (idx < layout.num_classes)
+    # sentinel = padded_classes => shard coordinate S (one past the stack) —
+    # genuinely out of bounds, so mode="drop" discards the whole contribution
+    safe = jnp.where(owned, idx, layout.padded_classes)
+    shard_of = safe // layout.shard_size
+    local = safe % layout.shard_size
+    obs.counter_inc("shards.routed_updates")
+    with obs.device_span(obs.SPAN_CLASS_ROUTE):
+        if inner_idx is None:
+            if arr.ndim != 2:
+                raise obs.flighted(
+                    TopologyMismatchError(
+                        f"route without inner_idx needs a (S, shard_size) state,"
+                        f" got shape {tuple(arr.shape)}"
+                    ),
+                    domain="reshard",
+                )
+            return arr.at[shard_of, local].add(vals, mode="drop")
+        inner = jnp.asarray(inner_idx).reshape(-1).astype(jnp.int32)
+        flat = arr.reshape(arr.shape[:2] + (-1,))
+        out = flat.at[shard_of, local, inner].add(vals, mode="drop")
+        return out.reshape(arr.shape)
+
+
+def add_dense(stacked: Any, dense: Any, layout: ClassShardLayout) -> jnp.ndarray:
+    """Accumulate a DENSE ``(C, *rest)`` additive contribution into the
+    stacked layout (the stat-scores family emits dense per-class vectors):
+    zero-pad, reshape into the stack, add elementwise. Pad rows receive 0,
+    so the tail stays at the additive identity. Zero-collective."""
+    from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+
+    arr = jnp.asarray(stacked)
+    _check_stacked(arr, layout)
+    obs.counter_inc("shards.routed_updates")
+    with obs.device_span(obs.SPAN_CLASS_ROUTE):
+        return arr + stack_dense(dense, layout, pad_value=0).astype(arr.dtype)
+
+
+def identity_pad_value(reduction: Reduction, dtype: Any) -> Any:
+    """The pad value a live class-sharded state's tail rows carry: the
+    declared reduction's identity (0 for sum/mean, ∓inf for max/min), so a
+    later fold or merge over the stack cannot see the padding."""
+    ident = reduction_identity(reduction, dtype)
+    return 0 if ident is None else ident

@@ -113,36 +113,65 @@ def _strip_reserved(states: Dict[str, Any]) -> Dict[str, Any]:
     return {k: v for k, v in states.items() if k not in (_COUNT_KEY, _SHARDS_KEY)}
 
 
-def fold_canonical(states: Dict[str, Any], reductions: Dict[str, Reduction]) -> Dict[str, Any]:
+def fold_canonical(
+    states: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    class_layouts: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """Collapse the leading shard axis of every field per its declared
     reduction — the topology-neutral canonical form (the same arithmetic as
     ``parallel.sync.fold_sharded_states``; reserved count/shard-mark keys are
-    stripped). Works on host (np) and device (jnp) stacks alike."""
-    return {
+    stripped). Works on host (np) and device (jnp) stacks alike.
+
+    ``class_layouts`` (field name → ``ClassShardLayout``) additionally
+    concatenates class-axis stacked fields back to their DENSE class axis, so
+    the canonical form stays neutral to BOTH topologies — the data-axis shard
+    count and the class-axis shard count (docs/SHARDING.md "Class-axis state
+    sharding"). The class gather is a pure metadata reshape + trim, exact for
+    every eligible reduction."""
+    from torchmetrics_tpu.parallel.class_shard import gather_dense
+
+    folded = {
         k: reduce_stacked(v if hasattr(v, "sum") else np.asarray(v), reductions.get(k))
         for k, v in _strip_reserved(states).items()
     }
+    for name, layout in (class_layouts or {}).items():
+        if name in folded:
+            folded[name] = gather_dense(folded[name], layout)
+    return folded
 
 
 def expand_canonical(
     canonical: Dict[str, Any],
     reductions: Dict[str, Reduction],
     num_shards: int,
+    class_layouts: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Reinstall a canonical (folded) state onto ``num_shards`` shards such
     that the next fold returns exactly the canonical value and subsequent
     local accumulation stays exact (the table in the module docstring).
 
+    ``class_layouts`` re-splits dense class axes into the target's class
+    stack (identity-padded) BEFORE the data-axis expand — the inverse of
+    :func:`fold_canonical`'s class gather, so an N-device/S-shard save
+    reinstalls exactly onto an M-device/S'-shard world.
+
     Raises :class:`TopologyMismatchError` for fields whose reduction cannot
     be re-split into a uniform stack (``cat``, ``None``, callables) — those
     are carried as a read-point baseline instead (:func:`merge_folded`)."""
     from torchmetrics_tpu import obs  # deferred: sync.py's import-cycle note applies
+    from torchmetrics_tpu.parallel.class_shard import identity_pad_value, stack_dense
 
     if num_shards < 1:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
     out: Dict[str, Any] = {}
     for name, value in _strip_reserved(canonical).items():
         fx = reductions.get(name)
+        layout = (class_layouts or {}).get(name)
+        if layout is not None:
+            value = stack_dense(
+                value, layout, pad_value=identity_pad_value(fx, jnp.asarray(value).dtype)
+            )
         if fx not in _IN_STACK:
             raise obs.flighted(
                 TopologyMismatchError(
@@ -208,6 +237,7 @@ def reshard_states(
     from_layout: ShardLayout,
     to_layout: ShardLayout,
     reductions: Dict[str, Reduction],
+    class_layouts: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The audited N→M re-split: fold ``states`` (stacked with
     ``from_layout.num_shards`` leading) to canonical, then expand onto
@@ -236,7 +266,12 @@ def reshard_states(
         return _strip_reserved(states)
     with obs.span(obs.SPAN_RESHARD, src=from_layout.num_shards, dst=to_layout.num_shards):
         obs.counter_inc("shards.resharded")
-        return expand_canonical(fold_canonical(states, reductions), reductions, to_layout.num_shards)
+        return expand_canonical(
+            fold_canonical(states, reductions, class_layouts),
+            reductions,
+            to_layout.num_shards,
+            class_layouts,
+        )
 
 
 # ---------------------------------------------------------------------------

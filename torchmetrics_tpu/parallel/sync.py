@@ -13,6 +13,16 @@ named mesh axes:
 A state's reduction is declared once via ``add_state(dist_reduce_fx=...)`` and that
 single declaration drives local merging, in-trace collectives and host-side sync —
 the PartitionSpec-aware generalisation of the reference's ``dist_reduce_fx``.
+
+Class-axis sharded states (``add_state(state_sharding="class_axis")``,
+``parallel/class_shard.py``) pass through this module UNCHANGED: the stacked
+``(S, shard_size, *rest)`` layout commutes with every eligible elementwise
+reduction (sum/mean/max/min — the eligibility rule exists precisely so this
+holds), and the identity-padded tail rows reduce to the identity, so syncing
+the stacked form across hosts equals stacking the synced dense form. Their
+own routing/gather path adds ZERO collectives (``tools/lint_collectives.py``
+scans every function in class_shard.py) — the one reduce here stays the only
+rendezvous.
 """
 from __future__ import annotations
 

@@ -80,16 +80,21 @@ class RetrievalMetric(Metric, ABC):
         if capacity is not None and (not isinstance(capacity, int) or capacity < 1):
             raise ValueError(f"Argument `capacity` expected to be a positive integer, got {capacity}")
         self.capacity = capacity
+        # sample buffers are indexed by ARRIVAL ORDER, not by class/bucket —
+        # class-axis sharding is meaningless for them (and "cat"/None growing
+        # reductions are ineligible anyway); the explicit pin keeps the layout
+        # deterministic under a TORCHMETRICS_TPU_STATE_SHARDING=class_axis
+        # process default (docs/SHARDING.md eligibility table)
         if capacity is not None:
-            self.add_state("indexes_buffer", default=jnp.zeros(capacity, dtype=jnp.int32), dist_reduce_fx="cat")
-            self.add_state("preds_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat")
-            self.add_state("target_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat")
-            self.add_state("valid_buffer", default=jnp.zeros(capacity, dtype=bool), dist_reduce_fx="cat")
+            self.add_state("indexes_buffer", default=jnp.zeros(capacity, dtype=jnp.int32), dist_reduce_fx="cat", state_sharding="replicated")
+            self.add_state("preds_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat", state_sharding="replicated")
+            self.add_state("target_buffer", default=jnp.zeros(capacity, dtype=jnp.float32), dist_reduce_fx="cat", state_sharding="replicated")
+            self.add_state("valid_buffer", default=jnp.zeros(capacity, dtype=bool), dist_reduce_fx="cat", state_sharding="replicated")
             self.add_state("sample_count", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
         else:
-            self.add_state("indexes", default=[], dist_reduce_fx=None)
-            self.add_state("preds", default=[], dist_reduce_fx=None)
-            self.add_state("target", default=[], dist_reduce_fx=None)
+            self.add_state("indexes", default=[], dist_reduce_fx=None, state_sharding="replicated")
+            self.add_state("preds", default=[], dist_reduce_fx=None, state_sharding="replicated")
+            self.add_state("target", default=[], dist_reduce_fx=None, state_sharding="replicated")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
