@@ -2040,11 +2040,12 @@ def bench_config10():
     shards — 1.25 GB per shard — with sparse zero-collective routing on
     update and the dense view gathered only at compute. Host-CPU by design
     like configs 2/9 (the measured quantities are layout memory + routing
-    dispatch cost, not device throughput). The per-call host recovery
-    snapshot would copy the full 10 GB state after every donated dispatch,
-    so the 50k rows run with TORCHMETRICS_TPU_EXECUTOR_RECOVERY=0 — the
-    documented mode for memory-wall deployments (docs/EXECUTOR.md); the
-    small-cardinality parity tripwire runs with stock settings."""
+    dispatch cost, not device throughput). Recovery stays ON (stock
+    settings): the cell-granular ``ClassShardMirror`` makes the per-call
+    recovery copy batch-sized — the metric names the ``target*C + pred``
+    cells each round touches, so the donating dispatch no longer pays the
+    10 GB whole-state host snapshot that previously forced
+    TORCHMETRICS_TPU_EXECUTOR_RECOVERY=0 here."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -2077,78 +2078,261 @@ def bench_config10():
         np.array_equal(np.asarray(dense.compute()), np.asarray(sharded0.compute()))
     )
 
-    # ---- 50k-class rows
+    # ---- 50k-class rows (stock recovery: the cell mirror keeps it cheap)
     C, S, BATCH = 50_000, 8, 4096
-    prev_recovery = os.environ.get("TORCHMETRICS_TPU_EXECUTOR_RECOVERY")
-    os.environ["TORCHMETRICS_TPU_EXECUTOR_RECOVERY"] = "0"
-    try:
-        m = MulticlassConfusionMatrix(
-            num_classes=C, validate_args=False,
-            state_sharding="class_axis", class_shards=S,
-        )
-        layout = m._class_layout("confmat")
-        p = jnp.asarray(rng.randint(0, C, BATCH))
-        t = jnp.asarray(rng.randint(0, C, BATCH))
-        # first two calls pay the one-time compile + escape-seam state copy
-        # (the installed default aliases _defaults); steady state is donated
+    m = MulticlassConfusionMatrix(
+        num_classes=C, validate_args=False,
+        state_sharding="class_axis", class_shards=S,
+    )
+    layout = m._class_layout("confmat")
+    p = jnp.asarray(rng.randint(0, C, BATCH))
+    t = jnp.asarray(rng.randint(0, C, BATCH))
+    # first two calls pay the one-time compile + escape-seam state copy
+    # (the installed default aliases _defaults) + the recovery mirror's
+    # full rebuild at first donation; steady state is donated with a
+    # cells-sized mirror fold per call
+    t0 = time.perf_counter()
+    m.update(p, t)
+    jax.block_until_ready(m._state["confmat"])
+    out["first_update_s"] = round(time.perf_counter() - t0, 2)
+    m.update(p, t)
+    jax.block_until_ready(m._state["confmat"])
+
+    def block():
         t0 = time.perf_counter()
-        m.update(p, t)
+        for _ in range(20):
+            m.update(p, t)
         jax.block_until_ready(m._state["confmat"])
-        out["first_update_s"] = round(time.perf_counter() - t0, 2)
-        m.update(p, t)
-        jax.block_until_ready(m._state["confmat"])
+        return (time.perf_counter() - t0) / 20
 
-        def block():
-            t0 = time.perf_counter()
-            for _ in range(20):
-                m.update(p, t)
-            jax.block_until_ready(m._state["confmat"])
-            return (time.perf_counter() - t0) / 20
+    step_s = _stable_min(block, repeats=3)
+    out["value"] = round(1.0 / step_s, 1)
+    out["update_batch"] = BATCH
 
-        step_s = _stable_min(block, repeats=3)
-        out["value"] = round(1.0 / step_s, 1)
-        out["update_batch"] = BATCH
+    # memory rows: the layout property the whole feature exists for
+    itemsize = np.dtype(m._state["confmat"].dtype).itemsize
+    out["dense_state_bytes"] = C * C * itemsize
+    out["per_device_state_bytes"] = layout.shard_size * C * itemsize
+    out["sharded_per_device_ratio"] = round(
+        out["per_device_state_bytes"] / out["dense_state_bytes"], 4
+    )
+    # measured, not just analytic: materialize the stacked layout over
+    # the 8-virtual-device mesh (sharded on the class-shard axis, each
+    # device holding one shard) and read back the peak shard bytes — a
+    # jitted sharded fill, so no 10 GB host-side staging copy
+    mesh = Mesh(np.array(jax.devices()[:S]), ("class",))
+    placed = jax.jit(
+        lambda: jnp.zeros((S, layout.shard_size, C), m._state["confmat"].dtype),
+        out_shardings=NamedSharding(mesh, P("class")),
+    )()
+    jax.block_until_ready(placed)
+    out["per_device_state_bytes_measured"] = int(
+        max(s.data.nbytes for s in placed.addressable_shards)
+    )
+    del placed
 
-        # memory rows: the layout property the whole feature exists for
-        itemsize = np.dtype(m._state["confmat"].dtype).itemsize
-        out["dense_state_bytes"] = C * C * itemsize
-        out["per_device_state_bytes"] = layout.shard_size * C * itemsize
-        out["sharded_per_device_ratio"] = round(
-            out["per_device_state_bytes"] / out["dense_state_bytes"], 4
-        )
-        # measured, not just analytic: materialize the stacked layout over
-        # the 8-virtual-device mesh (sharded on the class-shard axis, each
-        # device holding one shard) and read back the peak shard bytes — a
-        # jitted sharded fill, so no 10 GB host-side staging copy
-        mesh = Mesh(np.array(jax.devices()[:S]), ("class",))
-        placed = jax.jit(
-            lambda: jnp.zeros((S, layout.shard_size, C), m._state["confmat"].dtype),
-            out_shardings=NamedSharding(mesh, P("class")),
-        )()
-        jax.block_until_ready(placed)
-        out["per_device_state_bytes_measured"] = int(
-            max(s.data.nbytes for s in placed.addressable_shards)
-        )
-        del placed
+    # gather-only-at-compute: the one point the dense view exists
+    t0 = time.perf_counter()
+    val = m.compute()
+    jax.block_until_ready(val)
+    out["compute_gather_s"] = round(time.perf_counter() - t0, 2)
+    # conservation spot check without a 10 GB host pull: total count on
+    # device equals updates x batch (every routed row landed exactly
+    # once; the bench's total stays far inside int32)
+    total = int(jnp.sum(val))
+    out["counts_conserved"] = bool(total == int(m._update_count) * BATCH)
+    out["class_sharded_values_agree"] = bool(
+        out["class_sharded_values_agree"] and out["counts_conserved"]
+    )
+    return out
 
-        # gather-only-at-compute: the one point the dense view exists
+
+def bench_config11():
+    """Fleet aggregation (ISSUE 17): exactly-once delta trees over an
+    in-process simulated fleet. Leaves fold to canonical host form and ship
+    epoch-stamped deltas up the aggregator tree; the rows sweep aggregation
+    throughput/lag vs fleet size (2/8/32 leaves at fanout 8 — the 32-leaf
+    tree is two levels deep, so its deltas cross an interior hop), gate the
+    quantized-vs-exact uplink byte ratio, and carry the
+    ``fleet_values_agree`` tripwire: the delta-tree global view must be
+    BIT-EXACT against a fault-free single-process ``merge_folded`` fold
+    across all five reduction families, AND a dead root must still serve its
+    last merged view as a full-coverage ``DegradedValue``. Host-CPU by
+    design like configs 2/9/10 (the measured quantity is protocol + merge
+    dispatch cost, not device throughput)."""
+    import numpy as np
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.fleet import FleetTopology, build_fleet
+    from torchmetrics_tpu.parallel.reshard import merge_folded
+    from torchmetrics_tpu.quarantine import DegradedValue
+
+    no_sleep = lambda s: None  # noqa: E731 — injected backoff clock
+    reductions = {
+        "s_sum": "sum",
+        "s_mean": "mean",
+        "s_max": "max",
+        "s_min": "min",
+        "s_cat": "cat",
+        "n": "sum",
+    }
+    width = 64
+
+    class SimLeaf:
+        """One simulated leaf covering all five reduction families; updates
+        draw multiples of 1/8 so fp32 sums are exact and the bit-exactness
+        tripwire has no tolerance to hide behind."""
+
+        def __init__(self, seed):
+            self.rng = np.random.RandomState(seed)
+            self.state = {
+                "s_sum": np.zeros(width, np.float32),
+                "s_mean": np.zeros(width, np.float32),
+                "s_max": np.full((width,), -np.inf, np.float32),
+                "s_min": np.full((width,), np.inf, np.float32),
+                "s_cat": np.zeros((0,), np.float32),
+                "n": np.asarray(0, np.int64),
+            }
+            self.updates = 0
+
+        def update(self):
+            x = (self.rng.randint(-50, 50, width) / 8.0).astype(np.float32)
+            s = self.state
+            s["s_sum"] = s["s_sum"] + x
+            s["s_mean"] = s["s_mean"] + x
+            s["s_max"] = np.maximum(s["s_max"], x)
+            s["s_min"] = np.minimum(s["s_min"], x)
+            s["s_cat"] = np.concatenate([s["s_cat"], x[:4]])
+            s["n"] = s["n"] + 1
+            self.updates += 1
+
+        def source(self):
+            return lambda: (dict(self.state), dict(reductions), self.updates)
+
+    def build(n):
+        leaves = {f"leaf/{i:02d}": SimLeaf(i) for i in range(n)}
+        topo = FleetTopology(sorted(leaves), fanout=8)
+        fleet = build_fleet(topo, sleep=no_sleep)
+        exporters = {lid: fleet.leaf_exporter(lid, leaves[lid].source()) for lid in sorted(leaves)}
+        return leaves, fleet, exporters
+
+    def round_trip(leaves, fleet, exporters):
+        for lid in sorted(leaves):
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+        fleet.pump()
+
+    def lag_hist():
+        snap = obs.telemetry_snapshot().get("histograms", {})
+        return snap.get("fleet.aggregation_lag_us", {"sum": 0.0, "count": 0})
+
+    out = {
+        "unit": "deltas merged/s, 8-leaf fleet (five reduction families, 64-wide states)",
+        "vs_baseline": None,
+    }
+
+    # ---- fleet-size sweep: throughput + export-to-merge lag per size
+    sweep = {}
+    for n in (2, 8, 32):
+        leaves, fleet, exporters = build(n)
+        round_trip(leaves, fleet, exporters)  # first round pays the full installs
+        h0 = lag_hist()
+        rounds = 10
         t0 = time.perf_counter()
-        val = m.compute()
-        jax.block_until_ready(val)
-        out["compute_gather_s"] = round(time.perf_counter() - t0, 2)
-        # conservation spot check without a 10 GB host pull: total count on
-        # device equals updates x batch (every routed row landed exactly
-        # once; the bench's total stays far inside int32)
-        total = int(jnp.sum(val))
-        out["counts_conserved"] = bool(total == int(m._update_count) * BATCH)
-        out["class_sharded_values_agree"] = bool(
-            out["class_sharded_values_agree"] and out["counts_conserved"]
-        )
-    finally:
-        if prev_recovery is None:
-            os.environ.pop("TORCHMETRICS_TPU_EXECUTOR_RECOVERY", None)
-        else:
-            os.environ["TORCHMETRICS_TPU_EXECUTOR_RECOVERY"] = prev_recovery
+        for _ in range(rounds):
+            round_trip(leaves, fleet, exporters)
+        elapsed = time.perf_counter() - t0
+        h1 = lag_hist()
+        nobs = h1["count"] - h0["count"]
+        sweep[f"{n}_leaves"] = {
+            "deltas_per_s": round(n * rounds / elapsed, 1),
+            "round_trip_ms": round(1e3 * elapsed / rounds, 3),
+            "aggregation_lag_us_mean": round((h1["sum"] - h0["sum"]) / max(nobs, 1), 1)
+            if nobs
+            else None,
+        }
+        if n == 8:
+            fleet8 = (leaves, fleet, exporters)
+    out["fleet_size_sweep"] = sweep
+
+    # ---- headline: steady deltas merged/s on the 8-leaf fleet
+    leaves, fleet, exporters = fleet8
+
+    def block():
+        t0 = time.perf_counter()
+        for _ in range(10):
+            round_trip(leaves, fleet, exporters)
+        return (time.perf_counter() - t0) / (10 * len(leaves))
+
+    per_delta = _stable_min(block, repeats=3)
+    out["value"] = round(1.0 / per_delta, 1)
+
+    # ---- tripwire: global view bit-exact vs the fault-free single-process
+    # fold of every leaf's final state (sorted leaf-id order, the
+    # aggregator's own fold order)
+    view = fleet.view()
+    got = view.read()
+    truth = None
+    for lid in sorted(leaves):
+        state = {k: np.asarray(v) for k, v in leaves[lid].state.items()}
+        truth = state if truth is None else {
+            k: np.asarray(v) for k, v in merge_folded(truth, state, reductions).items()
+        }
+    agree = view.healthy() and isinstance(got, dict) and set(got) == set(truth)
+    if agree:
+        agree = all(np.array_equal(np.asarray(got[k]), truth[k]) for k in truth)
+    out["fleet_values_agree"] = bool(agree)
+
+    # ---- degraded-read check: a dead root still serves its last merged
+    # view, at full coverage, without blocking or raising
+    fleet.root.kill()
+    dv = fleet.view().read()
+    degraded_ok = (
+        isinstance(dv, DegradedValue)
+        and float(dv.coverage) == 1.0
+        and all(np.array_equal(np.asarray(dv.value[k]), truth[k]) for k in truth)
+    )
+    out["degraded_read_ok"] = bool(degraded_ok)
+    out["fleet_values_agree"] = bool(out["fleet_values_agree"] and degraded_ok)
+
+    # ---- uplink bytes: exact vs quantized wire on a state big enough for
+    # the block codes to matter (per-block scales dominate tiny fields)
+    class BigLeaf:
+        def __init__(self):
+            self.rng = np.random.RandomState(17)
+            self.state = {"hist": np.zeros(8192, np.float32), "n": np.asarray(0, np.int64)}
+            self.updates = 0
+
+        def update(self):
+            self.state["hist"] = self.state["hist"] + (
+                self.rng.randint(-50, 50, 8192) / 8.0
+            ).astype(np.float32)
+            self.state["n"] = self.state["n"] + 1
+            self.updates += 1
+
+        def source(self):
+            return lambda: (dict(self.state), {"hist": "sum", "n": "sum"}, self.updates)
+
+    topo1 = FleetTopology(["leaf/0"])
+    exact_fleet = build_fleet(topo1, sleep=no_sleep)
+    quant_fleet = build_fleet(topo1, sleep=no_sleep)
+    leaf_a, leaf_b = BigLeaf(), BigLeaf()
+    ex_a = exact_fleet.leaf_exporter("leaf/0", leaf_a.source())
+    ex_b = quant_fleet.leaf_exporter("leaf/0", leaf_b.source(), precision="quantized")
+    for _ in range(4):
+        leaf_a.update()
+        leaf_b.update()
+        ex_a.ship(wait=True)
+        ex_b.ship(wait=True)
+    out["fleet_uplink_bytes_exact"] = int(exact_fleet.uplink.stats["bytes"])
+    out["fleet_uplink_bytes_quantized"] = int(quant_fleet.uplink.stats["bytes"])
+    out["fleet_uplink_ratio"] = round(
+        out["fleet_uplink_bytes_exact"] / max(out["fleet_uplink_bytes_quantized"], 1), 2
+    )
+    # integer fields ride raw even on the quantized wire — exact by contract
+    q_n = np.asarray(quant_fleet.view().read()["n"])
+    e_n = np.asarray(exact_fleet.view().read()["n"])
+    out["fleet_values_agree"] = bool(out["fleet_values_agree"] and np.array_equal(q_n, e_n))
     return out
 
 
@@ -2387,12 +2571,19 @@ def main() -> None:
         if "error" not in result and on_accel and not result.get("timing_unstable"):
             _store_cache(cache, name, "tpu", ch, result)
         provenance["live" if on_accel else "cpu_only"].append(name)
-    for name in ("2_collection_mesh_sync", "sync_latency", "9_session_lanes", "10_extreme_cardinality"):
+    for name in (
+        "2_collection_mesh_sync",
+        "sync_latency",
+        "9_session_lanes",
+        "10_extreme_cardinality",
+        "11_fleet_aggregation",
+    ):
         # virtual-mesh / dispatch-amortization configs are host-CPU by design
         # (see _run_in_cpu_subprocess) and run live everywhere; the subprocess
         # reports its own stall signal. Config 10 materializes a 10 GB state
-        # twice (escape-seam copy + gather) on one core — give it headroom
-        to = 560 if name == "10_extreme_cardinality" else 240
+        # three times on one core (escape-seam copy + recovery-mirror rebuild
+        # + gather) — give it headroom
+        to = {"10_extreme_cardinality": 1200, "11_fleet_aggregation": 360}.get(name, 240)
         r = _run_config(lambda name=name, to=to: _run_in_cpu_subprocess(name, timeout=to))
         configs[name] = _apply_baselines(name, r, baselines)
     # config 8 is host-CPU by design too (cold start is a process/compile
@@ -2429,6 +2620,7 @@ if __name__ == "__main__":
             "8_cold_start_child": bench_config8_child,
             "9_session_lanes": bench_config9,
             "10_extreme_cardinality": bench_config10,
+            "11_fleet_aggregation": bench_config11,
         }[sys.argv[2]]
         out = fn()
         if _TIMING_UNSTABLE:  # surface the stall signal across the process boundary

@@ -1,0 +1,708 @@
+"""Fault-tolerant fleet aggregation (ISSUE 17): exactly-once delta trees.
+
+Contracts proven here:
+
+- **Convergence**: any schedule of drops / duplicates / reorders /
+  partitions over any of the five reduction families converges BIT-EXACT to
+  the fault-free single-process ``merge_folded`` fold once every leaf's
+  outbox drains — the exactly-once ledger (monotonic epochs, pending buffer,
+  watermark quarantine) plus outbox re-ship is the whole mechanism.
+- **Failover**: killing an aggregator mid-run and restoring a successor from
+  its newest snapshot loses nothing — leaves re-ship everything past the
+  ``durable_epoch`` ack floor and the restored ledgers drop the duplicates.
+- **Degraded reads**: a partial global view is served as a
+  :class:`DegradedValue` carrying the fleet-coverage fraction and per-leaf
+  staleness anchored on version counters; ``allow_degraded=False`` raises.
+- **Composed chaos** (the acceptance proof): drops + duplicates + late
+  deltas + a partitioned leaf + one mid-run aggregator kill/failover, all at
+  once, still converge bit-exact for all five families.
+
+Transport faults are injected at the documented ``Uplink.transmit`` seam via
+the ``testing/faults.py`` helpers. Backoff clocks are injected (``sleep``)
+so retries cost nothing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+jax.config.update("jax_platforms", "cpu")
+
+from torchmetrics_tpu.fleet import (  # noqa: E402
+    Aggregator,
+    Delta,
+    Fleet,
+    FleetTopology,
+    LeafExporter,
+    LeafLedger,
+    Uplink,
+    build_fleet,
+    delta_since,
+    field_mode,
+    metric_source,
+)
+from torchmetrics_tpu.parallel.quantized import wire_payload_bytes  # noqa: E402
+from torchmetrics_tpu.parallel.reshard import merge_folded  # noqa: E402
+from torchmetrics_tpu.quarantine import DegradedValue  # noqa: E402
+from torchmetrics_tpu.testing import faults  # noqa: E402
+from torchmetrics_tpu.utils.exceptions import (  # noqa: E402
+    CheckpointCorruptionError,
+    FleetProtocolError,
+)
+
+NO_SLEEP = lambda s: None  # noqa: E731 — injected backoff clock
+
+
+# --------------------------------------------------------------------- harness
+
+REDUCTIONS = {
+    "s_sum": "sum",
+    "s_mean": "mean",
+    "s_max": "max",
+    "s_min": "min",
+    "s_cat": "cat",
+    "n": "sum",
+}
+WIDTH = 4
+
+
+class FakeLeaf:
+    """One simulated leaf process covering all five reduction families.
+
+    Updates draw multiples of 1/8 so every float sum is exact in fp32 —
+    bit-exactness claims then have no tolerance to hide behind."""
+
+    def __init__(self, seed: int):
+        self.rng = np.random.RandomState(seed)
+        self.state = {
+            "s_sum": np.zeros(WIDTH, np.float32),
+            "s_mean": np.zeros(WIDTH, np.float32),
+            "s_max": np.full((WIDTH,), -np.inf, np.float32),
+            "s_min": np.full((WIDTH,), np.inf, np.float32),
+            "s_cat": np.zeros((0,), np.float32),
+            "n": np.asarray(0, np.int64),
+        }
+        self.updates = 0
+
+    def update(self):
+        x = (self.rng.randint(-50, 50, WIDTH) / 8.0).astype(np.float32)
+        s = self.state
+        s["s_sum"] = s["s_sum"] + x
+        s["s_mean"] = s["s_mean"] + x
+        s["s_max"] = np.maximum(s["s_max"], x)
+        s["s_min"] = np.minimum(s["s_min"], x)
+        s["s_cat"] = np.concatenate([s["s_cat"], x])
+        s["n"] = s["n"] + 1
+        self.updates += 1
+
+    def source(self):
+        def _src():
+            return dict(self.state), dict(REDUCTIONS), self.updates
+
+        return _src
+
+
+def single_process_fold(leaves):
+    """The fault-free ground truth: each leaf's final canonical state folded
+    via ``merge_folded`` in sorted leaf-id order (the aggregator's own fold
+    order, so bit-exactness is well-defined)."""
+    merged = None
+    for lid in sorted(leaves):
+        state = {k: np.asarray(v) for k, v in leaves[lid].state.items()}
+        if merged is None:
+            merged = state
+        else:
+            merged = {
+                k: np.asarray(v) for k, v in merge_folded(merged, state, REDUCTIONS).items()
+            }
+    return merged
+
+
+def assert_states_equal(got, want):
+    assert got is not None and set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def flat_fleet(n_leaves, tmp_path=None, **kwargs):
+    topo = FleetTopology([f"leaf/{i}" for i in range(n_leaves)], fanout=max(8, n_leaves))
+    kwargs.setdefault("sleep", NO_SLEEP)
+    if tmp_path is not None:
+        kwargs.setdefault("snapshot_dir", str(tmp_path))
+        kwargs.setdefault("snapshot_every", 1)
+    fleet = build_fleet(topo, **kwargs)
+    leaves = {lid: FakeLeaf(seed=i) for i, lid in enumerate(topo.leaves)}
+    exporters = {lid: fleet.leaf_exporter(lid, leaves[lid].source()) for lid in topo.leaves}
+    return fleet, leaves, exporters
+
+
+def drain_all(fleet, exporters, rounds=12):
+    """Flush every outbox until empty (breaker probation needs a few passes)."""
+    for _ in range(rounds):
+        for ex in exporters.values():
+            ex.flush()
+        fleet.pump()
+        if all(ex.outbox_size == 0 for ex in exporters.values()):
+            return
+    raise AssertionError(
+        "outboxes did not drain: " + str({k: ex.outbox_size for k, ex in exporters.items()})
+    )
+
+
+# ----------------------------------------------------------------- wire modes
+
+
+def test_field_mode_table():
+    assert field_mode("cat", np.float32) == "suffix"
+    assert field_mode("max", np.float32) == "merge"
+    assert field_mode("min", np.int32) == "merge"
+    assert field_mode("sum", np.int64) == "add"
+    assert field_mode("sum", np.uint32) == "add"
+    assert field_mode("sum", np.float32) == "replace"
+    assert field_mode("mean", np.float64) == "replace"
+    assert field_mode("mean", np.bool_) == "replace"  # bool subtraction is a numpy error
+    with pytest.raises(FleetProtocolError, match="wire mode"):
+        field_mode(None, np.float32)
+    with pytest.raises(FleetProtocolError):
+        field_mode(lambda a, b: a, np.float32)
+
+
+def test_delta_since_modes_and_shrink_guard():
+    reds = {"count": "sum", "total": "sum", "rows": "cat", "peak": "max"}
+    prev = {
+        "count": np.asarray([3, 4], np.int64),
+        "total": np.asarray([1.5, 2.5], np.float32),
+        "rows": np.asarray([1.0, 2.0], np.float32),
+        "peak": np.asarray(7.0, np.float32),
+    }
+    cur = {
+        "count": np.asarray([5, 4], np.int64),
+        "total": np.asarray([9.5, 2.5], np.float32),
+        "rows": np.asarray([1.0, 2.0, 3.0], np.float32),
+        "peak": np.asarray(8.0, np.float32),
+    }
+    d = delta_since(cur, prev, reds)
+    np.testing.assert_array_equal(d["count"], [2, 0])  # int add: exact difference
+    np.testing.assert_array_equal(d["total"], cur["total"])  # float replace: full value
+    np.testing.assert_array_equal(d["rows"], [3.0])  # cat suffix: new rows only
+    np.testing.assert_array_equal(d["peak"], 8.0)  # max merge: full value
+    shrunk = dict(cur, rows=np.asarray([1.0], np.float32))
+    with pytest.raises(FleetProtocolError, match="shrank"):
+        delta_since(shrunk, cur, reds)
+    full = delta_since(cur, None, reds)
+    for k in cur:
+        np.testing.assert_array_equal(full[k], cur[k])
+
+
+# -------------------------------------------------- exactly-once ledger laws
+
+
+def _cut_deltas(n_epochs, seed=0):
+    """``n_epochs`` consecutive deltas from one FakeLeaf's exporter (no
+    transport involved — export() only parks in the outbox)."""
+    leaf = FakeLeaf(seed)
+    exporter = LeafExporter(
+        "leaf/0", leaf.source(), Uplink({}, sleep=NO_SLEEP), "agg/root", outbox_limit=256
+    )
+    deltas = []
+    for _ in range(n_epochs):
+        leaf.update()
+        deltas.append(exporter.export())
+    return leaf, deltas
+
+
+# Property test over randomized schedules. Seeded numpy draws rather than
+# hypothesis (not shipped in the image; tests/test_merge_properties.py's
+# st.floats caveat would apply anyway) — 40 schedules per run, deterministic.
+@pytest.mark.parametrize("seed", range(40))
+def test_ledger_any_delivery_schedule_converges(seed):
+    """Any permutation of epochs 1..N with any duplicates interleaved lands
+    on the exact state of in-order delivery, with ``applied == N`` — the
+    exactly-once law the whole tree rests on (watermark >= N so no schedule
+    quarantines here; the quarantine path has its own test)."""
+    rng = np.random.RandomState(1000 + seed)
+    n = int(rng.randint(3, 9))
+    leaf, deltas = _cut_deltas(n, seed=seed)
+    schedule = []
+    for idx in rng.permutation(n):
+        schedule.append(int(idx))
+        for dup in rng.randint(0, n, rng.randint(0, 3)):
+            schedule.append(int(dup))
+
+    truth = LeafLedger("leaf/0", watermark=n + 1)
+    for d in deltas:
+        truth.offer(d)
+    chaotic = LeafLedger("leaf/0", watermark=n + 1)
+    for idx in schedule:
+        chaotic.offer(deltas[idx])
+
+    assert chaotic.applied_epoch == n
+    assert chaotic.stats["applied"] == truth.stats["applied"] == n
+    assert not chaotic.pending  # every gap eventually filled and drained
+    assert_states_equal(chaotic.acc, truth.acc)
+    assert_states_equal(truth.acc, {k: np.asarray(v) for k, v in leaf.state.items()})
+
+
+def test_ledger_watermark_quarantine_and_full_resync():
+    """A reorder gap wider than the watermark quarantines the leaf (pending
+    dropped, ``needs_full`` raised, later deltas counted ``late_dropped``);
+    a ``kind="full"`` resync re-anchors the epoch clock and recovers."""
+    leaf = FakeLeaf(3)
+    exporter = LeafExporter(
+        "leaf/0", leaf.source(), Uplink({}, sleep=NO_SLEEP), "agg/root", outbox_limit=256
+    )
+    deltas = []
+    for _ in range(12):
+        leaf.update()
+        deltas.append(exporter.export())
+    ledger = LeafLedger("leaf/0", watermark=4)
+    ledger.offer(deltas[0])
+    ack = ledger.offer(deltas[11])  # gap of 10 > watermark 4
+    assert ack["needs_full"] and ledger.quarantined
+    assert ledger.stats["quarantines"] == 1 and not ledger.pending
+    ack = ledger.offer(deltas[5])  # anything short of a resync is dead on arrival
+    assert ack["needs_full"] and ledger.stats["late_dropped"] == 1
+
+    exporter.mark_resync()
+    leaf.update()
+    full = exporter.export()
+    assert full.kind == "full"
+    ack = ledger.offer(full)
+    assert not ack["needs_full"] and ledger.applied_epoch == full.epoch
+    assert_states_equal(ledger.acc, {k: np.asarray(v) for k, v in leaf.state.items()})
+
+
+def test_ledger_snapshot_roundtrip():
+    leaf, deltas = _cut_deltas(5, seed=9)
+    ledger = LeafLedger("leaf/0")
+    for d in deltas:
+        ledger.offer(d)
+    restored = LeafLedger.restore(ledger.export())
+    assert restored.applied_epoch == 5 and restored.update_count == leaf.updates
+    assert_states_equal(restored.acc, ledger.acc)
+    # duplicates of already-applied epochs are still dropped by the successor
+    ack = restored.offer(deltas[2])
+    assert ack["applied_epoch"] == 5 and restored.stats["duplicates"] == 1
+
+
+# ------------------------------------------------------------ tree convergence
+
+
+def test_flat_fleet_five_families_converge_bit_exact():
+    fleet, leaves, exporters = flat_fleet(3)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        for lid in fleet.topology.leaves:
+            for _ in range(int(rng.randint(1, 4))):
+                leaves[lid].update()
+            exporters[lid].ship(wait=True)
+    view = fleet.view()
+    assert view.healthy() and view.coverage() == 1.0
+    got = view.read()
+    assert not isinstance(got, DegradedValue)
+    assert_states_equal(got, single_process_fold(leaves))
+    assert fleet.root.total_update_count() == sum(l.updates for l in leaves.values())
+
+
+def test_multi_level_tree_converges_after_pump():
+    topo = FleetTopology([f"leaf/{i}" for i in range(5)], fanout=2)
+    assert len(topo.levels) > 1  # the test exists to cross an interior link
+    fleet = build_fleet(topo, sleep=NO_SLEEP)
+    leaves = {lid: FakeLeaf(seed=i + 20) for i, lid in enumerate(topo.leaves)}
+    exporters = {lid: fleet.leaf_exporter(lid, leaves[lid].source()) for lid in topo.leaves}
+    for _ in range(3):
+        for lid in topo.leaves:
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+    view = fleet.view()
+    assert not view.healthy()  # interior links have not pumped yet
+    fleet.pump()
+    view = fleet.view()
+    assert view.healthy()
+    assert_states_equal(view.read(), single_process_fold(leaves))
+
+
+def test_metric_source_real_metrics_converge():
+    """Live aggregation metrics as leaf sources: the global read is the
+    cross-process value a single process accumulating everything would
+    compute."""
+    from torchmetrics_tpu.aggregation import SumMetric
+
+    fleet = build_fleet(FleetTopology(["leaf/0", "leaf/1"]), sleep=NO_SLEEP)
+    metrics, all_vals = {}, []
+    for i, lid in enumerate(fleet.topology.leaves):
+        metrics[lid] = SumMetric()
+        vals = [float(v) for v in range(1 + i, 5 + i)]
+        for v in vals:
+            metrics[lid].update(jnp.asarray(v, jnp.float32))
+        all_vals.extend(vals)
+        fleet.leaf_exporter(lid, metric_source(metrics[lid])).ship(wait=True)
+    got = fleet.view().read()
+    assert not isinstance(got, DegradedValue)
+    total = np.asarray(got["sum_value"], np.float32)
+    np.testing.assert_allclose(total, np.float32(sum(all_vals)))
+
+
+# ------------------------------------------------------------- injected faults
+
+
+def test_drop_within_retry_budget_is_invisible():
+    fleet, leaves, exporters = flat_fleet(2)
+    with faults.drop_delta("leaf/0", n=1) as ctx:
+        for lid in fleet.topology.leaves:
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+    assert ctx["dropped"] == 1
+    assert fleet.uplink.stats["failed"] == 0  # retried inside one send
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_drop_past_retry_budget_retains_outbox_then_reships():
+    fleet, leaves, exporters = flat_fleet(2)
+    with faults.drop_delta("leaf/0", n=4) as ctx:  # budget is 3 attempts/send
+        leaves["leaf/0"].update()
+        assert exporters["leaf/0"].ship(wait=True) is None
+        assert exporters["leaf/0"].outbox_size == 1  # kept for re-ship
+        leaves["leaf/1"].update()
+        exporters["leaf/1"].ship(wait=True)
+        exporters["leaf/0"].flush()  # 4th attempt drops, retry delivers
+    assert ctx["dropped"] == 4
+    assert exporters["leaf/0"].outbox_size == 0
+    assert fleet.root.ledger("leaf/0").stats["applied"] == 1
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_duplicate_delivery_is_idempotent():
+    fleet, leaves, exporters = flat_fleet(2)
+    with faults.duplicate_delta("leaf/1") as ctx:
+        for _ in range(4):
+            for lid in fleet.topology.leaves:
+                leaves[lid].update()
+                exporters[lid].ship(wait=True)
+    assert ctx["duplicated"] == 4
+    ledger = fleet.root.ledger("leaf/1")
+    assert ledger.stats["duplicates"] == 4 and ledger.stats["applied"] == 4
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_delayed_delta_buffers_and_drains():
+    """A held epoch arriving after its successors is a genuine reorder: the
+    successors sit in the pending buffer until the gap fills, then drain —
+    and the value is exactly what in-order delivery produces."""
+    fleet, leaves, exporters = flat_fleet(1)
+    with faults.delay_delta("leaf/0", epochs=2) as ctx:
+        for _ in range(4):
+            leaves["leaf/0"].update()
+            exporters["leaf/0"].ship(wait=True)
+    assert ctx["held_epoch"] == 1 and ctx["delivered_late"]
+    ledger = fleet.root.ledger("leaf/0")
+    assert ledger.stats["reordered"] >= 1
+    drain_all(fleet, exporters)
+    assert ledger.applied_epoch >= 4
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_partitioned_leaf_rejoins_and_replays_backlog():
+    fleet, leaves, exporters = flat_fleet(2)
+    with faults.partition_leaf("leaf/0", epochs=3) as ctx:
+        for _ in range(3):
+            for lid in fleet.topology.leaves:
+                leaves[lid].update()
+                exporters[lid].ship(wait=True)
+        assert fleet.root.ledger("leaf/0") is None or (
+            fleet.root.ledger("leaf/0").stats["applied"] == 0
+        )
+        assert exporters["leaf/0"].outbox_size == 3  # the whole partition backlog
+        view = fleet.view()
+        assert not view.healthy()
+        degraded = view.read()
+        assert isinstance(degraded, DegradedValue)
+        assert degraded.coverage == pytest.approx(0.5)
+        assert degraded.staleness["leaf/0"]["applied_epoch"] == 0
+    assert len(ctx["dropped_epochs"]) >= 1
+    drain_all(fleet, exporters)
+    ledger = fleet.root.ledger("leaf/0")
+    assert ledger.applied_epoch == 3 and ledger.stats["applied"] == 3  # in-order replay
+    assert fleet.view().healthy()
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_partition_lifts_after_distinct_epoch_attempts():
+    """Driving sends out of flush order (and with no retry budget, so one
+    send is one attempt) shows the in-context rejoin: after ``epochs``
+    distinct epochs hit the dead link, delivery resumes."""
+    from torchmetrics_tpu.io.retry import RetryPolicy
+
+    fleet, leaves, exporters = flat_fleet(1, policy=RetryPolicy(max_retries=0))
+    ex = exporters["leaf/0"]
+    with faults.partition_leaf("leaf/0", epochs=3) as ctx:
+        ds = []
+        for _ in range(3):
+            leaves["leaf/0"].update()
+            ds.append(ex.export())
+        for d in ds:  # each distinct epoch marks the partition clock
+            assert fleet.uplink.send("agg/root", d) is None
+        assert ctx["dropped_epochs"] == {1, 2, 3}
+        # partition lifted: backlog replays in order (the three faults opened
+        # the breaker, so the first flushes are skipped until its probe)
+        for _ in range(4):
+            ex.flush()
+        assert ex.outbox_size == 0
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_outbox_overflow_collapses_to_full_resync():
+    """An aggregator unreachable longer than the outbox bound costs the
+    backlog, not correctness: the exporter clears, marks resync, and the next
+    successful export is a ``kind="full"`` install."""
+    fleet, leaves, exporters = flat_fleet(1)
+    ex = fleet.leaf_exporter("leaf/0", leaves["leaf/0"].source(), outbox_limit=2)
+    with faults.kill_aggregator(fleet.root):
+        for _ in range(3):
+            leaves["leaf/0"].update()
+            ex.ship(wait=True)
+    assert ex.stats["outbox_overflows"] == 1
+    leaves["leaf/0"].update()
+    ex.ship(wait=True)
+    full_epoch = ex.epoch
+    ledger = fleet.root.ledger("leaf/0")
+    assert ledger.applied_epoch == full_epoch and ledger.stats["resyncs"] == 1
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_breaker_opens_skips_then_probes_closed():
+    fleet, leaves, exporters = flat_fleet(1)
+    ex = exporters["leaf/0"]
+    br = fleet.uplink.breaker("leaf/0")
+    with faults.kill_aggregator(fleet.root):
+        for _ in range(3):  # threshold faults -> open
+            leaves["leaf/0"].update()
+            ex.ship(wait=True)
+        assert br.state == "open"
+        ex.flush()  # skipped without touching the transport
+        assert fleet.uplink.stats["breaker_skipped"] >= 1
+    for _ in range(4):  # probe_after skips, then the probation probe closes it
+        ex.flush()
+    assert br.state == "closed" and ex.outbox_size == 0
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+# -------------------------------------------------------------------- failover
+
+
+def test_aggregator_failover_is_zero_loss(tmp_path):
+    fleet, leaves, exporters = flat_fleet(2, tmp_path=tmp_path)
+    for _ in range(3):
+        for lid in fleet.topology.leaves:
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+    fleet.root.kill()
+    leaves["leaf/0"].update()
+    assert exporters["leaf/0"].ship(wait=True) is None  # outbox retains
+    successor = fleet.failover("agg/root")
+    assert successor is fleet.root and successor.alive
+    assert successor.ledger("leaf/0").applied_epoch == 3  # restored, not rebuilt
+    drain_all(fleet, exporters)
+    assert successor.ledger("leaf/0").applied_epoch == 4
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_failover_without_snapshot_for_a_leaf_requests_resync(tmp_path):
+    """A successor restored from a snapshot that predates a leaf's first
+    delta has no ledger for it — the first delta acks ``needs_full`` and the
+    leaf resyncs with a full export."""
+    fleet, leaves, exporters = flat_fleet(2, tmp_path=tmp_path)
+    leaves["leaf/0"].update()
+    exporters["leaf/0"].ship(wait=True)  # only leaf/0 is in the snapshot
+    fleet.root.kill()
+    fleet.failover("agg/root")
+    for _ in range(2):
+        for lid in fleet.topology.leaves:
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+    drain_all(fleet, exporters)
+    assert exporters["leaf/1"].stats["full_exports"] >= 1
+    assert_states_equal(fleet.view().read(), single_process_fold(leaves))
+
+
+def test_snapshot_corruption_is_typed(tmp_path):
+    fleet, leaves, exporters = flat_fleet(1, tmp_path=tmp_path)
+    leaves["leaf/0"].update()
+    exporters["leaf/0"].ship(wait=True)
+    snaps = sorted(tmp_path.glob("fleet-*.ckpt"))
+    assert snaps
+    blob = snaps[-1].read_bytes()
+    snaps[-1].write_bytes(blob[: len(blob) // 2])  # torn write
+    with pytest.raises(CheckpointCorruptionError):
+        Aggregator.restore(str(tmp_path), node_id="agg/root")
+
+
+def test_dead_aggregator_still_serves_degraded_reads():
+    fleet, leaves, exporters = flat_fleet(2)
+    for lid in fleet.topology.leaves:
+        leaves[lid].update()
+        exporters[lid].ship(wait=True)
+    truth = single_process_fold(leaves)
+    fleet.root.kill()
+    view = fleet.view()
+    assert not view.healthy()
+    got = view.read()
+    assert isinstance(got, DegradedValue)
+    assert got.coverage == pytest.approx(1.0)  # every leaf had merged pre-kill
+    assert_states_equal(got.value, truth)
+    with pytest.raises(FleetProtocolError, match="degraded"):
+        view.read(allow_degraded=False)
+
+
+# ------------------------------------------------------------- quantized wire
+
+
+def test_quantized_uplink_cheaper_ints_exact():
+    """At state sizes where the wire matters (thousands of elements, not the
+    harness's 4-wide toys — block scales would dominate those) the quantized
+    uplink undercuts the exact one on bytes, integer fields ride raw."""
+
+    class BigLeaf:
+        def __init__(self):
+            self.rng = np.random.RandomState(11)
+            self.state = {
+                "hist": np.zeros(4096, np.float32),
+                "n": np.asarray(0, np.int64),
+            }
+            self.updates = 0
+
+        def update(self):
+            self.state["hist"] = self.state["hist"] + (
+                self.rng.randint(-50, 50, 4096) / 8.0
+            ).astype(np.float32)
+            self.state["n"] = self.state["n"] + 1
+            self.updates += 1
+
+        def source(self):
+            return lambda: (dict(self.state), {"hist": "sum", "n": "sum"}, self.updates)
+
+    topo = FleetTopology(["leaf/0"])
+    exact_fleet = build_fleet(topo, sleep=NO_SLEEP)
+    quant_fleet = build_fleet(topo, sleep=NO_SLEEP)
+    leaf_a, leaf_b = BigLeaf(), BigLeaf()
+    ex_a = exact_fleet.leaf_exporter("leaf/0", leaf_a.source())
+    ex_b = quant_fleet.leaf_exporter("leaf/0", leaf_b.source(), precision="quantized")
+    for _ in range(4):
+        leaf_a.update()
+        leaf_b.update()
+        ex_a.ship(wait=True)
+        ex_b.ship(wait=True)
+    assert quant_fleet.uplink.stats["bytes"] < exact_fleet.uplink.stats["bytes"] / 2
+    exact_val = exact_fleet.view().read()
+    quant_val = quant_fleet.view().read()
+    np.testing.assert_array_equal(quant_val["n"], exact_val["n"])  # ints ride raw
+    scale = np.abs(np.asarray(exact_val["hist"])).max()
+    np.testing.assert_allclose(quant_val["hist"], exact_val["hist"], atol=scale / 100)
+
+
+# ------------------------------------------------------- deferred-executor seam
+
+
+@pytest.fixture()
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def test_deferred_step_export_delta_seam(mesh8):
+    """``DeferredCollectionStep.export_delta``: applying the cut delta to the
+    previous canonical export reproduces the fresh canonical export exactly —
+    the leaf-side invariant the fleet exporter rides."""
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.fleet.delta import apply_delta
+    from torchmetrics_tpu.ops.executor import make_deferred_collection_step
+
+    coll = MetricCollection(
+        {"mean": MeanMetric(executor=False), "total": SumMetric(executor=False)},
+        reduce="deferred",
+    )
+    step = make_deferred_collection_step(coll, mesh8, axis_name="data")
+    states = step.init_states()
+
+    def batch(seed):
+        vals = np.random.RandomState(seed).randint(-40, 40, 16).astype(np.float32) / 8.0
+        return jax.device_put(jnp.asarray(vals), NamedSharding(mesh8, P("data")))
+
+    states = step.local_step(states, batch(0))
+    baseline, first = step.export_delta(states)
+    for leader, payload in first.items():  # no baseline: full payloads
+        for field, arr in payload.items():
+            np.testing.assert_array_equal(arr, np.asarray(baseline[leader][field]))
+
+    states = step.local_step(states, batch(1))
+    canonical, payload = step.export_delta(states, baseline=baseline)
+    reds = step.canonical_reductions()
+    for leader in canonical:
+        rebuilt = apply_delta(
+            {k: np.asarray(v) for k, v in baseline[leader].items()},
+            payload[leader],
+            reds[leader],
+        )
+        for field, want in canonical[leader].items():
+            np.testing.assert_array_equal(rebuilt[field], np.asarray(want), err_msg=field)
+
+
+# -------------------------------------------------------- composed chaos proof
+
+
+def test_composed_chaos_converges_bit_exact(tmp_path):
+    """The acceptance proof: dropped + duplicated + late deltas, one mid-run
+    aggregator kill with failover from snapshot, and one partitioned leaf
+    that rejoins — the global view still converges BIT-EXACT to the
+    fault-free single-process fold for all five reduction families, and
+    partial reads during the outage serve a DegradedValue with the correct
+    coverage fraction and per-leaf staleness."""
+    fleet, leaves, exporters = flat_fleet(4, tmp_path=tmp_path)
+
+    def round_trip():
+        for lid in fleet.topology.leaves:
+            leaves[lid].update()
+            exporters[lid].ship(wait=True)
+
+    with faults.drop_delta("leaf/0", n=4) as dropped, faults.duplicate_delta(
+        "leaf/1"
+    ) as duplicated, faults.delay_delta("leaf/2", epochs=2) as delayed, faults.partition_leaf(
+        "leaf/3", epochs=99
+    ) as partitioned:
+        for _ in range(3):
+            round_trip()
+
+        # mid-run outage: the root dies with leaf/3 still partitioned
+        fleet.root.kill()
+        round_trip()  # every ship fails; outboxes absorb the epoch
+        view = fleet.view()
+        assert not view.healthy()
+        degraded = view.read()
+        assert isinstance(degraded, DegradedValue)
+        assert degraded.coverage == pytest.approx(0.75)  # leaf/3 never merged
+        assert degraded.staleness["leaf/3"]["applied_epoch"] == 0
+        assert degraded.staleness["leaf/1"]["applied_epoch"] >= 1
+        with pytest.raises(FleetProtocolError, match="degraded"):
+            view.read(allow_degraded=False)
+
+        successor = fleet.failover("agg/root")
+        assert successor.alive
+        for _ in range(2):
+            round_trip()
+
+    assert dropped["dropped"] == 4
+    assert duplicated["duplicated"] >= 1
+    assert delayed["delivered_late"]
+    assert len(partitioned["dropped_epochs"]) >= 1
+
+    drain_all(fleet, exporters)
+    view = fleet.view()
+    assert view.healthy() and view.coverage() == 1.0
+    got = view.read()
+    assert not isinstance(got, DegradedValue)
+    assert_states_equal(got, single_process_fold(leaves))
+    root = fleet.root
+    assert root.ledger("leaf/1").stats["duplicates"] >= 1
+    assert root.ledger("leaf/3").applied_epoch == exporters["leaf/3"].epoch
+    assert root.total_update_count() == sum(l.updates for l in leaves.values())

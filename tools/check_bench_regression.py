@@ -351,6 +351,39 @@ def check_bench(
                     " compute(); exactness is the contract, fail outright",
                 )
             )
+        # fleet aggregation gates (ISSUE 17): the global view folded at the
+        # aggregator must be bit-exact against the fault-free single-process
+        # merge (hard tripwire), and the quantized uplink must keep beating
+        # the exact wire on bytes (floor from BASELINE.json
+        # fleet_uplink_ratio_min; see docs/FLEET.md "Determinism")
+        fagree = result.get("fleet_values_agree")
+        if fagree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "fleet_values_agree is false — the delta-tree global view diverged"
+                    " from the fault-free single-process merge_folded fold; exactly-once"
+                    " bit-exact convergence is the contract, fail outright"
+                    " (docs/FLEET.md 'Determinism')",
+                )
+            )
+        fratio = result.get("fleet_uplink_ratio")
+        if isinstance(fratio, (int, float)):
+            base = baselines.get(name, {})
+            floor = base.get("fleet_uplink_ratio_min", 1.5) if isinstance(base, dict) else 1.5
+            if float(fratio) < float(floor):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"fleet_uplink_ratio {fratio:.2f} below the {floor} floor — the"
+                        " quantized delta wire no longer meaningfully undercuts the exact"
+                        " wire on uplink bytes (docs/FLEET.md 'The delta protocol')",
+                    )
+                )
         ratio = effective_ratio(name, result, baselines)
         if ratio is None or ratio >= threshold:
             continue

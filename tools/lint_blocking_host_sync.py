@@ -53,6 +53,12 @@ HOT_PATH_FILES = (
     "parallel/quantized.py",
     "parallel/reshard.py",
     "parallel/class_shard.py",
+    "fleet/topology.py",
+    "fleet/delta.py",
+    "fleet/transport.py",
+    "fleet/leaf.py",
+    "fleet/aggregator.py",
+    "fleet/view.py",
     "io/checkpoint.py",
     "io/retry.py",
     "obs/tracer.py",
@@ -318,6 +324,51 @@ ALLOWLIST = {
     ),
     "parallel/quantized.py::wire_payload_bytes": (
         "uplink accounting on host wire payloads (already np arrays)"
+    ),
+    # --- class-axis recovery mirror (the laned mirror pattern at cell
+    #     granularity): host copies here ARE the recovery reference —
+    #     cells-sized on the warm path, state-sized only on a chain break
+    "parallel/class_shard.py::snapshot": (
+        "the incremental class-cell recovery mirror IS a deliberate host copy"
+        " — touched-cells-sized on the warm path, replacing the whole-state"
+        " executor _snapshot for class-sharded dispatches"
+    ),
+    "parallel/class_shard.py::materialize": (
+        "Autosaver recovery-reuse: detaching the (already host-side) cell"
+        " mirror is a host-to-host memcpy, no device fetch"
+    ),
+    "parallel/class_shard.py::_assemble_host": (
+        "the mirror's chain-break full rebuild IS the deliberate whole-state"
+        " recovery host copy, assembled per addressable shard to skip the"
+        " gathered-relayout path np.array takes on class-sharded operands"
+    ),
+    # --- fleet uplinks (docs/FLEET.md): every sync below runs at a SHIP or
+    #     MERGE point on host-side wire payloads — the step loop only ever
+    #     pays the one rows-sized export fold, and ship(wait=False) moves
+    #     even that flush onto the async read pipeline worker
+    "fleet/delta.py::delta_since": (
+        "delta cut point: per-field subtraction/suffix-slicing over the"
+        " already-host canonical fold — the deliberate rows-sized export copy"
+    ),
+    "fleet/delta.py::apply_delta": (
+        "aggregator merge point: receiver-side host arithmetic on decoded"
+        " wire payloads, never on a leaf's step loop"
+    ),
+    "fleet/delta.py::export": (
+        "ledger snapshot serialization: detaching host-side accumulations"
+        " for the aggregator's failover checkpoint (host-to-host memcpy)"
+    ),
+    "fleet/leaf.py::_source": (
+        "source fold: the ONE deliberate D2H per export interval — metric"
+        " state to canonical host form at ship cadence, not per step"
+    ),
+    "fleet/leaf.py::export": (
+        "defensive detach of the source's host fold before the delta cut"
+        " (host-to-host for well-behaved sources)"
+    ),
+    "fleet/aggregator.py::canonical": (
+        "global read point: np-ifying the merged per-leaf fold where the"
+        " caller is already reading the value"
     ),
     "lanes.py::remap_capacity": (
         "elastic restore / live lane resharding: host gather/scatter of lane"

@@ -41,6 +41,7 @@ TYPED_ERRORS = (
     "ShardLossError",
     "LaneFaultError",
     "DispatchStallError",
+    "FleetProtocolError",
 )
 
 #: names that count as the breadcrumb-with-flight helper at a raise site
@@ -62,6 +63,12 @@ COVERED_MODULES = (
     "parallel/class_shard.py",
     "io/checkpoint.py",
     "io/retry.py",
+    "fleet/topology.py",
+    "fleet/delta.py",
+    "fleet/transport.py",
+    "fleet/leaf.py",
+    "fleet/aggregator.py",
+    "fleet/view.py",
 )
 
 #: deliberate unwrapped raises; keys are "<path>::<function>", values say why

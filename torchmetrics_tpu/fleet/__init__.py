@@ -1,0 +1,67 @@
+"""torchmetrics_tpu.fleet — fault-tolerant cross-process metric aggregation.
+
+Everything below ``fleet/`` scales metrics *across* independent serving
+processes, where everything else in the package scales *within* one JAX
+world. Leaf processes periodically fold their state to the topology-neutral
+canonical form (the PR 10 ``export_canonical``/``merge_folded`` seam) and
+ship *deltas* — state since the last acked export — up a configurable
+aggregator tree to a global view (docs/FLEET.md):
+
+- :mod:`~torchmetrics_tpu.fleet.topology` — leaf ids + fanout → the
+  aggregator tree (:class:`FleetTopology`).
+- :mod:`~torchmetrics_tpu.fleet.delta` — the exactly-once delta protocol:
+  per-field wire modes derived from ``(reduction, dtype)``, monotonic
+  per-leaf epoch counters, and the :class:`LeafLedger` that makes duplicates
+  idempotent drops, buffers reorders under a watermark, and quarantines
+  gaps past it.
+- :mod:`~torchmetrics_tpu.fleet.transport` — the uplink: capped-backoff
+  retries (io/retry.py) plus a per-leaf circuit breaker mirroring the lane
+  guard's closed/open/probation states.
+- :mod:`~torchmetrics_tpu.fleet.leaf` — the :class:`LeafExporter`: cuts
+  epoch-stamped deltas from a metric (or deferred-executor) source, keeps an
+  outbox of un-durable deltas for failover re-ship, and can ship on the
+  PR 9 async read pipeline so the step loop never blocks.
+- :mod:`~torchmetrics_tpu.fleet.aggregator` — per-leaf ledgers, acks,
+  atomic snapshots (io/checkpoint.py) and failover restore.
+- :mod:`~torchmetrics_tpu.fleet.view` — the :class:`GlobalView`: the merged
+  fleet value, served as a ``DegradedValue`` carrying coverage fraction and
+  per-leaf staleness whenever any leaf is missing, stale, or quarantined;
+  plus :func:`build_fleet` wiring a whole tree in one call.
+
+The layer inherits the PR 13 observability substrate: ship→merge causal flow
+arrows via ``obs.capture_context``/``use_context``, the
+``fleet.aggregation_lag_us`` registry histogram, and a dedicated ``fleet``
+flight-recorder domain (docs/OBSERVABILITY.md).
+"""
+from torchmetrics_tpu.fleet.aggregator import Aggregator, aggregator_source  # noqa: F401
+from torchmetrics_tpu.fleet.delta import (  # noqa: F401
+    DELTA_KINDS,
+    Delta,
+    LeafLedger,
+    apply_delta,
+    delta_since,
+    field_mode,
+)
+from torchmetrics_tpu.fleet.leaf import LeafExporter, deferred_source, metric_source  # noqa: F401
+from torchmetrics_tpu.fleet.topology import FleetTopology  # noqa: F401
+from torchmetrics_tpu.fleet.transport import Uplink  # noqa: F401
+from torchmetrics_tpu.fleet.view import Fleet, GlobalView, build_fleet  # noqa: F401
+
+__all__ = [
+    "Aggregator",
+    "DELTA_KINDS",
+    "Delta",
+    "Fleet",
+    "FleetTopology",
+    "GlobalView",
+    "LeafExporter",
+    "LeafLedger",
+    "Uplink",
+    "aggregator_source",
+    "apply_delta",
+    "build_fleet",
+    "deferred_source",
+    "delta_since",
+    "field_mode",
+    "metric_source",
+]

@@ -1,0 +1,266 @@
+"""The leaf side of the fleet tree: cut epoch-stamped deltas, keep an outbox,
+ship without blocking the step loop.
+
+A :class:`LeafExporter` owns one leaf's uplink. Each :meth:`export` folds the
+source to canonical host form, cuts the per-field delta against the previous
+export (``fleet/delta.py`` wire modes), stamps the next epoch, and parks the
+delta in the **outbox**; :meth:`flush` ships the outbox in epoch order. The
+outbox is trimmed only up to the aggregator's acked ``durable_epoch`` (the
+newest epoch covered by an aggregator snapshot), so an aggregator death never
+loses acknowledged-but-not-durable state: the un-trimmed deltas simply
+re-ship to the successor and the exactly-once ledger drops what the restored
+snapshot already holds — loss is bounded by one export interval
+(docs/FLEET.md "Failover").
+
+Sources are plain callables returning ``(state, reductions, update_count)``
+with host-numpy state — :func:`metric_source` adapts a live
+:class:`~torchmetrics_tpu.Metric` (class-sharded states are gathered dense,
+growing cat lists concatenated), :func:`deferred_source` adapts a
+``DeferredCollectionStep`` through its ``export_canonical`` seam, and
+``aggregator_source`` (fleet/aggregator.py) adapts an interior aggregator for
+multi-level trees.
+
+``ship(wait=False)`` runs the flush on the PR 9 async read pipeline: the
+step loop pays one host fold (rows-sized for deltas) and returns; transport
+latency, retries, and backoff land on the pipeline worker.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.fleet.delta import Delta, delta_since
+from torchmetrics_tpu.fleet.transport import Uplink
+
+__all__ = ["LeafExporter", "deferred_source", "metric_source"]
+
+Source = Callable[[], Tuple[Dict[str, Any], Dict[str, Any], int]]
+
+#: outbox entries before the exporter collapses to a full resync (an
+#: aggregator that has been unreachable this long will be told everything
+#: anyway; bounding the outbox bounds leaf memory)
+DEFAULT_OUTBOX_LIMIT = 64
+
+
+def metric_source(metric: Any) -> Source:
+    """Adapt a live Metric: canonical host state (class-sharded fields
+    gathered dense, growing cat lists concatenated), its reductions, and its
+    update count."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.parallel.class_shard import gather_dense
+
+    def _source() -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+        state: Dict[str, Any] = {}
+        live = metric.metric_state
+        for name in metric._defaults:
+            value = live[name]
+            layout = metric._class_layout(name)
+            if layout is not None:
+                value = gather_dense(jnp.asarray(value), layout)
+            if isinstance(value, (list, tuple)):
+                value = (
+                    np.concatenate([np.atleast_1d(np.asarray(el)) for el in value], axis=0)
+                    if len(value)
+                    else np.zeros((0,), dtype=np.float32)
+                )
+            state[name] = np.array(value)
+        return state, dict(metric._reductions), int(metric.update_count)
+
+    return _source
+
+
+def deferred_source(step: Any, states: Any) -> Source:
+    """Adapt a ``DeferredCollectionStep``: the leader-keyed
+    ``export_canonical`` fold flattened to ``"leader.field"`` keys (the fleet
+    protocol is flat). ``states`` is the live states pytree or a zero-arg
+    callable returning it (the double-buffered escape seam)."""
+
+    def _source() -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+        live = states() if callable(states) else states
+        canonical = step.export_canonical(live)
+        reductions = step.canonical_reductions()
+        flat: Dict[str, Any] = {}
+        reds: Dict[str, Any] = {}
+        for leader, sub in canonical.items():
+            for name, value in sub.items():
+                flat[f"{leader}.{name}"] = np.asarray(value)
+                reds[f"{leader}.{name}"] = reductions[leader].get(name)
+        return flat, reds, int(step.steps)
+
+    return _source
+
+
+class LeafExporter:
+    """One leaf's delta pipeline: fold → cut → outbox → (async) ship."""
+
+    def __init__(
+        self,
+        leaf: str,
+        source: Source,
+        uplink: Uplink,
+        parent: str,
+        interval_updates: int = 1,
+        precision: str = "exact",
+        bits: int = 8,
+        block_size: int = 256,
+        outbox_limit: int = DEFAULT_OUTBOX_LIMIT,
+        always_full: bool = False,
+    ) -> None:
+        if precision not in ("exact", "quantized"):
+            raise ValueError(f"precision must be 'exact' or 'quantized', got {precision!r}")
+        if interval_updates < 1:
+            raise ValueError(f"interval_updates must be >= 1, got {interval_updates}")
+        if outbox_limit < 1:
+            raise ValueError(f"outbox_limit must be >= 1, got {outbox_limit}")
+        self.leaf = leaf
+        self.parent = parent
+        self.precision = precision
+        self.bits = int(bits)
+        self.block_size = int(block_size)
+        self.interval_updates = int(interval_updates)
+        self.outbox_limit = int(outbox_limit)
+        self.always_full = bool(always_full)
+        self._source = source
+        self._uplink = uplink
+        self._lock = threading.RLock()
+        self._outbox: Dict[int, Delta] = {}
+        self._prev: Optional[Dict[str, Any]] = None
+        self._epoch = 0
+        self._need_full = True  # the first export is always a full install
+        self._updates_seen = 0
+        self._updates_at_export = 0
+        self._inflight: Optional[Any] = None  # MetricFuture of the async flush
+        self.stats = {
+            "exports": 0,
+            "full_exports": 0,
+            "acked_epoch": 0,
+            "durable_epoch": 0,
+            "resyncs_requested": 0,
+            "outbox_overflows": 0,
+        }
+
+    # ----------------------------------------------------------------- export
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def outbox_size(self) -> int:
+        with self._lock:
+            return len(self._outbox)
+
+    def mark_resync(self) -> None:
+        """Force the next export to be a ``kind="full"`` resync (call after a
+        metric reset or any out-of-band state replacement)."""
+        with self._lock:
+            self._need_full = True
+
+    def export(self) -> Delta:
+        """Cut the next epoch's delta from the source and park it in the
+        outbox (no transport). The host fold here IS the deliberate per-export
+        host copy — rows-sized for deltas, state-sized only on resyncs."""
+        from torchmetrics_tpu import obs  # deferred: fleet loads before obs in some paths
+        from torchmetrics_tpu.parallel.quantized import encode_canonical
+
+        state, reductions, update_count = self._source()
+        host = {k: np.asarray(v) for k, v in state.items()}
+        with self._lock:
+            self._epoch += 1
+            full = self.always_full or self._need_full or self._prev is None
+            payload_host = delta_since(host, None if full else self._prev, reductions)
+            if self.precision == "quantized":
+                wire = encode_canonical(payload_host, bits=self.bits, block_size=self.block_size)
+            else:
+                wire = encode_canonical(payload_host, qspecs={k: None for k in payload_host})
+            delta = Delta(
+                leaf=self.leaf,
+                epoch=self._epoch,
+                base_epoch=0 if full else self._epoch - 1,
+                kind="full" if full else "delta",
+                payload=wire,
+                reductions=dict(reductions),
+                update_count=int(update_count),
+                created_s=time.time(),
+                ctx=obs.capture_context(),
+            )
+            self._prev = host
+            self._need_full = False
+            self._updates_at_export = self._updates_seen
+            self._outbox[self._epoch] = delta
+            self.stats["exports"] += 1
+            if full:
+                self.stats["full_exports"] += 1
+            if len(self._outbox) > self.outbox_limit:
+                # the aggregator has missed more history than we keep: drop it
+                # all and resync — cheaper than shipping a long-dead backlog
+                self._outbox.clear()
+                self._need_full = True
+                self.stats["outbox_overflows"] += 1
+                obs.counter_inc("fleet.outbox_overflows")
+        obs.counter_inc("fleet.deltas_exported")
+        return delta
+
+    # ------------------------------------------------------------------ flush
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Ship the outbox in epoch order; returns the last ack (None when the
+        transport is down — the outbox is kept for the next flush)."""
+        with self._lock:
+            batch = [self._outbox[e] for e in sorted(self._outbox)]
+        ack: Optional[Dict[str, Any]] = None
+        for delta in batch:
+            got = self._uplink.send(self.parent, delta)
+            if got is None:
+                break  # transport down: later epochs would only buffer as reorders
+            ack = got
+            with self._lock:
+                self.stats["acked_epoch"] = max(self.stats["acked_epoch"], int(got["applied_epoch"]))
+                durable = int(got.get("durable_epoch", got["applied_epoch"]))
+                self.stats["durable_epoch"] = max(self.stats["durable_epoch"], durable)
+                for epoch in [e for e in self._outbox if e <= durable]:
+                    del self._outbox[epoch]
+                if got.get("needs_full"):
+                    # the ledger lost continuity (watermark gap, fresh
+                    # successor): everything un-acked is moot — resync
+                    self._outbox.clear()
+                    self._need_full = True
+                    self.stats["resyncs_requested"] += 1
+                    break
+        return ack
+
+    def ship(self, wait: bool = True) -> Optional[Any]:
+        """Export + flush. ``wait=False`` cuts the delta inline (one host
+        fold) and runs the transport on the async read pipeline — the PR 9
+        non-blocking contract; returns the in-flight ``MetricFuture``. Only
+        one flush is in flight at a time: while one is pending, new exports
+        just accumulate in the outbox it will ship."""
+        self.export()
+        if wait:
+            return self.flush()
+        from torchmetrics_tpu.ops.async_read import get_pipeline
+
+        with self._lock:
+            if self._inflight is not None and not self._inflight.done():
+                return self._inflight
+            self._inflight = get_pipeline().submit(self.flush, owner=f"fleet:{self.leaf}")
+            return self._inflight
+
+    def step(self, n: int = 1, wait: bool = True) -> Optional[Any]:
+        """Count source updates; export+ship every ``interval_updates``."""
+        self._updates_seen += int(n)
+        if self._updates_seen - self._updates_at_export >= self.interval_updates:
+            return self.ship(wait=wait)
+        return None
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until the in-flight async flush (if any) resolves."""
+        fut = self._inflight
+        if fut is None:
+            return True
+        fut.result(timeout=timeout)
+        return True

@@ -53,6 +53,8 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_COMPUTE_ASYNC,
     SPAN_DISPATCH,
     SPAN_EXPORT,
+    SPAN_FLEET_MERGE,
+    SPAN_FLEET_SHIP,
     SPAN_KERNEL,
     SPAN_LANES,
     SPAN_NAMES,

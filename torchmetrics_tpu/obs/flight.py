@@ -71,6 +71,7 @@ DOMAINS = (
     "checkpoint",  # snapshot save/restore/validate
     "reshard",     # elastic N->M re-splits
     "kernels",     # backend gate decisions (ops/kernels.py)
+    "fleet",       # cross-process delta uplinks: ship/merge/failover (fleet/)
 )
 
 #: canonical span name -> flight domain (consumed by obs/tracer.span on exit;
@@ -99,6 +100,8 @@ DOMAIN_OF_SPAN = {
     "tm_tpu.class_route": "reshard",
     "tm_tpu.shadow.refresh": "shadow",
     "tm_tpu.kernel": "kernels",
+    "tm_tpu.fleet.ship": "fleet",
+    "tm_tpu.fleet.merge": "fleet",
 }
 
 

@@ -87,6 +87,8 @@ SPAN_READ_RESOLVE = "tm_tpu.read.resolve"  # read-pipeline worker: the blocking 
 SPAN_SHADOW = "tm_tpu.shadow.refresh"      # shard-shadow refresh (submit half + worker half)
 SPAN_PACK = "tm_tpu.lanes.pack"            # ingest slab pack (staged worker half + inline half)
 SPAN_CLASS_ROUTE = "tm_tpu.class_route"    # class-axis shard routing (scatter) + read-point gather
+SPAN_FLEET_SHIP = "tm_tpu.fleet.ship"      # leaf exporter: fold-to-delta + uplink transmit (per leaf)
+SPAN_FLEET_MERGE = "tm_tpu.fleet.merge"    # aggregator: ledger apply + per-leaf accumulate (per leaf)
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -113,6 +115,8 @@ SPAN_NAMES = (
     SPAN_SHADOW,
     SPAN_PACK,
     SPAN_CLASS_ROUTE,
+    SPAN_FLEET_SHIP,
+    SPAN_FLEET_MERGE,
 )
 
 

@@ -2705,6 +2705,37 @@ class DeferredCollectionStep:
             out[leader] = host
         return out
 
+    def canonical_reductions(self) -> Dict[str, Dict[str, Any]]:
+        """Per-leader reduction maps for the ``export_canonical`` fold — the
+        companion a fleet exporter needs to cut per-field deltas and an
+        aggregator needs to ``merge_folded`` them (``fleet.deferred_source``
+        pairs the two)."""
+        return {
+            leader: dict(self._coll._modules[leader]._reductions)
+            for leader in self._coll._modules
+        }
+
+    def export_delta(self, states, baseline=None):
+        """Delta-since-baseline export for fleet uplinks: the canonical fold
+        (exact, host numpy) plus the per-leader/per-field payload of what
+        changed since ``baseline`` (a previous ``export_delta`` canonical).
+        ``baseline=None`` means everything is new — the payload IS the
+        canonical. Returns ``(canonical, payload)``; ship the payload, keep
+        the canonical as the next call's baseline. Wire-mode semantics
+        (suffix/add/replace/merge per reduction+dtype) live in
+        ``fleet.delta_since``; this is the executor-side seam so a deferred
+        collection can feed a :class:`~torchmetrics_tpu.fleet.LeafExporter`
+        without re-deriving its fold."""
+        from torchmetrics_tpu.fleet.delta import delta_since
+
+        canonical = self.export_canonical(states)
+        reductions = self.canonical_reductions()
+        payload: Dict[str, Dict[str, Any]] = {}
+        for leader, sub in canonical.items():
+            prev = baseline.get(leader) if baseline is not None else None
+            payload[leader] = delta_since(sub, prev, reductions[leader])
+        return canonical, payload
+
     def recover(self):
         """Reinstall the shadow's last completed refresh as the carried
         baseline and return fresh accumulators on this mesh — the

@@ -52,6 +52,7 @@ __all__ = [
     "STATE_SHARDINGS",
     "STATE_SHARDING_ENV",
     "ClassShardLayout",
+    "ClassShardMirror",
     "add_dense",
     "default_state_sharding",
     "default_class_shards",
@@ -255,3 +256,146 @@ def identity_pad_value(reduction: Reduction, dtype: Any) -> Any:
     later fold or merge over the stack cannot see the padding."""
     ident = reduction_identity(reduction, dtype)
     return 0 if ident is None else ident
+
+
+def _assemble_host(v: Any):
+    """Full host copy of a (possibly sharded) array, assembled shard by
+    shard. ``np.array`` on a class-sharded operand routes through a gathered
+    relayout (~3-4x slower than the raw copy on the CPU harness); writing
+    each addressable shard's local buffer into a preallocated host array is
+    a plain memcpy per shard. Deduped by shard index so replicated arrays
+    are copied once, with ``np.array`` as the fallback for anything not
+    fully addressable."""
+    import numpy as np
+
+    arr = jnp.asarray(v)
+    try:
+        if not arr.is_fully_addressable:
+            return np.array(arr)
+        shards = arr.addressable_shards
+    except (AttributeError, TypeError):
+        return np.array(arr)
+    if not shards or arr.ndim == 0:
+        return np.array(arr)
+    out = np.empty(arr.shape, np.dtype(arr.dtype))
+    seen = set()
+    for sh in shards:
+        key = tuple(
+            (s.start, s.stop, s.step) if isinstance(s, slice) else s for s in sh.index
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        out[sh.index] = np.asarray(sh.data)
+    return out
+
+
+class _ClassMirrorRecovery:
+    """Handle the executor holds across one donating class-sharded dispatch:
+    ``as_state()`` reinstalls the mirrored pre-call state if the dispatch
+    dies (mirroring ``quarantine._MirrorRecovery`` at cell granularity)."""
+
+    def __init__(self, mirror: "ClassShardMirror") -> None:
+        self._mirror = mirror
+
+    def as_state(self):
+        data = self._mirror._mirror or {}
+        out = {k: jnp.asarray(v) for k, v in data.items()}
+        # a restore means the dispatch died: the commit stream is no longer
+        # one-snapshot-per-commit, so the next snapshot must rebuild fully
+        self._mirror._count = None
+        self._mirror._pending = None
+        return out
+
+    def materialize(self):
+        """Detached host copy for the Autosaver recovery-reuse seam
+        (host-to-host memcpy, zero extra device sync); None when cold."""
+        data = self._mirror._mirror
+        if data is None:
+            return None
+        import numpy as np
+
+        return {k: np.array(v) for k, v in data.items()}
+
+
+class ClassShardMirror:
+    """Incremental host-side mirror of stacked class-sharded state, at CELL
+    granularity — the laned ``LaneStateMirror`` idea applied to the class
+    axis.
+
+    A 50k-class sharded confusion matrix is ~10 GB of stacked state; the
+    executor's classic recovery snapshot copied ALL of it to host before
+    every donating call. But one update round touches at most batch-size
+    distinct ``(target_class, pred_class)`` cells, so the mirror folds
+    forward only the flat cells the previous round touched (one rows-sized
+    device gather) and pays the full host copy only when the incremental
+    chain is provably broken: first use, a commit that bypassed the snapshot
+    hook (update-counter mismatch), or a layout change (shape/dtype
+    mismatch).
+
+    ``cells`` maps each state field to the FLAT element indices (into
+    ``state[field].reshape(-1)``) the about-to-run round will touch; the
+    metric derives them host-side from its update args
+    (``Metric._touched_class_cells``).
+    """
+
+    def __init__(self) -> None:
+        self._mirror = None  # field -> host np array, stacked shape
+        self._pending = None  # field -> flat np.int64 cell indices of the last round
+        self._count = None  # update_count at the last snapshot
+        self.stats = {"rebuilds": 0, "incremental": 0}
+
+    def invalidate(self) -> None:
+        self._mirror = None
+        self._pending = None
+        self._count = None
+
+    def _chain_intact(self, state, update_count: int) -> bool:
+        import numpy as np
+
+        if self._mirror is None or self._count is None:
+            return False
+        if update_count != self._count + 1:
+            return False  # a commit happened without a snapshot: mirror is stale
+        if set(self._mirror) != set(state):
+            return False
+        for k, v in state.items():
+            ref = self._mirror[k]
+            if tuple(ref.shape) != tuple(v.shape) or ref.dtype != np.dtype(v.dtype):
+                return False
+        return True
+
+    def snapshot(self, state, cells, update_count: int) -> _ClassMirrorRecovery:
+        """Bring the mirror up to the pre-dispatch state (folding in the
+        previous round's touched cells) and register this round's cells for
+        the next fold. The ``np.array``/``np.asarray`` here are THE
+        deliberate recovery host copies — cells-sized on the warm path,
+        state-sized only on a chain break."""
+        import numpy as np
+
+        if self._chain_intact(state, int(update_count)):
+            for k, pend in (self._pending or {}).items():
+                if pend.size:
+                    # gather via unraveled multi-dim indices: a flat
+                    # ``reshape(-1)`` on a class-sharded operand materializes
+                    # the whole re-laid-out state before the take (a full
+                    # cross-shard relayout per call); the multi-dim gather
+                    # stays cells-sized end to end
+                    arr = jnp.asarray(state[k])
+                    if arr.ndim == 0:
+                        self._mirror[k][...] = np.asarray(arr)
+                    else:
+                        multi = np.unravel_index(pend, arr.shape)
+                        vals = np.asarray(arr[tuple(jnp.asarray(ix) for ix in multi)])
+                        self._mirror[k].reshape(-1)[pend] = vals
+            self.stats["incremental"] += 1
+        else:
+            self._mirror = {k: _assemble_host(v) for k, v in state.items()}
+            self.stats["rebuilds"] += 1
+        pending = {}
+        for k, idx in cells.items():
+            flat = np.unique(np.asarray(idx).reshape(-1).astype(np.int64))
+            pending[k] = flat[(flat >= 0) & (flat < self._mirror[k].size)]
+        self._pending = pending
+        self._count = int(update_count)
+        return _ClassMirrorRecovery(self)

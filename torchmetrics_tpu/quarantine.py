@@ -68,11 +68,19 @@ class DegradedValue(NamedTuple):
     the updates offered to the owner since the value was captured (how stale
     it is); ``age_updates`` is the owner's update count AT capture (how much
     data the value reflects).
+
+    Fleet-scope degraded reads (``fleet/view.py``) additionally carry
+    ``coverage`` — the fraction of expected leaves folded into ``value`` —
+    and ``staleness`` — per-leaf version-counter anchors (applied epoch,
+    update count, quarantine flags). Both default to None for the original
+    single-process contract.
     """
 
     value: Any
     updates_behind: int
     age_updates: int
+    coverage: Optional[float] = None
+    staleness: Optional[Dict[str, Any]] = None
 
 
 def _encode_sid(sid: Any) -> List[Any]:

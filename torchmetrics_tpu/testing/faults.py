@@ -42,6 +42,15 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
   corrupt only that session's rows, or raise an attributed
   ``LaneFaultError`` inside the laned update path — the blast-radius
   primitives behind the per-tenant isolation chaos suite.
+- :func:`drop_delta` / :func:`duplicate_delta` / :func:`delay_delta` /
+  :func:`partition_leaf` — fleet-uplink faults at the ``Uplink.transmit``
+  delivery seam (docs/FLEET.md "Failure table"): lose the first n delivery
+  attempts from a leaf, deliver each of its deltas twice, hold one delta
+  back and inject it late (a genuine reorder at the ledger), or black-hole
+  the leaf entirely for a stretch of epochs — the primitives the
+  exactly-once convergence property is asserted against.
+- :func:`kill_aggregator` — take an aggregator node down (every receive
+  fails at the transport level) for the failover/restore chaos suite.
 
 All context managers restore the patched seam on exit, including when the
 body raises. They are process-local and NOT thread-safe (they patch module
@@ -679,3 +688,150 @@ def preempt_after(
         yield
     finally:
         detach()
+
+
+# -------------------------------------------------------------- fleet uplink
+
+@contextmanager
+def drop_delta(leaf: Any, n: int = 1) -> Generator[Dict[str, int], None, None]:
+    """Lose the first ``n`` delivery ATTEMPTS of ``leaf``'s deltas at the
+    ``Uplink.transmit`` seam (each retry consumes one — ``n`` larger than the
+    retry budget makes a whole ``send`` fail and the outbox retain). The
+    exactly-once ledger plus outbox re-ship must make the eventual delivery
+    converge bit-exact. Yields a counters dict (``dropped``)."""
+    from torchmetrics_tpu.fleet import transport as transport_mod
+
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    orig = transport_mod.Uplink.transmit
+    counters = {"dropped": 0}
+
+    def patched(self: Any, node_id: str, delta: Any) -> Any:
+        if delta.leaf == leaf and counters["dropped"] < n:
+            counters["dropped"] += 1
+            raise ConnectionError(f"injected drop of {leaf!r} epoch {delta.epoch}")
+        return orig(self, node_id, delta)
+
+    transport_mod.Uplink.transmit = patched
+    try:
+        yield counters
+    finally:
+        transport_mod.Uplink.transmit = orig
+
+
+@contextmanager
+def duplicate_delta(leaf: Any) -> Generator[Dict[str, int], None, None]:
+    """Deliver every one of ``leaf``'s deltas TWICE (the at-least-once
+    transport reality: an ack lost on the way back causes a re-send of an
+    already-applied epoch). The ledger must drop the duplicate idempotently —
+    same global value, ``duplicates`` stat incremented. Yields counters
+    (``duplicated``)."""
+    from torchmetrics_tpu.fleet import transport as transport_mod
+
+    orig = transport_mod.Uplink.transmit
+    counters = {"duplicated": 0}
+
+    def patched(self: Any, node_id: str, delta: Any) -> Any:
+        ack = orig(self, node_id, delta)
+        if delta.leaf == leaf:
+            counters["duplicated"] += 1
+            orig(self, node_id, delta)  # second delivery; its ack is discarded
+        return ack
+
+    transport_mod.Uplink.transmit = patched
+    try:
+        yield counters
+    finally:
+        transport_mod.Uplink.transmit = orig
+
+
+@contextmanager
+def delay_delta(leaf: Any, epochs: int = 2) -> Generator[Dict[str, Any], None, None]:
+    """Hold ``leaf``'s NEXT delta back and inject it only after ``epochs``
+    later deliveries from that leaf have gone through — a genuine reorder at
+    the ledger (the held epoch arrives after its successors, which must sit
+    in the pending buffer until the gap fills). The hold answers with a
+    synthetic ack (``durable_epoch=0`` so the outbox keeps everything) —
+    a transport that accepted the bytes but sat on them. Yields counters
+    (``held_epoch``, ``delivered_late``)."""
+    from torchmetrics_tpu.fleet import transport as transport_mod
+
+    if epochs < 1:
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
+    orig = transport_mod.Uplink.transmit
+    held: Dict[str, Any] = {"delta": None, "node": None, "later": 0}
+    counters: Dict[str, Any] = {"held_epoch": None, "delivered_late": False}
+
+    def synthetic(node_id: str, delta: Any) -> Dict[str, Any]:
+        return {
+            "leaf": delta.leaf,
+            "applied_epoch": delta.epoch,
+            "durable_epoch": 0,
+            "needs_full": False,
+            "node": node_id,
+        }
+
+    def patched(self: Any, node_id: str, delta: Any) -> Any:
+        if delta.leaf != leaf or counters["delivered_late"]:
+            return orig(self, node_id, delta)
+        if held["delta"] is None:
+            held["delta"], held["node"] = delta, node_id
+            counters["held_epoch"] = delta.epoch
+            return synthetic(node_id, delta)
+        if delta.epoch == held["delta"].epoch:
+            return synthetic(node_id, delta)  # re-ship of the held epoch: keep holding
+        ack = orig(self, node_id, delta)
+        held["later"] += 1
+        if held["later"] >= epochs:
+            orig(self, held["node"], held["delta"])  # the late, out-of-order arrival
+            counters["delivered_late"] = True
+        return ack
+
+    transport_mod.Uplink.transmit = patched
+    try:
+        yield counters
+    finally:
+        transport_mod.Uplink.transmit = orig
+
+
+@contextmanager
+def partition_leaf(leaf: Any, epochs: int = 3) -> Generator[Dict[str, Any], None, None]:
+    """Black-hole every delivery from ``leaf`` until ``epochs`` DISTINCT
+    epochs have attempted the uplink — the network-partition signature: the
+    leaf keeps exporting into its outbox (possibly tripping its breaker),
+    then rejoins and re-ships the backlog in order. Watermark-sized
+    partitions must converge by replay; longer ones via the quarantine →
+    ``needs_full`` resync path. Yields counters (``dropped_epochs``)."""
+    from torchmetrics_tpu.fleet import transport as transport_mod
+
+    if epochs < 1:
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
+    orig = transport_mod.Uplink.transmit
+    seen: set = set()
+    counters: Dict[str, Any] = {"dropped_epochs": seen}
+
+    def patched(self: Any, node_id: str, delta: Any) -> Any:
+        if delta.leaf == leaf and len(seen) < epochs:
+            seen.add(delta.epoch)
+            raise ConnectionError(f"injected partition of {leaf!r} (epoch {delta.epoch})")
+        return orig(self, node_id, delta)
+
+    transport_mod.Uplink.transmit = patched
+    try:
+        yield counters
+    finally:
+        transport_mod.Uplink.transmit = orig
+
+
+@contextmanager
+def kill_aggregator(aggregator: Any) -> Generator[None, None, None]:
+    """Take an aggregator node down for the duration of the context: every
+    ``receive`` raises ``ConnectionError`` (the transport-level failure the
+    uplink retries, breakers on, and outboxes absorb). Revives on exit —
+    pair with ``Aggregator.restore`` / ``Fleet.failover`` INSIDE the context
+    to drive the successor path instead."""
+    aggregator.kill()
+    try:
+        yield
+    finally:
+        aggregator.revive()

@@ -125,3 +125,23 @@ class DispatchStallError(TorchMetricsUserError, TimeoutError):
     def __init__(self, message: str, executor_status=None) -> None:
         super().__init__(message)
         self.executor_status = executor_status
+
+
+class FleetProtocolError(TorchMetricsUserError):
+    """A fleet delta-protocol invariant was violated (torchmetrics_tpu/fleet/).
+
+    Raised by the exactly-once uplink ledger and its neighbours when a delta
+    cannot be merged safely: a leaf's epoch sequence regressed below its own
+    base, a gap outlived the reorder watermark without a full resync, a delta's
+    reduction map disagrees with the ledger's accumulated state, or an
+    aggregator received traffic for a leaf its topology does not own. Carries
+    the attribution (``leaf``, ``epoch``, ``node``) so the containment policy
+    (quarantine the leaf + request a full resync — docs/FLEET.md "Failure
+    table") can act on the one offending uplink instead of the whole fleet.
+    """
+
+    def __init__(self, message: str, leaf=None, epoch=None, node=None) -> None:
+        super().__init__(message)
+        self.leaf = leaf
+        self.epoch = epoch
+        self.node = node
