@@ -2336,6 +2336,152 @@ def bench_config11():
     return out
 
 
+def bench_config12():
+    """Streaming windowed state (ISSUE 18): O(1) window advance on a ring
+    axis. Three gates: (1) advance-cost flatness — closing a window on a
+    1k-lane metric must cost the same at W=64 as at W=4 (the head is data,
+    the retiring slot is a masked reset; nothing scales with W), gated as
+    ``window_advance_flatness`` = advance(W=64)/advance(W=4) within 1.2×;
+    (2) ``windowed_read_ratio`` — a sliding read folding live ring slots vs
+    re-accumulating the window span from raw event history from scratch;
+    (3) the hard ``windowed_values_agree`` tripwire: windowed reads must be
+    BIT-EXACT vs from-scratch re-accumulation for sum/mean/max/min, plain
+    AND laned, including a late event admitted inside the watermark.
+    Host-CPU by design like configs 9/10/11 (the measured quantity is
+    dispatch cost, not device throughput); updates draw multiples of 1/8 so
+    fp32 sums are exact and the tripwire has no tolerance to hide behind."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu import LanedMetric
+    from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric, MinMetric, SumMetric
+
+    LANES = 1024
+    ROUNDS = 20
+
+    def _x(rng, n=1):
+        return (rng.randint(-50, 50, n) / 8.0).astype(np.float32)
+
+    # ---- advance-cost-vs-W flatness: one donated dispatch per close,
+    # whatever the window count
+    def advance_cost(W):
+        laned = LanedMetric(SumMetric(nan_strategy="disable").windowed(W), capacity=LANES)
+        rng = np.random.RandomState(W)
+        laned.update_sessions([(f"s{i}", jnp.asarray(_x(rng))) for i in range(8)])
+        laned.advance_windows()  # warm the advance executable
+
+        def block():
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS):
+                laned.advance_windows()
+            jax.block_until_ready(laned._state["window_head"])
+            return (time.perf_counter() - t0) / ROUNDS
+
+        return _stable_min(block, repeats=3)
+
+    adv = {W: advance_cost(W) for W in (4, 16, 64)}
+    out = {
+        "unit": "window advances/s, 1k lanes x W=64 ring (one donated dispatch per close)",
+        "vs_baseline": None,
+        "advance_us": {f"W{W}": round(1e6 * s, 1) for W, s in adv.items()},
+        "window_advance_flatness": round(adv[64] / adv[4], 3),
+        "value": round(1.0 / adv[64], 1),
+    }
+
+    # ---- windowed read vs from-scratch re-accumulation over the same span
+    W = 8
+    EVENTS_PER_WINDOW = 64
+    rng = np.random.RandomState(7)
+    history = []  # (window, values) — raw event log a naive impl would replay
+    wm = SumMetric(nan_strategy="disable").windowed(W)
+    for k in range(W):
+        vals = _x(rng, EVENTS_PER_WINDOW)
+        history.append(vals)
+        wm.update(jnp.asarray(vals))
+        if k < W - 1:
+            wm.advance()
+    float(wm.compute())  # warm the fold
+
+    def windowed_block():
+        t0 = time.perf_counter()
+        for _ in range(ROUNDS):
+            wm._computed = None  # defeat the compute cache: time the fold itself
+            v = wm.compute()
+        jax.block_until_ready(v)
+        return (time.perf_counter() - t0) / ROUNDS
+
+    def scratch_block():
+        t0 = time.perf_counter()
+        for _ in range(ROUNDS):
+            fresh = SumMetric(nan_strategy="disable")
+            for vals in history:  # replay the whole live span
+                fresh.update(jnp.asarray(vals))
+            v = fresh.compute()
+        jax.block_until_ready(v)
+        return (time.perf_counter() - t0) / ROUNDS
+
+    windowed_s = _stable_min(windowed_block, repeats=3)
+    scratch_s = _stable_min(scratch_block, repeats=3)
+    out["windowed_read_us"] = round(1e6 * windowed_s, 1)
+    out["from_scratch_read_us"] = round(1e6 * scratch_s, 1)
+    out["windowed_read_ratio"] = round(scratch_s / windowed_s, 2)
+
+    # ---- tripwire: windowed reads bit-exact vs from-scratch re-accumulation
+    # (plain + laned, four compiled families, late event inside watermark)
+    families = {
+        "sum": lambda: SumMetric(nan_strategy="disable"),
+        "mean": lambda: MeanMetric(nan_strategy="disable"),
+        "max": lambda: MaxMetric(nan_strategy="disable"),
+        "min": lambda: MinMetric(nan_strategy="disable"),
+    }
+    agree = True
+    rng = np.random.RandomState(11)
+    for name, mk in families.items():
+        # plain: W=4, 6 windows of traffic + one late event into the
+        # still-open previous window
+        wmf = mk().windowed(4, lateness=2)
+        log = {}
+        for k in range(6):
+            vals = _x(rng, 16)
+            log.setdefault(k, []).append(vals)
+            wmf.update(jnp.asarray(vals))
+            if k < 5:
+                wmf.advance()
+        late = _x(rng, 4)
+        log.setdefault(4, []).append(late)
+        assert wmf.update_window(4, jnp.asarray(late))
+        fresh = mk()
+        for k in sorted(log):
+            if k > 5 - 4:  # live ring: windows clock-W+1..clock
+                for vals in log[k]:
+                    fresh.update(jnp.asarray(vals))
+        agree = agree and np.array_equal(np.asarray(wmf.compute()), np.asarray(fresh.compute()))
+
+        # laned: two tenants, skewed traffic, late event via the router
+        laned = LanedMetric(mk().windowed(4, lateness=2), capacity=4)
+        llog = {"a": {}, "b": {}}
+        for k in range(3):
+            for sid in ("a", "b"):
+                vals = _x(rng, 8)
+                llog[sid].setdefault(k, []).append(vals)
+                laned.update_sessions({sid: jnp.asarray(vals)}, window=k)
+            laned.advance_windows()
+        late = _x(rng, 8)
+        llog["a"].setdefault(1, []).append(late)
+        laned.update_sessions({"a": jnp.asarray(late)}, window=1)
+        for sid in ("a", "b"):
+            fresh = mk()
+            for k in sorted(llog[sid]):
+                for vals in llog[sid][k]:
+                    fresh.update(jnp.asarray(vals))
+            agree = agree and np.array_equal(
+                np.asarray(laned.lane_values()[sid]), np.asarray(fresh.compute())
+            )
+    out["windowed_values_agree"] = bool(agree)
+    return out
+
+
 # ----------------------------------------------------------- sync latency
 def bench_sync_latency():
     """psum / all_gather latency vs state size on the 8-device mesh (µs/step)."""
@@ -2577,6 +2723,7 @@ def main() -> None:
         "9_session_lanes",
         "10_extreme_cardinality",
         "11_fleet_aggregation",
+        "12_streaming_windows",
     ):
         # virtual-mesh / dispatch-amortization configs are host-CPU by design
         # (see _run_in_cpu_subprocess) and run live everywhere; the subprocess
@@ -2621,6 +2768,7 @@ if __name__ == "__main__":
             "9_session_lanes": bench_config9,
             "10_extreme_cardinality": bench_config10,
             "11_fleet_aggregation": bench_config11,
+            "12_streaming_windows": bench_config12,
         }[sys.argv[2]]
         out = fn()
         if _TIMING_UNSTABLE:  # surface the stall signal across the process boundary

@@ -113,6 +113,71 @@ class TestCalibration(MetricTester):
             assert abs(ours - ref) < 1e-5
 
 
+class TestBinnedCalibration(MetricTester):
+    """ISSUE 18 satellite: the default ``formulation="binned"`` (three fixed
+    ``(n_bins,)`` sum states — the complete sufficient statistic) must agree
+    with the legacy ``formulation="samples"`` cat-buffer accumulation, since
+    both routes share ``_ce_update_binned``/``_ce_compute_binned``."""
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_binary_binned_matches_samples(self, norm):
+        from torchmetrics_tpu.classification import BinaryCalibrationError
+
+        binned = BinaryCalibrationError(norm=norm, validate_args=False)
+        samples = BinaryCalibrationError(norm=norm, formulation="samples", validate_args=False)
+        assert binned.formulation == "binned"
+        for i in range(NUM_BATCHES):
+            preds, target = jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i])
+            binned.update(preds, target)
+            samples.update(preds, target)
+        assert abs(float(binned.compute()) - float(samples.compute())) < 1e-6
+
+    @pytest.mark.parametrize("norm", ["l1", "max"])
+    def test_multiclass_binned_matches_samples(self, norm):
+        from torchmetrics_tpu.classification import MulticlassCalibrationError
+
+        logits = rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        binned = MulticlassCalibrationError(num_classes=NUM_CLASSES, norm=norm, validate_args=False)
+        samples = MulticlassCalibrationError(
+            num_classes=NUM_CLASSES, norm=norm, formulation="samples", validate_args=False
+        )
+        for i in range(NUM_BATCHES):
+            preds, target = jnp.asarray(probs[i]), jnp.asarray(MC_TARGET[i])
+            binned.update(preds, target)
+            samples.update(preds, target)
+        assert abs(float(binned.compute()) - float(samples.compute())) < 1e-6
+
+    def test_binned_state_is_fixed_shape_and_window_eligible(self):
+        from torchmetrics_tpu.classification import BinaryCalibrationError
+        from torchmetrics_tpu.windows import window_eligible
+
+        m = BinaryCalibrationError(n_bins=15, validate_args=False)
+        for name in ("bin_count", "bin_conf", "bin_acc"):
+            assert m._defaults[name].shape == (15,)
+            assert m._reductions[name] == "sum"
+        assert window_eligible(m._defaults, m._reductions)
+        # the legacy samples formulation keeps unbounded cat buffers
+        legacy = BinaryCalibrationError(formulation="samples", validate_args=False)
+        assert not window_eligible(legacy._defaults, legacy._reductions)
+
+    def test_windowed_calibration_rides_the_compiled_ring(self):
+        from torchmetrics_tpu.classification import BinaryCalibrationError
+
+        win = BinaryCalibrationError(validate_args=False).windowed(window=3)
+        assert win.window_spec()["compiled"] is True
+        win.update(jnp.asarray(BIN_PROBS[0]), jnp.asarray(BIN_TARGET[0]))
+        win.advance()
+        win.update(jnp.asarray(BIN_PROBS[1]), jnp.asarray(BIN_TARGET[1]))
+        ref = BinaryCalibrationError(validate_args=False)
+        ref.update(jnp.asarray(BIN_PROBS[0]), jnp.asarray(BIN_TARGET[0]))
+        ref.update(jnp.asarray(BIN_PROBS[1]), jnp.asarray(BIN_TARGET[1]))
+        assert abs(float(win.compute()) - float(ref.compute())) < 1e-6
+        ref1 = BinaryCalibrationError(validate_args=False)
+        ref1.update(jnp.asarray(BIN_PROBS[1]), jnp.asarray(BIN_TARGET[1]))
+        assert abs(float(win.compute_window(1)) - float(ref1.compute())) < 1e-6
+
+
 class TestHinge(MetricTester):
     def test_binary_probs(self):
         # probability inputs pass through unsquashed → same math as sklearn

@@ -519,3 +519,68 @@ def test_bench_regression_gate_class_sharded_rows():
     }
     violations, _ = checker.check_bench(good, baseline)
     assert not violations
+
+
+def test_window_eligibility_pin():
+    """ISSUE 18 satellite: only fixed-shape sum/mean/max/min states can carry
+    a compiled ring axis. The rule is load-bearing twice over — the O(1)
+    advance resets the retiring slot to the per-field identity, which only
+    exists for those families, and the sliding read folds live slots through
+    merge_folded's identity-masked segment fold (parallel/sync.py
+    fold_window_slots) — so the constant is pinned here; cat/list states
+    must take the eager per-window path with a warning, never a ring."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.parallel.sync import _VALID_REDUCTIONS
+    from torchmetrics_tpu.windows import WINDOW_ELIGIBLE_REDUCTIONS, window_eligible
+
+    assert WINDOW_ELIGIBLE_REDUCTIONS == ("sum", "mean", "max", "min")
+    assert set(WINDOW_ELIGIBLE_REDUCTIONS) == set(_VALID_REDUCTIONS) - {"cat"}
+
+    arr = jnp.zeros((4,), jnp.float32)
+    for fx in WINDOW_ELIGIBLE_REDUCTIONS:
+        assert window_eligible({"s": arr}, {"s": fx})
+    # cat buffers (list defaults) and unknown/callable reductions must demote
+    assert not window_eligible({"s": []}, {"s": "cat"})
+    assert not window_eligible({"s": arr}, {"s": None})
+    assert not window_eligible({"s": arr}, {"s": max})
+    # one ineligible state demotes the whole metric — windows are all-or-nothing
+    assert not window_eligible({"a": arr, "b": []}, {"a": "sum", "b": "cat"})
+
+
+def test_bench_regression_gate_streaming_window_rows():
+    """The ISSUE 18 gates fire: windowed_values_agree=false (windowed read vs
+    from-scratch re-accumulation) is a hard tripwire, the advance-cost
+    flatness is capped (W=64 close within 1.2x of W=4 — the O(1) contract),
+    and the windowed-read ratio has a baseline floor."""
+    checker = _load_tool("check_bench_regression")
+    baseline = json.loads((REPO / "BASELINE.json").read_text())
+    assert "12_streaming_windows" in baseline["bench_baselines"]
+    row = baseline["bench_baselines"]["12_streaming_windows"]
+    bad = {
+        "configs": {
+            "12_streaming_windows": {
+                "value": row["value"],
+                "window_advance_flatness": row["window_advance_flatness_max"] + 0.5,
+                "windowed_read_ratio": row["windowed_read_ratio_min"] - 0.5,
+                "windowed_values_agree": False,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(bad, baseline)
+    reasons = " ".join(v.detail for v in violations)
+    assert "window_advance_flatness" in reasons
+    assert "windowed_read_ratio" in reasons
+    assert "windowed_values_agree" in reasons
+    good = {
+        "configs": {
+            "12_streaming_windows": {
+                "value": row["value"],
+                "window_advance_flatness": 0.9,
+                "windowed_read_ratio": 2.0,
+                "windowed_values_agree": True,
+            }
+        }
+    }
+    violations, _ = checker.check_bench(good, baseline)
+    assert not violations

@@ -1,0 +1,416 @@
+"""Streaming windowed state acceptance battery (ISSUE 18, torchmetrics_tpu/windows.py).
+
+Covers the two halves of the O(1)-advance claim — zero recompiles as the
+head rotates (traced clock: one executable serves every window) and the
+retiring-slot scatter leaving every other slot untouched — plus the
+bit-exactness contract: windowed reads must equal from-scratch
+re-accumulation of exactly the live span for every compiled reduction
+family, in step AND deferred execution, plain AND laned, including late
+events admitted inside the watermark and a kill/restore mid-window.
+Watermark misses drop with a ``window_late_drop`` breadcrumb, cat/list
+states demote to the eager per-window path with a warning, and the
+checkpoint manifest carries the ring geometry.
+
+Values are integer-valued floats throughout the exactness tests, so sums
+are exact in f32 regardless of reduction order and "bit-exact" is
+meaningful across the vmapped / scanned execution shapes.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import (
+    LanedCollection,
+    LanedMetric,
+    MetricCollection,
+    TorchMetricsUserError,
+    WindowedMetric,
+    make_deferred_lane_step,
+    obs,
+)
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.io import restore_state, save_state
+from torchmetrics_tpu.testing.faults import late_event, skew_clock
+
+
+def _agg(cls, **kw):
+    return cls(nan_strategy="disable", **kw)
+
+
+FAMILIES = {
+    "sum": lambda: _agg(SumMetric),
+    "mean": lambda: _agg(MeanMetric),
+    "max": lambda: _agg(MaxMetric),
+    "min": lambda: _agg(MinMetric),
+}
+
+
+def _rows(rng, n=4):
+    return jnp.asarray(rng.randint(-20, 20, n).astype(np.float32))
+
+
+def _fresh_replay(family, batches):
+    """From-scratch re-accumulation: one fresh metric fed the span's batches."""
+    m = FAMILIES[family]()
+    for b in batches:
+        m.update(b)
+    return np.asarray(m.compute())
+
+
+# ------------------------------------------------------------------ the ring
+
+
+class TestRing:
+    def test_sliding_and_per_window_reads(self):
+        win = _agg(SumMetric).windowed(window=4)
+        win.update(jnp.asarray([1.0, 2.0]))
+        assert win.advance() == 1
+        win.update(jnp.asarray([10.0]))
+        assert float(win.compute()) == 13.0
+        assert float(win.compute_window(0)) == 3.0
+        assert float(win.compute_window(1)) == 10.0
+
+    def test_retiring_slot_reset_is_surgical(self):
+        """Advancing past W slots ages the oldest window out of the sliding
+        aggregate while every other live slot keeps its exact value."""
+        win = _agg(SumMetric).windowed(window=3)
+        for k in range(3):
+            win.update(jnp.asarray([float(10 ** k)]))
+            if k < 2:
+                win.advance()
+        assert float(win.compute()) == 111.0
+        win.advance()  # clock 3: slot of window 0 retires
+        assert float(win.compute()) == 110.0
+        assert float(win.compute_window(1)) == 10.0
+        assert float(win.compute_window(2)) == 100.0
+
+    def test_window_spec_reports_geometry(self):
+        win = _agg(MeanMetric).windowed(window=8, lateness=2)
+        win.advance(3)
+        spec = win.window_spec()
+        assert spec["window"] == 8 and spec["lateness"] == 2
+        assert spec["clock"] == 3 and spec["compiled"] is True
+
+    def test_cat_state_demotes_to_eager_with_warning(self):
+        with pytest.warns(UserWarning, match="eager"):
+            win = _agg(CatMetric).windowed(window=3)
+        assert win.window_spec()["compiled"] is False
+        win.update(jnp.asarray([1.0, 2.0]))
+        win.advance()
+        win.update(jnp.asarray([5.0]))
+        np.testing.assert_array_equal(np.asarray(win.compute()), [1.0, 2.0, 5.0])
+        np.testing.assert_array_equal(np.asarray(win.compute_window(1)), [5.0])
+
+    def test_invalid_lateness_rejected(self):
+        with pytest.raises(ValueError):
+            _agg(SumMetric).windowed(window=4, lateness=4)
+
+
+# ------------------------------------------------- O(1): zero recompiles
+
+
+class TestZeroRecompile:
+    def test_plain_updates_share_one_executable_across_heads(self):
+        """The head is traced data: updates land in 6 different windows
+        through ONE compiled executable (compile-count assertion — the other
+        half of the O(1)-advance proof next to config 12's flatness gate)."""
+        win = _agg(SumMetric).windowed(window=4)
+        rng = np.random.RandomState(0)
+        win.update(_rows(rng))
+        stats0 = win.executor_status["stats"]
+        compiles0 = stats0["compiles"]
+        for _ in range(6):
+            win.advance()
+            win.update(_rows(rng))
+        stats = win.executor_status["stats"]
+        assert stats["compiles"] == compiles0, "head advance must not retrace"
+        assert stats["calls"] == stats0["calls"] + 6
+
+    def test_advance_itself_is_one_cached_executable(self):
+        """advance() jit-caches one body per donation flavor; rotating the
+        head through 3x the ring length never traces a second executable."""
+        win = _agg(SumMetric).windowed(window=4)
+        win.update(jnp.asarray([1.0]))
+        win.advance()  # builds the (donating) advance fn
+        fns = list(win.__dict__["_advance_fns"].values())
+        assert len(fns) == 1
+        win.advance(11)
+        assert list(win.__dict__["_advance_fns"].values()) == fns
+        assert fns[0]._cache_size() == 1  # one trace total, any head value
+
+    def test_laned_routing_never_retraces_as_heads_advance(self):
+        """Head-slot routing and explicit-window routing are two executables
+        (different traced signatures) — and exactly two, whatever the head
+        value or the late window index: the clock is data, not structure."""
+        laned = LanedMetric(_agg(SumMetric).windowed(4, lateness=2), capacity=8)
+        rng = np.random.RandomState(1)
+        laned.update_sessions([("a", (_rows(rng),))])
+        laned.advance_windows()
+        laned.update_sessions([("a", (_rows(rng),))], window=0)
+        compiles0 = laned.executor_status["stats"]["compiles"]
+        for k in range(1, 4):
+            laned.advance_windows()
+            laned.update_sessions([("a", (_rows(rng),))])
+            # late round for the window that just closed: same executable
+            laned.update_sessions([("a", (_rows(rng),))], window=k)
+        assert laned.executor_status["stats"]["compiles"] == compiles0
+
+
+# ------------------------------------------------ exactness: plain rings
+
+
+class TestPlainParity:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_sliding_read_matches_from_scratch(self, family):
+        """6 windows through a W=4 ring + one in-watermark late admit: the
+        sliding aggregate equals a fresh metric replaying exactly the live
+        span's batches."""
+        rng = np.random.RandomState(7)
+        win = FAMILIES[family]().windowed(window=4, lateness=2)
+        history = {}
+        for k in range(6):
+            b = _rows(rng)
+            history[k] = [b]
+            win.update(b)
+            if k < 5:
+                win.advance()
+        late = _rows(rng)
+        assert win.update_window(4, late)  # age 1, inside the watermark
+        history[4].append(late)
+        live = [b for k in range(2, 6) for b in history[k]]  # clock 5, W=4
+        got = np.asarray(win.compute())
+        np.testing.assert_array_equal(got, _fresh_replay(family, live))
+        for k in range(2, 6):
+            np.testing.assert_array_equal(
+                np.asarray(win.compute_window(k)), _fresh_replay(family, history[k])
+            )
+
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_kill_restore_mid_window_resumes_exactly(self, family, tmp_path):
+        """Pickle-kill the process mid-window: the restored ring serves the
+        same sliding read, keeps the same open window, and the same horizon."""
+        rng = np.random.RandomState(13)
+        win = FAMILIES[family]().windowed(window=4, lateness=1)
+        history = {}
+        for k in range(3):
+            b = _rows(rng)
+            history[k] = [b]
+            win.update(b)
+            if k < 2:
+                win.advance()
+        blob = pickle.dumps(win)
+        del win
+        back = pickle.loads(blob)
+        assert back.window_spec()["clock"] == 2
+        cont = _rows(rng)
+        back.update(cont)  # still window 2 — the one open at the kill
+        history[2].append(cont)
+        late = _rows(rng)
+        assert back.update_window(1, late)
+        history[1].append(late)
+        live = [b for k in range(3) for b in history[k]]
+        np.testing.assert_array_equal(np.asarray(back.compute()), _fresh_replay(family, live))
+
+    def test_save_restore_roundtrip_and_manifest(self, tmp_path):
+        win = _agg(SumMetric).windowed(window=4, lateness=1)
+        win.update(jnp.asarray([3.0]))
+        win.advance()
+        win.update(jnp.asarray([4.0]))
+        path = save_state(win, str(tmp_path / "snap"))
+        from torchmetrics_tpu.io import load_manifest
+
+        manifest = load_manifest(path)
+        assert manifest["windows"] == {
+            "window": 4,
+            "lateness": 1,
+            "clock": 1,
+            "head": 1,
+            "compiled": True,
+        }
+        fresh = _agg(SumMetric).windowed(window=4, lateness=1)
+        restore_state(path, fresh)
+        assert float(fresh.compute()) == 7.0
+        assert float(fresh.compute_window(0)) == 3.0
+        assert fresh.window_spec()["clock"] == 1
+
+    def test_past_watermark_drops_with_breadcrumb(self):
+        win = _agg(SumMetric).windowed(window=4, lateness=1)
+        win.update(jnp.asarray([1.0]))
+        win.advance(3)  # clock 3: window 0 is past the lateness bound
+        drops0 = obs.telemetry_snapshot()["counters"].get("windows.dropped_late", 0)
+        assert win.update_window(0, jnp.asarray([99.0])) is False
+        # W=4 at clock 3: window 0's slot is still live in the ring — the
+        # dropped event must not have touched it
+        assert float(win.compute_window(0)) == 1.0
+        counters = obs.telemetry_snapshot()["counters"]
+        assert counters.get("windows.dropped_late", 0) == drops0 + 1
+        crumbs = [
+            c for c in obs.dump_diagnostics()["breadcrumbs"] if c["kind"] == "window_late_drop"
+        ]
+        assert crumbs and crumbs[-1]["data"]["window"] == 0
+
+    def test_future_window_rejected(self):
+        win = _agg(SumMetric).windowed(window=4)
+        with pytest.raises(TorchMetricsUserError):
+            win.update_window(2, jnp.asarray([1.0]))
+
+
+# ------------------------------------------------ exactness: laned rings
+
+
+class TestLanedParity:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_per_tenant_windows_match_from_scratch(self, family):
+        """Two tenants, 4 windows, a fleet-wide advance cadence plus one
+        per-lane skew and one in-watermark late round: every tenant's sliding
+        value equals a fresh replay of its own live span."""
+        rng = np.random.RandomState(21)
+        laned = LanedMetric(FAMILIES[family]().windowed(4, lateness=2), capacity=8)
+        history = {"a": {}, "b": {}}
+        for k in range(4):
+            for sid in ("a", "b"):
+                b = _rows(rng)
+                history[sid].setdefault(k, []).append(b)
+                laned.update_sessions([(sid, (b,))])
+            if k < 3:
+                laned.advance_windows()
+        late = _rows(rng)
+        assert laned.update_sessions([("a", (late,))], window=2) == 1
+        history["a"][2].append(late)
+        vals = laned.lane_values()
+        for sid in ("a", "b"):
+            live = [b for k in range(4) for b in history[sid][k]]
+            np.testing.assert_array_equal(np.asarray(vals[sid]), _fresh_replay(family, live))
+
+    def test_skewed_clock_ages_one_tenant_only(self):
+        """advance_lane_windows desynchronizes one tenant: its ring retires
+        old windows while the other tenant's aggregate is untouched."""
+        laned = LanedMetric(_agg(SumMetric).windowed(window=2), capacity=8)
+        for sid, v in (("a", 1.0), ("b", 100.0)):
+            laned.update_sessions([(sid, (jnp.asarray([v]),))])
+        laned.advance_lane_windows(laned.sessions["a"], 2)  # a's window 0 retires
+        vals = laned.lane_values()
+        assert float(vals["a"]) == 0.0 and float(vals["b"]) == 100.0
+        clocks = laned._window_clocks()
+        assert clocks[laned.sessions["a"]] == 2 and clocks[laned.sessions["b"]] == 0
+
+    def test_watermark_drop_is_per_session(self):
+        laned = LanedMetric(_agg(SumMetric).windowed(4, lateness=1), capacity=8)
+        laned.update_sessions([("a", (jnp.asarray([5.0]),))])
+        laned.advance_windows(3)
+        drops0 = obs.telemetry_snapshot()["counters"].get("windows.dropped_late", 0)
+        # window 0 is past the bound: the round is dropped, not dispatched
+        assert laned.update_sessions([("a", (jnp.asarray([9.0]),))], window=0) == 0
+        assert obs.telemetry_snapshot()["counters"]["windows.dropped_late"] == drops0 + 1
+        # the dropped 9.0 never landed: only the original 5.0 (whose W=4 slot
+        # is still live at clock 3) shows in the sliding value
+        assert float(laned.lane_values()["a"]) == 5.0
+
+    def test_kill_restore_mid_window_laned(self, tmp_path):
+        rng = np.random.RandomState(3)
+        laned = LanedMetric(_agg(SumMetric).windowed(4, lateness=1), capacity=8)
+        total = {"a": 0.0, "b": 0.0}
+        for k in range(2):
+            for sid in ("a", "b"):
+                b = _rows(rng)
+                total[sid] += float(np.sum(np.asarray(b)))
+                laned.update_sessions([(sid, (b,))])
+            if k < 1:
+                laned.advance_windows()
+        path = save_state(laned, str(tmp_path / "snap"))
+        fresh = LanedMetric(_agg(SumMetric).windowed(4, lateness=1), capacity=8)
+        restore_state(path, fresh)
+        spec = fresh.window_spec()
+        assert spec["clock"] == 1 and spec["window"] == 4
+        cont = jnp.asarray([7.0])
+        fresh.update_sessions([("a", (cont,))])  # lands in the restored open window
+        vals = fresh.lane_values()
+        assert float(vals["a"]) == total["a"] + 7.0
+        assert float(vals["b"]) == total["b"]
+
+    def test_laned_collection_lockstep(self):
+        coll = MetricCollection({"s": _agg(SumMetric), "m": _agg(MeanMetric)})
+        lc = LanedCollection(coll.windowed(window=3, lateness=1), capacity=8)
+        lc.update_sessions([("t", (jnp.asarray([2.0, 4.0]),))])
+        lc.advance_windows()
+        lc.update_sessions([("t", (jnp.asarray([10.0]),))])
+        late = lc.update_sessions([("t", (jnp.asarray([6.0]),))], window=0)
+        assert late == 1
+        vals = lc.lane_values()["t"]
+        assert float(vals["s"]) == 22.0
+        assert float(vals["m"]) == 5.5
+        assert lc.window_spec()["clock"] == 1
+
+
+# --------------------------------------------- exactness: deferred shards
+
+
+class TestDeferredParity:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_windowed_deferred_matches_from_scratch(self, family, mesh):
+        """The ring inside the shard: head-slot + explicit-window routing and
+        the functional advance land the same per-tenant values as fresh
+        replays, through the single deferred reduce."""
+        rng = np.random.RandomState(17)
+        laned = LanedMetric(
+            FAMILIES[family]().windowed(4, lateness=2), capacity=8, reduce="deferred"
+        )
+        sessions = ["a", "b"]
+        for s in sessions:
+            laned.admit(s)
+        step = make_deferred_lane_step(laned, mesh)
+        states = step.init_states()
+        history = {s: {} for s in sessions}
+        rows = 8
+        for k in range(3):
+            lane_ids, leaves = [], []
+            for i in range(rows):
+                sid = sessions[i % 2] if i < 2 * (rows // 2) else None
+                b = _rows(rng, n=2)
+                lane_ids.append(laned.sessions[sid] if sid else laned.capacity)
+                if sid:
+                    history[sid].setdefault(k, []).append(b)
+                leaves.append(b)
+            stacked = jnp.stack(leaves)
+            states = step.local_step(states, jnp.asarray(lane_ids, jnp.int32), stacked)
+            if k < 2:
+                states = step.advance_windows(states)
+        # late rows into window 1 (age 1, inside the watermark)
+        late = _rows(rng, n=2)
+        ids = [laned.sessions["a"]] + [laned.capacity] * (rows - 1)
+        stacked = jnp.stack([late] + [jnp.zeros_like(late)] * (rows - 1))
+        states = step.local_step(
+            states, jnp.asarray(ids, jnp.int32), stacked, window=jnp.asarray(1, jnp.int32)
+        )
+        history["a"][1].append(late)
+        step.install_reduced(step.reduce(states))
+        vals = laned.lane_values()
+        for s in sessions:
+            live = [b for k in sorted(history[s]) for b in history[s][k]]
+            np.testing.assert_array_equal(np.asarray(vals[s]), _fresh_replay(family, live))
+
+
+# --------------------------------------------------------- fault injectors
+
+
+class TestInjectors:
+    def test_skew_clock_is_real_ring_state(self):
+        laned = LanedMetric(_agg(SumMetric).windowed(window=3), capacity=8)
+        laned.update_sessions([("a", (jnp.asarray([4.0]),))])
+        lane = laned.sessions["a"]
+        assert skew_clock(laned, lane, by=2) == 2
+        assert laned._window_clocks()[lane] == 2
+        assert float(laned.lane_values()["a"]) == 4.0  # W=3: window 0 still live
+
+    def test_late_event_admit_and_drop(self):
+        laned = LanedMetric(_agg(SumMetric).windowed(4, lateness=1), capacity=8)
+        laned.update_sessions([("a", (jnp.asarray([1.0]),))])
+        laned.advance_windows()
+        assert late_event(laned, "a", (jnp.asarray([10.0]),), age=1) == 1
+        assert float(laned.lane_values()["a"]) == 11.0
+        laned.advance_windows(2)  # clock 3: age-3 target is past the bound
+        assert late_event(laned, "a", (jnp.asarray([99.0]),), age=3) == 0
+        assert float(laned.lane_values()["a"]) == 11.0

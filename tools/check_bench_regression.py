@@ -384,6 +384,58 @@ def check_bench(
                         " wire on uplink bytes (docs/FLEET.md 'The delta protocol')",
                     )
                 )
+        # streaming-window gates (ISSUE 18): (a) advance-cost flatness — a
+        # W=64 ring close must cost within the cap of a W=4 close (the whole
+        # point of the head-rotate + retiring-slot scatter is that nothing
+        # scales with W; cap from BASELINE.json window_advance_flatness_max,
+        # default 1.2), (b) the windowed-read ratio vs from-scratch
+        # re-accumulation (floor windowed_read_ratio_min), and (c) the hard
+        # windowed_values_agree tripwire — a windowed read that diverges
+        # from from-scratch re-accumulation breaks the bit-exactness
+        # contract and fails outright (docs/STREAMING.md)
+        wflat = result.get("window_advance_flatness")
+        if isinstance(wflat, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("window_advance_flatness_max", 1.2) if isinstance(base, dict) else 1.2
+            if float(wflat) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        float(wflat),
+                        threshold,
+                        f"window_advance_flatness {wflat:.3f} above the {cap} cap — window"
+                        " advance cost is scaling with W again; the O(1) ring close"
+                        " regressed (docs/STREAMING.md 'The ring')",
+                    )
+                )
+        wratio = result.get("windowed_read_ratio")
+        if isinstance(wratio, (int, float)):
+            base = baselines.get(name, {})
+            floor = base.get("windowed_read_ratio_min", 1.0) if isinstance(base, dict) else 1.0
+            if float(wratio) < float(floor):
+                violations.append(
+                    Violation(
+                        name,
+                        float(wratio),
+                        threshold,
+                        f"windowed_read_ratio {wratio:.2f} below the {floor} floor — the"
+                        " sliding ring fold is slower than re-accumulating the window"
+                        " span from scratch, so the windowed state buys nothing",
+                    )
+                )
+        wagree = result.get("windowed_values_agree")
+        if wagree is False:
+            violations.append(
+                Violation(
+                    name,
+                    None,
+                    threshold,
+                    "windowed_values_agree is false — a windowed read diverged from"
+                    " from-scratch re-accumulation of the same span (or a watermark"
+                    " admit/drop went to the wrong slot); bit-exactness is the"
+                    " contract, fail outright (docs/STREAMING.md 'Exactness')",
+                )
+            )
         ratio = effective_ratio(name, result, baselines)
         if ratio is None or ratio >= threshold:
             continue

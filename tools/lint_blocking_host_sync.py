@@ -39,6 +39,7 @@ HOT_PATH_FILES = (
     "collections.py",
     "lanes.py",
     "quarantine.py",
+    "windows.py",
     "ops/executor.py",
     "ops/compile_cache.py",
     "ops/async_read.py",
@@ -231,6 +232,27 @@ ALLOWLIST = {
     "lanes.py::_recovery_snapshot": (
         "recovery hook fallback: a tiny host fetch of the lane-id leaf when a"
         " low-level update() bypassed the router (the router path is free)"
+    ),
+    "lanes.py::_window_clocks": (
+        "lazy window-clock mirror init: ONE scalar-per-lane fetch the first"
+        " time watermark admission runs after construction/restore; every"
+        " advance after that bumps the cached host mirror (docs/STREAMING.md"
+        " 'Watermarks are host arithmetic')"
+    ),
+    # --- windowed state (docs/STREAMING.md): the warm path — update routing
+    #     to the head slot and the O(1) advance scatter — never crosses to
+    #     host; the entries below are the restore/manifest seams only
+    "windows.py::_decode_json_blob": (
+        "checkpoint-restore path: decoding the persisted eager-window JSON"
+        " blob back to host dicts (restored payload, not live device state)"
+    ),
+    "windows.py::load_state": (
+        "restore path: reading the restored window_head scalar once to"
+        " re-seed the host clock mirror and close-time horizon"
+    ),
+    "windows.py::_load_state_eager": (
+        "restore path: unpacking per-window eager list counts from the"
+        " restored host payload"
     ),
     # --- pipelined lane ingest (docs/LANES.md "Ingest pipeline"): the pack
     #     WORKER is the one sanctioned place the ingest path blocks; the

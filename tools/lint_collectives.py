@@ -49,8 +49,11 @@ ALLOWLIST: dict = {}
 #: contract, relative to the package root: class-axis routing
 #: (parallel/class_shard.py) runs inside shard_map'd update bodies and
 #: promises zero collectives until the read point (docs/SHARDING.md
-#: "Class-axis state sharding"), so the whole module is scanned
-EXTRA_SCOPE_FILES = ("parallel/class_shard.py",)
+#: "Class-axis state sharding"), so the whole module is scanned; windows.py
+#: routes every update into a ring slot and advances heads with a local
+#: scatter — both run under shard_map on sharded state and must stay
+#: collective-free until compute's fold (docs/STREAMING.md "The ring")
+EXTRA_SCOPE_FILES = ("parallel/class_shard.py", "windows.py")
 
 
 class Violation(NamedTuple):

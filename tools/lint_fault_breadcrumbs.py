@@ -55,6 +55,7 @@ COVERED_MODULES = (
     "collections.py",
     "lanes.py",
     "quarantine.py",
+    "windows.py",
     "ops/executor.py",
     "ops/compile_cache.py",
     "ops/async_read.py",

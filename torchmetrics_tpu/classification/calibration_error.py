@@ -11,6 +11,8 @@ from torchmetrics_tpu.functional.classification.calibration_error import (
     _binary_calibration_error_arg_validation,
     _binary_calibration_error_update,
     _ce_compute,
+    _ce_compute_binned,
+    _ce_update_binned,
 )
 from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_format,
@@ -23,8 +25,42 @@ from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
+def _add_calibration_state(metric: Metric, formulation: str, n_bins: int) -> None:
+    """Install calibration state per formulation.
+
+    ``"binned"`` (default): three fixed ``(n_bins,)`` sum states — the exact
+    sufficient statistic of fixed-bin ECE/MCE. Constant memory, additive
+    across updates/lanes/shards, and window-eligible (fixed-shape "sum"
+    family — docs/STREAMING.md), which is what million-bucket calibration
+    deployments need. ``"samples"``: the reference's growing cat buffers,
+    kept for parity testing and exotic post-hoc re-binning.
+    """
+    if formulation == "binned":
+        zeros = jnp.zeros((n_bins,), dtype=jnp.float32)
+        # the bucket axis is a histogram axis, not a class axis; pinned
+        # replicated so a process-wide class_axis default cannot drift it
+        metric.add_state("bin_count", zeros, dist_reduce_fx="sum", state_sharding="replicated")
+        metric.add_state("bin_conf", zeros, dist_reduce_fx="sum", state_sharding="replicated")
+        metric.add_state("bin_acc", zeros, dist_reduce_fx="sum", state_sharding="replicated")
+    elif formulation == "samples":
+        # growing "cat" sample lists are ineligible for class-axis sharding
+        # (no class axis to partition); pinned replicated so a process-wide
+        # TORCHMETRICS_TPU_STATE_SHARDING=class_axis default cannot drift them
+        metric.add_state("confidences", [], dist_reduce_fx="cat", state_sharding="replicated")
+        metric.add_state("accuracies", [], dist_reduce_fx="cat", state_sharding="replicated")
+    else:
+        raise ValueError(f"Argument `formulation` is expected to be 'binned' or 'samples' but got {formulation}")
+
+
 class BinaryCalibrationError(Metric):
     """Binary Calibration Error (modular interface, accumulating across updates).
+
+    State is a fixed-bucket binned histogram by default (``formulation=
+    "binned"``): per-bin ``(count, conf_sum, acc_sum)`` sums, constant
+    memory however many samples stream through, identical to the sample
+    buffer's result up to float summation order (both bin through the same
+    ``_ce_update_binned``). ``formulation="samples"`` restores the growing
+    cat buffers.
 
     Example:
         >>> from torchmetrics_tpu.classification import BinaryCalibrationError
@@ -49,6 +85,7 @@ class BinaryCalibrationError(Metric):
         norm: str = "l1",
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        formulation: str = "binned",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -58,11 +95,8 @@ class BinaryCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        # growing "cat" sample lists are ineligible for class-axis sharding
-        # (no class axis to partition); pinned replicated so a process-wide
-        # TORCHMETRICS_TPU_STATE_SHARDING=class_axis default cannot drift them
-        self.add_state("confidences", [], dist_reduce_fx="cat", state_sharding="replicated")
-        self.add_state("accuracies", [], dist_reduce_fx="cat", state_sharding="replicated")
+        self.formulation = formulation
+        _add_calibration_state(self, formulation, n_bins)
 
     def update(self, preds: Array, target: Array) -> None:
         import numpy as np
@@ -78,10 +112,18 @@ class BinaryCalibrationError(Metric):
             jnp.asarray(np.asarray(target)[keep]),
             jnp.ones(int(keep.sum()), dtype=bool),
         )
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        if self.formulation == "binned":
+            count, conf, acc = _ce_update_binned(confidences, accuracies, self.n_bins)
+            self.bin_count = self.bin_count + count
+            self.bin_conf = self.bin_conf + conf
+            self.bin_acc = self.bin_acc + acc
+        else:
+            self.confidences.append(confidences)
+            self.accuracies.append(accuracies)
 
     def compute(self) -> Array:
+        if self.formulation == "binned":
+            return _ce_compute_binned(self.bin_count, self.bin_conf, self.bin_acc, self.norm)
         return _ce_compute(dim_zero_cat(self.confidences), dim_zero_cat(self.accuracies), self.n_bins, self.norm)
 
 
@@ -112,6 +154,7 @@ class MulticlassCalibrationError(Metric):
         norm: str = "l1",
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        formulation: str = "binned",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -122,11 +165,8 @@ class MulticlassCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        # growing "cat" sample lists are ineligible for class-axis sharding
-        # (no class axis to partition); pinned replicated so a process-wide
-        # TORCHMETRICS_TPU_STATE_SHARDING=class_axis default cannot drift them
-        self.add_state("confidences", [], dist_reduce_fx="cat", state_sharding="replicated")
-        self.add_state("accuracies", [], dist_reduce_fx="cat", state_sharding="replicated")
+        self.formulation = formulation
+        _add_calibration_state(self, formulation, n_bins)
 
     def update(self, preds: Array, target: Array) -> None:
         import numpy as np
@@ -140,10 +180,20 @@ class MulticlassCalibrationError(Metric):
             keep = np.asarray(target != self.ignore_index)
             preds = jnp.asarray(np.asarray(preds)[keep])
             target = jnp.asarray(np.asarray(target)[keep])
-        self.confidences.append(preds.max(-1))
-        self.accuracies.append(preds.argmax(-1) == target)
+        confidences = preds.max(-1)
+        accuracies = preds.argmax(-1) == target
+        if self.formulation == "binned":
+            count, conf, acc = _ce_update_binned(confidences, accuracies, self.n_bins)
+            self.bin_count = self.bin_count + count
+            self.bin_conf = self.bin_conf + conf
+            self.bin_acc = self.bin_acc + acc
+        else:
+            self.confidences.append(confidences)
+            self.accuracies.append(accuracies)
 
     def compute(self) -> Array:
+        if self.formulation == "binned":
+            return _ce_compute_binned(self.bin_count, self.bin_conf, self.bin_acc, self.norm)
         return _ce_compute(dim_zero_cat(self.confidences), dim_zero_cat(self.accuracies), self.n_bins, self.norm)
 
 

@@ -990,6 +990,15 @@ class MetricCollection:
 
         return LanedCollection(self, capacity=capacity, max_capacity=max_capacity, **kwargs)
 
+    def windowed(self, window: int = 8, lateness: int = 0, **kwargs: Any) -> Any:
+        """A :class:`~torchmetrics_tpu.windows.WindowedCollection` stacking W
+        per-window copies of every member's state on a ring axis — the whole
+        suite advances its tumbling/sliding windows in O(1) per close
+        (docs/STREAMING.md)."""
+        from torchmetrics_tpu.windows import WindowedCollection
+
+        return WindowedCollection(self, window=window, lateness=lateness, **kwargs)
+
     @property
     def compute_groups(self) -> Dict[int, List[str]]:
         return self._groups

@@ -20,11 +20,15 @@ from torchmetrics_tpu.utils.compute import _safe_divide
 from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
-def _binning_bucketize(
-    confidences: Array, accuracies: Array, bin_boundaries_or_n: int
-) -> Tuple[Array, Array, Array]:
-    """Per-bin mean confidence, mean accuracy and proportion (reference :36-60)."""
-    n_bins = bin_boundaries_or_n
+def _ce_update_binned(confidences: Array, accuracies: Array, n_bins: int) -> Tuple[Array, Array, Array]:
+    """One batch's binned-histogram contribution: ``(count, conf_sum, acc_sum)``
+    per fixed equal-width bucket, built with a single scatter-add.
+
+    These three ``(n_bins,)`` sums are the WHOLE sufficient statistic for
+    ECE/MCE under fixed binning — they add across batches, across lanes and
+    across shards (``dist_reduce_fx="sum"``), which is what lets the modular
+    metric hold constant-size state instead of a growing sample buffer.
+    """
     indices = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
     from torchmetrics_tpu.ops import weighted_bincount_multi
 
@@ -33,6 +37,29 @@ def _binning_bucketize(
         jnp.stack([jnp.ones_like(confidences), confidences, accuracies.astype(jnp.float32)]),
         n_bins,
     )
+    return count, conf, acc
+
+
+def _ce_compute_binned(bin_count: Array, bin_conf: Array, bin_acc: Array, norm: str = "l1") -> Array:
+    """Calibration error from accumulated per-bin sums (the binned state)."""
+    prop_bin = bin_count / bin_count.sum()
+    conf_bin = _safe_divide(bin_conf, bin_count)
+    acc_bin = _safe_divide(bin_acc, bin_count)
+    if norm == "l1":
+        return ((acc_bin - conf_bin).__abs__() * prop_bin).sum()
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin) * (prop_bin > 0))
+    if norm == "l2":
+        ce = ((acc_bin - conf_bin) ** 2 * prop_bin).sum()
+        return jnp.sqrt(ce)
+    raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries_or_n: int
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean confidence, mean accuracy and proportion (reference :36-60)."""
+    count, conf, acc = _ce_update_binned(confidences, accuracies, bin_boundaries_or_n)
     prop_bin = count / count.sum()
     return _safe_divide(conf, count), _safe_divide(acc, count), prop_bin
 
@@ -43,15 +70,11 @@ def _ce_compute(
     n_bins: int,
     norm: str = "l1",
 ) -> Array:
-    conf_bin, acc_bin, prop_bin = _binning_bucketize(confidences, accuracies, n_bins)
-    if norm == "l1":
-        return ((acc_bin - conf_bin).__abs__() * prop_bin).sum()
-    if norm == "max":
-        return jnp.max(jnp.abs(acc_bin - conf_bin) * (prop_bin > 0))
-    if norm == "l2":
-        ce = ((acc_bin - conf_bin) ** 2 * prop_bin).sum()
-        return jnp.sqrt(ce)
-    raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    # route through the SAME binned sufficient statistic the modular metric
+    # accumulates, so the sample-buffer and binned formulations agree up to
+    # float summation order
+    count, conf, acc = _ce_update_binned(confidences, accuracies, n_bins)
+    return _ce_compute_binned(count, conf, acc, norm)
 
 
 def _binary_calibration_error_arg_validation(n_bins: int, norm: str, ignore_index: Optional[int]) -> None:

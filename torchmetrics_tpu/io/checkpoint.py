@@ -241,6 +241,26 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
     except Exception as err:  # a broken status probe must not block the save
         rank_zero_debug(f"torchmetrics_tpu checkpoint: lane_status probe failed ({err})")
 
+    # windowed objects (torchmetrics_tpu/windows.py) describe their ring in
+    # the manifest — window count W, open head slot, and the window clock —
+    # so load_manifest answers "which windows does this snapshot hold"
+    # without touching the payload arrays
+    windows = None
+    try:
+        spec_fn = getattr(obj, "window_spec", None)
+        if spec_fn is None:
+            spec_fn = getattr(getattr(obj, "inner", None), "window_spec", None)
+        if callable(spec_fn):
+            ws = spec_fn()
+            if isinstance(ws, dict):
+                windows = {
+                    k: ws.get(k)
+                    for k in ("window", "lateness", "clock", "head", "compiled")
+                    if k in ws
+                }
+    except Exception as err:  # a broken window probe must not block the save
+        rank_zero_debug(f"torchmetrics_tpu checkpoint: window_spec probe failed ({err})")
+
     world = _world_topology()
     # topology block (manifest v2, docs/DURABILITY.md "Elastic restore"): the
     # world shape this snapshot's layout is bound to, so a restore onto a
@@ -274,6 +294,7 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
         "class": type(obj).__name__,
         "spec": spec,
         "lanes": lanes,
+        "windows": windows,
         "topology": topology,
         "update_count": update_count,
         "reduce_policy": getattr(obj, "reduce_policy", None),

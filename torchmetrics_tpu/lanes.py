@@ -590,6 +590,17 @@ class LanedMetric(Metric):
         # list ("cat") accumulators cannot stack a lane axis: exact host-side
         # per-lane fallback (docs/LANES.md "Two execution modes")
         self.__dict__["_compiled_lanes"] = not any(isinstance(v, list) for v in inner._defaults.values())
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        if isinstance(inner, WindowedMetric) and not inner._compiled_windows:
+            # an eager windowed inner declares NO array states at all — the
+            # lane axis would stack nothing and every session would silently
+            # share one host-side ring
+            raise TorchMetricsUserError(
+                "LanedMetric needs a compiled ring to stack the lane axis over;"
+                f" {type(inner.inner).__name__} fell back to eager per-window state"
+                " (list/'cat'/custom reductions — see docs/STREAMING.md)"
+            )
         self.__dict__["_table"] = table if table is not None else LaneTable(capacity)
         if table is not None and table.capacity != capacity:
             capacity = table.capacity  # shared table wins: members must agree
@@ -706,23 +717,31 @@ class LanedMetric(Metric):
         return list(self.inner._defaults)
 
     # ------------------------------------------------------------ update path
-    def update(self, lane_ids: Any, *args: Any) -> None:
+    def update(self, lane_ids: Any, *args: Any, window: Optional[Any] = None) -> None:
         """Advance the lanes named by ``lane_ids`` with the row-stacked batch.
 
         ``lane_ids`` is an int array ``(rows,)``; every batch leaf carries a
         matching leading row axis. Rows whose lane id is out of range (the
         router's padding sentinel ``== capacity``) are DROPPED by the scatter
         — a padded row cannot perturb any lane, whatever the state family.
-        Prefer :meth:`update_sessions`, which packs, pads, admits and stamps
-        sessions for you; this low-level entry is what the executor compiles.
+        ``window`` (windowed inner only — a traced int32 scalar) routes every
+        row into that ABSOLUTE window's ring slot instead of each lane's open
+        head; :meth:`update_sessions` passes it after the watermark admits
+        the round. Prefer :meth:`update_sessions`, which packs, pads, admits
+        and stamps sessions for you; this low-level entry is what the
+        executor compiles.
         """
         lane_ids = jnp.asarray(lane_ids, jnp.int32)
         if self._compiled_lanes:
-            self._update_compiled(lane_ids, args)
+            self._update_compiled(lane_ids, args, window=window)
         else:
+            if window is not None:
+                raise TorchMetricsUserError(
+                    "explicit-window routing needs compiled (fixed-shape) lane states"
+                )
             self._update_eager(lane_ids, args)
 
-    def _update_compiled(self, lane_ids: Any, args: Tuple[Any, ...]) -> None:
+    def _update_compiled(self, lane_ids: Any, args: Tuple[Any, ...], window: Optional[Any] = None) -> None:
         inner = self.inner
         fields = self._inner_fields()
         states = {f: self._state[f] for f in fields}
@@ -731,7 +750,11 @@ class LanedMetric(Metric):
         gathered = {f: jnp.take(v, safe_ids, axis=0) for f, v in states.items()}
 
         def one(state: Dict[str, Any], *row: Any) -> Dict[str, Any]:
-            return inner.functional_update(state, *row)
+            if window is None:
+                return inner.functional_update(state, *row)
+            # the closed-over window index is DATA (a traced scalar): every
+            # window value runs this same executable
+            return inner.functional_update(state, *row, window=window)
 
         with obs.device_span(obs.SPAN_UPDATE, suffix=type(inner).__name__):
             updated = jax.vmap(one)(gathered, *args)
@@ -801,7 +824,11 @@ class LanedMetric(Metric):
         )
 
     # ----------------------------------------------------------------- router
-    def update_sessions(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
+    def update_sessions(
+        self,
+        items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]],
+        window: Optional[int] = None,
+    ) -> int:
         """Pack ``(session_id, batch)`` traffic into lane-batched dispatches.
 
         ``items`` is a dict or iterable of pairs; each batch is a tuple of
@@ -812,12 +839,58 @@ class LanedMetric(Metric):
         session appearing k times spans k sequential rounds. Returns the
         number of dispatches issued.
 
+        ``window`` (windowed inner only) stamps the round with an event-time
+        window index: per-session watermark admission drops events older
+        than the lateness bound (with a ``window_late_drop`` breadcrumb) and
+        routes admitted late events into their still-open ring slot.
+
         Guard-active rounds run under the shared read mutex so an in-flight
         asynchronous read's scan-and-attribute step (docs/ASYNC.md) never
         interleaves with the round's guard/state mutations.
         """
         with self._read_mutex():
-            return self._update_sessions_impl(items)
+            if window is None:
+                return self._update_sessions_impl(items)
+            return self._update_sessions_windowed(int(window), items)
+
+    def _update_sessions_windowed(
+        self, k: int, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]
+    ) -> int:
+        from torchmetrics_tpu.windows import _now_us
+
+        win = self._windowed_inner()
+        pairs = list(items.items()) if isinstance(items, dict) else list(items)
+        kept: List[Tuple[Any, Any]] = []
+        for sid, batch in pairs:
+            lane = self._router_admit(sid)
+            clock = int(self._window_clocks()[lane])  # re-read: admit may have grown/invalidated
+            if k > clock:
+                raise TorchMetricsUserError(
+                    f"window {k} is ahead of lane clock {clock} for session {sid!r};"
+                    " advance the window before routing events into it"
+                )
+            age = clock - k
+            if age > win.lateness or age >= win.window:
+                obs.counter_inc("windows.dropped_late")
+                obs.fault_breadcrumb(
+                    "window_late_drop",
+                    domain="windows",
+                    data={"session": str(sid), "window": k, "clock": clock, "age": age},
+                )
+                continue
+            if age > 0:
+                obs.counter_inc("windows.late_events")
+                close_us = self._window_close_us().get(k)
+                if close_us is not None:
+                    obs.histogram_observe("windows.lateness_us", max(0, _now_us() - close_us))
+            kept.append((sid, batch))
+        if not kept:
+            return 0
+        self.__dict__["_round_window"] = k
+        try:
+            return _route_rounds(self, kept)
+        finally:
+            self.__dict__.pop("_round_window", None)
 
     def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
         return _route_rounds(self, items)
@@ -845,8 +918,180 @@ class LanedMetric(Metric):
         return self.__dict__.setdefault("_screen_kind_memo", {})
 
     def _router_dispatch(self, lane_arr: Any, batch: Tuple[Any, ...], rows: int, bucket: int) -> None:
+        k = self.__dict__.get("_round_window")
         with obs.span(obs.SPAN_LANES, owner=type(self.inner).__name__, histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
-            self.update(lane_arr, *batch)
+            if k is None:
+                self.update(lane_arr, *batch)
+            else:
+                self.update(lane_arr, *batch, window=jnp.asarray(k, jnp.int32))
+
+    # ----------------------------------------------------------- window rings
+    def _windowed_inner(self) -> Any:
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        inner = self.inner
+        if not isinstance(inner, WindowedMetric):
+            raise TorchMetricsUserError(
+                "window operations need a windowed inner metric;"
+                f" got {type(inner).__name__} — build with"
+                " LanedMetric(metric.windowed(W))"
+            )
+        return inner
+
+    def _window_clocks(self) -> Any:
+        """Host mirror of the per-lane window clocks, ``np.int64 (capacity,)``.
+
+        Authoritative for watermark ADMISSION only (the device-side
+        ``window_head`` state is the traced truth); lazily re-synced from the
+        state after any out-of-band mutation invalidates it. Keeping
+        admission on the host mirror means the update hot path never blocks
+        on a device readback.
+        """
+        clocks = self.__dict__.get("_window_clocks_host")
+        if clocks is None:
+            self._windowed_inner()
+            heads = np.asarray(self._state["window_head"], dtype=np.int64)
+            if heads.ndim > 1:  # sharded: identical replicas, max folds exactly
+                heads = heads.max(axis=tuple(range(1, heads.ndim)))
+            clocks = heads
+            self.__dict__["_window_clocks_host"] = clocks
+        return clocks
+
+    def advance_windows(self, n: int = 1) -> None:
+        """Close the open window on EVERY lane ``n`` times — O(1) each.
+
+        One donated dispatch bumps all per-lane heads and masked-resets each
+        lane's retiring ring slot to the reduction identity; cost is
+        independent of the window count W (the head is data, not shape, so
+        no recompile ever).
+        """
+        win = self._windowed_inner()
+        for _ in range(int(n)):
+            with obs.span(
+                obs.SPAN_WINDOWS,
+                owner=type(win.inner).__name__,
+                histogram="windows.advance_us",
+                window=win.window,
+                lanes=self.capacity,
+            ):
+                self._advance_windows_once(win)
+            obs.counter_inc("windows.advanced")
+
+    def _advance_windows_once(self, win: Any) -> None:
+        clocks = self._window_clocks()  # materialize BEFORE the device bump
+        fields = self._inner_fields()
+        states = {f: self._state[f] for f in fields}
+        donate = not self._state_escaped
+        fn = self._win_advance_fn(win.window, donate, lane=False)
+        out = fn(states)
+        self._state.update(out)
+        if not donate:
+            self._state_escaped = False
+        clocks += 1
+        self._window_close_stamp(int(clocks.max()) - 1, win)
+        self._computed = None
+        self.__dict__["_lane_mirror"].invalidate()
+
+    def advance_lane_windows(self, lane: int, n: int = 1) -> None:
+        """Close the open window on ONE lane ``n`` times (clock skew).
+
+        Per-tenant event time is allowed to drift: a lane whose stream runs
+        ahead closes its windows early while the rest of the fleet stays
+        put. The lane index is traced data — every lane shares one
+        executable.
+        """
+        win = self._windowed_inner()
+        fields = self._inner_fields()
+        for _ in range(int(n)):
+            clocks = self._window_clocks()  # materialize BEFORE the device bump
+            states = {f: self._state[f] for f in fields}
+            donate = not self._state_escaped
+            fn = self._win_advance_fn(win.window, donate, lane=True)
+            out = fn(states, jnp.asarray(lane, jnp.int32))
+            self._state.update(out)
+            if not donate:
+                self._state_escaped = False
+            clocks[int(lane)] += 1
+            self._window_close_stamp(int(clocks.max()) - 1, win)
+            obs.counter_inc("windows.advanced")
+        self._computed = None
+        self.__dict__["_lane_mirror"].invalidate()
+
+    def window_spec(self) -> Dict[str, Any]:
+        """The suite's window ring described for manifests/debugging:
+        W, lateness, the fleet-max clock, the open head slot at that clock,
+        and per-lane clocks (a JSON-able list)."""
+        win = self._windowed_inner()
+        clocks = self._window_clocks()
+        clock = int(clocks.max())
+        return {
+            "window": win.window,
+            "lateness": win.lateness,
+            "clock": clock,
+            "head": clock % win.window,
+            "compiled": True,
+            "lane_clocks": [int(c) for c in clocks],
+        }
+
+    def _window_close_us(self) -> Dict[int, int]:
+        return self.__dict__.setdefault("_win_close_us", {})
+
+    def _window_close_stamp(self, closed: int, win: Any) -> None:
+        from torchmetrics_tpu.windows import _now_us
+
+        closes = self._window_close_us()
+        closes[closed] = _now_us()
+        horizon = closed - int(win.lateness) - 1
+        for k in [k for k in closes if k < horizon]:
+            closes.pop(k, None)
+
+    def _win_advance_fn(self, window: int, donate: bool, lane: bool) -> Any:
+        """Cached jitted window-advance closures, keyed (donate, lane).
+
+        Closed over the capacity-shaped laned defaults — cleared wherever
+        the lane axis is re-laid-out (grow / remap / respec), alongside
+        ``_reset_fn``.
+        """
+        fns = self.__dict__.setdefault("_win_advance_fns", {})
+        key = (donate, lane)
+        fn = fns.get(key)
+        if fn is not None:
+            return fn
+        # the per-(lane, slot) identity rows — every slot shares the stacked
+        # default, so slot 0's rows stand in for any retiring slot
+        default_slot = {
+            f: self._defaults[f][:, 0]
+            for f in self._inner_fields()
+            if f != "window_head"
+        }
+
+        def body(states: Dict[str, Any], lane_idx: Any = None) -> Dict[str, Any]:
+            heads = states["window_head"]
+            out = {}
+            if lane_idx is None:
+                heads = heads + 1
+                slot = jnp.mod(heads, window)
+                lanes_idx = jnp.arange(heads.shape[0], dtype=jnp.int32)
+                for f, v in states.items():
+                    if f == "window_head":
+                        continue
+                    # scatter ONLY each lane's retiring slot to the identity
+                    # — with donation an in-place write of L rows, so the
+                    # advance cost is independent of W
+                    out[f] = v.at[lanes_idx, slot].set(default_slot[f])
+            else:
+                heads = heads.at[lane_idx].add(1)
+                slot = jnp.mod(heads[lane_idx], window)
+                for f, v in states.items():
+                    if f == "window_head":
+                        continue
+                    out[f] = v.at[lane_idx, slot].set(default_slot[f][lane_idx])
+            out["window_head"] = heads
+            return out
+
+        fn = jax.jit(body, donate_argnums=(0,) if donate else ())
+        fns[key] = fn
+        return fn
 
     # ------------------------------------------------------ fault containment
     def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
@@ -1334,6 +1579,7 @@ class LanedMetric(Metric):
 
     def _reset_lane_indices(self, lanes: Sequence[int]) -> None:
         self.__dict__["_lane_mirror"].invalidate()  # out-of-band state mutation
+        self.__dict__.pop("_window_clocks_host", None)  # head resets with the lane
         if not self._compiled_lanes:
             inner = self.inner
             for lane in lanes:
@@ -1376,6 +1622,8 @@ class LanedMetric(Metric):
         kept (a service reset clears accumulators, not its routing table)."""
         super().reset()
         self.__dict__["_lane_mirror"].invalidate()
+        self.__dict__.pop("_window_clocks_host", None)
+        self.__dict__.pop("_win_close_us", None)
         self.__dict__["_health_seen"] = np.zeros((self.capacity,), np.int64)
         if not self._compiled_lanes:
             inner = self.inner
@@ -1434,6 +1682,8 @@ class LanedMetric(Metric):
         self.__dict__["_state_escaped"] = True
         self.__dict__["_reset_fn"] = None  # capacity-shaped closures rebuild lazily
         self.__dict__["_lane_compute_fn"] = None
+        self.__dict__["_win_advance_fns"] = {}
+        self.__dict__.pop("_window_clocks_host", None)
         # invalidate the executor's memoized state signature (ops/executor.py
         # _state_sig): the stacked layout just changed shape
         self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
@@ -1522,6 +1772,8 @@ class LanedMetric(Metric):
             self.__dict__["_state_escaped"] = True
             self.__dict__["_reset_fn"] = None
             self.__dict__["_lane_compute_fn"] = None
+            self.__dict__["_win_advance_fns"] = {}
+            self.__dict__.pop("_window_clocks_host", None)
             self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
             guard: LaneGuard = self.__dict__["_guard"]
             if guard.active:
@@ -1959,6 +2211,8 @@ class LanedMetric(Metric):
                 self.__dict__["_lane_health_counts"], dtype=np.int64
             )
         self.__dict__["_lane_mirror"].invalidate()
+        self.__dict__.pop("_window_clocks_host", None)  # restored heads are the clock now
+        self.__dict__.pop("_win_close_us", None)
 
     def _infer_capacity(self, state: Dict[str, Any], sharded: bool) -> int:
         axis = 1 if sharded else 0
@@ -1988,6 +2242,8 @@ class LanedMetric(Metric):
         self.__dict__["_state_escaped"] = True
         self.__dict__["_reset_fn"] = None
         self.__dict__["_lane_compute_fn"] = None
+        self.__dict__["_win_advance_fns"] = {}
+        self.__dict__.pop("_window_clocks_host", None)
         self.__dict__["_state_layout_version"] = self.__dict__.get("_state_layout_version", 0) + 1
         table: LaneTable = self.__dict__["_table"]
         if capacity != table.capacity:
@@ -2101,6 +2357,9 @@ class LanedMetric(Metric):
         # capacity-shaped jitted closures are process-local; rebuilt lazily
         out["_reset_fn"] = None
         out["_lane_compute_fn"] = None
+        out["_win_advance_fns"] = {}
+        out.pop("_window_clocks_host", None)
+        out.pop("_win_close_us", None)
         # the recovery mirror chains off this process's commit stream
         out["_lane_mirror"] = LaneStateMirror()
         out.pop("_round_ctx", None)
@@ -2155,8 +2414,14 @@ class LanedCollection:
     ) -> None:
         from torchmetrics_tpu.collections import MetricCollection
 
+        from torchmetrics_tpu.windows import WindowedCollection
+
         if isinstance(metrics, MetricCollection):
             metrics = {name: m for name, m in metrics.items(keep_base=True)}
+        elif isinstance(metrics, WindowedCollection):
+            # lane the already-windowed members: window axis under the lane
+            # axis, every ring advancing in lockstep (docs/STREAMING.md)
+            metrics = dict(metrics.items())
         elif isinstance(metrics, Metric):
             metrics = {type(metrics).__name__: metrics}
         elif not isinstance(metrics, dict):
@@ -2254,12 +2519,85 @@ class LanedCollection:
 
         return guard_lock(self._guard)
 
-    def update_sessions(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
+    def update_sessions(
+        self,
+        items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]],
+        window: Optional[int] = None,
+    ) -> int:
         """Pack ``(session_id, batch)`` traffic and advance EVERY member with
         one fused collection dispatch per round (see
-        :meth:`LanedMetric.update_sessions`). Returns the dispatch count."""
+        :meth:`LanedMetric.update_sessions`). ``window`` (windowed members
+        only) stamps the round with an event-time window index; watermark
+        admission runs once for the suite — members advance their rings in
+        lockstep through :meth:`advance_windows`, so one member's clock
+        speaks for all. Returns the dispatch count."""
         with self._read_mutex():
-            return self._update_sessions_impl(items)
+            if window is None:
+                return self._update_sessions_impl(items)
+            return LanedMetric._update_sessions_windowed(self, int(window), items)
+
+    def _windowed_inner(self) -> Any:
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        for m in self._members.values():
+            if isinstance(m.inner, WindowedMetric):
+                return m.inner
+        raise TorchMetricsUserError(
+            "window operations need at least one windowed member;"
+            " build with MetricCollection(...).windowed(W).laned(capacity)"
+        )
+
+    def _window_clocks(self) -> Any:
+        """Suite window clocks — members advance in lockstep, so the first
+        windowed member's mirror speaks for every member."""
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        for m in self._members.values():
+            if isinstance(m.inner, WindowedMetric):
+                return m._window_clocks()
+        raise TorchMetricsUserError("no windowed member to read clocks from")
+
+    def _window_close_us(self) -> Dict[int, int]:
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        for m in self._members.values():
+            if isinstance(m.inner, WindowedMetric):
+                return m._window_close_us()
+        return {}
+
+    def window_spec(self) -> Dict[str, Any]:
+        """Suite window ring (see :meth:`LanedMetric.window_spec`) — members
+        advance in lockstep, so the first windowed member speaks for all."""
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        for m in self._members.values():
+            if isinstance(m.inner, WindowedMetric):
+                return m.window_spec()
+        raise TorchMetricsUserError("no windowed member to describe")
+
+    def advance_windows(self, n: int = 1) -> None:
+        """Close the open window on every lane of EVERY windowed member —
+        the suite's rings stay in lockstep (one clock, many metrics)."""
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        with self._read_mutex():
+            hit = False
+            for m in self._members.values():
+                if isinstance(m.inner, WindowedMetric):
+                    m.advance_windows(n)
+                    hit = True
+            if not hit:
+                raise TorchMetricsUserError("no windowed member to advance")
+
+    def advance_lane_windows(self, lane: int, n: int = 1) -> None:
+        """Per-lane window advance (clock skew) applied to every windowed
+        member so the suite's per-lane clocks stay coherent."""
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        with self._read_mutex():
+            for m in self._members.values():
+                if isinstance(m.inner, WindowedMetric):
+                    m.advance_lane_windows(lane, n)
 
     def _update_sessions_impl(self, items: Union[Dict[Any, Any], Iterable[Tuple[Any, Any]]]) -> int:
         return _route_rounds(self, items)
@@ -2287,8 +2625,13 @@ class LanedCollection:
         return memo
 
     def _router_dispatch(self, lane_arr: Any, batch: Tuple[Any, ...], rows: int, bucket: int) -> None:
+        k = self.__dict__.get("_round_window")
         with obs.span(obs.SPAN_LANES, owner="LanedCollection", histogram="lanes.dispatch_us", rows=rows, bucket=bucket):
-            self.collection.update(lane_arr, *batch)
+            if k is None:
+                self.collection.update(lane_arr, *batch)
+            else:
+                # _filter_kwargs drops `window` for non-windowed members
+                self.collection.update(lane_arr, *batch, window=jnp.asarray(k, jnp.int32))
 
     def _apply_fault_action(self, sid: Any, action: str, err: LaneFaultError) -> None:
         """Suite-wide ``on_lane_fault`` action: eviction/reset span every
@@ -2508,30 +2851,87 @@ class DeferredLaneStep:
             self._compiled[key] = fn
         return fn
 
-    def local_step(self, states, lane_ids, *batch):
+    def local_step(self, states, lane_ids, *batch, window=None):
         """One donated dispatch: each device scatters ITS rows into ITS local
         lane copies — zero collectives. ``lane_ids`` and every batch leaf are
         sharded along the mesh axis on their leading row dim (row count must
         divide the mesh size; the router's power-of-two padding guarantees
-        it)."""
+        it). ``window`` (windowed inner only — an int) routes the rows into
+        that absolute window's ring slot; it is traced data, so every window
+        shares one executable."""
         from jax.sharding import PartitionSpec as P
 
         from torchmetrics_tpu.parallel.sync import reshard_local_state, shard_map_compat, unshard_local_state
 
         laned = self._laned
+        windowed = window is not None
 
         def build():
             def body(st, ids, *b):
-                local = laned.functional_update(unshard_local_state(st), ids, *b)
+                if windowed:
+                    b, w = b[:-1], b[-1]
+                    local = laned.functional_update(unshard_local_state(st), ids, *b, window=w)
+                else:
+                    local = laned.functional_update(unshard_local_state(st), ids, *b)
                 return reshard_local_state(local)
 
-            in_specs = (self._spec, P(self._axis)) + tuple(P(self._axis) for _ in batch)
+            extra = (P(),) if windowed else ()
+            in_specs = (self._spec, P(self._axis)) + tuple(P(self._axis) for _ in batch) + extra
             mapped = shard_map_compat(body, self._mesh, in_specs, self._spec)
             return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
 
-        fn = self._get(("local", len(batch)), build)
+        fn = self._get(("local", len(batch), windowed), build)
+        tail = (jnp.asarray(window, jnp.int32),) if windowed else ()
         with obs.span(obs.SPAN_LANES, owner=type(laned.inner).__name__, deferred=True):
-            return fn(states, lane_ids, *batch)
+            return fn(states, lane_ids, *batch, *tail)
+
+    def advance_windows(self, states):
+        """O(1) window advance on deferred sharded states — each device bumps
+        its shard's per-lane heads and masked-resets the retiring ring slots
+        locally, zero collectives (every shard holds the same heads, so they
+        stay in agreement without a rendezvous)."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchmetrics_tpu.parallel.sync import reshard_local_state, shard_map_compat, unshard_local_state
+
+        laned = self._laned
+        win = laned._windowed_inner()
+        W = win.window
+
+        def build():
+            default_slot = {
+                f: laned._defaults[f][:, 0]
+                for f in laned._inner_fields()
+                if f != "window_head"
+            }
+
+            def body(st):
+                local = unshard_local_state(st)
+                heads = local["window_head"] + 1
+                slot = jnp.mod(heads, W)
+                lanes_idx = jnp.arange(heads.shape[0], dtype=jnp.int32)
+                out = dict(local)
+                out["window_head"] = heads
+                for f, d in default_slot.items():
+                    # retiring-slot scatter (see _win_advance_fn): O(lanes),
+                    # not O(lanes x W)
+                    out[f] = local[f].at[lanes_idx, slot].set(d)
+                return reshard_local_state(out)
+
+            mapped = shard_map_compat(body, self._mesh, (self._spec,), self._spec)
+            return jax.jit(mapped, donate_argnums=0) if self._donate else jax.jit(mapped)
+
+        fn = self._get("advance_windows", build)
+        with obs.span(
+            obs.SPAN_WINDOWS,
+            owner=type(win.inner).__name__,
+            histogram="windows.advance_us",
+            window=W,
+            deferred=True,
+        ):
+            out = fn(states)
+        obs.counter_inc("windows.advanced")
+        return out
 
     def reduce(self, states):
         """The single deferred rendezvous: fold the shard axis per declared
@@ -2565,6 +2965,7 @@ class DeferredLaneStep:
         laned.__dict__["_reduced"] = True
         laned.__dict__["_pending_shards"] = None
         laned.__dict__["_lane_mirror"].invalidate()  # reduced layout replaced the arrays
+        laned.__dict__.pop("_window_clocks_host", None)
         laned._computed = None
 
 

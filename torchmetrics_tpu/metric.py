@@ -2011,6 +2011,18 @@ class Metric:
 
         return LanedMetric(self, capacity=capacity, max_capacity=max_capacity, **kwargs)
 
+    def windowed(self, window: int = 8, lateness: int = 0, **kwargs: Any) -> Any:
+        """A :class:`~torchmetrics_tpu.windows.WindowedMetric` stacking W
+        per-window copies of this metric's state along a ring axis: O(1)
+        tumbling/sliding windows with watermark-bounded late-event routing
+        (docs/STREAMING.md). The wrapper holds a detached clone; this
+        instance is untouched. Compose with lanes as
+        ``metric.windowed(W).laned(capacity)`` — window axis under the lane
+        axis."""
+        from torchmetrics_tpu.windows import WindowedMetric
+
+        return WindowedMetric(self, window=window, lateness=lateness, **kwargs)
+
     def persistent(self, mode: bool = False) -> None:
         """Toggle persistence of all states (reference metric.py:840-843)."""
         for key in self._persistent:

@@ -68,6 +68,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_SYNC_GATHER,
     SPAN_UPDATE,
     SPAN_WARMUP,
+    SPAN_WINDOWS,
     TELEMETRY_ENV,
     TRACE_BUFFER_ENV,
     TRACE_ENV,

@@ -72,6 +72,7 @@ DOMAINS = (
     "reshard",     # elastic N->M re-splits
     "kernels",     # backend gate decisions (ops/kernels.py)
     "fleet",       # cross-process delta uplinks: ship/merge/failover (fleet/)
+    "windows",     # streaming window ring: advance, late-event routing, drops
 )
 
 #: canonical span name -> flight domain (consumed by obs/tracer.span on exit;
@@ -102,6 +103,7 @@ DOMAIN_OF_SPAN = {
     "tm_tpu.kernel": "kernels",
     "tm_tpu.fleet.ship": "fleet",
     "tm_tpu.fleet.merge": "fleet",
+    "tm_tpu.windows.advance": "windows",
 }
 
 

@@ -89,6 +89,7 @@ SPAN_PACK = "tm_tpu.lanes.pack"            # ingest slab pack (staged worker hal
 SPAN_CLASS_ROUTE = "tm_tpu.class_route"    # class-axis shard routing (scatter) + read-point gather
 SPAN_FLEET_SHIP = "tm_tpu.fleet.ship"      # leaf exporter: fold-to-delta + uplink transmit (per leaf)
 SPAN_FLEET_MERGE = "tm_tpu.fleet.merge"    # aggregator: ledger apply + per-leaf accumulate (per leaf)
+SPAN_WINDOWS = "tm_tpu.windows.advance"    # streaming ring advance: head rotate + masked slot reset
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -117,6 +118,7 @@ SPAN_NAMES = (
     SPAN_CLASS_ROUTE,
     SPAN_FLEET_SHIP,
     SPAN_FLEET_MERGE,
+    SPAN_WINDOWS,
 )
 
 

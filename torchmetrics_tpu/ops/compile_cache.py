@@ -485,6 +485,7 @@ class CompileWorker:
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)  # (job, trace ctx) pairs
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._atexit_registered = False
         self.stats = {"submitted": 0, "dropped": 0, "completed": 0, "errors": 0}
 
     def _ensure_thread(self) -> None:
@@ -492,6 +493,17 @@ class CompileWorker:
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(target=self._run, name="tm_tpu_compile_worker", daemon=True)
                 self._thread.start()
+                if not self._atexit_registered:
+                    # the thread is daemon so a hung compile can never wedge
+                    # shutdown — but interpreter teardown freezing it MID
+                    # XLA-compile segfaults (observed: a cold-key dispatch as
+                    # a script's last statement). Drain in-flight jobs at
+                    # atexit, bounded so a wedged compile still only delays
+                    # exit, never blocks it
+                    import atexit
+
+                    atexit.register(self.drain, 30.0)
+                    self._atexit_registered = True
 
     def _run(self) -> None:
         from torchmetrics_tpu import obs  # deferred: keep import-time deps minimal

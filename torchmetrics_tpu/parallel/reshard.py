@@ -79,6 +79,10 @@ _IN_STACK = ("sum", "mean", "max", "min")
 #: reserved keys a state export may carry that are not declared fields
 _COUNT_KEY = "_update_count"
 _SHARDS_KEY = "_sharded_shards"
+#: windowed exports carry their ring geometry under this key (windows.py) —
+#: host metadata, never reduced; the window CLOCK itself rides the declared
+#: ``window_head`` state field (fx="max": exact through fold AND expand)
+_WINDOW_META_KEY = "_window_meta"
 
 
 class ShardLayout(NamedTuple):
@@ -110,7 +114,7 @@ def layout_of(states: Dict[str, Any]) -> ShardLayout:
 
 
 def _strip_reserved(states: Dict[str, Any]) -> Dict[str, Any]:
-    return {k: v for k, v in states.items() if k not in (_COUNT_KEY, _SHARDS_KEY)}
+    return {k: v for k, v in states.items() if k not in (_COUNT_KEY, _SHARDS_KEY, _WINDOW_META_KEY)}
 
 
 def fold_canonical(

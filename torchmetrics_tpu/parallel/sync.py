@@ -410,6 +410,50 @@ def reduce_stacked(gathered: Any, reduction: Reduction) -> Any:
     return gathered
 
 
+def live_window_mask(head: Any, window: int) -> jnp.ndarray:
+    """Boolean ``(window,)`` mask of ring slots holding LIVE windows.
+
+    ``head`` is the (traced or concrete) monotonic window clock; slot
+    ``head % window`` houses the open window and older slots wrap behind it.
+    Before the clock has wrapped once (``head < window - 1``) the not-yet
+    opened slots hold defaults, which are NOT the fold identity for every
+    family (e.g. a ``max`` state may default to 0) — the mask lets the fold
+    replace them with :func:`reduction_identity` instead. Pure traced
+    arithmetic on data: advancing the head never changes a shape.
+    """
+    slots = jnp.arange(window)
+    age = jnp.mod(jnp.mod(head, window) - slots, window)
+    return (head - age) >= 0
+
+
+def fold_window_slots(value: Any, reduction: Reduction, live: jnp.ndarray) -> Any:
+    """Collapse the leading WINDOW axis of a ring-stacked state field into the
+    sliding-window aggregate, masking dead slots with the reduction identity.
+
+    Ring slots are disjoint SEGMENTS of one accumulation stream, so the
+    combine follows :func:`~torchmetrics_tpu.parallel.reshard.merge_folded`'s
+    segment semantics — ``sum`` AND ``mean`` states both ADD across segments
+    (the mean fold is linear over contributors, so per-window partial sums
+    combine by addition exactly as an unwindowed run would have accumulated
+    them); ``max``/``min`` take the masked extremum. ``cat``/``None``/callable
+    families have no identity-masked fold — windows.py keeps those metrics on
+    the eager per-window path and never calls this.
+    """
+    if callable(reduction) or reduction in ("cat", None):
+        raise ValueError(
+            f"fold_window_slots is undefined for {reduction!r} reductions; eager"
+            " per-window states merge through Metric.merge_states instead"
+        )
+    ident = reduction_identity(reduction, value.dtype)
+    mask = live.reshape((-1,) + (1,) * (value.ndim - 1))
+    masked = jnp.where(mask, value, ident)
+    if reduction in ("sum", "mean"):
+        return masked.sum(0)
+    if reduction == "max":
+        return masked.max(0)
+    return masked.min(0)
+
+
 def host_sync_value(value: Any, reduction: Reduction, timeout: Optional[float] = None) -> Any:
     """Multi-host (DCN) sync outside jit via process_allgather, then local reduce.
 

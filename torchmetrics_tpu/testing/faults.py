@@ -42,6 +42,11 @@ guarantees (docs/ROBUSTNESS.md) are *asserted*, not assumed:
   corrupt only that session's rows, or raise an attributed
   ``LaneFaultError`` inside the laned update path — the blast-radius
   primitives behind the per-tenant isolation chaos suite.
+- :func:`skew_clock` / :func:`late_event` — windowed-state chaos against a
+  laned metric's event-time semantics (docs/STREAMING.md): run one lane's
+  window clock ahead of the fleet, or deliver a batch stamped ``age``
+  windows late — the primitives the watermark admit/drop boundary and the
+  skewed-clock read invariants are asserted against.
 - :func:`drop_delta` / :func:`duplicate_delta` / :func:`delay_delta` /
   :func:`partition_leaf` — fleet-uplink faults at the ``Uplink.transmit``
   delivery seam (docs/FLEET.md "Failure table"): lose the first n delivery
@@ -177,7 +182,7 @@ def poison_session(
     ``seed`` are :func:`poison_batch`'s."""
     orig = laned.update_sessions
 
-    def poisoned(items: Any) -> int:
+    def poisoned(items: Any, **kwargs: Any) -> int:
         items = list(items.items()) if isinstance(items, dict) else list(items)
         out = []
         for sid, batch in items:
@@ -187,7 +192,7 @@ def poison_session(
                 leaves = poison_batch(*leaves, mode=mode, frac=frac, seed=seed)
                 batch = leaves if was_tuple else leaves[0]
             out.append((sid, batch))
-        return orig(out)
+        return orig(out, **kwargs)
 
     object.__setattr__(laned, "update_sessions", poisoned)
     try:
@@ -253,6 +258,37 @@ def fail_lane_dispatch(
         object.__setattr__(
             patched_target, attr, orig_coll_update if orig_coll_update is not None else orig_update
         )
+
+
+# ------------------------------------------------------------- window clocks
+
+def skew_clock(laned: Any, lane: int, by: int = 1) -> int:
+    """Run ONE lane's window clock ``by`` windows AHEAD of the fleet — the
+    per-tenant event-time drift scenario (docs/STREAMING.md "Clock skew"):
+    a tenant whose stream runs fast closes its windows early while every
+    other lane stays put. The skew is real ring state (the lane's retiring
+    slots are identity-reset), so it is deliberately NOT undone — compose
+    with the other chaos managers around the traffic you drive afterwards.
+    Returns the lane's new clock."""
+    laned.advance_lane_windows(int(lane), int(by))
+    return int(laned._window_clocks()[int(lane)])
+
+
+def late_event(laned: Any, session_id: Any, batch: Any, age: int = 1) -> int:
+    """Deliver ``batch`` for ``session_id`` stamped ``age`` windows behind
+    the session's CURRENT lane clock — the watermark chaos primitive. Within
+    the lateness bound the event must land in its still-open ring slot;
+    beyond it the watermark must drop it with a ``window_late_drop``
+    breadcrumb and count ``windows.dropped_late``. Returns the dispatch
+    count (0 == dropped), so a test asserts either outcome directly."""
+    lane = laned._router_admit(session_id)
+    clock = int(laned._window_clocks()[lane])
+    k = clock - int(age)
+    if k < 0:
+        raise ValueError(
+            f"cannot inject an event {age} windows late: lane clock is only {clock}"
+        )
+    return laned.update_sessions({session_id: batch}, window=k)
 
 
 # ----------------------------------------------------------------- executor
