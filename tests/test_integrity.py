@@ -1,0 +1,622 @@
+"""Runtime state-integrity chaos suite (ISSUE 19).
+
+Silent data corruption — a flipped bit from a mercurial core, a replica that
+drifts after a reduce, an install-path H2D fault, a delta corrupted in
+flight — must be *detected* by the fingerprint layer
+(torchmetrics_tpu/integrity.py) and resolved per the ``on_divergence``
+policy triple, never served/snapshotted/shipped as truth. The acceptance
+properties exercised here:
+
+- host (numpy) and device (jitted XLA) fingerprints agree bit-for-bit
+  across every state dtype, and ANY single flipped bit changes them;
+- a 1-bit flip injected between updates is caught within one audit interval
+  in step mode (read-point verify) AND deferred mode (per-shard audit),
+  with shard attribution for replica skew;
+- ``"restore"`` converges bit-exact with the fault-free run; ``"degraded"``
+  serves the last-good value with staleness attribution;
+- recovery mirrors that diverge from the state they claim to equal rebuild
+  instead of serving corrupt rollback rows;
+- checkpoint restore re-fingerprints the INSTALLED state against the
+  manifest and falls back through the rotation like a torn file;
+- a fleet delta corrupted in flight hash-mismatches at the ledger, drops
+  without merging, quarantines, and heals through the full resync —
+  converging bit-exact.
+
+Runs on the 8-fake-device CPU mesh from conftest.py. Exact float claims use
+multiples of 1/8 so fp32 sums carry no rounding to hide behind.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, "/root/repo/tests")
+
+import torchmetrics_tpu as tm  # noqa: E402
+from torchmetrics_tpu import Metric, MetricCollection, obs  # noqa: E402
+from torchmetrics_tpu.fleet import (  # noqa: E402
+    FleetTopology,
+    LeafExporter,
+    LeafLedger,
+    Uplink,
+    build_fleet,
+    payload_checksum,
+)
+from torchmetrics_tpu.integrity import (  # noqa: E402
+    DeferredIntegrity,
+    IntegrityAuditor,
+    device_fingerprints,
+    device_shard_fingerprints,
+    expanded_divergences,
+    fingerprint_digest,
+    host_fingerprints,
+    host_leaf_fingerprint,
+    replica_divergences,
+)
+from torchmetrics_tpu.io import restore_state, save_state  # noqa: E402
+from torchmetrics_tpu.io.checkpoint import load_manifest  # noqa: E402
+from torchmetrics_tpu.ops.async_read import drain_pipeline  # noqa: E402
+from torchmetrics_tpu.ops.executor import make_deferred_collection_step  # noqa: E402
+from torchmetrics_tpu.parallel.class_shard import ClassShardMirror  # noqa: E402
+from torchmetrics_tpu.quarantine import DegradedValue, LaneStateMirror  # noqa: E402
+from torchmetrics_tpu.testing import faults  # noqa: E402
+from torchmetrics_tpu.utils.exceptions import (  # noqa: E402
+    CheckpointCorruptionError,
+    StateCorruptionError,
+    StateDivergenceError,
+)
+
+NO_SLEEP = lambda s: None  # noqa: E731 — injected backoff clock
+
+
+def _counter(name):
+    return obs.telemetry_snapshot()["counters"].get(name, 0)
+
+
+def _mesh(d=8):
+    return Mesh(np.array(jax.devices()[:d]), ("batch",))
+
+
+def _put(mesh, arr, spec=P("batch")):
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+def _batches(n, seed=0, width=8):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(-40, 40, width) / 8.0).astype(np.float32) for _ in range(n)]
+
+
+class _SumLike(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+# ---------------------------------------------------------------------------
+# fingerprint primitives: host/device agreement, sensitivity, shard folds
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprints:
+    DTYPES = [
+        np.float32,
+        np.float64,
+        np.int32,
+        np.int64,
+        np.uint8,
+        np.int16,
+        np.bool_,
+    ]
+
+    @pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: np.dtype(d).name)
+    def test_host_device_agree(self, dtype):
+        rng = np.random.RandomState(11)
+        if dtype == np.bool_:
+            arr = rng.rand(3, 5) > 0.5
+        else:
+            arr = (rng.randint(-100, 100, (3, 5))).astype(dtype)
+        dev = np.asarray(list(device_fingerprints({"x": jnp.asarray(arr)}).values())[0])
+        # fingerprint the DEVICE array's bits: jax may truncate 64-bit input
+        host = host_leaf_fingerprint(np.asarray(jnp.asarray(arr)))
+        np.testing.assert_array_equal(dev, host)
+        assert dev.dtype == np.uint32 and dev.shape == (2,)
+
+    def test_bfloat16_agrees(self):
+        arr = jnp.arange(24, dtype=jnp.bfloat16).reshape(4, 6) / 8
+        dev = np.asarray(list(device_fingerprints({"x": arr}).values())[0])
+        host = host_leaf_fingerprint(np.asarray(arr))
+        np.testing.assert_array_equal(dev, host)
+
+    def test_single_bit_flip_changes_fingerprint(self):
+        arr = (np.arange(32, dtype=np.float32) / 8.0).reshape(4, 8)
+        clean = host_leaf_fingerprint(arr)
+        for seed in range(8):
+            bad, bits = faults._flip_bits_host(arr, 1, seed)
+            assert len(bits) == 1
+            assert not np.array_equal(host_leaf_fingerprint(bad), clean)
+
+    def test_order_insensitive_and_empty(self):
+        rng = np.random.RandomState(3)
+        arr = rng.rand(64).astype(np.float32)
+        shuffled = arr[rng.permutation(64)]
+        np.testing.assert_array_equal(
+            host_leaf_fingerprint(arr), host_leaf_fingerprint(shuffled)
+        )
+        np.testing.assert_array_equal(
+            host_leaf_fingerprint(np.zeros((0,), np.float32)), np.zeros(2, np.uint32)
+        )
+
+    def test_shard_fps_match_per_row_host(self):
+        stacked = jnp.asarray(
+            (np.random.RandomState(5).randint(-100, 100, (8, 4)) / 8.0).astype(np.float32)
+        )
+        per_shard = np.asarray(list(device_shard_fingerprints({"s": stacked}).values())[0])
+        assert per_shard.shape == (8, 2)
+        host = np.asarray(stacked)
+        for i in range(8):
+            np.testing.assert_array_equal(per_shard[i], host_leaf_fingerprint(host[i]))
+
+    def test_digest_deterministic_and_sensitive(self):
+        state = {"a": np.arange(4, dtype=np.float32), "b": np.asarray(7, np.int64)}
+        d1 = fingerprint_digest(host_fingerprints(state))
+        d2 = fingerprint_digest(host_fingerprints({k: np.array(v) for k, v in state.items()}))
+        assert d1 == d2 and len(d1) == 64
+        bad, _ = faults._flip_bits_host(state["a"], 1, 0)
+        assert fingerprint_digest(host_fingerprints({**state, "a": bad})) != d1
+
+    def test_expanded_divergences_families(self):
+        # a clean expand_canonical layout: sum carries identity rows, mean is
+        # replicated — then skew one shard of each and demand attribution
+        val = (np.arange(4) / 8.0).astype(np.float32)
+        states = {
+            "s": jnp.asarray(np.stack([val] + [np.zeros(4, np.float32)] * 7)),
+            "m": jnp.asarray(np.stack([val] * 8)),
+        }
+        reds = {"s": "sum", "m": "mean"}
+        assert expanded_divergences(states, reds) == []
+        skewed, info = faults.skew_replica({"m": states["m"]}, shard=5, seed=2)
+        found = expanded_divergences({"m": skewed["m"], "s": states["s"]}, reds)
+        assert len(found) == 1 and found[0].shard == 5 and found[0].field == "m"
+
+    def test_replica_divergences_clean_on_replicated(self):
+        mesh = _mesh(8)
+        rep = jax.device_put(
+            jnp.arange(4, dtype=jnp.float32), NamedSharding(mesh, P())
+        )
+        assert replica_divergences({"r": rep}) == []
+
+
+# ---------------------------------------------------------------------------
+# step mode: the metric-attached auditor (chain surface + policies)
+# ---------------------------------------------------------------------------
+
+
+class TestChainAudit:
+    def _metric(self, n=3, **kw):
+        m = _SumLike(executor=False)
+        auditor = m.attach_integrity(**kw)
+        for b in _batches(n, seed=21):
+            m.update(jnp.asarray(b))
+        drain_pipeline(30.0)
+        return m, auditor
+
+    def test_clean_audit_ok(self):
+        m, auditor = self._metric()
+        report = auditor.audit()
+        assert report.ok and report.checked >= 1 and report.action == "none"
+        assert auditor.stats["captures"] == 3 and auditor.baseline_count == 3
+        assert m.integrity is auditor
+        assert float(m.compute()) == float(np.sum(np.concatenate(_batches(3, seed=21))))
+
+    def test_bit_flip_detected_at_read_within_one_interval(self):
+        """The acceptance property: a 1-bit flip between updates is caught at
+        the very next read — no extra updates, no explicit audit call."""
+        m, auditor = self._metric(on_divergence="raise")
+        before = _counter("integrity.divergences")
+        info = faults.flip_state_bits(m, seed=4)
+        with pytest.raises(StateDivergenceError) as err:
+            m.compute()
+        assert err.value.surface == "chain"
+        assert info["field"] in err.value.field
+        assert auditor.stats["divergences"] >= 1
+        assert _counter("integrity.divergences") > before
+
+    def test_explicit_audit_raises_flighted(self):
+        m, auditor = self._metric(on_divergence="raise")
+        faults.flip_state_bits(m, seed=1)
+        with pytest.raises(StateDivergenceError):
+            auditor.audit()
+        crumbs = [
+            c for c in obs.dump_diagnostics()["breadcrumbs"]
+            if c.get("kind") == "integrity_divergence"
+        ]
+        assert crumbs and crumbs[-1]["data"]["owner"] == "_SumLike"
+
+    def test_policy_restore_heals_bit_exact(self):
+        m, auditor = self._metric(on_divergence="restore")
+        want = float(m.compute())
+        clean_fp = host_fingerprints({k: np.asarray(v) for k, v in m._copy_state_dict().items()})
+        faults.flip_state_bits(m, seed=9)
+        got = float(m.compute())  # read-point restore, then the read proceeds
+        assert got == want
+        assert auditor.stats["restores"] == 1
+        healed = host_fingerprints({k: np.asarray(v) for k, v in m._copy_state_dict().items()})
+        assert fingerprint_digest(healed) == fingerprint_digest(clean_fp)
+        m.update(jnp.asarray([8.0]))  # the run continues on verified bits
+        drain_pipeline(30.0)
+        assert float(m.compute()) == want + 8.0
+
+    def test_policy_degraded_serves_last_good(self):
+        m, auditor = self._metric(on_divergence="degraded")
+        want = float(m.compute())  # caches the last-good value
+        faults.flip_state_bits(m, seed=2)
+        got = m.compute()
+        assert isinstance(got, DegradedValue)
+        assert float(got.value) == want
+        assert auditor.stats["degraded_serves"] == 1
+
+    def test_restore_without_snapshot_escalates_to_raise(self):
+        m, _ = self._metric(on_divergence="restore", snapshots=False)
+        faults.flip_state_bits(m, seed=3)
+        with pytest.raises(StateDivergenceError):
+            m.compute()
+
+    def test_async_read_verifies_on_worker_raise(self):
+        m, _ = self._metric(on_divergence="raise")
+        faults.flip_state_bits(m, seed=5)
+        fut = m.compute_async()
+        with pytest.raises(StateDivergenceError):
+            fut.result(60.0)
+
+    def test_async_read_verifies_on_worker_degraded(self):
+        m, _ = self._metric(on_divergence="degraded")
+        want = float(m.compute())
+        faults.flip_state_bits(m, seed=6)
+        got = m.compute_async().result(60.0)
+        assert isinstance(got, DegradedValue) and float(got.value) == want
+
+    def test_stale_baseline_still_runs_replica_checks(self):
+        m = _SumLike(executor=False)
+        auditor = m.attach_integrity(every_n_updates=100)  # cadence never fires
+        m.update(jnp.asarray([1.0]))
+        report = auditor.audit()
+        assert report.ok and report.checked == 0  # no baseline yet: nothing chained
+        assert auditor.stats["audits"] == 1
+
+    def test_detach_and_pickle_drop_auditor(self):
+        import pickle
+
+        m, auditor = self._metric()
+        blob = pickle.dumps(m)
+        m2 = pickle.loads(blob)
+        assert m2.integrity is None
+        auditor.detach()
+        assert m.integrity is None
+        faults.flip_state_bits(m, seed=7)
+        m.compute()  # detached: the read no longer audits
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="on_divergence"):
+            IntegrityAuditor(_SumLike(executor=False), on_divergence="bogus")
+        with pytest.raises(ValueError, match="every_n_updates"):
+            IntegrityAuditor(_SumLike(executor=False), every_n_updates=0)
+        with pytest.raises(ValueError, match="on_divergence"):
+            DeferredIntegrity(object(), on_divergence="explode")
+
+
+# ---------------------------------------------------------------------------
+# deferred mode: per-shard audits of the carried states
+# ---------------------------------------------------------------------------
+
+
+class TestDeferredAudit:
+    def _step(self, on_divergence="raise", shadow=False):
+        coll = MetricCollection({"m": _SumLike(executor=False)}, compute_groups=False)
+        step = make_deferred_collection_step(coll, _mesh(8), axis_name="batch")
+        if shadow:
+            step.attach_shadow(every_n_steps=1, on_shard_loss="raise")
+        integ = step.attach_integrity(every_n_steps=1, on_divergence=on_divergence)
+        return step, integ
+
+    def _run(self, step, batches):
+        mesh = _mesh(8)
+        st = step.init_states()
+        for b in batches:
+            st = step.local_step(st, _put(mesh, b))
+        drain_pipeline(30.0)
+        return st
+
+    def test_clean_audit_and_cadence(self):
+        step, integ = self._step()
+        st = self._run(step, _batches(3, seed=41))
+        assert integ.baseline_steps == step.steps
+        report = integ.audit(st)
+        assert report.ok and report.checked >= 1
+        assert integ.stats["captures"] == 3 and step.integrity is integ
+
+    def test_skewed_replica_named_by_shard(self):
+        """1-bit flip in ONE shard row, caught within one audit interval with
+        the offending shard named — the deferred half of the acceptance."""
+        step, integ = self._step(on_divergence="raise")
+        st = self._run(step, _batches(3, seed=42))
+        skewed, info = faults.skew_replica(st, shard=3, seed=1)
+        with pytest.raises(StateDivergenceError) as err:
+            integ.audit(skewed)
+        assert err.value.surface == "chain" and err.value.shard == info["shard"] == 3
+
+    def test_flip_any_leaf_detected(self):
+        step, integ = self._step(on_divergence="degraded")
+        st = self._run(step, _batches(2, seed=43))
+        flipped, _ = faults.flip_state_bits(st, seed=2)
+        report = integ.audit(flipped)
+        assert not report.ok and report.action == "degraded"
+        assert integ.stats["divergences"] >= 1
+
+    def test_restore_converges_bit_exact(self):
+        step, integ = self._step(on_divergence="restore", shadow=True)
+        st = self._run(step, _batches(4, seed=44))
+        clean = step.reduce(st)
+        skewed, _ = faults.skew_replica(st, shard=2, seed=3)
+        report = integ.audit(skewed)
+        assert not report.ok and report.action == "restored"
+        assert report.restored_states is not None
+        healed = step.reduce(report.restored_states)
+        np.testing.assert_array_equal(np.asarray(healed["m"]), np.asarray(clean["m"]))
+        assert integ.stats["restores"] == 1
+        # the loop continues on the restored carry
+        mesh = _mesh(8)
+        extra = _batches(1, seed=45)[0]
+        st2 = step.local_step(report.restored_states, _put(mesh, extra))
+        np.testing.assert_array_equal(
+            np.asarray(step.reduce(st2)["m"]),
+            np.asarray(clean["m"]) + np.float32(extra.sum()),
+        )
+
+    def test_restore_without_shadow_raises(self):
+        step, integ = self._step(on_divergence="restore", shadow=False)
+        st = self._run(step, _batches(2, seed=46))
+        skewed, _ = faults.skew_replica(st, shard=1, seed=4)
+        with pytest.raises(StateDivergenceError):
+            integ.audit(skewed)
+
+
+# ---------------------------------------------------------------------------
+# mirror coherence: diverged recovery mirrors rebuild, never serve
+# ---------------------------------------------------------------------------
+
+
+class TestMirrorCoherence:
+    def test_lane_mirror_divergence_invalidates(self):
+        state = {"hits": jnp.asarray(np.arange(8, dtype=np.float32))}
+        mirror = LaneStateMirror()
+        mirror.snapshot(state, np.asarray([0, 1]), update_count=1, capacity=8)
+        assert mirror.verify(state, 1)  # coherent
+        before = _counter("integrity.mirror_rebuilds")
+        mirror._mirror["hits"], _ = faults._flip_bits_host(mirror._mirror["hits"], 1, 0)
+        assert not mirror.verify(state, 1)
+        assert mirror._mirror is None  # invalidated: next snapshot rebuilds
+        assert _counter("integrity.mirror_rebuilds") > before
+        mirror.snapshot(state, np.asarray([0]), update_count=2, capacity=8)
+        assert mirror.stats["rebuilds"] >= 1 and mirror.verify(state, 2)
+
+    def test_lane_mirror_out_of_phase_is_not_audited(self):
+        state = {"hits": jnp.asarray(np.ones(4, np.float32))}
+        mirror = LaneStateMirror()
+        mirror.snapshot(state, np.asarray([0]), update_count=1, capacity=4)
+        assert mirror.verify(state, 2)  # count moved: nothing coherent to audit
+
+    def test_class_mirror_divergence_invalidates(self):
+        state = {"confmat": jnp.asarray(np.arange(12, dtype=np.int32).reshape(3, 4))}
+        mirror = ClassShardMirror()
+        mirror.snapshot(state, {"confmat": np.asarray([0, 5], np.int64)}, update_count=1)
+        assert mirror.verify(state, 1)
+        mirror._mirror["confmat"], _ = faults._flip_bits_host(mirror._mirror["confmat"], 1, 1)
+        assert not mirror.verify(state, 1)
+        assert mirror._mirror is None
+
+    def test_auditor_heals_attached_mirror(self):
+        m = _SumLike(executor=False)
+        auditor = m.attach_integrity()
+        m.update(jnp.asarray([1.0]))
+        drain_pipeline(30.0)
+        mirror = LaneStateMirror()
+        state = {k: jnp.asarray(v) for k, v in m._copy_state_dict().items() if k == "total"}
+        mirror.snapshot(state, np.asarray([], np.int64), update_count=1, capacity=1)
+        m.__dict__["_lane_mirror"] = mirror
+        mirror._mirror["total"], _ = faults._flip_bits_host(mirror._mirror["total"], 1, 0)
+        report = auditor.audit()  # mirror surface self-heals; chain stays ok
+        assert report.ok
+        assert auditor.stats.get("mirror_rebuilds", 0) == 1
+        assert mirror._mirror is None
+        del m.__dict__["_lane_mirror"]
+
+
+# ---------------------------------------------------------------------------
+# verified recovery: manifest fingerprints + installed-state verification
+# ---------------------------------------------------------------------------
+
+
+class TestVerifiedRestore:
+    def test_manifest_carries_fingerprints(self, tmp_path):
+        m = _SumLike(executor=False)
+        m.update(jnp.asarray(_batches(1, seed=51)[0]))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        leaves = load_manifest(path)["leaves"]
+        with_fp = [e for e in leaves if e.get("fingerprint")]
+        assert with_fp, "manifest leaves carry pre-save fingerprints"
+        for e in with_fp:
+            assert len(e["fingerprint"]) == 2
+            assert all(0 <= w < 2**32 for w in e["fingerprint"])
+
+    def test_clean_restore_verifies_and_passes(self, tmp_path):
+        m = _SumLike(executor=False)
+        for b in _batches(2, seed=52):
+            m.update(jnp.asarray(b))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        m2 = _SumLike(executor=False)
+        restore_state(path, m2)
+        assert float(m2.compute()) == float(m.compute())
+
+    def _corrupting_load(self, cls, monkeypatch, only_first=True):
+        """Patch ``load_state`` to flip one bit during install — the
+        H2D/aliasing corruption the post-install verification exists for."""
+        orig = cls.load_state
+        calls = {"n": 0}
+
+        def bad_load(self, state, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1 or not only_first:
+                state = dict(state)
+                bad, _ = faults._flip_bits_host(np.asarray(state["total"]), 1, 13)
+                state["total"] = bad
+            return orig(self, state, **kw)
+
+        monkeypatch.setattr(cls, "load_state", bad_load)
+        return calls
+
+    def test_install_corruption_detected(self, tmp_path, monkeypatch):
+        m = _SumLike(executor=False)
+        m.update(jnp.asarray(_batches(1, seed=53)[0]))
+        path = str(tmp_path / "snap.ckpt")
+        save_state(m, path)
+        before = _counter("checkpoint.integrity_mismatches")
+        m2 = _SumLike(executor=False)
+        self._corrupting_load(_SumLike, monkeypatch)
+        with pytest.raises(StateDivergenceError) as err:
+            restore_state(path, m2)
+        assert err.value.surface == "restore" and "total" in str(err.value.field)
+        assert isinstance(err.value, StateCorruptionError)  # rotation-scan compatible
+        assert _counter("checkpoint.integrity_mismatches") > before
+
+    def test_rotation_falls_back_past_install_mismatch(self, tmp_path, monkeypatch):
+        """An installed-state fingerprint mismatch is treated exactly like a
+        torn file: breadcrumb, counter, fall back to the next-older snapshot."""
+        store = str(tmp_path / "store")
+        m = _SumLike(executor=False)
+        checkpoints = []
+        for b in _batches(3, seed=54):
+            m.update(jnp.asarray(b))
+            save_state(m, store, keep=3)
+            checkpoints.append(float(m.compute()))
+        m2 = _SumLike(executor=False)
+        self._corrupting_load(_SumLike, monkeypatch)  # newest install corrupts
+        warned = []
+        info = restore_state(store, m2, on_fallback=lambda p, e: warned.append((p, e)))
+        assert info["fallbacks_skipped"] == 1 and len(warned) == 1
+        assert isinstance(warned[0][1], StateDivergenceError)
+        assert float(m2.compute()) == checkpoints[1]  # newest VERIFIED, not newest
+
+
+# ---------------------------------------------------------------------------
+# fleet surface: ship-time checksums, corrupt-delta drop + quarantine + heal
+# ---------------------------------------------------------------------------
+
+FLEET_REDS = {"total": "sum", "n": "sum"}
+
+
+class _Leaf:
+    """One simulated leaf; draws multiples of 1/8 so fp32 sums are exact."""
+
+    def __init__(self, seed):
+        self.rng = np.random.RandomState(seed)
+        self.state = {
+            "total": np.zeros(4, np.float32),
+            "n": np.asarray(0, np.int64),
+        }
+        self.updates = 0
+
+    def update(self):
+        x = (self.rng.randint(-40, 40, 4) / 8.0).astype(np.float32)
+        self.state["total"] = self.state["total"] + x
+        self.state["n"] = self.state["n"] + 1
+        self.updates += 1
+
+    def source(self):
+        return lambda: (dict(self.state), dict(FLEET_REDS), self.updates)
+
+
+class TestFleetChecksum:
+    def test_payload_checksum_deterministic_and_sensitive(self):
+        payload = {"total": np.arange(4, dtype=np.float32), "n": np.asarray(3, np.int64)}
+        c1 = payload_checksum(payload)
+        c2 = payload_checksum({k: np.array(v) for k, v in payload.items()})
+        assert c1 == c2 and len(c1) == 64
+        bad, _ = faults._flip_bits_host(payload["total"], 1, 0)
+        assert payload_checksum({**payload, "total": bad}) != c1
+
+    def test_exports_are_stamped(self):
+        leaf = _Leaf(1)
+        exporter = LeafExporter(
+            "leaf/0", leaf.source(), Uplink({}, sleep=NO_SLEEP), "agg/root", outbox_limit=64
+        )
+        leaf.update()
+        delta = exporter.export()
+        assert delta.checksum == payload_checksum(delta.payload)
+
+    def test_ledger_drops_corrupt_delta_and_heals_on_full(self):
+        import copy
+        import dataclasses
+
+        leaf = _Leaf(2)
+        exporter = LeafExporter(
+            "leaf/0", leaf.source(), Uplink({}, sleep=NO_SLEEP), "agg/root", outbox_limit=64
+        )
+        leaf.update()
+        clean = exporter.export()  # epoch 1, kind="full"
+        bad_payload = copy.deepcopy(clean.payload)
+        assert any(
+            isinstance(v, np.ndarray) and v.size for v in jax.tree_util.tree_leaves(bad_payload)
+        )
+        for v in jax.tree_util.tree_leaves(bad_payload):
+            if isinstance(v, np.ndarray) and v.size:
+                v.reshape(-1).view(np.uint8)[0] ^= np.uint8(1)
+                break
+        corrupt = dataclasses.replace(clean, payload=bad_payload)
+        ledger = LeafLedger("leaf/0", watermark=8)
+        before = _counter("fleet.deltas_corrupt")
+        ack = ledger.offer(corrupt)
+        assert ack["needs_full"] and ack["applied_epoch"] == 0
+        assert ledger.quarantined and ledger.stats["corrupt_dropped"] == 1
+        assert _counter("fleet.deltas_corrupt") > before
+        # the re-shipped CLEAN full resync heals the quarantine
+        ack2 = ledger.offer(clean)
+        assert ack2["applied_epoch"] == 1 and not ledger.quarantined
+
+    def test_corrupt_delta_converges_bit_exact_after_resync(self):
+        """End-to-end acceptance: a delta corrupted in flight never merges;
+        the quarantine → full-resync cycle converges the global view onto the
+        exact fault-free state."""
+        topo = FleetTopology(["leaf/0", "leaf/1"])
+        fleet = build_fleet(topo, sleep=NO_SLEEP)
+        leaves = {lid: _Leaf(10 + i) for i, lid in enumerate(topo.leaves)}
+        exporters = {lid: fleet.leaf_exporter(lid, leaves[lid].source()) for lid in topo.leaves}
+        with faults.corrupt_delta_payload("leaf/0", n=1) as injected:
+            for lid in topo.leaves:
+                leaves[lid].update()
+                exporters[lid].ship(wait=True)
+        assert injected["corrupted"] == 1
+        assert exporters["leaf/0"].stats["resyncs_requested"] == 1
+        for _ in range(2):  # the resync + one steady round
+            for lid in topo.leaves:
+                leaves[lid].update()
+                exporters[lid].ship(wait=True)
+        view = fleet.view()
+        assert view.healthy() and view.coverage() == 1.0
+        got = view.read()
+        assert not isinstance(got, DegradedValue)
+        want_total = leaves["leaf/0"].state["total"] + leaves["leaf/1"].state["total"]
+        np.testing.assert_array_equal(np.asarray(got["total"], np.float32), want_total)
+        assert int(np.asarray(got["n"])) == sum(l.updates for l in leaves.values())

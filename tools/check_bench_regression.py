@@ -136,6 +136,27 @@ def check_bench(
                         " the shard-shadow refresh is taxing the steady deferred step loop",
                     )
                 )
+        # state-integrity gate (ISSUE 19): a config reporting the fingerprint
+        # auditor's steady-path overhead column is gated against its baseline
+        # cap (default 1% — the silent-data-corruption acceptance bound: one
+        # per-shard XOR+sum dispatch per chunk must stay in the noise); the
+        # integrity_epoch_us_per_step row rides along ungated (recorded for
+        # trajectory only)
+        ioverhead = result.get("integrity_overhead_pct")
+        if isinstance(ioverhead, (int, float)):
+            base = baselines.get(name, {})
+            cap = base.get("integrity_overhead_max_pct", 1.0) if isinstance(base, dict) else 1.0
+            if float(ioverhead) > float(cap):
+                violations.append(
+                    Violation(
+                        name,
+                        None,
+                        threshold,
+                        f"integrity_overhead_pct {ioverhead:.2f} exceeds the {cap}% cap —"
+                        " the fingerprint audit is taxing the steady deferred step loop"
+                        " (docs/ROBUSTNESS.md 'Silent data corruption')",
+                    )
+                )
         # telemetry-overhead gate (ISSUE 13): the counters + flight recorder +
         # histograms fully on (spans included) must not tax the deferred epoch
         # loop beyond the cap (real-hardware acceptance <1%; the 1-vCPU VM

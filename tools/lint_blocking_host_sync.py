@@ -37,6 +37,7 @@ from typing import List, NamedTuple
 HOT_PATH_FILES = (
     "metric.py",
     "collections.py",
+    "integrity.py",
     "lanes.py",
     "quarantine.py",
     "windows.py",
@@ -136,6 +137,17 @@ ALLOWLIST = {
     ),
     "metric.py::_check_field_finite": (
         "validated restore (check_finite): a deliberate read-point validation"
+    ),
+    # --- integrity (docs/ROBUSTNESS.md "Silent data corruption"): the audit
+    #     surfaces fold fingerprints over ALREADY-FETCHED host arrays on the
+    #     read pipeline worker or at read points — never the update dispatch
+    "integrity.py::host_leaf_fingerprint": (
+        "host-side fingerprint fold: takes a host array by contract (callers"
+        " fetch via the pipeline); np.array here packs two uint32 words"
+    ),
+    "integrity.py::expanded_divergences": (
+        "post-expand replica audit: compares host-fetched shard stacks against"
+        " reduction identities — an audit/read surface, not the step loop"
     ),
     # --- checkpoint/host-copy: the ISSUE-named allowlist entries
     "io/checkpoint.py::host_copy_tree": (

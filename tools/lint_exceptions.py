@@ -51,6 +51,9 @@ RECORDER_NAMES = {
     "debug",
     "error",
     "exception",
+    # a fault breadcrumb IS a recorded reason — it lands in the flight
+    # recorder with the surrounding span/counter window (obs/flight.py)
+    "fault_breadcrumb",
 }
 
 #: an assignment to any of these counts as recording the reason

@@ -35,6 +35,7 @@ from typing import List, NamedTuple
 #: of these inside a raise statement must route through the flight helper
 TYPED_ERRORS = (
     "StateCorruptionError",
+    "StateDivergenceError",
     "SyncTimeoutError",
     "CheckpointCorruptionError",
     "TopologyMismatchError",
@@ -53,6 +54,7 @@ HELPER_NAMES = ("flighted",)
 COVERED_MODULES = (
     "metric.py",
     "collections.py",
+    "integrity.py",
     "lanes.py",
     "quarantine.py",
     "windows.py",

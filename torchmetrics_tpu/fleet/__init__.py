@@ -41,6 +41,7 @@ from torchmetrics_tpu.fleet.delta import (  # noqa: F401
     apply_delta,
     delta_since,
     field_mode,
+    payload_checksum,
 )
 from torchmetrics_tpu.fleet.leaf import LeafExporter, deferred_source, metric_source  # noqa: F401
 from torchmetrics_tpu.fleet.topology import FleetTopology  # noqa: F401
@@ -64,4 +65,5 @@ __all__ = [
     "delta_since",
     "field_mode",
     "metric_source",
+    "payload_checksum",
 ]

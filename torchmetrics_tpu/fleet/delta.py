@@ -40,6 +40,7 @@ under ``precision="quantized"`` (integer fields always exact).
 """
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
@@ -55,6 +56,7 @@ __all__ = [
     "apply_delta",
     "delta_since",
     "field_mode",
+    "payload_checksum",
 ]
 
 #: delta kinds: ``"delta"`` builds on the previous epoch, ``"full"`` replaces
@@ -82,6 +84,49 @@ class Delta:
     update_count: int
     created_s: float = field(default_factory=time.time)
     ctx: Optional[Any] = None  # obs.TraceContext captured at ship time
+    #: sha256 of the payload wire bytes, stamped by the exporter at ship time
+    #: (integrity.py fleet surface): the ledger re-hashes before merging, so a
+    #: delta corrupted in flight/relay DROPS (quarantine -> full resync)
+    #: instead of poisoning the fleet accumulation. None = legacy sender.
+    checksum: Optional[str] = None
+
+
+def payload_checksum(payload: Dict[str, Any]) -> str:
+    """Deterministic sha256 over a delta's wire payload — dict keys sorted,
+    arrays hashed as ``dtype/shape/raw bytes`` — so sender and receiver
+    compute the identical digest from the identical bits, independent of
+    dict insertion order or array layout."""
+    h = hashlib.sha256()
+
+    def feed(value: Any) -> None:
+        if isinstance(value, dict):
+            h.update(b"{")
+            for k in sorted(value, key=str):
+                h.update(str(k).encode("utf-8"))
+                h.update(b"=")
+                feed(value[k])
+                h.update(b";")
+            h.update(b"}")
+        elif isinstance(value, (list, tuple)):
+            h.update(b"[")
+            for el in value:
+                feed(el)
+                h.update(b",")
+            h.update(b"]")
+        elif hasattr(value, "dtype") and hasattr(value, "shape"):
+            arr = np.ascontiguousarray(value)
+            h.update(f"a:{arr.dtype}:{arr.shape}:".encode("utf-8"))
+            h.update(arr.tobytes())
+        elif isinstance(value, bytes):
+            h.update(b"b:")
+            h.update(value)
+        elif value is None:
+            h.update(b"n")
+        else:
+            h.update(f"s:{value!r}".encode("utf-8"))
+
+    feed(payload)
+    return h.hexdigest()
 
 
 def field_mode(fx: Any, dtype: Any) -> str:
@@ -201,6 +246,7 @@ class LeafLedger:
             "duplicates": 0,
             "reordered": 0,
             "late_dropped": 0,
+            "corrupt_dropped": 0,
             "quarantines": 0,
             "resyncs": 0,
         }
@@ -239,6 +285,35 @@ class LeafLedger:
                 ),
                 domain="fleet",
             )
+        if delta.checksum is not None and payload_checksum(delta.payload) != delta.checksum:
+            # corrupted in flight: the payload no longer matches the digest
+            # the exporter stamped at ship time. NEVER merge — an
+            # accumulation extended by corrupt bits cannot be repaired by
+            # later deltas — drop it and flip the leaf to quarantine so the
+            # next ack demands a full resync (integrity.py fleet surface).
+            # A transport fault, not a protocol violation: no raise (the
+            # uplink never retries FleetProtocolError; the resync heals).
+            self.needs_full = True
+            self.quarantined = True
+            self.pending.clear()
+            self.stats["corrupt_dropped"] += 1
+            self.stats["quarantines"] += 1
+            obs.counter_inc("fleet.deltas_corrupt")
+            obs.fault_breadcrumb(
+                "fleet_delta_corrupt",
+                domain="integrity",
+                data={
+                    "leaf": delta.leaf,
+                    "epoch": delta.epoch,
+                    "kind": delta.kind,
+                    "expected": delta.checksum,
+                },
+            )
+            return {
+                "leaf": self.leaf,
+                "applied_epoch": self.applied_epoch,
+                "needs_full": True,
+            }
 
         if delta.kind == "full":
             if delta.epoch <= self.applied_epoch:

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from torchmetrics_tpu.fleet.delta import Delta, delta_since
+from torchmetrics_tpu.fleet.delta import Delta, delta_since, payload_checksum
 from torchmetrics_tpu.fleet.transport import Uplink
 
 __all__ = ["LeafExporter", "deferred_source", "metric_source"]
@@ -187,6 +187,9 @@ class LeafExporter:
                 update_count=int(update_count),
                 created_s=time.time(),
                 ctx=obs.capture_context(),
+                # ship-time payload digest: the ledger re-hashes before any
+                # merge so in-flight corruption drops + resyncs, never merges
+                checksum=payload_checksum(wire),
             )
             self._prev = host
             self._need_full = False

@@ -1,0 +1,851 @@
+"""Runtime state-integrity auditing: bit-exact fingerprints over live state.
+
+Every durability layer before this one (rollback, quarantine, elastic
+restore, exactly-once fleet deltas) assumes the bits it protects are
+*correct*: snapshots are checksummed at rest, but live device state, host
+recovery mirrors, and in-flight fleet deltas had zero integrity coverage —
+a flipped bit from a mercurial core, a donation/aliasing bug, or a replica
+that silently drifts after a reduce would be served, snapshotted, and
+shipped fleet-wide as truth. This module is the detection layer
+(docs/ROBUSTNESS.md "Silent data corruption").
+
+Fingerprint contract
+--------------------
+
+A leaf fingerprint is two ``uint32`` words over the leaf's raw bits:
+
+- bitcast every element to ``uint32`` (1/2-byte dtypes zero-extend through
+  their same-width unsigned view; 8-byte dtypes split into two words;
+  ``bool`` maps to 0/1), then
+- fold with XOR (word 0) and wrap-around SUM mod 2**32 (word 1).
+
+Both folds are order-insensitive, so the host (numpy) and device (jitted
+XLA) implementations agree bit-for-bit, shards can be fingerprinted
+independently, and — the property everything below leans on — **identical
+bits give identical fingerprints with no float tolerance**, while any
+single flipped bit changes the XOR word. The device fingerprint of a whole
+state pytree is ONE cheap dispatch returning a few words per leaf; the
+host readback rides the async read pipeline so the step loop never blocks.
+
+Audit surfaces (one policy knob: ``on_divergence="raise"|"degraded"|"restore"``)
+-------------------------------------------------------------------------------
+
+- **chain** — :class:`IntegrityAuditor` rides the committed-update observer
+  seam (like ``io.checkpoint.Autosaver``): on a cadence it records the
+  fingerprint (and, by default, a host copy) of the just-committed state;
+  an audit or a read re-fingerprints the live state and, while the update
+  count has not moved, the bits must match. Catches anything that mutates
+  accumulated state *outside* an update.
+- **replica** — values that are replicated by construction (post-reduce
+  outputs, per-device copies of a synced state, the replicated rows of an
+  ``expand_canonical`` install) must be bit-identical across replicas; a
+  tiny fingerprint gather (:func:`replica_divergences`,
+  :func:`expanded_divergences`) catches drift.
+- **mirror / restore** — host recovery mirrors
+  (:class:`~torchmetrics_tpu.quarantine.LaneStateMirror`,
+  :class:`~torchmetrics_tpu.parallel.class_shard.ClassShardMirror`) verify
+  their fold-forward chain against the device state they claim to mirror
+  and rebuild instead of serving corrupt recovery state; checkpoint
+  manifests carry per-leaf fingerprints and ``restore_state``
+  re-fingerprints the *installed* device state (io/checkpoint.py), catching
+  H2D/aliasing corruption that at-rest checksums structurally cannot.
+
+Divergences raise :class:`~torchmetrics_tpu.utils.exceptions.StateDivergenceError`
+(flighted, ``integrity`` domain), serve the last-good value as a
+:class:`~torchmetrics_tpu.quarantine.DegradedValue`, or restore from the
+auditor's verified host snapshot / the shard shadow — the same policy
+triple as ``on_shard_loss``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import obs
+from torchmetrics_tpu.utils.exceptions import StateDivergenceError
+
+__all__ = [
+    "INTEGRITY_POLICIES",
+    "Divergence",
+    "IntegrityReport",
+    "IntegrityAuditor",
+    "DeferredIntegrity",
+    "fingerprint_digest",
+    "device_fingerprints",
+    "device_shard_fingerprints",
+    "host_fingerprints",
+    "host_leaf_fingerprint",
+    "replica_divergences",
+    "expanded_divergences",
+]
+
+#: valid ``on_divergence`` policies (docs/ROBUSTNESS.md "Silent data
+#: corruption" policy table) — the same triple as ``on_shard_loss``
+INTEGRITY_POLICIES = ("raise", "degraded", "restore")
+
+#: reserved state() keys that are bookkeeping, not audited bits
+_RESERVED_KEYS = ("_update_count", "_sharded_shards", "_window_meta")
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint primitives — device (jitted) and host (numpy) mirrors
+# ---------------------------------------------------------------------------
+
+def _device_words(x: jnp.ndarray) -> jnp.ndarray:
+    """Bitcast a device array to a flat ``uint32`` word vector (dtype is
+    static under jit, so the branches trace away)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint32)
+    elif x.dtype.itemsize >= 4:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    else:
+        narrow = jnp.uint8 if x.dtype.itemsize == 1 else jnp.uint16
+        u = jax.lax.bitcast_convert_type(x, narrow).astype(jnp.uint32)
+    return u.reshape(-1)
+
+
+def _device_leaf_fp(x: jnp.ndarray) -> jnp.ndarray:
+    """``(2,) uint32`` — (xor-fold, sum mod 2**32) of one leaf's bits."""
+    u = _device_words(x)
+    if u.size == 0:
+        return jnp.zeros((2,), jnp.uint32)
+    xor = jax.lax.reduce(u, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+    total = jnp.sum(u, dtype=jnp.uint32)
+    return jnp.stack([xor, total])
+
+
+def _device_shard_fp(x: jnp.ndarray) -> jnp.ndarray:
+    """``(S, 2) uint32`` — per-shard fingerprints of a stacked leaf (leading
+    axis = shards), each shard folded independently so drift localises."""
+    x = jnp.asarray(x)
+    shards = x.shape[0] if x.ndim else 1
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint32)
+    elif x.dtype.itemsize >= 4:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    else:
+        narrow = jnp.uint8 if x.dtype.itemsize == 1 else jnp.uint16
+        u = jax.lax.bitcast_convert_type(x, narrow).astype(jnp.uint32)
+    u = u.reshape(shards, -1)
+    if u.shape[1] == 0:
+        return jnp.zeros((shards, 2), jnp.uint32)
+    xor = jax.lax.reduce(u, jnp.uint32(0), jax.lax.bitwise_xor, (1,))
+    total = jnp.sum(u, axis=1, dtype=jnp.uint32)
+    return jnp.stack([xor, total], axis=-1)
+
+
+def _is_arrayish(leaf: Any) -> bool:
+    return hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+
+
+def _array_leaves(tree: Any) -> List[Tuple[str, Any]]:
+    """Stable ``(path, leaf)`` pairs of the array leaves of a state pytree
+    (reserved bookkeeping keys and python scalars are skipped) — the SAME
+    walk on host and device, so fingerprint keys always line up."""
+    if isinstance(tree, dict):
+        tree = {k: v for k, v in tree.items() if k not in _RESERVED_KEYS}
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        (jax.tree_util.keystr(path), leaf)
+        for path, leaf in flat
+        if _is_arrayish(leaf)
+    ]
+
+
+def _tree_fp_device(tree: Any) -> Dict[str, jnp.ndarray]:
+    return {key: _device_leaf_fp(leaf) for key, leaf in _array_leaves(tree)}
+
+
+def _tree_shard_fp_device(tree: Any) -> Dict[str, jnp.ndarray]:
+    return {key: _device_shard_fp(leaf) for key, leaf in _array_leaves(tree)}
+
+
+#: structure-specialised jitted fingerprint dispatches; jax.jit caches one
+#: executable per (treedef, shapes, dtypes) — fixed-shape states reuse it
+_fp_jit = jax.jit(_tree_fp_device)
+_shard_fp_jit = jax.jit(_tree_shard_fp_device)
+
+
+def device_fingerprints(tree: Any) -> Dict[str, jnp.ndarray]:
+    """Fingerprint every array leaf of ``tree`` in ONE jitted device
+    dispatch; returns ``{path: uint32[2]}`` of *device* arrays (enqueued,
+    not awaited — fetch on the read-pipeline worker)."""
+    return _fp_jit(tree)
+
+
+def device_shard_fingerprints(tree: Any) -> Dict[str, jnp.ndarray]:
+    """Per-shard fingerprints (``{path: uint32[num_shards, 2]}``) of a
+    stacked deferred state pytree, one jitted dispatch."""
+    return _shard_fp_jit(tree)
+
+
+def host_leaf_fingerprint(arr: Any) -> np.ndarray:
+    """Host mirror of :func:`_device_leaf_fp` over a numpy array — agrees
+    bit-for-bit with the device fold (both folds are order-insensitive, so
+    word order under the bitcast does not matter)."""
+    a = np.ascontiguousarray(arr)
+    if a.dtype == np.bool_:
+        u = a.astype(np.uint32).reshape(-1)
+    elif a.dtype.itemsize >= 4:
+        u = a.reshape(-1).view(np.uint32)
+    else:
+        narrow = np.uint8 if a.dtype.itemsize == 1 else np.uint16
+        u = a.reshape(-1).view(narrow).astype(np.uint32)
+    if u.size == 0:
+        return np.zeros((2,), np.uint32)
+    xor = np.bitwise_xor.reduce(u)
+    total = np.sum(u, dtype=np.uint32)
+    return np.array([xor, total], np.uint32)
+
+
+def host_fingerprints(tree: Any) -> Dict[str, np.ndarray]:
+    """Host-side fingerprints of an already-fetched (numpy) state pytree."""
+    return {key: host_leaf_fingerprint(leaf) for key, leaf in _array_leaves(tree)}
+
+
+def fingerprint_digest(fps: Dict[str, Any]) -> str:
+    """Deterministic hex digest of a fingerprint map — the manifest-friendly
+    summary of a whole state (sha256 over the sorted ``path:xor:sum`` lines)."""
+    import hashlib
+
+    lines = []
+    for key in sorted(fps):
+        words = np.ascontiguousarray(fps[key]).reshape(-1)
+        lines.append(f"{key}:" + ":".join(str(int(w)) for w in words))
+    return hashlib.sha256("\n".join(lines).encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Replica-agreement checks
+# ---------------------------------------------------------------------------
+
+class Divergence(NamedTuple):
+    """One detected disagreement, with attribution for the flight record."""
+
+    surface: str                    # "chain" | "replica" | "mirror" | "restore"
+    field: str                      # leaf path within the audited pytree
+    shard: Optional[int]            # replica/shard index when one is implicated
+    expected: Tuple[int, ...]       # fingerprint words believed correct
+    observed: Tuple[int, ...]       # fingerprint words actually found
+
+
+class IntegrityReport(NamedTuple):
+    """Outcome of one audit pass."""
+
+    ok: bool
+    checked: int                    # array leaves fingerprint-compared
+    divergences: Tuple[Divergence, ...]
+    update_count: Optional[int]     # count the audited bits belong to
+    policy: str
+    action: str                     # "none" | "degraded" | "restored" | "stale_baseline"
+    restored_states: Any = None     # fresh states when a deferred restore fired
+
+
+def _fp_words(fp: Any) -> Tuple[int, ...]:
+    return tuple(int(w) for w in np.ascontiguousarray(fp).reshape(-1))
+
+
+def replica_divergences(tree: Any) -> List[Divergence]:
+    """Bit-compare the per-device copies of every fully-replicated array
+    leaf of ``tree`` (a tiny fingerprint gather: one host fold per replica).
+    Replicated arrays are identical by construction — a reduce output, a
+    synced state — so ANY disagreement is silent corruption on one device.
+    Blocking (fetches each replica): call from the read-pipeline worker or
+    an explicit audit, never the step loop."""
+    from torchmetrics_tpu.ops.async_read import fetch_host
+
+    out: List[Divergence] = []
+    for key, leaf in _array_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards or len(shards) < 2 or not getattr(leaf, "is_fully_replicated", False):
+            continue
+        fps = [(s.device.id, host_leaf_fingerprint(fetch_host(s.data))) for s in shards]
+        reference = fps[0][1]
+        for device_id, fp in fps[1:]:
+            if not np.array_equal(fp, reference):
+                out.append(
+                    Divergence("replica", key, int(device_id), _fp_words(reference), _fp_words(fp))
+                )
+    return out
+
+
+def expanded_divergences(states: Dict[str, Any], reductions: Dict[str, Any]) -> List[Divergence]:
+    """Verify the ``expand_canonical`` install invariant on a host-fetched
+    stacked state (parallel/reshard.py): replicated families (mean/max/min)
+    must be bit-identical across shards, and a sum field's shards 1..S-1
+    must hold the exact reduction identity. Valid right after an
+    expand/restore — the first local step legitimately de-replicates."""
+    from torchmetrics_tpu.ops.async_read import fetch_host
+    from torchmetrics_tpu.parallel.sync import reduction_identity
+
+    out: List[Divergence] = []
+    for name, value in states.items():
+        if name in _RESERVED_KEYS or not _is_arrayish(value) or getattr(value, "ndim", 0) < 1:
+            continue
+        fx = reductions.get(name)
+        if fx not in ("sum", "mean", "max", "min"):
+            continue
+        host = fetch_host(value)
+        shard_fps = [host_leaf_fingerprint(host[i]) for i in range(host.shape[0])]
+        if fx == "sum":
+            ident = np.broadcast_to(
+                np.asarray(reduction_identity(fx, host.dtype)).astype(host.dtype), host.shape[1:]
+            )
+            expected = host_leaf_fingerprint(ident)
+            start = 1
+        else:
+            expected = shard_fps[0]
+            start = 1
+        for shard in range(start, len(shard_fps)):
+            if not np.array_equal(shard_fps[shard], expected):
+                out.append(
+                    Divergence("replica", name, shard, _fp_words(expected), _fp_words(shard_fps[shard]))
+                )
+    return out
+
+
+def _compare_fps(
+    surface: str, expected: Dict[str, Any], observed: Dict[str, Any]
+) -> Tuple[int, List[Divergence]]:
+    """Compare two fingerprint maps over their shared keys (a leaf present
+    on one side only — a grown cat buffer, a reshaped field — is structural
+    change, not bit corruption, and is skipped)."""
+    checked = 0
+    out: List[Divergence] = []
+    for key in expected:
+        if key not in observed:
+            continue
+        exp = np.ascontiguousarray(expected[key])
+        got = np.ascontiguousarray(observed[key])
+        if exp.shape != got.shape:
+            continue
+        checked += 1
+        if not np.array_equal(exp, got):
+            shard = None
+            if exp.ndim == 2:  # per-shard map: attribute the first offending shard
+                for i in range(exp.shape[0]):
+                    if not np.array_equal(exp[i], got[i]):
+                        shard = i
+                        break
+            out.append(Divergence(surface, key, shard, _fp_words(exp), _fp_words(got)))
+    return checked, out
+
+
+def _fetch_tree(tree: Any) -> Any:
+    """D2H the array leaves of a pytree (worker-side / explicit-audit only;
+    routes through the pipeline's sanctioned fetch primitive)."""
+    from torchmetrics_tpu.ops.async_read import fetch_host
+
+    return jax.tree_util.tree_map(lambda v: fetch_host(v) if _is_arrayish(v) else v, tree)
+
+
+def _flight_divergence(report: "IntegrityReport", owner: str) -> StateDivergenceError:
+    first = report.divergences[0]
+    return obs.flighted(
+        StateDivergenceError(
+            f"{owner}: state integrity audit found {len(report.divergences)} divergent"
+            f" leaf/replica fingerprint(s); first: {first.surface} surface, leaf"
+            f" {first.field!r}"
+            + (f", shard {first.shard}" if first.shard is not None else "")
+            + f" (expected {first.expected}, observed {first.observed})",
+            surface=first.surface,
+            field=first.field,
+            shard=first.shard,
+            expected=first.expected,
+            observed=first.observed,
+        ),
+        domain="integrity",
+        owner=owner,
+        divergences=len(report.divergences),
+        update_count=report.update_count,
+    )
+
+
+def _record_divergence(report: "IntegrityReport", owner: str) -> None:
+    first = report.divergences[0]
+    obs.counter_inc("integrity.divergences", len(report.divergences))
+    obs.fault_breadcrumb(
+        "integrity_divergence",
+        domain="integrity",
+        data={
+            "owner": owner,
+            "surface": first.surface,
+            "field": first.field,
+            "shard": first.shard,
+            "divergences": len(report.divergences),
+            "update_count": report.update_count,
+            "policy": report.policy,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# The metric-attached auditor (chain + replica surfaces)
+# ---------------------------------------------------------------------------
+
+class IntegrityAuditor:
+    """Cadence-driven bit-exact audits of one live metric/collection member.
+
+    Attach to any :class:`~torchmetrics_tpu.Metric`::
+
+        auditor = IntegrityAuditor(metric, every_n_updates=8,
+                                   on_divergence="restore").attach()
+
+    After every ``every_n_updates``-th committed top-level update/forward
+    (the same observer seam the Autosaver rides) the just-committed state is
+    *captured*: device references are staged (free — arrays are immutable
+    and marked escaped, double-buffering them against the next donating
+    dispatch) and the read-pipeline worker fetches them, fingerprints every
+    leaf, and records the baseline ``(update_count, fingerprints[, host
+    copy])``. The step loop never blocks.
+
+    An :meth:`audit` (explicit, or implicit at every read while attached —
+    ``compute``/``compute_async`` verify before serving) re-fingerprints
+    the live state; while the update count still equals the baseline's, the
+    bits MUST match (the **chain** surface), and replicated leaves must
+    agree across devices (the **replica** surface). On divergence,
+    ``on_divergence`` resolves exactly like ``on_shard_loss``:
+
+    - ``"raise"`` — flighted :class:`StateDivergenceError` (``integrity``
+      flight domain);
+    - ``"degraded"`` — the last-good computed value is served as a
+      :class:`~torchmetrics_tpu.quarantine.DegradedValue` with its staleness
+      attribution (reads only; an explicit audit records and reports);
+    - ``"restore"`` — the baseline host copy is reinstalled via
+      ``load_state`` (same update count — nothing is lost) and the read
+      proceeds on the verified bits; requires ``snapshots=True``.
+
+    ``snapshots=False`` skips the host copy (fingerprints only — for states
+    too large to mirror); ``"restore"`` then degrades to ``"raise"`` with a
+    breadcrumb. Detection window: corruption is caught while the update
+    count has not moved past the last capture — run ``every_n_updates=1``
+    (the default) to make that every inter-update gap; corruption folded
+    into a later committed update is the documented TOCTOU residue
+    (docs/ROBUSTNESS.md).
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        every_n_updates: int = 1,
+        on_divergence: str = "raise",
+        snapshots: bool = True,
+    ) -> None:
+        if on_divergence not in INTEGRITY_POLICIES:
+            raise ValueError(
+                f"on_divergence must be one of {INTEGRITY_POLICIES}, got {on_divergence!r}"
+            )
+        if every_n_updates < 1:
+            raise ValueError(f"every_n_updates must be >= 1, got {every_n_updates}")
+        self.metric = metric
+        self.every_n_updates = every_n_updates
+        self.on_divergence = on_divergence
+        self.snapshots = snapshots
+        self.stats: Dict[str, Any] = {
+            "captures": 0,
+            "audits": 0,
+            "divergences": 0,
+            "degraded_serves": 0,
+            "restores": 0,
+            "stale_baselines": 0,
+            "last_divergence": None,
+        }
+        self._since = 0
+        self._lock = threading.Lock()
+        #: (update_count, {path: uint32[2]}, host state copy or None)
+        self._baseline: Optional[Tuple[int, Dict[str, np.ndarray], Optional[Dict[str, Any]]]] = None
+        self._detach_fns: List[Callable[[], None]] = []
+
+    # ------------------------------------------------------------- attachment
+    def attach(self) -> "IntegrityAuditor":
+        """Observe committed updates and hook the read points (idempotent)."""
+        if not self._detach_fns:
+            self._detach_fns.append(self.metric.add_update_observer(self._on_update))
+            self.metric.__dict__["_integrity_auditor"] = self
+        return self
+
+    def detach(self) -> None:
+        for fn in self._detach_fns:
+            fn()
+        self._detach_fns.clear()
+        if self.metric.__dict__.get("_integrity_auditor") is self:
+            del self.metric.__dict__["_integrity_auditor"]
+
+    def _on_update(self, _obj: Any) -> None:
+        self._since += 1
+        if self._since >= self.every_n_updates:
+            self._since = 0
+            self.capture()
+
+    # ---------------------------------------------------------------- capture
+    def capture(self, wait: bool = False) -> Any:
+        """Record the committed state's fingerprints (and host copy) as the
+        audit baseline. The hot path only stages escaped device references
+        and submits; the D2H + fold run on the read-pipeline worker."""
+        from torchmetrics_tpu.ops.async_read import get_pipeline
+
+        state = self.metric._copy_state_dict()  # by-reference; marks state escaped
+        count = int(self.metric._update_count)
+        self.stats["captures"] += 1
+        obs.counter_inc("integrity.captures")
+        with obs.span(obs.SPAN_INTEGRITY, suffix=type(self.metric).__name__):
+            future = get_pipeline().submit(
+                lambda: self._capture_job(state, count), owner="IntegrityAuditor.capture"
+            )
+        if wait:
+            future.result(60.0)
+        return future
+
+    def _capture_job(self, state: Dict[str, Any], count: int) -> int:
+        """WORKER-SIDE: fetch + fingerprint the staged refs, install baseline."""
+        host_state = _fetch_tree(state)
+        fps = host_fingerprints(host_state)
+        with self._lock:
+            if self._baseline is None or count >= self._baseline[0]:
+                self._baseline = (count, fps, host_state if self.snapshots else None)
+        return count
+
+    @property
+    def baseline_count(self) -> Optional[int]:
+        with self._lock:
+            return self._baseline[0] if self._baseline else None
+
+    # ------------------------------------------------------------------ audit
+    def audit(self, wait: bool = True) -> Any:
+        """Verify the live state against the baseline (chain surface) and
+        the per-device replicas of replicated leaves (replica surface).
+
+        ``wait=True`` (default) runs inline — an explicit audit is a
+        deliberate blocking read, like ``compute()``. ``wait=False`` submits
+        the verification to the read pipeline and returns a
+        :class:`~torchmetrics_tpu.ops.async_read.MetricFuture` resolving to
+        the :class:`IntegrityReport` (or raising, under ``"raise"``)."""
+        from torchmetrics_tpu.ops.async_read import get_pipeline
+
+        state = self.metric._copy_state_dict()
+        count = int(self.metric._update_count)
+        if wait:
+            with obs.span(
+                obs.SPAN_INTEGRITY, suffix=type(self.metric).__name__, histogram="integrity.audit_us"
+            ):
+                report = self._verify(state, count)
+                return self._apply_policy(report, serve_degraded=False)
+        with obs.span(obs.SPAN_INTEGRITY, suffix=type(self.metric).__name__):
+            return get_pipeline().submit(
+                lambda: self._apply_policy(self._verify(state, count), serve_degraded=False),
+                owner="IntegrityAuditor.audit",
+            )
+
+    def _verify(self, state: Dict[str, Any], count: int) -> IntegrityReport:
+        """Fingerprint ``state`` and compare (worker-side or explicit-audit
+        context: fetches are deliberate here)."""
+        self.stats["audits"] += 1
+        obs.counter_inc("integrity.audits")
+        divergences: List[Divergence] = list(replica_divergences(state))
+        # mirror surface: a recovery mirror claiming to equal this state must
+        # fingerprint-match it; divergence self-heals (invalidate -> the next
+        # snapshot rebuilds instead of serving corrupt rollback rows)
+        for name in ("_lane_mirror", "_class_mirror"):
+            mirror = self.metric.__dict__.get(name)
+            if mirror is not None and hasattr(mirror, "verify"):
+                if not mirror.verify(state, count):
+                    self.stats["mirror_rebuilds"] = self.stats.get("mirror_rebuilds", 0) + 1
+        checked = 0
+        action = "none"
+        with self._lock:
+            baseline = self._baseline
+        if baseline is not None and baseline[0] == count:
+            observed = host_fingerprints(_fetch_tree(state))
+            checked, chain = _compare_fps("chain", baseline[1], observed)
+            divergences.extend(chain)
+        elif baseline is not None:
+            # the count moved since the last capture: the chain baseline is
+            # stale (legitimate updates landed) — replica checks still ran
+            self.stats["stale_baselines"] += 1
+            action = "stale_baseline"
+        ok = not divergences
+        if not ok:
+            self.stats["divergences"] += len(divergences)
+            self.stats["last_divergence"] = divergences[0]._asdict()
+        return IntegrityReport(
+            ok=ok,
+            checked=checked,
+            divergences=tuple(divergences),
+            update_count=count,
+            policy=self.on_divergence,
+            action=action,
+        )
+
+    # ----------------------------------------------------- policy resolution
+    def _apply_policy(self, report: IntegrityReport, serve_degraded: bool) -> Any:
+        """Resolve a divergent report per ``on_divergence``; returns the
+        report (possibly action-updated), a DegradedValue for read paths, or
+        raises. Clean reports pass through."""
+        if report.ok:
+            return report
+        owner = type(self.metric).__name__
+        _record_divergence(report, owner)
+        policy = self.on_divergence
+        if policy == "restore":
+            restored = self._try_restore(report)
+            if restored is not None:
+                return restored
+            policy = "raise"  # no verified snapshot to restore from
+        if policy == "degraded":
+            self.stats["degraded_serves"] += 1
+            obs.counter_inc("integrity.degraded_serves")
+            if serve_degraded:
+                served = self._degraded_value(report)
+                if served is not None:
+                    return served
+                raise _flight_divergence(report, owner)  # nothing cached to serve
+            return report._replace(action="degraded")
+        raise _flight_divergence(report, owner)
+
+    def _degraded_value(self, report: IntegrityReport) -> Any:
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        last_good = self.metric.__dict__.get("_last_good_compute")
+        if last_good is None:
+            return None
+        count, value = last_good
+        live = int(self.metric._update_count)
+        obs.histogram_observe("reads.staleness_age_updates", live - count)
+        return DegradedValue(value=value, updates_behind=live - count, age_updates=count)
+
+    def _try_restore(self, report: IntegrityReport) -> Optional[IntegrityReport]:
+        """Reinstall the verified baseline host copy (same update count —
+        nothing is lost); also rebuilds any attached recovery mirror so a
+        diverged mirror never survives as a future restore source."""
+        with self._lock:
+            baseline = self._baseline
+        if baseline is None or baseline[2] is None or baseline[0] != report.update_count:
+            return None
+        count, fps, host_state = baseline
+        try:
+            self.metric.load_state(dict(host_state))
+        except Exception as err:  # noqa: BLE001 — restore failure escalates to raise
+            obs.fault_breadcrumb(
+                "integrity_restore_failed",
+                domain="integrity",
+                data={"owner": type(self.metric).__name__, "error": f"{type(err).__name__}: {err}"},
+            )
+            return None
+        self.metric.__dict__["_update_count"] = count
+        for name in ("_lane_mirror", "_class_mirror"):
+            mirror = self.metric.__dict__.get(name)
+            if mirror is not None and hasattr(mirror, "invalidate"):
+                mirror.invalidate()  # a diverged mirror must not survive as a restore source
+        self.stats["restores"] += 1
+        obs.counter_inc("integrity.restores")
+        obs.fault_breadcrumb(
+            "integrity_restored",
+            domain="integrity",
+            data={"owner": type(self.metric).__name__, "update_count": count},
+        )
+        return report._replace(action="restored")
+
+    # ------------------------------------------------------------ read hooks
+    def verify_read(self) -> Any:
+        """Read-point hook (``Metric.compute``): verify before serving.
+        Returns None when the read may proceed (clean, stale baseline, or a
+        completed restore), or a DegradedValue the wrapper should serve."""
+        state = self.metric._copy_state_dict()
+        count = int(self.metric._update_count)
+        with obs.span(
+            obs.SPAN_INTEGRITY, suffix=type(self.metric).__name__, histogram="integrity.audit_us"
+        ):
+            report = self._verify(state, count)
+            if report.ok:
+                return None
+            resolved = self._apply_policy(report, serve_degraded=True)
+        from torchmetrics_tpu.quarantine import DegradedValue
+
+        return resolved if isinstance(resolved, DegradedValue) else None
+
+    def wrap_async_read(self, body: Callable[[], Any], snapshot: Dict[str, Any], flags: Dict[str, Any]) -> Callable[[], Any]:
+        """Wrap a ``compute_async`` worker body: the submission-time snapshot
+        is verified ON THE WORKER before the read resolves, so the future
+        carries the same policy outcomes a blocking read would (raise /
+        degraded / restored) without ever blocking the submitting thread."""
+        count = int(flags["count"])
+
+        def verified_body() -> Any:
+            with obs.span(obs.SPAN_INTEGRITY, suffix=type(self.metric).__name__):
+                report = self._verify(snapshot, count)
+            if report.ok:
+                return body()
+            owner = type(self.metric).__name__
+            _record_divergence(report, owner)
+            if self.on_divergence == "restore":
+                with self._lock:
+                    baseline = self._baseline
+                if baseline is not None and baseline[2] is not None and baseline[0] == count:
+                    # swap the corrupt refs for the verified host copy in
+                    # place: the body reads `snapshot` at install time
+                    snapshot.clear()
+                    snapshot.update(
+                        {k: v for k, v in baseline[2].items() if k not in _RESERVED_KEYS}
+                    )
+                    self.stats["restores"] += 1
+                    obs.counter_inc("integrity.restores")
+                    self._try_restore(report)  # heal the live state too, if unmoved
+                    return body()
+            if self.on_divergence == "degraded":
+                self.stats["degraded_serves"] += 1
+                obs.counter_inc("integrity.degraded_serves")
+                from torchmetrics_tpu.quarantine import DegradedValue
+
+                last_good = flags.get("last_good")
+                if last_good is not None:
+                    good_count, value = last_good
+                    return DegradedValue(
+                        value=value, updates_behind=count - good_count, age_updates=good_count
+                    )
+            raise _flight_divergence(report, owner)
+
+        return verified_body
+
+
+# ---------------------------------------------------------------------------
+# The deferred-loop auditor (per-shard chain over externally carried states)
+# ---------------------------------------------------------------------------
+
+class DeferredIntegrity:
+    """Per-shard fingerprint audits of a deferred epoch loop's stacked state
+    (attached via ``DeferredCollectionStep.attach_integrity``).
+
+    The deferred layout carries state OUTSIDE any metric object, so the
+    auditor rides the step's commit seam instead of the observer: every
+    ``every_n_steps`` committed local steps, ONE jitted dispatch
+    fingerprints every shard of every leaf (``uint32[S, 2]`` per leaf —
+    bytes, not state) and the readback rides the pipeline. :meth:`audit`
+    re-fingerprints the carried states and, while the step count has not
+    moved, every shard's bits must match — a flip in ANY shard names the
+    shard it hit. ``on_divergence="restore"`` reinstalls the attached
+    :class:`~torchmetrics_tpu.parallel.reshard.ShardShadow` through the
+    reshard seam (``step.recover()``) and hands back fresh states.
+    """
+
+    def __init__(self, step: Any, every_n_steps: int = 8, on_divergence: str = "raise") -> None:
+        if on_divergence not in INTEGRITY_POLICIES:
+            raise ValueError(
+                f"on_divergence must be one of {INTEGRITY_POLICIES}, got {on_divergence!r}"
+            )
+        if every_n_steps < 1:
+            raise ValueError(f"every_n_steps must be >= 1, got {every_n_steps}")
+        self._step = step
+        self.every_n_steps = every_n_steps
+        self.on_divergence = on_divergence
+        self.stats: Dict[str, Any] = {
+            "captures": 0,
+            "audits": 0,
+            "divergences": 0,
+            "restores": 0,
+            "stale_baselines": 0,
+            "last_divergence": None,
+        }
+        self._lock = threading.Lock()
+        self._last_capture_step = -1
+        #: (step_count, {path: uint32[S, 2]})
+        self._baseline: Optional[Tuple[int, Dict[str, np.ndarray]]] = None
+
+    def due(self, steps: int) -> bool:
+        return steps - self._last_capture_step >= self.every_n_steps
+
+    # ---------------------------------------------------------------- capture
+    def observe(self, states: Any, steps: int) -> None:
+        """Commit-seam tick: dispatch the per-shard fingerprint executable
+        (enqueued — the step loop never waits) and park the readback on the
+        pipeline worker."""
+        from torchmetrics_tpu.ops.async_read import get_pipeline
+
+        self._last_capture_step = steps
+        self.stats["captures"] += 1
+        obs.counter_inc("integrity.captures")
+        with obs.span(obs.SPAN_INTEGRITY, suffix="DeferredCollectionStep"):
+            fps = device_shard_fingerprints(states)  # one dispatch, not awaited
+            get_pipeline().submit(
+                lambda: self._capture_job(fps, steps), owner="DeferredIntegrity.capture"
+            )
+
+    def _capture_job(self, fps: Dict[str, jnp.ndarray], steps: int) -> int:
+        host = {k: np.ascontiguousarray(_materialized(v)) for k, v in fps.items()}
+        with self._lock:
+            if self._baseline is None or steps >= self._baseline[0]:
+                self._baseline = (steps, host)
+        return steps
+
+    @property
+    def baseline_steps(self) -> Optional[int]:
+        with self._lock:
+            return self._baseline[0] if self._baseline else None
+
+    # ------------------------------------------------------------------ audit
+    def audit(self, states: Any) -> IntegrityReport:
+        """Verify the carried ``states`` against the last captured per-shard
+        fingerprints (blocking by contract, like ``reduce``). On divergence:
+        ``"raise"`` throws flighted; ``"degraded"`` records and reports;
+        ``"restore"`` reinstalls the shard shadow (``step.recover()``) and
+        returns the fresh states in ``report.restored_states`` — swap them
+        in for the carried pytree and continue the loop."""
+        self.stats["audits"] += 1
+        obs.counter_inc("integrity.audits")
+        steps = int(getattr(self._step, "steps", 0))
+        with self._lock:
+            baseline = self._baseline
+        with obs.span(
+            obs.SPAN_INTEGRITY, suffix="DeferredCollectionStep", histogram="integrity.audit_us"
+        ):
+            divergences: List[Divergence] = []
+            checked = 0
+            action = "none"
+            if baseline is not None and baseline[0] == steps:
+                fps = device_shard_fingerprints(states)
+                observed = {k: np.ascontiguousarray(_materialized(v)) for k, v in fps.items()}
+                checked, divergences = _compare_fps("chain", baseline[1], observed)
+            elif baseline is not None:
+                self.stats["stale_baselines"] += 1
+                action = "stale_baseline"
+        ok = not divergences
+        report = IntegrityReport(
+            ok=ok,
+            checked=checked,
+            divergences=tuple(divergences),
+            update_count=steps,
+            policy=self.on_divergence,
+            action=action,
+        )
+        if ok:
+            return report
+        self.stats["divergences"] += len(divergences)
+        self.stats["last_divergence"] = divergences[0]._asdict()
+        _record_divergence(report, "DeferredCollectionStep")
+        if self.on_divergence == "restore" and getattr(self._step, "shadow", None) is not None:
+            if getattr(self._step.shadow, "snapshot", lambda: None)() is not None:
+                fresh = self._step.recover()
+                self.stats["restores"] += 1
+                obs.counter_inc("integrity.restores")
+                obs.fault_breadcrumb(
+                    "integrity_restored",
+                    domain="integrity",
+                    data={"owner": "DeferredCollectionStep", "steps": steps},
+                )
+                return report._replace(action="restored", restored_states=fresh)
+        if self.on_divergence == "degraded":
+            obs.counter_inc("integrity.degraded_serves")
+            return report._replace(action="degraded")
+        raise _flight_divergence(report, "DeferredCollectionStep")
+
+
+def _materialized(value: Any) -> Any:
+    """Worker/audit-side ready-wait on a tiny fingerprint array (the
+    pipeline's sanctioned blocking primitive)."""
+    from torchmetrics_tpu.ops.async_read import fetch_host
+
+    return fetch_host(value)

@@ -47,6 +47,7 @@ from torchmetrics_tpu import obs
 from torchmetrics_tpu.utils.exceptions import (
     CheckpointCorruptionError,
     StateCorruptionError,
+    StateDivergenceError,
     TopologyMismatchError,
     TorchMetricsUserError,
 )
@@ -81,6 +82,15 @@ _SHARDS_KEY = "_sharded_shards"
 
 def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
+
+
+def _leaf_fingerprint(arr: np.ndarray) -> np.ndarray:
+    """Pre-save state fingerprint of one export leaf (integrity.py's
+    bit-exact uint32[2] fold) — carried in the manifest so the restore path
+    can verify the INSTALLED device state, not just the bytes at rest."""
+    from torchmetrics_tpu.integrity import host_leaf_fingerprint
+
+    return host_leaf_fingerprint(arr)
 
 
 def _world_topology() -> Dict[str, Any]:
@@ -217,6 +227,11 @@ def _snapshot_bytes(obj: Any, state: Dict[str, Any], update_count: Optional[int]
             "shape": list(arr.shape),
             "dtype": str(arr.dtype),
             "sha256": _sha256(np.ascontiguousarray(arr).tobytes()),
+            # pre-save state fingerprint (integrity.py): restore_state
+            # re-fingerprints the INSTALLED device state against this, so
+            # install-path corruption (H2D, aliasing) is caught — the sha256
+            # above only ever covers the bytes at rest
+            "fingerprint": [int(w) for w in _leaf_fingerprint(arr)],
         }
         for i, (desc, arr) in enumerate(leaves)
     ]
@@ -650,6 +665,68 @@ def _force_fold(obj: Any) -> None:
             member_fold()
 
 
+def _verify_installed_state(path: str, manifest: Dict[str, Any], obj: Any) -> None:
+    """Re-fingerprint the state ``obj`` installed and compare per-leaf
+    against the manifest's pre-save fingerprints (where present — older
+    snapshots verify vacuously). Leaves whose installed shape/dtype differ
+    from the saved ones (a ``validate="cast"`` conversion, a grown buffer)
+    are legitimately transformed and skipped. A mismatch on an unchanged
+    leaf is install-path corruption: breadcrumb + counter + flighted
+    :class:`StateDivergenceError` — a :class:`StateCorruptionError`
+    subclass, so a rotating-store scan falls back to the next older
+    snapshot exactly as for a torn file."""
+    entries = {
+        (e.get("leader"), e.get("field"), e.get("index")): e
+        for e in manifest.get("leaves", [])
+        if e.get("fingerprint")
+    }
+    if not entries:
+        return
+    try:
+        installed = obj.state()
+    except Exception as err:  # exotic wrappers without a state probe still restore
+        rank_zero_debug(
+            f"torchmetrics_tpu checkpoint: install verify skipped for {type(obj).__name__} ({err})"
+        )
+        return
+    leaves, _ = _flatten_export(installed)
+    for desc, arr in leaves:
+        entry = entries.get((desc["leader"], desc["field"], desc["index"]))
+        if entry is None:
+            continue
+        if list(arr.shape) != list(entry["shape"]) or str(arr.dtype) != entry["dtype"]:
+            continue
+        expected = [int(w) for w in entry["fingerprint"]]
+        observed = [int(w) for w in _leaf_fingerprint(arr)]
+        if observed != expected:
+            field = entry.get("field")
+            obs.counter_inc("checkpoint.integrity_mismatches")
+            obs.fault_breadcrumb(
+                "checkpoint_integrity_mismatch",
+                domain="integrity",
+                data={
+                    "snapshot": os.path.basename(path),
+                    "leader": entry.get("leader"),
+                    "field": field,
+                    "expected": expected,
+                    "observed": observed,
+                },
+            )
+            raise obs.flighted(
+                StateDivergenceError(
+                    f"{path}: installed state leaf {field!r} does not fingerprint-match the"
+                    f" snapshot (expected {expected}, observed {observed}) — the restore"
+                    " installed different bits than were saved",
+                    surface="restore",
+                    field=field,
+                    expected=tuple(expected),
+                    observed=tuple(observed),
+                ),
+                domain="integrity",
+                snapshot=os.path.basename(path),
+            )
+
+
 def _restore_file(
     path: str, obj: Any, validate: str, check_finite: bool, topology: str = "strict"
 ) -> Dict[str, Any]:
@@ -676,6 +753,14 @@ def _restore_file(
     if target_capacity is not None and "target_capacity" in params:
         kwargs["target_capacity"] = target_capacity
     obj.load_state(state, **kwargs)
+    if action in ("match", "legacy"):
+        # verified recovery surface (integrity.py): re-fingerprint the state
+        # the object actually INSTALLED against the manifest's pre-save
+        # fingerprints — the per-leaf sha256 only covers bytes at rest, so a
+        # flip introduced on the install path (H2D, aliasing, cast bug) would
+        # otherwise restore silently. Elastic actions (fold/remap/reshard)
+        # legitimately transform the bits and are structurally unverifiable.
+        _verify_installed_state(path, manifest, obj)
     if action == "fold":
         # elastic: the stacked layout no longer matches this world — fold to
         # the topology-neutral canonical form NOW; the folded value is the

@@ -912,6 +912,15 @@ class Metric:
             if self._computed is not None:
                 return self._computed
             self._fold_pending()  # sharded restore: re-reduce before sync/compute
+            auditor = self.__dict__.get("_integrity_auditor")
+            if auditor is not None:
+                # read-point integrity audit (integrity.py): verify the bits
+                # before serving them — a divergence raises, restores the
+                # verified baseline in place, or hands back the last-good
+                # value to serve as a DegradedValue per on_divergence
+                served = auditor.verify_read()
+                if served is not None:
+                    return served
             self.__dict__.pop("_serve_last_good", None)
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
@@ -970,6 +979,41 @@ class Metric:
                 obs.remove(callback)
 
         return detach
+
+    def attach_integrity(
+        self,
+        every_n_updates: int = 1,
+        on_divergence: str = "raise",
+        snapshots: bool = True,
+    ) -> Any:
+        """Attach a bit-exact state-integrity auditor (integrity.py) riding
+        the committed-update observer seam: every ``every_n_updates``-th
+        commit captures the state's fingerprints (host readback on the read
+        pipeline — the step loop never blocks), and every read verifies the
+        live bits against them before serving. ``on_divergence`` picks the
+        policy (``"raise"``/``"degraded"``/``"restore"`` — the
+        ``on_shard_loss`` triple); ``snapshots=False`` keeps fingerprints
+        only (no host copy, so ``"restore"`` degrades to ``"raise"``).
+        Returns the attached :class:`~torchmetrics_tpu.integrity.IntegrityAuditor`
+        (``auditor.detach()`` to remove; also exposed as
+        ``metric.integrity``)."""
+        from torchmetrics_tpu.integrity import IntegrityAuditor
+
+        existing = self.__dict__.get("_integrity_auditor")
+        if existing is not None:
+            existing.detach()
+        return IntegrityAuditor(
+            self,
+            every_n_updates=every_n_updates,
+            on_divergence=on_divergence,
+            snapshots=snapshots,
+        ).attach()
+
+    @property
+    def integrity(self) -> Any:
+        """The attached :class:`~torchmetrics_tpu.integrity.IntegrityAuditor`
+        (None when :meth:`attach_integrity` was never called)."""
+        return self.__dict__.get("_integrity_auditor")
 
     def _notify_update(self) -> None:
         """Fire update observers — only at top level (not inside forward's
@@ -1395,7 +1439,14 @@ class Metric:
         snapshot = self._copy_state_dict()  # by-reference; marks state escaped
         flags = self._capture_read_flags()
         clone = self._read_clone()
-        return lambda: self._async_compute_job(clone, snapshot, flags)
+        body = lambda: self._async_compute_job(clone, snapshot, flags)  # noqa: E731
+        auditor = self.__dict__.get("_integrity_auditor")
+        if auditor is not None:
+            # verify the submission-time snapshot ON THE WORKER before the
+            # read resolves (integrity.py): the future carries the same
+            # policy outcomes a blocking read would, without blocking here
+            body = auditor.wrap_async_read(body, snapshot, flags)
+        return body
 
     def _install_read_snapshot(self, clone: "Metric", snapshot: Dict[str, Any], flags: Dict[str, Any]) -> None:
         """WORKER-SIDE: stage a submission-time snapshot into the read clone
@@ -2133,6 +2184,7 @@ class Metric:
         # observers are process-local callbacks (autosavers, fault hooks): a
         # pickled/cloned copy must not inherit another instance's triggers
         state.pop("_update_observers", None)
+        state.pop("_integrity_auditor", None)  # holds a lock + live-metric ref
         state.pop("_forward_depth", None)
         # the async-read clone and its inline verdict are process-local (and
         # keeping the clone would deep-copy it into every clone-of-a-clone)

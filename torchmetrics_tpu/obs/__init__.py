@@ -55,6 +55,7 @@ from torchmetrics_tpu.obs.tracer import (  # noqa: F401
     SPAN_EXPORT,
     SPAN_FLEET_MERGE,
     SPAN_FLEET_SHIP,
+    SPAN_INTEGRITY,
     SPAN_KERNEL,
     SPAN_LANES,
     SPAN_NAMES,

@@ -73,6 +73,7 @@ DOMAINS = (
     "kernels",     # backend gate decisions (ops/kernels.py)
     "fleet",       # cross-process delta uplinks: ship/merge/failover (fleet/)
     "windows",     # streaming window ring: advance, late-event routing, drops
+    "integrity",   # state-integrity audits: fingerprint chain, replica drift, mirror/restore verify
 )
 
 #: canonical span name -> flight domain (consumed by obs/tracer.span on exit;
@@ -104,6 +105,7 @@ DOMAIN_OF_SPAN = {
     "tm_tpu.fleet.ship": "fleet",
     "tm_tpu.fleet.merge": "fleet",
     "tm_tpu.windows.advance": "windows",
+    "tm_tpu.integrity.audit": "integrity",
 }
 
 

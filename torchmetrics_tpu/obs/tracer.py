@@ -90,6 +90,7 @@ SPAN_CLASS_ROUTE = "tm_tpu.class_route"    # class-axis shard routing (scatter) 
 SPAN_FLEET_SHIP = "tm_tpu.fleet.ship"      # leaf exporter: fold-to-delta + uplink transmit (per leaf)
 SPAN_FLEET_MERGE = "tm_tpu.fleet.merge"    # aggregator: ledger apply + per-leaf accumulate (per leaf)
 SPAN_WINDOWS = "tm_tpu.windows.advance"    # streaming ring advance: head rotate + masked slot reset
+SPAN_INTEGRITY = "tm_tpu.integrity.audit"  # state-integrity audit: fingerprint dispatch + verify half
 
 #: every canonical span name, for docs/tests
 SPAN_NAMES = (
@@ -119,6 +120,7 @@ SPAN_NAMES = (
     SPAN_FLEET_SHIP,
     SPAN_FLEET_MERGE,
     SPAN_WINDOWS,
+    SPAN_INTEGRITY,
 )
 
 

@@ -2398,6 +2398,7 @@ class DeferredCollectionStep:
         self._shadow: Optional[Any] = None
         self._on_shard_loss = "raise"
         self._recovered_states: Optional[Any] = None
+        self._integrity: Optional[Any] = None
 
     def _b_specs(self, batch):
         from jax.sharding import PartitionSpec as P
@@ -2456,6 +2457,7 @@ class DeferredCollectionStep:
                 out = fn(fresh, *batch)
         self._steps += 1
         self._tick_shadow(out)
+        self._tick_integrity(out)
         return out
 
     def local_epoch(self, states, *stacked):
@@ -2493,6 +2495,7 @@ class DeferredCollectionStep:
                 out = fn(fresh, *stacked)
         self._steps += int(jnp.shape(stacked[0])[0]) if stacked else 0
         self._tick_shadow(out)
+        self._tick_integrity(out)
         return out
 
     def reduce(self, states):
@@ -2602,6 +2605,37 @@ class DeferredCollectionStep:
         self._shadow = ShardShadow(reductions_of, every_n_steps=every_n_steps)
         self._on_shard_loss = on_shard_loss
         return self._shadow
+
+    def _tick_integrity(self, states) -> None:
+        """Cadence hook on every committed local step/epoch: when an audit
+        capture is due, ONE jitted dispatch fingerprints every shard of every
+        leaf (enqueued, not awaited) and the readback rides the pipeline
+        (docs/ROBUSTNESS.md "Silent data corruption")."""
+        integrity = self._integrity
+        if integrity is None or not integrity.due(self._steps):
+            return
+        integrity.observe(states, self._steps)
+
+    def attach_integrity(self, every_n_steps: int = 8, on_divergence: str = "raise"):
+        """Audit the carried sharded state's bits on a cadence
+        (integrity.py): every ``every_n_steps``-th committed step captures
+        per-shard fingerprints (``uint32[S, 2]`` per leaf — bytes, not
+        state), and :meth:`~torchmetrics_tpu.integrity.DeferredIntegrity.audit`
+        verifies the carried states against them while the step count has
+        not moved, naming the shard a flip hit. ``on_divergence="restore"``
+        reinstalls the attached shard shadow (:meth:`recover`) — attach one
+        first. Returns the :class:`~torchmetrics_tpu.integrity.DeferredIntegrity`
+        (also exposed as :attr:`integrity`)."""
+        from torchmetrics_tpu.integrity import DeferredIntegrity
+
+        self._integrity = DeferredIntegrity(
+            self, every_n_steps=every_n_steps, on_divergence=on_divergence
+        )
+        return self._integrity
+
+    @property
+    def integrity(self):
+        return self._integrity
 
     @property
     def shadow(self):

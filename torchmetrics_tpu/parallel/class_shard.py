@@ -365,6 +365,42 @@ class ClassShardMirror:
                 return False
         return True
 
+    def verify(self, state, update_count: int) -> bool:
+        """Bit-exact coherence audit of the mirror against the live stacked
+        state it claims to equal (integrity.py "mirror" surface): valid while
+        the update count still matches the last snapshot's. A diverged mirror
+        is invalidated (next snapshot pays one full rebuild instead of
+        serving corrupt recovery cells) with a breadcrumb; returns False on
+        divergence. Blocking — audit/read-point use only."""
+        import numpy as np
+
+        if self._mirror is None or self._count != int(update_count):
+            return True  # cold or out-of-phase: nothing coherent to audit
+        from torchmetrics_tpu import obs
+        from torchmetrics_tpu.integrity import host_leaf_fingerprint
+
+        bad = None
+        for k, ref in self._mirror.items():
+            live = state.get(k)
+            if live is None or tuple(ref.shape) != tuple(jnp.shape(live)):
+                bad = k
+                break
+            if not np.array_equal(
+                host_leaf_fingerprint(ref), host_leaf_fingerprint(_assemble_host(live))
+            ):
+                bad = k
+                break
+        if bad is None:
+            return True
+        self.invalidate()
+        obs.counter_inc("integrity.mirror_rebuilds")
+        obs.fault_breadcrumb(
+            "mirror_divergence",
+            domain="integrity",
+            data={"mirror": "ClassShardMirror", "field": bad, "update_count": int(update_count)},
+        )
+        return False
+
     def snapshot(self, state, cells, update_count: int) -> _ClassMirrorRecovery:
         """Bring the mirror up to the pre-dispatch state (folding in the
         previous round's touched cells) and register this round's cells for

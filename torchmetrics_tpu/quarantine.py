@@ -669,6 +669,42 @@ class LaneStateMirror:
         self._count = int(update_count)
         return _MirrorRecovery(self)
 
+    def verify(self, state: Dict[str, Any], update_count: int) -> bool:
+        """Bit-exact coherence audit of the mirror against the live state it
+        claims to equal (integrity.py "mirror" surface): valid while the
+        update count still matches the last snapshot's. A diverged mirror —
+        a flipped bit on either side, a fold the chain tracking missed — is
+        invalidated (the next snapshot pays one full rebuild instead of
+        serving corrupt rollback rows) with a breadcrumb. Returns False on
+        divergence. Blocking (fingerprints fetch the compared rows): call
+        from audits/read points, not the dispatch loop."""
+        if self._mirror is None or self._count != int(update_count):
+            return True  # cold or out-of-phase: nothing coherent to audit
+        from torchmetrics_tpu.integrity import host_leaf_fingerprint
+        from torchmetrics_tpu.ops.async_read import fetch_host
+
+        bad = None
+        for k, ref in self._mirror.items():
+            live = state.get(k)
+            if live is None or tuple(ref.shape) != tuple(live.shape):
+                bad = k
+                break
+            if not np.array_equal(
+                host_leaf_fingerprint(ref), host_leaf_fingerprint(fetch_host(live))
+            ):
+                bad = k
+                break
+        if bad is None:
+            return True
+        self.invalidate()
+        obs.counter_inc("integrity.mirror_rebuilds")
+        obs.fault_breadcrumb(
+            "mirror_divergence",
+            domain="integrity",
+            data={"mirror": "LaneStateMirror", "field": bad, "update_count": int(update_count)},
+        )
+        return False
+
     def rows(self, lanes: Sequence[int]) -> Optional[Dict[str, np.ndarray]]:
         """Pre-dispatch rows for ``lanes`` (valid between :meth:`snapshot` and
         the next one) — the lane-granular rollback source. None when the

@@ -580,6 +580,169 @@ def corrupt_state(
     return out
 
 
+def _flip_bits_host(arr: np.ndarray, n_bits: int, seed: int) -> Tuple[np.ndarray, list]:
+    """Flip ``n_bits`` distinct random bits of ``arr``'s raw bytes; returns
+    the damaged copy and the flat bit positions hit."""
+    out = np.array(arr)
+    raw = out.reshape(-1).view(np.uint8)
+    if raw.size == 0:
+        raise ValueError("cannot flip bits of an empty array")
+    rng = np.random.RandomState(seed)
+    total = raw.size * 8
+    positions = rng.choice(total, size=min(int(n_bits), total), replace=False)
+    for pos in positions:
+        raw[pos // 8] ^= np.uint8(1 << (pos % 8))
+    return out, [int(p) for p in positions]
+
+
+def flip_state_bits(
+    target: Any, field: Optional[str] = None, n_bits: int = 1, seed: int = 0
+) -> Any:
+    """Silent data corruption: flip ``n_bits`` random bits of one state
+    leaf's raw bytes — the mercurial-core / DMA-corruption signature the
+    integrity layer (torchmetrics_tpu/integrity.py) exists to catch. No
+    shape, dtype, or NaN tell: only the bits change, so every pre-integrity
+    validator passes. Deterministic in ``seed``.
+
+    ``target`` is either a live ``Metric`` (its ``_state`` is corrupted IN
+    PLACE; returns an info dict with the victim ``field`` and flat ``bits``
+    hit) or a plain state pytree, e.g. the deferred loop's carried states
+    (never modified; returns ``(flipped_copy, info)`` — swap the copy in).
+    ``field`` picks the victim leaf (Metric targets; default first array
+    field); pytree targets flip the first array leaf found.
+    """
+    import jax as _jax
+
+    if hasattr(target, "_state") and isinstance(getattr(target, "_state"), dict):
+        state = target._state
+        candidates = [
+            k for k, v in state.items()
+            if not isinstance(v, (list, tuple)) and hasattr(v, "dtype") and k != "_update_count"
+        ]
+        if field is not None:
+            if field not in state:
+                raise KeyError(f"field {field!r} not in state")
+            candidates = [field]
+        if not candidates:
+            raise ValueError("metric state has no array field to corrupt")
+        victim = candidates[0]
+        value = state[victim]
+        flipped, bits = _flip_bits_host(np.array(value), n_bits, seed)
+        new_leaf = jnp.asarray(flipped)
+        try:  # keep the victim on its original placement (sharded deferred leaves)
+            new_leaf = _jax.device_put(new_leaf, value.sharding)
+        except (AttributeError, ValueError):
+            pass
+        object.__setattr__(target, "_state", {**state, victim: new_leaf})
+        target.__dict__["_computed"] = None  # a cached read would mask the flip
+        return {"field": victim, "bits": bits}
+
+    leaves, treedef = _jax.tree_util.tree_flatten(target)
+    idx = next(
+        (i for i, leaf in enumerate(leaves) if hasattr(leaf, "dtype") and hasattr(leaf, "shape")),
+        None,
+    )
+    if idx is None:
+        raise ValueError("pytree has no array leaf to corrupt")
+    value = leaves[idx]
+    flipped, bits = _flip_bits_host(np.array(value), n_bits, seed)
+    new_leaf = jnp.asarray(flipped)
+    try:
+        new_leaf = _jax.device_put(new_leaf, value.sharding)
+    except (AttributeError, ValueError):
+        pass
+    leaves[idx] = new_leaf
+    return _jax.tree_util.tree_unflatten(treedef, leaves), {"leaf_index": idx, "bits": bits}
+
+
+def skew_replica(states: Any, shard: int = 0, n_bits: int = 1, seed: int = 0) -> Tuple[Any, Dict[str, Any]]:
+    """Replica drift: flip ``n_bits`` bits in exactly ONE shard row of the
+    first stacked array leaf of ``states`` (a deferred loop's carried pytree)
+    — every other replica keeps the true bits, the way a single drifting
+    device presents. The per-shard fingerprint audit
+    (``DeferredCollectionStep.attach_integrity``) must name this shard.
+    Returns ``(skewed_copy, info)``; the input is never modified."""
+    import jax as _jax
+
+    leaves, treedef = _jax.tree_util.tree_flatten(states)
+    idx = next(
+        (
+            i for i, leaf in enumerate(leaves)
+            if hasattr(leaf, "dtype") and getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] > shard
+        ),
+        None,
+    )
+    if idx is None:
+        raise ValueError(f"states has no stacked array leaf with a shard {shard}")
+    value = leaves[idx]
+    host = np.array(value)
+    row, bits = _flip_bits_host(host[shard], n_bits, seed)
+    host[shard] = row
+    new_leaf = jnp.asarray(host)
+    try:
+        new_leaf = _jax.device_put(new_leaf, value.sharding)
+    except (AttributeError, ValueError):
+        pass
+    leaves[idx] = new_leaf
+    return (
+        _jax.tree_util.tree_unflatten(treedef, leaves),
+        {"leaf_index": idx, "shard": int(shard), "bits": bits},
+    )
+
+
+@contextmanager
+def corrupt_delta_payload(leaf: Any, n: int = 1, seed: int = 0) -> Generator[Dict[str, int], None, None]:
+    """Corrupt the first ``n`` of ``leaf``'s deltas IN FLIGHT at the
+    ``Uplink.transmit`` seam: a bit flips in the payload after the exporter
+    stamped its ship-time checksum (fleet/delta.py ``payload_checksum``), the
+    way a relay/serialization fault presents. The receiving ledger must hash-
+    mismatch, DROP without merging, quarantine the leaf, and heal through the
+    requested full resync — converging bit-exact with the fault-free run.
+    The sender's outbox copy is never touched (the corruption is a transport
+    event, not a source event). Yields counters (``corrupted``)."""
+    import copy
+    import dataclasses
+
+    from torchmetrics_tpu.fleet import transport as transport_mod
+
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    orig = transport_mod.Uplink.transmit
+    counters = {"corrupted": 0}
+    rng = np.random.RandomState(seed)
+
+    def damaged(payload: Any) -> Any:
+        out = copy.deepcopy(payload)
+
+        def walk(value: Any) -> bool:
+            if isinstance(value, dict):
+                return any(walk(v) for v in value.values())
+            if isinstance(value, (list, tuple)):
+                return any(walk(v) for v in value)
+            if isinstance(value, np.ndarray) and value.size:
+                raw = value.reshape(-1).view(np.uint8)
+                pos = int(rng.randint(0, raw.size * 8))
+                raw[pos // 8] ^= np.uint8(1 << (pos % 8))
+                return True
+            return False
+
+        if not walk(out):
+            raise ValueError(f"delta payload for {leaf!r} has no array to corrupt")
+        return out
+
+    def patched(self: Any, node_id: str, delta: Any) -> Any:
+        if delta.leaf == leaf and counters["corrupted"] < n:
+            counters["corrupted"] += 1
+            delta = dataclasses.replace(delta, payload=damaged(delta.payload))
+        return orig(self, node_id, delta)
+
+    transport_mod.Uplink.transmit = patched
+    try:
+        yield counters
+    finally:
+        transport_mod.Uplink.transmit = orig
+
+
 def torn_write(path: Any, mode: str = "truncate", frac: float = 0.5, seed: int = 0) -> None:
     """Damage a snapshot FILE in place, the way real storage failures present.
 

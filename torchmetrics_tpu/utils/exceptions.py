@@ -75,6 +75,40 @@ class TopologyMismatchError(StateCorruptionError):
         self.current = current
 
 
+class StateDivergenceError(StateCorruptionError):
+    """Live state failed a bit-exact integrity audit (torchmetrics_tpu/integrity.py).
+
+    Raised under ``on_divergence="raise"`` when one of the three audit
+    surfaces finds bits that should be identical and are not
+    (docs/ROBUSTNESS.md "Silent data corruption"):
+
+    - **chain**: the state's fingerprint no longer matches the one recorded
+      at the last committed update although the update count has not moved —
+      something mutated accumulated state outside an update (bit flip,
+      donation/aliasing bug);
+    - **replica**: a replicated value (post-reduce output, replicated
+      shard stack, per-device copies of a synced state) differs between
+      replicas that must be bit-identical by construction;
+    - **mirror** / **restore**: a host recovery mirror or a freshly installed
+      checkpoint does not fingerprint-match the state it claims to be.
+
+    Subclasses :class:`StateCorruptionError` so the rotating-store restore
+    scan treats a fingerprint-mismatched install exactly like a torn file
+    (skip + breadcrumb + try the next older snapshot). Carries the audit
+    attribution: ``surface`` (``"chain"``/``"replica"``/``"mirror"``/
+    ``"restore"``), the offending ``field``, the ``shard``/replica index when
+    one is implicated, and the ``expected``/``observed`` fingerprint words.
+    """
+
+    def __init__(self, message: str, surface=None, field=None, shard=None, expected=None, observed=None) -> None:
+        super().__init__(message)
+        self.surface = surface
+        self.field = field
+        self.shard = shard
+        self.expected = expected
+        self.observed = observed
+
+
 class ShardLossError(TorchMetricsUserError):
     """A per-device shard of deferred (locally-accumulated) state is gone.
 
